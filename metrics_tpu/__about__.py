@@ -1,0 +1,9 @@
+__version__ = "0.1.0"
+__author__ = "metrics-tpu developers"
+__license__ = "Apache-2.0"
+__docs__ = (
+    "TPU-native metrics framework: 80+ machine-learning metrics as pure JAX/XLA "
+    "programs with mesh-aware distributed accumulation."
+)
+
+__all__ = ["__version__", "__author__", "__license__", "__docs__"]

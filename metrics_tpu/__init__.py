@@ -1,0 +1,35 @@
+"""metrics_tpu — TPU-native metrics framework (JAX/XLA).
+
+A from-scratch re-design of the capabilities of TorchMetrics
+(`/root/reference`, v0.10.0dev) for TPU: metric state is a pytree of immutable
+JAX arrays, update/compute are pure jittable kernels, and distributed
+accumulation lowers to fused XLA collectives over a `jax.sharding.Mesh`.
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+from metrics_tpu.__about__ import __version__  # noqa: E402
+from metrics_tpu.aggregation import (  # noqa: E402
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    SumMetric,
+)
+from metrics_tpu.collections import MetricCollection  # noqa: E402
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+
+__all__ = [
+    "__version__",
+    "Metric",
+    "CompositionalMetric",
+    "MetricCollection",
+    "CatMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "MinMetric",
+    "SumMetric",
+]

@@ -1,0 +1,162 @@
+"""Stream aggregation metrics: Max/Min/Sum/Cat/Mean.
+
+Parity: reference `src/torchmetrics/aggregation.py` (``BaseAggregator`` `:24`,
+``_cast_and_nan_check_input`` `:66`, subclasses `:119-364`).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class BaseAggregator(Metric):
+    """Base for simple stream aggregators.
+
+    Args:
+        fn: reduction spec for the state ("sum"/"max"/"min"/"cat").
+        default_value: initial state value.
+        nan_strategy: "error" | "warn" | "ignore" | float (impute value).
+    """
+
+    full_state_update: Optional[bool] = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[jax.Array, list],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed = ("error", "warn", "ignore")
+        if not (nan_strategy in allowed or isinstance(nan_strategy, (int, float))):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed} but got {nan_strategy}"
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    # value substituted for dropped NaNs when shapes must stay static (jit
+    # tracing); the identity element of the subclass's reduction.
+    _nan_neutral: float = 0.0
+
+    def _cast_and_nan_check_input(
+        self, x: Union[float, jax.Array], weight: Optional[Union[float, jax.Array]] = None
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Cast to float and apply the NaN strategy (to values AND weights).
+
+        "error"/"warn"/"ignore" drop offending elements when arrays are
+        concrete; under jit tracing (static shapes) "ignore" masks with the
+        subclass's reduction-identity ``_nan_neutral`` and zero weight, while
+        "error"/"warn" cannot inspect values and fall through.
+        """
+        # accumulate in the state's dtype so .bfloat16()/.double() casts stick
+        state_dtype = self.value.dtype if not isinstance(self.value, list) else jnp.float32
+        acc_dtype = state_dtype if jnp.issubdtype(state_dtype, jnp.floating) else jnp.float32
+        x = jnp.asarray(x, dtype=acc_dtype)
+        weight = jnp.ones_like(x) if weight is None else jnp.broadcast_to(
+            jnp.asarray(weight, dtype=acc_dtype), x.shape
+        )
+        nans = jnp.isnan(x) | jnp.isnan(weight)
+        is_tracer = isinstance(x, jax.core.Tracer) or isinstance(weight, jax.core.Tracer)
+        if isinstance(self.nan_strategy, str):
+            if not is_tracer and bool(jnp.any(nans)):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encounted `nan` values in tensor")
+                if self.nan_strategy == "warn":
+                    rank_zero_warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+                x, weight = x[~nans], weight[~nans]
+            elif is_tracer and self.nan_strategy == "ignore":
+                x = jnp.where(nans, self._nan_neutral, x)
+                weight = jnp.where(nans, 0.0, weight)
+        else:
+            x = jnp.where(jnp.isnan(x), float(self.nan_strategy), x)
+            weight = jnp.where(jnp.isnan(weight), float(self.nan_strategy), weight)
+        return x.reshape(-1), weight.reshape(-1)
+
+    def update(self, value: Union[float, jax.Array]) -> None:  # noqa: D102
+        raise NotImplementedError
+
+    def compute(self) -> jax.Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference `aggregation.py:119-166`)."""
+
+    _nan_neutral = float("-inf")
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, jax.Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:  # numel check only meaningful eagerly
+            self.value = jnp.maximum(self.value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference `aggregation.py:169-216`)."""
+
+    _nan_neutral = float("inf")
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, jax.Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.minimum(self.value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference `aggregation.py:219-265`)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, jax.Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.value = self.value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference `aggregation.py:268-313`)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, jax.Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> jax.Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference `aggregation.py:316-364`)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, jax.Array], weight: Union[float, jax.Array] = 1.0) -> None:
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> jax.Array:
+        return self.value / self.weight
+
+
+__all__ = ["BaseAggregator", "MaxMetric", "MinMetric", "SumMetric", "CatMetric", "MeanMetric"]

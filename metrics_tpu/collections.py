@@ -1,0 +1,314 @@
+"""MetricCollection — shared-call fan-out over a dict of metrics with compute groups.
+
+Parity: reference `src/torchmetrics/collections.py:29-457` (forward/update fan-out
+`:151-189`, group merge `:191-249`, state sharing `:251-267`, naming `:390-408`).
+
+TPU-first notes: metric states are immutable ``jax.Array`` leaves, so compute-group
+state sharing is plain reference assignment with no aliasing hazard — the
+reference's ``copy_state`` machinery only matters for list-kind states (python
+lists mutate in place).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import _flatten_dict, allclose
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricCollection:
+    """Chain metrics with the same call signature into a single object.
+
+    Args:
+        metrics: a Metric, a sequence of Metrics (keyed by class name), or a
+            dict name -> Metric (keys sorted alphabetically).
+        prefix / postfix: strings added around output-dict keys.
+        compute_groups: ``True`` to auto-detect metrics that share identical
+            state (only the group leader updates — "2x-3x lower computational
+            cost", reference `docs/source/pages/overview.rst:313-316`); a list of
+            lists to pin groups manually; ``False`` to disable.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked: bool = False
+        self._state_is_copy: bool = False
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ----------------------------------------------------------- call surface
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call ``forward`` on every metric; kwargs filtered per update signature."""
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True, copy_state=False)}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update every metric (or just each compute-group leader)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+                for name in cg[1:]:
+                    mi = self._modules[name]
+                    mi._update_count = m0._update_count
+                    mi._computed = None  # leader's update must invalidate members' caches
+            if self._state_is_copy:
+                self._compute_groups_create_state_ref()
+                self._state_is_copy = False
+        else:
+            for _, m in self.items(keep_base=True, copy_state=False):
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._compute_groups_create_state_ref()
+                self._groups_checked = True
+
+    def compute(self) -> Dict[str, Any]:
+        res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def reset(self) -> None:
+        for _, m in self.items(keep_base=True, copy_state=False):
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked:
+            self._compute_groups_create_state_ref()
+
+    # ---------------------------------------------------------- compute groups
+    def _merge_compute_groups(self) -> None:
+        """Merge groups whose leaders hold pairwise-identical states."""
+        n_groups = len(self._groups)
+        while True:
+            for idx1, members1 in list(self._groups.items()):
+                merged = False
+                for idx2, members2 in list(self._groups.items()):
+                    if idx1 == idx2 or idx1 not in self._groups or idx2 not in self._groups:
+                        continue
+                    metric1 = self._modules[members1[0]]
+                    metric2 = self._modules[members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[idx1].extend(self._groups.pop(idx2))
+                        merged = True
+                        break
+                if merged:
+                    break
+            if len(self._groups) == n_groups:
+                break
+            n_groups = len(self._groups)
+        self._groups = {i: v for i, v in enumerate(self._groups.values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """True when two metrics hold byte-identical state (reference `:227-249`)."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1, state2 = getattr(metric1, key), getattr(metric2, key)
+            if type(state1) is not type(state2):
+                return False
+            if isinstance(state1, jax.Array):
+                if not (state1.shape == state2.shape and allclose(state1, state2)):
+                    return False
+            elif isinstance(state1, list):
+                if len(state1) != len(state2):
+                    return False
+                if not all(s1.shape == s2.shape and allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Point group members' states at the leader's (copy only list states)."""
+        if not self._state_is_copy:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                for name in cg[1:]:
+                    mi = self._modules[name]
+                    for state in m0._defaults:
+                        m0_state = getattr(m0, state)
+                        # arrays are immutable: plain refs are always safe; lists
+                        # need a copy when the caller may mutate them
+                        if copy and isinstance(m0_state, list):
+                            setattr(mi, state, deepcopy(m0_state))
+                        else:
+                            setattr(mi, state, m0_state)
+        self._state_is_copy = copy
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    def _init_compute_groups(self) -> None:
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = {i: k for i, k in enumerate(self._enable_compute_groups)}
+            for members in self._groups.values():
+                for metric in members:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the"
+                            f" collection. Please make sure that {self._enable_compute_groups} matches"
+                            f" {list(self._modules)}"
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(self._modules)}
+
+    # ------------------------------------------------------------- management
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, (str, dict)):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passed extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passed extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for _, m in self.items(keep_base=True, copy_state=False):
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, m in self._modules.items():
+            out.update(m.state_dict(prefix=f"{name}."))
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for name, m in self._modules.items():
+            m.load_state_dict(state_dict, prefix=f"{name}.", strict=strict)
+
+    def to_device(self, device: Any) -> "MetricCollection":
+        for m in self._modules.values():
+            m.to_device(device)
+        return self
+
+    # --------------------------------------------------------------- dict api
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_ordered_dict(self) -> "OrderedDict[str, Metric]":
+        return OrderedDict((self._set_name(k), v) for k, v in self._modules.items())
+
+    def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
+        if keep_base:
+            return self._modules.keys()
+        return self._to_renamed_ordered_dict().keys()
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        self._compute_groups_create_state_ref(copy_state)
+        if keep_base:
+            return self._modules.items()
+        return self._to_renamed_ordered_dict().items()
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules.values()
+
+    def __getitem__(self, key: str) -> Metric:
+        self._compute_groups_create_state_ref(True)
+        return self._modules[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules
+
+    def __iter__(self) -> Iterable[str]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def __repr__(self) -> str:
+        lines = [f"  ({k}): {v!r}" for k, v in self._modules.items()]
+        repr_str = "MetricCollection(\n" + ",\n".join(lines)
+        if self.prefix:
+            repr_str += f",\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f",\n  postfix={self.postfix}"
+        return repr_str + "\n)"
+
+
+__all__ = ["MetricCollection"]

@@ -1,0 +1,776 @@
+"""Core ``Metric`` base class — the L1 runtime.
+
+Parity target: reference ``src/torchmetrics/metric.py:44-961`` (state registry via
+``add_state`` `:158`, dual-purpose ``forward`` `:228-325`, state-merge table
+`:327-354`, sync engine `:356-506`, compute caching `:508-536`, serialization
+`:662-700`, operator composition `:743-846`).
+
+TPU-first redesign (not a port):
+
+- **No ``nn.Module``.** A metric is a plain object whose state is a pytree of
+  immutable ``jax.Array`` leaves (tensor kind) or python lists of arrays (cat
+  kind). Because arrays are immutable, the reference's snapshot/restore dance in
+  ``forward`` (`metric.py:249-325`) degenerates to holding references — zero
+  copies on the hot path.
+- **Pure-function export.** :meth:`as_functions` exposes ``(init, update,
+  compute)`` as pure functions over the state pytree, directly usable under
+  ``jax.jit`` / ``shard_map`` / ``lax.scan``. The stateful API and the SPMD API
+  are the same kernels.
+- **Fused distributed sync.** ``dist_reduce_fx`` is kept as a *spec* so that the
+  in-program path lowers "sum" to one ``lax.psum`` over ICI instead of the
+  reference's barrier + all_gather + host reduce. The host (multi-process) path
+  keeps the reference's uneven-shape gather protocol
+  (`utilities/distributed.py:128-151`).
+- **Grad-mode free.** JAX has no global autograd mode; differentiability is a
+  property of the pure functions (`jax.grad` over :meth:`as_functions`), so the
+  reference's ``_enable_grad`` bookkeeping disappears.
+"""
+from __future__ import annotations
+
+import copy
+import functools
+import inspect
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel.collectives import sync_pytree
+from metrics_tpu.parallel.reductions import resolve_reduction
+from metrics_tpu.parallel.sync import distributed_available as _dist_available
+from metrics_tpu.parallel.sync import gather_all_tensors
+from metrics_tpu.utils.data import _flatten, apply_to_collection, dim_zero_cat
+from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def jit_distributed_available() -> bool:
+    return _dist_available()
+
+
+class Metric(ABC):
+    """Base class for all metrics.
+
+    Subclasses implement :meth:`update` and :meth:`compute` and declare their
+    accumulator states with :meth:`add_state`. States come in two kinds
+    (reference `metric.py:202-216`):
+
+    - **tensor kind** — a fixed-shape ``jax.Array`` accumulator with a reduction
+      spec (``"sum" | "mean" | "max" | "min"`` or a callable);
+    - **list kind** — an unbounded python list of arrays with ``"cat"``/``None``
+      reduction (concatenated / stacked across devices at sync time).
+
+    Constructor kwargs (reference `metric.py:93-117`):
+        compute_on_cpu: move list states to host memory after each update to
+            free HBM (reference ``compute_on_cpu``, `metric.py:404-414`).
+        dist_sync_on_step: sync state when computing the batch value in
+            ``forward`` (expensive; reference `metric.py:96-99`).
+        process_group: reserved for host-path process subsets; the SPMD path
+            expresses scope as a mesh axis instead (SURVEY §2.10).
+        dist_sync_fn: custom gather callable (host path injection point).
+        sync_on_compute: whether ``compute()`` syncs automatically.
+    """
+
+    __jit_unused_properties__: List[str] = ["update_called", "update_count"]
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = None
+
+    def __init__(
+        self,
+        *,
+        compute_on_cpu: bool = False,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        sync_on_compute: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        if kwargs:
+            raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
+        if not isinstance(compute_on_cpu, bool):
+            raise ValueError(f"Expected `compute_on_cpu` to be a bool, got {compute_on_cpu}")
+        if not isinstance(dist_sync_on_step, bool):
+            raise ValueError(f"Expected `dist_sync_on_step` to be a bool, got {dist_sync_on_step}")
+        if dist_sync_fn is not None and not callable(dist_sync_fn):
+            raise ValueError(f"Expected `dist_sync_fn` to be callable or None, got {dist_sync_fn}")
+        if not isinstance(sync_on_compute, bool):
+            raise ValueError(f"Expected `sync_on_compute` to be a bool, got {sync_on_compute}")
+
+        self.compute_on_cpu = compute_on_cpu
+        self.dist_sync_on_step = dist_sync_on_step
+        self.process_group = process_group
+        self.dist_sync_fn = dist_sync_fn
+        self.sync_on_compute = sync_on_compute
+
+        self._defaults: Dict[str, Any] = {}
+        self._reductions: Dict[str, Optional[Callable]] = {}
+        self._reduction_specs: Dict[str, Optional[str]] = {}
+        self._persistent: Dict[str, bool] = {}
+
+        self._update_count: int = 0
+        self._computed: Any = None
+        self._forward_cache: Any = None
+        self._is_synced: bool = False
+        self._cache: Optional[Dict[str, Any]] = None
+        self._to_sync: bool = self.sync_on_compute
+        self._should_unsync: bool = True
+
+        # wrap user update/compute with bookkeeping (reference `metric.py:121-122`)
+        self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
+        self.compute: Callable = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+    # ------------------------------------------------------------------ state
+    def add_state(
+        self,
+        name: str,
+        default: Union[jax.Array, list],
+        dist_reduce_fx: Union[str, Callable, None] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register an accumulator state (reference ``add_state`` `metric.py:158-226`)."""
+        if not name.isidentifier():
+            raise ValueError(f"Argument `name` must be a valid python identifier, got {name!r}")
+        is_list = isinstance(default, list)
+        if is_list and len(default) != 0:
+            raise ValueError("State defaults of list kind must be empty lists")
+        if not is_list:
+            default = jnp.asarray(default)
+
+        spec, fn = resolve_reduction(dist_reduce_fx)
+        self._defaults[name] = default
+        self._reductions[name] = fn
+        self._reduction_specs[name] = spec
+        self._persistent[name] = persistent
+        setattr(self, name, list(default) if is_list else default)
+
+    @property
+    def update_called(self) -> bool:
+        """Whether ``update``/``forward`` has been called since the last reset."""
+        return self._update_count > 0
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    @property
+    def metric_state(self) -> Dict[str, Any]:
+        """Current state pytree (name -> array or list of arrays)."""
+        return {name: getattr(self, name) for name in self._defaults}
+
+    def _state_snapshot(self) -> Dict[str, Any]:
+        # Arrays are immutable: holding references is a valid snapshot. Lists
+        # are shallow-copied because update() appends in place.
+        return {k: (list(v) if isinstance(v, list) else v) for k, v in self.metric_state.items()}
+
+    def _restore_state(self, state: Dict[str, Any]) -> None:
+        for name, value in state.items():
+            setattr(self, name, list(value) if isinstance(value, list) else value)
+
+    # ----------------------------------------------------------------- update
+    @abstractmethod
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate batch statistics into the metric state."""
+
+    @abstractmethod
+    def compute(self) -> Any:
+        """Finalise the accumulated state into the metric value."""
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped(*args: Any, **kwargs: Any) -> None:
+            self._computed = None
+            self._update_count += 1
+            update(*args, **kwargs)
+            if self.compute_on_cpu:
+                self._move_list_states_to_host()
+
+        return wrapped
+
+    def _move_list_states_to_host(self) -> None:
+        """Offload list states to host RAM to free HBM (``compute_on_cpu`` analogue)."""
+        for name in self._defaults:
+            value = getattr(self, name)
+            if isinstance(value, list):
+                setattr(self, name, [np.asarray(jax.device_get(v)) for v in value])
+
+    # ---------------------------------------------------------------- forward
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Compute the metric on the batch AND accumulate into global state.
+
+        Returns the batch-local value (same contract as reference
+        ``forward`` `metric.py:228-247`).
+        """
+        if self._is_synced:
+            raise MetricsUserError(
+                "The Metric shouldn't be synced when performing `forward`. "
+                "HINT: Did you forget to call `unsync()`?"
+            )
+        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+            self._forward_cache = self._forward_full_state_update(*args, **kwargs)
+        else:
+            self._forward_cache = self._forward_reduce_state_update(*args, **kwargs)
+        return self._forward_cache
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Two-update path: metrics whose update depends on pre-existing state."""
+        self.update(*args, **kwargs)
+        update_count = self._update_count
+
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        compute_on_cpu, self.compute_on_cpu = self.compute_on_cpu, False
+
+        cache = self._state_snapshot()
+        self.reset()
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        self._restore_state(cache)
+        self._update_count = update_count
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self.compute_on_cpu = compute_on_cpu
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Single-update fast path: batch state is merged into global state."""
+        global_state = self._state_snapshot()
+        update_count = self._update_count
+        self.reset()
+
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        compute_on_cpu, self.compute_on_cpu = self.compute_on_cpu, False
+
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        self._update_count = update_count + 1
+        self._reduce_states(global_state)
+
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self.compute_on_cpu = compute_on_cpu
+        return batch_val
+
+    def _reduce_states(self, incoming_state: Dict[str, Any]) -> None:
+        """Merge an incoming state into the current one (reference `metric.py:327-354`)."""
+        for name in self._defaults:
+            local = getattr(self, name)
+            incoming = incoming_state[name]
+            spec = self._reduction_specs[name]
+            if spec == "sum":
+                reduced = incoming + local
+            elif spec == "mean":
+                reduced = ((self._update_count - 1) * incoming + local) / self._update_count
+            elif spec == "max":
+                reduced = jnp.maximum(incoming, local)
+            elif spec == "min":
+                reduced = jnp.minimum(incoming, local)
+            elif spec == "cat":
+                reduced = incoming + local if isinstance(incoming, list) else jnp.concatenate([incoming, local])
+            elif spec is None and isinstance(incoming, list):
+                reduced = _flatten([incoming, local])
+            elif spec is None:
+                reduced = jnp.stack([incoming, local])
+            else:  # custom callable
+                reduced = self._reductions[name](jnp.stack([jnp.asarray(incoming), jnp.asarray(local)]))
+            setattr(self, name, reduced)
+
+    # ------------------------------------------------------------------- sync
+    def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
+        input_dict = {name: getattr(self, name) for name in self._reductions}
+        for name, spec in self._reduction_specs.items():
+            # pre-concatenate list states: one collective per state
+            if spec == "cat" and isinstance(input_dict[name], list) and len(input_dict[name]) > 1:
+                input_dict[name] = [dim_zero_cat(input_dict[name])]
+
+        output_dict = apply_to_collection(
+            input_dict, (jax.Array, np.ndarray), dist_sync_fn, group=process_group or self.process_group
+        )
+
+        for name, reduction_fn in self._reductions.items():
+            gathered = output_dict[name]
+            if isinstance(gathered, list) and len(gathered) == 0:
+                # never-updated list state: nothing was gathered on any rank
+                setattr(self, name, [])
+                continue
+            if isinstance(gathered[0], (jax.Array, np.ndarray)):
+                gathered = jnp.stack([jnp.asarray(g) for g in gathered])
+            elif isinstance(gathered[0], list):
+                gathered = _flatten(gathered)
+            if not (callable(reduction_fn) or reduction_fn is None):
+                raise TypeError("reduction_fn must be callable or None")
+            reduced = reduction_fn(gathered) if reduction_fn is not None else gathered
+            setattr(self, name, reduced)
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = jit_distributed_available,
+    ) -> None:
+        """Manually sync state across processes (reference `metric.py:416-450`)."""
+        if self._is_synced and should_sync:
+            raise MetricsUserError("The Metric has already been synced.")
+
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not should_sync or not is_distributed:
+            return
+
+        if dist_sync_fn is None:
+            dist_sync_fn = self.dist_sync_fn or gather_all_tensors
+
+        self._cache = self._state_snapshot()
+        self._sync_dist(dist_sync_fn, process_group=process_group)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore pre-sync local state (reference `metric.py:452-472`)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise MetricsUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise MetricsUserError("The internal cache should exist to unsync the Metric.")
+        self._restore_state(self._cache)
+        self._is_synced = False
+        self._cache = None
+
+    class _SyncContext:
+        def __init__(self, metric: "Metric", **kwargs: Any) -> None:
+            self.metric = metric
+            self.kwargs = kwargs
+            self.should_unsync = kwargs.pop("should_unsync", True)
+
+        def __enter__(self) -> "Metric":
+            self.metric.sync(**self.kwargs)
+            return self.metric
+
+        def __exit__(self, *exc: Any) -> None:
+            self.metric.unsync(should_unsync=self.should_unsync and self.metric._is_synced)
+
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = jit_distributed_available,
+    ) -> "Metric._SyncContext":
+        """Context manager: sync on enter, restore local state on exit."""
+        return Metric._SyncContext(
+            self,
+            dist_sync_fn=dist_sync_fn,
+            process_group=process_group,
+            should_sync=should_sync,
+            should_unsync=should_unsync,
+            distributed_available=distributed_available,
+        )
+
+    # ---------------------------------------------------------------- compute
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped(*args: Any, **kwargs: Any) -> Any:
+            if self._update_count == 0:
+                rank_zero_warn(
+                    f"The ``compute`` method of metric {self.__class__.__name__} was called before the ``update``"
+                    " method which may lead to errors, as metric states have not yet been updated.",
+                    UserWarning,
+                )
+            if self._computed is not None:
+                return self._computed
+
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                value = compute(*args, **kwargs)
+                self._computed = _squeeze_scalar(value)
+            return self._computed
+
+        return wrapped
+
+    def reset(self) -> None:
+        """Reset state to defaults (reference `metric.py:547-562`)."""
+        self._update_count = 0
+        self._forward_cache = None
+        self._computed = None
+        for name, default in self._defaults.items():
+            setattr(self, name, list(default) if isinstance(default, list) else default)
+        self._cache = None
+        self._is_synced = False
+
+    # ---------------------------------------------------- functional export
+    def as_functions(self) -> tuple:
+        """Export ``(init, update, compute)`` as pure functions over the state pytree.
+
+        These are the kernels for jit/shard_map use::
+
+            init, update_fn, compute_fn = metric.as_functions()
+            state = init()
+            state = jax.jit(update_fn)(state, preds, target)
+            value = compute_fn(state, axis_name="dp")   # inside shard_map: fused sync
+
+        The update must be trace-safe (all device math; true for every metric
+        whose reference kernel is pure tensor ops). ``compute_fn`` with
+        ``axis_name`` lowers each state's reduction spec to a single XLA
+        collective (psum/pmax/all_gather) — the TPU-native replacement for the
+        reference's ``_sync_dist`` gather path.
+        """
+        template = self._bare_clone()
+
+        def init() -> Dict[str, Any]:
+            return {
+                k: (list(v) if isinstance(v, list) else v) for k, v in template._defaults.items()
+            }
+
+        def update_fn(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+            m = template._bare_clone()
+            m._restore_state(state)
+            m._inner_update(*args, **kwargs)
+            return m._state_snapshot()
+
+        def compute_fn(state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
+            m = template._bare_clone()
+            if axis_name is not None:
+                custom = {k: fn for k, fn in m._reductions.items() if m._reduction_specs[k] == "custom"}
+                state = sync_pytree(state, m._reduction_specs, axis_name, custom)
+            m._restore_state(state)
+            return m._inner_compute()
+
+        return init, update_fn, compute_fn
+
+    def _inner_update(self, *args: Any, **kwargs: Any) -> None:
+        self.update.__wrapped__(*args, **kwargs)  # type: ignore[attr-defined]
+
+    def _inner_compute(self) -> Any:
+        return _squeeze_scalar(self.compute.__wrapped__())  # type: ignore[attr-defined]
+
+    def _bare_clone(self) -> "Metric":
+        """A reset deep copy used as a pure-function template."""
+        m = copy.deepcopy(self)
+        m.reset()
+        return m
+
+    # -------------------------------------------------------- serialization
+    def clone(self) -> "Metric":
+        return copy.deepcopy(self)
+
+    def state_dict(self, prefix: str = "", keep_vars: bool = False) -> Dict[str, Any]:
+        """Persistent states as host numpy arrays (checkpointable pytree leaves).
+
+        Parity: reference ``state_dict`` `metric.py:662-680`; the result is a
+        plain dict so it drops into orbax/flax checkpoints.
+        """
+        destination: Dict[str, Any] = {}
+        for name in self._defaults:
+            if not self._persistent[name]:
+                continue
+            value = getattr(self, name)
+            if isinstance(value, list):
+                destination[prefix + name] = [np.asarray(jax.device_get(v)) for v in value]
+            else:
+                destination[prefix + name] = np.asarray(jax.device_get(value))
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        for name in self._defaults:
+            key = prefix + name
+            if key in state_dict:
+                value = state_dict[key]
+                if isinstance(value, list):
+                    setattr(self, name, [jnp.asarray(v) for v in value])
+                else:
+                    setattr(self, name, jnp.asarray(value))
+            elif strict and self._persistent[name]:
+                raise KeyError(f"Missing key {key!r} in state_dict")
+
+    def persistent(self, mode: bool = False) -> None:
+        """Toggle the persistent flag on all states (reference `metric.py:657-660`)."""
+        for name in self._persistent:
+            self._persistent[name] = mode
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # drop the wrapped bound methods; re-wrapped on unpickle (reference `metric.py:568-577`)
+        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute")}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self.update = self._wrap_update(type(self).update.__get__(self))  # type: ignore[method-assign]
+        self.compute = self._wrap_compute(type(self).compute.__get__(self))  # type: ignore[method-assign]
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in ("higher_is_better", "is_differentiable", "full_state_update"):
+            raise RuntimeError(f"Can't change const `{name}`.")
+        object.__setattr__(self, name, value)
+
+    def __hash__(self) -> int:
+        # states are mutable accumulators; identity hash like the reference (`metric.py:724-737`)
+        hash_vals: List[Any] = [self.__class__.__name__, id(self)]
+        return hash(tuple(hash_vals))
+
+    # --------------------------------------------------------- device moves
+    def to_device(self, device: Any) -> "Metric":
+        """Move all states to ``device`` (replaces torch ``.to()``)."""
+        for name in self._defaults:
+            value = getattr(self, name)
+            if isinstance(value, list):
+                setattr(self, name, [jax.device_put(v, device) for v in value])
+            else:
+                setattr(self, name, jax.device_put(value, device))
+        return self
+
+    def astype(self, dtype: Any) -> "Metric":
+        """Cast floating-point states to ``dtype`` (bf16 for HBM-light accumulation)."""
+        def _cast(x: jax.Array) -> jax.Array:
+            return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        for name in self._defaults:
+            value = getattr(self, name)
+            if isinstance(value, list):
+                setattr(self, name, [_cast(jnp.asarray(v)) for v in value])
+            else:
+                setattr(self, name, _cast(jnp.asarray(value)))
+        return self
+
+    # ------------------------------------------------------------- plumbing
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs accepted by this metric's update (reference `metric.py:702-722`)."""
+        sig = inspect.signature(type(self).update)
+        params = sig.parameters
+        has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
+        if has_var_kw:
+            return kwargs
+        return {
+            k: v
+            for k, v in kwargs.items()
+            if k in params and params[k].kind not in (inspect.Parameter.VAR_POSITIONAL,)
+        }
+
+    def type(self, dtype: Any) -> "Metric":
+        return self.astype(dtype)
+
+    def float(self) -> "Metric":
+        return self.astype(jnp.float32)
+
+    def double(self) -> "Metric":
+        return self.astype(jnp.float64)
+
+    def half(self) -> "Metric":
+        return self.astype(jnp.float16)
+
+    def bfloat16(self) -> "Metric":
+        return self.astype(jnp.bfloat16)
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}()"
+
+    # ------------------------------------------------------- composition ops
+    def __add__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __radd__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __sub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __rsub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __mul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __rmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __truediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.divide, self, other)
+
+    def __rtruediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.divide, other, self)
+
+    def __floordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __mod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __rmod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __pow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __rpow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __matmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __and__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rand__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, other, self)
+
+    def __or__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __ror__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __xor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __rxor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __lt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __le__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __gt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __ge__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __abs__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __neg__(self) -> "CompositionalMetric":
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __invert__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.logical_not, self, None)
+
+    def __getitem__(self, idx: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+    def __getnewargs__(self) -> tuple:
+        return tuple()
+
+
+def _neg(x: jax.Array) -> jax.Array:
+    return -jnp.abs(x)
+
+
+def _squeeze_scalar(value: Any) -> Any:
+    """Squeeze 1-element arrays to scalars like reference `metric.py:531-532`."""
+    if isinstance(value, jax.Array) and value.ndim == 1 and value.shape[0] == 1:
+        return jnp.squeeze(value)
+    return value
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic composition of metrics (reference `metric.py:853-961`)."""
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, float, int, jax.Array, None],
+        metric_b: Union[Metric, float, int, jax.Array, None],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else _maybe_asarray(metric_a)
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else _maybe_asarray(metric_b)
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        pass  # children sync themselves
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            self._forward_cache = None
+        elif val_b is None:
+            # unary op when metric_b was never given; None if child returned None
+            self._forward_cache = None if isinstance(self.metric_b, Metric) else self.op(val_a)
+        else:
+            self._forward_cache = self.op(val_a, val_b)
+        return self._forward_cache
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else self.op}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        # no caching/sync wrapping: children handle their own (reference `metric.py:957-961`)
+        return compute
+
+
+def _maybe_asarray(value: Any) -> Any:
+    if value is None:
+        return None
+    return jnp.asarray(value)
+
+
+__all__ = ["Metric", "CompositionalMetric", "jit_distributed_available"]

@@ -1,0 +1,1 @@
+"""Feature-extractor networks used by model-backed metrics (InceptionV3, LPIPS nets)."""

@@ -1,0 +1,1 @@
+"""Low-level device kernels (segment reductions, sorting helpers, Pallas ops)."""

@@ -1,0 +1,21 @@
+"""Distributed state synchronisation: SPMD collectives + multi-host backend."""
+from metrics_tpu.parallel.collectives import sync_array, sync_pytree
+from metrics_tpu.parallel.reductions import resolve_reduction
+from metrics_tpu.parallel.sync import (
+    class_reduce,
+    distributed_available,
+    gather_all_tensors,
+    reduce,
+    world_size,
+)
+
+__all__ = [
+    "sync_array",
+    "sync_pytree",
+    "resolve_reduction",
+    "gather_all_tensors",
+    "distributed_available",
+    "world_size",
+    "reduce",
+    "class_reduce",
+]

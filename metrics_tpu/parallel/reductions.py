@@ -1,0 +1,54 @@
+"""Canonical state-reduction specs.
+
+A metric state declares *how* replicas of it combine across devices/processes via a
+reduction spec — the TPU-native analogue of the reference's ``dist_reduce_fx``
+string/callable (`src/torchmetrics/metric.py:205-216`). The spec is carried
+separately from the eager callable so the SPMD path can lower it to a single fused
+XLA collective (``psum``/``pmax``/... ) instead of gather-then-reduce.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+from metrics_tpu.utils.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+
+# spec values: "sum" | "mean" | "max" | "min" | "cat" | None | "custom"
+ReductionSpec = Optional[str]
+
+_SPEC_TO_FN = {
+    "sum": dim_zero_sum,
+    "mean": dim_zero_mean,
+    "max": dim_zero_max,
+    "min": dim_zero_min,
+    "cat": dim_zero_cat,
+}
+
+
+def resolve_reduction(dist_reduce_fx: Union[str, Callable, None]) -> tuple:
+    """Normalise a user-provided reduction into ``(spec, eager_fn)``.
+
+    ``eager_fn`` operates on a stack/concat of per-replica states (reference
+    `metric.py:371-382`); ``spec`` drives the fused collective lowering in
+    :func:`metrics_tpu.parallel.collectives.sync_array`.
+    """
+    if dist_reduce_fx is None:
+        return None, None
+    if isinstance(dist_reduce_fx, str):
+        key = dist_reduce_fx.lower()
+        if key not in _SPEC_TO_FN:
+            raise ValueError(
+                f"`dist_reduce_fx` must be one of {sorted(_SPEC_TO_FN)}, a callable, or None; got {dist_reduce_fx!r}"
+            )
+        return key, _SPEC_TO_FN[key]
+    if callable(dist_reduce_fx):
+        return "custom", dist_reduce_fx
+    raise ValueError(f"`dist_reduce_fx` must be a string, callable, or None, got {type(dist_reduce_fx)}")
+
+
+__all__ = ["ReductionSpec", "resolve_reduction"]

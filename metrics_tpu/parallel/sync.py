@@ -1,0 +1,106 @@
+"""Host-driven (multi-process) synchronisation backend.
+
+Parity target: reference `src/torchmetrics/utilities/distributed.py` —
+``gather_all_tensors`` (`:102-151`) with its uneven-shape protocol (gather shapes →
+pad to max → all_gather → trim), plus ``reduce``/``class_reduce`` (`:22-66`).
+
+On TPU the multi-*process* world is JAX's multi-host runtime: collectives here ride
+``jax.experimental.multihost_utils`` (DCN/ICI as appropriate). Within one process,
+multi-device parallelism is expressed in-program instead — see
+:mod:`metrics_tpu.parallel.collectives`. Single-process/single-host mode is a
+zero-overhead early-out, mirroring ``distributed_available()``
+(reference `metric.py:40-41,437-440`).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def distributed_available() -> bool:
+    """True when more than one JAX process participates (multi-host)."""
+    try:
+        return jax.process_count() > 1
+    except RuntimeError:
+        return False
+
+
+def world_size() -> int:
+    return jax.process_count() if distributed_available() else 1
+
+
+def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+    """All-gather an array from every process; handles uneven dim sizes.
+
+    Returns a list with one entry per process (every process receives all
+    entries — all-gather, not gather-to-root), like the reference
+    `utilities/distributed.py:102-151`.
+
+    ``group`` (process subsets) is not supported on the host path — scope
+    restriction is expressed as a mesh-axis subset in the SPMD path instead
+    (SURVEY §2.10). Passing a non-None group raises.
+    """
+    if group is not None:
+        raise ValueError(
+            "Process sub-groups are not supported by the host sync backend; "
+            "restrict scope via a mesh axis in the SPMD path (metrics_tpu.parallel.collectives)."
+        )
+    if not distributed_available():
+        return [jnp.asarray(result)]
+
+    from jax.experimental import multihost_utils
+
+    result = jnp.asarray(result)
+    local_shape = np.asarray(result.shape, dtype=np.int32)
+    # 1) exchange shapes (rank count must match across processes)
+    all_shapes = np.asarray(multihost_utils.process_allgather(local_shape))
+    max_shape = all_shapes.max(axis=0)
+    # 2) pad to the max shape, 3) gather, 4) trim each entry back
+    pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
+    padded = jnp.pad(result, pad_width) if any(p[1] for p in pad_width) else result
+    gathered = multihost_utils.process_allgather(padded)
+    out = []
+    for idx in range(all_shapes.shape[0]):
+        slices = tuple(slice(0, int(d)) for d in all_shapes[idx])
+        out.append(jnp.asarray(gathered[idx])[slices])
+    return out
+
+
+def reduce(x: jax.Array, reduction: str) -> jax.Array:
+    """Reduce a tensor: "elementwise_mean" | "sum" | "none" (reference `distributed.py:22-41`)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction in ("none", None):
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(
+    num: jax.Array, denom: jax.Array, weights: jax.Array, class_reduction: str = "none"
+) -> jax.Array:
+    """Per-class fraction reduce: "micro" | "macro" | "weighted" | "none".
+
+    Parity: reference `utilities/distributed.py:44-93` including the 0/0 → 0
+    convention for macro/weighted.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    if class_reduction == "micro":
+        return jnp.sum(num) / jnp.sum(denom)
+
+    # 0/0 -> 0 for the per-class fractions
+    fraction = jnp.where(denom == 0, jnp.zeros_like(num, dtype=jnp.float32), num / jnp.where(denom == 0, 1, denom))
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights / jnp.sum(weights)))
+    if class_reduction in ("none", None):
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction!r} unknown. Choose between one of these: {valid_reduction}")
+
+
+__all__ = ["distributed_available", "world_size", "gather_all_tensors", "reduce", "class_reduce"]

@@ -1,0 +1,44 @@
+"""Small numerically-careful compute helpers.
+
+Parity: reference `src/torchmetrics/utilities/compute.py` (``_safe_xlogy`` etc.).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    return jnp.matmul(x, y)
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y), defined as 0 where x == 0 (even if y <= 0)."""
+    safe_y = jnp.where(x == 0, jnp.ones_like(y), y)
+    return jnp.where(x == 0, jnp.zeros_like(x), x * jnp.log(safe_y))
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """num / denom with 0 where denom == 0 (the reference's `_safe_divide`)."""
+    num = jnp.asarray(num, dtype=jnp.result_type(num, jnp.float32))
+    denom = jnp.asarray(denom, dtype=jnp.result_type(denom, jnp.float32))
+    return jnp.where(denom == 0, jnp.zeros_like(num), num / jnp.where(denom == 0, jnp.ones_like(denom), denom))
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Trapezoidal area under (x, y); optionally sort by x first.
+
+    Parity: reference `functional/classification/auc.py`. Direction (ascending or
+    descending x) is resolved from the data like the reference; under jit this is
+    a traced sign, handled with ``jnp.where`` instead of python branching.
+    """
+    if reorder:
+        order = jnp.argsort(x, stable=True)
+        x, y = x[order], y[order]
+    dx = jnp.diff(x)
+    # +1 if x ascending, -1 if descending; mixed directions integrate as-is.
+    direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
+    return direction * jnp.trapezoid(y, x)
+
+
+__all__ = ["_safe_xlogy", "_safe_divide", "_auc_compute", "_safe_matmul"]

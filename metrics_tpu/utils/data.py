@@ -1,0 +1,170 @@
+"""Array/data manipulation helpers shared across metrics.
+
+Parity: reference `src/torchmetrics/utilities/data.py` (dim_zero_* at `:36-62`,
+``to_onehot``/``select_topk``/``to_categorical``, ``apply_to_collection`` `:160`,
+``get_group_indexes`` `:210-233`, ``_bincount`` `:244-264`).
+
+TPU-first notes:
+- every device op is a pure ``jnp`` function with static output shapes, so each is
+  jit/vmap/shard_map-safe;
+- ``_bincount`` needs no determinism workaround: XLA scatter-add is deterministic
+  (the reference's CUDA fallback loop at `data.py:244-264` is dropped by design);
+- ``get_group_indexes`` stays host-side (used only for eager grouping); the jitted
+  path uses segment reductions from :mod:`metrics_tpu.ops.segments`.
+"""
+from __future__ import annotations
+
+from collections.abc import Mapping, Sequence
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+TensorOrList = Union[Array, List[Array]]
+
+
+def dim_zero_cat(x: TensorOrList) -> Array:
+    """Concatenate a (possibly list-kind) state along dim 0."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return x
+    x = [jnp.atleast_1d(v) for v in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """One-level list flatten."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> Dict:
+    """Flatten dict-of-dicts one level; non-dict values pass through."""
+    out: Dict = {}
+    for key, value in x.items():
+        if isinstance(value, dict):
+            out.update(value)
+        else:
+            out[key] = value
+    return out
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Integer labels ``(N, ...)`` -> one-hot ``(N, C, ...)``.
+
+    Mirrors reference ``to_onehot`` (`utilities/data.py:65-106`) including the
+    dim-1 insertion point for multi-dim inputs.
+    """
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # one_hot appends the class axis last; the convention is (N, C, extra...).
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim`` (reference `data.py:109-137`)."""
+    if topk == 1:  # cheap argmax path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    _, idx = jax.lax.top_k(jnp.moveaxis(prob_tensor, dim, -1), topk)
+    mask = jnp.zeros(jnp.moveaxis(prob_tensor, dim, -1).shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities -> integer labels via argmax (reference `data.py:140-157`)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` leaves of a collection.
+
+    Parity: reference `utilities/data.py:160-207`.
+    """
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return type(data)({k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()})
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data))
+    if isinstance(data, Sequence) and not isinstance(data, str):
+        return type(data)(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data)
+    return data
+
+
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Host-side grouping of sample rows by integer query id.
+
+    Parity: reference `utilities/data.py:210-233`. Only valid on concrete arrays
+    (eager epoch-end paths); jitted retrieval kernels use segment reductions
+    instead (`metrics_tpu/ops/segments.py`).
+    """
+    import numpy as np
+
+    idx = np.asarray(indexes)
+    if idx.ndim != 1:
+        idx = idx.reshape(-1)
+    groups: Dict[int, List[int]] = {}
+    for i, v in enumerate(idx.tolist()):
+        groups.setdefault(int(v), []).append(i)
+    return [jnp.asarray(v, dtype=jnp.int32) for v in groups.values()]
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    return apply_to_collection(data, jax.Array, lambda x: jnp.squeeze(x) if x.ndim == 1 and x.shape[0] == 1 else x)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Deterministic bincount with a static ``minlength`` (jit-safe).
+
+    The reference needs a CUDA-determinism fallback (`utilities/data.py:244-264`);
+    XLA scatter-add is deterministic so ``jnp.bincount`` is used directly. The
+    ``length`` argument keeps the output shape static under jit.
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def allclose(x: Array, y: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    if x.shape != y.shape:
+        return False
+    return bool(jnp.allclose(x, y, rtol=rtol, atol=atol))
+
+
+__all__ = [
+    "dim_zero_cat",
+    "dim_zero_sum",
+    "dim_zero_mean",
+    "dim_zero_max",
+    "dim_zero_min",
+    "to_onehot",
+    "select_topk",
+    "to_categorical",
+    "apply_to_collection",
+    "get_group_indexes",
+    "allclose",
+]

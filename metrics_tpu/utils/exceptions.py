@@ -1,0 +1,11 @@
+"""User-facing error types.
+
+Parity: reference `src/torchmetrics/utilities/exceptions.py:15-17`.
+"""
+
+
+class MetricsUserError(Exception):
+    """Raised on incorrect use of the metrics API (e.g. double ``sync()``)."""
+
+
+__all__ = ["MetricsUserError"]

@@ -1,0 +1,65 @@
+"""Optional-dependency feature gates.
+
+Parity: reference `src/torchmetrics/utilities/imports.py:26-124` (~30 availability
+flags). Here the flags gate host-side helpers (NLTK stemmer, HF transformers for
+BERTScore/InfoLM, reference DSP packages) — the compute path only needs JAX.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache()
+def package_available(name: str) -> bool:
+    """True if ``import name`` would succeed (spec lookup only, no import)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+@lru_cache()
+def module_available(path: str) -> bool:
+    """True if the dotted module path is importable (checks every parent)."""
+    parts = path.split(".")
+    for i in range(1, len(parts) + 1):
+        if not package_available(".".join(parts[:i])):
+            return False
+    return True
+
+
+@lru_cache()
+def _try_import(name: str):
+    try:
+        return importlib.import_module(name)
+    except Exception:
+        return None
+
+
+_SCIPY_AVAILABLE = package_available("scipy")
+_SKLEARN_AVAILABLE = package_available("sklearn")
+_NLTK_AVAILABLE = package_available("nltk")
+_REGEX_AVAILABLE = package_available("regex")
+_TRANSFORMERS_AVAILABLE = package_available("transformers")
+_FLAX_AVAILABLE = package_available("flax")
+_PESQ_AVAILABLE = package_available("pesq")
+_PYSTOI_AVAILABLE = package_available("pystoi")
+_PYCOCOTOOLS_AVAILABLE = package_available("pycocotools")
+_TORCH_AVAILABLE = package_available("torch")
+
+__all__ = [
+    "package_available",
+    "module_available",
+    "_SCIPY_AVAILABLE",
+    "_SKLEARN_AVAILABLE",
+    "_NLTK_AVAILABLE",
+    "_REGEX_AVAILABLE",
+    "_TRANSFORMERS_AVAILABLE",
+    "_FLAX_AVAILABLE",
+    "_PESQ_AVAILABLE",
+    "_PYSTOI_AVAILABLE",
+    "_PYCOCOTOOLS_AVAILABLE",
+    "_TORCH_AVAILABLE",
+]

@@ -1,0 +1,65 @@
+"""Process-zero-gated logging/warning helpers.
+
+Parity: reference `src/torchmetrics/utilities/prints.py:22-50`, which keys off the
+``LOCAL_RANK`` env var. On TPU the authoritative identity is
+``jax.process_index()``; we fall back to env vars before JAX is initialised so that
+importing this module never forces backend initialisation.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _process_index() -> int:
+    # Avoid initialising the JAX backend just to emit a warning: trust the
+    # standard launcher env vars first.
+    for var in ("JAX_PROCESS_INDEX", "LOCAL_RANK", "RANK"):
+        if var in os.environ:
+            try:
+                return int(os.environ[var])
+            except ValueError:
+                continue
+    return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Call ``fn`` only on process 0."""
+
+    @wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if _process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, stacklevel: int = 4, **kwargs: Any) -> None:
+    warnings.warn(message, *args, stacklevel=stacklevel, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, *args: Any, **kwargs: Any) -> None:
+    log.info(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str, *args: Any, **kwargs: Any) -> None:
+    log.debug(message, *args, **kwargs)
+
+
+_future_warning = partial(warnings.warn, category=FutureWarning)
+
+__all__ = [
+    "rank_zero_only",
+    "rank_zero_warn",
+    "rank_zero_info",
+    "rank_zero_debug",
+]

@@ -1,0 +1,96 @@
+"""Aggregation metric tests (analogue of reference tests/unittests/bases/test_aggregation.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+@pytest.mark.parametrize(
+    "metric_cls, values, expected",
+    [
+        (SumMetric, [[1.0, 2.0], [3.0]], 6.0),
+        (MaxMetric, [[1.0, 5.0], [3.0]], 5.0),
+        (MinMetric, [[2.0, 5.0], [3.0]], 2.0),
+        (MeanMetric, [[1.0, 2.0], [3.0, 6.0]], 3.0),
+    ],
+)
+def test_aggregators(metric_cls, values, expected):
+    m = metric_cls()
+    for v in values:
+        m.update(jnp.asarray(v))
+    assert float(m.compute()) == pytest.approx(expected)
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(3.0)
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_mean_metric_weighted():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 3.0]), weight=jnp.asarray([1.0, 3.0]))
+    assert float(m.compute()) == pytest.approx((1 * 1 + 3 * 3) / 4)
+
+
+def test_nan_strategy_error():
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+def test_nan_strategy_warn():
+    m = SumMetric(nan_strategy="warn")
+    with pytest.warns(UserWarning):
+        m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    assert float(m.compute()) == pytest.approx(3.0)
+
+
+def test_nan_strategy_ignore():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    assert float(m.compute()) == pytest.approx(3.0)
+
+
+def test_nan_strategy_impute():
+    m = MeanMetric(nan_strategy=0.0)
+    m.update(jnp.asarray([2.0, float("nan"), 4.0]))
+    assert float(m.compute()) == pytest.approx(2.0)
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError, match="nan_strategy"):
+        SumMetric(nan_strategy="bogus")
+
+
+def test_aggregator_forward():
+    m = MeanMetric()
+    batch_val = m(jnp.asarray([2.0, 4.0]))
+    assert float(batch_val) == pytest.approx(3.0)
+    m(jnp.asarray([6.0]))
+    assert float(m.compute()) == pytest.approx(4.0)
+
+
+def test_nan_ignore_does_not_corrupt_max_min():
+    """Regression: 'ignore' must drop NaNs, not zero-substitute (review finding)."""
+    m = MaxMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([-5.0, float("nan")]))
+    assert float(m.compute()) == -5.0
+    m2 = MinMetric(nan_strategy="ignore")
+    m2.update(jnp.asarray([5.0, float("nan")]))
+    assert float(m2.compute()) == 5.0
+    m3 = CatMetric(nan_strategy="ignore")
+    m3.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    np.testing.assert_allclose(np.asarray(m3.compute()), [1.0, 2.0])
+
+
+def test_nan_weight_checked():
+    """Regression: NaN in weight must trigger the strategy too (review finding)."""
+    m = MeanMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0]), weight=jnp.asarray([float("nan")]))
+    m2 = MeanMetric(nan_strategy="ignore")
+    m2.update(jnp.asarray([1.0, 3.0]), weight=jnp.asarray([1.0, float("nan")]))
+    assert float(m2.compute()) == pytest.approx(1.0)

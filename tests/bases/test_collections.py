@@ -1,0 +1,177 @@
+"""MetricCollection tests incl. compute groups (analogue of reference tests/unittests/bases/test_collections.py)."""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import MaxMetric, MeanMetric, MetricCollection, MinMetric, SumMetric
+from tests.helpers.testers import DummyMetric
+
+
+class DummyA(DummyMetric):
+    pass
+
+
+class DummyB(DummyMetric):
+    def compute(self):
+        return self.x * 2
+
+
+def test_list_input_keys_by_class_name():
+    col = MetricCollection([DummyA(), DummyB()])
+    assert set(col.keys()) == {"DummyA", "DummyB"}
+
+
+def test_duplicate_class_names_raise():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([DummyA(), DummyA()])
+
+
+def test_dict_input_sorted():
+    col = MetricCollection({"b": DummyA(), "a": DummyB()})
+    assert list(col.keys(keep_base=True)) == ["a", "b"]
+
+
+def test_invalid_input_raises():
+    with pytest.raises(ValueError):
+        MetricCollection([DummyA(), "not a metric"])
+    with pytest.raises(ValueError):
+        MetricCollection({"a": "not a metric"})
+
+
+def test_prefix_postfix():
+    col = MetricCollection([DummyA()], prefix="pre_", postfix="_post")
+    col.update(1.0)
+    out = col.compute()
+    assert set(out) == {"pre_DummyA_post"}
+    with pytest.raises(ValueError, match="Expected input `prefix`"):
+        MetricCollection([DummyA()], prefix=5)
+
+
+def test_clone_with_new_prefix():
+    col = MetricCollection([DummyA()], prefix="a_")
+    c2 = col.clone(prefix="b_")
+    col.update(1.0)
+    c2.update(2.0)
+    assert set(col.compute()) == {"a_DummyA"}
+    assert set(c2.compute()) == {"b_DummyA"}
+    assert float(list(c2.compute().values())[0]) == 2.0
+
+
+def test_update_and_compute_fan_out():
+    col = MetricCollection([DummyA(), DummyB()])
+    col.update(2.0)
+    out = col.compute()
+    assert float(out["DummyA"]) == 2.0
+    assert float(out["DummyB"]) == 4.0
+
+
+def test_forward_returns_dict():
+    col = MetricCollection([DummyA(), DummyB()])
+    out = col(3.0)
+    assert float(out["DummyA"]) == 3.0
+    assert float(out["DummyB"]) == 6.0
+
+
+def test_compute_groups_merge_identical_states():
+    """DummyA and DummyB share identical state -> one compute group after first update."""
+    col = MetricCollection([DummyA(), DummyB()])
+    col.update(1.0)
+    assert len(col.compute_groups) == 1
+    # second update only touches the leader but results stay correct
+    col.update(2.0)
+    out = col.compute()
+    assert float(out["DummyA"]) == 3.0
+    assert float(out["DummyB"]) == 6.0
+
+
+def test_compute_groups_distinct_states_stay_separate():
+    col = MetricCollection([SumMetric(), MaxMetric()])
+    col.update(jnp.asarray([1.0, 4.0]))
+    assert len(col.compute_groups) == 2
+    col.update(jnp.asarray([2.0]))
+    out = col.compute()
+    assert float(out["SumMetric"]) == 7.0
+    assert float(out["MaxMetric"]) == 4.0
+
+
+def test_compute_groups_disabled():
+    col = MetricCollection([DummyA(), DummyB()], compute_groups=False)
+    col.update(1.0)
+    assert col.compute_groups == {}
+    col.update(2.0)
+    out = col.compute()
+    assert float(out["DummyA"]) == 3.0
+
+
+def test_compute_groups_user_specified():
+    col = MetricCollection([DummyA(), DummyB()], compute_groups=[["DummyA", "DummyB"]])
+    col.update(1.0)
+    col.update(1.0)
+    out = col.compute()
+    assert float(out["DummyA"]) == 2.0
+    assert float(out["DummyB"]) == 4.0
+    with pytest.raises(ValueError, match="does not match a metric"):
+        MetricCollection([DummyA()], compute_groups=[["Nope"]])
+
+
+def test_reset_restores_group_refs():
+    col = MetricCollection([DummyA(), DummyB()])
+    col.update(1.0)
+    col.reset()
+    col.update(5.0)
+    out = col.compute()
+    assert float(out["DummyA"]) == 5.0
+    assert float(out["DummyB"]) == 10.0
+
+
+def test_getitem_gives_safe_copy_state():
+    col = MetricCollection([DummyA(), DummyB()])
+    col.update(1.0)
+    a = col["DummyA"]
+    assert float(a.compute()) == 1.0
+
+
+def test_nested_collection_flattens():
+    inner = MetricCollection([DummyA()], prefix="in_")
+    col = MetricCollection({"outer": inner})
+    col.update(1.0)
+    out = col.compute()
+    assert set(out) == {"outer_in_DummyA"}
+
+
+def test_add_metrics_after_init():
+    col = MetricCollection([DummyA()])
+    col.add_metrics(DummyB())
+    col.update(1.0)
+    assert set(col.compute()) == {"DummyA", "DummyB"}
+
+
+def test_len_iter_contains():
+    col = MetricCollection([DummyA(), DummyB()])
+    assert len(col) == 2
+    assert "DummyA" in col
+    assert set(iter(col)) == {"DummyA", "DummyB"}
+
+
+def test_collection_state_dict_roundtrip():
+    col = MetricCollection([SumMetric(), MeanMetric()])
+    col.persistent(True)
+    col.update(jnp.asarray([1.0, 2.0]))
+    sd = col.state_dict()
+    col2 = MetricCollection([SumMetric(), MeanMetric()])
+    col2.persistent(True)
+    col2.load_state_dict(sd)
+    out = col2.compute()
+    assert float(out["SumMetric"]) == 3.0
+    assert float(out["MeanMetric"]) == 1.5
+
+
+def test_compute_group_member_cache_invalidated():
+    """Regression: member's _computed cache must clear when only leader updates."""
+    col = MetricCollection([DummyA(), DummyB()])
+    col.update(1.0)
+    out1 = col.compute()
+    assert float(out1["DummyA"]) == 1.0 and float(out1["DummyB"]) == 2.0
+    col.update(2.0)  # only leader updates now
+    out2 = col.compute()
+    assert float(out2["DummyA"]) == 3.0
+    assert float(out2["DummyB"]) == 6.0  # was returning stale 2.0 before fix

@@ -1,0 +1,130 @@
+"""CompositionalMetric operator tests (analogue of reference tests/unittests/bases/test_composition.py)."""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import CompositionalMetric
+from tests.helpers.testers import DummyMetric
+
+
+def _pair(a=2.0, b=3.0):
+    m1, m2 = DummyMetric(), DummyMetric()
+    m1.update(a)
+    m2.update(b)
+    return m1, m2
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda a, b: a + b, 5.0),
+        (lambda a, b: a - b, -1.0),
+        (lambda a, b: a * b, 6.0),
+        (lambda a, b: a / b, 2.0 / 3.0),
+        (lambda a, b: a % b, 2.0),
+        (lambda a, b: a**b, 8.0),
+        (lambda a, b: a // b, 0.0),
+    ],
+)
+def test_binary_metric_metric(op, expected):
+    m1, m2 = _pair()
+    comp = op(m1, m2)
+    assert isinstance(comp, CompositionalMetric)
+    assert float(comp.compute()) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda a: a + 10, 12.0),
+        (lambda a: 10 + a, 12.0),
+        (lambda a: a * 4, 8.0),
+        (lambda a: 10 - a, 8.0),
+        (lambda a: a / 2, 1.0),
+        (lambda a: 8 / a, 4.0),
+    ],
+)
+def test_binary_metric_scalar(op, expected):
+    m1, _ = _pair()
+    comp = op(m1)
+    assert float(comp.compute()) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda a, b: a == b, False),
+        (lambda a, b: a != b, True),
+        (lambda a, b: a < b, True),
+        (lambda a, b: a <= b, True),
+        (lambda a, b: a > b, False),
+        (lambda a, b: a >= b, False),
+    ],
+)
+def test_comparison_ops(op, expected):
+    m1, m2 = _pair()
+    assert bool(op(m1, m2).compute()) is expected
+
+
+def test_unary_ops():
+    m = DummyMetric()
+    m.update(-4.0)
+    assert float(abs(m).compute()) == 4.0
+    assert float((+m).compute()) == 4.0  # __pos__ is abs, like the reference
+    assert float((-m).compute()) == -4.0  # __neg__ is -abs
+
+
+def test_getitem():
+    m = DummyMetric()
+    m.update(jnp.asarray([1.0, 2.0, 3.0]))
+    comp = m[1]
+    assert float(comp.compute()) == 2.0
+
+
+def test_composition_update_fans_out():
+    m1, m2 = DummyMetric(), DummyMetric()
+    comp = m1 + m2
+    comp.update(1.0)
+    assert float(m1.x) == 1.0
+    assert float(m2.x) == 1.0
+    assert float(comp.compute()) == 2.0
+
+
+def test_composition_forward():
+    m1, m2 = DummyMetric(), DummyMetric()
+    comp = m1 + m2
+    out = comp(2.0)
+    assert float(out) == 4.0
+
+
+def test_composition_reset():
+    m1, m2 = _pair()
+    comp = m1 + m2
+    comp.reset()
+    assert float(m1.x) == 0.0
+    assert float(m2.x) == 0.0
+
+
+def test_nested_composition():
+    m1, m2 = _pair()
+    comp = (m1 + m2) * 2
+    assert float(comp.compute()) == 10.0
+
+
+def test_bitwise_ops():
+    m1, m2 = DummyMetric(), DummyMetric()
+    m1.update(jnp.asarray(3))
+    m2.update(jnp.asarray(5))
+
+    class IntMetric(DummyMetric):
+        def update(self, x):
+            self.x = jnp.asarray(x, dtype=jnp.int32)
+
+        def compute(self):
+            return self.x
+
+    a, b = IntMetric(), IntMetric()
+    a.update(3)
+    b.update(5)
+    assert int((a & b).compute()) == 1
+    assert int((a | b).compute()) == 7
+    assert int((a ^ b).compute()) == 6

@@ -1,0 +1,254 @@
+"""Base-class lifecycle tests (analogue of reference tests/unittests/bases/test_metric.py)."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsUserError
+from tests.helpers.testers import DummyListMetric, DummyMetric
+
+
+def test_inherit_instantiation_error():
+    class Incomplete(Metric):
+        pass
+
+    with pytest.raises(TypeError):
+        Incomplete()
+
+
+def test_add_state_kinds():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum")
+    m.add_state("b", [], "cat")
+    with pytest.raises(ValueError):
+        m.add_state("c", [jnp.asarray(1.0)], "cat")  # non-empty list default
+    with pytest.raises(ValueError):
+        m.add_state("d", jnp.asarray(0.0), "invalid")
+    with pytest.raises(ValueError):
+        m.add_state("not an identifier!", jnp.asarray(0.0), "sum")
+    # callables are allowed
+    m.add_state("e", jnp.asarray(0.0), lambda x: jnp.sum(x, axis=0))
+
+
+def test_update_and_reset():
+    m = DummyMetric()
+    assert not m.update_called
+    m.update(1.0)
+    assert m.update_called
+    assert m._update_count == 1
+    assert float(m.x) == 1.0
+    m.update(2.0)
+    assert float(m.x) == 3.0
+    m.reset()
+    assert not m.update_called
+    assert float(m.x) == 0.0
+
+
+def test_reset_list_state():
+    m = DummyListMetric()
+    m.update(1.0)
+    assert len(m.x) == 1
+    m.reset()
+    assert m.x == []
+    # reset must not alias the default list
+    m.update(2.0)
+    m2 = DummyListMetric()
+    assert m2.x == []
+
+
+def test_compute_caching():
+    m = DummyMetric()
+    m.update(1.0)
+    v1 = m.compute()
+    assert m._computed is not None
+    m.update(1.0)
+    assert m._computed is None  # update invalidates cache
+    assert float(m.compute()) == 2.0
+    assert float(v1) == 1.0
+
+
+def test_compute_before_update_warns():
+    m = DummyMetric()
+    with pytest.warns(UserWarning, match="called before"):
+        m.compute()
+
+
+def test_forward_returns_batch_value():
+    m = DummyMetric()
+    out = m(2.0)
+    assert float(out) == 2.0
+    out = m(3.0)
+    assert float(out) == 3.0  # batch-local, not accumulated
+    assert float(m.compute()) == 5.0  # accumulated
+
+
+def test_forward_full_vs_reduce_state_paths():
+    class FullState(DummyMetric):
+        full_state_update = True
+
+    class ReduceState(DummyMetric):
+        full_state_update = False
+
+    for cls in (FullState, ReduceState):
+        m = cls()
+        assert float(m(1.0)) == 1.0
+        assert float(m(2.0)) == 2.0
+        assert float(m.compute()) == 3.0
+
+
+def test_forward_mean_merge():
+    """The 'mean' reduce spec merges via running average weighted by update count."""
+
+    class MeanState(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("m", jnp.asarray(0.0), "mean")
+
+        def update(self, x):
+            self.m = jnp.asarray(x, dtype=jnp.float32)
+
+        def compute(self):
+            return self.m
+
+    m = MeanState()
+    m(1.0)
+    m(3.0)
+    assert float(m.compute()) == pytest.approx(2.0)
+
+
+def test_const_attributes_frozen():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.is_differentiable = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.higher_is_better = False
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.full_state_update = True
+
+
+def test_hash_and_pickle():
+    m = DummyMetric()
+    m.update(5.0)
+    assert isinstance(hash(m), int)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.x) == 5.0
+    m2.update(1.0)
+    assert float(m2.x) == 6.0
+    assert float(m.x) == 5.0  # original untouched
+
+
+def test_clone_independent():
+    m = DummyMetric()
+    m.update(1.0)
+    c = m.clone()
+    c.update(10.0)
+    assert float(m.x) == 1.0
+    assert float(c.x) == 11.0
+
+
+def test_state_dict_persistent_flag():
+    m = DummyMetric()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    m.update(4.0)
+    sd = m.state_dict()
+    assert set(sd) == {"x"}
+    assert np.asarray(sd["x"]) == pytest.approx(4.0)
+
+    m2 = DummyMetric()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert float(m2.x) == 4.0
+
+    m3 = DummyMetric()
+    m3.persistent(True)
+    with pytest.raises(KeyError):
+        m3.load_state_dict({}, strict=True)
+
+
+def test_metric_state_property():
+    m = DummyMetric()
+    m.update(2.0)
+    assert set(m.metric_state) == {"x"}
+    assert float(m.metric_state["x"]) == 2.0
+
+
+def test_double_sync_raises():
+    m = DummyMetric()
+    m.update(1.0)
+    m.sync(dist_sync_fn=lambda x, group=None: [x], distributed_available=lambda: True)
+    with pytest.raises(MetricsUserError, match="already been synced"):
+        m.sync(dist_sync_fn=lambda x, group=None: [x], distributed_available=lambda: True)
+    m.unsync()
+    with pytest.raises(MetricsUserError, match="un-synced"):
+        m.unsync()
+
+
+def test_forward_while_synced_raises():
+    m = DummyMetric()
+    m.update(1.0)
+    m.sync(dist_sync_fn=lambda x, group=None: [x], distributed_available=lambda: True)
+    with pytest.raises(MetricsUserError, match="shouldn't be synced"):
+        m(1.0)
+
+
+def test_filter_kwargs():
+    class TwoArg(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("x", jnp.asarray(0.0), "sum")
+
+        def update(self, preds, target):
+            self.x = self.x + jnp.sum(preds) + jnp.sum(target)
+
+        def compute(self):
+            return self.x
+
+    m = TwoArg()
+    filtered = m._filter_kwargs(preds=1, target=2, extra=3)
+    assert set(filtered) == {"preds", "target"}
+
+
+def test_astype_casts_float_states_only():
+    class Mixed(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("f", jnp.asarray(0.0), "sum")
+            self.add_state("i", jnp.asarray(0, dtype=jnp.int32), "sum")
+
+        def update(self, x):
+            pass
+
+        def compute(self):
+            return self.f
+
+    m = Mixed().astype(jnp.bfloat16)
+    assert m.f.dtype == jnp.bfloat16
+    assert m.i.dtype == jnp.int32
+
+
+def test_functional_export_jit_scan():
+    """as_functions kernels work under jit and lax.scan (trace-safety)."""
+    m = DummyMetric()
+    init, upd, cmp = m.as_functions()
+    state = init()
+
+    def body(st, x):
+        return upd(st, x), None
+
+    final, _ = jax.lax.scan(body, state, jnp.arange(5.0))
+    assert float(cmp(final)) == pytest.approx(10.0)
+
+
+def test_unexpected_kwargs_raise():
+    with pytest.raises(ValueError, match="Unexpected keyword"):
+        DummyMetric(not_a_real_kwarg=True)

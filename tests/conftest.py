@@ -1,0 +1,31 @@
+"""Global test config: force an 8-device virtual CPU mesh.
+
+The suite must behave identically whether launched on a TPU host or a plain CPU
+box, and must exercise multi-device SPMD sync without real chips
+(SURVEY §4 "What to replicate on TPU"). We therefore pin the CPU backend with 8
+virtual devices *before* any JAX backend initialisation. ``bench.py`` does NOT
+import this and runs on the real accelerator.
+"""
+import os
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from tests.helpers import seed_all
+
+    seed_all(42)
+    yield
+
+
+def pytest_configure(config):
+    assert jax.device_count() >= 8, f"expected >=8 virtual cpu devices, got {jax.devices()}"
