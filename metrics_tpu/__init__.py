@@ -19,6 +19,35 @@ from metrics_tpu.aggregation import (  # noqa: E402
     MinMetric,
     SumMetric,
 )
+from metrics_tpu.classification import (  # noqa: E402
+    AUC,
+    AUROC,
+    Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
+    CoverageError,
+    Dice,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    HingeLoss,
+    JaccardIndex,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+    MatthewsCorrCoef,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    ROC,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.collections import MetricCollection  # noqa: E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 
@@ -32,4 +61,31 @@ __all__ = [
     "MeanMetric",
     "MinMetric",
     "SumMetric",
+    "Accuracy",
+    "AUC",
+    "AUROC",
+    "AveragePrecision",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
+    "CalibrationError",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "CoverageError",
+    "Dice",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "HingeLoss",
+    "JaccardIndex",
+    "KLDivergence",
+    "LabelRankingAveragePrecision",
+    "LabelRankingLoss",
+    "MatthewsCorrCoef",
+    "Precision",
+    "PrecisionRecallCurve",
+    "Recall",
+    "ROC",
+    "Specificity",
+    "StatScores",
 ]

@@ -1,0 +1,86 @@
+"""Accuracy module metric.
+
+Parity: reference `classification/accuracy.py:31-260` (stat-score states plus
+``correct``/``total`` sum states for subset-accuracy mode).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.accuracy import (
+    _accuracy_compute,
+    _check_subset_validity,
+    _mode,
+    _subset_accuracy_compute,
+    _subset_accuracy_update,
+)
+from metrics_tpu.utils.enums import DataType
+
+
+class Accuracy(StatScores):
+    """Accuracy (micro/macro/weighted/samplewise, top-k, subset mode)."""
+
+    is_differentiable: Optional[bool] = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: Optional[bool] = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        subset_accuracy: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ("weighted", "none", None) else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+        self.add_state("correct", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.subset_accuracy = subset_accuracy
+        self.mode: Optional[DataType] = None
+
+    def update(self, preds, target) -> None:
+        mode = _mode(preds, target, self.threshold, self.top_k, self.num_classes, self.multiclass, self.ignore_index)
+        if not self.mode:
+            self.mode = mode
+        elif self.mode != mode:
+            raise ValueError(f"You can not use {mode} inputs with {self.mode} inputs.")
+
+        if self.subset_accuracy and _check_subset_validity(self.mode):
+            correct, total = _subset_accuracy_update(
+                preds, target, threshold=self.threshold, top_k=self.top_k, ignore_index=self.ignore_index
+            )
+            self.correct = self.correct + correct
+            self.total = self.total + total
+        else:
+            super().update(preds, target)
+
+    def compute(self) -> jax.Array:
+        if self.subset_accuracy and _check_subset_validity(self.mode):
+            return _subset_accuracy_compute(self.correct, self.total)
+        tp, fp, tn, fn = self._get_final_stats()
+        return _accuracy_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce, self.mode)
+
+
+__all__ = ["Accuracy"]

@@ -1,0 +1,42 @@
+"""AUC module metric (reference `classification/auc.py`)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class AUC(Metric):
+    """Area under any accumulated (x, y) curve."""
+
+    is_differentiable: Optional[bool] = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False
+
+    def __init__(self, reorder: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reorder = reorder
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+        self.add_state("y", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds, target) -> None:
+        x, y = _auc_update(preds, target)
+        self.x.append(x)
+        self.y.append(y)
+
+    def compute(self) -> jax.Array:
+        import jax.numpy as jnp
+
+        x = dim_zero_cat(self.x).astype(jnp.float32)
+        y = dim_zero_cat(self.y).astype(jnp.float32)
+        if self.reorder:
+            order = jnp.argsort(x, stable=True)
+            x, y = x[order], y[order]
+        return _auc_compute(x, y)
+
+
+__all__ = ["AUC"]

@@ -1,0 +1,46 @@
+"""CohenKappa module metric (reference `classification/cohen_kappa.py`)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_compute, _cohen_kappa_update
+from metrics_tpu.metric import Metric
+
+
+class CohenKappa(Metric):
+    """Cohen's kappa from an accumulated confusion matrix."""
+
+    is_differentiable: Optional[bool] = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: Optional[bool] = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        weights: Optional[str] = None,
+        threshold: float = 0.5,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.weights = weights
+        self.threshold = threshold
+
+        allowed_weights = ("linear", "quadratic", "none", None)
+        if self.weights not in allowed_weights:
+            raise ValueError(f"Argument weights needs to one of the following: {allowed_weights}")
+
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        confmat = _cohen_kappa_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> jax.Array:
+        return _cohen_kappa_compute(self.confmat, self.weights)
+
+
+__all__ = ["CohenKappa"]

@@ -1,0 +1,38 @@
+"""HammingDistance module metric (reference `classification/hamming.py`)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming import (
+    _hamming_distance_compute,
+    _hamming_distance_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class HammingDistance(Metric):
+    """Share of wrongly predicted labels over all label positions."""
+
+    is_differentiable: Optional[bool] = False
+    higher_is_better: Optional[bool] = False
+    full_state_update: Optional[bool] = False
+
+    def __init__(self, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("correct", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.threshold = threshold
+
+    def update(self, preds, target) -> None:
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> jax.Array:
+        return _hamming_distance_compute(self.correct, self.total)
+
+
+__all__ = ["HammingDistance"]

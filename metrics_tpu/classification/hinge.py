@@ -1,0 +1,52 @@
+"""HingeLoss module metric (reference `classification/hinge.py`)."""
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hinge import (
+    MulticlassMode,
+    _hinge_compute,
+    _hinge_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class HingeLoss(Metric):
+    """Mean hinge loss over all seen samples."""
+
+    is_differentiable: Optional[bool] = True
+    higher_is_better: Optional[bool] = False
+    full_state_update: Optional[bool] = False
+
+    def __init__(
+        self,
+        squared: bool = False,
+        multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.add_state("measure", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+        if multiclass_mode not in (None, MulticlassMode.CRAMMER_SINGER, MulticlassMode.ONE_VS_ALL):
+            raise ValueError(
+                "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+                "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+                f" got {multiclass_mode}."
+            )
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+
+    def update(self, preds, target) -> None:
+        measure, total = _hinge_update(preds, target, squared=self.squared, multiclass_mode=self.multiclass_mode)
+        self.measure = measure + self.measure
+        self.total = total + self.total
+
+    def compute(self) -> jax.Array:
+        return _hinge_compute(self.measure, self.total)
+
+
+__all__ = ["HingeLoss"]

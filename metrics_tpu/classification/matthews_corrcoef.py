@@ -1,0 +1,37 @@
+"""MatthewsCorrCoef module metric (reference `classification/matthews_corrcoef.py`)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.matthews_corrcoef import (
+    _matthews_corrcoef_compute,
+    _matthews_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class MatthewsCorrCoef(Metric):
+    """Matthews correlation coefficient from an accumulated confusion matrix."""
+
+    is_differentiable: Optional[bool] = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: Optional[bool] = False
+
+    def __init__(self, num_classes: int, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        confmat = _matthews_corrcoef_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> jax.Array:
+        return _matthews_corrcoef_compute(self.confmat)
+
+
+__all__ = ["MatthewsCorrCoef"]

@@ -1,0 +1,54 @@
+"""PrecisionRecallCurve module metric (exact, cat-states).
+
+Parity: reference `classification/precision_recall_curve.py` — raw preds/target
+accumulated as list states (``dist_reduce_fx="cat"``), exact curve at compute.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class PrecisionRecallCurve(Metric):
+    """Exact PR curve from all accumulated scores (epoch-end, eager)."""
+
+    is_differentiable: Optional[bool] = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds, target) -> None:
+        preds, target, num_classes, pos_label = _precision_recall_curve_update(
+            preds, target, self.num_classes, self.pos_label
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def compute(self) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)
+
+
+__all__ = ["PrecisionRecallCurve"]

@@ -1,0 +1,85 @@
+"""Multilabel ranking module metrics (reference `classification/ranking.py`)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.ranking import (
+    _coverage_error_compute,
+    _coverage_error_update,
+    _label_ranking_average_precision_compute,
+    _label_ranking_average_precision_update,
+    _label_ranking_loss_compute,
+    _label_ranking_loss_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class _RankingBase(Metric):
+    is_differentiable: Optional[bool] = False
+    full_state_update: Optional[bool] = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sample_weight", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._weighted = False
+
+
+class CoverageError(_RankingBase):
+    """Average depth of ranking needed to cover all relevant labels."""
+
+    higher_is_better: Optional[bool] = False
+
+    def update(self, preds, target, sample_weight: Optional[jax.Array] = None) -> None:
+        measure, total, weight = _coverage_error_update(preds, target, sample_weight)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+        if weight is not None:
+            self._weighted = True
+            self.sample_weight = self.sample_weight + weight
+
+    def compute(self) -> jax.Array:
+        return _coverage_error_compute(self.measure, self.total, self.sample_weight if self._weighted else None)
+
+
+class LabelRankingAveragePrecision(_RankingBase):
+    """Label ranking average precision for multilabel data."""
+
+    higher_is_better: Optional[bool] = True
+
+    def update(self, preds, target, sample_weight: Optional[jax.Array] = None) -> None:
+        measure, total, weight = _label_ranking_average_precision_update(preds, target, sample_weight)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+        if weight is not None:
+            self._weighted = True
+            self.sample_weight = self.sample_weight + weight
+
+    def compute(self) -> jax.Array:
+        return _label_ranking_average_precision_compute(
+            self.measure, self.total, self.sample_weight if self._weighted else None
+        )
+
+
+class LabelRankingLoss(_RankingBase):
+    """Average number of wrongly-ordered label pairs."""
+
+    higher_is_better: Optional[bool] = False
+
+    def update(self, preds, target, sample_weight: Optional[jax.Array] = None) -> None:
+        measure, total, weight = _label_ranking_loss_update(preds, target, sample_weight)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+        if weight is not None:
+            self._weighted = True
+            self.sample_weight = self.sample_weight + weight
+
+    def compute(self) -> jax.Array:
+        return _label_ranking_loss_compute(self.measure, self.total, self.sample_weight if self._weighted else None)
+
+
+__all__ = ["CoverageError", "LabelRankingAveragePrecision", "LabelRankingLoss"]

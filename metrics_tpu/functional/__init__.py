@@ -1,0 +1,8 @@
+"""Stateless functional metrics namespace (L2).
+
+Parity target: reference `src/torchmetrics/functional/__init__.py` (78 exports).
+"""
+from metrics_tpu.functional.classification import *  # noqa: F401,F403
+from metrics_tpu.functional.classification import __all__ as _classification_all
+
+__all__ = list(_classification_all)

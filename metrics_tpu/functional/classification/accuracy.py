@@ -1,0 +1,195 @@
+"""Accuracy (incl. subset accuracy and top-k).
+
+Parity: reference `functional/classification/accuracy.py` (`_mode` `:29`,
+`_accuracy_update` `:71`, `_accuracy_compute` `:122`, `_subset_accuracy_update`
+`:205`, `accuracy` `:258-430`).
+
+TPU note: the reference drops absent classes with boolean indexing for
+``average='macro'`` (`accuracy.py:177-190`); here absent classes are flagged
+``-1`` instead — ``_reduce_stat_scores`` zero-weights flagged classes, which is
+numerically identical and keeps shapes static under jit.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utils.checks import _check_classification_inputs, _input_format_classification, _input_squeeze
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+
+
+def _mode(
+    preds,
+    target,
+    threshold: float,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    return _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        top_k=top_k,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+
+
+def _check_subset_validity(mode: DataType) -> bool:
+    return mode in (DataType.MULTILABEL, DataType.MULTIDIM_MULTICLASS)
+
+
+def _accuracy_update(
+    preds,
+    target,
+    reduce: Optional[str],
+    mdmc_reduce: Optional[str],
+    threshold: float,
+    num_classes: Optional[int],
+    top_k: Optional[int],
+    multiclass: Optional[bool],
+    ignore_index: Optional[int],
+    mode: DataType,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+    preds, target = _input_squeeze(preds, target)
+    return _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+        mode=mode,
+    )
+
+
+def _accuracy_compute(
+    tp: jax.Array,
+    fp: jax.Array,
+    tn: jax.Array,
+    fn: jax.Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    mode: DataType,
+) -> jax.Array:
+    simple_average = (AverageMethod.MICRO, AverageMethod.SAMPLES)
+    if (mode == DataType.BINARY and average in simple_average) or mode == DataType.MULTILABEL:
+        numerator = tp + tn
+        denominator = tp + tn + fp + fn
+    else:
+        numerator = tp
+        denominator = tp + fn
+
+    if mdmc_average != MDMCAverageMethod.SAMPLEWISE and average in (AverageMethod.MACRO, AverageMethod.NONE, None):
+        # flag classes absent from both preds and target with -1; the reducer
+        # zero-weights them (static-shape form of reference `:177-190`)
+        absent = (tp + fp + fn) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _subset_accuracy_update(
+    preds,
+    target,
+    threshold: float,
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    preds, target = _input_squeeze(preds, target)
+    preds, target, mode = _input_format_classification(
+        preds, target, threshold=threshold, top_k=top_k, ignore_index=ignore_index
+    )
+
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+
+    if mode == DataType.MULTILABEL:
+        correct = (preds == target).all(axis=1).sum()
+        total = jnp.asarray(target.shape[0])
+    elif mode == DataType.MULTICLASS:
+        correct = (preds * target).sum()
+        total = target.sum()
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        sample_correct = (preds * target).sum(axis=(1, 2))
+        correct = (sample_correct == target.shape[2]).sum()
+        total = jnp.asarray(target.shape[0])
+    else:
+        correct, total = jnp.asarray(0), jnp.asarray(0)
+    return correct, total
+
+
+def _subset_accuracy_compute(correct: jax.Array, total: jax.Array) -> jax.Array:
+    return correct.astype(jnp.float32) / total
+
+
+def accuracy(
+    preds,
+    target,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    subset_accuracy: bool = False,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    """Accuracy score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> accuracy(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+    if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
+        raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+
+    preds, target = _input_squeeze(preds, target)
+    mode = _mode(preds, target, threshold, top_k, num_classes, multiclass, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+
+    if subset_accuracy and _check_subset_validity(mode):
+        correct, total = _subset_accuracy_update(preds, target, threshold, top_k, ignore_index)
+        return _subset_accuracy_compute(correct, total)
+    tp, fp, tn, fn = _accuracy_update(
+        preds, target, reduce, mdmc_average, threshold, num_classes, top_k, multiclass, ignore_index, mode
+    )
+    return _accuracy_compute(tp, fp, tn, fn, average, mdmc_average, mode)
+
+
+__all__ = ["accuracy"]

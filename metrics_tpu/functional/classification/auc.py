@@ -1,0 +1,59 @@
+"""Area under any (x, y) curve via the trapezoidal rule.
+
+Parity: reference `functional/classification/auc.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _auc_compute
+
+
+def _auc_update(x: jax.Array, y: jax.Array):
+    if x.ndim > 1:
+        x = jnp.squeeze(x)
+    if y.ndim > 1:
+        y = jnp.squeeze(y)
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimension {x.ndim} and {y.ndim}")
+    _check_same_shape(x, y)
+    return x, y
+
+
+def _auc_compute_without_check(x: jax.Array, y: jax.Array, direction: float = 1.0) -> jax.Array:
+    return jnp.trapezoid(y, x) * direction
+
+
+def auc(x: jax.Array, y: jax.Array, reorder: bool = False) -> jax.Array:
+    """AUC under the (x, y) polyline.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auc
+        >>> x = jnp.asarray([0, 1, 2, 3])
+        >>> y = jnp.asarray([0, 1, 2, 2])
+        >>> auc(x, y)
+        Array(4., dtype=float32)
+    """
+    x, y = _auc_update(x, y)
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    if reorder:
+        order = jnp.argsort(x, stable=True)
+        x, y = x[order], y[order]
+    else:
+        dx = jnp.diff(x)
+        if not isinstance(x, jax.core.Tracer):
+            import numpy as np
+
+            dxn = np.asarray(dx)
+            if not ((dxn >= 0).all() or (dxn <= 0).all()):
+                raise ValueError(
+                    "The `x` array is neither increasing or decreasing. Try setting the reorder argument to `True`."
+                )
+    return _auc_compute(x, y)
+
+
+__all__ = ["auc"]

@@ -1,0 +1,113 @@
+"""Average precision (area under the PR curve, step interpolation).
+
+Parity: reference `functional/classification/average_precision.py:27-160`.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+
+
+def _average_precision_update(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    if average == "micro" and preds.ndim != target.ndim:
+        raise ValueError("Cannot use `micro` average with multi-class input")
+    return preds, target, num_classes, pos_label
+
+
+def _average_precision_compute(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Union[List[jax.Array], jax.Array]:
+    if average == "micro" and preds.ndim == target.ndim:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+        num_classes = 1
+
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
+    if average == "weighted":
+        if preds.ndim == target.ndim and target.ndim > 1:
+            weights = target.sum(axis=0).astype(jnp.float32)
+        else:
+            weights = _bincount_float(target, num_classes)
+        weights = weights / weights.sum()
+    else:
+        weights = None
+    return _average_precision_compute_with_precision_recall(precision, recall, num_classes, average, weights)
+
+
+def _bincount_float(target: jax.Array, num_classes: int) -> jax.Array:
+    return jnp.bincount(target.reshape(-1), length=num_classes).astype(jnp.float32)
+
+
+def _average_precision_compute_with_precision_recall(
+    precision,
+    recall,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    weights: Optional[jax.Array] = None,
+) -> Union[List[jax.Array], jax.Array]:
+    # step-function integral; final precision entry is pinned at 1
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    res = [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
+
+    if average in ("macro", "weighted"):
+        res_arr = jnp.stack(res)
+        nan_mask = jnp.isnan(res_arr)
+        if bool(nan_mask.any()):
+            warnings.warn(
+                "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
+                UserWarning,
+            )
+        if average == "macro":
+            valid = ~nan_mask
+            return jnp.sum(jnp.where(valid, res_arr, 0.0)) / jnp.maximum(valid.sum(), 1)
+        weights = jnp.ones_like(res_arr) if weights is None else weights
+        return jnp.sum(jnp.where(nan_mask, 0.0, res_arr * weights))
+    if average in ("none", None):
+        return res
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+
+
+def average_precision(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Union[List[jax.Array], jax.Array]:
+    """Average precision score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import average_precision
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> average_precision(pred, target, pos_label=1)
+        Array(1., dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label, average)
+    return _average_precision_compute(preds, target, num_classes, pos_label, average)
+
+
+__all__ = ["average_precision"]

@@ -1,0 +1,63 @@
+"""Cohen's kappa (with linear/quadratic weighting).
+
+Parity: reference `functional/classification/cohen_kappa.py:24-75`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+
+
+def _cohen_kappa_update(preds, target, num_classes: int, threshold: float = 0.5) -> jax.Array:
+    return _confusion_matrix_update(preds, target, num_classes, threshold)
+
+
+def _cohen_kappa_compute(confmat: jax.Array, weights: Optional[str] = None) -> jax.Array:
+    confmat = _confusion_matrix_compute(confmat).astype(jnp.float32)
+    n_classes = confmat.shape[0]
+    sum0 = confmat.sum(axis=0, keepdims=True)
+    sum1 = confmat.sum(axis=1, keepdims=True)
+    expected = sum1 @ sum0 / sum0.sum()
+
+    if weights is None or weights == "none":
+        w_mat = 1.0 - jnp.eye(n_classes, dtype=confmat.dtype)
+    elif weights in ("linear", "quadratic"):
+        grid = jnp.broadcast_to(jnp.arange(n_classes, dtype=confmat.dtype), (n_classes, n_classes))
+        diff = grid - grid.T
+        w_mat = jnp.abs(diff) if weights == "linear" else diff**2
+    else:
+        raise ValueError(f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'")
+
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def cohen_kappa(
+    preds,
+    target,
+    num_classes: int,
+    weights: Optional[str] = None,
+    threshold: float = 0.5,
+) -> jax.Array:
+    """Cohen's kappa inter-rater agreement.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cohen_kappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> cohen_kappa(preds, target, num_classes=2)
+        Array(0.5, dtype=float32)
+    """
+    confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
+    return _cohen_kappa_compute(confmat, weights)
+
+
+__all__ = ["cohen_kappa"]

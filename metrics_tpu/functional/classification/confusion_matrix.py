@@ -1,0 +1,86 @@
+"""Confusion matrix.
+
+Parity: reference `functional/classification/confusion_matrix.py:25-120`
+(label-pair bincount; multilabel per-class 2x2). XLA scatter-add is
+deterministic so no CUDA-style fallback is needed (`utilities/data.py:244-264`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import _bincount
+from metrics_tpu.utils.enums import DataType
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _confusion_matrix_update(
+    preds, target, num_classes: int, threshold: float = 0.5, multilabel: bool = False
+) -> jax.Array:
+    import jax.numpy as jnp
+
+    # forward num_classes when labels are integers: under jit the one-hot width
+    # must be static and cannot be inferred from data maxima
+    preds_arr = jnp.asarray(preds)
+    pass_nc = num_classes if (
+        not jnp.issubdtype(preds_arr.dtype, jnp.floating) and preds_arr.ndim == jnp.asarray(target).ndim
+    ) else None
+    preds, target, mode = _input_format_classification(preds, target, threshold, num_classes=pass_nc)
+    if mode not in (DataType.BINARY, DataType.MULTILABEL):
+        preds = preds.argmax(axis=1)
+        target = target.argmax(axis=1)
+    if multilabel:
+        unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
+        bins = _bincount(unique_mapping, minlength=4 * num_classes)
+        return bins.reshape(num_classes, 2, 2)
+    unique_mapping = target.reshape(-1) * num_classes + preds.reshape(-1)
+    bins = _bincount(unique_mapping, minlength=num_classes**2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_compute(confmat: jax.Array, normalize: Optional[str] = None) -> jax.Array:
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / confmat.sum(axis=1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / confmat.sum(axis=0, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / confmat.sum()
+        nan_mask = jnp.isnan(confmat)
+        if not isinstance(confmat, jax.core.Tracer) and bool(nan_mask.any()):
+            rank_zero_warn("nan values found in confusion matrix have been replaced with zeros.")
+        confmat = jnp.where(nan_mask, 0.0, confmat)
+    return confmat
+
+
+def confusion_matrix(
+    preds,
+    target,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> jax.Array:
+    """Confusion matrix ``(C, C)`` (or ``(C, 2, 2)`` for multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import confusion_matrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> confusion_matrix(preds, target, num_classes=2)
+        Array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
+    return _confusion_matrix_compute(confmat, normalize)
+
+
+__all__ = ["confusion_matrix"]

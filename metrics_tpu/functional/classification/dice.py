@@ -1,0 +1,115 @@
+"""Dice score.
+
+Parity: reference `functional/classification/dice.py` (`_dice_compute` `:107-156`,
+``dice`` public fn, and the legacy ``dice_score`` `:27` on softmax probs).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall import _check_average_arg
+from metrics_tpu.functional.classification.stat_scores import (
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utils.checks import _input_squeeze
+from metrics_tpu.utils.data import to_categorical
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+
+def _dice_compute(
+    tp: jax.Array,
+    fp: jax.Array,
+    fn: jax.Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> jax.Array:
+    numerator = 2 * tp
+    denominator = 2 * tp + fp + fn
+    if mdmc_average != MDMCAverageMethod.SAMPLEWISE and average in (AverageMethod.MACRO, AverageMethod.NONE, None):
+        absent = (tp + fp + fn) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+        zero_division=zero_division,
+    )
+
+
+def dice(
+    preds,
+    target,
+    zero_division: int = 0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    """Dice = 2·tp / (2·tp + fp + fn).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import dice
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> dice(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+    preds, target = _input_squeeze(preds, target)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _dice_compute(tp, fp, fn, average, mdmc_average, zero_division)
+
+
+def dice_score(
+    preds,
+    target,
+    bg: bool = False,
+    nan_score: float = 0.0,
+    no_fg_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> jax.Array:
+    """Legacy dice over softmax probability maps (reference `dice.py:27-104`)."""
+    from metrics_tpu.parallel.sync import reduce as _reduce
+
+    num_classes = preds.shape[1]
+    bg_inv = 1 - int(bg)
+    pred_lab = to_categorical(preds)
+    scores = []
+    for i in range(bg_inv, num_classes):
+        t_i = target == i
+        p_i = pred_lab == i
+        has_fg = t_i.sum() > 0
+        tp = jnp.sum(p_i & t_i).astype(jnp.float32)
+        fp = jnp.sum(p_i & ~t_i).astype(jnp.float32)
+        fn = jnp.sum(~p_i & t_i).astype(jnp.float32)
+        denom = 2 * tp + fp + fn
+        score = jnp.where(denom > 0, 2 * tp / jnp.where(denom > 0, denom, 1.0), float(nan_score))
+        score = jnp.where(has_fg, score, float(no_fg_score))
+        scores.append(score)
+    return _reduce(jnp.stack(scores), reduction)
+
+
+__all__ = ["dice", "dice_score"]

@@ -1,0 +1,41 @@
+"""Hamming distance.
+
+Parity: reference `functional/classification/hamming.py:22-96`.
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+
+def _hamming_distance_update(preds, target, threshold: float = 0.5) -> Tuple[jax.Array, int]:
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = (preds == target).sum()
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: jax.Array, total: Union[int, jax.Array]) -> jax.Array:
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds, target, threshold: float = 0.5) -> jax.Array:
+    """Share of wrongly predicted labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hamming_distance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)
+
+
+__all__ = ["hamming_distance"]

@@ -1,0 +1,104 @@
+"""Hinge loss (binary, Crammer-Singer multiclass, one-vs-all).
+
+Parity: reference `functional/classification/hinge.py:75-155`. The reference's
+boolean fancy-indexing (`preds[target]`) is replaced with masked max/select —
+same math, static shapes.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _input_squeeze
+from metrics_tpu.utils.data import to_onehot
+from metrics_tpu.utils.enums import DataType, EnumStr
+
+
+class MulticlassMode(EnumStr):
+    CRAMMER_SINGER = "crammer-singer"
+    ONE_VS_ALL = "one-vs-all"
+
+
+def _check_shape_and_type_consistency_hinge(preds, target) -> DataType:
+    if target.ndim > 1:
+        raise ValueError(f"The `target` should be one dimensional, got `target` with shape={target.shape}.")
+    if preds.ndim == 1:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape,")
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("The `preds` should be floats.")
+        return DataType.BINARY
+    if preds.ndim == 2:
+        if preds.shape[0] != target.shape[0]:
+            raise ValueError("The `preds` and `target` should have the same shape in the first dimension,")
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("The `preds` should be floats.")
+        return DataType.MULTICLASS
+    raise ValueError(f"The `preds` should be one or two dimensional, got `preds` with shape={preds.shape}.")
+
+
+def _hinge_update(
+    preds,
+    target,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    preds, target = _input_squeeze(preds, target)
+    mode = _check_shape_and_type_consistency_hinge(preds, target)
+
+    if mode == DataType.MULTICLASS:
+        target_oh = to_onehot(target, max(2, preds.shape[1])).astype(bool)
+
+    if mode == DataType.MULTICLASS and (multiclass_mode is None or multiclass_mode == MulticlassMode.CRAMMER_SINGER):
+        # margin = score(true class) - max over other classes
+        true_score = jnp.sum(jnp.where(target_oh, preds, 0.0), axis=1)
+        other_max = jnp.max(jnp.where(target_oh, -jnp.inf, preds), axis=1)
+        margin = true_score - other_max
+    elif mode == DataType.BINARY or multiclass_mode == MulticlassMode.ONE_VS_ALL:
+        if mode == DataType.BINARY:
+            t = target.astype(bool)
+        else:
+            t = target_oh
+        margin = jnp.where(t, preds, -preds)
+    else:
+        raise ValueError(
+            "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+            "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+            f" got {multiclass_mode}."
+        )
+
+    measures = jnp.clip(1 - margin, min=0)
+    if squared:
+        measures = measures**2
+
+    total = jnp.asarray(target.shape[0])
+    return measures.sum(axis=0), total
+
+
+def _hinge_compute(measure: jax.Array, total: jax.Array) -> jax.Array:
+    return measure / total
+
+
+def hinge_loss(
+    preds,
+    target,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> jax.Array:
+    """Mean hinge loss, typically for SVM-style margins.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hinge_loss
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> hinge_loss(preds, target)
+        Array(0.3, dtype=float32)
+    """
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)
+
+
+__all__ = ["hinge_loss", "MulticlassMode"]

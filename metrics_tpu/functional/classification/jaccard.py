@@ -1,0 +1,79 @@
+"""Jaccard index (IoU) from the confusion matrix.
+
+Parity: reference `functional/classification/jaccard.py:22-120`. The
+``ignore_index`` removal slices with static python ints, so it stays jit-safe.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+
+
+def _jaccard_from_confmat(
+    confmat: jax.Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+) -> jax.Array:
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(0.0)
+
+    if average in ("none", None):
+        intersection = jnp.diag(confmat)
+        union = confmat.sum(0) + confmat.sum(1) - intersection
+        scores = intersection.astype(jnp.float32) / jnp.where(union == 0, 1, union).astype(jnp.float32)
+        scores = jnp.where(union == 0, absent_score, scores)
+        if ignore_index is not None and 0 <= ignore_index < num_classes:
+            scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1 :]])
+        return scores
+
+    if average == "macro":
+        scores = _jaccard_from_confmat(confmat, num_classes, "none", ignore_index, absent_score)
+        return jnp.mean(scores)
+
+    if average == "micro":
+        intersection = jnp.sum(jnp.diag(confmat))
+        union = jnp.sum(confmat.sum(0) + confmat.sum(1) - jnp.diag(confmat))
+        return intersection.astype(jnp.float32) / union.astype(jnp.float32)
+
+    # weighted
+    weights = confmat.sum(axis=1).astype(jnp.float32) / confmat.sum().astype(jnp.float32)
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        weights = jnp.concatenate([weights[:ignore_index], weights[ignore_index + 1 :]])
+    scores = _jaccard_from_confmat(confmat, num_classes, "none", ignore_index, absent_score)
+    return jnp.sum(weights * scores)
+
+
+def jaccard_index(
+    preds,
+    target,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+) -> jax.Array:
+    """Jaccard index |A∩B| / |A∪B|.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import jaccard_index
+        >>> target = jnp.asarray([[0, 1, 1], [1, 1, 0]])
+        >>> pred = jnp.asarray([[0, 1, 0], [1, 1, 1]])
+        >>> jaccard_index(pred, target, num_classes=2)
+        Array(0.5833334, dtype=float32)
+    """
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _jaccard_from_confmat(confmat, num_classes, average, ignore_index, absent_score)
+
+
+__all__ = ["jaccard_index"]

@@ -1,0 +1,62 @@
+"""KL divergence between distributions.
+
+Parity: reference `functional/classification/kl_divergence.py`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_xlogy
+
+
+def _kld_update(p: jax.Array, q: jax.Array, log_prob: bool) -> Tuple[jax.Array, int]:
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / p.sum(axis=-1, keepdims=True)
+        q = q / q.sum(axis=-1, keepdims=True)
+        q = jnp.clip(q, min=jnp.finfo(p.dtype).eps)
+        measures = jnp.sum(_safe_xlogy(p, p / q), axis=-1)
+    return measures, total
+
+
+def _kld_compute(measures: jax.Array, total, reduction: Optional[str] = "mean") -> jax.Array:
+    if reduction == "sum":
+        return measures.sum()
+    if reduction == "mean":
+        return measures.sum() / total
+    if reduction in ("none", None):
+        return measures
+    return measures / total
+
+
+def kl_divergence(
+    p: jax.Array,
+    q: jax.Array,
+    log_prob: bool = False,
+    reduction: Optional[str] = "mean",
+) -> jax.Array:
+    """KL(P ‖ Q) over rows of distributions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import kl_divergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> kl_divergence(p, q)
+        Array(0.08540752, dtype=float32)
+    """
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)
+
+
+__all__ = ["kl_divergence"]

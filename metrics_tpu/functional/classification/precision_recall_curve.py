@@ -1,0 +1,182 @@
+"""Exact precision-recall curve (sort-scan over distinct thresholds).
+
+Parity: reference `functional/classification/precision_recall_curve.py`
+(`_binary_clf_curve` `:23-61`, update `:64-122`, single/multi compute
+`:125-200`).
+
+TPU note (SURVEY §7 hard-part 1): the curve has a **data-dependent output
+length** (one point per distinct score), so this exact path runs eagerly on
+concrete arrays — the natural fit for an epoch-end ``compute``. The jit-path
+fixed-memory alternative is the binned curve family
+(`metrics_tpu/classification/binned_precision_recall.py`) whose state is a
+static ``(C, n_thresholds)`` grid.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _require_concrete(*arrays) -> None:
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        raise ValueError(
+            "Exact curve metrics have data-dependent output shapes and cannot run under jit tracing."
+            " Use the binned variants (e.g. BinnedPrecisionRecallCurve) for a jit-compatible fixed-size curve."
+        )
+
+
+def _binary_clf_curve(
+    preds: jax.Array,
+    target: jax.Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cumulative fps/tps at each distinct score threshold (descending)."""
+    _require_concrete(preds, target)
+    if sample_weights is not None:
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    order = jnp.argsort(-preds, stable=True)
+    preds = preds[order]
+    target = target[order]
+    weight = sample_weights[order] if sample_weights is not None else 1.0
+
+    distinct_idx = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.concatenate([distinct_idx, jnp.asarray([target.shape[0] - 1])])
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
+    """Flatten/transpose inputs to (flat-preds, flat-target) + resolved classes."""
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            # multilabel
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in"
+                    f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                    " number of classes from predictions"
+                )
+            preds = jnp.moveaxis(preds, 0, 1).reshape(num_classes, -1).T
+            target = jnp.moveaxis(target, 0, 1).reshape(num_classes, -1).T
+        else:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+    elif preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                f"Argument `pos_label` should be `None` when running multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in"
+                f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                " number of classes from predictions"
+            )
+        preds = jnp.moveaxis(preds, 0, 1).reshape(num_classes, -1).T
+        target = target.reshape(-1)
+    else:
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute_single_class(
+    preds: jax.Array,
+    target: jax.Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+
+    # cut the curve at full recall and flip so recall is decreasing
+    last_ind = int(jnp.nonzero(tps == tps[-1])[0][0])
+    sl = slice(0, last_ind + 1)
+    precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, dtype=recall.dtype)])
+    thresholds = thresholds[sl][::-1]
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute_multi_class(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    precision, recall, thresholds = [], [], []
+    for cls in range(num_classes):
+        preds_cls = preds[:, cls]
+        if target.ndim > 1:
+            res = precision_recall_curve(
+                preds_cls, target[:, cls], num_classes=1, pos_label=1, sample_weights=sample_weights
+            )
+        else:
+            res = precision_recall_curve(
+                preds_cls, target, num_classes=1, pos_label=cls, sample_weights=sample_weights
+            )
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
+    if num_classes == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _precision_recall_curve_compute_single_class(preds, target, pos_label, sample_weights)
+    return _precision_recall_curve_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def precision_recall_curve(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
+    """(precision, recall, thresholds) at every distinct score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall_curve
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
+
+
+__all__ = ["precision_recall_curve"]

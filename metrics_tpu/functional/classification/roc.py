@@ -1,0 +1,117 @@
+"""ROC curve.
+
+Parity: reference `functional/classification/roc.py` (single/multi-class/
+multilabel computes). Eager exact path; see precision_recall_curve.py TPU note.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _roc_update(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+
+
+def _roc_compute_single_class(
+    preds: jax.Array,
+    target: jax.Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    # prepend (0, 0) so the curve starts at the origin
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thresholds = jnp.concatenate([thresholds[0:1] + 1, thresholds])
+
+    if float(fps[-1]) <= 0:
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = jnp.zeros_like(thresholds)
+    else:
+        fpr = fps / fps[-1]
+
+    if float(tps[-1]) <= 0:
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = jnp.zeros_like(thresholds)
+    else:
+        tpr = tps / tps[-1]
+    return fpr, tpr, thresholds
+
+
+def _roc_compute_multi_class(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    fpr, tpr, thresholds = [], [], []
+    for cls in range(num_classes):
+        if target.ndim > 1:  # multilabel
+            res = roc(preds[:, cls], target[:, cls], num_classes=1, pos_label=1, sample_weights=sample_weights)
+        else:
+            res = roc(preds[:, cls], target, num_classes=1, pos_label=cls, sample_weights=sample_weights)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+    return fpr, tpr, thresholds
+
+
+def _roc_compute(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
+    if num_classes == 1 and preds.ndim == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _roc_compute_single_class(preds, target, pos_label, sample_weights)
+    return _roc_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def roc(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
+    """(fpr, tpr, thresholds).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import roc
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)
+
+
+__all__ = ["roc"]

@@ -1,0 +1,82 @@
+"""Specificity = tn / (tn + fp).
+
+Parity: reference `functional/classification/specificity.py:44-70` ff.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall import _check_average_arg
+from metrics_tpu.functional.classification.stat_scores import (
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utils.checks import _input_squeeze
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+
+def _specificity_compute(
+    tp: jax.Array,
+    fp: jax.Array,
+    tn: jax.Array,
+    fn: jax.Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> jax.Array:
+    numerator = tn
+    denominator = tn + fp
+    if mdmc_average != MDMCAverageMethod.SAMPLEWISE and average in (AverageMethod.NONE, None):
+        absent = (tp + fp + fn) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tn + fp,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def specificity(
+    preds,
+    target,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> jax.Array:
+    """Specificity (true negative rate).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import specificity
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> specificity(preds, target, average='macro', num_classes=3)
+        Array(0.6111111, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+    preds, target = _input_squeeze(preds, target)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _specificity_compute(tp, fp, tn, fn, average, mdmc_average)
+
+
+__all__ = ["specificity"]

@@ -1,0 +1,325 @@
+"""Classification input validation + canonicalization engine.
+
+Parity: reference `src/torchmetrics/utilities/checks.py` —
+``_input_format_classification`` (`:313-454`), ``_check_classification_inputs``
+(`:206`), ``_check_shape_and_type_consistency`` (`:68`), plus the retrieval input
+checks (`:534`).
+
+Inputs are classified into one of four :class:`DataType` cases and converted to
+canonical **binary int tensors** of shape ``(N, C)`` (or ``(N, C, X)`` for
+multi-dim multi-class) by thresholding, one-hot, or top-k selection.
+
+TPU-first rework:
+- shape/dtype validation is static and always runs (jit-safe);
+- value-dependent validation (label ranges, probability bounds) runs only on
+  concrete arrays — under ``jit`` tracing the values are unknowable, so those
+  checks are skipped, matching the "traceable with static shapes" contract;
+- ``num_classes`` inference from data maxima is eager-only; under jit, pass
+  ``num_classes`` explicitly (a shape-defining value must be static on TPU).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+
+
+def _is_concrete(*arrays) -> bool:
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _check_same_shape(preds, target) -> None:
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, got {preds.shape} and {target.shape}"
+        )
+
+
+def _check_for_empty(preds, target) -> bool:
+    return preds.size == 0 and target.size == 0
+
+
+def _squeeze_excess_dims(preds, target):
+    """Drop all size-1 dims except the leading N dim (reference `_input_squeeze`)."""
+    if preds.shape[:1] == (1,):
+        preds = jnp.expand_dims(jnp.squeeze(preds), 0)
+        target = jnp.expand_dims(jnp.squeeze(target), 0)
+    else:
+        preds, target = jnp.squeeze(preds), jnp.squeeze(target)
+    return preds, target
+
+
+def _basic_validation(preds, target, threshold, multiclass, ignore_index) -> None:
+    if _check_for_empty(preds, target):
+        return
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("The `target` has to be an integer tensor.")
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    if preds.shape[0] != target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    if not _is_concrete(preds, target):
+        return  # value checks need concrete data
+    if ignore_index is None and int(target.min()) < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if ignore_index is not None and ignore_index >= 0 and int(target.min()) < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if not preds_float and int(preds.min()) < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if multiclass is False and int(target.max()) > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    if multiclass is False and not preds_float and int(preds.max()) > 1:
+        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+
+def _case_and_implied_classes(preds, target) -> Tuple[DataType, int]:
+    """Resolve the input case from shapes/dtypes (reference `:68-121`)."""
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                f"The `preds` and `target` should have the same shape, got {preds.shape} and {target.shape}."
+            )
+        if preds_float and target.size > 0 and _is_concrete(target) and int(target.max()) > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = int(np.prod(preds.shape[1:])) if preds.size > 0 else 0
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = preds.shape[1] if preds.size > 0 else 0
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    return case, implied_classes
+
+
+def _validate_num_classes(case, preds, target, num_classes, multiclass, implied_classes) -> None:
+    if case == DataType.BINARY:
+        if num_classes > 2:
+            raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+        if num_classes == 2 and not multiclass:
+            raise ValueError(
+                "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+                " Set it to True if you want to transform binary data to multi-class format."
+            )
+        if num_classes == 1 and multiclass:
+            raise ValueError(
+                "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+            )
+    elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        if num_classes == 1 and multiclass is not False:
+            raise ValueError(
+                "You have set `num_classes=1`, but predictions are integers."
+                " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+                " to binary/multi-label, set `multiclass=False`."
+            )
+        if num_classes > 1:
+            if multiclass is False and implied_classes != num_classes:
+                raise ValueError(
+                    "You have set `multiclass=False`, but the implied number of classes"
+                    " (from shape of inputs) does not match `num_classes`."
+                )
+            if target.size > 0 and _is_concrete(target) and num_classes <= int(target.max()):
+                raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+            if preds.shape != target.shape and num_classes != implied_classes:
+                raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+    elif case == DataType.MULTILABEL:
+        if multiclass and num_classes != 2:
+            raise ValueError(
+                "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+            )
+        if not multiclass and num_classes != implied_classes:
+            raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _validate_top_k(top_k, case, implied_classes, multiclass, preds_float) -> None:
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            " multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds,
+    target,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Full input validation; returns the resolved :class:`DataType` case."""
+    _basic_validation(preds, target, threshold, multiclass, ignore_index)
+    case, implied_classes = _case_and_implied_classes(preds, target)
+
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if target.size > 0 and _is_concrete(target) and int(target.max()) >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        _validate_num_classes(case, preds, target, num_classes, multiclass, implied_classes)
+
+    if top_k is not None:
+        _validate_top_k(top_k, case, implied_classes, multiclass, jnp.issubdtype(preds.dtype, jnp.floating))
+
+    return case
+
+
+def _input_format_classification(
+    preds,
+    target,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, DataType]:
+    """Canonicalize (preds, target) to binary int tensors ``(N, C)``/``(N, C, X)``.
+
+    Same contract as reference ``_input_format_classification``
+    (`utilities/checks.py:313-454`): binary -> ``(N, 1)`` thresholded; multi-class
+    -> one-hot/top-k ``(N, C)``; multi-label -> thresholded ``(N, C)`` (extra dims
+    flattened); multi-dim multi-class -> ``(N, C, X)``. The ``multiclass`` flag
+    force-converts between views.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _squeeze_excess_dims(preds, target)
+    if preds.dtype == jnp.float16:
+        preds = preds.astype(jnp.float32)
+
+    case = _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+    )
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32) if jnp.issubdtype(preds.dtype, jnp.floating) else preds
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if jnp.issubdtype(preds.dtype, jnp.floating):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if num_classes is None:
+                if not _is_concrete(preds, target):
+                    raise ValueError(
+                        "`num_classes` must be given explicitly for label inputs under jit tracing"
+                        " (class count defines the output shape, which must be static on TPU)."
+                    )
+                num_classes = int(max(int(preds.max()), int(target.max())) + 1)
+            preds = to_onehot(preds, max(2, num_classes))
+        target = to_onehot(target, max(2, int(num_classes) if num_classes else 2))
+
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if not _check_for_empty(preds, target):
+        if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+            target = target.reshape(target.shape[0], target.shape[1], -1)
+            preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+        else:
+            target = target.reshape(target.shape[0], -1)
+            preds = preds.reshape(preds.shape[0], -1)
+
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = jnp.squeeze(preds, -1), jnp.squeeze(target, -1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _input_squeeze(preds, target):
+    return _squeeze_excess_dims(jnp.asarray(preds), jnp.asarray(target))
+
+
+def _check_retrieval_inputs(
+    indexes,
+    preds,
+    target,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Validate and flatten retrieval triples (reference `checks.py:534-590`)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+
+    indexes = indexes.reshape(-1)
+    preds = preds.reshape(-1).astype(jnp.float32)
+    target = target.reshape(-1)
+
+    if ignore_index is not None:
+        valid = target != ignore_index
+        if _is_concrete(target):
+            indexes, preds, target = indexes[valid], preds[valid], target[valid]
+
+    if preds.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+
+    if _is_concrete(target) and not allow_non_binary_target and target.size and int(target.max()) > 1:
+        raise ValueError("`target` must contain binary values")
+
+    return indexes.astype(jnp.int32) if indexes.dtype != jnp.int64 else indexes, preds, target.astype(jnp.int32)
+
+
+__all__ = [
+    "_input_format_classification",
+    "_check_classification_inputs",
+    "_check_same_shape",
+    "_check_retrieval_inputs",
+    "_input_squeeze",
+]

@@ -29,13 +29,19 @@ class EnumStr(str, Enum):
             raise ValueError(f"`{arg}` must be one of {[e.value for e in cls]}, got {value!r}")
         return member
 
+    @staticmethod
+    def _canon(value: str) -> str:
+        return value.replace("-", "_").lower()
+
     def __eq__(self, other: object) -> bool:
+        if isinstance(other, Enum):
+            other = other.value
         if isinstance(other, str):
-            return self.value.lower() == other.replace("-", "_").lower()
-        return Enum.__eq__(self, other)
+            return self._canon(self.value) == self._canon(other)
+        return NotImplemented
 
     def __hash__(self) -> int:
-        return hash(self.value.lower())
+        return hash(self._canon(self.value))
 
 
 class DataType(EnumStr):

@@ -1,0 +1,42 @@
+"""Seeded classification input fixtures (analogue of reference tests/unittests/classification/inputs.py)."""
+from collections import namedtuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.RandomState(1)
+
+_binary_prob = Input(
+    preds=jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)),
+    target=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))),
+)
+_binary = Input(
+    preds=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))),
+    target=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))),
+)
+_multiclass_prob = Input(
+    preds=jnp.asarray(
+        (lambda p: p / p.sum(-1, keepdims=True))(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+    ),
+    target=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))),
+)
+_multiclass = Input(
+    preds=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))),
+    target=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))),
+)
+_multilabel_prob = Input(
+    preds=jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)),
+    target=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))),
+)
+_multilabel = Input(
+    preds=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))),
+    target=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))),
+)
+_multidim_multiclass = Input(
+    preds=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
+    target=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
+)

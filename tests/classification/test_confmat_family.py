@@ -1,0 +1,145 @@
+"""Confusion-matrix family vs sklearn (ConfusionMatrix/CohenKappa/Matthews/Jaccard)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from metrics_tpu import CohenKappa, ConfusionMatrix, JaccardIndex, MatthewsCorrCoef
+from metrics_tpu.functional import cohen_kappa, confusion_matrix, jaccard_index, matthews_corrcoef
+from tests.classification.inputs import _multiclass, _multiclass_prob
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_cm(preds, target, normalize=None):
+    if preds.ndim > target.ndim:
+        preds = preds.argmax(-1)
+    return skm.confusion_matrix(target, preds, labels=range(NUM_CLASSES), normalize=normalize)
+
+
+class TestConfusionMatrix(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_confmat_class(self, ddp):
+        self.run_class_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            ConfusionMatrix,
+            _sk_cm,
+            metric_args={"num_classes": NUM_CLASSES},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("normalize", ["true", "pred", "all", None])
+    def test_confmat_normalize(self, normalize):
+        self.run_functional_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            confusion_matrix,
+            lambda p, t: np.nan_to_num(_sk_cm(p, t, normalize=normalize)),
+            metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+        )
+
+    def test_confmat_probs(self):
+        self.run_functional_metric_test(
+            _multiclass_prob.preds,
+            _multiclass_prob.target,
+            confusion_matrix,
+            _sk_cm,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_confmat_jit(self):
+        self.run_jit_test(
+            _multiclass.preds, _multiclass.target, confusion_matrix, metric_args={"num_classes": NUM_CLASSES}
+        )
+
+    def test_confmat_spmd(self):
+        self.run_spmd_test(
+            _multiclass.preds,
+            _multiclass.target,
+            lambda **kw: ConfusionMatrix(num_classes=NUM_CLASSES, **kw),
+            _sk_cm,
+        )
+
+    def test_confmat_multilabel(self):
+        rng = np.random.RandomState(3)
+        p = rng.randint(0, 2, (4, 20, NUM_CLASSES))
+        t = rng.randint(0, 2, (4, 20, NUM_CLASSES))
+
+        def sk_ml_cm(preds, target):
+            return skm.multilabel_confusion_matrix(target, preds)
+
+        self.run_functional_metric_test(
+            jnp.asarray(p),
+            jnp.asarray(t),
+            confusion_matrix,
+            sk_ml_cm,
+            metric_args={"num_classes": NUM_CLASSES, "multilabel": True},
+        )
+
+
+class TestCohenKappa(MetricTester):
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_kappa_functional(self, weights):
+        self.run_functional_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            cohen_kappa,
+            lambda p, t: skm.cohen_kappa_score(t, p, weights=weights),
+            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_kappa_class(self, ddp):
+        self.run_class_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            CohenKappa,
+            lambda p, t: skm.cohen_kappa_score(t, p),
+            metric_args={"num_classes": NUM_CLASSES},
+            ddp=ddp,
+            check_batch=False,
+        )
+
+
+class TestMatthews(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_mcc_class(self, ddp):
+        self.run_class_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            MatthewsCorrCoef,
+            lambda p, t: skm.matthews_corrcoef(t, p),
+            metric_args={"num_classes": NUM_CLASSES},
+            ddp=ddp,
+            check_batch=False,
+        )
+
+    def test_mcc_jit(self):
+        self.run_jit_test(
+            _multiclass.preds, _multiclass.target, matthews_corrcoef, metric_args={"num_classes": NUM_CLASSES}
+        )
+
+
+class TestJaccard(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    def test_jaccard_functional(self, average):
+        sk_average = None if average == "none" else average
+        self.run_functional_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            jaccard_index,
+            lambda p, t: skm.jaccard_score(t, p, average=sk_average, labels=range(NUM_CLASSES), zero_division=0),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_jaccard_class(self, ddp):
+        self.run_class_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            JaccardIndex,
+            lambda p, t: skm.jaccard_score(t, p, average="macro", zero_division=0),
+            metric_args={"num_classes": NUM_CLASSES},
+            ddp=ddp,
+            check_batch=False,
+        )

@@ -1,0 +1,193 @@
+"""Curve metrics vs sklearn + reference (PRCurve/ROC/AUROC/AP/AUC/binned family)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.classification.inputs import _binary_prob, _multiclass_prob
+from tests.helpers.reference_oracle import get_reference
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+class TestAUROC(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_binary_class(self, ddp):
+        self.run_class_metric_test(
+            _binary_prob.preds,
+            _binary_prob.target,
+            AUROC,
+            lambda p, t: skm.roc_auc_score(t, p),
+            metric_args={"pos_label": 1},
+            ddp=ddp,
+            check_batch=False,
+        )
+
+    def test_auroc_multiclass(self):
+        self.run_functional_metric_test(
+            _multiclass_prob.preds,
+            _multiclass_prob.target,
+            auroc,
+            lambda p, t: skm.roc_auc_score(t, p, multi_class="ovr", average="macro", labels=range(NUM_CLASSES)),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_auroc_max_fpr(self):
+        p, t = _binary_prob.preds[0], _binary_prob.target[0]
+        res = auroc(p, t, max_fpr=0.5)
+        ref = skm.roc_auc_score(np.asarray(t), np.asarray(p), max_fpr=0.5)
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-5)
+
+
+class TestAveragePrecision(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ap_binary_class(self, ddp):
+        self.run_class_metric_test(
+            _binary_prob.preds,
+            _binary_prob.target,
+            AveragePrecision,
+            lambda p, t: skm.average_precision_score(t, p),
+            metric_args={"pos_label": 1},
+            ddp=ddp,
+            check_batch=False,
+        )
+
+    def test_ap_multiclass_macro(self):
+        def sk_ap_macro(p, t):
+            onehot = np.eye(NUM_CLASSES)[t]
+            scores = [skm.average_precision_score(onehot[:, c], p[:, c]) for c in range(NUM_CLASSES)]
+            return np.nanmean(scores)
+
+        self.run_functional_metric_test(
+            _multiclass_prob.preds,
+            _multiclass_prob.target,
+            average_precision,
+            sk_ap_macro,
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+        )
+
+
+class TestCurves(MetricTester):
+    def test_pr_curve_matches_reference(self):
+        ref = get_reference()
+        if ref is None:
+            pytest.skip("reference implementation not available")
+        import torch
+
+        p, t = _binary_prob.preds[0], _binary_prob.target[0]
+        mp, mr, mt = precision_recall_curve(p, t, pos_label=1)
+        rp, rr, rt = ref.functional.precision_recall_curve(
+            torch.tensor(np.asarray(p)), torch.tensor(np.asarray(t)), pos_label=1
+        )
+        np.testing.assert_allclose(np.asarray(mp), rp.numpy(), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(mr), rr.numpy(), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(mt), rt.numpy(), atol=1e-6)
+
+    def test_roc_matches_sklearn(self):
+        p, t = _binary_prob.preds[0], _binary_prob.target[0]
+        fpr, tpr, _ = roc(p, t, pos_label=1)
+        sfpr, stpr, _ = skm.roc_curve(np.asarray(t), np.asarray(p), drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sfpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), stpr, atol=1e-6)
+
+    def test_pr_curve_class_accumulates(self):
+        m = PrecisionRecallCurve(pos_label=1)
+        for i in range(2):
+            m.update(_binary_prob.preds[i], _binary_prob.target[i])
+        p, r, t = m.compute()
+        all_p = jnp.concatenate([_binary_prob.preds[0], _binary_prob.preds[1]])
+        all_t = jnp.concatenate([_binary_prob.target[0], _binary_prob.target[1]])
+        fp, fr, ft = precision_recall_curve(all_p, all_t, pos_label=1)
+        np.testing.assert_allclose(np.asarray(p), np.asarray(fp), atol=1e-6)
+
+    def test_roc_class(self):
+        m = ROC(pos_label=1)
+        m.update(_binary_prob.preds[0], _binary_prob.target[0])
+        fpr, tpr, th = m.compute()
+        assert fpr.shape == tpr.shape == th.shape
+
+    def test_auc(self):
+        x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        np.testing.assert_allclose(np.asarray(auc(x, y)), 4.0)
+        m = AUC()
+        m.update(x, y)
+        np.testing.assert_allclose(np.asarray(m.compute()), 4.0)
+        with pytest.raises(ValueError, match="neither increasing or decreasing"):
+            auc(jnp.asarray([1.0, 0.0, 2.0]), jnp.asarray([1.0, 1.0, 1.0]))
+        np.testing.assert_allclose(np.asarray(auc(jnp.asarray([1.0, 0.0, 2.0]), jnp.asarray([1.0, 1.0, 1.0]), reorder=True)), 2.0)
+
+
+class TestBinnedFamily(MetricTester):
+    def test_binned_ap_close_to_exact(self):
+        m = BinnedAveragePrecision(num_classes=1, thresholds=500)
+        for i in range(4):
+            m.update(_binary_prob.preds[i], _binary_prob.target[i])
+        binned = float(m.compute())
+        all_p = jnp.concatenate([_binary_prob.preds[i] for i in range(4)])
+        all_t = jnp.concatenate([_binary_prob.target[i] for i in range(4)])
+        exact = float(skm.average_precision_score(np.asarray(all_t), np.asarray(all_p)))
+        assert abs(binned - exact) < 0.05
+
+    def test_binned_curve_is_jittable(self):
+        """The binned curve update must run fully under jit (the TPU-native path)."""
+        import jax
+
+        m = BinnedPrecisionRecallCurve(num_classes=1, thresholds=10)
+        init, upd, cmp = m.as_functions()
+        state = init()
+        jupd = jax.jit(upd)
+        for i in range(2):
+            state = jupd(state, _binary_prob.preds[i], _binary_prob.target[i])
+        assert state["TPs"].shape == (1, 10)
+
+    def test_binned_curve_reference_example(self):
+        pred = jnp.asarray([0.0, 1.0, 2.0, 3.0]) / 3.0
+        target = jnp.asarray([0, 1, 1, 1])
+        m = BinnedAveragePrecision(num_classes=1, thresholds=10)
+        res = m(pred, target)
+        np.testing.assert_allclose(np.asarray(res), 1.0, atol=1e-4)
+
+    def test_binned_recall_at_precision(self):
+        pred = jnp.asarray([0.0, 0.2, 0.5, 0.8])
+        target = jnp.asarray([0, 1, 1, 0])
+        m = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+        recall, thr = m(pred, target)
+        np.testing.assert_allclose(np.asarray(recall), 1.0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(thr), 0.1111, atol=1e-3)
+
+    def test_binned_spmd(self):
+        """Binned curve state syncs with one psum under shard_map; the SPMD
+        result must equal single-device accumulation over all data."""
+        m = BinnedAveragePrecision(num_classes=1, thresholds=100)
+        for i in range(4):
+            m.update(_binary_prob.preds[i], _binary_prob.target[i])
+        single = float(m.compute())
+
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        m2 = BinnedAveragePrecision(num_classes=1, thresholds=100)
+        init, upd, cmp = m2.as_functions()
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+
+        def f(p, t):
+            st = init()
+            for i in range(2):
+                st = upd(st, p[i], t[i])
+            return cmp(st, axis_name="dp")
+
+        out = jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+        )(jnp.stack([_binary_prob.preds[i] for i in range(4)]), jnp.stack([_binary_prob.target[i] for i in range(4)]))
+        np.testing.assert_allclose(float(out), single, atol=1e-5)

@@ -1,0 +1,210 @@
+"""Hamming/Hinge/KLDiv/Calibration/Ranking/Dice tests vs sklearn + reference conventions."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from metrics_tpu import (
+    CalibrationError,
+    CoverageError,
+    Dice,
+    HammingDistance,
+    HingeLoss,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+)
+from metrics_tpu.functional import (
+    calibration_error,
+    coverage_error,
+    dice,
+    hamming_distance,
+    hinge_loss,
+    kl_divergence,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
+from tests.classification.inputs import _multilabel, _multilabel_prob
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(7)
+
+
+class TestHamming(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_hamming_multilabel(self, ddp):
+        def sk_hamming(preds, target):
+            n = target.shape[0]
+            return skm.hamming_loss(target.reshape(n, -1), preds.reshape(n, -1))
+
+        self.run_class_metric_test(
+            _multilabel.preds, _multilabel.target, HammingDistance, sk_hamming, ddp=ddp
+        )
+
+    def test_hamming_functional(self):
+        self.run_functional_metric_test(
+            _multilabel.preds,
+            _multilabel.target,
+            hamming_distance,
+            lambda p, t: skm.hamming_loss(t.reshape(t.shape[0], -1), p.reshape(p.shape[0], -1)),
+        )
+
+
+class TestHinge(MetricTester):
+    def test_hinge_binary(self):
+        preds = jnp.asarray(_rng.randn(4, 32).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (4, 32)))
+
+        def sk_hinge(p, t):
+            return skm.hinge_loss(t * 2 - 1, p)
+
+        self.run_functional_metric_test(preds, target, hinge_loss, sk_hinge)
+
+    def test_hinge_multiclass_crammer_singer(self):
+        preds = jnp.asarray(_rng.randn(4, 32, 3).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 3, (4, 32)))
+
+        def sk_hinge_mc(p, t):
+            return skm.hinge_loss(t, p, labels=[0, 1, 2])
+
+        self.run_functional_metric_test(preds, target, hinge_loss, sk_hinge_mc)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_hinge_class(self, ddp):
+        preds = jnp.asarray(_rng.randn(4, 32).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (4, 32)))
+        self.run_class_metric_test(
+            preds, target, HingeLoss, lambda p, t: skm.hinge_loss(t * 2 - 1, p), ddp=ddp, check_batch=False
+        )
+
+    def test_hinge_grad(self):
+        preds = jnp.asarray(_rng.randn(2, 16).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (2, 16)))
+        self.run_differentiability_test(preds, target, hinge_loss)
+
+
+class TestKLDivergence(MetricTester):
+    def test_kl_functional(self):
+        p = _rng.rand(4, 32, 6).astype(np.float32)
+        q = _rng.rand(4, 32, 6).astype(np.float32)
+
+        def ref_kl(pp, qq):
+            pn = pp / pp.sum(-1, keepdims=True)
+            qn = qq / qq.sum(-1, keepdims=True)
+            return np.mean(np.sum(pn * np.log(pn / qn), axis=-1))
+
+        self.run_functional_metric_test(jnp.asarray(p), jnp.asarray(q), kl_divergence, ref_kl, atol=1e-5)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_kl_class(self, ddp):
+        p = _rng.rand(4, 32, 6).astype(np.float32)
+        q = _rng.rand(4, 32, 6).astype(np.float32)
+
+        def ref_kl(pp, qq):
+            pn = pp / pp.sum(-1, keepdims=True)
+            qn = qq / qq.sum(-1, keepdims=True)
+            return np.mean(np.sum(pn * np.log(pn / qn), axis=-1))
+
+        self.run_class_metric_test(jnp.asarray(p), jnp.asarray(q), KLDivergence, ref_kl, ddp=ddp, atol=1e-5)
+
+    def test_kl_jit(self):
+        p = jnp.asarray(_rng.rand(4, 8, 3).astype(np.float32))
+        q = jnp.asarray(_rng.rand(4, 8, 3).astype(np.float32))
+        self.run_jit_test(p, q, kl_divergence)
+
+
+class TestCalibration(MetricTester):
+    def test_ece_vs_manual(self):
+        """Binary ECE against a hand-rolled numpy implementation."""
+        preds = _rng.rand(200).astype(np.float32)
+        target = _rng.randint(0, 2, 200)
+
+        def ref_ece(p, t, n_bins=15):
+            bins = np.linspace(0, 1, n_bins + 1)
+            idx = np.clip(np.searchsorted(bins, p, side="left") - 1, 0, n_bins - 1)
+            ce = 0.0
+            for b in range(n_bins):
+                m = idx == b
+                if m.sum() == 0:
+                    continue
+                ce += abs(t[m].mean() - p[m].mean()) * m.mean()
+            return ce
+
+        res = calibration_error(jnp.asarray(preds), jnp.asarray(target), n_bins=15, norm="l1")
+        np.testing.assert_allclose(np.asarray(res), ref_ece(preds, target), atol=1e-5)
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    def test_ce_class_accumulates(self, norm):
+        preds = jnp.asarray(_rng.rand(4, 50).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 2, (4, 50)))
+        m = CalibrationError(norm=norm)
+        for i in range(4):
+            m.update(preds[i], target[i])
+        batch_all = calibration_error(preds.reshape(-1), target.reshape(-1), norm=norm)
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(batch_all), atol=1e-6)
+
+    def test_invalid_norm(self):
+        with pytest.raises(ValueError, match="Norm"):
+            CalibrationError(norm="l3")
+
+
+class TestRanking(MetricTester):
+    @pytest.mark.parametrize(
+        "functional, module, sk_fn",
+        [
+            (coverage_error, CoverageError, skm.coverage_error),
+            (label_ranking_average_precision, LabelRankingAveragePrecision, skm.label_ranking_average_precision_score),
+            (label_ranking_loss, LabelRankingLoss, skm.label_ranking_loss),
+        ],
+    )
+    def test_ranking_functional(self, functional, module, sk_fn):
+        self.run_functional_metric_test(
+            _multilabel_prob.preds,
+            _multilabel_prob.target,
+            functional,
+            lambda p, t: sk_fn(t, p),
+            atol=1e-5,
+        )
+
+    @pytest.mark.parametrize(
+        "module, sk_fn",
+        [
+            (CoverageError, skm.coverage_error),
+            (LabelRankingAveragePrecision, skm.label_ranking_average_precision_score),
+            (LabelRankingLoss, skm.label_ranking_loss),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ranking_class(self, module, sk_fn, ddp):
+        self.run_class_metric_test(
+            _multilabel_prob.preds,
+            _multilabel_prob.target,
+            module,
+            lambda p, t: sk_fn(t, p),
+            ddp=ddp,
+            check_batch=False,
+            atol=1e-5,
+        )
+
+    def test_ranking_jit(self):
+        self.run_jit_test(_multilabel_prob.preds, _multilabel_prob.target, label_ranking_loss)
+
+
+class TestDice(MetricTester):
+    def test_dice_micro_equals_f1_micro(self):
+        preds = jnp.asarray(_rng.randint(0, 3, (4, 32)))
+        target = jnp.asarray(_rng.randint(0, 3, (4, 32)))
+        self.run_functional_metric_test(
+            preds,
+            target,
+            dice,
+            lambda p, t: skm.f1_score(t, p, average="micro"),
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_dice_class(self, ddp):
+        preds = jnp.asarray(_rng.randint(0, 3, (4, 32)))
+        target = jnp.asarray(_rng.randint(0, 3, (4, 32)))
+        self.run_class_metric_test(
+            preds, target, Dice, lambda p, t: skm.f1_score(t, p, average="micro"), ddp=ddp
+        )

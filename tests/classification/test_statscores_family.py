@@ -1,0 +1,227 @@
+"""Stat-scores family vs sklearn oracles (Accuracy/Precision/Recall/F1/FBeta/Specificity/StatScores)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from metrics_tpu import Accuracy, F1Score, FBetaScore, Precision, Recall, Specificity, StatScores
+from metrics_tpu.functional import (
+    accuracy,
+    f1_score,
+    fbeta_score,
+    precision,
+    recall,
+    specificity,
+    stat_scores,
+)
+from tests.classification.inputs import (
+    _binary,
+    _binary_prob,
+    _multiclass,
+    _multiclass_prob,
+    _multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy_binary_prob(preds, target):
+    return skm.accuracy_score(target, (preds >= THRESHOLD).astype(int))
+
+
+def _sk_accuracy_mc(preds, target):
+    if preds.ndim > target.ndim:
+        preds = preds.argmax(-1)
+    return skm.accuracy_score(target, preds)
+
+
+class TestAccuracy(MetricTester):
+    @pytest.mark.parametrize(
+        "preds, target, sk_fn",
+        [
+            (_binary_prob.preds, _binary_prob.target, _sk_accuracy_binary_prob),
+            (_binary.preds, _binary.target, _sk_accuracy_mc),
+            (_multiclass.preds, _multiclass.target, _sk_accuracy_mc),
+            (_multiclass_prob.preds, _multiclass_prob.target, _sk_accuracy_mc),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_accuracy_class(self, preds, target, sk_fn, ddp):
+        self.run_class_metric_test(preds, target, Accuracy, sk_fn, ddp=ddp, check_batch=not ddp)
+
+    def test_accuracy_functional(self):
+        self.run_functional_metric_test(
+            _multiclass.preds, _multiclass.target, accuracy, _sk_accuracy_mc
+        )
+
+    def test_accuracy_jit(self):
+        self.run_jit_test(_multiclass.preds, _multiclass.target, accuracy, metric_args={"num_classes": NUM_CLASSES})
+
+    def test_accuracy_spmd(self):
+        # num_classes must be static under shard_map tracing (one-hot width)
+        self.run_spmd_test(
+            _multiclass.preds,
+            _multiclass.target,
+            Accuracy,
+            _sk_accuracy_mc,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_accuracy_top_k(self):
+        p, t = _multiclass_prob.preds[0], _multiclass_prob.target[0]
+        res = accuracy(p, t, top_k=2)
+        ref = skm.top_k_accuracy_score(np.asarray(t), np.asarray(p), k=2, labels=range(NUM_CLASSES))
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+    def test_subset_accuracy_multilabel(self):
+        p, t = _multilabel_prob.preds[0], _multilabel_prob.target[0]
+        res = accuracy(p, t, subset_accuracy=True)
+        ref = skm.accuracy_score(np.asarray(t), (np.asarray(p) >= THRESHOLD).astype(int))
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn",
+    [
+        (Precision, precision, skm.precision_score),
+        (Recall, recall, skm.recall_score),
+        (F1Score, f1_score, skm.f1_score),
+        (partial(FBetaScore, beta=2.0), partial(fbeta_score, beta=2.0), partial(skm.fbeta_score, beta=2.0)),
+    ],
+)
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+class TestPrecisionRecallF(MetricTester):
+    def test_multiclass_class(self, metric_class, metric_fn, sk_fn, average):
+        sk_average = None if average == "none" else average
+        self.run_class_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            metric_class,
+            lambda p, t: sk_fn(t, p, average=sk_average, labels=range(NUM_CLASSES), zero_division=0),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+            check_batch=False,
+        )
+
+    def test_multiclass_functional(self, metric_class, metric_fn, sk_fn, average):
+        sk_average = None if average == "none" else average
+        self.run_functional_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            metric_fn,
+            lambda p, t: sk_fn(t, p, average=sk_average, labels=range(NUM_CLASSES), zero_division=0),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+        )
+
+    def test_multiclass_prob_ddp(self, metric_class, metric_fn, sk_fn, average):
+        sk_average = None if average == "none" else average
+        self.run_class_metric_test(
+            _multiclass_prob.preds,
+            _multiclass_prob.target,
+            metric_class,
+            lambda p, t: sk_fn(t, p.argmax(-1), average=sk_average, labels=range(NUM_CLASSES), zero_division=0),
+            metric_args={"average": average, "num_classes": NUM_CLASSES},
+            ddp=True,
+        )
+
+
+class TestSpecificity(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_specificity_binary(self, ddp):
+        def sk_specificity(preds, target):
+            tn, fp, fn, tp = skm.confusion_matrix(target, (preds >= THRESHOLD).astype(int), labels=[0, 1]).ravel()
+            return tn / (tn + fp)
+
+        self.run_class_metric_test(
+            _binary_prob.preds, _binary_prob.target, Specificity, sk_specificity, ddp=ddp, check_batch=False
+        )
+
+    def test_specificity_functional_macro(self):
+        def sk_specificity_macro(preds, target):
+            cm = skm.confusion_matrix(target, preds, labels=range(NUM_CLASSES))
+            res = []
+            for c in range(NUM_CLASSES):
+                tp = cm[c, c]
+                fp = cm[:, c].sum() - tp
+                fn = cm[c, :].sum() - tp
+                tn = cm.sum() - tp - fp - fn
+                res.append(tn / (tn + fp))
+            return np.mean(res)
+
+        self.run_functional_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            specificity,
+            sk_specificity_macro,
+            metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+        )
+
+
+class TestStatScores(MetricTester):
+    def test_stat_scores_micro(self):
+        def sk_stats(preds, target):
+            cm = skm.confusion_matrix(target, preds, labels=range(NUM_CLASSES))
+            tp = np.diag(cm).sum()
+            fp = cm.sum(0).sum() - np.diag(cm).sum()
+            fn = cm.sum(1).sum() - np.diag(cm).sum()
+            tn = NUM_CLASSES * cm.sum() - (cm.sum() * 2 - tp) - cm.sum() + tp
+            # elementwise over one-hot: tn = N*C - tp - fp - fn
+            n = target.shape[0]
+            tn = n * NUM_CLASSES - tp - fp - fn
+            return np.array([tp, fp, tn, fn, tp + fn])
+
+        self.run_functional_metric_test(_multiclass.preds, _multiclass.target, stat_scores, sk_stats)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_stat_scores_class_macro(self, ddp):
+        def sk_stats_macro(preds, target):
+            cm = skm.confusion_matrix(target, preds, labels=range(NUM_CLASSES))
+            out = []
+            n = target.shape[0]
+            for c in range(NUM_CLASSES):
+                tp = cm[c, c]
+                fp = cm[:, c].sum() - tp
+                fn = cm[c, :].sum() - tp
+                tn = n - tp - fp - fn
+                out.append([tp, fp, tn, fn, tp + fn])
+            return np.array(out)
+
+        self.run_class_metric_test(
+            _multiclass.preds,
+            _multiclass.target,
+            StatScores,
+            sk_stats_macro,
+            metric_args={"reduce": "macro", "num_classes": NUM_CLASSES},
+            ddp=ddp,
+            check_batch=False,
+        )
+
+    def test_stat_scores_jit(self):
+        self.run_jit_test(
+            _multiclass.preds,
+            _multiclass.target,
+            stat_scores,
+            metric_args={"reduce": "macro", "num_classes": NUM_CLASSES},
+        )
+
+    def test_ignore_index(self):
+        """ignore_index masks the class column exactly like reference deletion."""
+        preds = jnp.asarray([1, 0, 2, 1])
+        target = jnp.asarray([1, 1, 2, 0])
+        res = stat_scores(preds, target, reduce="micro", num_classes=3, ignore_index=0)
+        np.testing.assert_array_equal(np.asarray(res), [2, 1, 4, 1, 3])
+        res_macro = stat_scores(preds, target, reduce="macro", num_classes=3, ignore_index=0)
+        assert (np.asarray(res_macro)[0] == -1).all()
+
+
+def test_differentiability_of_probs_path():
+    """Stat-scores are not differentiable (thresholding), but must not crash under grad of inputs."""
+    t = MetricTester()
+    # hinge is differentiable; quick check via accuracy of probabilities is skipped
+    from metrics_tpu.functional import hinge_loss
+
+    t.run_differentiability_test(
+        jnp.asarray(np.random.RandomState(0).randn(2, 8).astype(np.float32)),
+        jnp.asarray(np.random.RandomState(1).randint(0, 2, (2, 8))),
+        hinge_loss,
+    )

@@ -1,0 +1,69 @@
+"""Import the mounted reference implementation as a differential-test oracle.
+
+The reference (`/root/reference/src`, pure torch, runs on CPU) is the behavioral
+contract for cases sklearn handles differently (e.g. curve endpoint conventions,
+hamming over one-hot). Requires a tiny ``pkg_resources`` shim on python >= 3.12.
+Tests using it must skip when the mount is absent.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+_REF_PATH = "/root/reference/src"
+
+
+def _install_pkg_resources_shim() -> None:
+    if "pkg_resources" in sys.modules:
+        return
+    pr = types.ModuleType("pkg_resources")
+
+    class DistributionNotFound(Exception):
+        pass
+
+    def get_distribution(name):
+        import importlib.metadata as im
+
+        class _Dist:
+            def __init__(self, version):
+                self.version = version
+
+        try:
+            return _Dist(im.version(name))
+        except Exception as err:
+            raise DistributionNotFound(name) from err
+
+    pr.DistributionNotFound = DistributionNotFound
+    pr.get_distribution = get_distribution
+    sys.modules["pkg_resources"] = pr
+
+
+def reference_available() -> bool:
+    import os
+
+    return os.path.isdir(_REF_PATH)
+
+
+_cache = {}
+
+
+def get_reference():
+    """Returns the reference `torchmetrics` module, or None if unavailable."""
+    if "mod" in _cache:
+        return _cache["mod"]
+    if not reference_available():
+        _cache["mod"] = None
+        return None
+    _install_pkg_resources_shim()
+    if _REF_PATH not in sys.path:
+        sys.path.insert(0, _REF_PATH)
+    try:
+        import torchmetrics  # noqa: F401
+
+        _cache["mod"] = torchmetrics
+    except Exception:
+        _cache["mod"] = None
+    return _cache["mod"]
+
+
+__all__ = ["get_reference", "reference_available"]
