@@ -4,5 +4,11 @@ Parity target: reference `src/torchmetrics/functional/__init__.py` (78 exports).
 """
 from metrics_tpu.functional.classification import *  # noqa: F401,F403
 from metrics_tpu.functional.classification import __all__ as _classification_all
+from metrics_tpu.functional.pairwise import *  # noqa: F401,F403
+from metrics_tpu.functional.pairwise import __all__ as _pairwise_all
+from metrics_tpu.functional.regression import *  # noqa: F401,F403
+from metrics_tpu.functional.regression import __all__ as _regression_all
+from metrics_tpu.functional.retrieval import *  # noqa: F401,F403
+from metrics_tpu.functional.retrieval import __all__ as _retrieval_all
 
-__all__ = list(_classification_all)
+__all__ = list(_classification_all) + list(_pairwise_all) + list(_regression_all) + list(_retrieval_all)

@@ -1,0 +1,138 @@
+"""Elementwise-error regression kernels: MSE/MAE/MSLE/MAPE/SMAPE/WMAPE.
+
+Parity: reference `functional/regression/{mse,mae,log_mse,mape,symmetric_mape,
+wmape}.py` — each is a (sum-accumulate, count, divide) triple with
+``dist_reduce_fx="sum"`` states.
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+_EPS = 1.17e-06
+
+
+def _mean_squared_error_update(preds, target, num_outputs: int = 1) -> Tuple[jax.Array, int]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = (preds - target).astype(jnp.float32)
+    return jnp.sum(diff * diff, axis=0), target.shape[0] if num_outputs > 1 else target.size
+
+
+def _mean_squared_error_compute(sum_squared_error, n_obs, squared: bool = True) -> jax.Array:
+    mse = sum_squared_error / n_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds, target, squared: bool = True, num_outputs: int = 1) -> jax.Array:
+    """MSE (or RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_error
+        >>> x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> mean_squared_error(x, y)
+        Array(0.25, dtype=float32)
+    """
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared)
+
+
+def _mean_absolute_error_update(preds, target) -> Tuple[jax.Array, int]:
+    _check_same_shape(preds, target)
+    return jnp.sum(jnp.abs(preds.astype(jnp.float32) - target)), target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error, n_obs) -> jax.Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds, target) -> jax.Array:
+    """MAE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_error
+        >>> x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> y = jnp.asarray([0.0, 1.0, 2.0, 1.0])
+        >>> mean_absolute_error(x, y)
+        Array(0.5, dtype=float32)
+    """
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)
+
+
+def _mean_squared_log_error_update(preds, target) -> Tuple[jax.Array, int]:
+    _check_same_shape(preds, target)
+    diff = jnp.log1p(preds.astype(jnp.float32)) - jnp.log1p(target.astype(jnp.float32))
+    return jnp.sum(diff * diff), target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error, n_obs) -> jax.Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds, target) -> jax.Array:
+    """MSLE over log1p-transformed values."""
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)
+
+
+def _mean_absolute_percentage_error_update(preds, target, epsilon: float = _EPS) -> Tuple[jax.Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error, n_obs) -> jax.Array:
+    return sum_abs_per_error / n_obs
+
+
+def mean_absolute_percentage_error(preds, target) -> jax.Array:
+    """MAPE with epsilon-clipped denominators."""
+    sum_abs_per_error, n_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, n_obs)
+
+
+def _symmetric_mape_update(preds, target, epsilon: float = _EPS) -> Tuple[jax.Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = 2 * jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def symmetric_mean_absolute_percentage_error(preds, target) -> jax.Array:
+    """SMAPE = mean(2|p - t| / (|t| + |p|))."""
+    sum_abs_per_error, n_obs = _symmetric_mape_update(preds, target)
+    return sum_abs_per_error / n_obs
+
+
+def _weighted_mape_update(preds, target) -> Tuple[jax.Array, jax.Array]:
+    _check_same_shape(preds, target)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def _weighted_mape_compute(sum_abs_error, sum_scale, epsilon: float = _EPS) -> jax.Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds, target) -> jax.Array:
+    """WMAPE = Σ|p - t| / Σ|t|."""
+    sum_abs_error, sum_scale = _weighted_mape_update(preds, target)
+    return _weighted_mape_compute(sum_abs_error, sum_scale)
+
+
+__all__ = [
+    "mean_squared_error",
+    "mean_absolute_error",
+    "mean_squared_log_error",
+    "mean_absolute_percentage_error",
+    "symmetric_mean_absolute_percentage_error",
+    "weighted_mean_absolute_percentage_error",
+]

@@ -1,0 +1,213 @@
+"""Moment-accumulator regression kernels: ExplainedVariance, R2, Tweedie.
+
+Parity: reference `functional/regression/{explained_variance,r2,
+tweedie_deviance}.py`. All states are O(1) streaming sums.
+"""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_xlogy
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+# ------------------------------------------------------------ explained var
+def _explained_variance_update(preds, target) -> Tuple[int, jax.Array, jax.Array, jax.Array, jax.Array]:
+    _check_same_shape(preds, target)
+    n_obs = preds.shape[0]
+    diff = target - preds
+    return (
+        n_obs,
+        jnp.sum(diff, axis=0),
+        jnp.sum(diff * diff, axis=0),
+        jnp.sum(target, axis=0),
+        jnp.sum(target * target, axis=0),
+    )
+
+
+def _explained_variance_compute(
+    n_obs,
+    sum_error,
+    sum_squared_error,
+    sum_target,
+    sum_squared_target,
+    multioutput: str = "uniform_average",
+) -> jax.Array:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid_score = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.ones_like(diff_avg)
+    output_scores = jnp.where(
+        valid_score, 1.0 - numerator / jnp.where(valid_score, denominator, 1.0), output_scores
+    )
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, output_scores)
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(
+        "Argument `multioutput` must be one of 'raw_values', 'uniform_average' or 'variance_weighted',"
+        f" got {multioutput}"
+    )
+
+
+def explained_variance(preds, target, multioutput: str = "uniform_average") -> jax.Array:
+    """Explained variance 1 - Var(y - ŷ)/Var(y).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import explained_variance
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> explained_variance(preds, target)
+        Array(0.95717883, dtype=float32)
+    """
+    return _explained_variance_compute(*_explained_variance_update(preds, target), multioutput=multioutput)
+
+
+# --------------------------------------------------------------------- r2
+def _r2_score_update(preds, target) -> Tuple[jax.Array, jax.Array, jax.Array, int]:
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            f"Expected both prediction and target to be 1D or 2D tensors, but received tensors with dimension {preds.shape}"
+        )
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = jnp.sum((target - preds) ** 2, axis=0)
+    return sum_squared_obs, sum_obs, residual, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs,
+    sum_obs,
+    rss,
+    n_obs,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> jax.Array:
+    if not isinstance(n_obs, jax.core.Tracer) and int(n_obs) < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+
+    mean_obs = sum_obs / n_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    raw_scores = 1 - (rss / tss)
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+            f" Received {multioutput}."
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+
+    if adjusted != 0:
+        if not isinstance(n_obs, jax.core.Tracer) and adjusted > int(n_obs) - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif not isinstance(n_obs, jax.core.Tracer) and adjusted == int(n_obs) - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            r2 = 1 - (1 - r2) * (n_obs - 1) / (n_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(preds, target, adjusted: int = 0, multioutput: str = "uniform_average") -> jax.Array:
+    """R² coefficient of determination (optionally adjusted).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import r2_score
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> r2_score(preds, target)
+        Array(0.9486081, dtype=float32)
+    """
+    sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
+    return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)
+
+
+# ----------------------------------------------------------------- tweedie
+def _tweedie_deviance_score_update(preds, targets, power: float = 0.0) -> Tuple[jax.Array, jax.Array]:
+    _check_same_shape(preds, targets)
+    preds = preds.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+
+    concrete = not (isinstance(preds, jax.core.Tracer) or isinstance(targets, jax.core.Tracer))
+    if power == 0:
+        deviance_score = (targets - preds) ** 2
+    elif power == 1:
+        if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
+            raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)
+    else:
+        if power < 0:
+            if concrete and bool(jnp.any(preds <= 0)):
+                raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+        elif 1 < power < 2:
+            if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
+                raise ValueError(
+                    f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+                )
+        else:
+            if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
+                raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+        term_1 = jnp.maximum(targets, 0) ** (2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * preds ** (1 - power) / (1 - power)
+        term_3 = preds ** (2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(targets.size)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score, num_observations) -> jax.Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds, targets, power: float = 0.0) -> jax.Array:
+    """Tweedie deviance with power-parameterized distribution family.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import tweedie_deviance_score
+        >>> targets = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.asarray([4.0, 3.0, 2.0, 1.0])
+        >>> tweedie_deviance_score(preds, targets, power=2)
+        Array(1.2083334, dtype=float32)
+    """
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)
+
+
+__all__ = ["explained_variance", "r2_score", "tweedie_deviance_score"]

@@ -1,0 +1,191 @@
+"""Per-query retrieval kernels.
+
+Parity: reference `functional/retrieval/*.py` (584 LoC): each kernel scores ONE
+query's ``(preds, target)`` pair; grouping over queries happens in
+:class:`metrics_tpu.retrieval.base.RetrievalMetric`. All kernels are pure
+sort/topk/cumsum programs — jittable at fixed per-query length.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_retrieval_functional_inputs(preds, target, allow_non_binary_target: bool = False):
+    if preds.shape != target.shape or preds.ndim != 1:
+        raise ValueError("`preds` and `target` must be of the same shape and 1 dimensional")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    t = jnp.asarray(target)
+    if not (jnp.issubdtype(t.dtype, jnp.integer) or t.dtype == jnp.bool_):
+        if not allow_non_binary_target or not jnp.issubdtype(t.dtype, jnp.floating):
+            raise ValueError("`target` must be a tensor of booleans or integers")
+    if not allow_non_binary_target and not isinstance(t, jax.core.Tracer) and t.size and int(t.max()) > 1:
+        raise ValueError("`target` must contain binary values")
+    return jnp.asarray(preds, dtype=jnp.float32), t
+
+
+def retrieval_average_precision(preds, target) -> jax.Array:
+    """AP over one query: mean of (cumulative relevant / rank) at relevant rows.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    order = jnp.argsort(-preds, stable=True)
+    rel = target[order].astype(jnp.float32)
+    ranks = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    precision_at_i = jnp.cumsum(rel) / ranks
+    denom = jnp.maximum(rel.sum(), 1.0)
+    return jnp.where(rel.sum() > 0, (precision_at_i * rel).sum() / denom, 0.0)
+
+
+def retrieval_reciprocal_rank(preds, target) -> jax.Array:
+    """1 / rank of the first relevant document.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, False])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    order = jnp.argsort(-preds, stable=True)
+    rel = target[order].astype(jnp.float32)
+    ranks = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+    first = jnp.min(jnp.where(rel > 0, ranks, jnp.inf))
+    return jnp.where(jnp.isfinite(first), 1.0 / first, 0.0)
+
+
+def _resolve_k(n: int, k: Optional[int]) -> int:
+    if k is None:
+        return n
+    if not isinstance(k, int) or k <= 0:
+        raise ValueError("`k` has to be a positive integer or None")
+    return min(k, n)
+
+
+def retrieval_precision(preds, target, k: Optional[int] = None) -> jax.Array:
+    """Fraction of top-k documents that are relevant."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    kk = _resolve_k(preds.shape[0], k)
+    order = jnp.argsort(-preds, stable=True)
+    rel = target[order].astype(jnp.float32)
+    return rel[:kk].sum() / kk
+
+
+def retrieval_recall(preds, target, k: Optional[int] = None) -> jax.Array:
+    """Fraction of relevant documents found in the top-k."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    kk = _resolve_k(preds.shape[0], k)
+    order = jnp.argsort(-preds, stable=True)
+    rel = target[order].astype(jnp.float32)
+    total = rel.sum()
+    return jnp.where(total > 0, rel[:kk].sum() / jnp.maximum(total, 1.0), 0.0)
+
+
+def retrieval_fall_out(preds, target, k: Optional[int] = None) -> jax.Array:
+    """Fraction of NON-relevant documents retrieved in the top-k."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    kk = _resolve_k(preds.shape[0], k)
+    order = jnp.argsort(-preds, stable=True)
+    nonrel = 1.0 - target[order].astype(jnp.float32)
+    total = nonrel.sum()
+    return jnp.where(total > 0, nonrel[:kk].sum() / jnp.maximum(total, 1.0), 0.0)
+
+
+def retrieval_hit_rate(preds, target, k: Optional[int] = None) -> jax.Array:
+    """1.0 if any relevant document appears in the top-k."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    kk = _resolve_k(preds.shape[0], k)
+    order = jnp.argsort(-preds, stable=True)
+    rel = target[order].astype(jnp.float32)
+    return (rel[:kk].sum() > 0).astype(jnp.float32)
+
+
+def retrieval_r_precision(preds, target) -> jax.Array:
+    """Precision at R where R = number of relevant documents."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    order = jnp.argsort(-preds, stable=True)
+    rel = target[order].astype(jnp.float32)
+    r = rel.sum().astype(jnp.int32)
+    n = rel.shape[0]
+    mask = jnp.arange(n) < r
+    return jnp.where(r > 0, (rel * mask).sum() / jnp.maximum(r, 1), 0.0)
+
+
+def _dcg(ranked_gains: jax.Array) -> jax.Array:
+    discount = 1.0 / jnp.log2(jnp.arange(2, ranked_gains.shape[0] + 2, dtype=jnp.float32))
+    return (ranked_gains * discount).sum()
+
+
+def retrieval_normalized_dcg(preds, target, k: Optional[int] = None) -> jax.Array:
+    """NDCG@k with log2 discount; target may carry graded (non-binary) gains.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([0.1, 0.2, 0.3, 4.0, 70.0])
+        >>> target = jnp.asarray([10, 0, 0, 1, 5])
+        >>> retrieval_normalized_dcg(preds, target)
+        Array(0.6956941, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    kk = _resolve_k(preds.shape[0], k)
+    order = jnp.argsort(-preds, stable=True)
+    gains = target[order].astype(jnp.float32)[:kk]
+    ideal_gains = jnp.sort(target.astype(jnp.float32))[::-1][:kk]
+    dcg = _dcg(gains)
+    idcg = _dcg(ideal_gains)
+    return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
+
+
+def retrieval_precision_recall_curve(
+    preds, target, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(precision@k, recall@k, k) for k = 1..max_k.
+
+    Parity: reference `functional/retrieval/precision_recall_curve.py`.
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    n = preds.shape[0]
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = n
+    if not isinstance(max_k, int) or max_k <= 0:
+        raise ValueError("`max_k` has to be a positive integer or None")
+    if adaptive_k and max_k > n:
+        max_k = n
+    max_k = min(max_k, n)
+
+    order = jnp.argsort(-preds, stable=True)
+    rel = target[order].astype(jnp.float32)
+    ks = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+    cum_rel = jnp.cumsum(rel)[:max_k]
+    precision = cum_rel / ks
+    total = rel.sum()
+    recall = jnp.where(total > 0, cum_rel / jnp.maximum(total, 1.0), jnp.zeros_like(cum_rel))
+    return precision, recall, ks.astype(jnp.int32)
+
+
+__all__ = [
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_hit_rate",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_precision_recall_curve",
+    "retrieval_r_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
+]

@@ -1,0 +1,125 @@
+"""BLEU score.
+
+Parity: reference `functional/text/bleu.py` — n-gram counters with
+``dist_reduce_fx="sum"`` states (numerator/denominator of shape ``(n_gram,)``,
+pred/target length scalars) and brevity penalty.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _ngrams
+
+
+def _count_ngrams(tokens: Sequence, n_gram: int) -> Counter:
+    counts: Counter = Counter()
+    for n in range(1, n_gram + 1):
+        counts.update(_ngrams(tokens, n))
+    return counts
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: jax.Array,
+    denominator: jax.Array,
+    preds_len: jax.Array,
+    target_len: jax.Array,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = str.split,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Accumulate clipped n-gram matches over a batch of (pred, references)."""
+    target_corpus = [[tokenizer(t) for t in targets] for targets in target]
+    preds_tokens = [tokenizer(p) for p in preds]
+
+    num = jnp.zeros(n_gram)
+    den = jnp.zeros(n_gram)
+    p_len = 0
+    t_len = 0
+    num_np = [0.0] * n_gram
+    den_np = [0.0] * n_gram
+    for pred, targets in zip(preds_tokens, target_corpus):
+        p_len += len(pred)
+        # closest reference length (ties -> shorter)
+        len_diffs = [(abs(len(t) - len(pred)), len(t)) for t in targets]
+        t_len += min(len_diffs)[1]
+
+        pred_counter = _count_ngrams(pred, n_gram)
+        max_counter: Counter = Counter()
+        for t in targets:
+            max_counter |= _count_ngrams(t, n_gram)
+        clipped = pred_counter & max_counter
+        for ngram, count in clipped.items():
+            num_np[len(ngram) - 1] += count
+        for ngram, count in pred_counter.items():
+            den_np[len(ngram) - 1] += count
+
+    numerator = numerator + jnp.asarray(num_np)
+    denominator = denominator + jnp.asarray(den_np)
+    preds_len = preds_len + p_len
+    target_len = target_len + t_len
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: jax.Array,
+    target_len: jax.Array,
+    numerator: jax.Array,
+    denominator: jax.Array,
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> jax.Array:
+    """Geometric mean of n-gram precisions x brevity penalty (device math)."""
+    device_zero = jnp.asarray(0.0)
+    if not isinstance(numerator, jax.core.Tracer) and float(numerator.sum()) == 0:
+        return device_zero
+
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator[0] / jnp.maximum(denominator[0], 1e-12))
+    else:
+        precision_scores = numerator / jnp.where(denominator == 0, 1.0, denominator)
+
+    log_precision_scores = (1.0 / n_gram) * jnp.log(jnp.where(precision_scores > 0, precision_scores, 1e-30))
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.where(
+        preds_len > target_len, jnp.asarray(1.0), jnp.exp(1.0 - target_len / jnp.maximum(preds_len, 1e-12))
+    )
+    return brevity_penalty * geometric_mean
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[Sequence[str], Sequence[Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> jax.Array:
+    """Corpus BLEU with whitespace tokenization.
+
+    Example:
+        >>> from metrics_tpu.functional import bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu_score(preds, target)
+        Array(0.75762904, dtype=float32)
+    """
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    preds_len = jnp.asarray(0.0)
+    target_len = jnp.asarray(0.0)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, preds_len, target_len, n_gram
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth).astype(jnp.float32)
+
+
+__all__ = ["bleu_score"]

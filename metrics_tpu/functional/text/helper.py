@@ -1,0 +1,57 @@
+"""Host-side text helpers: tokenization + edit distance.
+
+Parity: reference `functional/text/helper.py` (``_edit_distance`` `:333`,
+``_LevenshteinEditDistance`` cache class `:64`).
+
+TPU note (SURVEY §2.6): string processing is inherently host-side — the
+reference also runs it in python. The design split is host tokenize/count →
+device tensor reductions; the accumulated count states still sync as arrays.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
+    """Levenshtein distance via numpy DP over the (m+1, n+1) table."""
+    m, n = len(prediction_tokens), len(reference_tokens)
+    if m == 0:
+        return n
+    if n == 0:
+        return m
+    prev = np.arange(n + 1, dtype=np.int32)
+    for i in range(1, m + 1):
+        curr = np.empty(n + 1, dtype=np.int32)
+        curr[0] = i
+        p = prediction_tokens[i - 1]
+        sub_cost = np.fromiter((0 if p == r else 1 for r in reference_tokens), dtype=np.int32, count=n)
+        for j in range(1, n + 1):
+            curr[j] = min(prev[j] + 1, curr[j - 1] + 1, prev[j - 1] + sub_cost[j - 1])
+        prev = curr
+    return int(prev[n])
+
+
+def _edit_distance_matrix(prediction_tokens: Sequence, reference_tokens: Sequence) -> np.ndarray:
+    """Full Levenshtein DP table (needed by TER's shift search)."""
+    m, n = len(prediction_tokens), len(reference_tokens)
+    d = np.zeros((m + 1, n + 1), dtype=np.int32)
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            cost = 0 if prediction_tokens[i - 1] == reference_tokens[j - 1] else 1
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1, d[i - 1, j - 1] + cost)
+    return d
+
+
+def _tokenize_sentence(text: str) -> List[str]:
+    return text.split()
+
+
+def _ngrams(tokens: Sequence, n: int) -> List[Tuple]:
+    return [tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)]
+
+
+__all__ = ["_edit_distance", "_edit_distance_matrix", "_tokenize_sentence", "_ngrams"]

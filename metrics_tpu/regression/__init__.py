@@ -1,0 +1,31 @@
+from metrics_tpu.regression.advanced import (
+    CosineSimilarity,
+    ExplainedVariance,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    TweedieDevianceScore,
+)
+from metrics_tpu.regression.basic import (
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+
+__all__ = [
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

@@ -1,0 +1,233 @@
+"""Moment/correlation regression modules.
+
+Parity: reference `regression/{explained_variance,r2,pearson,spearman,
+cosine_similarity,tweedie_deviance}.py`. ``PearsonCorrCoef`` declares its moment
+states with ``dist_reduce_fx=None`` so cross-device sync stacks per-device stats
+for the pairwise parallel merge (reference `regression/pearson.py:109-114`).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.correlation import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+    _pearson_final_aggregation,
+    _spearman_corrcoef_compute,
+    _spearman_corrcoef_update,
+)
+from metrics_tpu.functional.regression.moments import (
+    _explained_variance_compute,
+    _explained_variance_update,
+    _r2_score_compute,
+    _r2_score_update,
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class CosineSimilarity(Metric):
+    """Accumulated row-wise cosine similarity."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(self, reduction: str = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds, target) -> None:
+        preds, target = _cosine_similarity_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> jax.Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _cosine_similarity_compute(preds, target, self.reduction)
+
+
+class ExplainedVariance(Metric):
+    """Streaming explained variance."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
+        self.multioutput = multioutput
+        self.add_state("sum_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_target", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_obs", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+        self.n_obs = self.n_obs + n_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> jax.Array:
+        return _explained_variance_compute(
+            self.n_obs,
+            self.sum_error,
+            self.sum_squared_error,
+            self.sum_target,
+            self.sum_squared_target,
+            self.multioutput,
+        )
+
+
+class R2Score(Metric):
+    """Streaming R² (optionally adjusted, multioutput)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_outputs: int = 1,
+        adjusted: int = 0,
+        multioutput: str = "uniform_average",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}"
+            )
+        self.multioutput = multioutput
+
+        shape = () if num_outputs == 1 else (num_outputs,)
+        self.add_state("sum_squared_error", default=jnp.zeros(shape), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=jnp.zeros(shape), dist_reduce_fx="sum")
+        self.add_state("residual", default=jnp.zeros(shape), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + n_obs
+
+    def compute(self) -> jax.Array:
+        return _r2_score_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+        )
+
+
+class PearsonCorrCoef(Metric):
+    """Streaming Pearson correlation with cross-device parallel merge."""
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        # dist_reduce_fx=None: sync stacks per-device stats; compute merges them
+        self.add_state("mean_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("mean_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("corr_xy", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("n_total", default=jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def update(self, preds, target) -> None:
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+
+    def compute(self) -> jax.Array:
+        if isinstance(self.var_x, jax.Array) and self.var_x.ndim > 0 and self.var_x.shape[0] > 1:
+            # synced: stacked per-device stats -> pairwise merge
+            var_x, var_y, corr_xy, n_total = _pearson_final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+
+class SpearmanCorrCoef(Metric):
+    """Spearman rank correlation over all accumulated samples."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds, target) -> None:
+        preds, target = _spearman_corrcoef_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> jax.Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)
+
+
+class TweedieDevianceScore(Metric):
+    """Mean Tweedie deviance with parameterized power."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_observations", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, targets) -> None:
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> jax.Array:
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)
+
+
+__all__ = [
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "R2Score",
+    "PearsonCorrCoef",
+    "SpearmanCorrCoef",
+    "TweedieDevianceScore",
+]

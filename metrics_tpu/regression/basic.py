@@ -1,0 +1,166 @@
+"""Elementwise-error regression modules.
+
+Parity: reference `regression/{mse,mae,log_mse,mape,symmetric_mape,wmape}.py`.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.basic import (
+    _mean_absolute_error_compute,
+    _mean_absolute_error_update,
+    _mean_absolute_percentage_error_compute,
+    _mean_absolute_percentage_error_update,
+    _mean_squared_error_compute,
+    _mean_squared_error_update,
+    _mean_squared_log_error_compute,
+    _mean_squared_log_error_update,
+    _symmetric_mape_update,
+    _weighted_mape_compute,
+    _weighted_mape_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class MeanSquaredError(Metric):
+    """MSE (or RMSE with ``squared=False``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        shape = () if num_outputs == 1 else (num_outputs,)
+        self.add_state("sum_squared_error", default=jnp.zeros(shape), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.squared = squared
+
+    def update(self, preds, target) -> None:
+        sum_squared_error, n_obs = _mean_squared_error_update(preds, target, self.num_outputs)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> jax.Array:
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, self.squared)
+
+
+class MeanAbsoluteError(Metric):
+    """MAE."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> jax.Array:
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)
+
+
+class MeanSquaredLogError(Metric):
+    """MSLE."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + sum_squared_log_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> jax.Array:
+        return _mean_squared_log_error_compute(self.sum_squared_log_error, self.total)
+
+
+class MeanAbsolutePercentageError(Metric):
+    """MAPE."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        sum_abs_per_error, n_obs = _mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> jax.Array:
+        return _mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
+
+
+class SymmetricMeanAbsolutePercentageError(Metric):
+    """SMAPE."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        sum_abs_per_error, n_obs = _symmetric_mape_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> jax.Array:
+        return self.sum_abs_per_error / self.total
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """WMAPE."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_scale", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        sum_abs_error, sum_scale = _weighted_mape_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.sum_scale = self.sum_scale + sum_scale
+
+    def compute(self) -> jax.Array:
+        return _weighted_mape_compute(self.sum_abs_error, self.sum_scale)
+
+
+__all__ = [
+    "MeanSquaredError",
+    "MeanAbsoluteError",
+    "MeanSquaredLogError",
+    "MeanAbsolutePercentageError",
+    "SymmetricMeanAbsolutePercentageError",
+    "WeightedMeanAbsolutePercentageError",
+]

@@ -1,0 +1,90 @@
+"""RetrievalMetric base — grouped per-query evaluation.
+
+Parity: reference `retrieval/base.py:27-146`: ``indexes/preds/target`` cat
+states; ``compute`` groups rows by query id and averages the per-query kernel,
+with ``empty_target_action`` in {error, skip, neg, pos}.
+"""
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+
+
+class RetrievalMetric(Metric):
+    """Base for retrieval metrics evaluated per query group."""
+
+    is_differentiable: Optional[bool] = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: Optional[bool] = False
+    allow_non_binary_target: bool = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = False
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds, target, indexes) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            jnp.asarray(indexes),
+            jnp.asarray(preds),
+            jnp.asarray(target),
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> jax.Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        res = []
+        groups = get_group_indexes(indexes)
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if not bool(mini_target.sum()):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
+
+    @abstractmethod
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        """Score a single query group."""
+
+
+__all__ = ["RetrievalMetric"]

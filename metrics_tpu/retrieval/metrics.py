@@ -1,0 +1,236 @@
+"""Retrieval module metrics.
+
+Parity: reference `retrieval/{average_precision,reciprocal_rank,precision,
+recall,fall_out,hit_rate,ndcg,r_precision,precision_recall_curve}.py`.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval.kernels import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries."""
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_average_precision(preds, target)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank over queries."""
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_reciprocal_rank(preds, target)
+
+
+class _RetrievalKMetric(RetrievalMetric):
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+
+class RetrievalPrecision(_RetrievalKMetric):
+    """Mean precision@k over queries."""
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_precision(preds, target, k=self.k)
+
+
+class RetrievalRecall(_RetrievalKMetric):
+    """Mean recall@k over queries."""
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_recall(preds, target, k=self.k)
+
+
+class RetrievalFallOut(_RetrievalKMetric):
+    """Mean fall-out@k over queries; empty-target convention is inverted
+    (a query with NO relevant docs scores via ``empty_target_action`` on the
+    negative side — reference `retrieval/fall_out.py`)."""
+
+    higher_is_better = False
+
+    def compute(self) -> jax.Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        res = []
+        for group in get_group_indexes(indexes):
+            mini_preds = preds[group]
+            mini_target = target[group]
+            # fall-out's empty case is "no NEGATIVE targets"
+            if bool((1 - mini_target).sum() == 0):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no negative target.")
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_fall_out(preds, target, k=self.k)
+
+
+class RetrievalHitRate(_RetrievalKMetric):
+    """Mean hit-rate@k over queries."""
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_hit_rate(preds, target, k=self.k)
+
+
+class RetrievalNormalizedDCG(_RetrievalKMetric):
+    """Mean NDCG@k over queries; targets may be graded."""
+
+    allow_non_binary_target = True
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_normalized_dcg(preds, target, k=self.k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Mean R-precision over queries."""
+
+    def _metric(self, preds, target) -> jax.Array:
+        return retrieval_r_precision(preds, target)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged (precision@k, recall@k) curves over queries.
+
+    Parity: reference `retrieval/precision_recall_curve.py`.
+    """
+
+    higher_is_better = None
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds, target) -> jax.Array:  # pragma: no cover - unused
+        raise NotImplementedError
+
+    def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        groups = get_group_indexes(indexes)
+        max_k = self.max_k or max(int(g.shape[0]) for g in groups)
+
+        precisions, recalls = [], []
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if not bool(mini_target.sum()):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                fill = 1.0 if self.empty_target_action == "pos" else 0.0
+                if self.empty_target_action in ("pos", "neg"):
+                    precisions.append(jnp.full((max_k,), fill))
+                    recalls.append(jnp.full((max_k,), fill))
+            else:
+                n = mini_preds.shape[0]
+                p, r, _ = retrieval_precision_recall_curve(mini_preds, mini_target, max_k=min(max_k, n))
+                # pad short queries by repeating the final value (k > n_docs)
+                if p.shape[0] < max_k:
+                    pad = max_k - p.shape[0]
+                    p = jnp.concatenate([p, jnp.full((pad,), float(p[-1]))])
+                    r = jnp.concatenate([r, jnp.full((pad,), float(r[-1]))])
+                precisions.append(p)
+                recalls.append(r)
+
+        top_k = jnp.arange(1, max_k + 1)
+        if not precisions:
+            return jnp.zeros(max_k), jnp.zeros(max_k), top_k
+        return jnp.stack(precisions).mean(axis=0), jnp.stack(recalls).mean(axis=0), top_k
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Highest recall@k whose precision@k >= min_precision (reference
+    `retrieval/recall_at_precision.py`)."""
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k,
+            adaptive_k=adaptive_k,
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        if not isinstance(min_precision, float) or not 0.0 <= min_precision <= 1.0:
+            raise ValueError("`min_precision` has to be a float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        precisions, recalls, top_k = super().compute()
+        ok = precisions >= self.min_precision
+        rec = jnp.where(ok, recalls, -jnp.inf)
+        rmax = jnp.max(rec)
+        any_ok = jnp.isfinite(rmax)
+        cand = ok & (rec == rmax)
+        kbest = jnp.min(jnp.where(cand, top_k, jnp.iinfo(jnp.int32).max))
+        best_recall = jnp.where(any_ok, rmax, 0.0)
+        best_k = jnp.where(any_ok, kbest, jnp.max(top_k))
+        return best_recall, best_k
+
+
+__all__ = [
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalPrecision",
+    "RetrievalRecall",
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalNormalizedDCG",
+    "RetrievalRPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRecallAtFixedPrecision",
+]

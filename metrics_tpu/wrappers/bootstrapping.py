@@ -1,0 +1,110 @@
+"""BootStrapper — bootstrap confidence estimates for any metric.
+
+Parity: reference `wrappers/bootstrapping.py:26-155` (``_bootstrap_sampler``
+poisson/multinomial resampling; mean/std/quantile/raw outputs).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None):
+    """Resampling indices for one bootstrap draw (reference `:26-47`).
+
+    Host-side randomness: bootstrap draws are part of the evaluation harness,
+    not the jitted compute path, so numpy RNG keeps the API free of explicit
+    PRNG-key plumbing.
+    """
+    rng = rng or np.random
+    if sampling_strategy == "poisson":
+        p = rng.poisson(1, size=size)
+        return jnp.asarray(np.repeat(np.arange(size), p))
+    if sampling_strategy == "multinomial":
+        return jnp.asarray(rng.randint(0, size, size=size))
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    """Maintains ``num_bootstraps`` resampled clones of a base metric."""
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, jax.Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling} but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.RandomState()
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch per bootstrap clone and update each."""
+        for idx in range(self.num_bootstraps):
+            args_sizes = apply_to_collection(args, jax.Array, len)
+            kwargs_sizes = apply_to_collection(kwargs, jax.Array, len)
+            if len(args_sizes) > 0:
+                size = args_sizes[0]
+            elif len(kwargs_sizes) > 0:
+                size = next(iter(kwargs_sizes.values()))
+            else:
+                raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, jax.Array]:
+        """mean/std/quantile/raw over the bootstrap distribution."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output_dict["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile, axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()
+
+
+__all__ = ["BootStrapper"]

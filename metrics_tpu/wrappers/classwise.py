@@ -1,0 +1,47 @@
+"""ClasswiseWrapper — split per-class results into a named dict.
+
+Parity: reference `wrappers/classwise.py:8-78`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from metrics_tpu.metric import Metric
+
+
+class ClasswiseWrapper(Metric):
+    """Wraps a per-class metric and returns ``{name_class: value}``."""
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: jax.Array) -> Dict[str, jax.Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, jax.Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, jax.Array]:
+        return self._convert(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()
+
+
+__all__ = ["ClasswiseWrapper"]

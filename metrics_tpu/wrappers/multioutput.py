@@ -1,0 +1,95 @@
+"""MultioutputWrapper — clone a metric per output column.
+
+Parity: reference `wrappers/multioutput.py:24-145` (incl. optional NaN-row
+removal `_get_nan_indices` `:12`).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+
+def _get_nan_indices(*tensors: jax.Array) -> jax.Array:
+    """Rows containing any NaN in any tensor."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    nan_idxs = jnp.zeros(len(tensors[0]), dtype=bool)
+    for tensor in tensors:
+        permuted = tensor.reshape(len(tensor), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """Evaluate one metric per output dimension and return the list of values."""
+
+    is_differentiable = False
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: jax.Array, **kwargs: jax.Array) -> List[Tuple]:
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = apply_to_collection(
+                args, jax.Array, jnp.take, indices=jnp.asarray([i]), axis=self.output_dim
+            )
+            selected_kwargs = apply_to_collection(
+                kwargs, jax.Array, jnp.take, indices=jnp.asarray([i]), axis=self.output_dim
+            )
+            if self.remove_nans:
+                tensors = list(selected_args) + list(selected_kwargs.values())
+                if tensors:
+                    nan_idxs = _get_nan_indices(*tensors)
+                    selected_args = [arg[~nan_idxs] for arg in selected_args]
+                    selected_kwargs = {k: v[~nan_idxs] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(arg, axis=self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: jnp.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> List[jax.Array]:
+        return [m.compute() for m in self.metrics]
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs)
+        ]
+        if results[0] is None:
+            return None
+        return results
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()
+
+
+__all__ = ["MultioutputWrapper"]

@@ -1,0 +1,135 @@
+"""MetricTracker — history of a metric (or collection) across steps.
+
+Parity: reference `wrappers/tracker.py:26-213` (``increment`` appends a clone,
+``compute_all`` stacks, ``best_metric`` arg-max/min with ``maximize``).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricTracker:
+    """List of metric copies over time steps."""
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a metrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        if isinstance(metric, Metric) and not isinstance(maximize, bool):
+            raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        self.maximize = maximize
+        self._history: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of tracked steps (the initial base copy does not count)."""
+        return len(self._history) - 1
+
+    def increment(self) -> None:
+        """Start a new time step: append a fresh copy of the base metric."""
+        self._increment_called = True
+        self._history.append(deepcopy(self._base_metric))
+        self._history[-1].reset()
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._history[-1](*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._history[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._history[-1].compute()
+
+    def compute_all(self) -> Union[jax.Array, Dict[str, jax.Array]]:
+        """Stack computed values across all steps."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._history]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([r[k] for r in res], axis=0) for k in keys}
+        return jnp.stack(res, axis=0)
+
+    def reset(self) -> None:
+        """Reset the current step's metric."""
+        if self._history:
+            self._history[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self._history:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[
+        None,
+        float,
+        Tuple[float, int],
+        Tuple[None, None],
+        Dict[str, Optional[float]],
+        Tuple[Dict[str, Optional[float]], Dict[str, Optional[int]]],
+    ]:
+        """Best value (and optionally its step index) across the history."""
+        if isinstance(self._base_metric, Metric):
+            try:
+                values = self.compute_all()
+                fn = jnp.argmax if self.maximize else jnp.argmin
+                idx = int(fn(values))
+                if return_step:
+                    return float(values[idx]), idx
+                return float(values[idx])
+            except (ValueError, TypeError) as error:
+                rank_zero_warn(
+                    f"Encountered the following error when trying to get the best metric: {error}"
+                    "this is probably due to the 'best' not being defined for this metric."
+                    "Returning `None` instead.",
+                    UserWarning,
+                )
+                if return_step:
+                    return None, None
+                return None
+        else:
+            res = self.compute_all()
+            maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    fn = jnp.argmax if maximize[i] else jnp.argmin
+                    out = int(fn(v))
+                    value[k], idx[k] = float(v[out]), out
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{error} this is probably due to the 'best' not being defined for this metric."
+                        "Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            if return_step:
+                return value, idx
+            return value
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")
+
+
+__all__ = ["MetricTracker"]

@@ -1,0 +1,65 @@
+"""Pairwise distance functions vs sklearn."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from metrics_tpu.functional import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+_rng = np.random.RandomState(5)
+_x = jnp.asarray(_rng.rand(12, 6).astype(np.float32))
+_y = jnp.asarray(_rng.rand(8, 6).astype(np.float32))
+
+_CASES = [
+    (pairwise_cosine_similarity, sk_cosine),
+    (pairwise_euclidean_distance, sk_euclidean),
+    (pairwise_linear_similarity, sk_linear),
+    (pairwise_manhattan_distance, sk_manhattan),
+]
+
+
+@pytest.mark.parametrize("fn, sk_fn", _CASES)
+def test_pairwise_two_inputs(fn, sk_fn):
+    np.testing.assert_allclose(np.asarray(fn(_x, _y)), sk_fn(np.asarray(_x), np.asarray(_y)), atol=1e-5)
+
+
+@pytest.mark.parametrize("fn, sk_fn", _CASES)
+def test_pairwise_single_input_zero_diagonal(fn, sk_fn):
+    res = np.asarray(fn(_x))
+    ref = sk_fn(np.asarray(_x))
+    np.fill_diagonal(ref, 0)
+    np.testing.assert_allclose(res, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("fn, sk_fn", _CASES)
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_pairwise_reductions(fn, sk_fn, reduction):
+    ref = sk_fn(np.asarray(_x), np.asarray(_y))
+    ref = ref.mean(-1) if reduction == "mean" else ref.sum(-1)
+    np.testing.assert_allclose(np.asarray(fn(_x, _y, reduction=reduction)), ref, atol=1e-4)
+
+
+@pytest.mark.parametrize("fn, sk_fn", _CASES)
+def test_pairwise_jit(fn, sk_fn):
+    jitted = jax.jit(fn)
+    np.testing.assert_allclose(np.asarray(jitted(_x, _y)), np.asarray(fn(_x, _y)), atol=1e-6)
+
+
+def test_pairwise_invalid_inputs():
+    with pytest.raises(ValueError, match="Expected argument `x`"):
+        pairwise_cosine_similarity(jnp.zeros(3))
+    with pytest.raises(ValueError, match="Expected argument `y`"):
+        pairwise_cosine_similarity(_x, jnp.zeros((3, 2)))
+    with pytest.raises(ValueError, match="Expected reduction"):
+        pairwise_cosine_similarity(_x, _y, reduction="bogus")

@@ -1,0 +1,173 @@
+"""Regression metrics vs sklearn/scipy oracles."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats
+import sklearn.metrics as skm
+
+from metrics_tpu import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.RandomState(11)
+_preds = jnp.asarray(_rng.rand(4, 32).astype(np.float32))
+_target = jnp.asarray(_rng.rand(4, 32).astype(np.float32))
+
+
+def _sk_smape(preds, target):
+    return np.mean(2 * np.abs(preds - target) / (np.abs(preds) + np.abs(target)))
+
+
+def _sk_wmape(preds, target):
+    return np.sum(np.abs(preds - target)) / np.sum(np.abs(target))
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn, atol",
+    [
+        (MeanSquaredError, mean_squared_error, lambda p, t: skm.mean_squared_error(t, p), 1e-6),
+        (MeanAbsoluteError, mean_absolute_error, lambda p, t: skm.mean_absolute_error(t, p), 1e-6),
+        (MeanSquaredLogError, mean_squared_log_error, lambda p, t: skm.mean_squared_log_error(t, p), 1e-6),
+        (
+            MeanAbsolutePercentageError,
+            mean_absolute_percentage_error,
+            lambda p, t: skm.mean_absolute_percentage_error(t, p),
+            1e-4,
+        ),
+        (SymmetricMeanAbsolutePercentageError, symmetric_mean_absolute_percentage_error, _sk_smape, 1e-4),
+        (WeightedMeanAbsolutePercentageError, weighted_mean_absolute_percentage_error, _sk_wmape, 1e-5),
+        (ExplainedVariance, explained_variance, lambda p, t: skm.explained_variance_score(t, p), 1e-5),
+        (R2Score, r2_score, lambda p, t: skm.r2_score(t, p), 1e-4),
+        (PearsonCorrCoef, pearson_corrcoef, lambda p, t: scipy.stats.pearsonr(t.ravel(), p.ravel())[0], 1e-4),
+        (SpearmanCorrCoef, spearman_corrcoef, lambda p, t: scipy.stats.spearmanr(t.ravel(), p.ravel())[0], 1e-4),
+    ],
+)
+class TestRegressionSuite(MetricTester):
+    def test_functional(self, metric_class, metric_fn, sk_fn, atol):
+        self.run_functional_metric_test(_preds, _target, metric_fn, sk_fn, atol=atol)
+
+    def test_class_single(self, metric_class, metric_fn, sk_fn, atol):
+        self.run_class_metric_test(_preds, _target, metric_class, sk_fn, atol=atol, check_batch=False)
+
+    def test_class_ddp(self, metric_class, metric_fn, sk_fn, atol):
+        self.run_class_metric_test(_preds, _target, metric_class, sk_fn, ddp=True, atol=atol)
+
+    def test_jit(self, metric_class, metric_fn, sk_fn, atol):
+        self.run_jit_test(_preds, _target, metric_fn, atol=atol)
+
+    def test_grad(self, metric_class, metric_fn, sk_fn, atol):
+        if metric_fn is spearman_corrcoef:
+            pytest.skip("rank transform is not differentiable")
+        self.run_differentiability_test(_preds, _target, metric_fn)
+
+
+def test_rmse():
+    t = MetricTester()
+    t.run_functional_metric_test(
+        _preds,
+        _target,
+        partial(mean_squared_error, squared=False),
+        lambda p, tt: np.sqrt(skm.mean_squared_error(tt, p)),
+    )
+
+
+def test_pearson_spmd_parallel_merge():
+    """Pearson's per-device moment stats merge exactly (Chan parallel formula)."""
+    t = MetricTester()
+    t.run_spmd_test(
+        _preds,
+        _target,
+        PearsonCorrCoef,
+        lambda p, tt: scipy.stats.pearsonr(tt.ravel(), p.ravel())[0],
+        atol=1e-4,
+    )
+
+
+def test_spearman_ties():
+    p = jnp.asarray([1.0, 1.0, 2.0, 3.0, 3.0, 3.0])
+    t = jnp.asarray([1.0, 2.0, 2.0, 2.0, 3.0, 4.0])
+    ref = scipy.stats.spearmanr(np.asarray(t), np.asarray(p))[0]
+    np.testing.assert_allclose(np.asarray(spearman_corrcoef(p, t)), ref, atol=1e-5)
+
+
+def test_cosine_similarity_reductions():
+    p = jnp.asarray(_rng.rand(10, 5).astype(np.float32))
+    t = jnp.asarray(_rng.rand(10, 5).astype(np.float32))
+    sims = np.array(
+        [np.dot(p[i], t[i]) / (np.linalg.norm(p[i]) * np.linalg.norm(t[i])) for i in range(10)]
+    )
+    np.testing.assert_allclose(np.asarray(cosine_similarity(p, t, "mean")), sims.mean(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cosine_similarity(p, t, "sum")), sims.sum(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(cosine_similarity(p, t, None)), sims, atol=1e-5)
+    m = CosineSimilarity(reduction="mean")
+    m.update(p[:5], t[:5])
+    m.update(p[5:], t[5:])
+    np.testing.assert_allclose(np.asarray(m.compute()), sims.mean(), atol=1e-5)
+
+
+@pytest.mark.parametrize("power", [0, 1, 2, 3, -1, 1.5])
+def test_tweedie(power):
+    p = jnp.asarray(_rng.rand(64).astype(np.float32) + 0.1)
+    t = jnp.asarray(_rng.rand(64).astype(np.float32) + 0.1)
+    ref = skm.mean_tweedie_deviance(np.asarray(t), np.asarray(p), power=power)
+    np.testing.assert_allclose(np.asarray(tweedie_deviance_score(p, t, power=power)), ref, atol=1e-4)
+    m = TweedieDevianceScore(power=power)
+    m.update(p[:32], t[:32])
+    m.update(p[32:], t[32:])
+    np.testing.assert_allclose(np.asarray(m.compute()), ref, atol=1e-4)
+
+
+def test_tweedie_invalid_power():
+    with pytest.raises(ValueError, match="not defined"):
+        TweedieDevianceScore(power=0.5)
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+def test_explained_variance_multioutput(multioutput):
+    p = jnp.asarray(_rng.rand(32, 3).astype(np.float32))
+    t = jnp.asarray(_rng.rand(32, 3).astype(np.float32))
+    ref = skm.explained_variance_score(np.asarray(t), np.asarray(p), multioutput=multioutput)
+    np.testing.assert_allclose(np.asarray(explained_variance(p, t, multioutput)), ref, atol=1e-5)
+
+
+def test_r2_adjusted_and_multioutput():
+    p = jnp.asarray(_rng.rand(32, 2).astype(np.float32))
+    t = jnp.asarray(_rng.rand(32, 2).astype(np.float32))
+    ref = skm.r2_score(np.asarray(t), np.asarray(p), multioutput="raw_values")
+    np.testing.assert_allclose(np.asarray(r2_score(p, t, multioutput="raw_values")), ref, atol=1e-4)
+    # adjusted
+    n, k = 32, 1
+    raw = skm.r2_score(np.asarray(t[:, 0]), np.asarray(p[:, 0]))
+    adj = 1 - (1 - raw) * (n - 1) / (n - k - 1)
+    np.testing.assert_allclose(np.asarray(r2_score(p[:, 0], t[:, 0], adjusted=1)), adj, atol=1e-4)
+    m = R2Score(num_outputs=2, multioutput="raw_values")
+    m.update(p, t)
+    np.testing.assert_allclose(np.asarray(m.compute()), ref, atol=1e-4)

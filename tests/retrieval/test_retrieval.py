@@ -1,0 +1,254 @@
+"""Retrieval metrics vs sklearn/hand-numpy per-group oracles."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+
+_rng = np.random.RandomState(21)
+N = 200
+_indexes = np.sort(_rng.randint(0, 10, N))
+_preds = _rng.rand(N).astype(np.float32)
+_target = _rng.randint(0, 2, N)
+
+
+def _grouped_mean(fn, empty="skip"):
+    res = []
+    for g in np.unique(_indexes):
+        mask = _indexes == g
+        t, p = _target[mask], _preds[mask]
+        if t.sum() == 0:
+            if empty == "neg":
+                res.append(0.0)
+            elif empty == "pos":
+                res.append(1.0)
+            continue
+        res.append(fn(p, t))
+    return np.mean(res)
+
+
+def _np_mrr(p, t):
+    order = np.argsort(-p, kind="stable")
+    rel = t[order]
+    pos = np.nonzero(rel)[0]
+    return 1.0 / (pos[0] + 1) if len(pos) else 0.0
+
+
+def _np_precision_at(p, t, k=None):
+    k = k or len(p)
+    k = min(k, len(p))
+    order = np.argsort(-p, kind="stable")
+    return t[order][:k].sum() / k
+
+
+def _np_recall_at(p, t, k=None):
+    k = k or len(p)
+    k = min(k, len(p))
+    order = np.argsort(-p, kind="stable")
+    return t[order][:k].sum() / t.sum()
+
+
+def _np_fallout_at(p, t, k=None):
+    k = k or len(p)
+    k = min(k, len(p))
+    order = np.argsort(-p, kind="stable")
+    nr = 1 - t[order]
+    return nr[:k].sum() / max(nr.sum(), 1)
+
+
+def _np_hit_at(p, t, k=None):
+    k = k or len(p)
+    k = min(k, len(p))
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][:k].sum() > 0)
+
+
+def _np_rprec(p, t):
+    r = int(t.sum())
+    order = np.argsort(-p, kind="stable")
+    return t[order][:r].sum() / r if r else 0.0
+
+
+class TestFunctionalKernels:
+    def test_ap(self):
+        for g in np.unique(_indexes):
+            m = _indexes == g
+            if _target[m].sum() == 0:
+                continue
+            ref = skm.average_precision_score(_target[m], _preds[m])
+            res = retrieval_average_precision(jnp.asarray(_preds[m]), jnp.asarray(_target[m]))
+            np.testing.assert_allclose(np.asarray(res), ref, atol=1e-5)
+
+    def test_mrr(self):
+        m = _indexes == 0
+        np.testing.assert_allclose(
+            np.asarray(retrieval_reciprocal_rank(jnp.asarray(_preds[m]), jnp.asarray(_target[m]))),
+            _np_mrr(_preds[m], _target[m]),
+            atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("k", [None, 1, 3, 100])
+    def test_precision_recall_fallout_hit(self, k):
+        m = _indexes == 1
+        p, t = _preds[m], _target[m]
+        np.testing.assert_allclose(np.asarray(retrieval_precision(jnp.asarray(p), jnp.asarray(t), k=k)), _np_precision_at(p, t, k), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(retrieval_recall(jnp.asarray(p), jnp.asarray(t), k=k)), _np_recall_at(p, t, k), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(retrieval_fall_out(jnp.asarray(p), jnp.asarray(t), k=k)), _np_fallout_at(p, t, k), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(retrieval_hit_rate(jnp.asarray(p), jnp.asarray(t), k=k)), _np_hit_at(p, t, k), atol=1e-6)
+
+    def test_ndcg_vs_sklearn(self):
+        m = _indexes == 2
+        p, t = _preds[m], _target[m]
+        ref = skm.ndcg_score(t[None, :], p[None, :])
+        np.testing.assert_allclose(
+            np.asarray(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t))), ref, atol=1e-5
+        )
+
+    def test_ndcg_graded(self):
+        p = jnp.asarray([0.1, 0.2, 0.3, 4.0, 70.0])
+        t_graded = np.array([10, 0, 0, 1, 5])
+        ref = skm.ndcg_score(t_graded[None, :], np.asarray(p)[None, :])
+        np.testing.assert_allclose(
+            np.asarray(retrieval_normalized_dcg(p, jnp.asarray(t_graded))), ref, atol=1e-5
+        )
+
+    def test_rprecision(self):
+        m = _indexes == 3
+        p, t = _preds[m], _target[m]
+        np.testing.assert_allclose(
+            np.asarray(retrieval_r_precision(jnp.asarray(p), jnp.asarray(t))), _np_rprec(p, t), atol=1e-6
+        )
+
+    def test_invalid_inputs(self):
+        with pytest.raises(ValueError, match="same shape"):
+            retrieval_precision(jnp.zeros(3), jnp.zeros(4, dtype=jnp.int32))
+        with pytest.raises(ValueError, match="floats"):
+            retrieval_precision(jnp.zeros(3, dtype=jnp.int32), jnp.zeros(3, dtype=jnp.int32))
+        with pytest.raises(ValueError, match="positive integer"):
+            retrieval_precision(jnp.zeros(3), jnp.zeros(3, dtype=jnp.int32), k=-1)
+
+
+@pytest.mark.parametrize(
+    "module_cls, np_fn",
+    [
+        (RetrievalMAP, lambda p, t: skm.average_precision_score(t, p)),
+        (RetrievalMRR, _np_mrr),
+        (RetrievalPrecision, _np_precision_at),
+        (RetrievalRecall, _np_recall_at),
+        (RetrievalHitRate, _np_hit_at),
+        (RetrievalRPrecision, _np_rprec),
+    ],
+)
+class TestRetrievalModules:
+    def test_module_vs_grouped_oracle(self, module_cls, np_fn):
+        m = module_cls(empty_target_action="skip")
+        half = N // 2
+        m.update(jnp.asarray(_preds[:half]), jnp.asarray(_target[:half]), indexes=jnp.asarray(_indexes[:half]))
+        m.update(jnp.asarray(_preds[half:]), jnp.asarray(_target[half:]), indexes=jnp.asarray(_indexes[half:]))
+        ref = _grouped_mean(np_fn, empty="skip")
+        np.testing.assert_allclose(np.asarray(m.compute()), ref, atol=1e-5)
+
+    def test_module_emulated_ddp(self, module_cls, np_fn):
+        from tests.helpers.testers import _FakeGather
+
+        ranks = [module_cls(empty_target_action="skip") for _ in range(2)]
+        half = N // 2
+        ranks[0].update(jnp.asarray(_preds[:half]), jnp.asarray(_target[:half]), indexes=jnp.asarray(_indexes[:half]))
+        ranks[1].update(jnp.asarray(_preds[half:]), jnp.asarray(_target[half:]), indexes=jnp.asarray(_indexes[half:]))
+        gather = _FakeGather(ranks)
+        with ranks[0].sync_context(dist_sync_fn=gather, distributed_available=lambda: True):
+            value = ranks[0]._inner_compute()
+        ref = _grouped_mean(np_fn, empty="skip")
+        np.testing.assert_allclose(np.asarray(value), ref, atol=1e-5)
+
+
+def test_fallout_module():
+    m = RetrievalFallOut(empty_target_action="skip")
+    m.update(jnp.asarray(_preds), jnp.asarray(_target), indexes=jnp.asarray(_indexes))
+    res = []
+    for g in np.unique(_indexes):
+        mask = _indexes == g
+        t, p = _target[mask], _preds[mask]
+        if (1 - t).sum() == 0:
+            continue
+        res.append(_np_fallout_at(p, t))
+    np.testing.assert_allclose(np.asarray(m.compute()), np.mean(res), atol=1e-5)
+
+
+def test_empty_target_actions():
+    idx = jnp.asarray([0, 0, 1, 1])
+    p = jnp.asarray([0.5, 0.3, 0.2, 0.8])
+    t = jnp.asarray([0, 0, 1, 0])  # group 0 has no positives
+
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(p, t, indexes=idx)
+    with pytest.raises(ValueError, match="no positive"):
+        m.compute()
+
+    for action, expected_g0 in [("neg", 0.0), ("pos", 1.0)]:
+        m = RetrievalMAP(empty_target_action=action)
+        m.update(p, t, indexes=idx)
+        g1 = skm.average_precision_score([1, 0], [0.2, 0.8])
+        np.testing.assert_allclose(np.asarray(m.compute()), np.mean([expected_g0, g1]), atol=1e-6)
+
+    with pytest.raises(ValueError, match="wrong value"):
+        RetrievalMAP(empty_target_action="bogus")
+
+
+def test_ignore_index_filters_rows():
+    idx = jnp.asarray([0, 0, 0, 0])
+    p = jnp.asarray([0.9, 0.7, 0.5, 0.3])
+    t = jnp.asarray([1, -1, 0, 1])
+    m = RetrievalMAP(ignore_index=-1)
+    m.update(p, t, indexes=idx)
+    ref = skm.average_precision_score([1, 0, 1], [0.9, 0.5, 0.3])
+    np.testing.assert_allclose(np.asarray(m.compute()), ref, atol=1e-6)
+
+
+def test_retrieval_pr_curve_and_recall_at_precision():
+    idx = jnp.asarray([0] * 6 + [1] * 6)
+    p = jnp.asarray(_rng.rand(12).astype(np.float32))
+    t = jnp.asarray([1, 0, 1, 0, 1, 0, 0, 1, 0, 1, 0, 1])
+    m = RetrievalPrecisionRecallCurve(max_k=4)
+    m.update(p, t, indexes=idx)
+    prec, rec, top_k = m.compute()
+    assert prec.shape == rec.shape == (4,)
+    ref_p = np.mean(
+        [[_np_precision_at(np.asarray(p[s]), np.asarray(t[s]), k) for k in range(1, 5)] for s in (slice(0, 6), slice(6, 12))],
+        axis=0,
+    )
+    np.testing.assert_allclose(np.asarray(prec), ref_p, atol=1e-5)
+
+    m2 = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=4)
+    m2.update(p, t, indexes=idx)
+    best_r, best_k = m2.compute()
+    assert 0.0 <= float(best_r) <= 1.0
+    assert 1 <= int(best_k) <= 4
+
+
+def test_indexes_required():
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="cannot be None"):
+        m.update(jnp.asarray([0.1]), jnp.asarray([1]), indexes=None)

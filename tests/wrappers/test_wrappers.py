@@ -1,0 +1,149 @@
+"""Wrapper metrics: BootStrapper, ClasswiseWrapper, MinMaxMetric, MultioutputWrapper, MetricTracker."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanSquaredError,
+    MetricTracker,
+    MetricCollection,
+    MinMaxMetric,
+    MultioutputWrapper,
+    Precision,
+    Recall,
+    SumMetric,
+)
+from tests.helpers.testers import NUM_CLASSES
+
+_rng = np.random.RandomState(13)
+
+
+class TestBootStrapper:
+    def test_mean_close_to_base(self):
+        base = MeanSquaredError()
+        boot = BootStrapper(MeanSquaredError(), num_bootstraps=20)
+        p = jnp.asarray(_rng.rand(256).astype(np.float32))
+        t = jnp.asarray(_rng.rand(256).astype(np.float32))
+        base.update(p, t)
+        boot.update(p, t)
+        out = boot.compute()
+        assert set(out) == {"mean", "std"}
+        assert abs(float(out["mean"]) - float(base.compute())) < 0.02
+        assert float(out["std"]) > 0
+
+    def test_quantile_and_raw(self):
+        boot = BootStrapper(SumMetric(), num_bootstraps=5, quantile=0.5, raw=True)
+        boot.update(jnp.asarray([1.0, 2.0, 3.0]))
+        out = boot.compute()
+        assert out["raw"].shape == (5,)
+        assert "quantile" in out
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError, match="base metric"):
+            BootStrapper("not a metric")
+        with pytest.raises(ValueError, match="sampling_strategy"):
+            BootStrapper(SumMetric(), sampling_strategy="bogus")
+
+
+class TestClasswiseWrapper:
+    def test_names_and_values(self):
+        metric = ClasswiseWrapper(Accuracy(average="none", num_classes=NUM_CLASSES))
+        p = jnp.asarray(_rng.randint(0, NUM_CLASSES, 64))
+        t = jnp.asarray(_rng.randint(0, NUM_CLASSES, 64))
+        metric.update(p, t)
+        out = metric.compute()
+        assert set(out) == {f"accuracy_{i}" for i in range(NUM_CLASSES)}
+
+    def test_custom_labels(self):
+        metric = ClasswiseWrapper(Recall(average="none", num_classes=3), labels=["horse", "fish", "dog"])
+        p = jnp.asarray(_rng.randint(0, 3, 32))
+        t = jnp.asarray(_rng.randint(0, 3, 32))
+        out = metric(p, t)
+        assert set(out) == {"recall_horse", "recall_fish", "recall_dog"}
+
+    def test_invalid(self):
+        with pytest.raises(ValueError, match="metric"):
+            ClasswiseWrapper("nope")
+        with pytest.raises(ValueError, match="labels"):
+            ClasswiseWrapper(Recall(average="none", num_classes=3), labels=[1, 2, 3])
+
+
+class TestMinMax:
+    def test_tracks_extrema(self):
+        mm = MinMaxMetric(SumMetric())
+        mm.update(jnp.asarray([2.0]))
+        out1 = mm.compute()
+        assert float(out1["raw"]) == 2.0 and float(out1["min"]) == 2.0 and float(out1["max"]) == 2.0
+        mm.update(jnp.asarray([3.0]))
+        out2 = mm.compute()
+        assert float(out2["raw"]) == 5.0 and float(out2["max"]) == 5.0 and float(out2["min"]) == 2.0
+        mm.reset()
+        mm.update(jnp.asarray([1.0]))
+        out3 = mm.compute()
+        assert float(out3["min"]) == 1.0 and float(out3["max"]) == 1.0
+
+    def test_invalid_base(self):
+        with pytest.raises(ValueError, match="base metric"):
+            MinMaxMetric("nope")
+
+
+class TestMultioutput:
+    def test_per_output_mse(self):
+        mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        p = jnp.asarray(_rng.rand(32, 2).astype(np.float32))
+        t = jnp.asarray(_rng.rand(32, 2).astype(np.float32))
+        mo.update(p, t)
+        out = mo.compute()
+        assert len(out) == 2
+        for i in range(2):
+            ref = np.mean((np.asarray(p)[:, i] - np.asarray(t)[:, i]) ** 2)
+            np.testing.assert_allclose(np.asarray(out[i]), ref, atol=1e-6)
+
+    def test_nan_removal(self):
+        mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=True)
+        p = np.asarray(_rng.rand(8, 2), dtype=np.float32)
+        t = np.asarray(_rng.rand(8, 2), dtype=np.float32)
+        t[0, 0] = np.nan
+        mo.update(jnp.asarray(p), jnp.asarray(t))
+        out = mo.compute()
+        ref0 = np.mean((p[1:, 0] - t[1:, 0]) ** 2)
+        np.testing.assert_allclose(np.asarray(out[0]), ref0, atol=1e-6)
+
+
+class TestTracker:
+    def test_single_metric_history(self):
+        tracker = MetricTracker(SumMetric(), maximize=True)
+        for vals in ([1.0], [5.0], [3.0]):
+            tracker.increment()
+            tracker.update(jnp.asarray(vals))
+        all_vals = tracker.compute_all()
+        np.testing.assert_allclose(np.asarray(all_vals), [1.0, 5.0, 3.0])
+        best, idx = tracker.best_metric(return_step=True)
+        assert best == 5.0 and idx == 1
+        assert tracker.n_steps == 2  # reference counts len(history) - 1
+
+    def test_collection_history(self):
+        col = MetricCollection([Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")])
+        tracker = MetricTracker(col, maximize=[True, True])
+        for _ in range(2):
+            tracker.increment()
+            tracker.update(jnp.asarray(_rng.randint(0, 3, 32)), jnp.asarray(_rng.randint(0, 3, 32)))
+        allv = tracker.compute_all()
+        assert set(allv) == {"Precision", "Recall"}
+        assert allv["Precision"].shape == (2,)
+        best = tracker.best_metric()
+        assert set(best) == {"Precision", "Recall"}
+
+    def test_update_before_increment_raises(self):
+        tracker = MetricTracker(SumMetric())
+        with pytest.raises(ValueError, match="increment"):
+            tracker.update(jnp.asarray([1.0]))
+
+    def test_invalid_args(self):
+        with pytest.raises(TypeError, match="need to be an instance"):
+            MetricTracker("nope")
+        with pytest.raises(ValueError, match="single bool"):
+            MetricTracker(SumMetric(), maximize=[True])
