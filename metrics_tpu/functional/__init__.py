@@ -10,5 +10,13 @@ from metrics_tpu.functional.regression import *  # noqa: F401,F403
 from metrics_tpu.functional.regression import __all__ as _regression_all
 from metrics_tpu.functional.retrieval import *  # noqa: F401,F403
 from metrics_tpu.functional.retrieval import __all__ as _retrieval_all
+from metrics_tpu.functional.text import *  # noqa: F401,F403
+from metrics_tpu.functional.text import __all__ as _text_all
 
-__all__ = list(_classification_all) + list(_pairwise_all) + list(_regression_all) + list(_retrieval_all)
+__all__ = (
+    list(_classification_all)
+    + list(_pairwise_all)
+    + list(_regression_all)
+    + list(_retrieval_all)
+    + list(_text_all)
+)

@@ -1,0 +1,81 @@
+"""Shared image kernels: gaussian windows + depthwise convolution.
+
+Parity: reference `functional/image/helper.py` (gaussian kernel builders) and
+the depthwise ``F.conv2d(groups=C)`` pattern of `functional/image/ssim.py`.
+On TPU the depthwise window conv lowers through
+``lax.conv_general_dilated(feature_group_count=C)`` — an MXU-tiled op.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype) -> jax.Array:
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1, dtype=dtype)
+    gauss = jnp.exp(-(dist**2) / (2 * sigma**2))
+    return gauss / gauss.sum()
+
+
+def _gaussian_kernel_2d(kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> jax.Array:
+    """(kh, kw) separable gaussian window."""
+    gk_h = _gaussian(kernel_size[0], sigma[0], dtype)
+    gk_w = _gaussian(kernel_size[1], sigma[1], dtype)
+    return jnp.outer(gk_h, gk_w)
+
+
+def _gaussian_kernel_3d(kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> jax.Array:
+    k = _gaussian_kernel_2d(kernel_size[:2], sigma[:2], dtype)
+    gk_d = _gaussian(kernel_size[2], sigma[2], dtype)
+    return jnp.einsum("hw,d->hwd", k, gk_d)
+
+
+def _uniform_kernel(kernel_size: Sequence[int], dtype=jnp.float32) -> jax.Array:
+    return jnp.ones(tuple(kernel_size), dtype=dtype) / float(jnp.prod(jnp.asarray(kernel_size)))
+
+
+def _depthwise_conv(x: jax.Array, kernel: jax.Array) -> jax.Array:
+    """Depthwise (per-channel) valid convolution.
+
+    x: (B, C, *spatial); kernel: (*spatial_k) shared across channels.
+    """
+    channels = x.shape[1]
+    nd = kernel.ndim
+    # kernel layout (O, I/g, *k) with O=C, I/g=1
+    k = jnp.broadcast_to(kernel, (channels, 1) + kernel.shape)
+    dn_spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    dn = lax.conv_dimension_numbers(x.shape, k.shape, dn_spec)
+    return lax.conv_general_dilated(
+        x.astype(kernel.dtype),
+        k,
+        window_strides=(1,) * nd,
+        padding="VALID",
+        dimension_numbers=dn,
+        feature_group_count=channels,
+    )
+
+
+def _reflect_pad(x: jax.Array, pads: Sequence[Tuple[int, int]]) -> jax.Array:
+    """Reflection-pad the trailing spatial dims of (B, C, *spatial)."""
+    pad_width = [(0, 0), (0, 0)] + list(pads)
+    return jnp.pad(x, pad_width, mode="reflect")
+
+
+def _avg_pool(x: jax.Array, window: int = 2) -> jax.Array:
+    """Non-overlapping average pool over all spatial dims of (B, C, *spatial)."""
+    nd = x.ndim - 2
+    dims = (1, 1) + (window,) * nd
+    return lax.reduce_window(x, 0.0, lax.add, dims, dims, "VALID") / (window**nd)
+
+
+__all__ = [
+    "_gaussian_kernel_2d",
+    "_gaussian_kernel_3d",
+    "_uniform_kernel",
+    "_depthwise_conv",
+    "_reflect_pad",
+    "_avg_pool",
+]

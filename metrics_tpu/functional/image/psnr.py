@@ -1,0 +1,77 @@
+"""Peak signal-to-noise ratio.
+
+Parity: reference `functional/image/psnr.py:23-160`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.parallel.sync import reduce as _reduce
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _psnr_update(
+    preds: jax.Array,
+    target: jax.Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    if dim is None:
+        sum_squared_error = jnp.sum((preds - target) ** 2)
+        n_obs = jnp.asarray(target.size)
+        return sum_squared_error, n_obs
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    n_obs = jnp.asarray(int(jnp.prod(jnp.asarray([target.shape[d] for d in dim_list]))))
+    return sum_squared_error, n_obs
+
+
+def _psnr_compute(
+    sum_squared_error: jax.Array,
+    n_obs: jax.Array,
+    data_range: jax.Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> jax.Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(base))
+    return _reduce(psnr_vals, reduction)
+
+
+def peak_signal_noise_ratio(
+    preds: jax.Array,
+    target: jax.Array,
+    data_range: Optional[float] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> jax.Array:
+    """PSNR = 10·log10(range² / MSE).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import peak_signal_noise_ratio
+        >>> pred = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> peak_signal_noise_ratio(pred, target)
+        Array(2.5527415, dtype=float32)
+    """
+    if dim is None and reduction != "elementwise_mean":
+        from metrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    _check_same_shape(preds, target)
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range_t = jnp.maximum(target.max() - target.min(), preds.max() - preds.min())
+    else:
+        data_range_t = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range_t, base=base, reduction=reduction)
+
+
+__all__ = ["peak_signal_noise_ratio"]

@@ -1,0 +1,194 @@
+"""Spectral/statistical image kernels: UQI, ERGAS, SAM, D-lambda, gradients.
+
+Parity: reference `functional/image/{uqi,ergas,sam,d_lambda,gradients}.py`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import _depthwise_conv, _gaussian_kernel_2d, _reflect_pad
+from metrics_tpu.parallel.sync import reduce as _reduce
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _image_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def universal_image_quality_index(
+    preds: jax.Array,
+    target: jax.Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+) -> jax.Array:
+    """UQI — SSIM without the stabilizing constants.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.functional import universal_image_quality_index
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> universal_image_quality_index(preds, target).round(4)
+        Array(0.9216, dtype=float32)
+    """
+    preds, target = _image_update(preds, target)
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    kernel = _gaussian_kernel_2d(kernel_size, sigma, preds.dtype)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds_p = _reflect_pad(preds, [(pad_h, pad_h), (pad_w, pad_w)])
+    target_p = _reflect_pad(target, [(pad_h, pad_h), (pad_w, pad_w)])
+
+    stacked = jnp.concatenate(
+        (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p), axis=0
+    )
+    out = _depthwise_conv(stacked, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target, e_pp, e_tt, e_pt = (out[i * b : (i + 1) * b] for i in range(5))
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+    sigma_pred_sq = e_pp - mu_pred_sq
+    sigma_target_sq = e_tt - mu_target_sq
+    sigma_pred_target = e_pt - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower)
+    uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w]
+    return _reduce(uqi_idx, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: jax.Array,
+    target: jax.Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> jax.Array:
+    """ERGAS = 100·ratio·sqrt(mean over bands of (RMSE_b / mean_b)²).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.functional import error_relative_global_dimensionless_synthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> error_relative_global_dimensionless_synthesis(preds, target).round(0)
+        Array(154., dtype=float32)
+    """
+    preds, target = _image_update(preds, target)
+    b, c, h, w = preds.shape
+    preds_f = preds.reshape(b, c, h * w)
+    target_f = target.reshape(b, c, h * w)
+    diff = preds_f - target_f
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target_f, axis=2)
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return _reduce(ergas_score, reduction)
+
+
+def spectral_angle_mapper(
+    preds: jax.Array,
+    target: jax.Array,
+    reduction: Optional[str] = "elementwise_mean",
+) -> jax.Array:
+    """Per-pixel spectral angle between band vectors.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.functional import spectral_angle_mapper
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (8, 3, 16, 16))
+        >>> spectral_angle_mapper(preds, target).round(2)
+        Array(0.58, dtype=float32)
+    """
+    preds, target = _image_update(preds, target)
+    if preds.shape[1] <= 1:
+        raise ValueError(f"Expected channel dimension of `preds` and `target` to be larger than 1. Got preds: {preds.shape[1]}.")
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return _reduce(sam_score, reduction)
+
+
+def spectral_distortion_index(
+    preds: jax.Array,
+    target: jax.Array,
+    p: int = 1,
+    reduction: Optional[str] = "elementwise_mean",
+) -> jax.Array:
+    """D-lambda: distance between band-pair UQI matrices of preds vs target."""
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+    preds, target = _image_update(preds, target)
+    length = preds.shape[1]
+    m1 = jnp.zeros((length, length))
+    m2 = jnp.zeros((length, length))
+    for k in range(length):
+        for r in range(k, length):
+            v1 = universal_image_quality_index(target[:, k : k + 1], target[:, r : r + 1])
+            v2 = universal_image_quality_index(preds[:, k : k + 1], preds[:, r : r + 1])
+            m1 = m1.at[k, r].set(v1)
+            m1 = m1.at[r, k].set(v1)
+            m2 = m2.at[k, r].set(v2)
+            m2 = m2.at[r, k].set(v2)
+    diff = jnp.abs(m1 - m2) ** p
+    if length == 1:
+        output = diff ** (1.0 / p)
+    else:
+        output = (jnp.sum(diff) / (length * (length - 1))) ** (1.0 / p)
+    return _reduce(output, reduction)
+
+
+def image_gradients(img: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Finite-difference (dy, dx) of an image batch (reference `gradients.py`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import image_gradients
+        >>> image = jnp.arange(0, 1 * 1 * 5 * 5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :, :]
+        Array([[5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [0., 0., 0., 0., 0.]], dtype=float32)
+    """
+    if img.ndim != 4:
+        raise RuntimeError(f"The size of the image tensor {img.shape} is different from BxCxHxW")
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+__all__ = [
+    "universal_image_quality_index",
+    "error_relative_global_dimensionless_synthesis",
+    "spectral_angle_mapper",
+    "spectral_distortion_index",
+    "image_gradients",
+]

@@ -1,0 +1,165 @@
+"""BERTScore.
+
+Parity: reference `functional/text/bert.py` (426 LoC) + `text/bert.py` +
+`helper_embedding_metric.py`: tokenize -> contextual embeddings -> greedy
+cosine matching with optional idf weighting and baseline rescaling.
+
+TPU-first: embeddings come from a **Flax** transformer (`FlaxAutoModel`) so the
+model forward is a jitted XLA program on TPU — same HuggingFace hub, native
+JAX, replacing the reference's torch/CUDA path (SURVEY §2.9). A
+``user_forward_fn`` escape hatch accepts any `(list[str]) -> (embeddings
+(N, L, D), mask (N, L))` callable for offline/custom models.
+"""
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+
+def _load_flax_model(model_name_or_path: str):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` metric with default models requires `transformers` package be installed."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    return tokenizer, model
+
+
+def _default_forward(
+    sentences: List[str], tokenizer, model, max_length: int, num_layers: Optional[int]
+) -> Tuple[jax.Array, jax.Array, List[List[int]]]:
+    enc = tokenizer(
+        sentences,
+        padding="max_length",
+        max_length=max_length,
+        truncation=True,
+        return_tensors="np",
+    )
+    outputs = model(
+        input_ids=jnp.asarray(enc["input_ids"]),
+        attention_mask=jnp.asarray(enc["attention_mask"]),
+        output_hidden_states=True,
+    )
+    hidden = outputs.hidden_states[num_layers if num_layers is not None else -1]
+    return hidden, jnp.asarray(enc["attention_mask"]), [list(ids) for ids in enc["input_ids"]]
+
+
+def _compute_idf(corpus_token_ids: List[List[int]], mask_rows: jax.Array) -> Dict[int, float]:
+    """Inverse document frequency over the target corpus (reference idf path)."""
+    num_docs = len(corpus_token_ids)
+    df: Counter = Counter()
+    for row_ids, row_mask in zip(corpus_token_ids, mask_rows):
+        seen = {tid for tid, m in zip(row_ids, row_mask) if m}
+        df.update(seen)
+    return {tid: math.log((num_docs + 1) / (cnt + 1)) for tid, cnt in df.items()}
+
+
+def _greedy_cos_sim(
+    pred_emb: jax.Array,
+    pred_mask: jax.Array,
+    target_emb: jax.Array,
+    target_mask: jax.Array,
+    pred_weights: jax.Array,
+    target_weights: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched greedy matching: P = weighted mean over pred tokens of best match."""
+    pred_emb = pred_emb / jnp.clip(jnp.linalg.norm(pred_emb, axis=-1, keepdims=True), min=1e-12)
+    target_emb = target_emb / jnp.clip(jnp.linalg.norm(target_emb, axis=-1, keepdims=True), min=1e-12)
+
+    sim = jnp.einsum("bld,bmd->blm", pred_emb, target_emb)  # (B, Lp, Lt)
+    sim = jnp.where(pred_mask[:, :, None] > 0, sim, -jnp.inf)
+    sim = jnp.where(target_mask[:, None, :] > 0, sim, -jnp.inf)
+
+    best_for_pred = jnp.where(pred_mask > 0, sim.max(axis=2), 0.0)
+    best_for_target = jnp.where(target_mask > 0, sim.max(axis=1), 0.0)
+
+    pw = pred_weights * pred_mask
+    tw = target_weights * target_mask
+    precision = (best_for_pred * pw).sum(axis=1) / jnp.clip(pw.sum(axis=1), min=1e-12)
+    recall = (best_for_target * tw).sum(axis=1) / jnp.clip(tw.sum(axis=1), min=1e-12)
+    f1 = 2 * precision * recall / jnp.clip(precision + recall, min=1e-12)
+    return precision, recall, f1
+
+
+def bert_score(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    max_length: int = 128,
+    batch_size: int = 64,
+    return_hash: bool = False,
+) -> Dict[str, List[float]]:
+    """BERTScore precision/recall/f1 per sentence pair.
+
+    Either pass ``model_name_or_path`` (uses ``FlaxAutoModel``) or a
+    ``user_forward_fn(sentences) -> (embeddings, mask)`` for custom/offline
+    embedding models.
+    """
+    preds = [preds] if isinstance(preds, str) else list(preds)
+    target = [target] if isinstance(target, str) else list(target)
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+
+    if user_forward_fn is not None:
+        pred_emb, pred_mask = user_forward_fn(preds)
+        target_emb, target_mask = user_forward_fn(target)
+        pred_ids = target_ids = None
+    else:
+        name = model_name_or_path or "roberta-large"
+        tokenizer, fx_model = (user_tokenizer, model) if model is not None else _load_flax_model(name)
+        pred_emb, pred_mask, pred_ids = _default_forward(preds, tokenizer, fx_model, max_length, num_layers)
+        target_emb, target_mask, target_ids = _default_forward(target, tokenizer, fx_model, max_length, num_layers)
+
+    if idf:
+        if pred_ids is None or target_ids is None:
+            raise ValueError("`idf=True` requires tokenized ids; not available with `user_forward_fn`.")
+        import numpy as np
+
+        idf_map = _compute_idf(target_ids, np.asarray(target_mask))
+        pred_weights = jnp.asarray(
+            [[idf_map.get(tid, math.log(len(target_ids) + 1)) for tid in row] for row in pred_ids]
+        )
+        target_weights = jnp.asarray(
+            [[idf_map.get(tid, math.log(len(target_ids) + 1)) for tid in row] for row in target_ids]
+        )
+    else:
+        pred_weights = jnp.ones(pred_mask.shape)
+        target_weights = jnp.ones(target_mask.shape)
+
+    precision, recall, f1 = _greedy_cos_sim(
+        pred_emb, pred_mask.astype(jnp.float32), target_emb, target_mask.astype(jnp.float32), pred_weights, target_weights
+    )
+
+    if rescale_with_baseline:
+        raise NotImplementedError(
+            "Baseline rescaling requires the downloaded baseline files; pass rescale_with_baseline=False"
+            " or rescale externally."
+        )
+
+    return {
+        "precision": [float(p) for p in precision],
+        "recall": [float(r) for r in recall],
+        "f1": [float(f) for f in f1],
+    }
+
+
+__all__ = ["bert_score"]

@@ -71,12 +71,15 @@ def _bleu_score_compute(
     numerator: jax.Array,
     denominator: jax.Array,
     n_gram: int = 4,
+    weights: Optional[Sequence[float]] = None,
     smooth: bool = False,
 ) -> jax.Array:
-    """Geometric mean of n-gram precisions x brevity penalty (device math)."""
-    device_zero = jnp.asarray(0.0)
-    if not isinstance(numerator, jax.core.Tracer) and float(numerator.sum()) == 0:
-        return device_zero
+    """Geometric mean of n-gram precisions x brevity penalty (device math).
+
+    Any order with zero matches zeroes the whole score, smoothed or not
+    (reference `bleu.py` compute contract).
+    """
+    weights = weights if weights is not None else [1.0 / n_gram] * n_gram
 
     if smooth:
         precision_scores = (numerator + 1.0) / (denominator + 1.0)
@@ -84,12 +87,14 @@ def _bleu_score_compute(
     else:
         precision_scores = numerator / jnp.where(denominator == 0, 1.0, denominator)
 
-    log_precision_scores = (1.0 / n_gram) * jnp.log(jnp.where(precision_scores > 0, precision_scores, 1e-30))
+    log_precision_scores = jnp.asarray(weights) * jnp.log(jnp.where(precision_scores > 0, precision_scores, 1e-30))
     geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
     brevity_penalty = jnp.where(
         preds_len > target_len, jnp.asarray(1.0), jnp.exp(1.0 - target_len / jnp.maximum(preds_len, 1e-12))
     )
-    return brevity_penalty * geometric_mean
+    bleu = brevity_penalty * geometric_mean
+    # an order with zero matches zeroes the score (jit-safe masked form)
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, bleu)
 
 
 def bleu_score(
@@ -97,6 +102,7 @@ def bleu_score(
     target: Union[Sequence[str], Sequence[Sequence[str]]],
     n_gram: int = 4,
     smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
 ) -> jax.Array:
     """Corpus BLEU with whitespace tokenization.
 
@@ -119,7 +125,7 @@ def bleu_score(
     numerator, denominator, preds_len, target_len = _bleu_score_update(
         preds_, target_, numerator, denominator, preds_len, target_len, n_gram
     )
-    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth).astype(jnp.float32)
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth).astype(jnp.float32)
 
 
 __all__ = ["bleu_score"]

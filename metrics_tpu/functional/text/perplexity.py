@@ -1,0 +1,65 @@
+"""Perplexity from next-token logits.
+
+Parity: reference `functional/text/perplexity.py` — device-only math
+(log-softmax gather + masked sum), fully jittable with ``ignore_index`` as a
+mask (static shapes).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_perplexity_inputs(preds: jax.Array, target: jax.Array) -> None:
+    if preds.ndim != 3:
+        raise ValueError(f"Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size], but got {preds.ndim}.")
+    if target.ndim != 2:
+        raise ValueError(f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}.")
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise TypeError(f"Input tensor `preds` is expected to be of a type one of the floating point types but got {preds.dtype}.")
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of integer type but got {target.dtype}.")
+
+
+def _perplexity_update(preds: jax.Array, target: jax.Array, ignore_index: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+    _check_perplexity_inputs(preds, target)
+    probs = jax.nn.log_softmax(preds.astype(jnp.float32), axis=-1)
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.float32)
+        safe_target = jnp.where(target == ignore_index, 0, target)
+    else:
+        mask = jnp.ones(target.shape, dtype=jnp.float32)
+        safe_target = target
+    token_logprob = jnp.take_along_axis(probs, safe_target[..., None], axis=-1)[..., 0]
+    total_log_probs = -(token_logprob * mask).sum()
+    count = mask.sum()
+    return total_log_probs, count
+
+
+def _perplexity_compute(total: jax.Array, count: jax.Array) -> jax.Array:
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: jax.Array, target: jax.Array, ignore_index: Optional[int] = None) -> jax.Array:
+    """exp(mean NLL) over non-ignored tokens.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.functional import perplexity
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> perplexity(preds, target, ignore_index=None).round(4)
+        Array(5.2545, dtype=float32)
+    """
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)
+
+
+__all__ = ["perplexity"]

@@ -1,0 +1,150 @@
+"""Word/character error-rate family: WER, MER, WIL, WIP, CER, MatchErrorRate.
+
+Parity: reference `functional/text/{wer,mer,wil,wip,cer}.py` — all are
+Levenshtein counters with scalar sum states.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+
+def _str_list(x: Union[str, List[str]]) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def _wer_update(preds, target) -> Tuple[jax.Array, jax.Array]:
+    preds, target = _str_list(preds), _str_list(target)
+    errors, total = 0, 0
+    for p, t in zip(preds, target):
+        p_tok, t_tok = p.split(), t.split()
+        errors += _edit_distance(p_tok, t_tok)
+        total += len(t_tok)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _wer_compute(errors, total) -> jax.Array:
+    return errors / total
+
+
+def word_error_rate(preds, target) -> jax.Array:
+    """WER = edit distance / reference length.
+
+    Example:
+        >>> from metrics_tpu.functional import word_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_error_rate(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)
+
+
+def _cer_update(preds, target) -> Tuple[jax.Array, jax.Array]:
+    preds, target = _str_list(preds), _str_list(target)
+    errors, total = 0, 0
+    for p, t in zip(preds, target):
+        errors += _edit_distance(list(p), list(t))
+        total += len(t)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def char_error_rate(preds, target) -> jax.Array:
+    """CER = character edit distance / reference chars.
+
+    Example:
+        >>> from metrics_tpu.functional import char_error_rate
+        >>> char_error_rate(["this is the prediction"], ["this is the reference"])
+        Array(0.42857143, dtype=float32)
+    """
+    errors, total = _cer_update(preds, target)
+    return errors / total
+
+
+def _mer_update(preds, target) -> Tuple[jax.Array, jax.Array]:
+    preds, target = _str_list(preds), _str_list(target)
+    errors, total = 0, 0
+    for p, t in zip(preds, target):
+        p_tok, t_tok = p.split(), t.split()
+        d = _edit_distance(p_tok, t_tok)
+        errors += d
+        total += max(len(t_tok), len(p_tok))
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def match_error_rate(preds, target) -> jax.Array:
+    """MER = edit distance / max(len(ref), len(pred)) accumulated.
+
+    Example:
+        >>> from metrics_tpu.functional import match_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> match_error_rate(preds, target)
+        Array(0.44444445, dtype=float32)
+    """
+    errors, total = _mer_update(preds, target)
+    return errors / total
+
+
+def _wil_wip_update(preds, target) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Accumulate hit counts for word-information metrics (reference wil/wip)."""
+    preds, target = _str_list(preds), _str_list(target)
+    total = 0.0
+    errors = 0.0
+    target_total = 0.0
+    preds_total = 0.0
+    for p, t in zip(preds, target):
+        p_tok, t_tok = p.split(), t.split()
+        d = _edit_distance(p_tok, t_tok)
+        # "preserved information" count: max(|t|, |p|) - d (reference wil/wip)
+        hits = max(len(t_tok), len(p_tok)) - d
+        errors += hits
+        target_total += len(t_tok)
+        preds_total += len(p_tok)
+        total += 1
+    return (
+        jnp.asarray(errors, dtype=jnp.float32),
+        jnp.asarray(target_total, dtype=jnp.float32),
+        jnp.asarray(preds_total, dtype=jnp.float32),
+    )
+
+
+def word_information_preserved(preds, target) -> jax.Array:
+    """WIP = (hits/len_t) * (hits/len_p).
+
+    Example:
+        >>> from metrics_tpu.functional import word_information_preserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_preserved(preds, target)
+        Array(0.3472222, dtype=float32)
+    """
+    hits, target_total, preds_total = _wil_wip_update(preds, target)
+    return (hits / target_total) * (hits / preds_total)
+
+
+def word_information_lost(preds, target) -> jax.Array:
+    """WIL = 1 - WIP.
+
+    Example:
+        >>> from metrics_tpu.functional import word_information_lost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_lost(preds, target)
+        Array(0.6527778, dtype=float32)
+    """
+    return 1.0 - word_information_preserved(preds, target)
+
+
+__all__ = [
+    "word_error_rate",
+    "char_error_rate",
+    "match_error_rate",
+    "word_information_preserved",
+    "word_information_lost",
+]

@@ -1,0 +1,37 @@
+from metrics_tpu.text.advanced import (
+    BERTScore,
+    CHRFScore,
+    ExtendedEditDistance,
+    InfoLM,
+    ROUGEScore,
+    TranslationEditRate,
+)
+from metrics_tpu.text.basic import (
+    BLEUScore,
+    CharErrorRate,
+    MatchErrorRate,
+    Perplexity,
+    SacreBLEUScore,
+    SQuAD,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+__all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "ExtendedEditDistance",
+    "InfoLM",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

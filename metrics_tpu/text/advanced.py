@@ -1,0 +1,339 @@
+"""List-state / model-backed text modules: ROUGE, CHRF, TER, EED, BERTScore, InfoLM.
+
+Parity: reference `text/{rouge,chrf,ter,eed,bert,infolm}.py`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.chrf import chrf_score
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ROUGE_KEYS,
+    _create_stemmer,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class ROUGEScore(Metric):
+    """ROUGE-1/2/L/Lsum accumulated per sentence."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(rouge_keys, str):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS)}")
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        self.stemmer = _create_stemmer(use_stemmer)
+        self.accumulate = accumulate
+        for rouge_key in self.rouge_keys:
+            for score in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{rouge_key}_{score}", [], dist_reduce_fx=None)
+
+    def update(self, preds, target) -> None:
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        output = _rouge_score_update(preds, target, self.rouge_keys_values, self.accumulate, self.stemmer)
+        for rouge_key, metrics in output.items():
+            for metric in metrics:
+                for tp, value in metric.items():
+                    getattr(self, f"rouge{rouge_key}_{tp}").append(value)
+
+    def compute(self) -> Dict[str, jax.Array]:
+        update_output = {
+            f"{rouge_key}_{score}": getattr(self, f"{rouge_key}_{score}")
+            for rouge_key in self.rouge_keys
+            for score in ("fmeasure", "precision", "recall")
+        }
+        return _rouge_score_compute(update_output)
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state.pop("stemmer", None)  # nltk stemmers may not pickle
+        state["_use_stemmer"] = self.stemmer is not None
+        return state
+
+    def __setstate__(self, state):
+        use_stemmer = state.pop("_use_stemmer", False)
+        super().__setstate__(state)
+        self.stemmer = _create_stemmer(use_stemmer)
+
+
+class CHRFScore(Metric):
+    """Corpus chrF/chrF++; state is the list of raw sentence pairs.
+
+    The reference keeps aggregate n-gram count dict states (`text/chrf.py`);
+    here the per-pair strings accumulate host-side and the corpus statistics
+    are recomputed at ``compute`` — identical result, simpler sync story.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self._preds: List[str] = []
+        self._target: List[List[str]] = []
+
+    def update(self, preds, target) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+        self._preds.extend(preds_)
+        self._target.extend(target_)
+
+    def compute(self):
+        return chrf_score(
+            self._preds,
+            self._target,
+            self.n_char_order,
+            self.n_word_order,
+            self.beta,
+            self.lowercase,
+            self.whitespace,
+            self.return_sentence_level_score,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []
+
+
+class TranslationEditRate(Metric):
+    """Corpus TER accumulated over batches."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def update(self, preds, target) -> None:
+        num_edits, tgt_length, sentence_ter = _ter_update(
+            preds,
+            target,
+            self.tokenizer,
+            0.0,
+            0.0,
+            self.sentence_ter if self.return_sentence_level_score else None,
+        )
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_length = self.total_tgt_length + tgt_length
+
+    def compute(self):
+        ter = _ter_compute(self.total_num_edits, self.total_tgt_length)
+        if self.return_sentence_level_score:
+            return ter, dim_zero_cat(self.sentence_ter)
+        return ter
+
+
+class ExtendedEditDistance(Metric):
+    """Corpus EED accumulated per sentence."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for param, name in ((alpha, "alpha"), (rho, "rho"), (deletion, "deletion"), (insertion, "insertion")):
+            if not isinstance(param, float) or (isinstance(param, float) and param < 0):
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def update(self, preds, target) -> None:
+        self.sentence_eed = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion, self.sentence_eed
+        )
+
+    def compute(self):
+        average = _eed_compute([jnp.atleast_1d(s) for s in self.sentence_eed]) if self.sentence_eed else jnp.asarray(0.0)
+        if self.return_sentence_level_score:
+            return average, dim_zero_cat(self.sentence_eed)
+        return average
+
+
+class BERTScore(Metric):
+    """BERTScore over accumulated sentence pairs (Flax transformer forward)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        idf: bool = False,
+        user_forward_fn: Optional[Any] = None,
+        max_length: int = 128,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.idf = idf
+        self.user_forward_fn = user_forward_fn
+        self.max_length = max_length
+        self._preds: List[str] = []
+        self._target: List[str] = []
+
+    def update(self, preds, target) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        if len(preds_) != len(target_):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        self._preds.extend(preds_)
+        self._target.extend(target_)
+
+    def compute(self) -> Dict[str, List[float]]:
+        from metrics_tpu.functional.text.bert import bert_score
+
+        return bert_score(
+            self._preds,
+            self._target,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            idf=self.idf,
+            user_forward_fn=self.user_forward_fn,
+            max_length=self.max_length,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []
+
+
+class InfoLM(Metric):
+    """InfoLM over accumulated sentence pairs (Flax masked-LM forward)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: str = "bert-base-uncased",
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        max_length: Optional[int] = None,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.max_length = max_length
+        self.return_sentence_level_score = return_sentence_level_score
+        self._preds: List[str] = []
+        self._target: List[str] = []
+
+    def update(self, preds, target) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        self._preds.extend(preds_)
+        self._target.extend(target_)
+
+    def compute(self):
+        from metrics_tpu.functional.text.infolm import infolm
+
+        return infolm(
+            self._preds,
+            self._target,
+            model_name_or_path=self.model_name_or_path,
+            temperature=self.temperature,
+            information_measure=self.information_measure,
+            idf=self.idf,
+            alpha=self.alpha,
+            beta=self.beta,
+            max_length=self.max_length,
+            return_sentence_level_score=self.return_sentence_level_score,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []
+
+
+__all__ = ["ROUGEScore", "CHRFScore", "TranslationEditRate", "ExtendedEditDistance", "BERTScore", "InfoLM"]

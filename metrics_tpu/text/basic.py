@@ -1,0 +1,219 @@
+"""Counter-state text modules: BLEU, SacreBLEU, WER family, Perplexity, SQuAD.
+
+Parity: reference `text/{bleu,sacre_bleu,wer,cer,mer,wil,wip,perplexity,squad}.py`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update
+from metrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+from metrics_tpu.functional.text.squad import _squad_compute, _squad_input_check, _squad_update
+from metrics_tpu.functional.text.wer import (
+    _cer_update,
+    _mer_update,
+    _wer_update,
+    _wil_wip_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class BLEUScore(Metric):
+    """Corpus BLEU accumulated over batches."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self, n_gram: int = 4, smooth: bool = False, weights: Optional[Sequence[float]] = None, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights
+        self.add_state("preds_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[t] if isinstance(t, str) else t for t in target]
+        self.numerator, self.denominator, self.preds_len, self.target_len = _bleu_score_update(
+            preds_, target_, self.numerator, self.denominator, self.preds_len, self.target_len, self.n_gram
+        )
+
+    def compute(self) -> jax.Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.weights, self.smooth
+        ).astype(jnp.float32)
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with sacrebleu tokenizers."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        target_ = [[t] if isinstance(t, str) else t for t in target]
+        self.numerator, self.denominator, self.preds_len, self.target_len = _bleu_score_update(
+            list(preds),
+            target_,
+            self.numerator,
+            self.denominator,
+            self.preds_len,
+            self.target_len,
+            self.n_gram,
+            self.tokenizer,
+        )
+
+
+class _ErrorRateMetric(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    _update_fn = None
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        errors, total = type(self)._update_fn(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> jax.Array:
+        return self.errors / self.total
+
+
+class WordErrorRate(_ErrorRateMetric):
+    """WER accumulated over batches."""
+
+    _update_fn = staticmethod(_wer_update)
+
+
+class CharErrorRate(_ErrorRateMetric):
+    """CER accumulated over batches."""
+
+    _update_fn = staticmethod(_cer_update)
+
+
+class MatchErrorRate(_ErrorRateMetric):
+    """MER accumulated over batches."""
+
+    _update_fn = staticmethod(_mer_update)
+
+
+class _WordInfoMetric(Metric):
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        hits, target_total, preds_total = _wil_wip_update(preds, target)
+        self.errors = self.errors + hits
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+
+class WordInfoPreserved(_WordInfoMetric):
+    """WIP accumulated over batches."""
+
+    higher_is_better = True
+
+    def compute(self) -> jax.Array:
+        return (self.errors / self.target_total) * (self.errors / self.preds_total)
+
+
+class WordInfoLost(_WordInfoMetric):
+    """WIL accumulated over batches."""
+
+    higher_is_better = False
+
+    def compute(self) -> jax.Array:
+        return 1.0 - (self.errors / self.target_total) * (self.errors / self.preds_total)
+
+
+class Perplexity(Metric):
+    """Perplexity over accumulated token NLL."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        total, count = _perplexity_update(preds, target, self.ignore_index)
+        self.total_log_probs = self.total_log_probs + total
+        self.count = self.count + count
+
+    def compute(self) -> jax.Array:
+        return _perplexity_compute(self.total_log_probs, self.count)
+
+
+class SQuAD(Metric):
+    """SQuAD v1 EM/F1 accumulated over batches."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        preds_dict, target_list = _squad_input_check(preds, target)
+        f1, exact_match, total = _squad_update(preds_dict, target_list)
+        self.f1_score = self.f1_score + f1
+        self.exact_match = self.exact_match + exact_match
+        self.total = self.total + total
+
+    def compute(self) -> Dict[str, jax.Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)
+
+
+__all__ = [
+    "BLEUScore",
+    "SacreBLEUScore",
+    "WordErrorRate",
+    "CharErrorRate",
+    "MatchErrorRate",
+    "WordInfoPreserved",
+    "WordInfoLost",
+    "Perplexity",
+    "SQuAD",
+]
