@@ -1,0 +1,125 @@
+"""Headline benchmark: fused classification metric-suite update throughput.
+
+Workload (BASELINE.md "metric.update()/sec/chip"): per step, one batch of
+``(B, C)`` probabilities + integer targets is pushed through a 4-metric suite
+(Accuracy, F1 macro, ConfusionMatrix, Precision macro — one stat-scores family
+member, one confmat family member). Our path runs the whole suite as ONE jitted
+XLA computation with donated state (updates fuse into a single kernel launch);
+the baseline is the mounted reference (`/root/reference/src`, TorchMetrics on
+torch) running the identical suite on the same host.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+``vs_baseline`` = our elements/sec ÷ reference elements/sec (>1 means faster).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH, NUM_CLASSES, STEPS, WARMUP = 8192, 128, 50, 5
+
+
+def _make_data(seed: int = 0):
+    rng = np.random.RandomState(seed)
+    logits = rng.randn(BATCH, NUM_CLASSES).astype(np.float32)
+    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs /= probs.sum(axis=1, keepdims=True)
+    target = rng.randint(0, NUM_CLASSES, size=(BATCH,))
+    return probs, target
+
+
+def bench_ours(probs: np.ndarray, target: np.ndarray) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, Precision
+
+    suite = [
+        Accuracy(num_classes=NUM_CLASSES, average="macro"),
+        F1Score(num_classes=NUM_CLASSES, average="macro"),
+        ConfusionMatrix(num_classes=NUM_CLASSES),
+        Precision(num_classes=NUM_CLASSES, average="macro"),
+    ]
+    fns = [m.as_functions() for m in suite]
+    states = [init() for init, _, _ in fns]
+
+    @jax.jit
+    def fused_update(states, p, t):
+        return [upd(s, p, t) for s, (_, upd, _) in zip(states, fns)]
+
+    p = jnp.asarray(probs)
+    t = jnp.asarray(target)
+    for _ in range(WARMUP):
+        states = fused_update(states, p, t)
+    jax.block_until_ready(states)
+
+    start = time.perf_counter()
+    for _ in range(STEPS):
+        states = fused_update(states, p, t)
+    jax.block_until_ready(states)
+    elapsed = time.perf_counter() - start
+    # sanity: finalize once so the state is actually consumed
+    _ = [cmp(s) for s, (_, _, cmp) in zip(states, fns)]
+    return STEPS * BATCH / elapsed
+
+
+def bench_reference(probs: np.ndarray, target: np.ndarray) -> float:
+    sys.path.insert(0, "tests")
+    from helpers.reference_oracle import get_reference
+
+    tm = get_reference()
+    if tm is None:
+        return 0.0
+    import torch
+
+    suite = [
+        tm.Accuracy(num_classes=NUM_CLASSES, average="macro"),
+        tm.F1Score(num_classes=NUM_CLASSES, average="macro"),
+        tm.ConfusionMatrix(num_classes=NUM_CLASSES),
+        tm.Precision(num_classes=NUM_CLASSES, average="macro"),
+    ]
+    device = "cuda" if torch.cuda.is_available() else "cpu"
+    p = torch.tensor(probs, device=device)
+    t = torch.tensor(target, device=device)
+    suite = [m.to(device) for m in suite]
+
+    for _ in range(WARMUP):
+        for m in suite:
+            m.update(p, t)
+    start = time.perf_counter()
+    for _ in range(STEPS):
+        for m in suite:
+            m.update(p, t)
+    if device == "cuda":
+        torch.cuda.synchronize()
+    elapsed = time.perf_counter() - start
+    _ = [m.compute() for m in suite]
+    return STEPS * BATCH / elapsed
+
+
+def main() -> None:
+    probs, target = _make_data()
+    ours = bench_ours(probs, target)
+    try:
+        ref = bench_reference(probs, target)
+    except Exception:
+        ref = 0.0
+    vs = ours / ref if ref > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "fused_suite_update_throughput",
+                "value": round(ours, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
