@@ -47,9 +47,10 @@ def bench_ours(probs: np.ndarray, target: np.ndarray) -> float:
     fns = [m.as_functions() for m in suite]
     states = [init() for init, _, _ in fns]
 
-    @jax.jit
-    def fused_update(states, p, t):
+    def _fused_update(states, p, t):
         return [upd(s, p, t) for s, (_, upd, _) in zip(states, fns)]
+
+    fused_update = jax.jit(_fused_update, donate_argnums=(0,))
 
     p = jnp.asarray(probs)
     t = jnp.asarray(target)
@@ -90,6 +91,8 @@ def bench_reference(probs: np.ndarray, target: np.ndarray) -> float:
     for _ in range(WARMUP):
         for m in suite:
             m.update(p, t)
+    if device == "cuda":
+        torch.cuda.synchronize()
     start = time.perf_counter()
     for _ in range(STEPS):
         for m in suite:

@@ -35,7 +35,7 @@ def _load_flax_model(model_name_or_path: str):
 
 
 def _default_forward(
-    sentences: List[str], tokenizer, model, max_length: int, num_layers: Optional[int]
+    sentences: List[str], tokenizer, model, max_length: int, num_layers: Optional[int], batch_size: int = 64
 ) -> Tuple[jax.Array, jax.Array, List[List[int]]]:
     enc = tokenizer(
         sentences,
@@ -44,12 +44,15 @@ def _default_forward(
         truncation=True,
         return_tensors="np",
     )
-    outputs = model(
-        input_ids=jnp.asarray(enc["input_ids"]),
-        attention_mask=jnp.asarray(enc["attention_mask"]),
-        output_hidden_states=True,
-    )
-    hidden = outputs.hidden_states[num_layers if num_layers is not None else -1]
+    hiddens = []
+    for start in range(0, len(sentences), batch_size):
+        outputs = model(
+            input_ids=jnp.asarray(enc["input_ids"][start : start + batch_size]),
+            attention_mask=jnp.asarray(enc["attention_mask"][start : start + batch_size]),
+            output_hidden_states=True,
+        )
+        hiddens.append(outputs.hidden_states[num_layers if num_layers is not None else -1])
+    hidden = jnp.concatenate(hiddens, axis=0)
     return hidden, jnp.asarray(enc["attention_mask"]), [list(ids) for ids in enc["input_ids"]]
 
 
@@ -118,6 +121,13 @@ def bert_score(
     target = [target] if isinstance(target, str) else list(target)
     if len(preds) != len(target):
         raise ValueError("Number of predicted and reference sentences must be the same!")
+    if all_layers:
+        raise NotImplementedError(
+            "`all_layers=True` is not supported; pass `num_layers` to select a single layer."
+        )
+    if (model is None) != (user_tokenizer is None):
+        # reference `functional/text/bert.py` validates the pair together
+        raise ValueError("Both `model` and `user_tokenizer` must be provided together (or neither).")
 
     if user_forward_fn is not None:
         pred_emb, pred_mask = user_forward_fn(preds)
@@ -126,8 +136,10 @@ def bert_score(
     else:
         name = model_name_or_path or "roberta-large"
         tokenizer, fx_model = (user_tokenizer, model) if model is not None else _load_flax_model(name)
-        pred_emb, pred_mask, pred_ids = _default_forward(preds, tokenizer, fx_model, max_length, num_layers)
-        target_emb, target_mask, target_ids = _default_forward(target, tokenizer, fx_model, max_length, num_layers)
+        pred_emb, pred_mask, pred_ids = _default_forward(preds, tokenizer, fx_model, max_length, num_layers, batch_size)
+        target_emb, target_mask, target_ids = _default_forward(
+            target, tokenizer, fx_model, max_length, num_layers, batch_size
+        )
 
     if idf:
         if pred_ids is None or target_ids is None:

@@ -117,6 +117,8 @@ def bleu_score(
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
     if len(preds_) != len(target_):
         raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
 
     numerator = jnp.zeros(n_gram)
     denominator = jnp.zeros(n_gram)

@@ -142,7 +142,9 @@ def chrf_score(
     tot_r_word: Dict[int, float] = defaultdict(float)
     sentence_scores: List[jax.Array] = []
 
-    for pred, targets in zip(preds_, target_):
+    for i, (pred, targets) in enumerate(zip(preds_, target_)):
+        if not targets:
+            raise ValueError(f"Expected at least one reference sentence for prediction at index {i}, got none.")
         best, hyp_char_tot, hyp_word_tot = _sentence_stats(
             pred, targets, n_char_order, n_word_order, beta, lowercase, whitespace
         )

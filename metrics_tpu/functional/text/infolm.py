@@ -120,16 +120,23 @@ def _sentence_distribution(
     temperature: float,
     max_length: int,
     idf: bool,
+    batch_size: int = 64,
 ) -> jax.Array:
     """Aggregated masked-LM distribution per sentence: each position is masked
     in turn, its predicted token distribution collected, and positions averaged
-    (idf-weighted when requested)."""
+    (idf-weighted when requested). Forwards are chunked by ``batch_size`` and
+    the position loop stops at the longest real (unpadded) sequence — padding
+    positions carry zero weight so skipping them is exact."""
     import numpy as np
 
     enc = tokenizer(sentences, padding="max_length", max_length=max_length, truncation=True, return_tensors="np")
     input_ids = enc["input_ids"]
     attention_mask = enc["attention_mask"]
-    batch, seq_len = input_ids.shape
+    batch, _ = input_ids.shape
+    # only mask positions holding a real token somewhere in the batch; correct
+    # for either tokenizer padding side, and skips always-padding positions
+    # (their weight is zero, so dropping them is exact)
+    real_positions = np.nonzero(attention_mask.any(axis=0))[0] if batch else np.zeros((0,), dtype=np.int64)
     mask_token_id = tokenizer.mask_token_id
 
     if idf:
@@ -144,18 +151,24 @@ def _sentence_distribution(
     else:
         idf_w = np.ones_like(input_ids, dtype=np.float32)
 
-    distributions = []
-    for pos in range(seq_len):
-        masked = input_ids.copy()
-        masked[:, pos] = mask_token_id
-        logits = model(input_ids=jnp.asarray(masked), attention_mask=jnp.asarray(attention_mask)).logits
-        probs = jax.nn.softmax(logits[:, pos, :] / temperature, axis=-1)
-        distributions.append(probs)
-    dist = jnp.stack(distributions, axis=1)  # (B, L, V)
+    chunks = []
+    for start in range(0, batch, batch_size):
+        ids_c = input_ids[start : start + batch_size]
+        am_c = jnp.asarray(attention_mask[start : start + batch_size])
+        distributions = []
+        for pos in real_positions:
+            masked = ids_c.copy()
+            masked[:, pos] = mask_token_id
+            logits = model(input_ids=jnp.asarray(masked), attention_mask=am_c).logits
+            probs = jax.nn.softmax(logits[:, pos, :] / temperature, axis=-1)
+            distributions.append(probs)
+        dist = jnp.stack(distributions, axis=1)  # (b, n_real_positions, V)
 
-    w = jnp.asarray(idf_w) * jnp.asarray(attention_mask, dtype=jnp.float32)
-    w = w / jnp.clip(w.sum(axis=1, keepdims=True), min=1e-12)
-    return jnp.einsum("bl,blv->bv", w, dist)
+        w = jnp.asarray(idf_w[start : start + batch_size][:, real_positions])
+        w = w * am_c[:, jnp.asarray(real_positions)].astype(jnp.float32)
+        w = w / jnp.clip(w.sum(axis=1, keepdims=True), min=1e-12)
+        chunks.append(jnp.einsum("bl,blv->bv", w, dist))
+    return jnp.concatenate(chunks, axis=0)
 
 
 def infolm(
@@ -186,11 +199,15 @@ def infolm(
 
     measure = _InformationMeasure(information_measure, alpha, beta)
     tokenizer, model = _load_mlm(model_name_or_path)
-    max_length = max_length or getattr(tokenizer, "model_max_length", 64)
-    max_length = min(max_length, 64)
+    if max_length is None:
+        # reference default: model.config.max_length (`functional/text/infolm.py`);
+        # cap the tokenizer fallback, which can be a sentinel like 1e30
+        max_length = getattr(model.config, "max_length", None) or min(
+            getattr(tokenizer, "model_max_length", 512) or 512, 512
+        )
 
-    preds_distribution = _sentence_distribution(preds, tokenizer, model, temperature, max_length, idf)
-    target_distribution = _sentence_distribution(target, tokenizer, model, temperature, max_length, idf)
+    preds_distribution = _sentence_distribution(preds, tokenizer, model, temperature, max_length, idf, batch_size)
+    target_distribution = _sentence_distribution(target, tokenizer, model, temperature, max_length, idf, batch_size)
     scores = measure(preds_distribution, target_distribution)
     if return_sentence_level_score:
         return scores.mean(), scores

@@ -120,6 +120,8 @@ def sacre_bleu_score(
     """
     if len(preds) != len(target):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
 
     tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
     numerator = jnp.zeros(n_gram)

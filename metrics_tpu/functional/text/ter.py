@@ -243,6 +243,8 @@ def _ter_update(
     if isinstance(preds, str):
         preds = [preds]
     target = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
     for pred, tgts in zip(preds, target):
         tgt_words_ = [tokenizer(str(t).rstrip()).split() for t in tgts]
         pred_words_ = tokenizer(str(pred).rstrip()).split()

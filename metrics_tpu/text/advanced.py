@@ -19,7 +19,32 @@ from metrics_tpu.functional.text.rouge import (
 )
 from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.data import (
+    dim_zero_cat,
+    pack_string_groups,
+    pack_strings,
+    unpack_string_groups,
+    unpack_strings,
+)
+
+
+def _packed_bytes(state):
+    """Concatenate a packed-string "cat" state; after sync it is a single array."""
+    import numpy as np
+
+    if isinstance(state, (list, tuple)):
+        if not state:
+            return np.zeros((0,), dtype=np.uint8)
+        return np.concatenate([np.asarray(a, dtype=np.uint8) for a in state])
+    return np.asarray(state, dtype=np.uint8)
+
+
+def _cat_packed(state) -> List[str]:
+    return unpack_strings(_packed_bytes(state))
+
+
+def _cat_packed_groups(state) -> List[List[str]]:
+    return unpack_string_groups(_packed_bytes(state))
 
 
 class ROUGEScore(Metric):
@@ -84,11 +109,13 @@ class ROUGEScore(Metric):
 
 
 class CHRFScore(Metric):
-    """Corpus chrF/chrF++; state is the list of raw sentence pairs.
+    """Corpus chrF/chrF++; state is the packed list of raw sentence pairs.
 
     The reference keeps aggregate n-gram count dict states (`text/chrf.py`);
-    here the per-pair strings accumulate host-side and the corpus statistics
-    are recomputed at ``compute`` — identical result, simpler sync story.
+    here the per-pair sentences accumulate as **packed uint8 "cat" states**
+    (:func:`~metrics_tpu.utils.data.pack_strings`) so the standard cross-device
+    gather protocol syncs them, and the corpus statistics are recomputed at
+    ``compute`` — identical result, first-class distributed story.
     """
 
     is_differentiable = False
@@ -112,19 +139,21 @@ class CHRFScore(Metric):
         self.lowercase = lowercase
         self.whitespace = whitespace
         self.return_sentence_level_score = return_sentence_level_score
-        self._preds: List[str] = []
-        self._target: List[List[str]] = []
+        self.add_state("preds_packed", [], dist_reduce_fx="cat")
+        self.add_state("target_packed", [], dist_reduce_fx="cat")
 
     def update(self, preds, target) -> None:
         preds_ = [preds] if isinstance(preds, str) else list(preds)
         target_ = [[t] if isinstance(t, str) else list(t) for t in target]
-        self._preds.extend(preds_)
-        self._target.extend(target_)
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        self.preds_packed.append(pack_strings(preds_))
+        self.target_packed.append(pack_string_groups(target_))
 
     def compute(self):
         return chrf_score(
-            self._preds,
-            self._target,
+            _cat_packed(self.preds_packed),
+            _cat_packed_groups(self.target_packed),
             self.n_char_order,
             self.n_word_order,
             self.beta,
@@ -132,11 +161,6 @@ class CHRFScore(Metric):
             self.whitespace,
             self.return_sentence_level_score,
         )
-
-    def reset(self) -> None:
-        super().reset()
-        self._preds = []
-        self._target = []
 
 
 class TranslationEditRate(Metric):
@@ -239,6 +263,7 @@ class BERTScore(Metric):
         idf: bool = False,
         user_forward_fn: Optional[Any] = None,
         max_length: int = 128,
+        batch_size: int = 64,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -247,34 +272,31 @@ class BERTScore(Metric):
         self.idf = idf
         self.user_forward_fn = user_forward_fn
         self.max_length = max_length
-        self._preds: List[str] = []
-        self._target: List[str] = []
+        self.batch_size = batch_size
+        self.add_state("preds_packed", [], dist_reduce_fx="cat")
+        self.add_state("target_packed", [], dist_reduce_fx="cat")
 
     def update(self, preds, target) -> None:
         preds_ = [preds] if isinstance(preds, str) else list(preds)
         target_ = [target] if isinstance(target, str) else list(target)
         if len(preds_) != len(target_):
             raise ValueError("Number of predicted and reference sentences must be the same!")
-        self._preds.extend(preds_)
-        self._target.extend(target_)
+        self.preds_packed.append(pack_strings(preds_))
+        self.target_packed.append(pack_strings(target_))
 
     def compute(self) -> Dict[str, List[float]]:
         from metrics_tpu.functional.text.bert import bert_score
 
         return bert_score(
-            self._preds,
-            self._target,
+            _cat_packed(self.preds_packed),
+            _cat_packed(self.target_packed),
             model_name_or_path=self.model_name_or_path,
             num_layers=self.num_layers,
             idf=self.idf,
             user_forward_fn=self.user_forward_fn,
             max_length=self.max_length,
+            batch_size=self.batch_size,
         )
-
-    def reset(self) -> None:
-        super().reset()
-        self._preds = []
-        self._target = []
 
 
 class InfoLM(Metric):
@@ -293,6 +315,7 @@ class InfoLM(Metric):
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
         max_length: Optional[int] = None,
+        batch_size: int = 64,
         return_sentence_level_score: bool = False,
         **kwargs: Any,
     ) -> None:
@@ -304,22 +327,25 @@ class InfoLM(Metric):
         self.alpha = alpha
         self.beta = beta
         self.max_length = max_length
+        self.batch_size = batch_size
         self.return_sentence_level_score = return_sentence_level_score
-        self._preds: List[str] = []
-        self._target: List[str] = []
+        self.add_state("preds_packed", [], dist_reduce_fx="cat")
+        self.add_state("target_packed", [], dist_reduce_fx="cat")
 
     def update(self, preds, target) -> None:
         preds_ = [preds] if isinstance(preds, str) else list(preds)
         target_ = [target] if isinstance(target, str) else list(target)
-        self._preds.extend(preds_)
-        self._target.extend(target_)
+        if len(preds_) != len(target_):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        self.preds_packed.append(pack_strings(preds_))
+        self.target_packed.append(pack_strings(target_))
 
     def compute(self):
         from metrics_tpu.functional.text.infolm import infolm
 
         return infolm(
-            self._preds,
-            self._target,
+            _cat_packed(self.preds_packed),
+            _cat_packed(self.target_packed),
             model_name_or_path=self.model_name_or_path,
             temperature=self.temperature,
             information_measure=self.information_measure,
@@ -327,13 +353,9 @@ class InfoLM(Metric):
             alpha=self.alpha,
             beta=self.beta,
             max_length=self.max_length,
+            batch_size=self.batch_size,
             return_sentence_level_score=self.return_sentence_level_score,
         )
-
-    def reset(self) -> None:
-        super().reset()
-        self._preds = []
-        self._target = []
 
 
 __all__ = ["ROUGEScore", "CHRFScore", "TranslationEditRate", "ExtendedEditDistance", "BERTScore", "InfoLM"]

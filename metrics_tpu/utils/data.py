@@ -18,6 +18,7 @@ from collections.abc import Mapping, Sequence
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax import Array
 
@@ -155,6 +156,47 @@ def allclose(x: Array, y: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool
     return bool(jnp.allclose(x, y, rtol=rtol, atol=atol))
 
 
+# --------------------------------------------------------------- string states
+# Text metrics accumulate sentences. To make them first-class syncable metric
+# states (reference keeps python lists the sync engine can't see for chrf/bert),
+# strings are packed into 1-D uint8 arrays using the bytes 0xFF (record
+# separator) and 0xFE (group separator) — both invalid in UTF-8, so they can
+# never collide with content. Packed arrays are closed under concatenation:
+# cat(pack(a), pack(b)) == pack(a + b), which is exactly the "cat" state
+# contract the cross-device gather protocol needs.
+_REC_SEP = 0xFF
+_GRP_SEP = 0xFE
+
+
+def pack_strings(strings: Sequence[str]) -> np.ndarray:
+    data = bytearray()
+    for s in strings:
+        data += s.encode("utf-8") + bytes([_REC_SEP])
+    return np.frombuffer(bytes(data), dtype=np.uint8)
+
+
+def unpack_strings(arr: Array) -> List[str]:
+    b = bytes(bytearray(np.asarray(arr, dtype=np.uint8)))
+    return [chunk.decode("utf-8") for chunk in b.split(bytes([_REC_SEP]))[:-1]]
+
+
+def pack_string_groups(groups: Sequence[Sequence[str]]) -> np.ndarray:
+    data = bytearray()
+    for group in groups:
+        for s in group:
+            data += s.encode("utf-8") + bytes([_REC_SEP])
+        data += bytes([_GRP_SEP])
+    return np.frombuffer(bytes(data), dtype=np.uint8)
+
+
+def unpack_string_groups(arr: Array) -> List[List[str]]:
+    b = bytes(bytearray(np.asarray(arr, dtype=np.uint8)))
+    return [
+        [chunk.decode("utf-8") for chunk in group.split(bytes([_REC_SEP]))[:-1]]
+        for group in b.split(bytes([_GRP_SEP]))[:-1]
+    ]
+
+
 __all__ = [
     "dim_zero_cat",
     "dim_zero_sum",
@@ -167,4 +209,8 @@ __all__ = [
     "apply_to_collection",
     "get_group_indexes",
     "allclose",
+    "pack_strings",
+    "unpack_strings",
+    "pack_string_groups",
+    "unpack_string_groups",
 ]

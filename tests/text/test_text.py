@@ -322,3 +322,53 @@ class TestBertInfoLM:
             _InformationMeasure("alpha_divergence", alpha=1.0)
         with pytest.raises(ValueError):
             _InformationMeasure("not_a_measure")
+
+
+class TestPackedStringSync:
+    """CHRF/BERTScore/InfoLM sentence states must survive the cross-rank gather
+    (review finding: plain-attribute string lists were invisible to sync)."""
+
+    def test_chrf_two_rank_sync_matches_single_corpus(self):
+        from tests.helpers.testers import _FakeGather
+
+        from metrics_tpu import CHRFScore
+
+        preds = ["the cat is on the mat", "a dog runs fast", "hello world", "jax on tpu"]
+        targets = [["there is a cat on the mat"], ["the dog runs quickly"], ["hello there world"], ["jax runs on tpu"]]
+
+        ranks = [CHRFScore(), CHRFScore()]
+        ranks[0].update(preds[:2], targets[:2])
+        ranks[1].update(preds[2:], targets[2:])
+        gather = _FakeGather(ranks)
+        synced = ranks[0]
+        synced.sync(dist_sync_fn=gather, distributed_available=lambda: True)
+        two_rank = synced.compute.__wrapped__()
+        synced.unsync()
+
+        full = CHRFScore()
+        full.update(preds, targets)
+        np.testing.assert_allclose(np.asarray(two_rank), np.asarray(full.compute()), atol=1e-6)
+
+    def test_chrf_empty_reference_raises(self):
+        from metrics_tpu.functional.text.chrf import chrf_score
+
+        with pytest.raises(ValueError, match="at least one reference"):
+            chrf_score(["a"], [[]])
+
+    def test_bleu_weights_length_mismatch_raises(self):
+        from metrics_tpu.functional.text.bleu import bleu_score
+
+        with pytest.raises(ValueError, match="weights"):
+            bleu_score(["the cat"], [["the cat"]], n_gram=4, weights=[0.5, 0.5])
+
+    def test_ter_corpus_size_mismatch_raises(self):
+        from metrics_tpu.functional.text.ter import translation_edit_rate
+
+        with pytest.raises(ValueError, match="Corpus has different size"):
+            translation_edit_rate(["pred a", "pred b"], [["ref a"]])
+
+    def test_bert_score_model_without_tokenizer_raises(self):
+        from metrics_tpu.functional.text.bert import bert_score
+
+        with pytest.raises(ValueError, match="user_tokenizer"):
+            bert_score(["a"], ["a"], model=object())
