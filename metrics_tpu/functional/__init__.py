@@ -4,6 +4,8 @@ Parity target: reference `src/torchmetrics/functional/__init__.py` (78 exports).
 """
 from metrics_tpu.functional.classification import *  # noqa: F401,F403
 from metrics_tpu.functional.classification import __all__ as _classification_all
+from metrics_tpu.functional.image import *  # noqa: F401,F403
+from metrics_tpu.functional.image import __all__ as _image_all
 from metrics_tpu.functional.pairwise import *  # noqa: F401,F403
 from metrics_tpu.functional.pairwise import __all__ as _pairwise_all
 from metrics_tpu.functional.regression import *  # noqa: F401,F403
@@ -15,6 +17,7 @@ from metrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = (
     list(_classification_all)
+    + list(_image_all)
     + list(_pairwise_all)
     + list(_regression_all)
     + list(_retrieval_all)

@@ -1,0 +1,27 @@
+"""Functional image metrics (L2).
+
+Parity target: reference `src/torchmetrics/functional/image/`.
+"""
+from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+from metrics_tpu.functional.image.spectral import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    universal_image_quality_index,
+)
+from metrics_tpu.functional.image.ssim import (
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+
+__all__ = [
+    "peak_signal_noise_ratio",
+    "structural_similarity_index_measure",
+    "multiscale_structural_similarity_index_measure",
+    "universal_image_quality_index",
+    "error_relative_global_dimensionless_synthesis",
+    "spectral_angle_mapper",
+    "spectral_distortion_index",
+    "image_gradients",
+]

@@ -177,10 +177,10 @@ def multiscale_structural_similarity_index_measure(
     Example:
         >>> import jax
         >>> from metrics_tpu.functional import multiscale_structural_similarity_index_measure
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 128, 128))
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 192, 192))
         >>> target = preds * 0.75
-        >>> multiscale_structural_similarity_index_measure(preds, target, data_range=1.0).round(4)
-        Array(0.9628, dtype=float32)
+        >>> multiscale_structural_similarity_index_measure(preds, target, data_range=1.0).round(2)
+        Array(0.96, dtype=float32)
     """
     preds, target = _ssim_check_inputs(preds, target)
     if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):

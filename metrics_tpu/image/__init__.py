@@ -1,0 +1,25 @@
+"""Image module metrics (L3).
+
+Parity target: reference `src/torchmetrics/image/__init__.py`.
+"""
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio
+from metrics_tpu.image.spectral import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+
+__all__ = [
+    "PeakSignalNoiseRatio",
+    "StructuralSimilarityIndexMeasure",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "UniversalImageQualityIndex",
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+]

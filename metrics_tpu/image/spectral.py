@@ -1,0 +1,183 @@
+"""UQI / ERGAS / SAM / D-lambda module metrics.
+
+Parity: reference `image/{uqi,ergas,sam,d_lambda}.py` — each keeps raw
+preds/target as "cat" list states and applies the functional kernel at
+compute time.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+
+from metrics_tpu.functional.image.spectral import (
+    _image_update,
+    error_relative_global_dimensionless_synthesis,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    universal_image_quality_index,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class _CatImageMetric(Metric):
+    """Shared cat-state plumbing for image metrics that buffer raw inputs."""
+
+    _input_check = staticmethod(_image_update)
+    _warn_name: str = ""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        rank_zero_warn(
+            f"Metric `{self._warn_name or type(self).__name__}` will save all targets and"
+            " predictions in buffer. For large datasets this may lead"
+            " to large memory footprint."
+        )
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        preds, target = self._input_check(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _cat_states(self):
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+
+class UniversalImageQualityIndex(_CatImageMetric):
+    """UQI (SSIM without stabilizing constants).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import UniversalImageQualityIndex
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> uqi = UniversalImageQualityIndex()
+        >>> uqi(preds, target).round(4)
+        Array(0.9216, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.data_range = data_range
+
+    def compute(self) -> jax.Array:
+        preds, target = self._cat_states()
+        return universal_image_quality_index(
+            preds, target, self.kernel_size, self.sigma, self.reduction, self.data_range
+        )
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(_CatImageMetric):
+    """ERGAS for pan-sharpening quality.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> ergas = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> ergas(preds, target).round(0)
+        Array(154., dtype=float32)
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        ratio: Union[int, float] = 4,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+
+    def compute(self) -> jax.Array:
+        preds, target = self._cat_states()
+        return error_relative_global_dimensionless_synthesis(preds, target, self.ratio, self.reduction)
+
+
+class SpectralAngleMapper(_CatImageMetric):
+    """Mean spectral angle between band vectors.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import SpectralAngleMapper
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (8, 3, 16, 16))
+        >>> sam = SpectralAngleMapper()
+        >>> sam(preds, target).round(2)
+        Array(0.58, dtype=float32)
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+    full_state_update = False
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+
+    def compute(self) -> jax.Array:
+        preds, target = self._cat_states()
+        return spectral_angle_mapper(preds, target, self.reduction)
+
+
+class SpectralDistortionIndex(_CatImageMetric):
+    """D-lambda spectral distortion between band-pair UQI matrices.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import SpectralDistortionIndex
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (8, 3, 16, 16))
+        >>> sdi = SpectralDistortionIndex()
+        >>> float(sdi(preds, target)) > 0
+        True
+    """
+
+    higher_is_better = True
+    is_differentiable = True
+    full_state_update = False
+
+    def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        allowed_reduction = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+    def compute(self) -> jax.Array:
+        preds, target = self._cat_states()
+        return spectral_distortion_index(preds, target, self.p, self.reduction)
+
+
+__all__ = [
+    "UniversalImageQualityIndex",
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+]

@@ -1,0 +1,272 @@
+"""Image metrics — differential tests against the mounted reference implementation."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.functional import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    structural_similarity_index_measure,
+    universal_image_quality_index,
+)
+from tests.helpers.reference_oracle import get_reference
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+_ref = get_reference()
+needs_ref = pytest.mark.skipif(_ref is None, reason="reference implementation not importable")
+
+_rng = np.random.RandomState(7)
+# positive-valued images so ERGAS/MSLE-style ratios are well-behaved
+_preds = jnp.asarray(_rng.rand(NUM_BATCHES, 4, 3, 32, 32).astype(np.float32)) * 0.8 + 0.1
+_target = jnp.asarray(_rng.rand(NUM_BATCHES, 4, 3, 32, 32).astype(np.float32)) * 0.8 + 0.1
+# MS-SSIM with kernel 11 and 5 betas needs height/width > 160
+_preds_big = jnp.asarray(_rng.rand(NUM_BATCHES, 2, 1, 192, 192).astype(np.float32))
+_target_big = jnp.asarray(_rng.rand(NUM_BATCHES, 2, 1, 192, 192).astype(np.float32))
+
+
+def _torch(fn, **fixed):
+    import torch
+
+    def wrapped(preds, target):
+        return fn(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **fixed).numpy()
+
+    return wrapped
+
+
+@needs_ref
+class TestPSNR(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _preds,
+            _target,
+            peak_signal_noise_ratio,
+            _torch(_ref.functional.peak_signal_noise_ratio, data_range=1.0),
+            metric_args={"data_range": 1.0},
+        )
+
+    def test_functional_data_range_from_data(self):
+        self.run_functional_metric_test(
+            _preds, _target, peak_signal_noise_ratio, _torch(_ref.functional.peak_signal_noise_ratio)
+        )
+
+    def test_functional_dim(self):
+        self.run_functional_metric_test(
+            _preds,
+            _target,
+            peak_signal_noise_ratio,
+            _torch(_ref.functional.peak_signal_noise_ratio, data_range=1.0, dim=(1, 2, 3)),
+            metric_args={"data_range": 1.0, "dim": (1, 2, 3)},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            _preds,
+            _target,
+            PeakSignalNoiseRatio,
+            _torch(_ref.functional.peak_signal_noise_ratio, data_range=1.0),
+            metric_args={"data_range": 1.0},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_tracked_range(self, ddp):
+        # data_range inferred from observed target min/max (incl. the 0.0 init quirk)
+        def ref(preds, target):
+            import torch
+
+            p, t = torch.from_numpy(preds), torch.from_numpy(target)
+            data_range = max(float(t.max()), 0.0) - min(float(t.min()), 0.0)
+            return _ref.functional.peak_signal_noise_ratio(p, t, data_range=data_range).numpy()
+
+        self.run_class_metric_test(
+            _preds, _target, PeakSignalNoiseRatio, ref, ddp=ddp, check_batch=False, atol=1e-4
+        )
+
+    def test_spmd(self):
+        self.run_spmd_test(
+            _preds,
+            _target,
+            PeakSignalNoiseRatio,
+            _torch(_ref.functional.peak_signal_noise_ratio, data_range=1.0),
+            metric_args={"data_range": 1.0},
+        )
+
+
+@needs_ref
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("gaussian_kernel", [True, False])
+    def test_functional(self, gaussian_kernel):
+        self.run_functional_metric_test(
+            _preds,
+            _target,
+            structural_similarity_index_measure,
+            _torch(
+                _ref.functional.structural_similarity_index_measure,
+                data_range=1.0,
+                gaussian_kernel=gaussian_kernel,
+            ),
+            metric_args={"data_range": 1.0, "gaussian_kernel": gaussian_kernel},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            _preds,
+            _target,
+            StructuralSimilarityIndexMeasure,
+            _torch(_ref.functional.structural_similarity_index_measure, data_range=1.0),
+            metric_args={"data_range": 1.0},
+            ddp=ddp,
+        )
+
+    def test_3d_volumes(self):
+        preds = jnp.asarray(_rng.rand(2, 1, 8, 8, 8).astype(np.float32))
+        target = jnp.asarray(_rng.rand(2, 1, 8, 8, 8).astype(np.float32))
+        import torch
+
+        ref = _ref.functional.structural_similarity_index_measure(
+            torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), data_range=1.0
+        ).numpy()
+        got = structural_similarity_index_measure(preds, target, data_range=1.0)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
+
+
+@needs_ref
+class TestMSSSIM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _preds_big,
+            _target_big,
+            multiscale_structural_similarity_index_measure,
+            _torch(_ref.functional.multiscale_structural_similarity_index_measure, data_range=1.0),
+            metric_args={"data_range": 1.0},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            _preds_big,
+            _target_big,
+            MultiScaleStructuralSimilarityIndexMeasure,
+            _torch(_ref.functional.multiscale_structural_similarity_index_measure, data_range=1.0),
+            metric_args={"data_range": 1.0},
+            ddp=ddp,
+        )
+
+
+@needs_ref
+class TestUQI(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, universal_image_quality_index, _torch(_ref.functional.universal_image_quality_index)
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            _preds,
+            _target,
+            UniversalImageQualityIndex,
+            _torch(_ref.functional.universal_image_quality_index),
+            ddp=ddp,
+        )
+
+
+@needs_ref
+class TestERGAS(MetricTester):
+    atol = 1e-2  # relative magnitudes ~100; fp32 accumulation differences
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _preds,
+            _target,
+            error_relative_global_dimensionless_synthesis,
+            _torch(_ref.functional.error_relative_global_dimensionless_synthesis),
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            _preds,
+            _target,
+            ErrorRelativeGlobalDimensionlessSynthesis,
+            _torch(_ref.functional.error_relative_global_dimensionless_synthesis),
+            ddp=ddp,
+        )
+
+
+@needs_ref
+class TestSAM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, spectral_angle_mapper, _torch(_ref.functional.spectral_angle_mapper)
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            _preds, _target, SpectralAngleMapper, _torch(_ref.functional.spectral_angle_mapper), ddp=ddp
+        )
+
+
+@needs_ref
+class TestDLambda(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _preds, _target, spectral_distortion_index, _torch(_ref.functional.spectral_distortion_index)
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            _preds, _target, SpectralDistortionIndex, _torch(_ref.functional.spectral_distortion_index), ddp=ddp
+        )
+
+
+@needs_ref
+def test_image_gradients():
+    import torch
+
+    img = _rng.rand(2, 3, 16, 16).astype(np.float32)
+    ref_dy, ref_dx = _ref.functional.image_gradients(torch.from_numpy(img))
+    dy, dx = image_gradients(jnp.asarray(img))
+    np.testing.assert_allclose(np.asarray(dy), ref_dy.numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx), ref_dx.numpy(), atol=1e-6)
+
+
+def test_psnr_dim_requires_data_range():
+    with pytest.raises(ValueError, match="data_range"):
+        PeakSignalNoiseRatio(dim=1)
+
+
+def test_ssim_invalid_ndim():
+    with pytest.raises(ValueError, match="BxCxHxW"):
+        structural_similarity_index_measure(jnp.zeros((4, 4)), jnp.zeros((4, 4)))
