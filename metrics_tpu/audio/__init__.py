@@ -1,0 +1,23 @@
+"""Audio module metrics (L3).
+
+Parity target: reference `src/torchmetrics/audio/__init__.py`.
+"""
+from metrics_tpu.audio.metrics import (
+    PermutationInvariantTraining,
+    PerceptualEvaluationSpeechQuality,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    ShortTimeObjectiveIntelligibility,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+
+__all__ = [
+    "SignalNoiseRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "SignalDistortionRatio",
+    "ScaleInvariantSignalDistortionRatio",
+    "PermutationInvariantTraining",
+    "PerceptualEvaluationSpeechQuality",
+    "ShortTimeObjectiveIntelligibility",
+]

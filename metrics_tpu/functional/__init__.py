@@ -2,6 +2,8 @@
 
 Parity target: reference `src/torchmetrics/functional/__init__.py` (78 exports).
 """
+from metrics_tpu.functional.audio import *  # noqa: F401,F403
+from metrics_tpu.functional.audio import __all__ as _audio_all
 from metrics_tpu.functional.classification import *  # noqa: F401,F403
 from metrics_tpu.functional.classification import __all__ as _classification_all
 from metrics_tpu.functional.image import *  # noqa: F401,F403
@@ -16,7 +18,8 @@ from metrics_tpu.functional.text import *  # noqa: F401,F403
 from metrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = (
-    list(_classification_all)
+    list(_audio_all)
+    + list(_classification_all)
     + list(_image_all)
     + list(_pairwise_all)
     + list(_regression_all)

@@ -1,0 +1,93 @@
+"""PESQ / STOI — host-side wrappers around the standards-locked C/DSP packages.
+
+Parity: reference `functional/audio/{pesq,stoi}.py` — both round-trip through
+numpy there too (the backends are reference implementations of ITU-T P.862 and
+the Taal et al. STOI algorithm; re-deriving them would break standard
+compliance). Inputs are pulled to host, scored per-clip, and returned as a
+device array.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+__doctest_skip__ = ["perceptual_evaluation_speech_quality", "short_time_objective_intelligibility"]
+
+
+def perceptual_evaluation_speech_quality(
+    preds: jax.Array, target: jax.Array, fs: int, mode: str, keep_same_device: bool = False
+) -> jax.Array:
+    """PESQ via the ``pesq`` package (ITU-T P.862).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import perceptual_evaluation_speech_quality
+        >>> preds = jnp.zeros(8000)
+        >>> perceptual_evaluation_speech_quality(preds, preds, 8000, 'nb')  # doctest: +SKIP
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Install it with `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    _check_same_shape(preds, target)
+
+    if preds.ndim == 1:
+        pesq_val_np = pesq_backend.pesq(fs, np.asarray(target), np.asarray(preds), mode)
+        pesq_val = jnp.asarray(pesq_val_np, dtype=jnp.float32)
+    else:
+        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+        pesq_val_np = np.empty(preds_np.shape[0])
+        for b in range(preds_np.shape[0]):
+            pesq_val_np[b] = pesq_backend.pesq(fs, target_np[b, :], preds_np[b, :], mode)
+        pesq_val = jnp.asarray(pesq_val_np.astype(np.float32)).reshape(preds.shape[:-1])
+    if keep_same_device:
+        pesq_val = jax.device_put(pesq_val, next(iter(preds.devices())))
+    return pesq_val
+
+
+def short_time_objective_intelligibility(
+    preds: jax.Array, target: jax.Array, fs: int, extended: bool = False, keep_same_device: bool = False
+) -> jax.Array:
+    """STOI via the ``pystoi`` package (Taal et al. 2010).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import short_time_objective_intelligibility
+        >>> preds = jnp.zeros(8000)
+        >>> short_time_objective_intelligibility(preds, preds, 8000)  # doctest: +SKIP
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that pystoi is installed. Install it with `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    _check_same_shape(preds, target)
+
+    if preds.ndim == 1:
+        stoi_val_np = stoi_backend(np.asarray(target), np.asarray(preds), fs, extended)
+        stoi_val = jnp.asarray(stoi_val_np, dtype=jnp.float32)
+    else:
+        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+        stoi_val_np = np.empty(preds_np.shape[0])
+        for b in range(preds_np.shape[0]):
+            stoi_val_np[b] = stoi_backend(target_np[b, :], preds_np[b, :], fs, extended)
+        stoi_val = jnp.asarray(stoi_val_np.astype(np.float32)).reshape(preds.shape[:-1])
+    if keep_same_device:
+        stoi_val = jax.device_put(stoi_val, next(iter(preds.devices())))
+    return stoi_val
+
+
+__all__ = ["perceptual_evaluation_speech_quality", "short_time_objective_intelligibility"]

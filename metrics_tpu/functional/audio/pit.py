@@ -1,0 +1,99 @@
+"""Permutation-invariant training (PIT).
+
+Parity: reference `functional/audio/pit.py:28-190` — pairwise metric matrix
+over speaker pairs, then the best target→prediction assignment.
+
+TPU-first design: the assignment is solved by exhaustive evaluation of all
+permutations as one gather + reduce (jittable, exact — identical optimum to
+the reference's scipy ``linear_sum_assignment`` path). The permutation table
+is a trace-time constant, so the whole search compiles to a single fused
+gather/argmax; for very large speaker counts a host-side Hungarian fallback
+kicks in (non-jit path), mirroring the reference's scipy fallback.
+"""
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
+
+# beyond this, 8!+ permutations make the exhaustive gather unreasonable
+_MAX_EXHAUSTIVE_SPK = 7
+
+
+def _find_best_perm_exhaustive(
+    metric_mtx: jax.Array, maximize: bool
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact assignment by evaluating every permutation in one gather."""
+    spk_num = metric_mtx.shape[-1]
+    ps = jnp.asarray(list(permutations(range(spk_num))))  # [perm_num, spk]
+    # metric_of_ps[b, p] = mean_i mtx[b, i, ps[p, i]]
+    gathered = metric_mtx[..., jnp.arange(spk_num)[None, :], ps]  # [batch, perm_num, spk]
+    metric_of_ps = gathered.mean(axis=-1)
+    best_idx = jnp.argmax(metric_of_ps, axis=-1) if maximize else jnp.argmin(metric_of_ps, axis=-1)
+    best_metric = jnp.take_along_axis(metric_of_ps, best_idx[..., None], axis=-1)[..., 0]
+    best_perm = ps[best_idx]
+    return best_metric, best_perm
+
+
+def _find_best_perm_lsa(metric_mtx: jax.Array, maximize: bool) -> Tuple[jax.Array, jax.Array]:
+    """Host-side Hungarian solve for large speaker counts (reference `pit.py:28-48`)."""
+    import numpy as np
+    from scipy.optimize import linear_sum_assignment
+
+    mtx = np.asarray(metric_mtx)
+    best_perm = jnp.asarray([linear_sum_assignment(m, maximize)[1] for m in mtx])
+    best_metric = jnp.take_along_axis(metric_mtx, best_perm[:, :, None], axis=2).mean(axis=(-1, -2))
+    return best_metric, best_perm
+
+
+def permutation_invariant_training(
+    preds: jax.Array, target: jax.Array, metric_func: Callable, eval_func: str = "max", **kwargs: Any
+) -> Tuple[jax.Array, jax.Array]:
+    """Best-permutation metric over speaker assignments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import (
+        ...     permutation_invariant_training, scale_invariant_signal_distortion_ratio)
+        >>> preds = jnp.asarray([[[-0.0579,  0.3560, -0.9604], [-0.1719,  0.3205,  0.2951]]])
+        >>> target = jnp.asarray([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
+        >>> best_metric, best_perm = permutation_invariant_training(
+        ...     preds, target, scale_invariant_signal_distortion_ratio, 'max')
+        >>> round(float(best_metric[0]), 3)
+        -5.109
+        >>> best_perm
+        Array([[0, 1]], dtype=int32)
+    """
+    if preds.shape[0:2] != target.shape[0:2]:
+        raise RuntimeError(
+            "Predictions and targets are expected to have the same shape at the batch and speaker dimensions"
+        )
+    if eval_func not in ("max", "min"):
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if target.ndim < 2:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
+
+    spk_num = target.shape[1]
+    # metric matrix [batch, target_idx, preds_idx]; loops are static (unrolled at trace)
+    rows = []
+    for target_idx in range(spk_num):
+        row = [metric_func(preds[:, preds_idx, ...], target[:, target_idx, ...], **kwargs) for preds_idx in range(spk_num)]
+        rows.append(jnp.stack(row, axis=-1))
+    metric_mtx = jnp.stack(rows, axis=-2)
+
+    maximize = eval_func == "max"
+    if spk_num <= _MAX_EXHAUSTIVE_SPK or not _SCIPY_AVAILABLE:
+        return _find_best_perm_exhaustive(metric_mtx, maximize)
+    return _find_best_perm_lsa(metric_mtx, maximize)
+
+
+def pit_permutate(preds: jax.Array, perm: jax.Array) -> jax.Array:
+    """Reorder ``preds`` speakers according to ``perm`` (reference `pit.py:193-216`)."""
+    return jnp.take_along_axis(preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)), axis=1)
+
+
+__all__ = ["permutation_invariant_training", "pit_permutate"]

@@ -1,0 +1,81 @@
+"""SNR family: closed-form energy ratios.
+
+Parity: reference `functional/audio/snr.py:22-100` (SNR, SI-SNR) and
+`functional/audio/sdr.py:239-279` (SI-SDR). Pure elementwise + last-axis
+reductions — fully jittable and batch-shardable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def signal_noise_ratio(preds: jax.Array, target: jax.Array, zero_mean: bool = False) -> jax.Array:
+    """SNR = 10·log10(‖target‖² / ‖target − preds‖²) over the last (time) axis.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(signal_noise_ratio(preds, target)), 2)
+        16.18
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_distortion_ratio(
+    preds: jax.Array, target: jax.Array, zero_mean: bool = False
+) -> jax.Array:
+    """SI-SDR: SNR after projecting preds onto the target direction.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import scale_invariant_signal_distortion_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> scale_invariant_signal_distortion_ratio(preds, target).round(4)
+        Array(18.403, dtype=float32)
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(val)
+
+
+def scale_invariant_signal_noise_ratio(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """SI-SNR = SI-SDR with zero-mean normalization.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> scale_invariant_signal_noise_ratio(preds, target).round(4)
+        Array(15.0918, dtype=float32)
+    """
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+__all__ = [
+    "signal_noise_ratio",
+    "scale_invariant_signal_noise_ratio",
+    "scale_invariant_signal_distortion_ratio",
+]

@@ -11,40 +11,12 @@ from typing import Any, Optional, Sequence, Union
 import jax
 
 from metrics_tpu.functional.image.spectral import (
-    _image_update,
     error_relative_global_dimensionless_synthesis,
     spectral_angle_mapper,
     spectral_distortion_index,
     universal_image_quality_index,
 )
-from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
-from metrics_tpu.utils.prints import rank_zero_warn
-
-
-class _CatImageMetric(Metric):
-    """Shared cat-state plumbing for image metrics that buffer raw inputs."""
-
-    _input_check = staticmethod(_image_update)
-    _warn_name: str = ""
-
-    def __init__(self, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
-        rank_zero_warn(
-            f"Metric `{self._warn_name or type(self).__name__}` will save all targets and"
-            " predictions in buffer. For large datasets this may lead"
-            " to large memory footprint."
-        )
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
-
-    def update(self, preds: jax.Array, target: jax.Array) -> None:
-        preds, target = self._input_check(preds, target)
-        self.preds.append(preds)
-        self.target.append(target)
-
-    def _cat_states(self):
-        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+from metrics_tpu.image.base import _CatImageMetric
 
 
 class UniversalImageQualityIndex(_CatImageMetric):

@@ -17,7 +17,7 @@ from metrics_tpu.functional.image.ssim import (
     _ssim_compute,
     multiscale_structural_similarity_index_measure,
 )
-from metrics_tpu.image.spectral import _CatImageMetric
+from metrics_tpu.image.base import _CatImageMetric
 
 
 class StructuralSimilarityIndexMeasure(_CatImageMetric):
