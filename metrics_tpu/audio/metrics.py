@@ -179,7 +179,8 @@ class PermutationInvariantTraining(_MeanAudioMetric):
 
     full_state_update = False
     is_differentiable = True
-    higher_is_better = True
+    # direction depends on eval_func, so no fixed polarity (reference `audio/pit.py:64-67`)
+    higher_is_better = None
     _state_name = "sum_pit_metric"
 
     def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:

@@ -1,0 +1,7 @@
+"""Detection module metrics (L3).
+
+Parity target: reference `src/torchmetrics/detection/__init__.py`.
+"""
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision
+
+__all__ = ["MeanAveragePrecision"]

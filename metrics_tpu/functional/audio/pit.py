@@ -12,6 +12,7 @@ kicks in (non-jit path), mirroring the reference's scipy fallback.
 """
 from __future__ import annotations
 
+from functools import lru_cache
 from itertools import permutations
 from typing import Any, Callable, Tuple
 
@@ -24,12 +25,18 @@ from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
 _MAX_EXHAUSTIVE_SPK = 7
 
 
+@lru_cache(maxsize=None)
+def _permutation_table(spk_num: int) -> jax.Array:
+    """Cached [perm_num, spk] device table (the reference's `_ps_dict`, `pit.py:37-63`)."""
+    return jnp.asarray(list(permutations(range(spk_num))))
+
+
 def _find_best_perm_exhaustive(
     metric_mtx: jax.Array, maximize: bool
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact assignment by evaluating every permutation in one gather."""
     spk_num = metric_mtx.shape[-1]
-    ps = jnp.asarray(list(permutations(range(spk_num))))  # [perm_num, spk]
+    ps = _permutation_table(spk_num)  # [perm_num, spk]
     # metric_of_ps[b, p] = mean_i mtx[b, i, ps[p, i]]
     gathered = metric_mtx[..., jnp.arange(spk_num)[None, :], ps]  # [batch, perm_num, spk]
     metric_of_ps = gathered.mean(axis=-1)

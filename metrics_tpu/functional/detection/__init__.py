@@ -1,0 +1,4 @@
+"""Functional detection utilities (L2)."""
+from metrics_tpu.functional.detection.box_ops import box_area, box_convert, box_iou, mask_iou
+
+__all__ = ["box_area", "box_convert", "box_iou", "mask_iou"]

@@ -1,0 +1,65 @@
+"""Box geometry in jnp — the TPU-native replacement for torchvision's C++ ops.
+
+Parity: torchvision ``box_convert``/``box_area``/``box_iou`` as used by the
+reference `detection/mean_ap.py:24-26,61-74`. All fully jittable; ``box_iou``
+is one broadcasted min/max + clamp over the (N, M) pair grid, and
+``mask_iou`` is a dense boolean-mask IoU (one matmul over flattened masks on
+the MXU) replacing the reference's pycocotools RLE codec
+(`mean_ap.py:127-143`) — RLE is an I/O format, not compute.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def box_convert(boxes: jax.Array, in_fmt: str, out_fmt: str) -> jax.Array:
+    """Convert between xyxy / xywh / cxcywh box formats."""
+    allowed = ("xyxy", "xywh", "cxcywh")
+    if in_fmt not in allowed or out_fmt not in allowed:
+        raise ValueError(f"Unsupported box format conversion {in_fmt} -> {out_fmt}")
+    if in_fmt == out_fmt:
+        return boxes
+
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+    if out_fmt == "xyxy":
+        return boxes
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def box_area(boxes: jax.Array) -> jax.Array:
+    """Area of xyxy boxes, shape (N,) from (N, 4)."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def box_iou(boxes1: jax.Array, boxes2: jax.Array) -> jax.Array:
+    """Pairwise IoU of xyxy boxes: (N, 4) × (M, 4) → (N, M)."""
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter / union
+
+
+def mask_iou(masks1: jax.Array, masks2: jax.Array) -> jax.Array:
+    """Pairwise IoU of boolean masks: (N, H, W) × (M, H, W) → (N, M)."""
+    m1 = masks1.reshape(masks1.shape[0], -1).astype(jnp.float32)
+    m2 = masks2.reshape(masks2.shape[0], -1).astype(jnp.float32)
+    inter = m1 @ m2.T
+    union = m1.sum(axis=-1)[:, None] + m2.sum(axis=-1)[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+__all__ = ["box_convert", "box_area", "box_iou", "mask_iou"]

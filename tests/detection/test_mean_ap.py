@@ -1,0 +1,274 @@
+"""COCO mAP — differential tests against the reference MeanAveragePrecision.
+
+The reference needs three torchvision box ops at runtime; torchvision is not
+installed here, so pure-torch stand-ins are injected into the reference
+module (they are ~15 lines of tensor math, defined below from the published
+op semantics, not copied code).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.functional.detection import box_area, box_convert, box_iou, mask_iou
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+needs_ref = pytest.mark.skipif(_ref is None, reason="reference implementation not importable")
+
+
+def _make_reference_map(**kwargs):
+    """Reference MeanAveragePrecision with torch box-op stand-ins injected."""
+    import torch
+
+    import torchmetrics.detection.mean_ap as ref_map
+
+    def t_box_area(boxes):
+        return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+    def t_box_iou(b1, b2):
+        a1, a2 = t_box_area(b1), t_box_area(b2)
+        lt = torch.max(b1[:, None, :2], b2[None, :, :2])
+        rb = torch.min(b1[:, None, 2:], b2[None, :, 2:])
+        wh = (rb - lt).clamp(min=0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (a1[:, None] + a2[None, :] - inter)
+
+    def t_box_convert(boxes, in_fmt, out_fmt):
+        if in_fmt == "xywh":
+            x, y, w, h = boxes.unbind(-1)
+            boxes = torch.stack([x, y, x + w, y + h], dim=-1)
+        elif in_fmt == "cxcywh":
+            cx, cy, w, h = boxes.unbind(-1)
+            boxes = torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+        if out_fmt == "xyxy":
+            return boxes
+        raise NotImplementedError
+
+    ref_map._TORCHVISION_GREATER_EQUAL_0_8 = True
+    ref_map.box_area = t_box_area
+    ref_map.box_iou = t_box_iou
+    ref_map.box_convert = t_box_convert
+    return ref_map.MeanAveragePrecision(**kwargs)
+
+
+def _random_scenario(rng, n_images=6, n_classes=4, max_boxes=8, seed_scale=500.0):
+    """Random det/gt dicts with overlapping boxes across size categories."""
+    preds, targets = [], []
+    for _ in range(n_images):
+        n_gt = rng.randint(0, max_boxes)
+        n_det = rng.randint(0, max_boxes)
+        # anchor some detections near GT boxes so matches exist
+        gt_xy = rng.rand(n_gt, 2) * seed_scale
+        gt_wh = rng.rand(n_gt, 2) * 100 + 2
+        gt_boxes = np.concatenate([gt_xy, gt_xy + gt_wh], axis=1).astype(np.float32)
+        det_boxes = []
+        for j in range(n_det):
+            if n_gt > 0 and rng.rand() < 0.7:
+                base = gt_boxes[rng.randint(n_gt)]
+                jitter = rng.randn(4) * 5
+                det_boxes.append(base + jitter)
+            else:
+                xy = rng.rand(2) * seed_scale
+                wh = rng.rand(2) * 100 + 2
+                det_boxes.append(np.concatenate([xy, xy + wh]))
+        det_boxes = np.asarray(det_boxes, dtype=np.float32).reshape(n_det, 4)
+        det_boxes[:, 2:] = np.maximum(det_boxes[:, 2:], det_boxes[:, :2] + 1)
+
+        preds.append(
+            dict(
+                boxes=det_boxes,
+                scores=rng.rand(n_det).astype(np.float32),
+                labels=rng.randint(0, n_classes, n_det),
+            )
+        )
+        targets.append(dict(boxes=gt_boxes, labels=rng.randint(0, n_classes, n_gt)))
+    return preds, targets
+
+
+def _to_jnp(dicts):
+    return [{k: jnp.asarray(v) for k, v in d.items()} for d in dicts]
+
+
+def _to_torch(dicts):
+    import torch
+
+    return [{k: torch.from_numpy(np.asarray(v)) for k, v in d.items()} for d in dicts]
+
+
+def _assert_results_close(got, ref, atol=1e-5):
+    for key, ref_val in ref.items():
+        np.testing.assert_allclose(
+            np.asarray(got[key]), ref_val.numpy(), atol=atol, err_msg=f"mismatch for {key}"
+        )
+
+
+@needs_ref
+class TestMeanAveragePrecision:
+    def test_docstring_example(self):
+        preds = [dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.asarray([0.536]), labels=jnp.asarray([0]))]
+        target = [dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.asarray([0]))]
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        result = metric.compute()
+        assert round(float(result["map"]), 4) == 0.6
+        assert float(result["map_50"]) == 1.0
+        assert float(result["map_75"]) == 1.0
+        assert float(result["map_small"]) == -1.0
+        assert round(float(result["mar_1"]), 4) == 0.6
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_scenarios(self, seed):
+        rng = np.random.RandomState(seed)
+        preds, targets = _random_scenario(rng)
+
+        metric = MeanAveragePrecision()
+        metric.update(_to_jnp(preds), _to_jnp(targets))
+        got = metric.compute()
+
+        ref_metric = _make_reference_map()
+        ref_metric.update(_to_torch(preds), _to_torch(targets))
+        ref = ref_metric.compute()
+        _assert_results_close(got, ref)
+
+    def test_class_metrics(self):
+        rng = np.random.RandomState(7)
+        preds, targets = _random_scenario(rng)
+
+        metric = MeanAveragePrecision(class_metrics=True)
+        metric.update(_to_jnp(preds), _to_jnp(targets))
+        got = metric.compute()
+
+        ref_metric = _make_reference_map(class_metrics=True)
+        ref_metric.update(_to_torch(preds), _to_torch(targets))
+        ref = ref_metric.compute()
+        _assert_results_close(got, ref)
+
+    @pytest.mark.parametrize("box_format", ["xywh", "cxcywh"])
+    def test_box_formats(self, box_format):
+        rng = np.random.RandomState(3)
+        preds, targets = _random_scenario(rng)
+        # re-express xyxy boxes in the alternative format
+        def conv(d):
+            out = dict(d)
+            b = np.asarray(d["boxes"], dtype=np.float32).reshape(-1, 4)
+            if box_format == "xywh":
+                out["boxes"] = np.concatenate([b[:, :2], b[:, 2:] - b[:, :2]], axis=1)
+            else:
+                out["boxes"] = np.concatenate([(b[:, :2] + b[:, 2:]) / 2, b[:, 2:] - b[:, :2]], axis=1)
+            return out
+
+        metric = MeanAveragePrecision(box_format=box_format)
+        metric.update(_to_jnp([conv(p) for p in preds]), _to_jnp([conv(t) for t in targets]))
+        got = metric.compute()
+
+        ref_metric = _make_reference_map()
+        ref_metric.update(_to_torch(preds), _to_torch(targets))
+        ref = ref_metric.compute()
+        _assert_results_close(got, ref)
+
+    def test_custom_thresholds(self):
+        rng = np.random.RandomState(11)
+        preds, targets = _random_scenario(rng)
+        kwargs = dict(iou_thresholds=[0.3, 0.6], max_detection_thresholds=[2, 5])
+
+        metric = MeanAveragePrecision(**kwargs)
+        metric.update(_to_jnp(preds), _to_jnp(targets))
+        got = metric.compute()
+
+        ref_metric = _make_reference_map(**kwargs)
+        ref_metric.update(_to_torch(preds), _to_torch(targets))
+        ref = ref_metric.compute()
+        _assert_results_close(got, ref)
+        assert "mar_5_per_class" in got
+
+    def test_two_rank_merge(self):
+        """Emulated 2-rank accumulation: list states concatenate across ranks."""
+        rng = np.random.RandomState(5)
+        preds, targets = _random_scenario(rng, n_images=8)
+
+        m0 = MeanAveragePrecision()
+        m1 = MeanAveragePrecision()
+        m0.update(_to_jnp(preds[:4]), _to_jnp(targets[:4]))
+        m1.update(_to_jnp(preds[4:]), _to_jnp(targets[4:]))
+        # merge rank-1 lists into rank-0 (the None-reduction gather semantics)
+        for name in m0._defaults:
+            getattr(m0, name).extend(getattr(m1, name))
+        got = m0.compute()
+
+        ref_metric = _make_reference_map()
+        ref_metric.update(_to_torch(preds), _to_torch(targets))
+        ref = ref_metric.compute()
+        _assert_results_close(got, ref)
+
+    def test_empty_preds_and_gt(self):
+        metric = MeanAveragePrecision()
+        metric.update(
+            [dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros((0,)), labels=jnp.zeros((0,), dtype=jnp.int32))],
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,), dtype=jnp.int32))],
+        )
+        result = metric.compute()
+        assert float(result["map"]) == -1.0
+
+    def test_input_validation(self):
+        metric = MeanAveragePrecision()
+        with pytest.raises(ValueError, match="same length"):
+            metric.update([], [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))])
+        with pytest.raises(ValueError, match="`scores`"):
+            metric.update([dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))], [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))])
+        with pytest.raises(ValueError, match="box_format"):
+            MeanAveragePrecision(box_format="abcd")
+        with pytest.raises(ValueError, match="iou_type"):
+            MeanAveragePrecision(iou_type="abcd")
+
+
+class TestSegmIoU:
+    def test_mask_map_perfect_match(self):
+        rng = np.random.RandomState(0)
+        masks = rng.rand(3, 32, 32) > 0.5
+        preds = [dict(masks=jnp.asarray(masks), scores=jnp.asarray([0.9, 0.8, 0.7]), labels=jnp.asarray([0, 1, 0]))]
+        target = [dict(masks=jnp.asarray(masks), labels=jnp.asarray([0, 1, 0]))]
+        metric = MeanAveragePrecision(iou_type="segm")
+        metric.update(preds, target)
+        result = metric.compute()
+        assert float(result["map"]) == 1.0
+        assert float(result["mar_100"]) == 1.0
+
+    def test_mask_map_disjoint(self):
+        m1 = np.zeros((1, 16, 16), dtype=bool)
+        m1[:, :8] = True
+        m2 = ~m1
+        preds = [dict(masks=jnp.asarray(m1), scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))]
+        target = [dict(masks=jnp.asarray(m2), labels=jnp.asarray([0]))]
+        metric = MeanAveragePrecision(iou_type="segm")
+        metric.update(preds, target)
+        assert float(metric.compute()["map"]) == 0.0
+
+
+class TestBoxOps:
+    def test_box_iou_values(self):
+        b1 = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+        b2 = jnp.asarray([[5.0, 5.0, 15.0, 15.0], [0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]])
+        iou = np.asarray(box_iou(b1, b2))
+        np.testing.assert_allclose(iou[0], [25 / 175, 1.0, 0.0], atol=1e-6)
+
+    def test_box_convert_roundtrip(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(5, 2) * 100
+        wh = rng.rand(5, 2) * 50 + 1
+        xyxy = jnp.asarray(np.concatenate([xy, xy + wh], axis=1).astype(np.float32))
+        for fmt in ("xywh", "cxcywh"):
+            other = box_convert(xyxy, "xyxy", fmt)
+            back = box_convert(other, fmt, "xyxy")
+            np.testing.assert_allclose(np.asarray(back), np.asarray(xyxy), atol=1e-4)
+
+    def test_box_area(self):
+        assert float(box_area(jnp.asarray([[0.0, 0.0, 4.0, 5.0]]))[0]) == 20.0
+
+    def test_mask_iou(self):
+        a = np.zeros((1, 4, 4), dtype=bool)
+        a[:, :2] = True
+        b = np.zeros((1, 4, 4), dtype=bool)
+        b[:, 1:3] = True
+        iou = np.asarray(mask_iou(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_allclose(iou, [[4 / 12]], atol=1e-6)
