@@ -2,6 +2,12 @@
 
 Parity target: reference `src/torchmetrics/image/__init__.py`.
 """
+from metrics_tpu.image.generative import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+)
 from metrics_tpu.image.psnr import PeakSignalNoiseRatio
 from metrics_tpu.image.spectral import (
     ErrorRelativeGlobalDimensionlessSynthesis,
@@ -15,6 +21,10 @@ from metrics_tpu.image.ssim import (
 )
 
 __all__ = [
+    "FrechetInceptionDistance",
+    "KernelInceptionDistance",
+    "InceptionScore",
+    "LearnedPerceptualImagePatchSimilarity",
     "PeakSignalNoiseRatio",
     "StructuralSimilarityIndexMeasure",
     "MultiScaleStructuralSimilarityIndexMeasure",

@@ -1,1 +1,9 @@
-"""Feature-extractor networks used by model-backed metrics (InceptionV3, LPIPS nets)."""
+"""In-tree Flax feature-extractor models (the reference's only "networks").
+
+Parity target: torch-fidelity InceptionV3 (`image/fid.py:27-58`) and the
+`lpips` package nets (`image/lpip.py:30-40`).
+"""
+from metrics_tpu.models.inception import InceptionV3Extractor, params_from_npz
+from metrics_tpu.models.lpips import LPIPSExtractor, LPIPSNet
+
+__all__ = ["InceptionV3Extractor", "params_from_npz", "LPIPSExtractor", "LPIPSNet"]

@@ -1,0 +1,172 @@
+"""Flax LPIPS perceptual-similarity network.
+
+Parity target: the reference's ``NoTrainLpips`` (`image/lpip.py:30-40`)
+wrapping the ``lpips`` package — backbone feature maps at tapped layers,
+channel-unit-normalized, squared difference, learned non-negative 1×1 heads,
+spatial mean, summed over layers (Zhang et al. 2018). From-scratch Flax
+implementation of the published architecture.
+
+Weights: no egress in this environment, so parameters are deterministically
+random-initialized by default (valid for pipeline testing and relative
+comparisons); converted ``lpips`` weights load via the same flat-npz format
+as :func:`metrics_tpu.models.inception.params_from_npz`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+# input normalization constants from the published LPIPS scaling layer
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+
+class AlexNetFeatures(nn.Module):
+    """AlexNet trunk with the 5 LPIPS tap points."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> List[jax.Array]:
+        taps = []
+        x = nn.relu(nn.Conv(64, (11, 11), (4, 4), padding=((2, 2), (2, 2)), name="conv1")(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(192, (5, 5), padding=((2, 2), (2, 2)), name="conv2")(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=((1, 1), (1, 1)), name="conv3")(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), name="conv4")(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), name="conv5")(x))
+        taps.append(x)
+        return taps
+
+
+class VGG16Features(nn.Module):
+    """VGG16 trunk tapped at relu1_2 / relu2_2 / relu3_3 / relu4_3 / relu5_3."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> List[jax.Array]:
+        taps = []
+        cfg = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        for block, (width, convs) in enumerate(cfg, start=1):
+            for i in range(1, convs + 1):
+                x = nn.relu(nn.Conv(width, (3, 3), padding=((1, 1), (1, 1)), name=f"conv{block}_{i}")(x))
+            taps.append(x)
+            if block < len(cfg):
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return taps
+
+
+class Fire(nn.Module):
+    squeeze: int
+    expand: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        s = nn.relu(nn.Conv(self.squeeze, (1, 1), name="squeeze")(x))
+        e1 = nn.relu(nn.Conv(self.expand, (1, 1), name="expand1x1")(s))
+        e3 = nn.relu(nn.Conv(self.expand, (3, 3), padding=((1, 1), (1, 1)), name="expand3x3")(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeNetFeatures(nn.Module):
+    """SqueezeNet 1.1 trunk with the 7 LPIPS tap points."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> List[jax.Array]:
+        taps = []
+        x = nn.relu(nn.Conv(64, (3, 3), (2, 2), name="conv1")(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = Fire(16, 64, name="fire2")(x)
+        x = Fire(16, 64, name="fire3")(x)
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = Fire(32, 128, name="fire4")(x)
+        x = Fire(32, 128, name="fire5")(x)
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = Fire(48, 192, name="fire6")(x)
+        taps.append(x)
+        x = Fire(48, 192, name="fire7")(x)
+        taps.append(x)
+        x = Fire(64, 256, name="fire8")(x)
+        taps.append(x)
+        x = Fire(64, 256, name="fire9")(x)
+        taps.append(x)
+        return taps
+
+
+_BACKBONES = {
+    "alex": (AlexNetFeatures, 5),
+    "vgg": (VGG16Features, 5),
+    "squeeze": (SqueezeNetFeatures, 7),
+}
+
+
+class LPIPSNet(nn.Module):
+    """Full LPIPS: backbone taps → unit-normalize → sq-diff → 1×1 heads → mean."""
+
+    net_type: str = "alex"
+
+    @nn.compact
+    def __call__(self, img1: jax.Array, img2: jax.Array) -> jax.Array:
+        backbone_cls, n_taps = _BACKBONES[self.net_type]
+        backbone = backbone_cls(name="net")
+
+        shift = jnp.asarray(_SHIFT).reshape(1, 1, 1, 3)
+        scale = jnp.asarray(_SCALE).reshape(1, 1, 1, 3)
+        feats1 = backbone((img1 - shift) / scale)
+        feats2 = backbone((img2 - shift) / scale)
+
+        total = 0.0
+        for i, (f1, f2) in enumerate(zip(feats1, feats2)):
+            f1 = f1 / jnp.sqrt(jnp.sum(f1**2, axis=-1, keepdims=True) + 1e-10)
+            f2 = f2 / jnp.sqrt(jnp.sum(f2**2, axis=-1, keepdims=True) + 1e-10)
+            diff = (f1 - f2) ** 2
+            head = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}")
+            # published LPIPS heads are trained non-negative; enforce at apply
+            weighted = head(diff)
+            weighted = jnp.abs(weighted)
+            total = total + weighted.mean(axis=(1, 2))[:, 0]
+        return total
+
+
+class LPIPSExtractor:
+    """Callable ``(img1, img2) → [N]`` LPIPS scores (NCHW inputs in [-1, 1])."""
+
+    def __init__(self, net_type: str = "alex", params: Any = None, seed: int = 0) -> None:
+        if net_type not in _BACKBONES:
+            raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
+        self.net_type = net_type
+        self.model = LPIPSNet(net_type=net_type)
+        if params is None:
+            dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
+            params = self.model.init(jax.random.PRNGKey(seed), dummy, dummy)
+        self.params = params
+        self._forward = jax.jit(functools.partial(self._apply, self.model))
+
+    @staticmethod
+    def _apply(model: "LPIPSNet", params: Any, img1: jax.Array, img2: jax.Array) -> jax.Array:
+        return model.apply(params, img1, img2)
+
+    def __call__(self, img1: jax.Array, img2: jax.Array) -> jax.Array:
+        img1 = jnp.transpose(jnp.asarray(img1), (0, 2, 3, 1))
+        img2 = jnp.transpose(jnp.asarray(img2), (0, 2, 3, 1))
+        return self._forward(self.params, img1, img2)
+
+
+__all__ = [
+    "LPIPSNet",
+    "LPIPSExtractor",
+    "AlexNetFeatures",
+    "VGG16Features",
+    "SqueezeNetFeatures",
+    "Fire",
+]
