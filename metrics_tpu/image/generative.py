@@ -52,6 +52,18 @@ def _compute_fid(mu1: jax.Array, sigma1: jax.Array, mu2: jax.Array, sigma2: jax.
     return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 
+def _f64_compute():
+    """Context that forces float64 for a distribution-distance ``compute``.
+
+    Policy: FID/KID compute covariance/kernel statistics whose rounding error
+    at float32 is visible against the reference's float64 path (reference
+    `image/fid.py:262-267` casts to ``.double()``). These are epoch-end,
+    small-matrix computations, so emulated f64 on TPU is an acceptable cost.
+    Hot-path ``update`` stays in the input dtype.
+    """
+    return jax.enable_x64(True)
+
+
 def _resolve_extractor(feature: Union[int, str, Callable], valid: tuple, params: Any, seed: int) -> Callable:
     if isinstance(feature, (int, str)) and not callable(feature):
         if feature not in valid:
@@ -139,20 +151,19 @@ class FrechetInceptionDistance(_FeatureBufferMetric):
         real_features = dim_zero_cat(self.real_features)
         fake_features = dim_zero_cat(self.fake_features)
         orig_dtype = real_features.dtype
-        # float64 when x64 mode is active; float32 otherwise (TPU f64 is emulated)
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        real_features = real_features.astype(dtype)
-        fake_features = fake_features.astype(dtype)
-
-        n = real_features.shape[0]
-        m = fake_features.shape[0]
-        mean1 = real_features.mean(axis=0)
-        mean2 = fake_features.mean(axis=0)
-        diff1 = real_features - mean1
-        diff2 = fake_features - mean2
-        cov1 = diff1.T @ diff1 / (n - 1)
-        cov2 = diff2.T @ diff2 / (m - 1)
-        return _compute_fid(mean1, cov1, mean2, cov2).astype(orig_dtype)
+        with _f64_compute():
+            real64 = real_features.astype(jnp.float64)
+            fake64 = fake_features.astype(jnp.float64)
+            n = real64.shape[0]
+            m = fake64.shape[0]
+            mean1 = real64.mean(axis=0)
+            mean2 = fake64.mean(axis=0)
+            diff1 = real64 - mean1
+            diff2 = fake64 - mean2
+            cov1 = diff1.T @ diff1 / (n - 1)
+            cov2 = diff2.T @ diff2 / (m - 1)
+            fid = _compute_fid(mean1, cov1, mean2, cov2)
+        return fid.astype(orig_dtype)
 
 
 def poly_kernel(f1: jax.Array, f2: jax.Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> jax.Array:
@@ -251,14 +262,21 @@ class KernelInceptionDistance(_FeatureBufferMetric):
         if n_samples_fake < self.subset_size:
             raise ValueError("Argument `subset_size` should be smaller than the number of samples")
 
+        # MMD in float64: permuting subset rows reorders the kernel-matrix
+        # summation, and float32 rounding would leak into the across-subset
+        # std (which must be ~0 when subset == full set). Cast per-subset so
+        # the extra f64 footprint is one (subset_size, dim) slice, not the
+        # whole feature buffer.
         rng = np.random.RandomState(self.seed)
-        kid_scores_ = []
-        for _ in range(self.subsets):
-            f_real = real_features[rng.permutation(n_samples_real)[: self.subset_size]]
-            f_fake = fake_features[rng.permutation(n_samples_fake)[: self.subset_size]]
-            kid_scores_.append(poly_mmd(f_real, f_fake, self.degree, self.gamma, self.coef))
-        kid_scores = jnp.stack(kid_scores_)
-        return kid_scores.mean(), kid_scores.std()
+        with _f64_compute():
+            kid_scores_ = []
+            for _ in range(self.subsets):
+                f_real = real_features[rng.permutation(n_samples_real)[: self.subset_size]].astype(jnp.float64)
+                f_fake = fake_features[rng.permutation(n_samples_fake)[: self.subset_size]].astype(jnp.float64)
+                kid_scores_.append(poly_mmd(f_real, f_fake, self.degree, self.gamma, self.coef))
+            kid_scores = jnp.stack(kid_scores_)
+            mean, std = kid_scores.mean(), kid_scores.std()
+        return mean.astype(real_features.dtype), std.astype(real_features.dtype)
 
 
 class InceptionScore(Metric):
