@@ -21,6 +21,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute_with_precision_recall,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.binned import binned_curve_counts
 from metrics_tpu.utils.data import to_onehot
 
 METRIC_EPS = 1e-6
@@ -85,11 +86,11 @@ class BinnedPrecisionRecallCurve(Metric):
             target = to_onehot(target, num_classes=self.num_classes)
 
         t = (target == 1).astype(jnp.float32)  # (N, C)
-        # (N, C, T) comparisons contracted over N in one shot
-        p = (preds[:, :, None] >= self.thresholds[None, None, :]).astype(jnp.float32)
-        self.TPs = self.TPs + jnp.einsum("nc,nct->ct", t, p)
-        self.FPs = self.FPs + jnp.einsum("nc,nct->ct", 1.0 - t, p)
-        self.FNs = self.FNs + jnp.einsum("nc,nct->ct", t, 1.0 - p)
+        # one fused MXU compare-contract program (metrics_tpu/ops/binned.py)
+        tps, fps, fns = binned_curve_counts(preds, t, self.thresholds)
+        self.TPs = self.TPs + tps
+        self.FPs = self.FPs + fps
+        self.FNs = self.FNs + fns
 
     def compute(self) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
         precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)

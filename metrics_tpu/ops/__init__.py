@@ -1,1 +1,24 @@
-"""Low-level device kernels (segment reductions, sorting helpers, Pallas ops)."""
+"""Low-level device kernels: Pallas MXU histogram, binned-curve counts, segment reductions."""
+from metrics_tpu.ops._dispatch import pallas_enabled
+from metrics_tpu.ops.binned import binned_curve_counts
+from metrics_tpu.ops.histogram import fused_bincount
+from metrics_tpu.ops.segments import (
+    segment_count,
+    segment_cumsum,
+    segment_max,
+    segment_ranks,
+    segment_starts,
+    segment_sum,
+)
+
+__all__ = [
+    "pallas_enabled",
+    "binned_curve_counts",
+    "fused_bincount",
+    "segment_count",
+    "segment_cumsum",
+    "segment_max",
+    "segment_ranks",
+    "segment_starts",
+    "segment_sum",
+]

@@ -1,0 +1,113 @@
+"""Fused (weighted) bincount — the scatter-free TPU histogram.
+
+Reference counterpart: `src/torchmetrics/utilities/data.py:244-264` (torch
+``bincount`` plus a CUDA-determinism fallback loop). On TPU, scatter-adds
+serialize poorly; the MXU-native formulation is a one-hot contraction:
+
+    counts[l] = sum_i w[i] * [x[i] == l]  ==  (w @ one_hot(x, L))[l]
+
+The Pallas kernel tiles ``x`` into ``(1, TN)`` strips and the label axis into
+``(1, TL)`` strips, materializes each one-hot tile only in VMEM, and feeds the
+``(1, TN) x (TN, TL)`` product to the MXU, accumulating the output strip
+in-place across the N-grid dimension. HBM traffic is O(N + L) instead of the
+O(N*L) a materialized one-hot would cost — but compare work is still O(N*L),
+so on chips where XLA's scatter-add is fast this kernel loses (measured: 76 us
+vs 10 us at N=1e6, L=16384); hence it is opt-in via METRICS_TPU_ENABLE_PALLAS
+(see `ops/_dispatch.py`). The XLA fallback is a deterministic segment-sum.
+
+Accumulation is float32: counts are exact while each bin stays below 2**24
+per update call (callers accumulate across updates in int32/float64 state).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops._dispatch import pallas_enabled
+
+_TN = 512  # elements of x per grid step
+_TL = 512  # label-axis strip width
+
+
+def _bincount_kernel(x_ref, w_ref, out_ref, *, tl: int):
+    import jax.experimental.pallas as pl
+
+    lj = pl.program_id(0)
+    ni = pl.program_id(1)
+
+    @pl.when(ni == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    x = x_ref[...]  # (1, TN) int32
+    labels = lj * tl + jax.lax.broadcasted_iota(jnp.int32, (x.shape[1], tl), 1)
+    # transpose, not x[0, :, None]: integer indexing lowers to an unsupported
+    # gather inside Mosaic; transpose+broadcast stays on the VPU
+    onehot = (jnp.transpose(x) == labels).astype(jnp.float32)  # (TN, TL)
+    out_ref[...] += jnp.dot(w_ref[...], onehot, preferred_element_type=jnp.float32)
+
+
+def _pallas_weighted_bincount(x: jax.Array, weights: jax.Array, length: int) -> jax.Array:
+    import jax.experimental.pallas as pl
+
+    n = x.shape[0]
+    np_ = -(-n // _TN) * _TN
+    lp = -(-length // _TL) * _TL
+    # out-of-range pad sentinel: never equals a real (non-negative) label
+    x = jnp.pad(x.astype(jnp.int32), (0, np_ - n), constant_values=-1).reshape(1, np_)
+    w = jnp.pad(weights.astype(jnp.float32), (0, np_ - n)).reshape(1, np_)
+
+    out = pl.pallas_call(
+        partial(_bincount_kernel, tl=_TL),
+        grid=(lp // _TL, np_ // _TN),
+        in_specs=[
+            pl.BlockSpec((1, _TN), lambda lj, ni: (0, ni)),
+            pl.BlockSpec((1, _TN), lambda lj, ni: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, _TL), lambda lj, ni: (0, lj)),
+        out_shape=jax.ShapeDtypeStruct((1, lp), jnp.float32),
+    )(x, w)
+    return out[0, :length]
+
+
+def fused_bincount(
+    x: jax.Array,
+    length: int,
+    weights: Optional[jax.Array] = None,
+    *,
+    force_xla: bool = False,
+) -> jax.Array:
+    """``bincount(x, weights, minlength=length)`` with a Pallas MXU path on TPU.
+
+    ``x`` is flattened; entries outside ``[0, length)`` are ignored in BOTH
+    dispatch paths (the `ignore_index = -1` sentinel convention — unlike
+    ``jnp.bincount``, which clips them into bin 0). Returns float32 when
+    ``weights`` is given, int32 otherwise. The XLA path is exact for unweighted
+    counts (int32 accumulation); the Pallas path accumulates in float32 and is
+    exact while each bin stays below 2**24 per call.
+    """
+    x = jnp.asarray(x).reshape(-1)
+
+    if pallas_enabled() and not force_xla and x.size >= _TN:
+        if weights is not None:
+            w = jnp.asarray(weights).reshape(-1).astype(jnp.float32)
+        else:
+            w = jnp.ones_like(x, dtype=jnp.float32)
+        counts = _pallas_weighted_bincount(x, w, length)
+        if weights is None:
+            return jnp.round(counts).astype(jnp.int32)
+        return counts
+
+    valid = (x >= 0) & (x < length)
+    idx = jnp.where(valid, x, 0)
+    if weights is None:
+        w_int = valid.astype(jnp.int32)
+        return jax.ops.segment_sum(w_int, idx, num_segments=length)
+    w = jnp.asarray(weights).reshape(-1).astype(jnp.float32)
+    return jax.ops.segment_sum(jnp.where(valid, w, 0.0), idx, num_segments=length)
+
+
+__all__ = ["fused_bincount"]

@@ -1,0 +1,88 @@
+"""Segment reductions over contiguous (sorted) group ids.
+
+The reference groups retrieval rows with a host-side python dict loop
+(`src/torchmetrics/utilities/data.py:210-233` ``get_group_indexes``) and then
+launches one kernel per query group. On TPU the grouped evaluation is one
+device program: rows are sorted by group id, and every per-group quantity
+becomes a segment reduction. All helpers assume ``segment_ids`` is sorted
+ascending and dense in ``[0, num_segments)`` — callers establish this with one
+``argsort`` (see :mod:`metrics_tpu.retrieval.base`). Helpers that need
+counts/starts accept them precomputed so a caller evaluating several
+reductions over the same segmentation dispatches each O(R) pass once.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Sum of ``data`` rows per segment (deterministic XLA scatter-add)."""
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments, indices_are_sorted=True)
+
+
+def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments, indices_are_sorted=True)
+
+
+def segment_count(segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Number of rows in each segment."""
+    return segment_sum(jnp.ones_like(segment_ids, dtype=jnp.int32), segment_ids, num_segments)
+
+
+def segment_starts(
+    segment_ids: jax.Array, num_segments: int, counts: Optional[jax.Array] = None
+) -> jax.Array:
+    """Index of the first row of each segment (== exclusive cumsum of counts)."""
+    if counts is None:
+        counts = segment_count(segment_ids, num_segments)
+    return jnp.cumsum(counts) - counts
+
+
+def segment_ranks(
+    segment_ids: jax.Array, num_segments: int, starts: Optional[jax.Array] = None
+) -> jax.Array:
+    """1-based rank of every row within its segment (row order preserved)."""
+    if starts is None:
+        starts = segment_starts(segment_ids, num_segments)
+    return jnp.arange(segment_ids.shape[0], dtype=jnp.int32) - starts[segment_ids] + 1
+
+
+def segment_cumsum(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    starts: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Inclusive cumsum of ``data`` restarting at every segment boundary.
+
+    Implemented as a segmented associative scan (flag-reset operator), NOT as
+    ``global_cumsum - offset_at_start``: the subtraction form loses float32
+    precision catastrophically for groups late in a large stream (each group's
+    values become the difference of two huge prefix sums), while the segmented
+    scan only ever accumulates within a group.
+    """
+    del starts  # not needed by the scan formulation; kept for API stability
+    if data.shape[0] == 0:
+        return data
+    is_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), segment_ids[1:] != segment_ids[:-1]])
+
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av + bv), af | bf
+
+    out, _ = jax.lax.associative_scan(combine, (data, is_start))
+    return out
+
+
+__all__ = [
+    "segment_sum",
+    "segment_max",
+    "segment_count",
+    "segment_starts",
+    "segment_ranks",
+    "segment_cumsum",
+]

@@ -1,20 +1,91 @@
-"""RetrievalMetric base — grouped per-query evaluation.
+"""RetrievalMetric base — grouped per-query evaluation as ONE device program.
 
 Parity: reference `retrieval/base.py:27-146`: ``indexes/preds/target`` cat
 states; ``compute`` groups rows by query id and averages the per-query kernel,
 with ``empty_target_action`` in {error, skip, neg, pos}.
+
+TPU-first rework (SURVEY §2.4): the reference groups rows with a host-side
+python dict loop (`utilities/data.py:210-233`) and launches one kernel per
+query. Here ``compute`` sorts rows once by (query, -score) and evaluates every
+query simultaneously with segment reductions (`metrics_tpu/ops/segments.py`) —
+one device program regardless of query count. Subclasses implement
+``_segment_metric(ctx) -> (G,)``; the per-query functional kernels remain in
+`metrics_tpu/functional/retrieval/kernels.py` for API parity.
 """
 from __future__ import annotations
 
 from abc import abstractmethod
+from dataclasses import dataclass
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.segments import (
+    segment_count,
+    segment_cumsum,
+    segment_ranks,
+    segment_starts,
+    segment_sum,
+)
 from metrics_tpu.utils.checks import _check_retrieval_inputs
-from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+@dataclass(frozen=True)
+class GroupedRows:
+    """All rows sorted by (query id, -score), with per-row/per-group stats.
+
+    ``seg`` is the dense group id per sorted row; within a group rows are in
+    descending score order, so ``ranks``/``cumrel`` give top-k statistics
+    directly and ``idx_at(kv)`` gathers the row index of rank ``kv``.
+    """
+
+    num_groups: int
+    seg: jax.Array  # (R,) int32, ascending
+    preds: jax.Array  # (R,) float32, descending within group
+    rel: jax.Array  # (R,) float32 relevance (graded allowed)
+    ranks: jax.Array  # (R,) int32, 1-based rank within group
+    cumrel: jax.Array  # (R,) float32 inclusive cumsum of rel within group
+    counts: jax.Array  # (G,) int32 rows per group
+    starts: jax.Array  # (G,) int32 first-row index per group
+    n_pos: jax.Array  # (G,) float32 sum of rel per group
+
+    def idx_at(self, kv: jax.Array) -> jax.Array:
+        """Row index of rank ``kv`` (clamped to [1, count]) in each group."""
+        return self.starts + jnp.clip(kv, 1, self.counts) - 1
+
+    def k_eff(self, k: Optional[int]) -> jax.Array:
+        """Effective per-group k: ``min(k, count)`` (count when ``k`` is None)."""
+        return self.counts if k is None else jnp.minimum(k, self.counts)
+
+
+def group_rows(indexes: jax.Array, preds: jax.Array, target: jax.Array) -> GroupedRows:
+    """Sort rows by (query, -score) and precompute segment statistics."""
+    uniques, seg_raw = jnp.unique(indexes, return_inverse=True)
+    g = int(uniques.shape[0])
+    # two-pass stable lexsort: secondary key first (score desc), then group
+    order1 = jnp.argsort(-preds, stable=True)
+    order2 = jnp.argsort(seg_raw[order1], stable=True)
+    perm = order1[order2]
+
+    seg = seg_raw[perm].astype(jnp.int32)
+    p = preds[perm].astype(jnp.float32)
+    rel = target[perm].astype(jnp.float32)
+    counts = segment_count(seg, g)
+    starts = segment_starts(seg, g, counts=counts)
+    return GroupedRows(
+        num_groups=g,
+        seg=seg,
+        preds=p,
+        rel=rel,
+        ranks=segment_ranks(seg, g, starts=starts),
+        cumrel=segment_cumsum(rel, seg, g, starts=starts),
+        counts=counts,
+        starts=starts,
+        n_pos=segment_sum(rel, seg, g),
+    )
 
 
 class RetrievalMetric(Metric):
@@ -24,6 +95,9 @@ class RetrievalMetric(Metric):
     higher_is_better: Optional[bool] = True
     full_state_update: Optional[bool] = False
     allow_non_binary_target: bool = False
+    # which side's absence makes a query "empty": positives for most metrics,
+    # negatives for fall-out (reference `retrieval/fall_out.py:60-74`)
+    _empty_when_no: str = "pos"
 
     def __init__(
         self,
@@ -61,30 +135,50 @@ class RetrievalMetric(Metric):
         self.preds.append(preds)
         self.target.append(target)
 
-    def compute(self) -> jax.Array:
+    def _grouped_state(self) -> Optional[GroupedRows]:
+        if not self.indexes:
+            return None
         indexes = dim_zero_cat(self.indexes)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
+        if indexes.size == 0:
+            return None
+        return group_rows(indexes, preds, target)
 
-        res = []
-        groups = get_group_indexes(indexes)
-        for group in groups:
-            mini_preds = preds[group]
-            mini_target = target[group]
-            if not bool(mini_target.sum()):
-                if self.empty_target_action == "error":
-                    raise ValueError("`compute` method was provided with a query with no positive target.")
-                if self.empty_target_action == "pos":
-                    res.append(jnp.asarray(1.0))
-                elif self.empty_target_action == "neg":
-                    res.append(jnp.asarray(0.0))
-            else:
-                res.append(self._metric(mini_preds, mini_target))
-        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
+    def _group_valid(self, ctx: GroupedRows) -> jax.Array:
+        if self._empty_when_no == "neg":
+            n_neg = ctx.counts.astype(jnp.float32) - segment_sum(
+                (ctx.rel > 0).astype(jnp.float32), ctx.seg, ctx.num_groups
+            )
+            return n_neg > 0
+        return ctx.n_pos > 0
+
+    def _apply_empty_action(self, values: jax.Array, valid: jax.Array) -> jax.Array:
+        """Mean over groups (axis 0) under ``empty_target_action``.
+
+        ``values`` is ``(G,)`` or ``(G, K)`` (per-k curves); ``valid`` is ``(G,)``.
+        """
+        side = "positive" if self._empty_when_no == "pos" else "negative"
+        if self.empty_target_action == "error" and bool(jnp.any(~valid)):
+            raise ValueError(f"`compute` method was provided with a query with no {side} target.")
+        mask = valid.reshape((-1,) + (1,) * (values.ndim - 1))
+        if self.empty_target_action == "skip":
+            n = jnp.maximum(valid.sum(), 1)
+            summed = jnp.where(mask, values, 0.0).sum(axis=0) / n
+            return jnp.where(valid.any(), summed, jnp.zeros_like(summed))
+        fill = {"pos": 1.0, "neg": 0.0, "error": 0.0}[self.empty_target_action]
+        return jnp.where(mask, values, fill).mean(axis=0)
+
+    def compute(self) -> jax.Array:
+        ctx = self._grouped_state()
+        if ctx is None:
+            return jnp.asarray(0.0)
+        values = self._segment_metric(ctx)
+        return self._apply_empty_action(values, self._group_valid(ctx))
 
     @abstractmethod
-    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
-        """Score a single query group."""
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        """Score every query group at once; returns ``(num_groups,)``."""
 
 
-__all__ = ["RetrievalMetric"]
+__all__ = ["RetrievalMetric", "GroupedRows", "group_rows"]

@@ -1,7 +1,11 @@
-"""Retrieval module metrics.
+"""Retrieval module metrics — segment-reduction (all-queries-at-once) kernels.
 
 Parity: reference `retrieval/{average_precision,reciprocal_rank,precision,
-recall,fall_out,hit_rate,ndcg,r_precision,precision_recall_curve}.py`.
+recall,fall_out,hit_rate,ndcg,r_precision,precision_recall_curve,
+recall_at_precision}.py`. Each ``_segment_metric`` evaluates EVERY query group
+in one device program over the (query, -score)-sorted rows prepared by
+:func:`metrics_tpu.retrieval.base.group_rows`; the per-query formulas are the
+same as the functional kernels in `functional/retrieval/kernels.py`.
 """
 from __future__ import annotations
 
@@ -10,33 +14,27 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.retrieval.kernels import (
-    retrieval_average_precision,
-    retrieval_fall_out,
-    retrieval_hit_rate,
-    retrieval_normalized_dcg,
-    retrieval_precision,
-    retrieval_precision_recall_curve,
-    retrieval_r_precision,
-    retrieval_recall,
-    retrieval_reciprocal_rank,
-)
-from metrics_tpu.retrieval.base import RetrievalMetric
-from metrics_tpu.utils.data import dim_zero_cat, get_group_indexes
+from metrics_tpu.ops.segments import segment_cumsum, segment_max, segment_sum
+from metrics_tpu.retrieval.base import GroupedRows, RetrievalMetric
 
 
 class RetrievalMAP(RetrievalMetric):
     """Mean average precision over queries."""
 
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_average_precision(preds, target)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        # AP = sum_ranks rel * (cumrel / rank) / n_pos
+        terms = ctx.rel * ctx.cumrel / ctx.ranks.astype(jnp.float32)
+        ap_sum = segment_sum(terms, ctx.seg, ctx.num_groups)
+        return jnp.where(ctx.n_pos > 0, ap_sum / jnp.maximum(ctx.n_pos, 1.0), 0.0)
 
 
 class RetrievalMRR(RetrievalMetric):
     """Mean reciprocal rank over queries."""
 
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_reciprocal_rank(preds, target)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        # the first relevant row has the largest 1/rank among relevant rows
+        rr = jnp.where(ctx.rel > 0, 1.0 / ctx.ranks.astype(jnp.float32), 0.0)
+        return jnp.maximum(segment_max(rr, ctx.seg, ctx.num_groups), 0.0)
 
 
 class _RetrievalKMetric(RetrievalMetric):
@@ -56,76 +54,78 @@ class _RetrievalKMetric(RetrievalMetric):
 class RetrievalPrecision(_RetrievalKMetric):
     """Mean precision@k over queries."""
 
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_precision(preds, target, k=self.k)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        kv = ctx.k_eff(self.k)
+        return ctx.cumrel[ctx.idx_at(kv)] / kv.astype(jnp.float32)
 
 
 class RetrievalRecall(_RetrievalKMetric):
     """Mean recall@k over queries."""
 
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_recall(preds, target, k=self.k)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        kv = ctx.k_eff(self.k)
+        found = ctx.cumrel[ctx.idx_at(kv)]
+        return jnp.where(ctx.n_pos > 0, found / jnp.maximum(ctx.n_pos, 1.0), 0.0)
 
 
 class RetrievalFallOut(_RetrievalKMetric):
-    """Mean fall-out@k over queries; empty-target convention is inverted
-    (a query with NO relevant docs scores via ``empty_target_action`` on the
-    negative side — reference `retrieval/fall_out.py`)."""
+    """Mean fall-out@k over queries; the "empty" convention is inverted —
+    a query with no NEGATIVE docs is the degenerate one (reference
+    `retrieval/fall_out.py`)."""
 
     higher_is_better = False
+    _empty_when_no = "neg"
 
-    def compute(self) -> jax.Array:
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-
-        res = []
-        for group in get_group_indexes(indexes):
-            mini_preds = preds[group]
-            mini_target = target[group]
-            # fall-out's empty case is "no NEGATIVE targets"
-            if bool((1 - mini_target).sum() == 0):
-                if self.empty_target_action == "error":
-                    raise ValueError("`compute` method was provided with a query with no negative target.")
-                if self.empty_target_action == "pos":
-                    res.append(jnp.asarray(1.0))
-                elif self.empty_target_action == "neg":
-                    res.append(jnp.asarray(0.0))
-            else:
-                res.append(self._metric(mini_preds, mini_target))
-        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
-
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_fall_out(preds, target, k=self.k)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        kv = ctx.k_eff(self.k)
+        nonrel = 1.0 - (ctx.rel > 0).astype(jnp.float32)
+        cum_nonrel = segment_cumsum(nonrel, ctx.seg, ctx.num_groups, starts=ctx.starts)
+        n_neg = segment_sum(nonrel, ctx.seg, ctx.num_groups)
+        found = cum_nonrel[ctx.idx_at(kv)]
+        return jnp.where(n_neg > 0, found / jnp.maximum(n_neg, 1.0), 0.0)
 
 
 class RetrievalHitRate(_RetrievalKMetric):
     """Mean hit-rate@k over queries."""
 
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_hit_rate(preds, target, k=self.k)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        kv = ctx.k_eff(self.k)
+        return (ctx.cumrel[ctx.idx_at(kv)] > 0).astype(jnp.float32)
 
 
 class RetrievalNormalizedDCG(_RetrievalKMetric):
-    """Mean NDCG@k over queries; targets may be graded."""
+    """Mean NDCG@k over queries; targets may carry graded gains."""
 
     allow_non_binary_target = True
 
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_normalized_dcg(preds, target, k=self.k)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        kv = ctx.k_eff(self.k)
+        discount = 1.0 / jnp.log2(ctx.ranks.astype(jnp.float32) + 1.0)
+        dcg_cum = segment_cumsum(ctx.rel * discount, ctx.seg, ctx.num_groups, starts=ctx.starts)
+        dcg = dcg_cum[ctx.idx_at(kv)]
+        # ideal ordering: re-sort rows by (group, -gain)
+        order1 = jnp.argsort(-ctx.rel, stable=True)
+        order2 = jnp.argsort(ctx.seg[order1], stable=True)
+        ideal = ctx.rel[order1][order2]
+        idcg_cum = segment_cumsum(ideal * discount, ctx.seg, ctx.num_groups, starts=ctx.starts)
+        idcg = idcg_cum[ctx.idx_at(kv)]
+        return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """Mean R-precision over queries."""
+    """Mean R-precision over queries (precision at R = #relevant)."""
 
-    def _metric(self, preds, target) -> jax.Array:
-        return retrieval_r_precision(preds, target)
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
+        r = ctx.n_pos.astype(jnp.int32)
+        found = ctx.cumrel[ctx.idx_at(r)]
+        return jnp.where(r > 0, found / jnp.maximum(r, 1).astype(jnp.float32), 0.0)
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
     """Averaged (precision@k, recall@k) curves over queries.
 
-    Parity: reference `retrieval/precision_recall_curve.py`.
+    Parity: reference `retrieval/precision_recall_curve.py`. Queries shorter
+    than ``max_k`` repeat their final value (clamped-rank gather).
     """
 
     higher_is_better = None
@@ -146,43 +146,31 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         self.max_k = max_k
         self.adaptive_k = adaptive_k
 
-    def _metric(self, preds, target) -> jax.Array:  # pragma: no cover - unused
+    def _segment_metric(self, ctx: GroupedRows) -> jax.Array:  # pragma: no cover - unused
         raise NotImplementedError
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-
-        groups = get_group_indexes(indexes)
-        max_k = self.max_k or max(int(g.shape[0]) for g in groups)
-
-        precisions, recalls = [], []
-        for group in groups:
-            mini_preds = preds[group]
-            mini_target = target[group]
-            if not bool(mini_target.sum()):
-                if self.empty_target_action == "error":
-                    raise ValueError("`compute` method was provided with a query with no positive target.")
-                fill = 1.0 if self.empty_target_action == "pos" else 0.0
-                if self.empty_target_action in ("pos", "neg"):
-                    precisions.append(jnp.full((max_k,), fill))
-                    recalls.append(jnp.full((max_k,), fill))
-            else:
-                n = mini_preds.shape[0]
-                p, r, _ = retrieval_precision_recall_curve(mini_preds, mini_target, max_k=min(max_k, n))
-                # pad short queries by repeating the final value (k > n_docs)
-                if p.shape[0] < max_k:
-                    pad = max_k - p.shape[0]
-                    p = jnp.concatenate([p, jnp.full((pad,), float(p[-1]))])
-                    r = jnp.concatenate([r, jnp.full((pad,), float(r[-1]))])
-                precisions.append(p)
-                recalls.append(r)
-
+        ctx = self._grouped_state()
+        max_k = self.max_k or (int(ctx.counts.max()) if ctx is not None else 1)
         top_k = jnp.arange(1, max_k + 1)
-        if not precisions:
+        if ctx is None:
             return jnp.zeros(max_k), jnp.zeros(max_k), top_k
-        return jnp.stack(precisions).mean(axis=0), jnp.stack(recalls).mean(axis=0), top_k
+
+        ks = top_k[None, :]  # (1, K)
+        kv = jnp.minimum(ks, ctx.counts[:, None])  # (G, K) clamped rank
+        idx = ctx.starts[:, None] + kv - 1
+        cumrel_k = ctx.cumrel[idx]  # (G, K)
+        precisions = cumrel_k / kv.astype(jnp.float32)
+        recalls = jnp.where(
+            (ctx.n_pos > 0)[:, None], cumrel_k / jnp.maximum(ctx.n_pos, 1.0)[:, None], 0.0
+        )
+
+        valid = self._group_valid(ctx)
+        return (
+            self._apply_empty_action(precisions, valid),
+            self._apply_empty_action(recalls, valid),
+            top_k,
+        )
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):

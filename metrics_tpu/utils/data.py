@@ -144,10 +144,15 @@ def _bincount(x: Array, minlength: int) -> Array:
     """Deterministic bincount with a static ``minlength`` (jit-safe).
 
     The reference needs a CUDA-determinism fallback (`utilities/data.py:244-264`);
-    XLA scatter-add is deterministic so ``jnp.bincount`` is used directly. The
-    ``length`` argument keeps the output shape static under jit.
+    XLA scatter-add is deterministic so no workaround is needed. Delegates to
+    :func:`metrics_tpu.ops.fused_bincount` so both the default XLA path and the
+    opt-in Pallas MXU path (``METRICS_TPU_ENABLE_PALLAS=1``) share one
+    semantics: out-of-range ids (e.g. ``ignore_index`` sentinels) are dropped,
+    never clipped into bin 0.
     """
-    return jnp.bincount(x.reshape(-1), length=minlength)
+    from metrics_tpu.ops import fused_bincount
+
+    return fused_bincount(x, minlength)
 
 
 def allclose(x: Array, y: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:

@@ -1,0 +1,170 @@
+"""Device-kernel unit tests: fused histogram, binned-curve counts, segment ops.
+
+The Pallas kernel itself is exercised in interpreter mode (runs on the CPU test
+mesh, same lowering semantics); the XLA fallbacks are checked against numpy.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops import (
+    binned_curve_counts,
+    fused_bincount,
+    segment_count,
+    segment_cumsum,
+    segment_max,
+    segment_ranks,
+    segment_starts,
+    segment_sum,
+)
+from metrics_tpu.ops.histogram import _bincount_kernel, _TN, _TL
+
+
+def _pallas_interpret_bincount(x, weights, length):
+    """Run the real Pallas kernel in interpreter mode on CPU."""
+    import functools
+
+    import jax.experimental.pallas as pl
+
+    n = x.shape[0]
+    np_ = -(-n // _TN) * _TN
+    lp = -(-length // _TL) * _TL
+    xp = jnp.pad(jnp.asarray(x, jnp.int32), (0, np_ - n), constant_values=-1).reshape(1, np_)
+    wp = jnp.pad(jnp.asarray(weights, jnp.float32), (0, np_ - n)).reshape(1, np_)
+    out = pl.pallas_call(
+        functools.partial(_bincount_kernel, tl=_TL),
+        grid=(lp // _TL, np_ // _TN),
+        in_specs=[
+            pl.BlockSpec((1, _TN), lambda lj, ni: (0, ni)),
+            pl.BlockSpec((1, _TN), lambda lj, ni: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, _TL), lambda lj, ni: (0, lj)),
+        out_shape=jax.ShapeDtypeStruct((1, lp), jnp.float32),
+        interpret=True,
+    )(xp, wp)
+    return out[0, :length]
+
+
+class TestFusedBincount:
+    @pytest.mark.parametrize("length", [7, 128, 1000])
+    def test_matches_numpy(self, length):
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, length, size=(4096,))
+        expected = np.bincount(x, minlength=length)
+        got = fused_bincount(jnp.asarray(x), length)
+        np.testing.assert_array_equal(np.asarray(got), expected)
+
+    def test_weighted(self):
+        rng = np.random.RandomState(1)
+        x = rng.randint(0, 50, size=(2000,))
+        w = rng.rand(2000).astype(np.float32)
+        expected = np.bincount(x, weights=w, minlength=50)
+        got = fused_bincount(jnp.asarray(x), 50, weights=jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5, atol=1e-4)
+
+    def test_out_of_range_ignored(self):
+        x = jnp.asarray([-1, 0, 1, 5, 99])
+        got = fused_bincount(x, 3)
+        np.testing.assert_array_equal(np.asarray(got), [1, 1, 0])
+
+    def test_jittable(self):
+        x = jnp.asarray(np.random.RandomState(2).randint(0, 10, size=(512,)))
+        got = jax.jit(lambda a: fused_bincount(a, 10))(x)
+        np.testing.assert_array_equal(np.asarray(got), np.bincount(np.asarray(x), minlength=10))
+
+    @pytest.mark.parametrize("n,length", [(600, 300), (2048, 1024), (513, 129)])
+    def test_pallas_kernel_interpret(self, n, length):
+        rng = np.random.RandomState(3)
+        x = rng.randint(0, length, size=(n,))
+        w = rng.rand(n).astype(np.float32)
+        got = _pallas_interpret_bincount(jnp.asarray(x), jnp.asarray(w), length)
+        expected = np.bincount(x, weights=w, minlength=length)
+        np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5, atol=1e-4)
+
+    def test_pallas_kernel_interpret_padding_sentinel(self):
+        # padded tail (-1 ids, 0 weights) must not contribute to bin 0
+        x = jnp.zeros((10,), jnp.int32)
+        w = jnp.ones((10,), jnp.float32)
+        got = _pallas_interpret_bincount(x, w, 256)
+        assert float(got[0]) == 10.0
+        assert float(got.sum()) == 10.0
+
+
+class TestBinnedCurveCounts:
+    @pytest.mark.parametrize("t", [5, 100])
+    @pytest.mark.parametrize("c", [1, 4])
+    def test_matches_broadcast(self, c, t):
+        rng = np.random.RandomState(0)
+        preds = rng.rand(256, c).astype(np.float32)
+        target = (rng.rand(256, c) > 0.5).astype(np.float32)
+        thr = np.linspace(0, 1, t).astype(np.float32)
+
+        ge = (preds[:, :, None] >= thr[None, None, :]).astype(np.float32)
+        tps_e = np.einsum("nc,nct->ct", target, ge)
+        fps_e = np.einsum("nc,nct->ct", 1 - target, ge)
+        fns_e = np.einsum("nc,nct->ct", target, 1 - ge)
+
+        tps, fps, fns = binned_curve_counts(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(thr))
+        np.testing.assert_allclose(np.asarray(tps), tps_e, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(fps), fps_e, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(fns), fns_e, atol=1e-4)
+
+    def test_unsorted_thresholds(self):
+        rng = np.random.RandomState(1)
+        preds = rng.rand(64, 2).astype(np.float32)
+        target = (rng.rand(64, 2) > 0.3).astype(np.float32)
+        thr = np.asarray([0.9, 0.1, 0.5], dtype=np.float32)
+        ge = (preds[:, :, None] >= thr[None, None, :]).astype(np.float32)
+        tps_e = np.einsum("nc,nct->ct", target, ge)
+        tps, _, _ = binned_curve_counts(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(thr))
+        np.testing.assert_allclose(np.asarray(tps), tps_e, atol=1e-4)
+
+    def test_exact_threshold_ties(self):
+        # preds exactly equal to a threshold must count as >= (side="right")
+        preds = jnp.asarray([[0.5], [0.5], [0.2]])
+        target = jnp.asarray([[1.0], [0.0], [1.0]])
+        thr = jnp.asarray([0.2, 0.5, 0.8])
+        tps, fps, fns = binned_curve_counts(preds, target, thr)
+        np.testing.assert_allclose(np.asarray(tps[0]), [2.0, 1.0, 0.0])
+        np.testing.assert_allclose(np.asarray(fps[0]), [1.0, 1.0, 0.0])
+        np.testing.assert_allclose(np.asarray(fns[0]), [0.0, 1.0, 2.0])
+
+
+class TestSegmentOps:
+    def _ids(self):
+        return jnp.asarray([0, 0, 0, 1, 1, 3, 3, 3, 3], dtype=jnp.int32), 4
+
+    def test_count_starts_ranks(self):
+        ids, n = self._ids()
+        np.testing.assert_array_equal(np.asarray(segment_count(ids, n)), [3, 2, 0, 4])
+        np.testing.assert_array_equal(np.asarray(segment_starts(ids, n)), [0, 3, 5, 5])
+        np.testing.assert_array_equal(np.asarray(segment_ranks(ids, n)), [1, 2, 3, 1, 2, 1, 2, 3, 4])
+
+    def test_cumsum(self):
+        ids, n = self._ids()
+        data = jnp.asarray([1.0, 2, 3, 4, 5, 6, 7, 8, 9])
+        got = segment_cumsum(data, ids, n)
+        np.testing.assert_allclose(np.asarray(got), [1, 3, 6, 4, 9, 6, 13, 21, 30])
+
+    def test_sum_max(self):
+        ids, n = self._ids()
+        data = jnp.asarray([1.0, 2, 3, 4, 5, 6, 7, 8, 9])
+        np.testing.assert_allclose(np.asarray(segment_sum(data, ids, n)), [6, 9, 0, 30])
+        got_max = np.asarray(segment_max(data, ids, n))
+        np.testing.assert_allclose(got_max[[0, 1, 3]], [3, 5, 9])
+
+    def test_cumsum_empty(self):
+        got = segment_cumsum(jnp.zeros((0,)), jnp.zeros((0,), jnp.int32), 0)
+        assert got.shape == (0,)
+
+    def test_cumsum_no_cancellation_after_huge_group(self):
+        # a tiny group following a 2M-row group must not inherit float32
+        # rounding from the global prefix (segmented scan, not cumsum-minus-offset)
+        rng = np.random.RandomState(0)
+        big = rng.rand(2_000_000).astype(np.float32)
+        small = rng.rand(10).astype(np.float32)
+        data = jnp.asarray(np.concatenate([big, small]))
+        ids = jnp.asarray(np.concatenate([np.zeros(big.size), np.ones(small.size)]).astype(np.int32))
+        got = np.asarray(segment_cumsum(data, ids, 2))[-small.size:]
+        np.testing.assert_allclose(got, np.cumsum(small), rtol=1e-6)
