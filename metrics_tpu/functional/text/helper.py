@@ -6,6 +6,8 @@ Parity: reference `functional/text/helper.py` (``_edit_distance`` `:333`,
 TPU note (SURVEY §2.6): string processing is inherently host-side — the
 reference also runs it in python. The design split is host tokenize/count →
 device tensor reductions; the accumulated count states still sync as arrays.
+The O(m*n) dynamic programs run in the native C++ layer when a toolchain is
+present (`metrics_tpu/native/text_kernels.cpp`), with pure-python fallbacks.
 """
 from __future__ import annotations
 
@@ -13,20 +15,25 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from metrics_tpu import native
+
 
 def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> int:
-    """Levenshtein distance via numpy DP over the (m+1, n+1) table."""
+    """Levenshtein distance (native C++ kernel; numpy DP fallback)."""
     m, n = len(prediction_tokens), len(reference_tokens)
     if m == 0:
         return n
     if n == 0:
         return m
+    a_ids, b_ids = native.intern_ids(prediction_tokens, reference_tokens)
+    result = native.levenshtein(a_ids, b_ids)
+    if result is not None:
+        return result
     prev = np.arange(n + 1, dtype=np.int32)
     for i in range(1, m + 1):
         curr = np.empty(n + 1, dtype=np.int32)
         curr[0] = i
-        p = prediction_tokens[i - 1]
-        sub_cost = np.fromiter((0 if p == r else 1 for r in reference_tokens), dtype=np.int32, count=n)
+        sub_cost = (b_ids != a_ids[i - 1]).astype(np.int32)
         for j in range(1, n + 1):
             curr[j] = min(prev[j] + 1, curr[j - 1] + 1, prev[j - 1] + sub_cost[j - 1])
         prev = curr
@@ -36,14 +43,33 @@ def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence) -> i
 def _edit_distance_matrix(prediction_tokens: Sequence, reference_tokens: Sequence) -> np.ndarray:
     """Full Levenshtein DP table (needed by TER's shift search)."""
     m, n = len(prediction_tokens), len(reference_tokens)
+    a_ids, b_ids = native.intern_ids(prediction_tokens, reference_tokens)
+    result = native.levenshtein_matrix(a_ids, b_ids)
+    if result is not None:
+        return result
     d = np.zeros((m + 1, n + 1), dtype=np.int32)
     d[:, 0] = np.arange(m + 1)
     d[0, :] = np.arange(n + 1)
     for i in range(1, m + 1):
+        sub_cost = (b_ids != a_ids[i - 1]).astype(np.int32)
         for j in range(1, n + 1):
-            cost = 0 if prediction_tokens[i - 1] == reference_tokens[j - 1] else 1
-            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1, d[i - 1, j - 1] + cost)
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1, d[i - 1, j - 1] + sub_cost[j - 1])
     return d
+
+
+def _edit_distances(pairs: Sequence[Tuple[Sequence, Sequence]]) -> List[int]:
+    """Levenshtein distance for every (prediction, reference) pair.
+
+    All pairs go to the native layer in ONE C call (CSR-packed batch); the
+    fallback loops the per-pair python DP.
+    """
+    if not pairs:
+        return []
+    ids = native.intern_ids(*(s for pair in pairs for s in pair))
+    batched = native.levenshtein_batch(ids[0::2], ids[1::2])
+    if batched is not None:
+        return [int(v) for v in batched]
+    return [_edit_distance(p, r) for p, r in pairs]
 
 
 def _tokenize_sentence(text: str) -> List[str]:
@@ -54,4 +80,4 @@ def _ngrams(tokens: Sequence, n: int) -> List[Tuple]:
     return [tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)]
 
 
-__all__ = ["_edit_distance", "_edit_distance_matrix", "_tokenize_sentence", "_ngrams"]
+__all__ = ["_edit_distance", "_edit_distances", "_edit_distance_matrix", "_tokenize_sentence", "_ngrams"]

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu import native
 from metrics_tpu.utils.imports import _NLTK_AVAILABLE
 
 ALLOWED_ROUGE_KEYS = {
@@ -71,10 +72,17 @@ def _rouge_n_score(pred: List[str], target: List[str], n_gram: int) -> Dict[str,
 
 
 def _lcs_length(pred: List[str], target: List[str]) -> int:
-    """Longest common subsequence via numpy rolling-row DP (reference `_lcs` `:72-116`)."""
+    """Longest common subsequence (native C++ kernel; rolling-row DP fallback).
+
+    Parity: reference `_lcs` `functional/text/rouge.py:72-116`.
+    """
     m, n = len(pred), len(target)
     if m == 0 or n == 0:
         return 0
+    a_ids, b_ids = native.intern_ids(pred, target)
+    result = native.lcs_length(a_ids, b_ids)
+    if result is not None:
+        return result
     prev = np.zeros(n + 1, dtype=np.int32)
     for i in range(1, m + 1):
         curr = np.zeros(n + 1, dtype=np.int32)

@@ -10,7 +10,7 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.helper import _edit_distances
 
 
 def _str_list(x: Union[str, List[str]]) -> List[str]:
@@ -19,11 +19,9 @@ def _str_list(x: Union[str, List[str]]) -> List[str]:
 
 def _wer_update(preds, target) -> Tuple[jax.Array, jax.Array]:
     preds, target = _str_list(preds), _str_list(target)
-    errors, total = 0, 0
-    for p, t in zip(preds, target):
-        p_tok, t_tok = p.split(), t.split()
-        errors += _edit_distance(p_tok, t_tok)
-        total += len(t_tok)
+    pairs = [(p.split(), t.split()) for p, t in zip(preds, target)]
+    errors = sum(_edit_distances(pairs))
+    total = sum(len(t_tok) for _, t_tok in pairs)
     return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
 
 
@@ -47,10 +45,9 @@ def word_error_rate(preds, target) -> jax.Array:
 
 def _cer_update(preds, target) -> Tuple[jax.Array, jax.Array]:
     preds, target = _str_list(preds), _str_list(target)
-    errors, total = 0, 0
-    for p, t in zip(preds, target):
-        errors += _edit_distance(list(p), list(t))
-        total += len(t)
+    pairs = [(list(p), list(t)) for p, t in zip(preds, target)]
+    errors = sum(_edit_distances(pairs))
+    total = sum(len(t) for _, t in pairs)
     return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
 
 
@@ -68,12 +65,9 @@ def char_error_rate(preds, target) -> jax.Array:
 
 def _mer_update(preds, target) -> Tuple[jax.Array, jax.Array]:
     preds, target = _str_list(preds), _str_list(target)
-    errors, total = 0, 0
-    for p, t in zip(preds, target):
-        p_tok, t_tok = p.split(), t.split()
-        d = _edit_distance(p_tok, t_tok)
-        errors += d
-        total += max(len(t_tok), len(p_tok))
+    pairs = [(p.split(), t.split()) for p, t in zip(preds, target)]
+    errors = sum(_edit_distances(pairs))
+    total = sum(max(len(t_tok), len(p_tok)) for p_tok, t_tok in pairs)
     return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
 
 
@@ -94,19 +88,16 @@ def match_error_rate(preds, target) -> jax.Array:
 def _wil_wip_update(preds, target) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Accumulate hit counts for word-information metrics (reference wil/wip)."""
     preds, target = _str_list(preds), _str_list(target)
-    total = 0.0
     errors = 0.0
     target_total = 0.0
     preds_total = 0.0
-    for p, t in zip(preds, target):
-        p_tok, t_tok = p.split(), t.split()
-        d = _edit_distance(p_tok, t_tok)
+    pairs = [(p.split(), t.split()) for p, t in zip(preds, target)]
+    for (p_tok, t_tok), d in zip(pairs, _edit_distances(pairs)):
         # "preserved information" count: max(|t|, |p|) - d (reference wil/wip)
         hits = max(len(t_tok), len(p_tok)) - d
         errors += hits
         target_total += len(t_tok)
         preds_total += len(p_tok)
-        total += 1
     return (
         jnp.asarray(errors, dtype=jnp.float32),
         jnp.asarray(target_total, dtype=jnp.float32),

@@ -1,0 +1,199 @@
+"""Native (C++) host-side runtime — build-on-first-use ctypes bindings.
+
+The TPU compute path is JAX/XLA; the host-side runtime around it (here: the
+text-domain dynamic programs that are string- not tensor-shaped) is native C++,
+mirroring how the reference leans on torch's C++ runtime for everything below
+python. The library is compiled once with the system ``g++`` into the user
+cache dir and loaded via ctypes; every entry point has a pure-python fallback
+so the package works (slower) without a toolchain. ``METRICS_TPU_NO_NATIVE=1``
+forces the fallbacks.
+
+Public surface: :func:`available`, :func:`levenshtein`, :func:`levenshtein_batch`,
+:func:`levenshtein_matrix`, :func:`lcs_length`, :func:`lcs_batch`,
+:func:`intern_ids` (token→int32 interning shared by callers).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC = Path(__file__).with_name("text_kernels.cpp")
+_LIB_NAME = f"metrics_tpu_text_kernels_py{sys.version_info.major}{sys.version_info.minor}.so"
+_lib: Optional[ctypes.CDLL] = None
+_tried_build = False
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(os.path.expanduser("~"), ".cache")
+    d = Path(base) / "metrics_tpu"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _build() -> Optional[Path]:
+    # every step can fail on locked-down hosts (read-only HOME, missing source
+    # in a stripped install, no compiler) — any failure means "no native", never
+    # an exception escaping into a metric call
+    tmp_path = None
+    try:
+        out = _cache_dir() / _LIB_NAME
+        if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+            return out
+        # build into a temp file then atomically rename, so concurrent
+        # processes never load a half-written library
+        with tempfile.NamedTemporaryFile(dir=out.parent, suffix=".so", delete=False) as tmp:
+            tmp_path = Path(tmp.name)
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(tmp_path)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        tmp_path.replace(out)
+        return out
+    except Exception:
+        if tmp_path is not None:
+            tmp_path.unlink(missing_ok=True)
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried_build
+    if _lib is not None:
+        return _lib
+    if _tried_build or os.environ.get("METRICS_TPU_NO_NATIVE") == "1":
+        return _lib
+    _tried_build = True
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        return None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.mt_levenshtein.restype = ctypes.c_int32
+    lib.mt_levenshtein.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32]
+    lib.mt_levenshtein_batch.restype = None
+    lib.mt_levenshtein_batch.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i32p]
+    lib.mt_levenshtein_matrix.restype = None
+    lib.mt_levenshtein_matrix.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32, i32p]
+    lib.mt_lcs.restype = ctypes.c_int32
+    lib.mt_lcs.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32]
+    lib.mt_lcs_batch.restype = None
+    lib.mt_lcs_batch.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i32p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the compiled kernels are loadable on this host."""
+    return _load() is not None
+
+
+def intern_ids(*seqs: Sequence) -> List[np.ndarray]:
+    """Map hashable tokens to dense int32 ids consistently across sequences."""
+    vocab: dict = {}
+    out = []
+    for s in seqs:
+        arr = np.empty(len(s), dtype=np.int32)
+        for i, tok in enumerate(s):
+            arr[i] = vocab.setdefault(tok, len(vocab))
+        out.append(arr)
+    return out
+
+
+def _as_i32(a: np.ndarray) -> Tuple["ctypes._Pointer", np.ndarray]:
+    """Returns (pointer, keep-alive array): the ndarray OWNS the buffer the
+    pointer aliases — callers must hold it for the duration of the C call."""
+    a = np.ascontiguousarray(a, dtype=np.int32)
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), a
+
+
+def levenshtein(a_ids: np.ndarray, b_ids: np.ndarray) -> Optional[int]:
+    """Edit distance between two id sequences; None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    pa, a = _as_i32(a_ids)
+    pb, b = _as_i32(b_ids)
+    return int(lib.mt_levenshtein(pa, len(a), pb, len(b)))
+
+
+def levenshtein_matrix(a_ids: np.ndarray, b_ids: np.ndarray) -> Optional[np.ndarray]:
+    """Full (m+1, n+1) DP table; None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    pa, a = _as_i32(a_ids)
+    pb, b = _as_i32(b_ids)
+    out = np.empty((len(a) + 1, len(b) + 1), dtype=np.int32)
+    lib.mt_levenshtein_matrix(pa, len(a), pb, len(b), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out
+
+
+def lcs_length(a_ids: np.ndarray, b_ids: np.ndarray) -> Optional[int]:
+    """LCS length between two id sequences; None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    pa, a = _as_i32(a_ids)
+    pb, b = _as_i32(b_ids)
+    return int(lib.mt_lcs(pa, len(a), pb, len(b)))
+
+
+def _pack(seqs: Sequence[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
+    off = np.zeros(len(seqs) + 1, dtype=np.int64)
+    np.cumsum([len(s) for s in seqs], out=off[1:])
+    flat = np.concatenate([np.asarray(s, np.int32) for s in seqs]) if seqs else np.zeros(0, np.int32)
+    return np.ascontiguousarray(flat, np.int32), off
+
+
+def _batch(fn_name: str, a_seqs: Sequence[np.ndarray], b_seqs: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    assert len(a_seqs) == len(b_seqs)
+    a_flat, a_off = _pack(a_seqs)
+    b_flat, b_off = _pack(b_seqs)
+    out = np.empty(len(a_seqs), dtype=np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    getattr(lib, fn_name)(
+        a_flat.ctypes.data_as(i32p),
+        a_off.ctypes.data_as(i64p),
+        b_flat.ctypes.data_as(i32p),
+        b_off.ctypes.data_as(i64p),
+        len(a_seqs),
+        out.ctypes.data_as(i32p),
+    )
+    return out
+
+
+def levenshtein_batch(a_seqs: Sequence[np.ndarray], b_seqs: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    """Edit distances for k packed pairs in one native call; None if unavailable."""
+    return _batch("mt_levenshtein_batch", a_seqs, b_seqs)
+
+
+def lcs_batch(a_seqs: Sequence[np.ndarray], b_seqs: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    """LCS lengths for k packed pairs in one native call; None if unavailable."""
+    return _batch("mt_lcs_batch", a_seqs, b_seqs)
+
+
+__all__ = [
+    "available",
+    "intern_ids",
+    "levenshtein",
+    "levenshtein_batch",
+    "levenshtein_matrix",
+    "lcs_length",
+    "lcs_batch",
+]

@@ -1,0 +1,84 @@
+// Native host-side text kernels: Levenshtein edit distance and LCS.
+//
+// The reference's "native layer" is torch's C++ runtime; its text metrics
+// (WER/CER/MER/WIL/TER at functional/text/{wer,cer,ter}.py, ROUGE-L `_lcs` at
+// functional/text/rouge.py:72-116) run O(m*n) dynamic programs in python.
+// String processing is inherently host-side on TPU as well (SURVEY §2.6), so
+// this framework's native layer lives here: token sequences are interned to
+// int32 ids in python and the DP inner loops run in C++ (~100x over the
+// python/numpy row loop). Exposed via a plain C ABI for ctypes
+// (see metrics_tpu/native/__init__.py); python fallbacks remain for
+// environments without a compiler.
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Levenshtein distance between a[0:m] and b[0:n] (unit costs).
+int32_t mt_levenshtein(const int32_t* a, int32_t m, const int32_t* b, int32_t n) {
+    if (m == 0) return n;
+    if (n == 0) return m;
+    std::vector<int32_t> prev(n + 1), curr(n + 1);
+    for (int32_t j = 0; j <= n; ++j) prev[j] = j;
+    for (int32_t i = 1; i <= m; ++i) {
+        curr[0] = i;
+        const int32_t ai = a[i - 1];
+        for (int32_t j = 1; j <= n; ++j) {
+            const int32_t sub = prev[j - 1] + (ai != b[j - 1]);
+            curr[j] = std::min(sub, std::min(prev[j] + 1, curr[j - 1] + 1));
+        }
+        std::swap(prev, curr);
+    }
+    return prev[n];
+}
+
+// Batched distances over k CSR-packed sequence pairs; offsets have k+1 entries.
+void mt_levenshtein_batch(const int32_t* a_flat, const int64_t* a_off, const int32_t* b_flat,
+                          const int64_t* b_off, int64_t k, int32_t* out) {
+    for (int64_t i = 0; i < k; ++i) {
+        out[i] = mt_levenshtein(a_flat + a_off[i], (int32_t)(a_off[i + 1] - a_off[i]),
+                                b_flat + b_off[i], (int32_t)(b_off[i + 1] - b_off[i]));
+    }
+}
+
+// Full (m+1) x (n+1) row-major DP table (TER's shift search needs the table).
+void mt_levenshtein_matrix(const int32_t* a, int32_t m, const int32_t* b, int32_t n, int32_t* d) {
+    const int64_t w = n + 1;
+    for (int32_t j = 0; j <= n; ++j) d[j] = j;
+    for (int32_t i = 1; i <= m; ++i) {
+        int32_t* row = d + i * w;
+        const int32_t* up = row - w;
+        row[0] = i;
+        const int32_t ai = a[i - 1];
+        for (int32_t j = 1; j <= n; ++j) {
+            const int32_t sub = up[j - 1] + (ai != b[j - 1]);
+            row[j] = std::min(sub, std::min(up[j] + 1, row[j - 1] + 1));
+        }
+    }
+}
+
+// Longest-common-subsequence length (ROUGE-L).
+int32_t mt_lcs(const int32_t* a, int32_t m, const int32_t* b, int32_t n) {
+    if (m == 0 || n == 0) return 0;
+    std::vector<int32_t> prev(n + 1, 0), curr(n + 1, 0);
+    for (int32_t i = 1; i <= m; ++i) {
+        const int32_t ai = a[i - 1];
+        for (int32_t j = 1; j <= n; ++j) {
+            curr[j] = (ai == b[j - 1]) ? prev[j - 1] + 1 : std::max(prev[j], curr[j - 1]);
+        }
+        std::swap(prev, curr);
+    }
+    return prev[n];
+}
+
+// Batched LCS over k CSR-packed pairs.
+void mt_lcs_batch(const int32_t* a_flat, const int64_t* a_off, const int32_t* b_flat,
+                  const int64_t* b_off, int64_t k, int32_t* out) {
+    for (int64_t i = 0; i < k; ++i) {
+        out[i] = mt_lcs(a_flat + a_off[i], (int32_t)(a_off[i + 1] - a_off[i]),
+                        b_flat + b_off[i], (int32_t)(b_off[i + 1] - b_off[i]));
+    }
+}
+
+}  // extern "C"

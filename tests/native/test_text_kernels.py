@@ -1,0 +1,116 @@
+"""Native C++ text-kernel tests: build, correctness vs pure-python DPs, batching."""
+import numpy as np
+import pytest
+
+from metrics_tpu import native
+
+
+def _py_levenshtein(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1), dtype=np.int64)
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1, d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d
+
+
+def _py_lcs(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1), dtype=np.int64)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = d[i - 1, j - 1] + 1 if a[i - 1] == b[j - 1] else max(d[i - 1, j], d[i, j - 1])
+    return int(d[m, n])
+
+
+needs_native = pytest.mark.skipif(not native.available(), reason="no C++ toolchain on host")
+
+
+@needs_native
+class TestNativeKernels:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_levenshtein_random(self, seed):
+        rng = np.random.RandomState(seed)
+        a = rng.randint(0, 5, size=rng.randint(0, 40)).astype(np.int32)
+        b = rng.randint(0, 5, size=rng.randint(0, 40)).astype(np.int32)
+        assert native.levenshtein(a, b) == int(_py_levenshtein(a, b)[len(a), len(b)])
+
+    def test_levenshtein_known(self):
+        a, b = native.intern_ids(list("kitten"), list("sitting"))
+        assert native.levenshtein(a, b) == 3
+
+    def test_matrix_matches_python(self):
+        rng = np.random.RandomState(3)
+        a = rng.randint(0, 4, size=12).astype(np.int32)
+        b = rng.randint(0, 4, size=9).astype(np.int32)
+        np.testing.assert_array_equal(native.levenshtein_matrix(a, b), _py_levenshtein(a, b))
+
+    @pytest.mark.parametrize("seed", [0, 4])
+    def test_lcs_random(self, seed):
+        rng = np.random.RandomState(seed)
+        a = rng.randint(0, 4, size=rng.randint(1, 30)).astype(np.int32)
+        b = rng.randint(0, 4, size=rng.randint(1, 30)).astype(np.int32)
+        assert native.lcs_length(a, b) == _py_lcs(a, b)
+
+    def test_batch_apis(self):
+        rng = np.random.RandomState(5)
+        a_seqs = [rng.randint(0, 5, size=rng.randint(0, 25)).astype(np.int32) for _ in range(17)]
+        b_seqs = [rng.randint(0, 5, size=rng.randint(0, 25)).astype(np.int32) for _ in range(17)]
+        lev = native.levenshtein_batch(a_seqs, b_seqs)
+        lcs = native.lcs_batch(a_seqs, b_seqs)
+        for i, (a, b) in enumerate(zip(a_seqs, b_seqs)):
+            assert lev[i] == int(_py_levenshtein(a, b)[len(a), len(b)])
+            assert lcs[i] == _py_lcs(a, b)
+
+    def test_empty_batch(self):
+        assert native.levenshtein_batch([], []).shape == (0,)
+
+
+class TestLoaderRobustness:
+    def test_unwritable_cache_falls_back(self):
+        # a fresh subprocess with an uncreatable cache dir must fall back to
+        # python, never crash a metric call
+        import subprocess
+        import sys
+
+        code = (
+            "import metrics_tpu.functional.text.helper as h;"
+            "print(h._edit_distance(list('ab'), list('ac')))"
+        )
+        env = dict(__import__("os").environ, XDG_CACHE_HOME="/dev/null/nope")
+        out = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, env=env)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "1"
+
+
+class TestInternIds:
+    def test_consistent_across_sequences(self):
+        a, b = native.intern_ids(["x", "y", "x"], ["y", "z"])
+        assert a.tolist() == [0, 1, 0]
+        assert b.tolist() == [1, 2]
+
+
+class TestMetricsUseNative:
+    """The text metrics must produce identical values with and without native."""
+
+    def test_wer_matches_fallback(self, monkeypatch):
+        import metrics_tpu.functional.text.helper as helper
+
+        preds = ["the quick brown fox jumps over the lazy dog today"] * 3
+        refs = ["the quick brown cat leaps over a lazy dog"] * 3
+        fast = [helper._edit_distance(p.split(), r.split()) for p, r in zip(preds, refs)]
+        monkeypatch.setattr(native, "levenshtein", lambda *a: None)
+        slow = [helper._edit_distance(p.split(), r.split()) for p, r in zip(preds, refs)]
+        assert fast == slow
+
+    def test_rouge_l_matches_fallback(self, monkeypatch):
+        import metrics_tpu.functional.text.rouge as rouge
+
+        pred = "the cat sat on the mat near the door".split()
+        tgt = "a cat was sitting on the mat by the door".split()
+        fast = rouge._lcs_length(pred, tgt)
+        monkeypatch.setattr(native, "lcs_length", lambda *a: None)
+        slow = rouge._lcs_length(pred, tgt)
+        assert fast == slow
