@@ -65,10 +65,11 @@ def _edit_distances(pairs: Sequence[Tuple[Sequence, Sequence]]) -> List[int]:
     """
     if not pairs:
         return []
-    ids = native.intern_ids(*(s for pair in pairs for s in pair))
-    batched = native.levenshtein_batch(ids[0::2], ids[1::2])
-    if batched is not None:
-        return [int(v) for v in batched]
+    if native.available():
+        ids = native.intern_ids(*(s for pair in pairs for s in pair))
+        batched = native.levenshtein_batch(ids[0::2], ids[1::2])
+        if batched is not None:
+            return [int(v) for v in batched]
     return [_edit_distance(p, r) for p, r in pairs]
 
 

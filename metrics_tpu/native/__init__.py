@@ -25,9 +25,17 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 _SRC = Path(__file__).with_name("text_kernels.cpp")
-_LIB_NAME = f"metrics_tpu_text_kernels_py{sys.version_info.major}{sys.version_info.minor}.so"
 _lib: Optional[ctypes.CDLL] = None
 _tried_build = False
+
+
+def _lib_name() -> str:
+    # key the cache on source CONTENT, not mtime: wheel installs normalize
+    # mtimes, which would otherwise keep a stale .so from an older version
+    import hashlib
+
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    return f"metrics_tpu_text_kernels_{digest}.so"
 
 
 def _cache_dir() -> Path:
@@ -43,8 +51,8 @@ def _build() -> Optional[Path]:
     # an exception escaping into a metric call
     tmp_path = None
     try:
-        out = _cache_dir() / _LIB_NAME
-        if out.exists() and out.stat().st_mtime >= _SRC.stat().st_mtime:
+        out = _cache_dir() / _lib_name()
+        if out.exists():
             return out
         # build into a temp file then atomically rename, so concurrent
         # processes never load a half-written library
@@ -76,20 +84,20 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(str(path))
-    except OSError:
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.mt_levenshtein.restype = ctypes.c_int32
+        lib.mt_levenshtein.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32]
+        lib.mt_levenshtein_batch.restype = None
+        lib.mt_levenshtein_batch.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i32p]
+        lib.mt_levenshtein_matrix.restype = None
+        lib.mt_levenshtein_matrix.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32, i32p]
+        lib.mt_lcs.restype = ctypes.c_int32
+        lib.mt_lcs.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32]
+        lib.mt_lcs_batch.restype = None
+        lib.mt_lcs_batch.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i32p]
+    except (OSError, AttributeError):
         return None
-    i32p = ctypes.POINTER(ctypes.c_int32)
-    i64p = ctypes.POINTER(ctypes.c_int64)
-    lib.mt_levenshtein.restype = ctypes.c_int32
-    lib.mt_levenshtein.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32]
-    lib.mt_levenshtein_batch.restype = None
-    lib.mt_levenshtein_batch.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i32p]
-    lib.mt_levenshtein_matrix.restype = None
-    lib.mt_levenshtein_matrix.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32, i32p]
-    lib.mt_lcs.restype = ctypes.c_int32
-    lib.mt_lcs.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32]
-    lib.mt_lcs_batch.restype = None
-    lib.mt_lcs_batch.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i32p]
     _lib = lib
     return _lib
 

@@ -50,7 +50,9 @@ def _bincount_kernel(x_ref, w_ref, out_ref, *, tl: int):
     out_ref[...] += jnp.dot(w_ref[...], onehot, preferred_element_type=jnp.float32)
 
 
-def _pallas_weighted_bincount(x: jax.Array, weights: jax.Array, length: int) -> jax.Array:
+def _pallas_weighted_bincount(
+    x: jax.Array, weights: jax.Array, length: int, *, interpret: bool = False
+) -> jax.Array:
     import jax.experimental.pallas as pl
 
     n = x.shape[0]
@@ -69,6 +71,7 @@ def _pallas_weighted_bincount(x: jax.Array, weights: jax.Array, length: int) -> 
         ],
         out_specs=pl.BlockSpec((1, _TL), lambda lj, ni: (0, lj)),
         out_shape=jax.ShapeDtypeStruct((1, lp), jnp.float32),
+        interpret=interpret,
     )(x, w)
     return out[0, :length]
 

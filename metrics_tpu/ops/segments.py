@@ -50,12 +50,7 @@ def segment_ranks(
     return jnp.arange(segment_ids.shape[0], dtype=jnp.int32) - starts[segment_ids] + 1
 
 
-def segment_cumsum(
-    data: jax.Array,
-    segment_ids: jax.Array,
-    num_segments: int,
-    starts: Optional[jax.Array] = None,
-) -> jax.Array:
+def segment_cumsum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
     """Inclusive cumsum of ``data`` restarting at every segment boundary.
 
     Implemented as a segmented associative scan (flag-reset operator), NOT as
@@ -64,7 +59,7 @@ def segment_cumsum(
     values become the difference of two huge prefix sums), while the segmented
     scan only ever accumulates within a group.
     """
-    del starts  # not needed by the scan formulation; kept for API stability
+    del num_segments  # segment boundaries are derived from the ids directly
     if data.shape[0] == 0:
         return data
     is_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), segment_ids[1:] != segment_ids[:-1]])

@@ -56,6 +56,16 @@ class GroupedRows:
         """Row index of rank ``kv`` (clamped to [1, count]) in each group."""
         return self.starts + jnp.clip(kv, 1, self.counts) - 1
 
+    def n_neg(self) -> jax.Array:
+        """Per-group count of non-relevant rows (memoized — shared by the
+        fall-out kernel and the empty-group validity check)."""
+        cached = self.__dict__.get("_n_neg")
+        if cached is None:
+            nonrel = 1.0 - (self.rel > 0).astype(jnp.float32)
+            cached = segment_sum(nonrel, self.seg, self.num_groups)
+            object.__setattr__(self, "_n_neg", cached)
+        return cached
+
     def k_eff(self, k: Optional[int]) -> jax.Array:
         """Effective per-group k: ``min(k, count)`` (count when ``k`` is None)."""
         return self.counts if k is None else jnp.minimum(k, self.counts)
@@ -81,7 +91,7 @@ def group_rows(indexes: jax.Array, preds: jax.Array, target: jax.Array) -> Group
         preds=p,
         rel=rel,
         ranks=segment_ranks(seg, g, starts=starts),
-        cumrel=segment_cumsum(rel, seg, g, starts=starts),
+        cumrel=segment_cumsum(rel, seg, g),
         counts=counts,
         starts=starts,
         n_pos=segment_sum(rel, seg, g),
@@ -147,10 +157,7 @@ class RetrievalMetric(Metric):
 
     def _group_valid(self, ctx: GroupedRows) -> jax.Array:
         if self._empty_when_no == "neg":
-            n_neg = ctx.counts.astype(jnp.float32) - segment_sum(
-                (ctx.rel > 0).astype(jnp.float32), ctx.seg, ctx.num_groups
-            )
-            return n_neg > 0
+            return ctx.n_neg() > 0
         return ctx.n_pos > 0
 
     def _apply_empty_action(self, values: jax.Array, valid: jax.Array) -> jax.Array:

@@ -79,8 +79,8 @@ class RetrievalFallOut(_RetrievalKMetric):
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         kv = ctx.k_eff(self.k)
         nonrel = 1.0 - (ctx.rel > 0).astype(jnp.float32)
-        cum_nonrel = segment_cumsum(nonrel, ctx.seg, ctx.num_groups, starts=ctx.starts)
-        n_neg = segment_sum(nonrel, ctx.seg, ctx.num_groups)
+        cum_nonrel = segment_cumsum(nonrel, ctx.seg, ctx.num_groups)
+        n_neg = ctx.n_neg()
         found = cum_nonrel[ctx.idx_at(kv)]
         return jnp.where(n_neg > 0, found / jnp.maximum(n_neg, 1.0), 0.0)
 
@@ -101,13 +101,13 @@ class RetrievalNormalizedDCG(_RetrievalKMetric):
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         kv = ctx.k_eff(self.k)
         discount = 1.0 / jnp.log2(ctx.ranks.astype(jnp.float32) + 1.0)
-        dcg_cum = segment_cumsum(ctx.rel * discount, ctx.seg, ctx.num_groups, starts=ctx.starts)
+        dcg_cum = segment_cumsum(ctx.rel * discount, ctx.seg, ctx.num_groups)
         dcg = dcg_cum[ctx.idx_at(kv)]
         # ideal ordering: re-sort rows by (group, -gain)
         order1 = jnp.argsort(-ctx.rel, stable=True)
         order2 = jnp.argsort(ctx.seg[order1], stable=True)
         ideal = ctx.rel[order1][order2]
-        idcg_cum = segment_cumsum(ideal * discount, ctx.seg, ctx.num_groups, starts=ctx.starts)
+        idcg_cum = segment_cumsum(ideal * discount, ctx.seg, ctx.num_groups)
         idcg = idcg_cum[ctx.idx_at(kv)]
         return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
 

@@ -1,0 +1,204 @@
+"""Exhaustive differential tests vs the mounted reference implementation.
+
+The analogue of the reference's per-metric parametrized matrices
+(`tests/unittests/classification/test_{accuracy,precision_recall,...}.py`):
+every (metric x input-type x average x mdmc x ignore_index x top_k) cell is
+checked against the reference running the identical inputs on torch/CPU.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.classification.inputs import (
+    _binary,
+    _binary_prob,
+    _multiclass,
+    _multiclass_prob,
+    _multidim_multiclass,
+    _multilabel,
+    _multilabel_prob,
+)
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+_INPUT_CASES = {
+    "binary_prob": _binary_prob,
+    "binary": _binary,
+    "multiclass_prob": _multiclass_prob,
+    "multiclass": _multiclass,
+    "multilabel_prob": _multilabel_prob,
+    "multilabel": _multilabel,
+    "mdmc": _multidim_multiclass,
+}
+
+_STAT_METRICS = ["Accuracy", "Precision", "Recall", "F1Score", "Specificity"]
+
+
+def _to_torch(x):
+    return torch.tensor(np.asarray(x))
+
+
+def _run_pair(name_ours, name_ref, inputs, our_kwargs, ref_kwargs=None, atol=1e-6):
+    """Stream all batches through both implementations; compare every compute."""
+    ref_kwargs = ref_kwargs if ref_kwargs is not None else our_kwargs
+    ours = getattr(mt, name_ours)(**our_kwargs)
+    ref = getattr(_ref, name_ref)(**ref_kwargs)
+    for i in range(inputs.preds.shape[0]):
+        ours.update(inputs.preds[i], inputs.target[i])
+        ref.update(_to_torch(inputs.preds[i]), _to_torch(inputs.target[i]))
+    ours_val = np.asarray(ours.compute())
+    ref_val = ref.compute()
+    if isinstance(ref_val, (list, tuple)):
+        ref_val = torch.stack([torch.as_tensor(v) for v in ref_val])
+    np.testing.assert_allclose(ours_val, ref_val.numpy(), atol=atol, rtol=1e-5)
+
+
+@pytest.mark.parametrize("metric", _STAT_METRICS)
+@pytest.mark.parametrize("case", ["binary_prob", "binary", "multiclass_prob", "multiclass", "multilabel_prob"])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+def test_stat_scores_family_matrix(metric, case, average):
+    # int-valued multilabel inputs are mdmc to the reference (see
+    # test_multilabel_int_is_mdmc); macro/weighted require num_classes
+    # (1 for binary) — invalid cells raise identically in both implementations
+    inputs = _INPUT_CASES[case]
+    kwargs = {"average": average}
+    if case.startswith("binary"):
+        if average != "micro":
+            kwargs["num_classes"] = 1
+            if case == "binary":
+                # int-valued 0/1 preds classify as 2-class multiclass; both
+                # implementations require the multiclass=False hint here
+                kwargs["multiclass"] = False
+    else:
+        kwargs["num_classes"] = 5
+    _run_pair(metric, metric, inputs, kwargs)
+
+
+@pytest.mark.parametrize("metric", ["Accuracy", "Precision"])
+def test_multilabel_int_is_mdmc(metric):
+    kwargs = {"average": "macro", "num_classes": 5, "mdmc_average": "global"}
+    _run_pair(metric, metric, _multilabel, kwargs)
+
+
+@pytest.mark.parametrize("metric", ["Precision", "Recall"])
+def test_invalid_macro_without_num_classes_raises_like_reference(metric):
+    with pytest.raises(ValueError, match="you have to provide the number of classes"):
+        getattr(mt, metric)(average="macro")
+    with pytest.raises(ValueError, match="you have to provide the number of classes"):
+        getattr(_ref, metric)(average="macro")
+
+
+@pytest.mark.parametrize("metric", ["Accuracy", "Precision", "Recall"])
+@pytest.mark.parametrize("mdmc", ["global", "samplewise"])
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multidim_multiclass(metric, mdmc, average):
+    kwargs = {"average": average, "mdmc_average": mdmc, "num_classes": 5}
+    _run_pair(metric, metric, _multidim_multiclass, kwargs)
+
+
+@pytest.mark.parametrize("metric", ["Accuracy", "Precision", "Recall", "F1Score"])
+@pytest.mark.parametrize("ignore_index", [0, 2])
+def test_ignore_index(metric, ignore_index):
+    kwargs = {"num_classes": 5, "average": "macro", "ignore_index": ignore_index}
+    _run_pair(metric, metric, _multiclass_prob, kwargs)
+
+
+@pytest.mark.parametrize("top_k", [1, 2, 3])
+def test_top_k_accuracy(top_k):
+    kwargs = {"num_classes": 5, "top_k": top_k}
+    _run_pair("Accuracy", "Accuracy", _multiclass_prob, kwargs)
+
+
+@pytest.mark.parametrize("beta", [0.5, 2.0])
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_fbeta(beta, average):
+    kwargs = {"num_classes": 5, "beta": beta, "average": average}
+    _run_pair("FBetaScore", "FBetaScore", _multiclass_prob, kwargs)
+
+
+@pytest.mark.parametrize("case", ["binary_prob", "multiclass_prob", "multilabel_prob"])
+def test_average_none_returns_per_class(case):
+    inputs = _INPUT_CASES[case]
+    kwargs = {"average": "none", "num_classes": 1 if case.startswith("binary") else 5}
+    _run_pair("Precision", "Precision", inputs, kwargs)
+
+
+@pytest.mark.parametrize("threshold", [0.3, 0.5, 0.7])
+def test_threshold_sweep(threshold):
+    _run_pair("Accuracy", "Accuracy", _binary_prob, {"threshold": threshold})
+
+
+@pytest.mark.parametrize("case", ["binary", "multiclass", "multilabel"])
+def test_confusion_matrix_parity(case):
+    inputs = _INPUT_CASES[case]
+    kwargs = {"num_classes": 2 if case == "binary" else 5}
+    if case == "multilabel":
+        kwargs["multilabel"] = True
+    _run_pair("ConfusionMatrix", "ConfusionMatrix", inputs, kwargs)
+
+
+@pytest.mark.parametrize("normalize", ["true", "pred", "all"])
+def test_confusion_matrix_normalized(normalize):
+    _run_pair(
+        "ConfusionMatrix", "ConfusionMatrix", _multiclass, {"num_classes": 5, "normalize": normalize}
+    )
+
+
+@pytest.mark.parametrize("metric,kwargs", [
+    ("CohenKappa", {"num_classes": 5}),
+    ("CohenKappa", {"num_classes": 5, "weights": "linear"}),
+    ("CohenKappa", {"num_classes": 5, "weights": "quadratic"}),
+    ("MatthewsCorrCoef", {"num_classes": 5}),
+    ("JaccardIndex", {"num_classes": 5}),
+    ("JaccardIndex", {"num_classes": 5, "average": "none"}),
+])
+def test_confmat_family(metric, kwargs):
+    _run_pair(metric, metric, _multiclass_prob, kwargs)
+
+
+@pytest.mark.parametrize("metric,kwargs,atol", [
+    ("AUROC", {}, 1e-5),
+    ("AveragePrecision", {}, 1e-5),
+    ("CalibrationError", {"norm": "l1"}, 1e-5),
+    ("CalibrationError", {"norm": "max"}, 1e-5),
+    ("HingeLoss", {}, 1e-4),
+])
+def test_binary_prob_metrics(metric, kwargs, atol):
+    _run_pair(metric, metric, _binary_prob, kwargs, atol=atol)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+def test_auroc_multiclass(average):
+    _run_pair("AUROC", "AUROC", _multiclass_prob, {"num_classes": 5, "average": average}, atol=1e-5)
+
+
+def test_kl_divergence():
+    rng = np.random.RandomState(0)
+    p = rng.rand(4, 32, 5) + 1e-3
+    q = rng.rand(4, 32, 5) + 1e-3
+    p /= p.sum(-1, keepdims=True)
+    q /= q.sum(-1, keepdims=True)
+    ours = mt.KLDivergence()
+    ref = _ref.KLDivergence()
+    for i in range(4):
+        ours.update(jnp.asarray(p[i]), jnp.asarray(q[i]))
+        ref.update(torch.tensor(p[i]), torch.tensor(q[i]))
+    np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("metric", ["CoverageError", "LabelRankingAveragePrecision", "LabelRankingLoss"])
+def test_ranking_metrics(metric):
+    _run_pair(metric, metric, _multilabel_prob, {}, atol=1e-5)
+
+
+def test_dice():
+    _run_pair("Dice", "Dice", _multiclass_prob, {"num_classes": 5, "average": "micro"})
+
+
+def test_stat_scores_raw():
+    _run_pair("StatScores", "StatScores", _multiclass_prob, {"num_classes": 5, "reduce": "macro"})

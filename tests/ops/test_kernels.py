@@ -18,32 +18,15 @@ from metrics_tpu.ops import (
     segment_starts,
     segment_sum,
 )
-from metrics_tpu.ops.histogram import _bincount_kernel, _TN, _TL
 
 
 def _pallas_interpret_bincount(x, weights, length):
-    """Run the real Pallas kernel in interpreter mode on CPU."""
-    import functools
+    """Run the REAL production wrapper in interpreter mode on CPU."""
+    from metrics_tpu.ops.histogram import _pallas_weighted_bincount
 
-    import jax.experimental.pallas as pl
-
-    n = x.shape[0]
-    np_ = -(-n // _TN) * _TN
-    lp = -(-length // _TL) * _TL
-    xp = jnp.pad(jnp.asarray(x, jnp.int32), (0, np_ - n), constant_values=-1).reshape(1, np_)
-    wp = jnp.pad(jnp.asarray(weights, jnp.float32), (0, np_ - n)).reshape(1, np_)
-    out = pl.pallas_call(
-        functools.partial(_bincount_kernel, tl=_TL),
-        grid=(lp // _TL, np_ // _TN),
-        in_specs=[
-            pl.BlockSpec((1, _TN), lambda lj, ni: (0, ni)),
-            pl.BlockSpec((1, _TN), lambda lj, ni: (0, ni)),
-        ],
-        out_specs=pl.BlockSpec((1, _TL), lambda lj, ni: (0, lj)),
-        out_shape=jax.ShapeDtypeStruct((1, lp), jnp.float32),
-        interpret=True,
-    )(xp, wp)
-    return out[0, :length]
+    return _pallas_weighted_bincount(
+        jnp.asarray(x, jnp.int32), jnp.asarray(weights, jnp.float32), length, interpret=True
+    )
 
 
 class TestFusedBincount:

@@ -1,0 +1,90 @@
+"""Differential tests for the L4 wrappers vs the mounted reference."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+_rng = np.random.RandomState(3)
+_PREDS = _rng.rand(4, 32, 5).astype(np.float32)
+_PREDS /= _PREDS.sum(-1, keepdims=True)
+_TARGET = _rng.randint(0, 5, (4, 32))
+_REG_P = _rng.randn(4, 32, 2).astype(np.float32)
+_REG_T = (_REG_P + 0.3 * _rng.randn(4, 32, 2)).astype(np.float32)
+
+
+def test_classwise_wrapper_parity():
+    ours = mt.ClasswiseWrapper(mt.Accuracy(num_classes=5, average="none"))
+    ref = _ref.ClasswiseWrapper(_ref.Accuracy(num_classes=5, average="none"))
+    for i in range(4):
+        ours.update(jnp.asarray(_PREDS[i]), jnp.asarray(_TARGET[i]))
+        ref.update(torch.tensor(_PREDS[i]), torch.tensor(_TARGET[i]))
+    ov, rv = ours.compute(), ref.compute()
+    assert set(ov) == set(rv)
+    for k in ov:
+        np.testing.assert_allclose(np.asarray(ov[k]), rv[k].numpy(), atol=1e-6)
+
+
+def test_minmax_parity():
+    ours = mt.MinMaxMetric(mt.Accuracy(num_classes=5))
+    ref = _ref.MinMaxMetric(_ref.Accuracy(num_classes=5))
+    for i in range(4):
+        ours(jnp.asarray(_PREDS[i]), jnp.asarray(_TARGET[i]))
+        ref(torch.tensor(_PREDS[i]), torch.tensor(_TARGET[i]))
+    ov, rv = ours.compute(), ref.compute()
+    for k in ("raw", "min", "max"):
+        np.testing.assert_allclose(np.asarray(ov[k]), rv[k].numpy(), atol=1e-6)
+
+
+def test_multioutput_parity():
+    ours = mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=2)
+    ref = _ref.MultioutputWrapper(_ref.MeanSquaredError(), num_outputs=2)
+    for i in range(4):
+        ours.update(jnp.asarray(_REG_P[i]), jnp.asarray(_REG_T[i]))
+        ref.update(torch.tensor(_REG_P[i]), torch.tensor(_REG_T[i]))
+    ov = np.asarray(ours.compute())
+    rv = torch.stack(list(ref.compute())).numpy() if isinstance(ref.compute(), (list, tuple)) else ref.compute().numpy()
+    np.testing.assert_allclose(ov.reshape(-1), rv.reshape(-1), atol=1e-6)
+
+
+def test_tracker_parity():
+    ours = mt.MetricTracker(mt.Accuracy(num_classes=5), maximize=True)
+    ref = _ref.MetricTracker(_ref.Accuracy(num_classes=5), maximize=True)
+    for step in range(3):
+        ours.increment()
+        ref.increment()
+        for i in range(2):
+            ours.update(jnp.asarray(_PREDS[(step + i) % 4]), jnp.asarray(_TARGET[(step + i) % 4]))
+            ref.update(torch.tensor(_PREDS[(step + i) % 4]), torch.tensor(_TARGET[(step + i) % 4]))
+    np.testing.assert_allclose(
+        np.asarray(ours.compute_all()), ref.compute_all().numpy(), atol=1e-6
+    )
+    ob, oi = ours.best_metric(return_step=True)
+    rb, ri = ref.best_metric(return_step=True)
+    np.testing.assert_allclose(float(ob), float(rb), atol=1e-6)
+    assert int(oi) == int(ri)
+
+
+def test_bootstrapper_statistics():
+    """Bootstrap RNG streams differ; the bootstrap MEAN must agree within
+    sampling error and std must be positive for a non-degenerate metric."""
+    base_val = None
+    ours = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=50, mean=True, std=True)
+    ref = _ref.BootStrapper(_ref.MeanSquaredError(), num_bootstraps=50, mean=True, std=True)
+    torch.manual_seed(0)
+    for i in range(4):
+        ours.update(jnp.asarray(_REG_P[i, :, 0]), jnp.asarray(_REG_T[i, :, 0]))
+        ref.update(torch.tensor(_REG_P[i, :, 0]), torch.tensor(_REG_T[i, :, 0]))
+        base_val = float(mt.functional.mean_squared_error(
+            jnp.asarray(_REG_P[: i + 1, :, 0].ravel()), jnp.asarray(_REG_T[: i + 1, :, 0].ravel())
+        ))
+    ov, rv = ours.compute(), ref.compute()
+    assert abs(float(ov["mean"]) - base_val) < 0.1 * base_val + 0.05
+    assert abs(float(rv["mean"]) - base_val) < 0.1 * base_val + 0.05
+    assert float(ov["std"]) > 0

@@ -83,7 +83,9 @@ class TestMinMax:
         mm.reset()
         mm.update(jnp.asarray([1.0]))
         out3 = mm.compute()
-        assert float(out3["min"]) == 1.0 and float(out3["max"]) == 1.0
+        # extrema survive reset (reference contract: running extrema are
+        # unregistered attributes, reset only clears the base metric)
+        assert float(out3["min"]) == 1.0 and float(out3["max"]) == 5.0
 
     def test_invalid_base(self):
         with pytest.raises(ValueError, match="base metric"):
