@@ -25,6 +25,8 @@ import jax.numpy as jnp
 
 from metrics_tpu.utils.checks import _check_same_shape
 
+from metrics_tpu.utils.compute import high_precision
+
 
 def _symmetric_toeplitz(vector: jax.Array) -> jax.Array:
     """Symmetric Toeplitz matrix from its first row: ``T[..., i, j] = v[..., |i-j|]``."""
@@ -84,6 +86,7 @@ def _toeplitz_conjugate_gradient(r_0: jax.Array, b: jax.Array, n_iter: int) -> j
     return x
 
 
+@high_precision
 def signal_distortion_ratio(
     preds: jax.Array,
     target: jax.Array,

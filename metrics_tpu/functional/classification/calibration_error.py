@@ -88,7 +88,7 @@ def calibration_error(preds: jax.Array, target: jax.Array, n_bins: int = 15, nor
         >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
         >>> target = jnp.asarray([0, 0, 1, 1, 1])
         >>> calibration_error(preds, target, n_bins=2, norm='l1')
-        Array(0.29, dtype=float32)
+        Array(0.29000002, dtype=float32)
     """
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")

@@ -13,12 +13,14 @@ from metrics_tpu.functional.classification.confusion_matrix import (
     _confusion_matrix_compute,
     _confusion_matrix_update,
 )
+from metrics_tpu.utils.compute import high_precision
 
 
 def _cohen_kappa_update(preds, target, num_classes: int, threshold: float = 0.5) -> jax.Array:
     return _confusion_matrix_update(preds, target, num_classes, threshold)
 
 
+@high_precision
 def _cohen_kappa_compute(confmat: jax.Array, weights: Optional[str] = None) -> jax.Array:
     confmat = _confusion_matrix_compute(confmat).astype(jnp.float32)
     n_classes = confmat.shape[0]

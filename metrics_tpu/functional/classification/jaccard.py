@@ -70,7 +70,7 @@ def jaccard_index(
         >>> target = jnp.asarray([[0, 1, 1], [1, 1, 0]])
         >>> pred = jnp.asarray([[0, 1, 0], [1, 1, 1]])
         >>> jaccard_index(pred, target, num_classes=2)
-        Array(0.5833334, dtype=float32)
+        Array(0.4666667, dtype=float32)
     """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
     return _jaccard_from_confmat(confmat, num_classes, average, ignore_index, absent_score)

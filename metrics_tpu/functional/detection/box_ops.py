@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.utils.compute import high_precision
+
 
 def box_convert(boxes: jax.Array, in_fmt: str, out_fmt: str) -> jax.Array:
     """Convert between xyxy / xywh / cxcywh box formats."""
@@ -53,6 +55,7 @@ def box_iou(boxes1: jax.Array, boxes2: jax.Array) -> jax.Array:
     return inter / union
 
 
+@high_precision
 def mask_iou(masks1: jax.Array, masks2: jax.Array) -> jax.Array:
     """Pairwise IoU of boolean masks: (N, H, W) × (M, H, W) → (N, M)."""
     m1 = masks1.reshape(masks1.shape[0], -1).astype(jnp.float32)

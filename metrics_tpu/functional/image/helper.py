@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from metrics_tpu.utils.compute import high_precision
+
 
 def _gaussian(kernel_size: int, sigma: float, dtype) -> jax.Array:
     dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1, dtype=dtype)
@@ -37,6 +39,7 @@ def _uniform_kernel(kernel_size: Sequence[int], dtype=jnp.float32) -> jax.Array:
     return jnp.ones(tuple(kernel_size), dtype=dtype) / float(jnp.prod(jnp.asarray(kernel_size)))
 
 
+@high_precision
 def _depthwise_conv(x: jax.Array, kernel: jax.Array) -> jax.Array:
     """Depthwise (per-channel) valid convolution.
 
@@ -64,6 +67,7 @@ def _reflect_pad(x: jax.Array, pads: Sequence[Tuple[int, int]]) -> jax.Array:
     return jnp.pad(x, pad_width, mode="reflect")
 
 
+@high_precision
 def _avg_pool(x: jax.Array, window: int = 2) -> jax.Array:
     """Non-overlapping average pool over all spatial dims of (B, C, *spatial)."""
     nd = x.ndim - 2

@@ -12,6 +12,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.utils.compute import high_precision
+
 
 def _check_pairwise_input(x: jax.Array, y: Optional[jax.Array], zero_diagonal: Optional[bool]) -> Tuple:
     if x.ndim != 2:
@@ -46,6 +48,7 @@ def _reduce_distance_matrix(distance: jax.Array, reduction: Optional[str]) -> ja
     raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
 
 
+@high_precision
 def pairwise_cosine_similarity(
     x: jax.Array,
     y: Optional[jax.Array] = None,
@@ -60,9 +63,9 @@ def pairwise_cosine_similarity(
         >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
         >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
         >>> pairwise_cosine_similarity(x, y).round(4)
-        Array([[0.5547, 0.8682],
-               [0.5145, 0.8437],
-               [0.5301, 0.8533]], dtype=float32)
+        Array([[0.55469996, 0.8682    ],
+               [0.51449996, 0.8437    ],
+               [0.53      , 0.8533    ]], dtype=float32)
     """
     x, y, zero_diagonal = _check_pairwise_input(x, y, zero_diagonal)
     norm_x = jnp.linalg.norm(x, axis=1, keepdims=True)
@@ -72,6 +75,7 @@ def pairwise_cosine_similarity(
     return _reduce_distance_matrix(distance, reduction)
 
 
+@high_precision
 def pairwise_euclidean_distance(
     x: jax.Array,
     y: Optional[jax.Array] = None,
@@ -86,9 +90,9 @@ def pairwise_euclidean_distance(
         >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
         >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
         >>> pairwise_euclidean_distance(x, y).round(4)
-        Array([[3.1623, 2.    ],
-               [5.3852, 4.1231],
-               [8.9443, 7.6158]], dtype=float32)
+        Array([[3.1622999, 2.       ],
+               [5.3852   , 4.1231   ],
+               [8.9443   , 7.6158   ]], dtype=float32)
     """
     x, y, zero_diagonal = _check_pairwise_input(x, y, zero_diagonal)
     x_norm = (x * x).sum(axis=1, keepdims=True)
@@ -98,6 +102,7 @@ def pairwise_euclidean_distance(
     return _reduce_distance_matrix(jnp.sqrt(jnp.clip(distance, min=0.0)), reduction)
 
 
+@high_precision
 def pairwise_linear_similarity(
     x: jax.Array,
     y: Optional[jax.Array] = None,
@@ -122,6 +127,7 @@ def pairwise_linear_similarity(
     return _reduce_distance_matrix(distance, reduction)
 
 
+@high_precision
 def pairwise_manhattan_distance(
     x: jax.Array,
     y: Optional[jax.Array] = None,

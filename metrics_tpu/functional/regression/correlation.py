@@ -93,7 +93,7 @@ def pearson_corrcoef(preds, target) -> jax.Array:
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
         >>> pearson_corrcoef(preds, target)
-        Array(0.98540974, dtype=float32)
+        Array(0.98486954, dtype=float32)
     """
     zero = jnp.asarray(0.0)
     _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
@@ -146,7 +146,7 @@ def spearman_corrcoef(preds, target) -> jax.Array:
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
         >>> spearman_corrcoef(preds, target)
-        Array(0.99999994, dtype=float32)
+        Array(0.9999992, dtype=float32)
     """
     preds, target = _spearman_corrcoef_update(preds, target)
     return _spearman_corrcoef_compute(preds, target)
@@ -180,7 +180,7 @@ def cosine_similarity(preds, target, reduction: Optional[str] = "sum") -> jax.Ar
         >>> target = jnp.asarray([[0.0, 1.0], [1.0, 1.0]])
         >>> preds = jnp.asarray([[0.0, 1.0], [0.0, 1.0]])
         >>> cosine_similarity(preds, target, 'mean')
-        Array(0.85355335, dtype=float32)
+        Array(0.8535534, dtype=float32)
     """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

@@ -73,7 +73,7 @@ def explained_variance(preds, target, multioutput: str = "uniform_average") -> j
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
         >>> explained_variance(preds, target)
-        Array(0.95717883, dtype=float32)
+        Array(0.95717347, dtype=float32)
     """
     return _explained_variance_compute(*_explained_variance_update(preds, target), multioutput=multioutput)
 
@@ -144,7 +144,7 @@ def r2_score(preds, target, adjusted: int = 0, multioutput: str = "uniform_avera
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
         >>> r2_score(preds, target)
-        Array(0.9486081, dtype=float32)
+        Array(0.94860816, dtype=float32)
     """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)
@@ -204,7 +204,7 @@ def tweedie_deviance_score(preds, targets, power: float = 0.0) -> jax.Array:
         >>> targets = jnp.asarray([1.0, 2.0, 3.0, 4.0])
         >>> preds = jnp.asarray([4.0, 3.0, 2.0, 1.0])
         >>> tweedie_deviance_score(preds, targets, power=2)
-        Array(1.2083334, dtype=float32)
+        Array(1.2083333, dtype=float32)
     """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

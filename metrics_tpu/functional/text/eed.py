@@ -159,7 +159,7 @@ def extended_edit_distance(
         >>> preds = ["this is the prediction", "here is an other sample"]
         >>> target = ["this is the reference", "here is another one"]
         >>> extended_edit_distance(preds, target)
-        Array(0.3078413, dtype=float32)
+        Array(0.30776307, dtype=float32)
     """
     for param, name in ((alpha, "alpha"), (rho, "rho"), (deletion, "deletion"), (insertion, "insertion")):
         if not isinstance(param, float) or (isinstance(param, float) and param < 0):

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from metrics_tpu.utils.enums import EnumStr
 from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
 
+from metrics_tpu.utils.compute import high_precision
+
 
 class _IMEnum(EnumStr):
     KL_DIVERGENCE = "kl_divergence"
@@ -113,6 +115,7 @@ def _load_mlm(model_name_or_path: str):
     return tokenizer, model
 
 
+@high_precision
 def _sentence_distribution(
     sentences: List[str],
     tokenizer,

@@ -116,7 +116,7 @@ def sacre_bleu_score(
         >>> preds = ['the cat is on the mat']
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> sacre_bleu_score(preds, target)
-        Array(0.75762904, dtype=float32)
+        Array(0.75983566, dtype=float32)
     """
     if len(preds) != len(target):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")

@@ -287,7 +287,7 @@ def translation_edit_rate(
         >>> preds = ['the cat is on the mat']
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> translation_edit_rate(preds, target)
-        Array(0.1538462, dtype=float32)
+        Array(0.15384616, dtype=float32)
     """
     if not isinstance(normalize, bool):
         raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")

@@ -57,7 +57,7 @@ def char_error_rate(preds, target) -> jax.Array:
     Example:
         >>> from metrics_tpu.functional import char_error_rate
         >>> char_error_rate(["this is the prediction"], ["this is the reference"])
-        Array(0.42857143, dtype=float32)
+        Array(0.3809524, dtype=float32)
     """
     errors, total = _cer_update(preds, target)
     return errors / total
@@ -113,7 +113,7 @@ def word_information_preserved(preds, target) -> jax.Array:
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> word_information_preserved(preds, target)
-        Array(0.3472222, dtype=float32)
+        Array(0.34722224, dtype=float32)
     """
     hits, target_total, preds_total = _wil_wip_update(preds, target)
     return (hits / target_total) * (hits / preds_total)

@@ -29,6 +29,8 @@ import functools
 from typing import Any, Callable, Dict, Sequence, Tuple
 
 import jax
+
+from metrics_tpu.utils.compute import high_precision
 import jax.numpy as jnp
 import numpy as np
 
@@ -207,7 +209,9 @@ class InceptionV3Extractor:
         self._forward = jax.jit(functools.partial(self._apply, self.model))
 
     @staticmethod
+    @high_precision
     def _apply(model: "InceptionV3", params: Any, imgs: jax.Array) -> Dict[str, jax.Array]:
+        # metric-grade features: full-precision convs (TPU default is bf16)
         return model.apply(params, imgs)
 
     def __call__(self, imgs: jax.Array) -> jax.Array:

@@ -17,6 +17,8 @@ import functools
 from typing import Any, Dict, List, Sequence, Tuple
 
 import jax
+
+from metrics_tpu.utils.compute import high_precision
 import jax.numpy as jnp
 
 import flax.linen as nn
@@ -153,6 +155,7 @@ class LPIPSExtractor:
         self._forward = jax.jit(functools.partial(self._apply, self.model))
 
     @staticmethod
+    @high_precision
     def _apply(model: "LPIPSNet", params: Any, img1: jax.Array, img2: jax.Array) -> jax.Array:
         return model.apply(params, img1, img2)
 

@@ -23,7 +23,10 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.utils.compute import high_precision
 
+
+@high_precision
 def binned_curve_counts(
     preds: jax.Array, target: jax.Array, thresholds: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

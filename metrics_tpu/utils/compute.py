@@ -4,12 +4,35 @@ Parity: reference `src/torchmetrics/utilities/compute.py` (``_safe_xlogy`` etc.)
 """
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import Array
 
 
+def high_precision(fn):
+    """Run ``fn`` with float32 contractions at full (HIGHEST) precision.
+
+    XLA's TPU default lowers float32 matmuls/convs to bf16 MXU passes, which
+    quantizes metric values onto a coarse grid (measured: pairwise cosine
+    similarities landing on exact 1/256 steps, count contractions losing
+    integer exactness above 256). Metrics are measurements — every contraction
+    in this library opts into HIGHEST precision. This is a trace-time config,
+    so it composes with ``jit`` and costs nothing on CPU.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
 def _safe_matmul(x: Array, y: Array) -> Array:
-    return jnp.matmul(x, y)
+    with jax.default_matmul_precision("highest"):
+        return jnp.matmul(x, y)
 
 
 def _safe_xlogy(x: Array, y: Array) -> Array:
@@ -41,4 +64,4 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
     return direction * jnp.trapezoid(y, x)
 
 
-__all__ = ["_safe_xlogy", "_safe_divide", "_auc_compute", "_safe_matmul"]
+__all__ = ["high_precision", "_safe_xlogy", "_safe_divide", "_auc_compute", "_safe_matmul"]

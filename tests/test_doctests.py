@@ -1,0 +1,50 @@
+"""Run every docstring example as a test (reference `Makefile:22-25` parity).
+
+Doctests execute on the pinned 8-device CPU backend (tests/conftest.py), which
+is what the expected strings were generated on; float formatting is platform-
+deterministic there. Running doctests directly on a TPU backend can print
+last-ulp-different values for a handful of reduction-heavy examples (different
+fma/reduction order) — that is expected; the CPU run is the contract, same as
+the reference generating its tensor reprs on its CPU CI.
+"""
+import contextlib
+import doctest
+import importlib
+import io
+import pkgutil
+
+import pytest
+
+import metrics_tpu
+
+_SKIP_SUBSTRINGS = (
+    ".models",  # flax model defs: no examples, heavy imports
+    "native",  # ctypes loader: no examples
+)
+
+
+def _module_names():
+    names = ["metrics_tpu"]
+    for m in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu."):
+        if any(s in m.name for s in _SKIP_SUBSTRINGS):
+            continue
+        names.append(m.name)
+    return names
+
+
+@pytest.mark.parametrize("module_name", _module_names())
+def test_module_doctests(module_name):
+    try:
+        mod = importlib.import_module(module_name)
+    except ModuleNotFoundError as err:
+        pytest.skip(f"optional dependency missing: {err}")
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    runner = doctest.DocTestRunner(optionflags=doctest.NORMALIZE_WHITESPACE)
+    failures = []
+    for test in finder.find(mod, module_name):
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            result = runner.run(test, out=out.write)
+        if result.failed:
+            failures.append(out.getvalue())
+    assert not failures, "\n".join(failures)
