@@ -184,7 +184,11 @@ class Metric(ABC):
         def wrapped(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            update(*args, **kwargs)
+            # TraceAnnotation shows up in jax.profiler / xprof timelines —
+            # the analogue of the reference's TorchScript profiling markers
+            # (SURVEY §5 "Tracing / profiling")
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_host()
 
@@ -398,7 +402,8 @@ class Metric(ABC):
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ):
-                value = compute(*args, **kwargs)
+                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+                    value = compute(*args, **kwargs)
                 self._computed = _squeeze_scalar(value)
             return self._computed
 

@@ -316,7 +316,102 @@ def _check_retrieval_inputs(
     return indexes.astype(jnp.int32) if indexes.dtype != jnp.int64 else indexes, preds, target.astype(jnp.int32)
 
 
+def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
+    if isinstance(res1, (list, tuple)):
+        return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
+    if isinstance(res1, dict):
+        return all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    return bool(jnp.allclose(jnp.asarray(res1), jnp.asarray(res2), atol=atol))
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare=(10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Empirically decide whether ``full_state_update=False`` is safe + faster.
+
+    Parity: reference ``check_forward_full_state_property``
+    (`utilities/checks.py:627-729`). Runs the metric's ``forward`` in both
+    modes: if the two-update (full-state) and single-update (reduce-state)
+    paths agree on every step, times both over ``num_update_to_compare`` steps
+    and prints the recommended ``full_state_update`` setting.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> from metrics_tpu.utils.checks import check_forward_full_state_property
+        >>> check_forward_full_state_property(
+        ...     ConfusionMatrix,
+        ...     init_args={'num_classes': 3},
+        ...     input_args={'preds': jnp.asarray([0, 2, 1]), 'target': jnp.asarray([0, 1, 1])},
+        ...     num_update_to_compare=(2,), reps=1,
+        ... )  # doctest: +ELLIPSIS
+        Full state for 2 steps took: ...
+        Partial state for 2 steps took: ...
+        Recommended setting `full_state_update=...`
+    """
+    from time import perf_counter
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    fullstate = FullState(**init_args)
+    partstate = PartState(**init_args)
+
+    equal = True
+    for _ in range(num_update_to_compare[0]):
+        out1 = fullstate(**input_args)
+        try:  # failure usually means update depends on pre-existing state
+            out2 = partstate(**input_args)
+        except Exception:
+            equal = False
+            break
+        equal = equal and _allclose_recursive(out1, out2)
+
+    if equal:
+        res1 = fullstate.compute()
+        try:
+            res2 = partstate.compute()
+        except Exception:
+            equal = False
+        else:
+            equal = equal and _allclose_recursive(res1, res2)
+
+    if not equal:
+        print("Recommended setting `full_state_update=True`")
+        return
+
+    timings = np.zeros((2, len(num_update_to_compare), reps))
+    for i, metric in enumerate([fullstate, partstate]):
+        metric.reset()  # drop state accumulated during the equality phase
+        for j, steps in enumerate(num_update_to_compare):
+            for r in range(reps):
+                start = perf_counter()
+                for _ in range(steps):
+                    _ = metric(**input_args)
+                timings[i, j, r] = perf_counter() - start
+                metric.reset()
+
+    mean = timings.mean(-1)
+    std = timings.std(-1)
+    for j, steps in enumerate(num_update_to_compare):
+        print(f"Full state for {steps} steps took: {mean[0, j]:0.3f}+-{std[0, j]:0.3f}")
+        print(f"Partial state for {steps} steps took: {mean[1, j]:0.3f}+-{std[1, j]:0.3f}")
+    faster = bool(mean[1, -1] < mean[0, -1])
+    print(f"Recommended setting `full_state_update={not faster}`")
+
+
 __all__ = [
+    "check_forward_full_state_property",
     "_input_format_classification",
     "_check_classification_inputs",
     "_check_same_shape",
