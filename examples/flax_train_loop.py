@@ -12,8 +12,12 @@ Runs on any platform; on a CPU-only host it builds a virtual 8-device mesh:
     python examples/flax_train_loop.py
 """
 import os
+import sys
 
-if "--real-devices" not in __import__("sys").argv and "XLA_FLAGS" not in os.environ:
+# runnable from a clean checkout without installing: put the repo root first
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--real-devices" not in sys.argv and "XLA_FLAGS" not in os.environ:
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 
 import jax

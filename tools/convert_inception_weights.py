@@ -1,0 +1,96 @@
+"""Convert torch-fidelity InceptionV3 weights to the metrics_tpu npz format.
+
+Usage:
+    python tools/convert_inception_weights.py pt_inception-2015-12-05.pth out.npz
+    # then: FrechetInceptionDistance(feature=2048, npz_path="out.npz")
+
+The source checkpoint is torch-fidelity's ``FeatureExtractorInceptionV3``
+state dict (the exact weights the reference uses for FID/KID/IS —
+`image/fid.py:27-45`). This environment has no network egress, so conversion
+runs wherever the .pth already exists; the mapping itself is validated
+structurally in `tests/models/test_weight_converter.py` by round-tripping a
+synthetic state dict generated from the Flax model's own parameter tree.
+
+Mapping (torch -> flax):
+    {m}.conv.weight   (O,I,H,W)  -> params/{m}/conv/kernel   (H,W,I,O)
+    {m}.bn.weight                -> params/{m}/bn/scale
+    {m}.bn.bias                  -> params/{m}/bn/bias
+    {m}.bn.running_mean          -> batch_stats/{m}/bn/mean
+    {m}.bn.running_var           -> batch_stats/{m}/bn/var
+    fc.weight         (O,I)      -> params/fc/kernel          (I,O)
+    fc.bias                      -> params/fc_bias
+    *.num_batches_tracked        -> dropped (inference-mode BN)
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+
+def torch_key_to_npz(key: str, value: np.ndarray) -> Optional[Tuple[str, np.ndarray]]:
+    """Map one torch state-dict entry to (npz_key, array); None to drop it."""
+    if key.endswith("num_batches_tracked"):
+        return None
+    if key == "fc.weight":
+        return "params/fc/kernel", value.transpose(1, 0)
+    if key == "fc.bias":
+        return "params/fc_bias", value
+    prefix = "/".join(key.split(".")[:-2])
+    kind, param = key.split(".")[-2:]
+    if kind == "conv" and param == "weight":
+        return f"params/{prefix}/conv/kernel", value.transpose(2, 3, 1, 0)
+    if kind == "bn":
+        if param == "weight":
+            return f"params/{prefix}/bn/scale", value
+        if param == "bias":
+            return f"params/{prefix}/bn/bias", value
+        if param == "running_mean":
+            return f"batch_stats/{prefix}/bn/mean", value
+        if param == "running_var":
+            return f"batch_stats/{prefix}/bn/var", value
+    raise ValueError(f"Unrecognized torch key: {key}")
+
+
+def npz_key_to_torch(key: str, value: np.ndarray) -> Tuple[str, np.ndarray]:
+    """Inverse mapping (used by the structural round-trip test)."""
+    parts = key.split("/")
+    if key == "params/fc/kernel":
+        return "fc.weight", value.transpose(1, 0)
+    if key == "params/fc_bias":
+        return "fc.bias", value
+    space, *mods, layer, param = parts
+    prefix = ".".join(mods)
+    if layer == "conv" and param == "kernel":
+        return f"{prefix}.conv.weight", value.transpose(3, 2, 0, 1)
+    if layer == "bn":
+        if space == "params":
+            return f"{prefix}.bn.{'weight' if param == 'scale' else 'bias'}", value
+        return f"{prefix}.bn.running_{param}", value
+    raise ValueError(f"Unrecognized npz key: {key}")
+
+
+def convert_state_dict(state: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        mapped = torch_key_to_npz(key, np.asarray(value))
+        if mapped is not None:
+            out[mapped[0]] = mapped[1]
+    return out
+
+
+def main(argv: Iterable[str]) -> None:
+    src, dst = argv
+    import torch
+
+    state = torch.load(src, map_location="cpu")
+    if not isinstance(state, dict) or "state_dict" in state:
+        state = state["state_dict"]
+    converted = convert_state_dict({k: v.numpy() for k, v in state.items()})
+    np.savez(dst, **converted)
+    print(f"wrote {len(converted)} arrays to {dst}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
