@@ -20,7 +20,7 @@ import time
 
 import numpy as np
 
-BATCH, NUM_CLASSES, STEPS, WARMUP = 8192, 128, 50, 5
+BATCH, NUM_CLASSES, STEPS, WARMUP, TRIALS = 8192, 128, 50, 5, 3
 
 
 def _make_data(seed: int = 0):
@@ -36,21 +36,19 @@ def bench_ours(probs: np.ndarray, target: np.ndarray) -> float:
     import jax
     import jax.numpy as jnp
 
-    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, Precision
+    from metrics_tpu import Accuracy, ConfusionMatrix, F1Score, MetricCollection, Precision
 
-    suite = [
-        Accuracy(num_classes=NUM_CLASSES, average="macro"),
-        F1Score(num_classes=NUM_CLASSES, average="macro"),
-        ConfusionMatrix(num_classes=NUM_CLASSES),
-        Precision(num_classes=NUM_CLASSES, average="macro"),
-    ]
-    fns = [m.as_functions() for m in suite]
-    states = [init() for init, _, _ in fns]
-
-    def _fused_update(states, p, t):
-        return [upd(s, p, t) for s, (_, upd, _) in zip(states, fns)]
-
-    fused_update = jax.jit(_fused_update, donate_argnums=(0,))
+    suite = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+            "f1": F1Score(num_classes=NUM_CLASSES, average="macro"),
+            "confmat": ConfusionMatrix(num_classes=NUM_CLASSES),
+            "precision": Precision(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    init, update, compute = suite.as_functions()
+    states = init()
+    fused_update = jax.jit(update, donate_argnums=(0,))
 
     p = jnp.asarray(probs)
     t = jnp.asarray(target)
@@ -58,14 +56,18 @@ def bench_ours(probs: np.ndarray, target: np.ndarray) -> float:
         states = fused_update(states, p, t)
     jax.block_until_ready(states)
 
-    start = time.perf_counter()
-    for _ in range(STEPS):
-        states = fused_update(states, p, t)
-    jax.block_until_ready(states)
-    elapsed = time.perf_counter() - start
+    # best of TRIALS: host<->device dispatch latency is noisy on tunneled
+    # accelerators; the minimum elapsed time reflects the device's capability
+    best = float("inf")
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        for _ in range(STEPS):
+            states = fused_update(states, p, t)
+        jax.block_until_ready(states)
+        best = min(best, time.perf_counter() - start)
     # sanity: finalize once so the state is actually consumed
-    _ = [cmp(s) for s, (_, _, cmp) in zip(states, fns)]
-    return STEPS * BATCH / elapsed
+    _ = compute(states)
+    return STEPS * BATCH / best
 
 
 def bench_reference(probs: np.ndarray, target: np.ndarray) -> float:
@@ -93,15 +95,17 @@ def bench_reference(probs: np.ndarray, target: np.ndarray) -> float:
             m.update(p, t)
     if device == "cuda":
         torch.cuda.synchronize()
-    start = time.perf_counter()
-    for _ in range(STEPS):
-        for m in suite:
-            m.update(p, t)
-    if device == "cuda":
-        torch.cuda.synchronize()
-    elapsed = time.perf_counter() - start
+    best = float("inf")
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        for _ in range(STEPS):
+            for m in suite:
+                m.update(p, t)
+        if device == "cuda":
+            torch.cuda.synchronize()
+        best = min(best, time.perf_counter() - start)
     _ = [m.compute() for m in suite]
-    return STEPS * BATCH / elapsed
+    return STEPS * BATCH / best
 
 
 def main() -> None:

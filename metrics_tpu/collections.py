@@ -94,6 +94,41 @@ class MetricCollection:
         if self._enable_compute_groups and self._groups_checked:
             self._compute_groups_create_state_ref()
 
+    # ---------------------------------------------------- functional export
+    def as_functions(self) -> tuple:
+        """Export the whole collection as ``(init, update, compute)`` pure
+        functions over a ``{metric_name: state_pytree}`` dict.
+
+        The exported ``update`` is ONE jittable function covering the entire
+        suite — XLA compiles it into a single program and its common-
+        subexpression elimination dedupes shared work across metrics (e.g.
+        identical stat-scores updates), which is the compiler-level analogue
+        of the reference's host-side compute groups (`collections.py:191-267`).
+        ``compute(states, axis_name=...)`` inside ``shard_map`` syncs every
+        state with fused collectives.
+        """
+        items = list(self.items(keep_base=True, copy_state=False))
+        fns = {name: m.as_functions() for name, m in items}
+        filters = {name: m._filter_kwargs for name, m in items}
+        set_name = self._set_name
+
+        def init() -> Dict[str, Any]:
+            return {name: f[0]() for name, f in fns.items()}
+
+        def update(states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+            return {
+                name: fns[name][1](states[name], *args, **filters[name](**kwargs)) for name in fns
+            }
+
+        def compute(states: Dict[str, Any], axis_name: Optional[str] = None) -> Dict[str, Any]:
+            # same naming contract as the stateful path: flatten dict-valued
+            # results, then apply prefix/postfix to every flat key
+            res = {name: fns[name][2](states[name], axis_name=axis_name) for name in fns}
+            res = _flatten_dict(res)
+            return {set_name(k): v for k, v in res.items()}
+
+        return init, update, compute
+
     # ---------------------------------------------------------- compute groups
     def _merge_compute_groups(self) -> None:
         """Merge groups whose leaders hold pairwise-identical states."""

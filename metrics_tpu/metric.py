@@ -439,8 +439,12 @@ class Metric(ABC):
         template = self._bare_clone()
 
         def init() -> Dict[str, Any]:
+            # fresh copies, never references to the template defaults: callers
+            # jit the update with donate_argnums, and donating a buffer shared
+            # with a live Metric instance would invalidate that metric's state
             return {
-                k: (list(v) if isinstance(v, list) else v) for k, v in template._defaults.items()
+                k: (list(v) if isinstance(v, list) else jnp.asarray(v).copy())
+                for k, v in template._defaults.items()
             }
 
         def update_fn(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:

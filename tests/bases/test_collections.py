@@ -1,8 +1,9 @@
 """MetricCollection tests incl. compute groups (analogue of reference tests/unittests/bases/test_collections.py)."""
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
-from metrics_tpu import MaxMetric, MeanMetric, MetricCollection, MinMetric, SumMetric
+from metrics_tpu import Accuracy, F1Score, MaxMetric, MeanMetric, MetricCollection, MinMetric, SumMetric
 from tests.helpers.testers import DummyMetric
 
 
@@ -175,3 +176,52 @@ def test_compute_group_member_cache_invalidated():
     out2 = col.compute()
     assert float(out2["DummyA"]) == 3.0
     assert float(out2["DummyB"]) == 6.0  # was returning stale 2.0 before fix
+
+
+class TestCollectionAsFunctions:
+    def test_fused_update_matches_stateful(self):
+        import jax
+
+        coll = MetricCollection(
+            {"acc": Accuracy(num_classes=3), "f1": F1Score(num_classes=3, average="macro")}
+        )
+        init, update, compute = coll.as_functions()
+        rng = np.random.RandomState(0)
+        states = init()
+        fused = jax.jit(update)
+        for _ in range(3):
+            p = jnp.asarray(rng.rand(16, 3).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 3, 16))
+            states = fused(states, p, t)
+            coll.update(p, t)
+        out_fn = compute(states)
+        out_st = coll.compute()
+        assert set(out_fn) == set(out_st)
+        for k in out_fn:
+            np.testing.assert_allclose(np.asarray(out_fn[k]), np.asarray(out_st[k]), atol=1e-6)
+
+    def test_spmd_collection_compute(self):
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        coll = MetricCollection({"acc": Accuracy(num_classes=3), "mean": MeanMetric()})
+        init, update, compute = coll.as_functions()
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        rng = np.random.RandomState(1)
+        p = jnp.asarray(rng.rand(32, 3).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 3, 32))
+
+        def shard_fn(pb, tb):
+            # kwargs route per update signature (positional args would go to all)
+            states = update(init(), preds=pb, target=tb, value=pb.mean())
+            return compute(states, axis_name="dp")
+
+        out = jax.jit(
+            jax.shard_map(shard_fn, mesh=mesh, in_specs=(P("dp", None), P("dp")), out_specs=P(), check_vma=False)
+        )(p, t)
+        # whole-data truth
+        coll2 = MetricCollection({"acc": Accuracy(num_classes=3), "mean": MeanMetric()})
+        coll2["acc"].update(p, t)
+        coll2["mean"].update(jnp.stack([p[:16].mean(), p[16:].mean()]))
+        np.testing.assert_allclose(float(out["acc"]), float(coll2.compute()["acc"]), atol=1e-6)
+        np.testing.assert_allclose(float(out["mean"]), float(coll2.compute()["mean"]), atol=1e-6)

@@ -7,12 +7,13 @@ convert back with the production converter, and require bit-identical trees —
 proving every parameter in the model has exactly one torch counterpart with
 consistent transposition.
 """
+import os
 import sys
 
 import numpy as np
 import pytest
 
-sys.path.insert(0, "tools")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "tools"))
 from convert_inception_weights import convert_state_dict, npz_key_to_torch  # noqa: E402
 
 

@@ -85,8 +85,10 @@ def main(argv: Iterable[str]) -> None:
     import torch
 
     state = torch.load(src, map_location="cpu")
-    if not isinstance(state, dict) or "state_dict" in state:
+    if isinstance(state, dict) and "state_dict" in state:
         state = state["state_dict"]
+    if not isinstance(state, dict):
+        raise ValueError(f"Unsupported checkpoint format: expected a state dict, got {type(state)}")
     converted = convert_state_dict({k: v.numpy() for k, v in state.items()})
     np.savez(dst, **converted)
     print(f"wrote {len(converted)} arrays to {dst}")
