@@ -112,6 +112,7 @@ def fbeta_score(
 def f1_score(
     preds,
     target,
+    beta: float = 1.0,
     average: Optional[str] = "micro",
     mdmc_average: Optional[str] = None,
     ignore_index: Optional[int] = None,

@@ -74,13 +74,24 @@ def _resolve_k(n: int, k: Optional[int]) -> int:
     return min(k, n)
 
 
-def retrieval_precision(preds, target, k: Optional[int] = None) -> jax.Array:
-    """Fraction of top-k documents that are relevant."""
+def retrieval_precision(preds, target, k: Optional[int] = None, adaptive_k: bool = False) -> jax.Array:
+    """Relevant docs among the top-k, divided by ``k`` itself.
+
+    Parity: reference `functional/retrieval/precision.py:21-66` — only
+    ``min(k, n)`` docs are examined, but the divisor stays ``k`` unless
+    ``adaptive_k`` caps it at the number of documents.
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
-    kk = _resolve_k(preds.shape[0], k)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    n = preds.shape[0]
+    if k is None or (adaptive_k and k > n):
+        k = n
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
     order = jnp.argsort(-preds, stable=True)
     rel = target[order].astype(jnp.float32)
-    return rel[:kk].sum() / kk
+    return rel[: min(k, n)].sum() / k
 
 
 def retrieval_recall(preds, target, k: Optional[int] = None) -> jax.Array:
@@ -154,7 +165,10 @@ def retrieval_precision_recall_curve(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(precision@k, recall@k, k) for k = 1..max_k.
 
-    Parity: reference `functional/retrieval/precision_recall_curve.py`.
+    Parity: reference `functional/retrieval/precision_recall_curve.py:23-98`:
+    the output always has ``max_k`` entries; past the number of documents the
+    cumulated hits stay flat, so precision DECAYS as hits/k — unless
+    ``adaptive_k``, which clamps the divisor (and reported k) at ``n``.
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     n = preds.shape[0]
@@ -164,18 +178,22 @@ def retrieval_precision_recall_curve(
         max_k = n
     if not isinstance(max_k, int) or max_k <= 0:
         raise ValueError("`max_k` has to be a positive integer or None")
+
     if adaptive_k and max_k > n:
-        max_k = n
-    max_k = min(max_k, n)
+        topk = jnp.concatenate(
+            [jnp.arange(1, n + 1), jnp.full((max_k - n,), n, dtype=jnp.int32)]
+        )
+    else:
+        topk = jnp.arange(1, max_k + 1)
 
     order = jnp.argsort(-preds, stable=True)
-    rel = target[order].astype(jnp.float32)
-    ks = jnp.arange(1, max_k + 1, dtype=jnp.float32)
-    cum_rel = jnp.cumsum(rel)[:max_k]
-    precision = cum_rel / ks
-    total = rel.sum()
+    rel = target[order].astype(jnp.float32)[: min(max_k, n)]
+    cum_rel = jnp.cumsum(jnp.pad(rel, (0, max(0, max_k - n))))
+    precision = cum_rel / topk.astype(jnp.float32)
+    total = target.astype(jnp.float32).sum()
     recall = jnp.where(total > 0, cum_rel / jnp.maximum(total, 1.0), jnp.zeros_like(cum_rel))
-    return precision, recall, ks.astype(jnp.int32)
+    precision = jnp.where(total > 0, precision, jnp.zeros_like(precision))
+    return precision, recall, topk
 
 
 __all__ = [

@@ -104,19 +104,27 @@ def bert_score(
     user_forward_fn: Optional[Callable] = None,
     verbose: bool = False,
     idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 128,
+    batch_size: int = 64,
+    num_threads: int = 4,
+    return_hash: bool = False,
     lang: str = "en",
     rescale_with_baseline: bool = False,
     baseline_path: Optional[str] = None,
-    max_length: int = 128,
-    batch_size: int = 64,
-    return_hash: bool = False,
+    baseline_url: Optional[str] = None,
 ) -> Dict[str, List[float]]:
     """BERTScore precision/recall/f1 per sentence pair.
 
     Either pass ``model_name_or_path`` (uses ``FlaxAutoModel``) or a
     ``user_forward_fn(sentences) -> (embeddings, mask)`` for custom/offline
     embedding models.
+
+    ``device``/``num_threads``/``baseline_url`` are accepted for drop-in
+    signature compatibility with the reference and are no-ops here: device
+    placement is JAX-managed and baselines load from ``baseline_path`` only.
     """
+    del device, num_threads, baseline_url  # torch runtime knobs; see docstring
     preds = [preds] if isinstance(preds, str) else list(preds)
     target = [target] if isinstance(target, str) else list(target)
     if len(preds) != len(target):

@@ -183,8 +183,11 @@ def infolm(
     idf: bool = True,
     alpha: Optional[float] = None,
     beta: Optional[float] = None,
+    device: Optional[Any] = None,
     max_length: Optional[int] = None,
     batch_size: int = 64,
+    num_threads: int = 0,
+    verbose: bool = True,
     return_sentence_level_score: bool = False,
 ):
     """InfoLM score between predictions and references.
@@ -192,7 +195,12 @@ def infolm(
     Requires an MLM checkpoint reachable by ``transformers``; all information
     measures are pure device math and unit-testable without a model via
     :class:`_InformationMeasure`.
+
+    ``device``/``num_threads``/``verbose`` are accepted for drop-in signature
+    compatibility with the reference and are no-ops here (JAX manages device
+    placement; the forward is jitted, not a tqdm-wrapped dataloader loop).
     """
+    del device, num_threads, verbose  # torch runtime knobs; see docstring
     preds = [preds] if isinstance(preds, str) else list(preds)
     target = [target] if isinstance(target, str) else list(target)
     if len(preds) != len(target):

@@ -37,10 +37,14 @@ def _create_stemmer(use_stemmer: bool):
     return nltk.stem.porter.PorterStemmer()
 
 
-def _rouge_tokenize(text: str, stemmer=None) -> List[str]:
-    """rouge_score tokenization: lowercase, split on non-alphanumerics."""
-    text = re.sub(r"[^a-z0-9]+", " ", text.lower())
-    tokens = re.split(r"\s+", text)
+def _rouge_tokenize(text: str, stemmer=None, normalizer=None, tokenizer=None) -> List[str]:
+    """rouge_score tokenization: lowercase, split on non-alphanumerics.
+
+    ``normalizer``/``tokenizer`` callables override the default regex steps
+    (reference `functional/text/rouge.py:146-171`).
+    """
+    text = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
+    tokens = list(tokenizer(text)) if callable(tokenizer) else re.split(r"\s+", text)
     if stemmer:
         tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
     return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
@@ -105,10 +109,10 @@ def _split_sentences(x: str) -> List[str]:
     return [s for s in re.split(r"\n", x) if len(s) > 0]
 
 
-def _rouge_lsum_score(pred: str, target: str, stemmer=None) -> Dict[str, jax.Array]:
+def _rouge_lsum_score(pred: str, target: str, stemmer=None, normalizer=None, tokenizer=None) -> Dict[str, jax.Array]:
     """Summary-level LCS: union-LCS over sentence pairs (rouge_score convention)."""
-    pred_sents = [_rouge_tokenize(s, stemmer) for s in _split_sentences(pred)]
-    target_sents = [_rouge_tokenize(s, stemmer) for s in _split_sentences(target)]
+    pred_sents = [_rouge_tokenize(s, stemmer, normalizer, tokenizer) for s in _split_sentences(pred)]
+    target_sents = [_rouge_tokenize(s, stemmer, normalizer, tokenizer) for s in _split_sentences(target)]
     m = sum(map(len, target_sents))
     n = sum(map(len, pred_sents))
     if m == 0 or n == 0:
@@ -169,13 +173,15 @@ def _rouge_score_update(
     rouge_keys_values: List,
     accumulate: str,
     stemmer=None,
+    normalizer=None,
+    tokenizer=None,
 ) -> Dict[Union[int, str], List[Dict[str, jax.Array]]]:
     results: Dict[Union[int, str], List[Dict[str, jax.Array]]] = {rk: [] for rk in rouge_keys_values}
     for pred_raw, target_raw_list in zip(preds, target):
         per_ref: List[Dict[Union[int, str], Dict[str, jax.Array]]] = []
-        pred_tokens = _rouge_tokenize(pred_raw, stemmer)
+        pred_tokens = _rouge_tokenize(pred_raw, stemmer, normalizer, tokenizer)
         for target_raw in target_raw_list:
-            tgt_tokens = _rouge_tokenize(target_raw, stemmer)
+            tgt_tokens = _rouge_tokenize(target_raw, stemmer, normalizer, tokenizer)
             scores_for_ref: Dict[Union[int, str], Dict[str, jax.Array]] = {}
             for rouge_key in rouge_keys_values:
                 if isinstance(rouge_key, int):
@@ -183,7 +189,7 @@ def _rouge_score_update(
                 elif rouge_key == "L":
                     score = _rouge_l_score(pred_tokens, tgt_tokens)
                 else:  # Lsum
-                    score = _rouge_lsum_score(pred_raw, target_raw, stemmer)
+                    score = _rouge_lsum_score(pred_raw, target_raw, stemmer, normalizer, tokenizer)
                 scores_for_ref[rouge_key] = score
             per_ref.append(scores_for_ref)
 
@@ -211,6 +217,8 @@ def rouge_score(
     target: Union[str, Sequence[str], Sequence[Sequence[str]]],
     accumulate: str = "best",
     use_stemmer: bool = False,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
     rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
 ) -> Dict[str, jax.Array]:
     """ROUGE score dict with ``{key}_{precision,recall,fmeasure}`` entries.
@@ -241,7 +249,9 @@ def rouge_score(
     if isinstance(target, str):
         target = [[target]]
 
-    sentence_results = _rouge_score_update(preds, target, rouge_keys_values, accumulate, stemmer)
+    sentence_results = _rouge_score_update(
+        preds, target, rouge_keys_values, accumulate, stemmer, normalizer, tokenizer
+    )
 
     output: Dict[str, List[jax.Array]] = {
         f"rouge{rouge_key}_{tp}": [] for rouge_key in rouge_keys_values for tp in ("fmeasure", "precision", "recall")

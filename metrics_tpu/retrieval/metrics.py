@@ -52,11 +52,33 @@ class _RetrievalKMetric(RetrievalMetric):
 
 
 class RetrievalPrecision(_RetrievalKMetric):
-    """Mean precision@k over queries."""
+    """Mean precision@k over queries.
+
+    Parity note: the divisor is ``k`` itself even when a query has fewer
+    documents (reference `functional/retrieval/precision.py:55-66`);
+    ``adaptive_k`` caps it at the per-query document count.
+    """
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
-        kv = ctx.k_eff(self.k)
-        return ctx.cumrel[ctx.idx_at(kv)] / kv.astype(jnp.float32)
+        examined = ctx.k_eff(self.k)
+        if self.k is None or self.adaptive_k:
+            divisor = examined
+        else:
+            divisor = jnp.full_like(examined, self.k)
+        return ctx.cumrel[ctx.idx_at(examined)] / divisor.astype(jnp.float32)
 
 
 class RetrievalRecall(_RetrievalKMetric):
@@ -70,11 +92,21 @@ class RetrievalRecall(_RetrievalKMetric):
 
 class RetrievalFallOut(_RetrievalKMetric):
     """Mean fall-out@k over queries; the "empty" convention is inverted —
-    a query with no NEGATIVE docs is the degenerate one (reference
-    `retrieval/fall_out.py`)."""
+    a query with no NEGATIVE docs is the degenerate one, and the default
+    empty action is "pos" (pessimistic for this lower-is-better metric) —
+    reference `retrieval/fall_out.py:78`."""
 
     higher_is_better = False
     _empty_when_no = "neg"
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, k=k, **kwargs)
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         kv = ctx.k_eff(self.k)
@@ -157,10 +189,13 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
             return jnp.zeros(max_k), jnp.zeros(max_k), top_k
 
         ks = top_k[None, :]  # (1, K)
-        kv = jnp.minimum(ks, ctx.counts[:, None])  # (G, K) clamped rank
+        kv = jnp.minimum(ks, ctx.counts[:, None])  # (G, K) clamped examined rank
         idx = ctx.starts[:, None] + kv - 1
-        cumrel_k = ctx.cumrel[idx]  # (G, K)
-        precisions = cumrel_k / kv.astype(jnp.float32)
+        cumrel_k = ctx.cumrel[idx]  # (G, K): hits stay flat past the group size
+        # reference divisor semantics (functional curve `:82-95`): plain k
+        # (precision decays past n) unless adaptive_k clamps it at n
+        divisor = kv if self.adaptive_k else jnp.broadcast_to(ks, kv.shape)
+        precisions = cumrel_k / divisor.astype(jnp.float32)
         recalls = jnp.where(
             (ctx.n_pos > 0)[:, None], cumrel_k / jnp.maximum(ctx.n_pos, 1.0)[:, None], 0.0
         )
@@ -204,9 +239,12 @@ class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
         rmax = jnp.max(rec)
         any_ok = jnp.isfinite(rmax)
         cand = ok & (rec == rmax)
-        kbest = jnp.min(jnp.where(cand, top_k, jnp.iinfo(jnp.int32).max))
+        # reference `max((r, k) ...)` is lexicographic: LARGEST k among ties,
+        # and k falls back to max_k whenever the best recall is 0
+        # (`retrieval/precision_recall_curve.py:43-52`)
+        kbest = jnp.max(jnp.where(cand, top_k, jnp.iinfo(jnp.int32).min))
         best_recall = jnp.where(any_ok, rmax, 0.0)
-        best_k = jnp.where(any_ok, kbest, jnp.max(top_k))
+        best_k = jnp.where(any_ok & (best_recall > 0.0), kbest, jnp.max(top_k))
         return best_recall, best_k
 
 

@@ -4,7 +4,7 @@ Parity: reference `text/{rouge,chrf,ter,eed,bert,infolm}.py`.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +57,8 @@ class ROUGEScore(Metric):
     def __init__(
         self,
         use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
         accumulate: str = "best",
         rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
         **kwargs: Any,
@@ -70,6 +72,8 @@ class ROUGEScore(Metric):
         self.rouge_keys = rouge_keys
         self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
         self.stemmer = _create_stemmer(use_stemmer)
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
         self.accumulate = accumulate
         for rouge_key in self.rouge_keys:
             for score in ("fmeasure", "precision", "recall"):
@@ -82,7 +86,9 @@ class ROUGEScore(Metric):
             preds = [preds]
         if isinstance(target, str):
             target = [[target]]
-        output = _rouge_score_update(preds, target, self.rouge_keys_values, self.accumulate, self.stemmer)
+        output = _rouge_score_update(
+            preds, target, self.rouge_keys_values, self.accumulate, self.stemmer, self.normalizer, self.tokenizer
+        )
         for rouge_key, metrics in output.items():
             for metric in metrics:
                 for tp, value in metric.items():

@@ -1,0 +1,98 @@
+"""Differential retrieval tests vs the mounted reference, focused on the
+k-vs-document-count edge cases (precision divides by k itself unless
+adaptive_k; curves keep max_k entries with decaying precision)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+import metrics_tpu.functional as mf  # noqa: E402
+
+_rng = np.random.RandomState(11)
+# 6 queries with group sizes 3..8 — smaller than some k values below
+_SIZES = [3, 4, 5, 6, 7, 8]
+_IDX = np.concatenate([np.full(s, i) for i, s in enumerate(_SIZES)])
+_PREDS = _rng.rand(_IDX.size).astype(np.float32)
+_TARGET = (_rng.rand(_IDX.size) > 0.4).astype(np.int64)
+
+
+def _run_module(ours_cls, ref_cls, **kwargs):
+    ours = ours_cls(**kwargs)
+    ref = ref_cls(**kwargs)
+    ours.update(jnp.asarray(_PREDS), jnp.asarray(_TARGET), indexes=jnp.asarray(_IDX))
+    ref.update(torch.tensor(_PREDS), torch.tensor(_TARGET), indexes=torch.tensor(_IDX))
+    return ours.compute(), ref.compute()
+
+
+@pytest.mark.parametrize("k", [1, 3, 5, 10])
+@pytest.mark.parametrize("adaptive_k", [False, True])
+def test_precision_k_semantics(k, adaptive_k):
+    ov, rv = _run_module(mt.RetrievalPrecision, _ref.RetrievalPrecision, k=k, adaptive_k=adaptive_k)
+    np.testing.assert_allclose(float(ov), float(rv), atol=1e-6)
+
+
+@pytest.mark.parametrize("k", [1, 3, 5, 10])
+@pytest.mark.parametrize(
+    "name", ["RetrievalRecall", "RetrievalFallOut", "RetrievalHitRate", "RetrievalNormalizedDCG"]
+)
+def test_k_metrics(name, k):
+    ov, rv = _run_module(getattr(mt, name), getattr(_ref, name), k=k)
+    np.testing.assert_allclose(float(ov), float(rv), atol=1e-6)
+
+
+@pytest.mark.parametrize("name", ["RetrievalMAP", "RetrievalMRR", "RetrievalRPrecision"])
+def test_rankless_metrics(name):
+    ov, rv = _run_module(getattr(mt, name), getattr(_ref, name))
+    np.testing.assert_allclose(float(ov), float(rv), atol=1e-6)
+
+
+@pytest.mark.parametrize("max_k", [2, 5, 12])
+@pytest.mark.parametrize("adaptive_k", [False, True])
+def test_curve_parity(max_k, adaptive_k):
+    ov, rv = _run_module(
+        mt.RetrievalPrecisionRecallCurve, _ref.RetrievalPrecisionRecallCurve, max_k=max_k, adaptive_k=adaptive_k
+    )
+    for o, r in zip(ov[:2], rv[:2]):
+        np.testing.assert_allclose(np.asarray(o), r.numpy(), atol=1e-6)
+
+
+@pytest.mark.parametrize("min_precision", [0.2, 0.5, 0.8])
+def test_recall_at_fixed_precision(min_precision):
+    ov, rv = _run_module(
+        mt.RetrievalRecallAtFixedPrecision,
+        _ref.RetrievalRecallAtFixedPrecision,
+        min_precision=min_precision,
+        max_k=10,
+    )
+    np.testing.assert_allclose(float(ov[0]), float(rv[0]), atol=1e-6)
+    assert int(ov[1]) == int(rv[1])
+
+
+@pytest.mark.parametrize("k", [2, 9])
+@pytest.mark.parametrize("adaptive_k", [False, True])
+def test_functional_precision_parity(k, adaptive_k):
+    p, t = _PREDS[:5], _TARGET[:5]
+    ov = mf.retrieval_precision(jnp.asarray(p), jnp.asarray(t), k=k, adaptive_k=adaptive_k)
+    rv = _ref.functional.retrieval_precision(torch.tensor(p), torch.tensor(t), k=k, adaptive_k=adaptive_k)
+    np.testing.assert_allclose(float(ov), float(rv), atol=1e-6)
+
+
+@pytest.mark.parametrize("max_k", [3, 9])
+@pytest.mark.parametrize("adaptive_k", [False, True])
+def test_functional_curve_parity(max_k, adaptive_k):
+    p, t = _PREDS[:5], _TARGET[:5]
+    op, orc, ok = mf.retrieval_precision_recall_curve(
+        jnp.asarray(p), jnp.asarray(t), max_k=max_k, adaptive_k=adaptive_k
+    )
+    rp, rr, rk = _ref.functional.retrieval_precision_recall_curve(
+        torch.tensor(p), torch.tensor(t), max_k=max_k, adaptive_k=adaptive_k
+    )
+    np.testing.assert_allclose(np.asarray(op), rp.numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(orc), rr.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(ok), rk.numpy())

@@ -57,10 +57,10 @@ def _np_mrr(p, t):
 
 
 def _np_precision_at(p, t, k=None):
+    # reference semantics: examine min(k, n) docs but divide by k itself
     k = k or len(p)
-    k = min(k, len(p))
     order = np.argsort(-p, kind="stable")
-    return t[order][:k].sum() / k
+    return t[order][: min(k, len(p))].sum() / k
 
 
 def _np_recall_at(p, t, k=None):
