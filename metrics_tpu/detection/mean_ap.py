@@ -49,18 +49,27 @@ def _input_validator(preds: Sequence[dict], targets: Sequence[dict], iou_type: s
         if any(k not in p for p in targets):
             raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
 
+    def _n_items(value: Any) -> int:
+        # masks may arrive as an RLE dict / list of RLE dicts (decoded later)
+        if isinstance(value, dict):
+            return 1
+        if isinstance(value, (list, tuple)) and value and isinstance(value[0], dict):
+            return len(value)
+        arr = np.asarray(value)
+        return arr.shape[0] if arr.size else 0
+
     for i, item in enumerate(targets):
-        n_boxes = np.asarray(item[iou_attribute]).shape[0] if np.asarray(item[iou_attribute]).size else 0
-        n_labels = np.asarray(item["labels"]).shape[0] if np.asarray(item["labels"]).size else 0
+        n_boxes = _n_items(item[iou_attribute])
+        n_labels = _n_items(item["labels"])
         if n_boxes != n_labels:
             raise ValueError(
                 f"Input {iou_attribute} and labels of sample {i} in targets have a"
                 f" different length (expected {n_boxes} labels, got {n_labels})"
             )
     for i, item in enumerate(preds):
-        n_boxes = np.asarray(item[iou_attribute]).shape[0] if np.asarray(item[iou_attribute]).size else 0
-        n_labels = np.asarray(item["labels"]).shape[0] if np.asarray(item["labels"]).size else 0
-        n_scores = np.asarray(item["scores"]).shape[0] if np.asarray(item["scores"]).size else 0
+        n_boxes = _n_items(item[iou_attribute])
+        n_labels = _n_items(item["labels"])
+        n_scores = _n_items(item["scores"])
         if not (n_boxes == n_labels == n_scores):
             raise ValueError(
                 f"Input {iou_attribute}, labels and scores of sample {i} in predictions have a"
@@ -155,11 +164,11 @@ class MeanAveragePrecision(Metric):
             if boxes.size > 0:
                 boxes = np.asarray(box_convert(jnp.asarray(boxes), in_fmt=self.box_format, out_fmt="xyxy"))
             return boxes
-        # segm: dense boolean masks [n, H, W]
-        masks = np.asarray(item["masks"], dtype=bool)
-        if masks.ndim == 2:
-            masks = masks[None]
-        return masks
+        # segm: dense boolean masks [n, H, W], or COCO RLE dict(s) decoded on
+        # host (metrics_tpu/functional/detection/rle.py)
+        from metrics_tpu.functional.detection.rle import masks_from_any
+
+        return masks_from_any(item["masks"])
 
     # ------------------------------------------------------------ compute
     def _get_classes(self) -> List[int]:

@@ -105,7 +105,7 @@ def bert_score(
     verbose: bool = False,
     idf: bool = False,
     device: Optional[Any] = None,
-    max_length: int = 128,
+    max_length: int = 512,
     batch_size: int = 64,
     num_threads: int = 4,
     return_hash: bool = False,

@@ -268,7 +268,7 @@ class BERTScore(Metric):
         num_layers: Optional[int] = None,
         idf: bool = False,
         user_forward_fn: Optional[Any] = None,
-        max_length: int = 128,
+        max_length: int = 512,
         batch_size: int = 64,
         **kwargs: Any,
     ) -> None:
