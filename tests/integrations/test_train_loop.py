@@ -1,0 +1,219 @@
+"""Training-loop integration tests — the Lightning-integration analogue.
+
+Parity target: reference `tests/integrations/test_lightning.py` (metrics
+logged from inside a training module via ``forward``/``compute``, reset
+between stages, state moving with checkpoints) re-expressed for a Flax/optax
+loop: the "trainer" is a plain python loop (eager module API) or a jitted
+SPMD step (pure-function API), and "self.log" is reading ``forward``'s
+return value every step.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+
+DIN, HIDDEN, NUM_CLASSES = 8, 16, 4
+BATCH, STEPS = 32, 6
+
+
+class _MLP(nn.Module):
+    """The BoringModel analogue (reference tests/integrations/lightning/boring_model.py)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(NUM_CLASSES)(nn.relu(nn.Dense(HIDDEN)(x)))
+
+
+def _data(seed: int, steps: int = STEPS):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(steps, BATCH, DIN).astype(np.float32)
+    ys = rng.randint(0, NUM_CLASSES, size=(steps, BATCH))
+    return xs, ys
+
+
+def _train_setup():
+    model = _MLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIN)))
+    opt = optax.sgd(1e-2)
+    return model, params, opt, opt.init(params)
+
+
+class TestTrainLoopModuleAPI:
+    """Eager loop + stateful metrics: the `self.log(metric)` pattern."""
+
+    def test_forward_logging_matches_epoch_compute(self):
+        model, params, opt, opt_state = _train_setup()
+        xs, ys = _data(0)
+        metric = mt.Accuracy(num_classes=NUM_CLASSES)
+        step_vals, all_logits = [], []
+
+        @jax.jit
+        def train_step(params, opt_state, xb, yb):
+            def loss_fn(p):
+                logits = model.apply(p, xb)
+                return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean(), logits
+
+            (_, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, logits
+
+        for xb, yb in zip(xs, ys):
+            params, opt_state, logits = train_step(params, opt_state, xb, yb)
+            step_vals.append(float(metric(jax.nn.softmax(logits), yb)))  # "self.log" value
+            all_logits.append(np.asarray(logits))
+
+        # per-step forward value is the batch-local metric
+        for logits, yb, v in zip(all_logits, ys, step_vals):
+            assert v == pytest.approx(float(np.mean(logits.argmax(-1) == yb)))
+        # epoch-end compute is the metric over ALL logged batches
+        expected = np.mean(np.concatenate([l.argmax(-1) for l in all_logits]) == ys.reshape(-1))
+        assert float(metric.compute()) == pytest.approx(float(expected))
+
+    def test_reset_between_stages(self):
+        """Train-stage state must not leak into the val stage (reference
+        test_lightning.py reset-between-stages contract)."""
+        metric = mt.Accuracy(num_classes=NUM_CLASSES)
+        xs, ys = _data(1)
+        preds = jax.nn.one_hot(jnp.asarray(ys[0]), NUM_CLASSES)
+        metric.update(preds, ys[0])  # "train": all correct
+        assert float(metric.compute()) == 1.0
+        metric.reset()
+        assert not metric.update_called
+        wrong = jnp.roll(preds, 1, axis=-1)
+        metric.update(wrong, ys[0])  # "val": all wrong
+        assert float(metric.compute()) == 0.0
+
+    def test_collection_log_dict(self):
+        """MetricCollection.forward == the `self.log_dict(collection)` pattern."""
+        suite = mt.MetricCollection(
+            {
+                "acc": mt.Accuracy(num_classes=NUM_CLASSES),
+                "f1": mt.F1Score(num_classes=NUM_CLASSES, average="macro"),
+            },
+            prefix="train_",
+        )
+        xs, ys = _data(2)
+        rng = np.random.RandomState(3)
+        for yb in ys:
+            pb = rng.rand(BATCH, NUM_CLASSES).astype(np.float32)
+            logged = suite(pb / pb.sum(-1, keepdims=True), yb)
+            assert set(logged) == {"train_acc", "train_f1"}
+        final = suite.compute()
+        assert set(final) == {"train_acc", "train_f1"}
+        suite.reset()
+        assert all(not m.update_called for m in suite.values(copy_state=False))
+
+    def test_checkpoint_mid_epoch_resume(self):
+        """state_dict → new instance → resume must equal the uninterrupted run
+        (reference persistence contract, metric.py:662-700)."""
+        xs, ys = _data(4)
+        rng = np.random.RandomState(5)
+        probs = rng.rand(STEPS, BATCH, NUM_CLASSES).astype(np.float32)
+
+        uninterrupted = mt.Accuracy(num_classes=NUM_CLASSES)
+        for pb, yb in zip(probs, ys):
+            uninterrupted.update(pb, yb)
+
+        first = mt.Accuracy(num_classes=NUM_CLASSES)
+        first.persistent(True)
+        for pb, yb in zip(probs[: STEPS // 2], ys[: STEPS // 2]):
+            first.update(pb, yb)
+        ckpt = first.state_dict()
+
+        resumed = mt.Accuracy(num_classes=NUM_CLASSES)
+        resumed.persistent(True)
+        resumed.load_state_dict(ckpt)
+        for pb, yb in zip(probs[STEPS // 2 :], ys[STEPS // 2 :]):
+            resumed.update(pb, yb)
+        assert float(resumed.compute()) == pytest.approx(float(uninterrupted.compute()))
+
+
+class TestTrainLoopSPMD:
+    """Jitted sharded train step with device-resident metric state."""
+
+    def test_dp_train_step_metric_sync(self):
+        """Metric accumulated inside a shard_map dp-train step, synced by
+        fused collectives at compute, must equal the single-device value."""
+        model, params, opt, opt_state = _train_setup()
+        xs, ys = _data(6)
+        devices = np.array(jax.devices()[:4])
+        mesh = Mesh(devices, ("dp",))
+        init, upd, cmp = mt.Accuracy(num_classes=NUM_CLASSES).as_functions()
+
+        def step(params, opt_state, mstate, xb, yb):
+            def loss_fn(p):
+                logits = model.apply(p, xb)
+                return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean(), logits
+
+            (_, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            mstate = upd(mstate, jax.nn.softmax(logits), yb)
+            return params, opt_state, mstate, logits
+
+        sharded = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(), P(), P(), P("dp"), P("dp")),
+                out_specs=(P(), P(), P(), P("dp")),
+                check_vma=False,
+            )
+        )
+        compute_synced = jax.jit(
+            jax.shard_map(
+                partial(cmp, axis_name="dp"),
+                mesh=mesh,
+                in_specs=(P(),),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+        # single-device oracle running the same math
+        oracle = mt.Accuracy(num_classes=NUM_CLASSES)
+        o_params, o_opt_state = params, opt_state
+        mstate = init()
+        for xb, yb in zip(xs, ys):
+            params, opt_state, mstate, logits = sharded(params, opt_state, mstate, xb, yb)
+
+            def loss_fn(p):
+                lg = model.apply(p, xb)
+                return optax.softmax_cross_entropy_with_integer_labels(lg, yb).mean(), lg
+
+            (_, o_logits), o_grads = jax.value_and_grad(loss_fn, has_aux=True)(o_params)
+            o_updates, o_opt_state = opt.update(o_grads, o_opt_state, o_params)
+            o_params = optax.apply_updates(o_params, o_updates)
+            oracle.update(jax.nn.softmax(o_logits), yb)
+            np.testing.assert_allclose(np.asarray(logits), np.asarray(o_logits), atol=1e-5)
+
+        np.testing.assert_allclose(
+            float(compute_synced(mstate)), float(oracle.compute()), atol=1e-6
+        )
+
+    def test_scan_over_epoch(self):
+        """An entire epoch as ONE program: metric state threaded through
+        lax.scan — no host dispatch between steps."""
+        xs, ys = _data(7)
+        init, upd, cmp = mt.MeanMetric().as_functions()
+        losses = jnp.abs(jnp.asarray(xs)).mean(axis=(1, 2))  # stand-in per-step losses
+
+        @jax.jit
+        def epoch(state, losses):
+            def body(st, loss):
+                return upd(st, loss), loss
+
+            st, _ = jax.lax.scan(body, state, losses)
+            return cmp(st)
+
+        assert float(epoch(init(), losses)) == pytest.approx(float(losses.mean()), rel=1e-6)
