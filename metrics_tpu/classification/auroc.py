@@ -56,12 +56,22 @@ class AUROC(Metric):
         self.mode = mode
 
     def compute(self) -> jax.Array:
-        if not self.mode:
+        # preds may be a list of per-batch arrays OR a bare array (post-sync
+        # cat states are reduced to one array) — guard emptiness explicitly
+        have_data = (
+            len(self.preds) > 0 if isinstance(self.preds, (list, tuple)) else self.preds.size > 0
+        )
+        if not self.mode and not have_data:
             raise RuntimeError("You have to have determined mode.")
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
+        mode = self.mode
+        if not mode:
+            # state restored in a fresh process: re-derive the data mode from
+            # the stored arrays (the formatter is idempotent on its own output)
+            _, _, mode = _auroc_update(preds, target)
         return _auroc_compute(
-            preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
+            preds, target, mode, self.num_classes, self.pos_label, self.average, self.max_fpr
         )
 
 

@@ -9,6 +9,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
+from metrics_tpu.functional.classification.precision_recall_curve import _rederive_curve_hparams
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
 
@@ -50,7 +51,10 @@ class AveragePrecision(Metric):
     def compute(self) -> Union[jax.Array, List[jax.Array]]:
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
-        return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)
+        preds, target, num_classes, pos_label = _rederive_curve_hparams(
+            preds, target, self.num_classes, self.pos_label
+        )
+        return _average_precision_compute(preds, target, num_classes, pos_label, self.average)
 
 
 __all__ = ["AveragePrecision"]

@@ -12,6 +12,7 @@ import jax
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
+    _rederive_curve_hparams,
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
@@ -48,7 +49,10 @@ class PrecisionRecallCurve(Metric):
     def compute(self) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
-        return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)
+        preds, target, num_classes, pos_label = _rederive_curve_hparams(
+            preds, target, self.num_classes, self.pos_label
+        )
+        return _precision_recall_curve_compute(preds, target, num_classes, pos_label)
 
 
 __all__ = ["PrecisionRecallCurve"]

@@ -5,6 +5,7 @@ from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
+from metrics_tpu.functional.classification.precision_recall_curve import _rederive_curve_hparams
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import dim_zero_cat
@@ -39,7 +40,10 @@ class ROC(Metric):
     def compute(self) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
-        return _roc_compute(preds, target, self.num_classes, self.pos_label)
+        preds, target, num_classes, pos_label = _rederive_curve_hparams(
+            preds, target, self.num_classes, self.pos_label
+        )
+        return _roc_compute(preds, target, num_classes, pos_label)
 
 
 __all__ = ["ROC"]

@@ -101,6 +101,25 @@ def _precision_recall_curve_update(
     return preds, target, num_classes, pos_label
 
 
+def _rederive_curve_hparams(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    pos_label: Optional[int],
+) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
+    """Resolve shape-inferred curve hyperparameters at compute time.
+
+    Used when a state is restored in a process whose update never ran (the
+    pure-function export / checkpoint path): re-runs the update formatter on
+    the stored data, which is safe because the formatter is idempotent on its
+    own output — it only flattens/reshapes. A `num_classes=None` multiclass
+    state cannot reach here: update would already have raised.
+    """
+    if num_classes is None:
+        return _precision_recall_curve_update(preds, target, None, pos_label)
+    return preds, target, num_classes, pos_label
+
+
 def _precision_recall_curve_compute_single_class(
     preds: jax.Array,
     target: jax.Array,

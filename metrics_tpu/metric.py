@@ -451,6 +451,7 @@ class Metric(ABC):
             m = template._bare_clone()
             m._restore_state(state)
             m._inner_update(*args, **kwargs)
+            _propagate_static_attrs(m, template)
             return m._state_snapshot()
 
         def compute_fn(state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
@@ -690,6 +691,40 @@ class Metric(ABC):
 
     def __getnewargs__(self) -> tuple:
         return tuple()
+
+
+_STATIC_ATTR_SCALARS = (int, float, bool, str, bytes, type(None))
+
+
+def _is_static_value(value: Any) -> bool:
+    if isinstance(value, _STATIC_ATTR_SCALARS):
+        return True
+    if isinstance(value, (tuple, frozenset)):
+        return all(_is_static_value(v) for v in value)
+    return False
+
+
+def _propagate_static_attrs(src: "Metric", dst: "Metric") -> None:
+    """Copy update-inferred static hyperparameters back to the export template.
+
+    Several metrics infer shape-derived hyperparameters from their first batch
+    and cache them on the instance for ``compute`` — e.g. ``num_classes`` /
+    ``pos_label`` on the curve family, ``mode`` on Accuracy/AUROC (mirroring
+    reference `classification/avg_precision.py` / `accuracy.py` behavior). In
+    the pure-function export the update runs on a throwaway clone, so those
+    attributes must flow back to the template for ``compute_fn``'s clone to see
+    them. Only plain static python values are copied (they derive from shapes,
+    so this is a trace-time effect — consistent across retraces of the same
+    shapes); states, arrays, and private bookkeeping are never touched.
+    """
+    state_names = set(src._reduction_specs)
+    for name, value in src.__dict__.items():
+        if name.startswith("_") or name in state_names:
+            continue
+        if not _is_static_value(value):
+            continue
+        if dst.__dict__.get(name, object()) != value:
+            object.__setattr__(dst, name, value)
 
 
 def _neg(x: jax.Array) -> jax.Array:

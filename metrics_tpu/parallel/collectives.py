@@ -73,6 +73,9 @@ def sync_pytree(
     out: Dict[str, Any] = {}
     for name, value in state.items():
         spec = specs.get(name)
+        if callable(spec):  # raw dist_reduce_fx callable → normalize to "custom"
+            custom_fns = {**custom_fns, name: spec}
+            spec = "custom"
         if isinstance(value, (list, tuple)):
             if len(value) == 0:
                 out[name] = list(value)
