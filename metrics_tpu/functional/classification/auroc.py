@@ -45,6 +45,26 @@ def _auroc_compute(
     if mode == DataType.BINARY:
         num_classes = 1
 
+    if isinstance(preds, jax.core.Tracer) or isinstance(target, jax.core.Tracer):
+        # static-shape path: exact AUROC is a scalar, so it CAN trace — sort +
+        # midrank segment reductions (ops/sorted_curves.py), unlike the curve
+        # itself whose length is data-dependent
+        from metrics_tpu.ops.sorted_curves import binary_auroc_sorted, multiclass_auroc_sorted
+
+        if sample_weights is not None:
+            raise ValueError("`sample_weights` are not supported for AUROC under jit; compute eagerly")
+        if max_fpr is not None:
+            raise ValueError("`max_fpr` (partial AUC) is not supported for AUROC under jit; compute eagerly")
+        if mode == DataType.BINARY:
+            pl = 1 if pos_label is None else pos_label
+            return binary_auroc_sorted(preds, target == pl)
+        if num_classes is None:
+            raise ValueError("Detected multiclass/multilabel input but `num_classes` was not provided")
+        if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
+            return binary_auroc_sorted(preds.reshape(-1), target.reshape(-1))
+        avg = "none" if average is None else getattr(average, "value", average)
+        return multiclass_auroc_sorted(preds, target, num_classes, avg)
+
     if max_fpr is not None:
         if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
@@ -92,7 +112,7 @@ def _auroc_compute(
             pass
         elif num_classes != 1:
             auc_scores = [_auc_compute_without_check(x, y, 1.0) for x, y in zip(fpr, tpr)]
-            if average == AverageMethod.NONE:
+            if average is None or average == AverageMethod.NONE:
                 return jnp.stack(auc_scores)
             if average == AverageMethod.MACRO:
                 return jnp.mean(jnp.stack(auc_scores))

@@ -41,6 +41,21 @@ def _average_precision_compute(
         target = target.reshape(-1)
         num_classes = 1
 
+    if isinstance(preds, jax.core.Tracer) or isinstance(target, jax.core.Tracer):
+        # static-shape path: exact AP is a scalar — sort + tie-group segment
+        # reductions (ops/sorted_curves.py) trace where the curve cannot.
+        # `average="none"` returns a stacked array rather than a python list.
+        from metrics_tpu.ops.sorted_curves import (
+            binary_average_precision_sorted,
+            multiclass_average_precision_sorted,
+        )
+
+        if num_classes == 1:
+            pl = 1 if pos_label is None else pos_label
+            return binary_average_precision_sorted(preds, target == pl)
+        avg = "none" if average is None else getattr(average, "value", average)
+        return multiclass_average_precision_sorted(preds, target, num_classes, avg)
+
     precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
     if average == "weighted":
         if preds.ndim == target.ndim and target.ndim > 1:

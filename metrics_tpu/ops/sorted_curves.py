@@ -1,0 +1,145 @@
+"""Exact AUROC / average precision as static-shape device kernels.
+
+The exact ROC / PR *curves* have data-dependent length (one point per distinct
+score — reference `functional/classification/precision_recall_curve.py:49-51`),
+which is why the eager curve path refuses to trace. But the *areas* under them
+are scalars, so the integrals can be computed with fully static shapes: sort
+(static N), identify tie runs with segment reductions (num_segments = N,
+static), and integrate analytically.
+
+- AUROC uses the midrank (Mann–Whitney U) identity: with average ranks over
+  tied scores, ``AUC = (Σ ranks(positives) − P(P+1)/2) / (P·N_neg)`` — exactly
+  the trapezoidal area of the tie-collapsed ROC curve.
+- Average precision uses the step-interpolated sum ``Σ_g ΔTP_g · P_g`` over
+  tie groups ``g``, rewritten per-element as ``Σ_i y_i · P_end(i) / P`` where
+  ``P_end(i)`` is precision at the END of i's tie group (so ties contribute
+  at the group precision, matching the distinct-threshold collapse).
+
+Everything is sort + cumsum + segment reductions: O(N log N), jittable,
+shard_map-safe — this is what lets exact AUROC/AP run inside fused SPMD
+programs where the reference must leave the device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.compute import high_precision
+
+
+def _tie_run_ids(sorted_vals: jax.Array) -> jax.Array:
+    """0-based run index per element of an already-sorted vector, ties sharing a run."""
+    boundary = jnp.concatenate([jnp.ones((1,), bool), sorted_vals[1:] != sorted_vals[:-1]])
+    return jnp.cumsum(boundary) - 1
+
+
+def midranks(x: jax.Array) -> jax.Array:
+    """Average 1-based ranks of ``x`` (ascending), ties sharing their midrank."""
+    n = x.shape[0]
+    order = jnp.argsort(x)
+    run_id = _tie_run_ids(x[order])
+    pos = jnp.arange(n, dtype=jnp.float32)
+    run_count = jax.ops.segment_sum(jnp.ones(n, jnp.float32), run_id, num_segments=n)
+    run_first = jax.ops.segment_min(pos, run_id, num_segments=n)
+    # 1-based midrank of a run starting at f (0-based) with c members: f + (c+1)/2
+    mid_sorted = run_first[run_id] + (run_count[run_id] + 1.0) * 0.5
+    return jnp.zeros(n, jnp.float32).at[order].set(mid_sorted)
+
+
+@high_precision
+def binary_auroc_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """Exact binary AUROC via midranks. Returns NaN when a class is empty."""
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    y = jnp.asarray(target).reshape(-1).astype(jnp.float32)
+    ranks = midranks(preds)
+    n_pos = jnp.sum(y)
+    n_neg = y.shape[0] - n_pos
+    u = jnp.sum(ranks * y) - n_pos * (n_pos + 1.0) * 0.5
+    denom = n_pos * n_neg
+    return jnp.where(denom > 0, u / jnp.maximum(denom, 1.0), jnp.nan)
+
+
+@high_precision
+def binary_average_precision_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """Exact binary AP (step interpolation, distinct-threshold collapse).
+
+    Returns NaN when there are no positives, matching the eager curve path
+    (`functional/classification/average_precision.py` → 0/0 recall).
+    """
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    y = jnp.asarray(target).reshape(-1).astype(jnp.float32)
+    n = preds.shape[0]
+    order = jnp.argsort(-preds)
+    ys = y[order]
+    ps = preds[order]
+    cum_tp = jnp.cumsum(ys)
+    cnt = jnp.arange(1, n + 1, dtype=jnp.float32)
+    run_id = _tie_run_ids(ps)
+    run_tp_end = jax.ops.segment_max(cum_tp, run_id, num_segments=n)
+    run_cnt_end = jax.ops.segment_max(cnt, run_id, num_segments=n)
+    prec_end = run_tp_end[run_id] / run_cnt_end[run_id]  # precision at i's group end
+    n_pos = cum_tp[-1]
+    ap = jnp.sum(ys * prec_end) / jnp.maximum(n_pos, 1.0)
+    return jnp.where(n_pos > 0, ap, jnp.nan)
+
+
+def _one_vs_rest(preds: jax.Array, target: jax.Array, num_classes: int) -> jax.Array:
+    """(N, C) one-hot of an int target, or target itself if already 2D."""
+    if target.ndim == preds.ndim:
+        return target.astype(jnp.float32)
+    return jax.nn.one_hot(target, num_classes, dtype=jnp.float32)
+
+
+def multiclass_auroc_sorted(
+    preds: jax.Array, target: jax.Array, num_classes: int, average: str = "macro"
+) -> jax.Array:
+    """Per-class one-vs-rest exact AUROC with macro/weighted/none averaging.
+
+    Degenerate classes (no positives or no negatives) score 0.0 and stay in
+    the macro mean — matching the eager curve path, where a flat ROC for an
+    unobserved class integrates to 0 (so jit and eager agree on identical
+    inputs). In the weighted average an unobserved class has support 0 and
+    drops out, mirroring `functional/classification/auroc.py:93-107`.
+    """
+    onehot = _one_vs_rest(preds, target, num_classes)
+    scores = jax.vmap(binary_auroc_sorted, in_axes=(1, 1))(preds, onehot)
+    scores = jnp.nan_to_num(scores, nan=0.0)
+    if average in ("none", None):
+        return scores
+    if average == "macro":
+        return jnp.mean(scores)
+    if average == "weighted":
+        support = onehot.sum(axis=0)
+        return jnp.sum(scores * support) / jnp.maximum(support.sum(), 1.0)
+    raise ValueError(f"Unsupported average {average!r} for traced AUROC")
+
+
+def multiclass_average_precision_sorted(
+    preds: jax.Array, target: jax.Array, num_classes: int, average: str = "macro"
+) -> jax.Array:
+    """Per-class one-vs-rest exact AP with micro/macro/weighted/none averaging."""
+    if average == "micro":
+        onehot = _one_vs_rest(preds, target, num_classes)
+        return binary_average_precision_sorted(preds.reshape(-1), onehot.reshape(-1))
+    onehot = _one_vs_rest(preds, target, num_classes)
+    scores = jax.vmap(binary_average_precision_sorted, in_axes=(1, 1))(preds, onehot)
+    if average in ("none", None):
+        return scores
+    valid = ~jnp.isnan(scores)
+    safe = jnp.where(valid, scores, 0.0)
+    if average == "macro":
+        return jnp.sum(safe) / jnp.maximum(valid.sum(), 1)
+    if average == "weighted":
+        support = onehot.sum(axis=0)
+        w = support / jnp.maximum(support.sum(), 1.0)
+        return jnp.sum(jnp.where(valid, scores * w, 0.0))
+    raise ValueError(f"Unsupported average {average!r} for traced AP")
+
+
+__all__ = [
+    "midranks",
+    "binary_auroc_sorted",
+    "binary_average_precision_sorted",
+    "multiclass_auroc_sorted",
+    "multiclass_average_precision_sorted",
+]
