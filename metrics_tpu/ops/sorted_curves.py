@@ -51,6 +51,8 @@ def binary_auroc_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
     """Exact binary AUROC via midranks. Returns NaN when a class is empty."""
     preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
     y = jnp.asarray(target).reshape(-1).astype(jnp.float32)
+    if preds.shape[0] == 0:  # empty shard: no data ⇒ undefined, like an empty class
+        return jnp.asarray(jnp.nan, dtype=jnp.float32)
     ranks = midranks(preds)
     n_pos = jnp.sum(y)
     n_neg = y.shape[0] - n_pos
@@ -69,6 +71,8 @@ def binary_average_precision_sorted(preds: jax.Array, target: jax.Array) -> jax.
     preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
     y = jnp.asarray(target).reshape(-1).astype(jnp.float32)
     n = preds.shape[0]
+    if n == 0:  # empty shard: no data ⇒ undefined, like a positives-free input
+        return jnp.asarray(jnp.nan, dtype=jnp.float32)
     order = jnp.argsort(-preds)
     ys = y[order]
     ps = preds[order]
@@ -118,10 +122,9 @@ def multiclass_average_precision_sorted(
     preds: jax.Array, target: jax.Array, num_classes: int, average: str = "macro"
 ) -> jax.Array:
     """Per-class one-vs-rest exact AP with micro/macro/weighted/none averaging."""
-    if average == "micro":
-        onehot = _one_vs_rest(preds, target, num_classes)
-        return binary_average_precision_sorted(preds.reshape(-1), onehot.reshape(-1))
     onehot = _one_vs_rest(preds, target, num_classes)
+    if average == "micro":
+        return binary_average_precision_sorted(preds.reshape(-1), onehot.reshape(-1))
     scores = jax.vmap(binary_average_precision_sorted, in_axes=(1, 1))(preds, onehot)
     if average in ("none", None):
         return scores

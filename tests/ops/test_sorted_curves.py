@@ -71,6 +71,12 @@ def test_degenerate_classes_nan():
     assert np.isnan(float(binary_average_precision_sorted(preds, np.zeros_like(preds, np.int32))))
 
 
+def test_empty_input_nan():
+    empty = jnp.zeros((0,))
+    assert np.isnan(float(binary_auroc_sorted(empty, empty)))
+    assert np.isnan(float(binary_average_precision_sorted(empty, empty)))
+
+
 @pytest.mark.parametrize("average", ["macro", "none"])
 def test_multiclass_auroc_vs_sklearn(average):
     preds, target = _multiclass_case(1)
