@@ -28,8 +28,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.functional.detection.box_ops import box_area, box_convert, box_iou, mask_iou
+from metrics_tpu.functional.detection.box_ops import box_convert, box_iou, mask_iou
 from metrics_tpu.metric import Metric
+
+
+def _box_iou_np(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Host mirror of the device `box_iou` — same float32 arithmetic, same
+    (unguarded) inter/union division, so the host/device cutoff can never
+    change the metric's value."""
+    det = det.astype(np.float32)
+    gt = gt.astype(np.float32)
+    area_d = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
+    area_g = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    lt = np.maximum(det[:, None, :2], gt[None, :, :2])
+    rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_d[:, None] + area_g[None, :] - inter
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return inter / union
+
+
+def _mask_iou_np(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Host mirror of the device `mask_iou` — float32 matmul, union>0 guard."""
+    d = det.reshape(det.shape[0], -1).astype(np.float32)
+    g = gt.reshape(gt.shape[0], -1).astype(np.float32)
+    inter = d @ g.T
+    union = d.sum(1)[:, None] + g.sum(1)[None, :] - inter
+    return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
 
 
 def _input_validator(preds: Sequence[dict], targets: Sequence[dict], iou_type: str = "bbox") -> None:
@@ -182,8 +208,19 @@ class MeanAveragePrecision(Metric):
 
     def _item_area(self, items: np.ndarray) -> np.ndarray:
         if self.iou_type == "bbox":
-            return np.asarray(box_area(jnp.asarray(items.reshape(-1, 4))))
+            # O(N) host arithmetic in the device path's float32: a device
+            # round-trip per ragged shape would recompile per distinct N and
+            # dominate wall-clock on slow-compile backends (xyxy area,
+            # reference `detection/mean_ap.py` via torchvision box_area)
+            b = items.reshape(-1, 4).astype(np.float32)
+            return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
         return items.reshape(items.shape[0], -1).sum(-1).astype(np.float64)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Next power-of-two padding size so the device IoU kernel compiles
+        O(log^2) distinct shapes instead of one per ragged (n_det, n_gt)."""
+        return max(8, 1 << (int(n) - 1).bit_length())
 
     def _compute_iou(self, idx: int, class_id: int, max_det: int) -> np.ndarray:
         """Device IoU between this image's class detections (score-sorted) and GTs."""
@@ -200,9 +237,30 @@ class MeanAveragePrecision(Metric):
         inds = np.argsort(-scores_filtered, kind="stable")
         det = det[inds][:max_det]
 
+        nd, ng = det.shape[0], gt.shape[0]
+        # Small problems: host numpy. A device dispatch per (image, class) pays
+        # a round-trip latency that dwarfs the arithmetic; the device path
+        # (bucket-padded so it compiles O(log^2) distinct shapes) wins once the
+        # work is genuinely large. The cost model counts actual FLOPs: box IoU
+        # is O(nd*ng) cells, mask IoU is O(nd*ng*H*W) — large masks go to the
+        # MXU even for a handful of instances.
+        work = nd * ng * (1 if self.iou_type == "bbox" else int(np.prod(det.shape[1:])))
+        if work <= 65536 * (1 if self.iou_type == "bbox" else 64):
+            if self.iou_type == "bbox":
+                return _box_iou_np(det, gt)
+            return _mask_iou_np(det, gt)
+        bd, bg = self._bucket(nd), self._bucket(ng)
         if self.iou_type == "bbox":
-            return np.asarray(box_iou(jnp.asarray(det), jnp.asarray(gt)))
-        return np.asarray(mask_iou(jnp.asarray(det), jnp.asarray(gt)))
+            det_p = np.zeros((bd, 4), det.dtype)
+            det_p[:nd] = det
+            gt_p = np.zeros((bg, 4), gt.dtype)
+            gt_p[:ng] = gt
+            return np.asarray(box_iou(jnp.asarray(det_p), jnp.asarray(gt_p)))[:nd, :ng]
+        det_p = np.zeros((bd,) + det.shape[1:], det.dtype)
+        det_p[:nd] = det
+        gt_p = np.zeros((bg,) + gt.shape[1:], gt.dtype)
+        gt_p[:ng] = gt
+        return np.asarray(mask_iou(jnp.asarray(det_p), jnp.asarray(gt_p)))[:nd, :ng]
 
     def _evaluate_image(
         self, idx: int, class_id: int, area_range: Tuple[int, int], max_det: int, ious: dict

@@ -189,12 +189,16 @@ def infolm(
     num_threads: int = 0,
     verbose: bool = True,
     return_sentence_level_score: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
 ):
     """InfoLM score between predictions and references.
 
-    Requires an MLM checkpoint reachable by ``transformers``; all information
-    measures are pure device math and unit-testable without a model via
-    :class:`_InformationMeasure`.
+    Requires an MLM checkpoint reachable by ``transformers``, OR an explicit
+    ``model`` + ``user_tokenizer`` pair (any Flax masked-LM with the standard
+    call signature) for offline/custom models — the same injection surface
+    BERTScore offers. All information measures are pure device math and
+    unit-testable without a model via :class:`_InformationMeasure`.
 
     ``device``/``num_threads``/``verbose`` are accepted for drop-in signature
     compatibility with the reference and are no-ops here (JAX manages device
@@ -207,9 +211,14 @@ def infolm(
         raise ValueError("Number of predicted and reference sentences must be the same!")
     if temperature <= 0:
         raise ValueError("Temperature must be strictly positive.")
+    if (model is None) != (user_tokenizer is None):
+        raise ValueError("Both `model` and `user_tokenizer` must be provided together (or neither).")
 
     measure = _InformationMeasure(information_measure, alpha, beta)
-    tokenizer, model = _load_mlm(model_name_or_path)
+    if model is not None:
+        tokenizer = user_tokenizer
+    else:
+        tokenizer, model = _load_mlm(model_name_or_path)
     if max_length is None:
         # reference default: model.config.max_length (`functional/text/infolm.py`);
         # cap the tokenizer fallback, which can be a sentinel like 1e30

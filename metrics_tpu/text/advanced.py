@@ -323,10 +323,14 @@ class InfoLM(Metric):
         max_length: Optional[int] = None,
         batch_size: int = 64,
         return_sentence_level_score: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.model_name_or_path = model_name_or_path
+        self.model = model
+        self.user_tokenizer = user_tokenizer
         self.temperature = temperature
         self.information_measure = information_measure
         self.idf = idf
@@ -361,6 +365,8 @@ class InfoLM(Metric):
             max_length=self.max_length,
             batch_size=self.batch_size,
             return_sentence_level_score=self.return_sentence_level_score,
+            model=self.model,
+            user_tokenizer=self.user_tokenizer,
         )
 
 

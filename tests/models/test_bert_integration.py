@@ -1,0 +1,86 @@
+"""BERTScore with a REAL Flax transformer forward (offline-constructed).
+
+The default BERTScore path embeds sentences with `FlaxAutoModel`
+(`metrics_tpu/functional/text/bert.py`); hub downloads are unavailable here,
+so these tests construct a tiny randomly-initialized `FlaxBertModel` plus a
+genuine WordPiece tokenizer from a locally written vocab — exercising the
+identical tokenize → Flax forward → cosine-match pipeline the pretrained path
+uses (reference counterpart: `tests/unittests/text/test_bertscore.py`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from transformers import BertConfig, BertTokenizerFast, FlaxBertModel  # noqa: E402
+
+from metrics_tpu.functional.text.bert import bert_score  # noqa: E402
+
+_WORDS = ["the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "slow"]
+
+
+@pytest.fixture(scope="module")
+def tiny_bert(tmp_path_factory):
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + _WORDS
+    vocab_file = tmp_path_factory.mktemp("bert") / "vocab.txt"
+    vocab_file.write_text("\n".join(vocab))
+    tokenizer = BertTokenizerFast(vocab_file=str(vocab_file), do_lower_case=True)
+    cfg = BertConfig(
+        vocab_size=len(vocab),
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=64,
+    )
+    model = FlaxBertModel(cfg, seed=0)
+    return model, tokenizer
+
+
+def test_identical_sentences_score_one(tiny_bert):
+    model, tokenizer = tiny_bert
+    sents = ["the cat sat on mat", "a dog ran fast"]
+    out = bert_score(sents, sents, model=model, user_tokenizer=tokenizer, max_length=16)
+    assert set(out) == {"precision", "recall", "f1"}
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["precision"]), 1.0, atol=1e-4)
+
+
+def test_different_sentences_score_below_one(tiny_bert):
+    model, tokenizer = tiny_bert
+    preds = ["the cat sat on mat", "a dog ran fast"]
+    target = ["a dog ran slow", "the mat sat"]
+    out = bert_score(preds, target, model=model, user_tokenizer=tokenizer, max_length=16)
+    f1 = np.asarray(out["f1"])
+    assert f1.shape == (2,)
+    assert np.all(f1 < 1.0) and np.all(f1 > -1.0)
+
+
+def test_idf_weighting_changes_score(tiny_bert):
+    model, tokenizer = tiny_bert
+    preds = ["the cat sat on mat", "the dog ran fast", "the cat ran"]
+    target = ["the cat sat on the mat", "a dog ran slow", "a cat ran fast"]
+    plain = bert_score(preds, target, model=model, user_tokenizer=tokenizer, max_length=16)
+    idf = bert_score(preds, target, model=model, user_tokenizer=tokenizer, max_length=16, idf=True)
+    assert not np.allclose(np.asarray(plain["f1"]), np.asarray(idf["f1"]))
+
+
+def test_module_metric_with_real_model(tiny_bert):
+    model, tokenizer = tiny_bert
+    from metrics_tpu import BERTScore
+
+    # the module API accepts a custom forward built on the real Flax model
+    def forward(sentences):
+        enc = tokenizer(sentences, padding="max_length", max_length=16, truncation=True, return_tensors="np")
+        out = model(enc["input_ids"], enc["attention_mask"]).last_hidden_state
+        return np.asarray(out), np.asarray(enc["attention_mask"])
+
+    m = BERTScore(user_forward_fn=forward)
+    m.update(["the cat sat"], ["the cat sat"])
+    m.update(["a dog ran"], ["a dog ran fast"])
+    out = m.compute()
+    f1 = np.asarray(out["f1"])
+    assert f1.shape == (2,)
+    assert f1[0] == pytest.approx(1.0, abs=1e-4)

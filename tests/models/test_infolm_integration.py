@@ -1,0 +1,86 @@
+"""InfoLM with a REAL Flax masked-LM forward (offline-constructed).
+
+Exercises the full pipeline — per-position masking, MLM forward, temperature-
+scaled distribution aggregation, information measures — with a tiny randomly
+initialized `FlaxBertForMaskedLM` plus a genuine WordPiece tokenizer, since
+hub checkpoints are unreachable here (reference counterpart:
+`tests/unittests/text/test_infolm.py`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from transformers import BertConfig, BertTokenizerFast, FlaxBertForMaskedLM  # noqa: E402
+
+from metrics_tpu import InfoLM  # noqa: E402
+from metrics_tpu.functional.text.infolm import infolm  # noqa: E402
+
+_WORDS = ["the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "slow"]
+
+
+@pytest.fixture(scope="module")
+def tiny_mlm(tmp_path_factory):
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + _WORDS
+    vocab_file = tmp_path_factory.mktemp("mlm") / "vocab.txt"
+    vocab_file.write_text("\n".join(vocab))
+    tokenizer = BertTokenizerFast(vocab_file=str(vocab_file), do_lower_case=True)
+    cfg = BertConfig(
+        vocab_size=len(vocab),
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=32,
+    )
+    return FlaxBertForMaskedLM(cfg, seed=0), tokenizer
+
+
+def test_identical_sentences_zero_divergence(tiny_mlm):
+    model, tokenizer = tiny_mlm
+    sents = ["the cat sat on mat", "a dog ran fast"]
+    score = infolm(sents, sents, model=model, user_tokenizer=tokenizer, max_length=16, idf=False)
+    assert float(score) == pytest.approx(0.0, abs=1e-5)  # KL(p‖p) = 0
+
+
+@pytest.mark.parametrize(
+    "measure,kwargs",
+    [
+        ("kl_divergence", {}),
+        ("l2_distance", {}),
+        ("fisher_rao_distance", {}),
+        ("alpha_divergence", {"alpha": 0.5}),
+        ("beta_divergence", {"beta": 0.7}),
+    ],
+)
+def test_measures_nonnegative_and_finite(tiny_mlm, measure, kwargs):
+    model, tokenizer = tiny_mlm
+    preds = ["the cat sat on mat", "a dog ran fast"]
+    target = ["a dog ran slow", "the mat sat"]
+    score = infolm(
+        preds, target, model=model, user_tokenizer=tokenizer, max_length=16, idf=False,
+        information_measure=measure, **kwargs,
+    )
+    val = float(score)
+    assert np.isfinite(val)
+    assert val >= -1e-6
+
+
+def test_module_metric_accumulates(tiny_mlm):
+    model, tokenizer = tiny_mlm
+    m = InfoLM(model=model, user_tokenizer=tokenizer, max_length=16, idf=False,
+               return_sentence_level_score=True)
+    m.update(["the cat sat"], ["the cat sat"])
+    m.update(["a dog ran"], ["a dog ran slow"])
+    mean_score, per_sentence = m.compute()
+    assert np.asarray(per_sentence).shape == (2,)
+    assert float(per_sentence[0]) == pytest.approx(0.0, abs=1e-5)
+    assert float(per_sentence[1]) > 0.0
+
+
+def test_injection_requires_pair(tiny_mlm):
+    model, _ = tiny_mlm
+    with pytest.raises(ValueError, match="together"):
+        infolm(["a"], ["a"], model=model)

@@ -72,7 +72,7 @@ def test_classification_fuzz(name, kwargs_fn, seed):
     batch = int(rng.choice([16, 33, 64]))
     n_batches = int(rng.randint(2, 5))
     kwargs = kwargs_fn(rng, num_classes)
-    kind = "probs" if name == "CalibrationError" else str(rng.choice(["probs", "labels"]))
+    kind = "probs" if name == "CalibrationError" else str(rng.choice(["probs", "labels", "logits"]))
     if name == "CalibrationError":
         kwargs.pop("num_classes", None)
     ours = getattr(mt, name)(**kwargs)
