@@ -410,6 +410,29 @@ def check_forward_full_state_property(
     print(f"Recommended setting `full_state_update={not faster}`")
 
 
+def is_overridden(method_name: str, instance: object, parent: type) -> bool:
+    """True when ``instance``'s ``method_name`` overrides ``parent``'s.
+
+    Parity: reference `utilities/checks.py:730-752` (sans mock support —
+    unwraps ``functools.wraps`` chains and ``partial``\\s before comparing).
+    """
+    from functools import partial
+
+    instance_attr = getattr(instance, method_name, None)
+    if instance_attr is None:
+        return False
+    while hasattr(instance_attr, "__wrapped__"):
+        instance_attr = instance_attr.__wrapped__
+    if isinstance(instance_attr, partial):
+        instance_attr = instance_attr.func
+    parent_attr = getattr(parent, method_name, None)
+    if parent_attr is None:
+        raise ValueError("The parent should define the method")
+    return getattr(instance_attr, "__func__", instance_attr) is not getattr(
+        parent_attr, "__func__", parent_attr
+    )
+
+
 __all__ = [
     "check_forward_full_state_property",
     "_input_format_classification",
@@ -417,4 +440,5 @@ __all__ = [
     "_check_same_shape",
     "_check_retrieval_inputs",
     "_input_squeeze",
+    "is_overridden",
 ]

@@ -38,6 +38,29 @@ def _try_import(name: str):
         return None
 
 
+@lru_cache()
+def compare_version(package: str, op, version: str) -> bool:
+    """True if ``package`` is installed and ``op(its_version, version)``.
+
+    Parity: reference `utilities/imports.py` ``_compare_version`` (lru-cached;
+    False rather than raising when the package is absent or unversioned).
+    """
+    if not package_available(package):
+        return False
+    try:
+        import importlib.metadata as _im
+
+        have = _im.version(package)
+    except Exception:
+        return False
+    from packaging.version import Version
+
+    try:
+        return bool(op(Version(have), Version(version)))
+    except Exception:
+        return False
+
+
 _SCIPY_AVAILABLE = package_available("scipy")
 _SKLEARN_AVAILABLE = package_available("sklearn")
 _NLTK_AVAILABLE = package_available("nltk")
@@ -52,6 +75,7 @@ _TORCH_AVAILABLE = package_available("torch")
 __all__ = [
     "package_available",
     "module_available",
+    "compare_version",
     "_SCIPY_AVAILABLE",
     "_SKLEARN_AVAILABLE",
     "_NLTK_AVAILABLE",

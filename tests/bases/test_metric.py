@@ -252,3 +252,29 @@ def test_functional_export_jit_scan():
 def test_unexpected_kwargs_raise():
     with pytest.raises(ValueError, match="Unexpected keyword"):
         DummyMetric(not_a_real_kwarg=True)
+
+
+def test_is_overridden():
+    from metrics_tpu.metric import Metric
+    from metrics_tpu.utils.checks import is_overridden
+
+    class Sub(Metric):
+        def update(self):
+            pass
+
+        def compute(self):
+            return 0
+
+    assert is_overridden("update", Sub(), Metric)
+    assert not is_overridden("reset", Sub(), Metric)
+    assert not is_overridden("missing_method", Sub(), Metric)
+
+
+def test_compare_version():
+    import operator
+
+    from metrics_tpu.utils.imports import compare_version
+
+    assert compare_version("numpy", operator.ge, "1.0")
+    assert not compare_version("numpy", operator.lt, "1.0")
+    assert not compare_version("definitely_not_a_package", operator.ge, "1.0")
