@@ -87,7 +87,15 @@ class MetricTracker:
         Dict[str, Optional[float]],
         Tuple[Dict[str, Optional[float]], Dict[str, Optional[int]]],
     ]:
-        """Best value (and optionally its step index) across the history."""
+        """Best value (and optionally its step index) across the history.
+
+        Intentional divergence from the reference: `wrappers/tracker.py:174`
+        unpacks ``torch.max(t, 0)`` as ``idx, best`` — torch returns
+        ``(values, indices)``, so the reference's "best" is actually the argmax
+        *index* (and with ``return_step`` the pair comes back swapped). This
+        implementation returns the actual best value, matching the documented
+        contract on both sides.
+        """
         if isinstance(self._base_metric, Metric):
             try:
                 values = self.compute_all()
