@@ -1,0 +1,158 @@
+"""Per-metric update-throughput sweep across the device-path metric suite.
+
+The BASELINE.md target "metric.update()/sec/chip over the 80-metric suite",
+as a harness: every listed metric gets synthetic data, its `as_functions`
+update jitted (donated state), and a steady-state samples/sec measurement —
+one JSON line each, plus a summary line. Host-side metrics (text, detection)
+are excluded: their cost is host string/matching work benchmarked separately
+in `tools/bench_extended.py`.
+
+    python tools/bench_sweep.py            # current default backend
+    JAX_PLATFORMS=cpu python tools/bench_sweep.py
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+BATCH, C = 4096, 16
+STEPS, TRIALS = 20, 3
+
+
+def _data(kind: str, rng):
+    if kind == "probs":
+        p = rng.rand(BATCH, C).astype(np.float32)
+        return (p / p.sum(1, keepdims=True), rng.randint(0, C, BATCH))
+    if kind == "binary":
+        return (rng.rand(BATCH).astype(np.float32), rng.randint(0, 2, BATCH))
+    if kind == "reg":
+        p = rng.randn(BATCH).astype(np.float32)
+        return (p, (p + 0.3 * rng.randn(BATCH)).astype(np.float32))
+    if kind == "reg_pos":
+        p = np.abs(rng.randn(BATCH)).astype(np.float32) + 0.1
+        return (p, np.abs(p + 0.3 * rng.randn(BATCH)).astype(np.float32) + 0.1)
+    if kind == "reg2d":
+        p = rng.randn(BATCH, 8).astype(np.float32)
+        return (p, (p + 0.3 * rng.randn(BATCH, 8)).astype(np.float32))
+    if kind == "img":
+        t = rng.rand(8, 3, 64, 64).astype(np.float32)
+        return (np.clip(t + 0.05 * rng.randn(*t.shape), 0, 1).astype(np.float32), t)
+    if kind == "audio":
+        t = rng.randn(8, 4000).astype(np.float32)
+        return ((t + 0.3 * rng.randn(*t.shape)).astype(np.float32), t)
+    if kind == "mlabel":
+        return ((rng.rand(BATCH, C) > 0.5).astype(np.int32), (rng.rand(BATCH, C) > 0.5).astype(np.int32))
+    if kind == "mlabel_probs":
+        return (rng.rand(BATCH, C).astype(np.float32), (rng.rand(BATCH, C) > 0.5).astype(np.int32))
+    raise ValueError(kind)
+
+
+SWEEP = [
+    # (metric ctor lambda, data kind, samples per step)
+    ("Accuracy", lambda mt: mt.Accuracy(num_classes=C, average="macro"), "probs", BATCH),
+    ("Precision", lambda mt: mt.Precision(num_classes=C, average="macro"), "probs", BATCH),
+    ("Recall", lambda mt: mt.Recall(num_classes=C, average="macro"), "probs", BATCH),
+    ("F1Score", lambda mt: mt.F1Score(num_classes=C, average="macro"), "probs", BATCH),
+    ("FBetaScore", lambda mt: mt.FBetaScore(num_classes=C, beta=2.0), "probs", BATCH),
+    ("Specificity", lambda mt: mt.Specificity(num_classes=C), "probs", BATCH),
+    ("Dice", lambda mt: mt.Dice(num_classes=C), "probs", BATCH),
+    ("StatScores", lambda mt: mt.StatScores(num_classes=C, reduce="macro"), "probs", BATCH),
+    ("ConfusionMatrix", lambda mt: mt.ConfusionMatrix(num_classes=C), "probs", BATCH),
+    ("CohenKappa", lambda mt: mt.CohenKappa(num_classes=C), "probs", BATCH),
+    ("MatthewsCorrCoef", lambda mt: mt.MatthewsCorrCoef(num_classes=C), "probs", BATCH),
+    ("JaccardIndex", lambda mt: mt.JaccardIndex(num_classes=C), "probs", BATCH),
+    ("CalibrationError", lambda mt: mt.CalibrationError(), "binary", BATCH),
+    ("HammingDistance", lambda mt: mt.HammingDistance(), "mlabel_probs", BATCH),
+    ("AUROC(exact,jit)", lambda mt: mt.AUROC(), "binary", BATCH),
+    ("AveragePrecision(exact,jit)", lambda mt: mt.AveragePrecision(), "binary", BATCH),
+    ("BinnedAveragePrecision", lambda mt: mt.BinnedAveragePrecision(num_classes=1, thresholds=100), "binary", BATCH),
+    ("KLDivergence", lambda mt: mt.KLDivergence(), "probs2", BATCH),
+    ("MeanSquaredError", lambda mt: mt.MeanSquaredError(), "reg", BATCH),
+    ("MeanAbsoluteError", lambda mt: mt.MeanAbsoluteError(), "reg", BATCH),
+    ("MeanAbsolutePercentageError", lambda mt: mt.MeanAbsolutePercentageError(), "reg_pos", BATCH),
+    ("MeanSquaredLogError", lambda mt: mt.MeanSquaredLogError(), "reg_pos", BATCH),
+    ("ExplainedVariance", lambda mt: mt.ExplainedVariance(), "reg", BATCH),
+    ("R2Score", lambda mt: mt.R2Score(), "reg", BATCH),
+    ("PearsonCorrCoef", lambda mt: mt.PearsonCorrCoef(), "reg", BATCH),
+    ("SpearmanCorrCoef", lambda mt: mt.SpearmanCorrCoef(), "reg", BATCH),
+    ("CosineSimilarity", lambda mt: mt.CosineSimilarity(), "reg2d", BATCH),
+    ("TweedieDevianceScore", lambda mt: mt.TweedieDevianceScore(power=1.5), "reg_pos", BATCH),
+    ("MeanMetric", lambda mt: mt.MeanMetric(), "agg", BATCH),
+    ("SumMetric", lambda mt: mt.SumMetric(), "agg", BATCH),
+    ("MaxMetric", lambda mt: mt.MaxMetric(), "agg", BATCH),
+    ("PeakSignalNoiseRatio", lambda mt: mt.PeakSignalNoiseRatio(data_range=1.0), "img", 8),
+    ("StructuralSimilarityIndexMeasure", lambda mt: mt.StructuralSimilarityIndexMeasure(), "img", 8),
+    ("MultiScaleSSIM", lambda mt: mt.MultiScaleStructuralSimilarityIndexMeasure(), "img", 8),
+    ("UniversalImageQualityIndex", lambda mt: mt.UniversalImageQualityIndex(), "img", 8),
+    ("SpectralAngleMapper", lambda mt: mt.SpectralAngleMapper(), "img", 8),
+    ("SignalNoiseRatio", lambda mt: mt.SignalNoiseRatio(), "audio", 8),
+    ("ScaleInvariantSignalDistortionRatio", lambda mt: mt.ScaleInvariantSignalDistortionRatio(), "audio", 8),
+    ("SignalDistortionRatio", lambda mt: mt.SignalDistortionRatio(), "audio", 8),
+]
+
+
+def main() -> None:
+    import jax
+
+    import metrics_tpu as mt
+
+    rng = np.random.RandomState(0)
+    results = []
+    for name, ctor, kind, samples in SWEEP:
+        try:
+            if kind == "probs2":
+                p = rng.rand(BATCH, C).astype(np.float32)
+                data = (p / p.sum(1, keepdims=True), (lambda q: q / q.sum(1, keepdims=True))(rng.rand(BATCH, C).astype(np.float32)))
+            elif kind == "agg":
+                data = (rng.randn(BATCH).astype(np.float32),)
+            else:
+                data = _data(kind, rng)
+            metric = ctor(mt)
+            init, upd, _ = metric.as_functions()
+            state0 = init()
+            has_cat = any(isinstance(v, list) for v in state0.values())
+            if has_cat:
+                # cat-state metrics grow their state pytree every update, so a
+                # jitted update would retrace per step; their supported hot
+                # path is the eager module update (device kernels inside, no
+                # trace) — time that instead
+                mode = "eager"
+                jdata = [jax.numpy.asarray(d) for d in data]
+                metric.update(*jdata)  # warmup (device transfer + compile)
+                best = float("inf")
+                for _ in range(TRIALS):
+                    metric.reset()
+                    start = time.perf_counter()
+                    for _ in range(STEPS):
+                        metric.update(*jdata)
+                    jax.block_until_ready(metric.metric_state)
+                    best = min(best, time.perf_counter() - start)
+            else:
+                mode = "jit"
+                fused = jax.jit(upd, donate_argnums=(0,))
+                state = fused(state0, *data)
+                jax.block_until_ready(state)
+                best = float("inf")
+                for _ in range(TRIALS):
+                    start = time.perf_counter()
+                    for _ in range(STEPS):
+                        state = fused(state, *data)
+                    jax.block_until_ready(state)
+                    best = min(best, time.perf_counter() - start)
+            rate = STEPS * samples / best
+            results.append({"metric": name, "mode": mode, "updates_per_s": round(STEPS / best, 1), "samples_per_s": round(rate, 1)})
+            print(json.dumps(results[-1]))
+        except Exception as err:
+            print(json.dumps({"metric": name, "error": str(err)[:160]}))
+    if results:
+        print(json.dumps({"metric": "SWEEP_SUMMARY", "n": len(results),
+                          "median_updates_per_s": round(float(np.median([r["updates_per_s"] for r in results])), 1)}))
+
+
+if __name__ == "__main__":
+    main()
