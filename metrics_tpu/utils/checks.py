@@ -33,6 +33,116 @@ def _is_concrete(*arrays) -> bool:
     return not any(isinstance(a, jax.core.Tracer) for a in arrays)
 
 
+@jax.jit
+def _minmax_pair(preds, target):
+    """min/max of both inputs as ONE device program → one host transfer.
+
+    The value checks need up to four scalar reductions; issuing them as
+    separate eager ops costs a blocking device sync each (hundreds of ms per
+    update on remote/tunneled backends). Fused + jitted they are a single
+    tiny program and a single 4-float transfer.
+    """
+    as_f32 = lambda x: x.astype(jnp.float32)  # noqa: E731
+    return jnp.stack(
+        [as_f32(target.min()), as_f32(target.max()), as_f32(preds.min()), as_f32(preds.max())]
+    )
+
+
+_BENIGN_STATS = np.array([0.0, 0.0, 0.0, 1.0], dtype=np.float32)  # t_min, t_max, p_min, p_max
+_validation_mode: Optional[str] = None  # resolved lazily from env
+_seen_check_keys: set = set()
+
+
+def set_validation_mode(mode: str) -> None:
+    """Control value-dependent input validation: ``"full"`` (default — every
+    update, reference parity), ``"first"`` (first update per input signature,
+    skipped after), or ``"off"``.
+
+    Shape/dtype validation always runs; this only gates checks that must read
+    data values (label ranges, probability bounds). Each such read costs one
+    blocking device→host sync — microseconds locally, but a full network
+    round-trip per ``update()`` on remote/tunneled TPU backends, where
+    ``"first"`` keeps misuse protection for the common case at zero
+    steady-state cost. Also settable via ``METRICS_TPU_VALIDATION``.
+    """
+    if mode not in ("full", "first", "off"):
+        raise ValueError(f"validation mode must be 'full', 'first' or 'off', got {mode!r}")
+    global _validation_mode
+    _validation_mode = mode
+    _seen_check_keys.clear()
+
+
+def _get_validation_mode() -> str:
+    global _validation_mode
+    if _validation_mode is None:
+        import os
+
+        _validation_mode = os.environ.get("METRICS_TPU_VALIDATION", "full")
+        if _validation_mode not in ("full", "first", "off"):
+            _validation_mode = "full"
+    return _validation_mode
+
+
+def _should_value_check(preds, target, key_extra=()) -> bool:
+    mode = _get_validation_mode()
+    if mode == "off":
+        return False
+    if mode == "full":
+        return True
+    if not _is_concrete(preds, target):
+        # a traced update never value-checks; do NOT consume the signature —
+        # a later eager update with the same shapes must still get checked
+        return False
+    key = (preds.shape, str(preds.dtype), target.shape, str(target.dtype), key_extra)
+    if key in _seen_check_keys:
+        return False
+    _seen_check_keys.add(key)
+    return True
+
+
+class _ValueStats:
+    """Lazily fetched (t_min, t_max, p_min, p_max) shared across check stages.
+
+    When the validation mode gates this signature out, benign values that pass
+    every check are returned without touching the device (target stats 0 —
+    below every class bound; preds in [0, 1]).
+    """
+
+    __slots__ = ("_preds", "_target", "_vals")
+
+    def __init__(self, preds, target, force: bool = False, key_extra=()) -> None:
+        self._preds, self._target = preds, target
+        self._vals = (
+            None if (force or _should_value_check(preds, target, key_extra)) else _BENIGN_STATS
+        )
+
+    @property
+    def is_real(self) -> bool:
+        """True when the stats reflect actual data (not the benign skip values)."""
+        return self._vals is not _BENIGN_STATS
+
+    def _fetch(self) -> np.ndarray:
+        if self._vals is None:
+            self._vals = np.asarray(_minmax_pair(self._preds, self._target))
+        return self._vals
+
+    @property
+    def target_min(self) -> float:
+        return float(self._fetch()[0])
+
+    @property
+    def target_max(self) -> float:
+        return float(self._fetch()[1])
+
+    @property
+    def preds_min(self) -> float:
+        return float(self._fetch()[2])
+
+    @property
+    def preds_max(self) -> float:
+        return float(self._fetch()[3])
+
+
 def _check_same_shape(preds, target) -> None:
     if preds.shape != target.shape:
         raise RuntimeError(
@@ -54,7 +164,7 @@ def _squeeze_excess_dims(preds, target):
     return preds, target
 
 
-def _basic_validation(preds, target, threshold, multiclass, ignore_index) -> None:
+def _basic_validation(preds, target, threshold, multiclass, ignore_index, stats=None) -> None:
     if _check_for_empty(preds, target):
         return
     if jnp.issubdtype(target.dtype, jnp.floating):
@@ -64,27 +174,30 @@ def _basic_validation(preds, target, threshold, multiclass, ignore_index) -> Non
         raise ValueError("The `preds` and `target` should have the same first dimension.")
     if not _is_concrete(preds, target):
         return  # value checks need concrete data
-    if ignore_index is None and int(target.min()) < 0:
+    stats = stats or _ValueStats(preds, target)
+    if ignore_index is None and stats.target_min < 0:
         raise ValueError("The `target` has to be a non-negative tensor.")
-    if ignore_index is not None and ignore_index >= 0 and int(target.min()) < 0:
+    if ignore_index is not None and ignore_index >= 0 and stats.target_min < 0:
         raise ValueError("The `target` has to be a non-negative tensor.")
-    if not preds_float and int(preds.min()) < 0:
+    if not preds_float and stats.preds_min < 0:
         raise ValueError("If `preds` are integers, they have to be non-negative.")
-    if multiclass is False and int(target.max()) > 1:
+    if multiclass is False and stats.target_max > 1:
         raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
-    if multiclass is False and not preds_float and int(preds.max()) > 1:
+    if multiclass is False and not preds_float and stats.preds_max > 1:
         raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
 
 
-def _case_and_implied_classes(preds, target) -> Tuple[DataType, int]:
+def _case_and_implied_classes(preds, target, stats=None) -> Tuple[DataType, int]:
     """Resolve the input case from shapes/dtypes (reference `:68-121`)."""
     preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    if stats is None:
+        stats = _ValueStats(preds, target)
     if preds.ndim == target.ndim:
         if preds.shape != target.shape:
             raise ValueError(
                 f"The `preds` and `target` should have the same shape, got {preds.shape} and {target.shape}."
             )
-        if preds_float and target.size > 0 and _is_concrete(target) and int(target.max()) > 1:
+        if preds_float and target.size > 0 and _is_concrete(target) and stats.target_max > 1:
             raise ValueError(
                 "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
             )
@@ -115,7 +228,7 @@ def _case_and_implied_classes(preds, target) -> Tuple[DataType, int]:
     return case, implied_classes
 
 
-def _validate_num_classes(case, preds, target, num_classes, multiclass, implied_classes) -> None:
+def _validate_num_classes(case, preds, target, num_classes, multiclass, implied_classes, stats=None) -> None:
     if case == DataType.BINARY:
         if num_classes > 2:
             raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
@@ -141,7 +254,7 @@ def _validate_num_classes(case, preds, target, num_classes, multiclass, implied_
                     "You have set `multiclass=False`, but the implied number of classes"
                     " (from shape of inputs) does not match `num_classes`."
                 )
-            if target.size > 0 and _is_concrete(target) and num_classes <= int(target.max()):
+            if target.size > 0 and _is_concrete(target) and num_classes <= (stats or _ValueStats(preds, target)).target_max:
                 raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
             if preds.shape != target.shape and num_classes != implied_classes:
                 raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
@@ -180,10 +293,15 @@ def _check_classification_inputs(
     multiclass: Optional[bool],
     top_k: Optional[int],
     ignore_index: Optional[int] = None,
+    stats: Optional[_ValueStats] = None,
 ) -> DataType:
     """Full input validation; returns the resolved :class:`DataType` case."""
-    _basic_validation(preds, target, threshold, multiclass, ignore_index)
-    case, implied_classes = _case_and_implied_classes(preds, target)
+    if stats is None:
+        stats = _ValueStats(
+            preds, target, key_extra=(threshold, num_classes, multiclass, top_k, ignore_index)
+        )
+    _basic_validation(preds, target, threshold, multiclass, ignore_index, stats)
+    case, implied_classes = _case_and_implied_classes(preds, target, stats)
 
     if preds.shape != target.shape:
         if multiclass is False and implied_classes != 2:
@@ -191,13 +309,13 @@ def _check_classification_inputs(
                 "You have set `multiclass=False`, but have more than 2 classes in your data,"
                 " based on the C dimension of `preds`."
             )
-        if target.size > 0 and _is_concrete(target) and int(target.max()) >= implied_classes:
+        if target.size > 0 and _is_concrete(target) and stats.target_max >= implied_classes:
             raise ValueError(
                 "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
             )
 
     if num_classes:
-        _validate_num_classes(case, preds, target, num_classes, multiclass, implied_classes)
+        _validate_num_classes(case, preds, target, num_classes, multiclass, implied_classes, stats)
 
     if top_k is not None:
         _validate_top_k(top_k, case, implied_classes, multiclass, jnp.issubdtype(preds.dtype, jnp.floating))
@@ -228,6 +346,9 @@ def _input_format_classification(
     if preds.dtype == jnp.float16:
         preds = preds.astype(jnp.float32)
 
+    stats = _ValueStats(
+        preds, target, key_extra=(threshold, num_classes, multiclass, top_k, ignore_index)
+    )
     case = _check_classification_inputs(
         preds,
         target,
@@ -236,6 +357,7 @@ def _input_format_classification(
         multiclass=multiclass,
         top_k=top_k,
         ignore_index=ignore_index,
+        stats=stats,
     )
 
     if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
@@ -256,7 +378,10 @@ def _input_format_classification(
                         "`num_classes` must be given explicitly for label inputs under jit tracing"
                         " (class count defines the output shape, which must be static on TPU)."
                     )
-                num_classes = int(max(int(preds.max()), int(target.max())) + 1)
+                # inference, not validation: needs REAL values — reuse the
+                # already-fetched stats when possible, force-fetch otherwise
+                _s = stats if stats.is_real else _ValueStats(preds, target, force=True)
+                num_classes = int(max(_s.preds_max, _s.target_max) + 1)
             preds = to_onehot(preds, max(2, num_classes))
         target = to_onehot(target, max(2, int(num_classes) if num_classes else 2))
 

@@ -278,3 +278,80 @@ def test_compare_version():
     assert compare_version("numpy", operator.ge, "1.0")
     assert not compare_version("numpy", operator.lt, "1.0")
     assert not compare_version("definitely_not_a_package", operator.ge, "1.0")
+
+
+def test_validation_modes():
+    import jax.numpy as jnp
+    import pytest
+
+    import metrics_tpu as mt
+    from metrics_tpu.utils.checks import set_validation_mode
+
+    bad_preds, bad_target = jnp.asarray([-1, 0, 1]), jnp.asarray([-1, 0, 1])
+    good_p, good_t = jnp.asarray([0.2, 0.8, 0.5]), jnp.asarray([0, 1, 1])
+    try:
+        set_validation_mode("first")
+        # first update with a signature: misuse raises
+        with pytest.raises(ValueError, match="non-negative"):
+            mt.Accuracy(num_classes=3).update(bad_preds, bad_target)
+        # same signature again: value checks skipped (no raise)
+        mt.Accuracy(num_classes=3).update(bad_preds, bad_target)
+        # shape checks still always run
+        with pytest.raises(ValueError):
+            mt.Accuracy(num_classes=3).update(jnp.zeros((2, 3)), jnp.zeros((5,), jnp.int32))
+
+        set_validation_mode("off")
+        mt.Accuracy(num_classes=3).update(bad_preds, bad_target)  # no raise
+
+        set_validation_mode("full")
+        with pytest.raises(ValueError, match="non-negative"):
+            mt.Accuracy(num_classes=3).update(bad_preds, bad_target)
+        acc = mt.Accuracy()
+        acc.update(good_p, good_t)  # normal path still works
+        assert float(acc.compute()) >= 0
+        with pytest.raises(ValueError):
+            set_validation_mode("bogus")
+    finally:
+        set_validation_mode("full")
+
+
+def test_validation_first_mode_key_includes_config():
+    """A permissive config (ignore_index) must not mark the signature safe for
+    a strict config (review regression)."""
+    import jax.numpy as jnp
+    import pytest
+
+    import metrics_tpu as mt
+    from metrics_tpu.utils.checks import set_validation_mode
+
+    try:
+        set_validation_mode("first")
+        neg = jnp.asarray([-1, 0, 1])
+        m_ok = mt.Accuracy(num_classes=2, ignore_index=-1, multiclass=True)
+        m_ok.update(jnp.asarray([0, 0, 1]), neg)  # legitimately passes
+        with pytest.raises(ValueError, match="non-negative"):
+            mt.Accuracy(num_classes=2, multiclass=True).update(jnp.asarray([0, 0, 1]), neg)
+    finally:
+        set_validation_mode("full")
+
+
+def test_validation_first_mode_traced_does_not_consume_signature():
+    """A jitted update never value-checks; the NEXT eager update with the same
+    shapes must still be validated (review regression)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    import metrics_tpu as mt
+    from metrics_tpu.utils.checks import set_validation_mode
+
+    try:
+        set_validation_mode("first")
+        init, upd, _ = mt.Accuracy(num_classes=3).as_functions()
+        good = jnp.asarray([1, 0, 2])
+        jax.jit(upd)(init(), good, good)  # traced: no value checks run
+        bad = jnp.asarray([-1, 0, 1])
+        with pytest.raises(ValueError, match="non-negative"):
+            mt.Accuracy(num_classes=3).update(jnp.asarray([1, 0, 2]), bad)
+    finally:
+        set_validation_mode("full")

@@ -45,8 +45,6 @@ def _data(kind: str, rng):
     if kind == "audio":
         t = rng.randn(8, 4000).astype(np.float32)
         return ((t + 0.3 * rng.randn(*t.shape)).astype(np.float32), t)
-    if kind == "mlabel":
-        return ((rng.rand(BATCH, C) > 0.5).astype(np.int32), (rng.rand(BATCH, C) > 0.5).astype(np.int32))
     if kind == "mlabel_probs":
         return (rng.rand(BATCH, C).astype(np.float32), (rng.rand(BATCH, C) > 0.5).astype(np.int32))
     raise ValueError(kind)
@@ -97,6 +95,11 @@ SWEEP = [
 
 
 def main() -> None:
+    import os
+
+    # throughput harness: value-check the first batch per signature only
+    # (see docs/performance.md "Input validation cost on remote backends")
+    os.environ.setdefault("METRICS_TPU_VALIDATION", "first")
     import jax
 
     import metrics_tpu as mt
