@@ -64,6 +64,35 @@ def _f64_compute():
     return jax.enable_x64(True)
 
 
+def _native_f64_backend() -> bool:
+    """True when the default backend computes float64 in hardware (CPU/GPU).
+
+    TPUs emulate f64 in software; an emulated 2048x2048 ``eigh`` is
+    impractically slow, so f64 statistics route to host LAPACK there.
+    """
+    try:
+        return jax.default_backend() in ("cpu", "gpu", "cuda", "rocm")
+    except Exception:
+        return True
+
+
+def _fid_from_features_host(real: np.ndarray, fake: np.ndarray) -> float:
+    """Fréchet distance in host numpy float64 — same math as the device path."""
+    real = real.astype(np.float64)
+    fake = fake.astype(np.float64)
+    mu1, mu2 = real.mean(0), fake.mean(0)
+    d1, d2 = real - mu1, fake - mu2
+    cov1 = d1.T @ d1 / (real.shape[0] - 1)
+    cov2 = d2.T @ d2 / (fake.shape[0] - 1)
+    vals1, vecs1 = np.linalg.eigh(cov1)
+    s1_half = (vecs1 * np.sqrt(np.clip(vals1, 0, None))[None, :]) @ vecs1.T
+    inner = s1_half @ cov2 @ s1_half
+    vals = np.linalg.eigvalsh(inner)
+    tr_covmean = np.sum(np.sqrt(np.clip(vals, 0, None)))
+    diff = mu1 - mu2
+    return float(diff @ diff + np.trace(cov1) + np.trace(cov2) - 2 * tr_covmean)
+
+
 def _resolve_extractor(feature: Union[int, str, Callable], valid: tuple, params: Any, seed: int) -> Callable:
     if isinstance(feature, (int, str)) and not callable(feature):
         if feature not in valid:
@@ -151,6 +180,15 @@ class FrechetInceptionDistance(_FeatureBufferMetric):
         real_features = dim_zero_cat(self.real_features)
         fake_features = dim_zero_cat(self.fake_features)
         orig_dtype = real_features.dtype
+        if not _native_f64_backend():
+            # TPU has no native float64 — the emulated f64 eigh of a 2048x2048
+            # covariance takes minutes-to-never. Features stay device-extracted;
+            # the O(D^2) statistics finish on host LAPACK in f64, the same
+            # device/host split as the reference's scipy sqrtm (`image/fid.py:61-95`)
+            return jnp.asarray(
+                _fid_from_features_host(np.asarray(real_features), np.asarray(fake_features)),
+                dtype=orig_dtype,
+            )
         with _f64_compute():
             real64 = real_features.astype(jnp.float64)
             fake64 = fake_features.astype(jnp.float64)

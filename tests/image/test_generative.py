@@ -224,3 +224,28 @@ class TestInceptionV3Model:
         unb = ex_unb(imgs)
         bias = ex.params["params"]["fc_bias"]
         np.testing.assert_allclose(np.asarray(logits), np.asarray(unb + bias), atol=1e-5)
+
+
+def test_fid_host_path_matches_device_path(monkeypatch):
+    """The TPU host-LAPACK FID route must agree with the on-device f64 route
+    (the backend picks between them; the value must not depend on it)."""
+    import numpy as np
+
+    import metrics_tpu.image.generative as G
+
+    rng = np.random.RandomState(7)
+    feat = lambda x: jnp.asarray(x).reshape(x.shape[0], -1)[:, :16]  # noqa: E731
+
+    def build():
+        fid = G.FrechetInceptionDistance(feature=feat)
+        fid.update(jnp.asarray(rng.rand(32, 3, 4, 4).astype(np.float32)), real=True)
+        fid.update(jnp.asarray(rng.rand(32, 3, 4, 4).astype(np.float32) + 0.3), real=False)
+        return fid
+
+    rng = np.random.RandomState(7)
+    monkeypatch.setattr(G, "_native_f64_backend", lambda: True)
+    device_val = float(build().compute())
+    rng = np.random.RandomState(7)
+    monkeypatch.setattr(G, "_native_f64_backend", lambda: False)
+    host_val = float(build().compute())
+    assert host_val == pytest.approx(device_val, rel=1e-5)
