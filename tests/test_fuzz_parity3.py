@@ -1,0 +1,148 @@
+"""Fuzz-parity wave 3: binned curve variants, calibration norms, text scores.
+
+Covers the families waves 1-2 skipped: the O(1)-state binned curve metrics
+(the blessed jit path), every CalibrationError norm, and the remaining text
+metrics (SQuAD, Perplexity, SacreBLEU tokenizer draws, ROUGE variants).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+N_VARIATIONS = 3
+
+
+def _close(a, b, atol=1e-5):
+    flat_a = a if isinstance(a, (list, tuple)) else [a]
+    flat_b = b if isinstance(b, (list, tuple)) else [b]
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol, rtol=1e-4)
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+@pytest.mark.parametrize("num_classes", [1, 4])
+@pytest.mark.parametrize(
+    "name,extra",
+    [
+        ("BinnedAveragePrecision", {}),
+        ("BinnedPrecisionRecallCurve", {}),
+        ("BinnedRecallAtFixedPrecision", {"min_precision": 0.4}),
+    ],
+)
+def test_binned_curves_fuzz(name, extra, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    thresholds = int(rng.choice([25, 50, 101]))
+    n = int(rng.choice([64, 129]))
+    if num_classes == 1:
+        preds = rng.rand(n).astype(np.float32)
+        target = (rng.rand(n) > 0.4).astype(np.int64)
+    else:
+        p = rng.rand(n, num_classes).astype(np.float32)
+        preds = p / p.sum(1, keepdims=True)
+        target = np.eye(num_classes, dtype=np.int64)[rng.randint(0, num_classes, n)]
+    ours = getattr(mt, name)(num_classes=num_classes, thresholds=thresholds, **extra)
+    ref = getattr(_ref, name)(num_classes=num_classes, thresholds=thresholds, **extra)
+    for chunk in range(2):
+        sl = slice(chunk * n // 2, (chunk + 1) * n // 2)
+        ours.update(jnp.asarray(preds[sl]), jnp.asarray(target[sl]))
+        ref.update(torch.tensor(preds[sl]), torch.tensor(target[sl]))
+    a, b = ours.compute(), ref.compute()
+    if name == "BinnedPrecisionRecallCurve":
+        for x, y in zip(a, b):
+            if isinstance(x, list):
+                for xi, yi in zip(x, y):
+                    _close(xi, yi.numpy())
+            else:
+                _close(x, y.numpy())
+    else:
+        _close(a, [t.numpy() for t in b] if isinstance(b, (list, tuple)) else b.numpy())
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_norms_fuzz(norm, seed):
+    rng = np.random.RandomState(10 + seed)
+    n_bins = int(rng.choice([10, 15, 20]))
+    preds = rng.rand(128).astype(np.float32)
+    target = (rng.rand(128) > 0.5).astype(np.int64)
+    ours = mt.CalibrationError(n_bins=n_bins, norm=norm)
+    ref = _ref.CalibrationError(n_bins=n_bins, norm=norm)
+    ours.update(jnp.asarray(preds), jnp.asarray(target))
+    ref.update(torch.tensor(preds), torch.tensor(target))
+    _close(ours.compute(), ref.compute().numpy())
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+def test_squad_fuzz(seed):
+    rng = np.random.RandomState(20 + seed)
+    answers = ["the cat", "a dog ran", "on the mat", "hello world"]
+    preds, targets = [], []
+    for i in range(int(rng.randint(2, 5))):
+        ans = answers[rng.randint(0, len(answers))]
+        guess = ans if rng.rand() > 0.5 else answers[rng.randint(0, len(answers))]
+        preds.append({"prediction_text": guess, "id": str(i)})
+        targets.append({"answers": {"answer_start": [0], "text": [ans]}, "id": str(i)})
+    ours, ref = mt.SQuAD(), _ref.SQuAD()
+    ours.update(preds, targets)
+    ref.update(preds, targets)
+    a, b = ours.compute(), ref.compute()
+    for k in ("exact_match", "f1"):
+        np.testing.assert_allclose(float(a[k]), float(b[k]), atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+def test_perplexity_fuzz(seed):
+    rng = np.random.RandomState(30 + seed)
+    b, s, v = 2, int(rng.choice([8, 17])), int(rng.choice([5, 11]))
+    logits = rng.randn(b, s, v).astype(np.float32)
+    target = rng.randint(0, v, (b, s))
+    ignore = None if rng.rand() > 0.5 else 0
+    ours = mt.Perplexity(ignore_index=ignore)
+    ref = _ref.Perplexity(ignore_index=ignore)
+    ours.update(jnp.asarray(logits), jnp.asarray(target))
+    ref.update(torch.tensor(logits), torch.tensor(target))
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), rtol=1e-4)
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "char", "none"])
+def test_sacrebleu_tokenizers(tokenize):
+    preds = ["the cat sat on the mat", "hello world this is a test"]
+    targets = [["the cat sat on a mat"], ["hello world this was a test sentence"]]
+    ours = mt.SacreBLEUScore(tokenize=tokenize)
+    ref = _ref.SacreBLEUScore(tokenize=tokenize)
+    ours.update(preds, targets)
+    ref.update(preds, targets)
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5)
+
+
+@pytest.mark.parametrize("use_stemmer", [False, True])
+def test_rouge_variants(use_stemmer):
+    from torchmetrics.text.rouge import ROUGEScore as RefROUGE
+
+    from metrics_tpu.utils.imports import _NLTK_AVAILABLE
+
+    if use_stemmer and not _NLTK_AVAILABLE:
+        pytest.skip("nltk unavailable")
+    preds = ["the cat sat on the mat", "dogs running fast"]
+    targets = ["a cat sat on the mat", "the dog ran faster"]
+    ours = mt.ROUGEScore(use_stemmer=use_stemmer)
+    try:
+        ref = RefROUGE(use_stemmer=use_stemmer)
+        ref.update(preds, targets)
+    except LookupError:
+        pytest.skip("reference ROUGE needs nltk data unavailable offline")
+    ours.update(preds, targets)
+    a, b = ours.compute(), ref.compute()
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_allclose(float(a[k]), float(b[k]), atol=1e-5)
