@@ -59,13 +59,17 @@ def _ce_compute(
 def _ce_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array]:
     _, _, mode = _input_format_classification(preds, target)
 
+    # logit detection is branch-free on device: a host `bool(...)` probe would
+    # block one device->host sync per update (a full network round-trip on
+    # tunneled backends), and under jit the probe can't run at all — `where`
+    # keeps eager and traced results identical with zero syncs
     if mode == DataType.BINARY:
-        if not isinstance(preds, jax.core.Tracer) and not bool(((preds >= 0) & (preds <= 1)).all()):
-            preds = jax.nn.sigmoid(preds)
+        is_prob = ((preds >= 0) & (preds <= 1)).all()
+        preds = jnp.where(is_prob, preds, jax.nn.sigmoid(preds))
         confidences, accuracies = preds, target
     elif mode == DataType.MULTICLASS:
-        if not isinstance(preds, jax.core.Tracer) and not bool(((preds >= 0) & (preds <= 1)).all()):
-            preds = jax.nn.softmax(preds, axis=1)
+        is_prob = ((preds >= 0) & (preds <= 1)).all()
+        preds = jnp.where(is_prob, preds, jax.nn.softmax(preds, axis=1))
         confidences = preds.max(axis=1)
         accuracies = preds.argmax(axis=1) == target
     elif mode == DataType.MULTIDIM_MULTICLASS:
