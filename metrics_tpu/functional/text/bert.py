@@ -93,6 +93,21 @@ def _greedy_cos_sim(
     return precision, recall, f1
 
 
+def _read_baseline_csv(baseline_path: str) -> "jnp.ndarray":
+    """Read a bert_score baseline csv: header, then ``layer,P,R,F`` rows.
+
+    Same format as reference `functional/text/bert.py:166-175`; returns the
+    ``(n_layers, 3)`` P/R/F baseline table (layer column dropped).
+    """
+    import csv
+
+    with open(baseline_path) as fname:
+        rows = [[float(item) for item in row] for idx, row in enumerate(csv.reader(fname)) if idx > 0]
+    if not rows:
+        raise ValueError(f"Baseline file {baseline_path!r} contains no data rows")
+    return jnp.asarray(rows)[:, 1:]
+
+
 def bert_score(
     preds: Union[str, List[str]],
     target: Union[str, List[str]],
@@ -170,10 +185,18 @@ def bert_score(
     )
 
     if rescale_with_baseline:
-        raise NotImplementedError(
-            "Baseline rescaling requires the downloaded baseline files; pass rescale_with_baseline=False"
-            " or rescale externally."
-        )
+        if baseline_path is None:
+            raise ValueError(
+                "`rescale_with_baseline=True` requires `baseline_path` pointing to a local baseline"
+                " csv (the bert_score format: header row, then `layer,P,R,F` rows — no downloads here)."
+            )
+        baseline = _read_baseline_csv(baseline_path)
+        layer_idx = -1 if num_layers is None else num_layers
+        scale = baseline[layer_idx]  # (3,) = P, R, F baselines for the layer
+        # reference `functional/text/bert.py:216-229`: (x - b) / (1 - b)
+        precision = (precision - scale[0]) / (1 - scale[0])
+        recall = (recall - scale[1]) / (1 - scale[1])
+        f1 = (f1 - scale[2]) / (1 - scale[2])
 
     return {
         "precision": [float(p) for p in precision],

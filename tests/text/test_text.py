@@ -372,3 +372,32 @@ class TestPackedStringSync:
 
         with pytest.raises(ValueError, match="user_tokenizer"):
             bert_score(["a"], ["a"], model=object())
+
+
+def test_bert_score_baseline_rescale(tmp_path):
+    """rescale_with_baseline applies (x - b)/(1 - b) from a local csv in the
+    bert_score file format (reference `functional/text/bert.py:166-229`)."""
+    import numpy as np
+
+    from metrics_tpu.functional import bert_score
+
+    def toy_forward(sentences):
+        rng = np.random.RandomState(0)
+        emb = np.stack([rng.rand(4, 8) + len(s) for s in sentences])
+        return emb.astype(np.float32), np.ones((len(sentences), 4), np.float32)
+
+    csv_file = tmp_path / "baseline.csv"
+    csv_file.write_text("LAYER,P,R,F\n0,0.1,0.2,0.3\n1,0.4,0.5,0.6\n")
+
+    plain = bert_score(["ab", "abcd"], ["ab", "abc"], user_forward_fn=toy_forward)
+    scaled = bert_score(
+        ["ab", "abcd"], ["ab", "abc"], user_forward_fn=toy_forward,
+        rescale_with_baseline=True, baseline_path=str(csv_file), num_layers=1,
+    )
+    for k, b in zip(("precision", "recall", "f1"), (0.4, 0.5, 0.6)):
+        np.testing.assert_allclose(
+            np.asarray(scaled[k]), (np.asarray(plain[k]) - b) / (1 - b), atol=1e-6
+        )
+
+    with pytest.raises(ValueError, match="baseline_path"):
+        bert_score(["a"], ["a"], user_forward_fn=toy_forward, rescale_with_baseline=True)
