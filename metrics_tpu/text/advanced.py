@@ -266,19 +266,41 @@ class BERTScore(Metric):
         self,
         model_name_or_path: Optional[str] = None,
         num_layers: Optional[int] = None,
-        idf: bool = False,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
         user_forward_fn: Optional[Any] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        device: Optional[Any] = None,
         max_length: int = 512,
         batch_size: int = 64,
+        num_threads: int = 4,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.model_name_or_path = model_name_or_path
         self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.model = model
+        self.user_tokenizer = user_tokenizer
         self.idf = idf
         self.user_forward_fn = user_forward_fn
+        self.verbose = verbose
+        self.device_arg = device
         self.max_length = max_length
         self.batch_size = batch_size
+        self.num_threads = num_threads
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
         self.add_state("preds_packed", [], dist_reduce_fx="cat")
         self.add_state("target_packed", [], dist_reduce_fx="cat")
 
@@ -298,10 +320,21 @@ class BERTScore(Metric):
             _cat_packed(self.target_packed),
             model_name_or_path=self.model_name_or_path,
             num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_tokenizer=self.user_tokenizer,
             idf=self.idf,
             user_forward_fn=self.user_forward_fn,
+            verbose=self.verbose,
+            device=self.device_arg,
             max_length=self.max_length,
             batch_size=self.batch_size,
+            num_threads=self.num_threads,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
         )
 
 
