@@ -353,14 +353,20 @@ class InfoLM(Metric):
         idf: bool = True,
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
+        device: Optional[Any] = None,
         max_length: Optional[int] = None,
         batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
         return_sentence_level_score: bool = False,
         model: Optional[Any] = None,
         user_tokenizer: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        # device/num_threads/verbose: reference torch runtime knobs, accepted
+        # for drop-in signature parity and unused (JAX manages placement)
+        del device, num_threads, verbose
         self.model_name_or_path = model_name_or_path
         self.model = model
         self.user_tokenizer = user_tokenizer
