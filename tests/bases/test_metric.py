@@ -355,3 +355,28 @@ def test_validation_first_mode_traced_does_not_consume_signature():
             mt.Accuracy(num_classes=3).update(jnp.asarray([1, 0, 2]), bad)
     finally:
         set_validation_mode("full")
+
+
+def test_compute_on_cpu_offloads_list_states():
+    """compute_on_cpu moves cat-state chunks to host numpy after each update
+    (HBM relief for feature banks) without changing any computed value."""
+    import numpy as np
+
+    import metrics_tpu as mt
+
+    rng = np.random.RandomState(0)
+    preds = rng.rand(64).astype(np.float32)
+    target = (rng.rand(64) > 0.5).astype(np.int32)
+
+    offloaded = mt.AveragePrecision(compute_on_cpu=True)
+    regular = mt.AveragePrecision()
+    for sl in (slice(0, 32), slice(32, 64)):
+        offloaded.update(jnp.asarray(preds[sl]), jnp.asarray(target[sl]))
+        regular.update(jnp.asarray(preds[sl]), jnp.asarray(target[sl]))
+
+    assert all(isinstance(v, np.ndarray) for v in offloaded.preds)  # host-resident
+    assert all(isinstance(v, jax.Array) for v in regular.preds)  # device-resident
+    np.testing.assert_allclose(float(offloaded.compute()), float(regular.compute()), atol=1e-6)
+
+    offloaded.reset()
+    assert offloaded.preds == []
