@@ -360,8 +360,6 @@ def test_validation_first_mode_traced_does_not_consume_signature():
 def test_compute_on_cpu_offloads_list_states():
     """compute_on_cpu moves cat-state chunks to host numpy after each update
     (HBM relief for feature banks) without changing any computed value."""
-    import numpy as np
-
     import metrics_tpu as mt
 
     rng = np.random.RandomState(0)
