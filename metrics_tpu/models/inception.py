@@ -185,6 +185,15 @@ def _resize_bilinear(imgs: jax.Array, size: int = 299) -> jax.Array:
     return jax.image.resize(imgs, imgs.shape[:2] + (size, size), method="bilinear")
 
 
+@functools.partial(jax.jit, static_argnums=0)
+@high_precision
+def _jitted_apply(model: "InceptionV3", params: Any, imgs: jax.Array) -> Dict[str, jax.Array]:
+    # metric-grade features: full-precision convs (TPU default is bf16).
+    # Module-level with the (hashable) flax module static so FID/KID/IS
+    # extractor instances share ONE compiled executable per config.
+    return model.apply(params, imgs)
+
+
 class InceptionV3Extractor:
     """Callable imgs → [N, d] features, the ``NoTrainInceptionV3`` analogue.
 
@@ -206,13 +215,7 @@ class InceptionV3Extractor:
             dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
             params = self.model.init(jax.random.PRNGKey(seed), dummy)
         self.params = params
-        self._forward = jax.jit(functools.partial(self._apply, self.model))
-
-    @staticmethod
-    @high_precision
-    def _apply(model: "InceptionV3", params: Any, imgs: jax.Array) -> Dict[str, jax.Array]:
-        # metric-grade features: full-precision convs (TPU default is bf16)
-        return model.apply(params, imgs)
+        self._forward = functools.partial(_jitted_apply, self.model)
 
     def __call__(self, imgs: jax.Array) -> jax.Array:
         imgs = jnp.asarray(imgs)

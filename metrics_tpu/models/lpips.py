@@ -129,8 +129,9 @@ class LPIPSNet(nn.Module):
 
         total = 0.0
         for i, (f1, f2) in enumerate(zip(feats1, feats2)):
-            f1 = f1 / jnp.sqrt(jnp.sum(f1**2, axis=-1, keepdims=True) + 1e-10)
-            f2 = f2 / jnp.sqrt(jnp.sum(f2**2, axis=-1, keepdims=True) + 1e-10)
+            # eps OUTSIDE the sqrt, matching the published lpips normalize_tensor
+            f1 = f1 / (jnp.sqrt(jnp.sum(f1**2, axis=-1, keepdims=True)) + 1e-10)
+            f2 = f2 / (jnp.sqrt(jnp.sum(f2**2, axis=-1, keepdims=True)) + 1e-10)
             diff = (f1 - f2) ** 2
             head = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}")
             # published LPIPS heads are trained non-negative; enforce at apply
@@ -140,24 +141,31 @@ class LPIPSNet(nn.Module):
         return total
 
 
+@functools.partial(jax.jit, static_argnums=0)
+@high_precision
+def _jitted_apply(model: "LPIPSNet", params: Any, img1: jax.Array, img2: jax.Array) -> jax.Array:
+    # module-level with the (hashable) flax module static: extractor
+    # instances with the same net_type share one compiled executable
+    return model.apply(params, img1, img2)
+
+
 class LPIPSExtractor:
     """Callable ``(img1, img2) → [N]`` LPIPS scores (NCHW inputs in [-1, 1])."""
 
-    def __init__(self, net_type: str = "alex", params: Any = None, seed: int = 0) -> None:
+    def __init__(self, net_type: str = "alex", params: Any = None, npz_path: str = None, seed: int = 0) -> None:
         if net_type not in _BACKBONES:
             raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
         self.net_type = net_type
         self.model = LPIPSNet(net_type=net_type)
+        if params is None and npz_path is not None:
+            from metrics_tpu.models.inception import params_from_npz
+
+            params = params_from_npz(npz_path)
         if params is None:
             dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
             params = self.model.init(jax.random.PRNGKey(seed), dummy, dummy)
         self.params = params
-        self._forward = jax.jit(functools.partial(self._apply, self.model))
-
-    @staticmethod
-    @high_precision
-    def _apply(model: "LPIPSNet", params: Any, img1: jax.Array, img2: jax.Array) -> jax.Array:
-        return model.apply(params, img1, img2)
+        self._forward = functools.partial(_jitted_apply, self.model)
 
     def __call__(self, img1: jax.Array, img2: jax.Array) -> jax.Array:
         img1 = jnp.transpose(jnp.asarray(img1), (0, 2, 3, 1))

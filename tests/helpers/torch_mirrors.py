@@ -1,0 +1,302 @@
+"""Torch-side architecture mirrors used as numerical oracles for model parity.
+
+This environment has no network egress, so the pretrained checkpoints the
+reference consumes (torch-fidelity's InceptionV3 for FID/KID/IS —
+`/root/reference/src/torchmetrics/image/fid.py:27-45` — and the ``lpips``
+package nets — `image/lpip.py:24-40`) cannot be downloaded. What CAN be
+proven here is the part that actually goes wrong in practice: that the Flax
+models in ``metrics_tpu/models/`` implement the same architecture, tap the
+same activations in the same order, and that the weight converters map every
+torch parameter to the right Flax leaf with the right layout.
+
+These mirrors are written directly against torch.nn from the published
+architecture descriptions (Szegedy et al. 2015 TF-Slim InceptionV3 with
+1008-way logits; Zhang et al. 2018 LPIPS over torchvision AlexNet). Their
+``state_dict()`` uses the same key naming as the real checkpoints, so the
+production converters (`tools/convert_inception_weights.py`,
+`tools/convert_lpips_weights.py`) run unmodified on them. A golden test that
+passes torch-mirror weights through the converter into the Flax model and
+matches taps/end-to-end numbers therefore fails on any tap-ordering,
+pooling-mode, padding, or converter-layout drift — exactly the bugs that
+would silently corrupt FID/KID/IS/LPIPS once real weights are loaded.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+
+class _ConvBN(nn.Module):
+    """Bias-free conv + inference BatchNorm(eps=1e-3) + ReLU (tf-compat block)."""
+
+    def __init__(self, cin: int, cout: int, kernel, stride=1, padding=0) -> None:
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, kernel, stride, padding, bias=False)
+        self.bn = nn.BatchNorm2d(cout, eps=1e-3)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg3(x: torch.Tensor) -> torch.Tensor:
+    return F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+
+
+class _MirrorA(nn.Module):
+    def __init__(self, cin: int, pool_features: int) -> None:
+        super().__init__()
+        self.branch1x1 = _ConvBN(cin, 64, 1)
+        self.branch5x5_1 = _ConvBN(cin, 48, 1)
+        self.branch5x5_2 = _ConvBN(48, 64, 5, padding=2)
+        self.branch3x3dbl_1 = _ConvBN(cin, 64, 1)
+        self.branch3x3dbl_2 = _ConvBN(64, 96, 3, padding=1)
+        self.branch3x3dbl_3 = _ConvBN(96, 96, 3, padding=1)
+        self.branch_pool = _ConvBN(cin, pool_features, 1)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        return torch.cat(
+            [
+                self.branch1x1(x),
+                self.branch5x5_2(self.branch5x5_1(x)),
+                self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+                self.branch_pool(_avg3(x)),
+            ],
+            dim=1,
+        )
+
+
+class _MirrorB(nn.Module):
+    def __init__(self, cin: int) -> None:
+        super().__init__()
+        self.branch3x3 = _ConvBN(cin, 384, 3, stride=2)
+        self.branch3x3dbl_1 = _ConvBN(cin, 64, 1)
+        self.branch3x3dbl_2 = _ConvBN(64, 96, 3, padding=1)
+        self.branch3x3dbl_3 = _ConvBN(96, 96, 3, stride=2)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        return torch.cat(
+            [
+                self.branch3x3(x),
+                self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+                F.max_pool2d(x, 3, stride=2),
+            ],
+            dim=1,
+        )
+
+
+class _MirrorC(nn.Module):
+    def __init__(self, cin: int, c7: int) -> None:
+        super().__init__()
+        self.branch1x1 = _ConvBN(cin, 192, 1)
+        self.branch7x7_1 = _ConvBN(cin, c7, 1)
+        self.branch7x7_2 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.branch7x7_3 = _ConvBN(c7, 192, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = _ConvBN(cin, c7, 1)
+        self.branch7x7dbl_2 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = _ConvBN(c7, 192, (1, 7), padding=(0, 3))
+        self.branch_pool = _ConvBN(cin, 192, 1)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_1(x)
+        for mod in (self.branch7x7dbl_2, self.branch7x7dbl_3, self.branch7x7dbl_4, self.branch7x7dbl_5):
+            bd = mod(bd)
+        return torch.cat([self.branch1x1(x), b7, bd, self.branch_pool(_avg3(x))], dim=1)
+
+
+class _MirrorD(nn.Module):
+    def __init__(self, cin: int) -> None:
+        super().__init__()
+        self.branch3x3_1 = _ConvBN(cin, 192, 1)
+        self.branch3x3_2 = _ConvBN(192, 320, 3, stride=2)
+        self.branch7x7x3_1 = _ConvBN(cin, 192, 1)
+        self.branch7x7x3_2 = _ConvBN(192, 192, (1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = _ConvBN(192, 192, (7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = _ConvBN(192, 192, 3, stride=2)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b7 = self.branch7x7x3_1(x)
+        for mod in (self.branch7x7x3_2, self.branch7x7x3_3, self.branch7x7x3_4):
+            b7 = mod(b7)
+        return torch.cat(
+            [self.branch3x3_2(self.branch3x3_1(x)), b7, F.max_pool2d(x, 3, stride=2)], dim=1
+        )
+
+
+class _MirrorE(nn.Module):
+    def __init__(self, cin: int, pool_type: str) -> None:
+        super().__init__()
+        self.pool_type = pool_type
+        self.branch1x1 = _ConvBN(cin, 320, 1)
+        self.branch3x3_1 = _ConvBN(cin, 384, 1)
+        self.branch3x3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = _ConvBN(cin, 448, 1)
+        self.branch3x3dbl_2 = _ConvBN(448, 384, 3, padding=1)
+        self.branch3x3dbl_3a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.branch_pool = _ConvBN(cin, 192, 1)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], dim=1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], dim=1)
+        if self.pool_type == "avg":
+            bp = _avg3(x)
+        else:
+            bp = F.max_pool2d(x, 3, stride=1, padding=1)
+        return torch.cat([self.branch1x1(x), b3, bd, self.branch_pool(bp)], dim=1)
+
+
+class TorchInceptionMirror(nn.Module):
+    """TF-compat InceptionV3 trunk returning the same tap dict as the Flax model.
+
+    State-dict keys follow the torch-fidelity checkpoint naming
+    (``Conv2d_1a_3x3.conv.weight``, ``Mixed_5b.branch1x1.bn.running_mean``,
+    ``fc.weight``…) so ``convert_state_dict`` applies directly.
+    """
+
+    def __init__(self, num_classes: int = 1008) -> None:
+        super().__init__()
+        self.Conv2d_1a_3x3 = _ConvBN(3, 32, 3, stride=2)
+        self.Conv2d_2a_3x3 = _ConvBN(32, 32, 3)
+        self.Conv2d_2b_3x3 = _ConvBN(32, 64, 3, padding=1)
+        self.Conv2d_3b_1x1 = _ConvBN(64, 80, 1)
+        self.Conv2d_4a_3x3 = _ConvBN(80, 192, 3)
+        self.Mixed_5b = _MirrorA(192, 32)
+        self.Mixed_5c = _MirrorA(256, 64)
+        self.Mixed_5d = _MirrorA(288, 64)
+        self.Mixed_6a = _MirrorB(288)
+        self.Mixed_6b = _MirrorC(768, 128)
+        self.Mixed_6c = _MirrorC(768, 160)
+        self.Mixed_6d = _MirrorC(768, 160)
+        self.Mixed_6e = _MirrorC(768, 192)
+        self.Mixed_7a = _MirrorD(768)
+        self.Mixed_7b = _MirrorE(1280, "avg")
+        self.Mixed_7c = _MirrorE(2048, "max")
+        self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x: torch.Tensor) -> Dict[str, torch.Tensor]:
+        out: Dict[str, torch.Tensor] = {}
+        x = self.Conv2d_2b_3x3(self.Conv2d_2a_3x3(self.Conv2d_1a_3x3(x)))
+        x = F.max_pool2d(x, 3, stride=2)
+        out["64"] = x.mean(dim=(2, 3))
+        x = self.Conv2d_4a_3x3(self.Conv2d_3b_1x1(x))
+        x = F.max_pool2d(x, 3, stride=2)
+        out["192"] = x.mean(dim=(2, 3))
+        for name in ("Mixed_5b", "Mixed_5c", "Mixed_5d", "Mixed_6a", "Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e"):
+            x = getattr(self, name)(x)
+        out["768"] = x.mean(dim=(2, 3))
+        for name in ("Mixed_7a", "Mixed_7b", "Mixed_7c"):
+            x = getattr(self, name)(x)
+        pooled = x.mean(dim=(2, 3))
+        out["2048"] = pooled
+        out["logits_unbiased"] = pooled @ self.fc.weight.t()
+        out["logits"] = out["logits_unbiased"] + self.fc.bias
+        return out
+
+
+def randomize_inception_(model: TorchInceptionMirror, seed: int = 0) -> None:
+    """Well-conditioned random weights: BN stats near identity so activations
+    stay bounded through the 94-conv trunk (default kaiming init + unit-ish
+    running stats keep fp32 tap comparison meaningful)."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for mod in model.modules():
+            if isinstance(mod, nn.BatchNorm2d):
+                mod.running_mean.normal_(0.0, 0.05, generator=gen)
+                mod.running_var.uniform_(0.8, 1.2, generator=gen)
+                mod.weight.uniform_(0.8, 1.2, generator=gen)
+                mod.bias.normal_(0.0, 0.05, generator=gen)
+            elif isinstance(mod, (nn.Conv2d, nn.Linear)):
+                fan_in = mod.weight[0].numel()
+                mod.weight.normal_(0.0, (2.0 / fan_in) ** 0.5, generator=gen)
+                if getattr(mod, "bias", None) is not None:
+                    mod.bias.normal_(0.0, 0.05, generator=gen)
+    model.eval()
+
+
+# ---------------------------------------------------------------------------
+# LPIPS (AlexNet backbone) mirror
+# ---------------------------------------------------------------------------
+
+# published LPIPS scaling-layer constants (match metrics_tpu.models.lpips)
+_LPIPS_SHIFT = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+_LPIPS_SCALE = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+
+# torchvision AlexNet `features` indices of the five tapped convs
+ALEX_FEATURE_INDICES = (0, 3, 6, 8, 10)
+
+
+class TorchAlexLPIPSMirror(nn.Module):
+    """LPIPS-over-AlexNet oracle; state dict keys follow the ``lpips`` package
+    layout (``net.slice{k}.{idx}.weight`` for the backbone, ``lin{k}.model.1.weight``
+    for the heads) so ``tools/convert_lpips_weights.py`` applies directly."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        specs = [  # (cin, cout, kernel, stride, padding) per tapped conv
+            (3, 64, 11, 4, 2),
+            (64, 192, 5, 1, 2),
+            (192, 384, 3, 1, 1),
+            (384, 256, 3, 1, 1),
+            (256, 256, 3, 1, 1),
+        ]
+        self.net = nn.Module()
+        for k, (idx, (cin, cout, ksz, st, pad)) in enumerate(zip(ALEX_FEATURE_INDICES, specs), start=1):
+            slice_mod = nn.Module()
+            slice_mod.add_module(str(idx), nn.Conv2d(cin, cout, ksz, st, pad))
+            self.net.add_module(f"slice{k}", slice_mod)
+        for k, (_, cout, *_rest) in enumerate(specs):
+            lin = nn.Module()
+            lin.model = nn.Module()
+            lin.model.add_module("1", nn.Conv2d(cout, 1, 1, bias=False))
+            self.add_module(f"lin{k}", lin)
+
+    def _taps(self, x: torch.Tensor):
+        taps = []
+        convs = [getattr(getattr(self.net, f"slice{k}"), str(i)) for k, i in enumerate(ALEX_FEATURE_INDICES, start=1)]
+        x = F.relu(convs[0](x))
+        taps.append(x)
+        x = F.relu(convs[1](F.max_pool2d(x, 3, 2)))
+        taps.append(x)
+        x = F.relu(convs[2](F.max_pool2d(x, 3, 2)))
+        taps.append(x)
+        x = F.relu(convs[3](x))
+        taps.append(x)
+        taps.append(F.relu(convs[4](x)))
+        return taps
+
+    def forward(self, img1: torch.Tensor, img2: torch.Tensor) -> torch.Tensor:
+        x1 = (img1 - _LPIPS_SHIFT) / _LPIPS_SCALE
+        x2 = (img2 - _LPIPS_SHIFT) / _LPIPS_SCALE
+        total = torch.zeros(img1.shape[0])
+        for k, (f1, f2) in enumerate(zip(self._taps(x1), self._taps(x2))):
+            f1 = f1 / (f1.pow(2).sum(dim=1, keepdim=True).sqrt() + 1e-10)
+            f2 = f2 / (f2.pow(2).sum(dim=1, keepdim=True).sqrt() + 1e-10)
+            head = getattr(getattr(self, f"lin{k}").model, "1")
+            total = total + head((f1 - f2).pow(2)).abs().mean(dim=(2, 3))[:, 0]
+        return total
+
+
+def randomize_lpips_(model: TorchAlexLPIPSMirror, seed: int = 0) -> None:
+    """Random backbone + non-negative head weights (published heads are trained
+    non-negative; keeping the fixture non-negative makes the |·| a no-op on
+    both sides of the comparison)."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for name, mod in model.named_modules():
+            if isinstance(mod, nn.Conv2d):
+                fan_in = mod.weight[0].numel()
+                mod.weight.normal_(0.0, (2.0 / fan_in) ** 0.5, generator=gen)
+                if name.startswith("lin"):
+                    mod.weight.abs_()
+                if mod.bias is not None:
+                    mod.bias.normal_(0.0, 0.05, generator=gen)
+    model.eval()

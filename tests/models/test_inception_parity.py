@@ -1,0 +1,198 @@
+"""Golden weight-sharing parity: Flax InceptionV3 vs an independent torch mirror.
+
+The reference's FID/KID/IS numbers come from torch-fidelity's InceptionV3
+(`/root/reference/src/torchmetrics/image/fid.py:27-58`). No egress means the
+real checkpoint can't be fetched, so parity is pinned the strongest way
+available: a torch-side mirror of the same published architecture
+(tests/helpers/torch_mirrors.py) is given random-but-well-conditioned
+weights, those exact weights are pushed through the production converter
+(`tools/convert_inception_weights.py`) into the Flax model, and every
+feature tap plus the end-to-end FID/KID/IS numbers must agree. Any drift in
+tap ordering, pooling mode, padding, BN epsilon, or converter layout fails
+these tests — which is precisely the class of bug that would silently
+corrupt published-number parity once real weights are loaded.
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "tools"))
+from convert_inception_weights import convert_state_dict  # noqa: E402
+
+from tests.helpers.torch_mirrors import TorchInceptionMirror, randomize_inception_  # noqa: E402
+
+TAPS = ("64", "192", "768", "2048", "logits_unbiased", "logits")
+
+
+@pytest.fixture(scope="module")
+def shared():
+    """(torch mirror, flax variables, uint8 test images) with identical weights."""
+    from metrics_tpu.models.inception import params_from_npz
+
+    mirror = TorchInceptionMirror()
+    randomize_inception_(mirror, seed=7)
+    state = {k: v.numpy() for k, v in mirror.state_dict().items()}
+    converted = convert_state_dict(state)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "w.npz")
+        np.savez(path, **converted)
+        variables = params_from_npz(path)
+    imgs = np.random.RandomState(11).randint(0, 256, size=(2, 3, 299, 299), dtype=np.uint8)
+    return mirror, variables, imgs
+
+
+def _torch_taps(mirror, imgs_uint8):
+    x = torch.from_numpy(imgs_uint8).float() / 255.0 * 2.0 - 1.0
+    with torch.no_grad():
+        return {k: v.numpy() for k, v in mirror(x).items()}
+
+
+def _flax_taps(variables, imgs_uint8):
+    from metrics_tpu.models.inception import InceptionV3
+
+    x = jnp.asarray(imgs_uint8).astype(jnp.float32) / 255.0 * 2.0 - 1.0
+    x = jnp.transpose(x, (0, 2, 3, 1))
+    out = InceptionV3().apply(variables, x)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def test_all_taps_match(shared):
+    """Feature-tap equality at 64/192/768/2048/logits — the VERDICT #1 gate."""
+    mirror, variables, imgs = shared
+    got = _flax_taps(variables, imgs)
+    want = _torch_taps(mirror, imgs)
+    assert set(got) == set(want)
+    for name in TAPS:
+        scale = np.abs(want[name]).mean() + 1e-6
+        err = np.abs(got[name] - want[name]).max()
+        assert err / scale < 5e-3, f"tap {name}: max abs err {err} vs mean scale {scale}"
+
+
+def test_extractor_end_to_end_matches(shared):
+    """The user-facing extractor path (uint8 NCHW -> resize -> normalize) agrees."""
+    from metrics_tpu.models.inception import InceptionV3Extractor
+
+    mirror, variables, imgs = shared
+    feats = np.asarray(InceptionV3Extractor(feature="2048", params=variables)(jnp.asarray(imgs)))
+    want = _torch_taps(mirror, imgs)["2048"]
+    scale = np.abs(want).mean() + 1e-6
+    assert np.abs(feats - want).max() / scale < 5e-3
+
+
+@pytest.fixture(scope="module")
+def mirror_features(shared):
+    """Larger image batches featurized by BOTH stacks (feature=64 keeps the
+    covariance small and the oracle numerically honest with 24 samples)."""
+    mirror, variables, _ = shared
+    rng = np.random.RandomState(3)
+    real = rng.randint(0, 256, size=(24, 3, 299, 299), dtype=np.uint8)
+    fake = np.clip(real.astype(np.int16) + rng.randint(-40, 40, size=real.shape), 0, 255).astype(np.uint8)
+    with torch.no_grad():
+        t_real = _torch_taps(mirror, real)
+        t_fake = _torch_taps(mirror, fake)
+    return real, fake, t_real, t_fake
+
+
+def test_fid_matches_scipy_oracle(shared, mirror_features):
+    """End-to-end FID: our metric (Flax features + eigh sqrtm) vs torch-mirror
+    features + scipy.linalg.sqrtm — the reference's exact host formula
+    (`image/fid.py:61-126`)."""
+    import scipy.linalg
+
+    from metrics_tpu.image.generative import FrechetInceptionDistance
+
+    _, variables, _ = shared
+    real, fake, t_real, t_fake = mirror_features
+
+    fid = FrechetInceptionDistance(feature=64, params=variables)
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    ours = float(fid.compute())
+
+    r, f = t_real["64"].astype(np.float64), t_fake["64"].astype(np.float64)
+    mu1, mu2 = r.mean(0), f.mean(0)
+    cov1, cov2 = np.cov(r, rowvar=False), np.cov(f, rowvar=False)
+    covmean = scipy.linalg.sqrtm(cov1 @ cov2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    want = float((mu1 - mu2) @ (mu1 - mu2) + np.trace(cov1) + np.trace(cov2) - 2 * np.trace(covmean))
+
+    assert ours == pytest.approx(want, rel=2e-2, abs=1e-3)
+
+
+def test_kid_matches_numpy_oracle(shared, mirror_features):
+    """End-to-end KID vs a numpy polynomial-MMD oracle on torch-mirror features."""
+    from metrics_tpu.image.generative import KernelInceptionDistance
+
+    _, variables, _ = shared
+    real, fake, t_real, t_fake = mirror_features
+
+    kid = KernelInceptionDistance(feature=64, params=variables, subsets=1, subset_size=24, seed=0)
+    kid.update(jnp.asarray(real), real=True)
+    kid.update(jnp.asarray(fake), real=False)
+    ours = float(kid.compute()[0])
+
+    r, f = t_real["64"].astype(np.float64), t_fake["64"].astype(np.float64)
+    gamma = 1.0 / r.shape[1]
+    k_xx = (r @ r.T * gamma + 1.0) ** 3
+    k_yy = (f @ f.T * gamma + 1.0) ** 3
+    k_xy = (r @ f.T * gamma + 1.0) ** 3
+    m = r.shape[0]
+    want = float(
+        ((k_xx.sum() - np.trace(k_xx)) + (k_yy.sum() - np.trace(k_yy))) / (m * (m - 1))
+        - 2 * k_xy.sum() / m**2
+    )
+    assert ours == pytest.approx(want, rel=2e-2, abs=1e-4)
+
+
+def test_inception_score_matches_numpy_oracle(shared, mirror_features):
+    """End-to-end IS on logits_unbiased vs a numpy KL oracle."""
+    from metrics_tpu.image.generative import InceptionScore
+
+    _, variables, _ = shared
+    real, _, t_real, _ = mirror_features
+
+    iscore = InceptionScore(feature="logits_unbiased", params=variables, splits=2, seed=0)
+    iscore.update(jnp.asarray(real))
+    ours_mean, ours_std = (float(v) for v in iscore.compute())
+
+    logits = t_real["logits_unbiased"].astype(np.float64)
+    logits = logits[np.random.RandomState(0).permutation(logits.shape[0])]
+    z = logits - logits.max(axis=1, keepdims=True)
+    prob = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+    log_prob = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+    scores = []
+    for chunk_p, chunk_lp in zip(np.array_split(prob, 2), np.array_split(log_prob, 2)):
+        mean_p = chunk_p.mean(axis=0, keepdims=True)
+        kl = (chunk_p * (chunk_lp - np.log(mean_p))).sum(axis=1).mean()
+        scores.append(np.exp(kl))
+    want_mean, want_std = float(np.mean(scores)), float(np.std(scores, ddof=1))
+    assert ours_mean == pytest.approx(want_mean, rel=1e-2)
+    assert ours_std == pytest.approx(want_std, rel=0.2, abs=1e-3)
+
+
+def test_trace_sqrtm_identity_vs_scipy():
+    """The device-path identity trace sqrtm(AB) = sum sqrt(eig(sqrt(A) B sqrt(A)))
+    against scipy.linalg.sqrtm on random (incl. rank-deficient) PSD pairs."""
+    import scipy.linalg
+
+    from metrics_tpu.image.generative import _trace_sqrtm_product
+
+    rng = np.random.RandomState(0)
+    for n, rank in ((16, 16), (32, 10), (8, 3)):
+        a = rng.randn(n, rank)
+        b = rng.randn(n, max(rank - 1, 1))
+        cov1 = (a @ a.T) / n
+        cov2 = (b @ b.T) / n
+        want = np.trace(scipy.linalg.sqrtm(cov1 @ cov2).real)
+        with jax.enable_x64(True):  # production FID compute runs under x64
+            got = float(_trace_sqrtm_product(jnp.asarray(cov1, jnp.float64), jnp.asarray(cov2, jnp.float64)))
+        assert got == pytest.approx(float(want), rel=1e-6, abs=1e-9)

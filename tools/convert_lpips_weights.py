@@ -1,0 +1,105 @@
+"""Convert ``lpips``-package checkpoints to the metrics_tpu flat-npz format.
+
+Usage:
+    python tools/convert_lpips_weights.py alex full_lpips_state.pth out.npz
+    # then: LearnedPerceptualImagePatchSimilarity(net_type="alex",
+    #           params=params_from_npz("out.npz"))
+
+The source is the state dict of ``lpips.LPIPS(net=...)`` (the exact network
+the reference wraps — `image/lpip.py:24-40`): backbone convs under
+``net.slice{k}.{idx}.*`` (torchvision ``features`` indices preserved inside
+each slice) and the learned 1x1 heads under ``lin{k}.model.1.weight``.
+Backbone-only torchvision dicts (``features.{idx}.*``) are accepted too,
+since the published ``alex.pth``/``vgg.pth`` artifacts carry only the heads
+and expect the torchvision backbone alongside.
+
+No egress here, so conversion runs wherever a checkpoint already exists; the
+mapping is validated numerically in `tests/models/test_lpips_parity.py` by
+round-tripping a torch mirror's state dict and matching scores.
+"""
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+# torchvision `features` index -> metrics_tpu module name, per backbone
+BACKBONE_INDEX_MAPS = {
+    "alex": {0: "conv1", 3: "conv2", 6: "conv3", 8: "conv4", 10: "conv5"},
+    "vgg": {
+        0: "conv1_1", 2: "conv1_2",
+        5: "conv2_1", 7: "conv2_2",
+        10: "conv3_1", 12: "conv3_2", 14: "conv3_3",
+        17: "conv4_1", 19: "conv4_2", 21: "conv4_3",
+        24: "conv5_1", 26: "conv5_2", 28: "conv5_3",
+    },
+    "squeeze": {0: "conv1", 3: "fire2", 4: "fire3", 6: "fire4", 7: "fire5",
+                9: "fire6", 10: "fire7", 11: "fire8", 12: "fire9"},
+}
+
+_BACKBONE_KEY = re.compile(r"^(?:net\.slice\d+|features)\.(\d+)\.(.+)$")
+_HEAD_KEY = re.compile(r"^lin(\d+)\.(?:model\.)?1?\.?weight$")
+
+
+def _conv_param(flax_prefix: str, rest: str, value: np.ndarray) -> Tuple[str, np.ndarray]:
+    """Map a conv-layer parameter ('weight'/'bias', possibly nested under a
+    Fire submodule like 'squeeze.weight') to its flax npz key + layout."""
+    *submods, param = rest.split(".")
+    path = "/".join([flax_prefix, *submods])
+    if param == "weight":
+        return f"{path}/kernel", value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+    if param == "bias":
+        return f"{path}/bias", value
+    raise ValueError(f"Unrecognized conv parameter: {rest}")
+
+
+def torch_key_to_npz(net_type: str, key: str, value: np.ndarray) -> Optional[Tuple[str, np.ndarray]]:
+    """Map one lpips/torchvision state-dict entry to (npz_key, array); None drops it."""
+    if key.startswith("scaling_layer."):
+        return None  # shift/scale are compile-time constants in LPIPSNet
+    if key.startswith("lins."):
+        # lpips.LPIPS registers the heads twice (attributes lin{k} AND the
+        # nn.ModuleList self.lins), so state_dict() duplicates every head
+        # under lins.{k}.*; keep only the lin{k}.* copies
+        return None
+    match = _HEAD_KEY.match(key)
+    if match:
+        # (1, C, 1, 1) OIHW -> (1, 1, C, 1) HWIO
+        return f"params/lin{match.group(1)}/kernel", value.transpose(2, 3, 1, 0)
+    match = _BACKBONE_KEY.match(key)
+    if match:
+        index_map = BACKBONE_INDEX_MAPS[net_type]
+        idx = int(match.group(1))
+        if idx not in index_map:
+            raise ValueError(f"features index {idx} is not a tapped conv for net_type={net_type!r}: {key}")
+        return _conv_param(f"params/net/{index_map[idx]}", match.group(2), value)
+    raise ValueError(f"Unrecognized lpips state-dict key: {key}")
+
+
+def convert_state_dict(net_type: str, state: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    if net_type not in BACKBONE_INDEX_MAPS:
+        raise ValueError(f"net_type must be one of {tuple(BACKBONE_INDEX_MAPS)}, got {net_type!r}")
+    out: Dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        mapped = torch_key_to_npz(net_type, key, np.asarray(value))
+        if mapped is not None:
+            out[mapped[0]] = mapped[1]
+    return out
+
+
+def main(argv: Iterable[str]) -> None:
+    net_type, src, dst = argv
+    import torch
+
+    state = torch.load(src, map_location="cpu")
+    if isinstance(state, dict) and "state_dict" in state:
+        state = state["state_dict"]
+    converted = convert_state_dict(net_type, {k: v.numpy() for k, v in state.items()})
+    np.savez(dst, **converted)
+    print(f"wrote {len(converted)} arrays to {dst}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
