@@ -2,11 +2,17 @@
 
 Parity: reference `functional/text/bert.py` (426 LoC) + `text/bert.py` +
 `helper_embedding_metric.py`: tokenize -> contextual embeddings -> greedy
-cosine matching with optional idf weighting and baseline rescaling.
+cosine matching with optional idf weighting and baseline rescaling. The
+matching follows the reference exactly: [CLS] and the final [SEP] token are
+zeroed out of the attention mask, embeddings are unit-normalized then masked,
+per-token weights (idf or uniform) are normalized per sentence, and
+``all_layers=True`` scores every hidden layer, returning ``(n_layers, N)``
+results like the original bert-score package.
 
 TPU-first: embeddings come from a **Flax** transformer (`FlaxAutoModel`) so the
 model forward is a jitted XLA program on TPU — same HuggingFace hub, native
-JAX, replacing the reference's torch/CUDA path (SURVEY §2.9). A
+JAX, replacing the reference's torch/CUDA path (SURVEY §2.9). The greedy
+matcher is one fused einsum/max program over the (B, L, S, D) stack. A
 ``user_forward_fn`` escape hatch accepts any `(list[str]) -> (embeddings
 (N, L, D), mask (N, L))` callable for offline/custom models.
 """
@@ -18,8 +24,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from metrics_tpu.utils.compute import high_precision
 from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def _load_flax_model(model_name_or_path: str):
@@ -34,63 +43,93 @@ def _load_flax_model(model_name_or_path: str):
     return tokenizer, model
 
 
+def _zero_special_tokens(mask: jax.Array) -> jax.Array:
+    """Zero the [CLS] column and the final real token ([SEP]) of each row
+    (reference `helper_embedding_metric.py:34-50`)."""
+    mask = mask.at[:, 0].set(0)
+    sep_pos = jnp.argmax(jnp.cumsum(mask - 0.1, axis=-1), axis=-1)
+    return mask.at[jnp.arange(mask.shape[0]), sep_pos].set(0)
+
+
 def _default_forward(
-    sentences: List[str], tokenizer, model, max_length: int, num_layers: Optional[int], batch_size: int = 64
-) -> Tuple[jax.Array, jax.Array, List[List[int]]]:
-    enc = tokenizer(
-        sentences,
-        padding="max_length",
-        max_length=max_length,
-        truncation=True,
-        return_tensors="np",
-    )
-    hiddens = []
-    for start in range(0, len(sentences), batch_size):
+    enc: Dict[str, np.ndarray],
+    model,
+    num_layers: Optional[int],
+    all_layers: bool,
+    batch_size: int = 64,
+) -> np.ndarray:
+    """Embed tokenized input, returning a (B, L, S, D) hidden-state stack
+    (L = 1 unless ``all_layers``).
+
+    Batches accumulate on HOST (the reference's `out.cpu()` move,
+    `functional/text/bert.py:109`): the all-layer stack of a large corpus can
+    dwarf HBM, and the matcher pushes it back to device once at the end.
+    """
+    n = enc["input_ids"].shape[0]
+    stacks = []
+    for start in range(0, n, batch_size):
         outputs = model(
             input_ids=jnp.asarray(enc["input_ids"][start : start + batch_size]),
             attention_mask=jnp.asarray(enc["attention_mask"][start : start + batch_size]),
             output_hidden_states=True,
         )
-        hiddens.append(outputs.hidden_states[num_layers if num_layers is not None else -1])
-    hidden = jnp.concatenate(hiddens, axis=0)
-    return hidden, jnp.asarray(enc["attention_mask"]), [list(ids) for ids in enc["input_ids"]]
+        if all_layers:
+            stacks.append(np.stack([np.asarray(h) for h in outputs.hidden_states], axis=1))
+        else:
+            stacks.append(np.asarray(outputs.hidden_states[num_layers if num_layers is not None else -1])[:, None])
+    return np.concatenate(stacks, axis=0)
 
 
-def _compute_idf(corpus_token_ids: List[List[int]], mask_rows: jax.Array) -> Dict[int, float]:
-    """Inverse document frequency over the target corpus (reference idf path)."""
+def _compute_idf(corpus_token_ids: np.ndarray) -> Dict[int, float]:
+    """Inverse document frequency over the (padded) target corpus rows —
+    same counting as reference `helper_embedding_metric.py:230-247`."""
     num_docs = len(corpus_token_ids)
     df: Counter = Counter()
-    for row_ids, row_mask in zip(corpus_token_ids, mask_rows):
-        seen = {tid for tid, m in zip(row_ids, row_mask) if m}
-        df.update(seen)
+    for row_ids in corpus_token_ids:
+        df.update(set(int(t) for t in row_ids))
     return {tid: math.log((num_docs + 1) / (cnt + 1)) for tid, cnt in df.items()}
 
 
-def _greedy_cos_sim(
+def _token_scale(
+    token_ids: Optional[np.ndarray],
+    processed_mask: jax.Array,
+    idf_map: Optional[Dict[int, float]],
+    idf_default: float,
+) -> jax.Array:
+    """Per-token weights: (idf or 1) × special-token-zeroed mask, normalized
+    per sentence (reference `functional/text/bert.py:107-117`)."""
+    if idf_map is not None:
+        idf_vals = jnp.asarray(
+            [[idf_map.get(int(tid), idf_default) for tid in row] for row in token_ids], dtype=jnp.float32
+        )
+        scale = idf_vals * processed_mask
+    else:
+        scale = processed_mask.astype(jnp.float32)
+    return scale / scale.sum(axis=-1, keepdims=True)
+
+
+def _prepare_embeddings(emb: jax.Array, processed_mask: jax.Array) -> jax.Array:
+    """Unit-normalize then zero masked/special positions — (B, L, S, D)."""
+    emb = jnp.asarray(emb)
+    emb = emb / jnp.clip(jnp.linalg.norm(emb, axis=-1, keepdims=True), min=1e-12)
+    return emb * processed_mask[:, None, :, None]
+
+
+@high_precision
+def _greedy_layerwise_scores(
     pred_emb: jax.Array,
-    pred_mask: jax.Array,
+    pred_scale: jax.Array,
     target_emb: jax.Array,
-    target_mask: jax.Array,
-    pred_weights: jax.Array,
-    target_weights: jax.Array,
+    target_scale: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Batched greedy matching: P = weighted mean over pred tokens of best match."""
-    pred_emb = pred_emb / jnp.clip(jnp.linalg.norm(pred_emb, axis=-1, keepdims=True), min=1e-12)
-    target_emb = target_emb / jnp.clip(jnp.linalg.norm(target_emb, axis=-1, keepdims=True), min=1e-12)
-
-    sim = jnp.einsum("bld,bmd->blm", pred_emb, target_emb)  # (B, Lp, Lt)
-    sim = jnp.where(pred_mask[:, :, None] > 0, sim, -jnp.inf)
-    sim = jnp.where(target_mask[:, None, :] > 0, sim, -jnp.inf)
-
-    best_for_pred = jnp.where(pred_mask > 0, sim.max(axis=2), 0.0)
-    best_for_target = jnp.where(target_mask > 0, sim.max(axis=1), 0.0)
-
-    pw = pred_weights * pred_mask
-    tw = target_weights * target_mask
-    precision = (best_for_pred * pw).sum(axis=1) / jnp.clip(pw.sum(axis=1), min=1e-12)
-    recall = (best_for_target * tw).sum(axis=1) / jnp.clip(tw.sum(axis=1), min=1e-12)
-    f1 = 2 * precision * recall / jnp.clip(precision + recall, min=1e-12)
-    return precision, recall, f1
+    """Greedy cosine matching per layer: (B, L, P, D) × (B, L, R, D) → (L, B)
+    precision/recall/f1 (reference `functional/text/bert.py:120-157`)."""
+    sim = jnp.einsum("blpd,blrd->blpr", pred_emb, target_emb)
+    precision = jnp.einsum("blp,bp->bl", sim.max(axis=3), pred_scale)
+    recall = jnp.einsum("blr,br->bl", sim.max(axis=2), target_scale)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.nan_to_num(f1, nan=0.0)
+    return precision.T, recall.T, f1.T
 
 
 def _read_baseline_csv(baseline_path: str) -> "jnp.ndarray":
@@ -108,9 +147,69 @@ def _read_baseline_csv(baseline_path: str) -> "jnp.ndarray":
     return jnp.asarray(rows)[:, 1:]
 
 
+def _rescale_with_baseline(
+    precision: jax.Array,
+    recall: jax.Array,
+    f1: jax.Array,
+    baseline: jax.Array,
+    num_layers: Optional[int],
+    all_layers: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(x - b) / (1 - b) per layer (reference `functional/text/bert.py:216-233`)."""
+    metrics = jnp.stack([precision, recall, f1], axis=-1)  # (L, B, 3)
+    if all_layers:
+        if baseline.shape[0] != metrics.shape[0]:
+            raise ValueError(
+                f"Baseline has {baseline.shape[0]} layer rows but the model produced"
+                f" {metrics.shape[0]} layers; `all_layers=True` rescaling needs one row per layer."
+            )
+        scale = baseline[:, None, :]
+    else:
+        layer_idx = -1 if num_layers is None else num_layers
+        if not -baseline.shape[0] <= layer_idx < baseline.shape[0]:
+            raise ValueError(
+                f"num_layers={layer_idx} is out of range for the baseline file with"
+                f" {baseline.shape[0]} layer rows."
+            )
+        scale = baseline[layer_idx]
+    metrics = (metrics - scale) / (1 - scale)
+    return metrics[..., 0], metrics[..., 1], metrics[..., 2]
+
+
+def _get_hash(model_name_or_path: Optional[str], num_layers: Optional[int], idf: bool) -> str:
+    """Same hash string as the original bert-score package (reference
+    `functional/text/bert.py:160-163`)."""
+    return f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+
+
+def _tokenize(sentences: Union[List[str], Dict[str, Any]], tokenizer, max_length: int) -> Dict[str, np.ndarray]:
+    if isinstance(sentences, dict):
+        return {
+            "input_ids": np.asarray(sentences["input_ids"]),
+            "attention_mask": np.asarray(sentences["attention_mask"]),
+        }
+    # pad to the corpus longest, not max_length: short-sentence corpora would
+    # otherwise attend over (and stack hidden states for) 512 mostly-pad
+    # positions — the reference trims per batch the same way (`_input_data_collator`)
+    enc = tokenizer(
+        sentences,
+        padding="longest",
+        max_length=max_length,
+        truncation=True,
+        return_tensors="np",
+    )
+    return {"input_ids": np.asarray(enc["input_ids"]), "attention_mask": np.asarray(enc["attention_mask"])}
+
+
+def _squeeze_to_output(arr: jax.Array) -> Union[float, List[float], List[List[float]]]:
+    """(L, B) → python lists, squeezing singleton dims like the reference's
+    ``.squeeze().tolist()`` (single layer → flat list; single pair → float)."""
+    return np.asarray(arr).squeeze().tolist()
+
+
 def bert_score(
-    preds: Union[str, List[str]],
-    target: Union[str, List[str]],
+    preds: Union[str, List[str], Dict[str, Any]],
+    target: Union[str, List[str], Dict[str, Any]],
     model_name_or_path: Optional[str] = None,
     num_layers: Optional[int] = None,
     all_layers: bool = False,
@@ -128,60 +227,82 @@ def bert_score(
     rescale_with_baseline: bool = False,
     baseline_path: Optional[str] = None,
     baseline_url: Optional[str] = None,
-) -> Dict[str, List[float]]:
+) -> Dict[str, Union[float, List[float], List[List[float]], str]]:
     """BERTScore precision/recall/f1 per sentence pair.
 
     Either pass ``model_name_or_path`` (uses ``FlaxAutoModel``) or a
     ``user_forward_fn(sentences) -> (embeddings, mask)`` for custom/offline
-    embedding models.
+    embedding models. ``preds``/``target`` may also be pre-tokenized dicts of
+    ``input_ids``/``attention_mask`` arrays (the reference's tensor-input path).
 
-    ``device``/``num_threads``/``baseline_url`` are accepted for drop-in
-    signature compatibility with the reference and are no-ops here: device
-    placement is JAX-managed and baselines load from ``baseline_path`` only.
+    With ``all_layers=True`` every hidden layer is scored and each result is a
+    ``(n_layers, n_pairs)`` nested list, matching the reference/bert-score
+    package layout. ``device``/``num_threads``/``baseline_url`` are accepted
+    for drop-in signature compatibility with the reference and are no-ops
+    here: device placement is JAX-managed and baselines load from
+    ``baseline_path`` only.
     """
     del device, num_threads, baseline_url  # torch runtime knobs; see docstring
-    preds = [preds] if isinstance(preds, str) else list(preds)
-    target = [target] if isinstance(target, str) else list(target)
-    if len(preds) != len(target):
+    preds = [preds] if isinstance(preds, str) else preds if isinstance(preds, dict) else list(preds)
+    target = [target] if isinstance(target, str) else target if isinstance(target, dict) else list(target)
+    if isinstance(preds, list) and isinstance(target, list) and len(preds) != len(target):
         raise ValueError("Number of predicted and reference sentences must be the same!")
-    if all_layers:
-        raise NotImplementedError(
-            "`all_layers=True` is not supported; pass `num_layers` to select a single layer."
-        )
     if (model is None) != (user_tokenizer is None):
         # reference `functional/text/bert.py` validates the pair together
         raise ValueError("Both `model` and `user_tokenizer` must be provided together (or neither).")
+    if all_layers and user_forward_fn is not None:
+        raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
+
+    if isinstance(preds, list) and len(preds) == 0 and isinstance(target, list) and len(target) == 0:
+        rank_zero_warn("Predictions and references are empty.")
+        output_dict: Dict[str, Union[List[float], str]] = {"precision": [0.0], "recall": [0.0], "f1": [0.0]}
+        if return_hash:
+            output_dict["hash"] = _get_hash(model_name_or_path, num_layers, idf)
+        return output_dict
 
     if user_forward_fn is not None:
         pred_emb, pred_mask = user_forward_fn(preds)
         target_emb, target_mask = user_forward_fn(target)
+        pred_emb = jnp.asarray(pred_emb)[:, None]  # (B, 1, S, D)
+        target_emb = jnp.asarray(target_emb)[:, None]
         pred_ids = target_ids = None
     else:
         name = model_name_or_path or "roberta-large"
         tokenizer, fx_model = (user_tokenizer, model) if model is not None else _load_flax_model(name)
-        pred_emb, pred_mask, pred_ids = _default_forward(preds, tokenizer, fx_model, max_length, num_layers, batch_size)
-        target_emb, target_mask, target_ids = _default_forward(
-            target, tokenizer, fx_model, max_length, num_layers, batch_size
-        )
+        try:
+            n_hidden = fx_model.config.num_hidden_layers
+            if num_layers and num_layers > n_hidden:
+                raise ValueError(
+                    f"num_layers={num_layers} is forbidden for {model_name_or_path}."
+                    f" Please use num_layers <= {n_hidden}"
+                )
+        except AttributeError:
+            rank_zero_warn("It was not possible to retrieve the parameter `num_layers` from the model specification.")
+        pred_enc = _tokenize(preds, tokenizer, max_length)
+        target_enc = _tokenize(target, tokenizer, max_length)
+        if pred_enc["input_ids"].shape[0] != target_enc["input_ids"].shape[0]:
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        pred_emb = _default_forward(pred_enc, fx_model, num_layers, all_layers, batch_size)
+        target_emb = _default_forward(target_enc, fx_model, num_layers, all_layers, batch_size)
+        pred_mask, target_mask = pred_enc["attention_mask"], target_enc["attention_mask"]
+        pred_ids, target_ids = pred_enc["input_ids"], target_enc["input_ids"]
 
+    idf_map = None
+    idf_default = 0.0
     if idf:
         if pred_ids is None or target_ids is None:
             raise ValueError("`idf=True` requires tokenized ids; not available with `user_forward_fn`.")
-        import numpy as np
+        # idf is computed on the reference corpus and shared with predictions
+        idf_map = _compute_idf(target_ids)
+        idf_default = math.log(len(target_ids) + 1)
 
-        idf_map = _compute_idf(target_ids, np.asarray(target_mask))
-        pred_weights = jnp.asarray(
-            [[idf_map.get(tid, math.log(len(target_ids) + 1)) for tid in row] for row in pred_ids]
-        )
-        target_weights = jnp.asarray(
-            [[idf_map.get(tid, math.log(len(target_ids) + 1)) for tid in row] for row in target_ids]
-        )
-    else:
-        pred_weights = jnp.ones(pred_mask.shape)
-        target_weights = jnp.ones(target_mask.shape)
-
-    precision, recall, f1 = _greedy_cos_sim(
-        pred_emb, pred_mask.astype(jnp.float32), target_emb, target_mask.astype(jnp.float32), pred_weights, target_weights
+    pred_processed = _zero_special_tokens(jnp.asarray(pred_mask))
+    target_processed = _zero_special_tokens(jnp.asarray(target_mask))
+    precision, recall, f1 = _greedy_layerwise_scores(
+        _prepare_embeddings(pred_emb, pred_processed),
+        _token_scale(pred_ids, pred_processed, idf_map, idf_default),
+        _prepare_embeddings(target_emb, target_processed),
+        _token_scale(target_ids, target_processed, idf_map, idf_default),
     )
 
     if rescale_with_baseline:
@@ -191,18 +312,16 @@ def bert_score(
                 " csv (the bert_score format: header row, then `layer,P,R,F` rows — no downloads here)."
             )
         baseline = _read_baseline_csv(baseline_path)
-        layer_idx = -1 if num_layers is None else num_layers
-        scale = baseline[layer_idx]  # (3,) = P, R, F baselines for the layer
-        # reference `functional/text/bert.py:216-229`: (x - b) / (1 - b)
-        precision = (precision - scale[0]) / (1 - scale[0])
-        recall = (recall - scale[1]) / (1 - scale[1])
-        f1 = (f1 - scale[2]) / (1 - scale[2])
+        precision, recall, f1 = _rescale_with_baseline(precision, recall, f1, baseline, num_layers, all_layers)
 
-    return {
-        "precision": [float(p) for p in precision],
-        "recall": [float(r) for r in recall],
-        "f1": [float(f) for f in f1],
+    output_dict = {
+        "precision": _squeeze_to_output(precision),
+        "recall": _squeeze_to_output(recall),
+        "f1": _squeeze_to_output(f1),
     }
+    if return_hash:
+        output_dict["hash"] = _get_hash(model_name_or_path, num_layers, idf)
+    return output_dict
 
 
 __all__ = ["bert_score"]

@@ -263,15 +263,23 @@ class TestBertInfoLM:
         """BERTScore pipeline with a toy hash-embedding forward (offline path)."""
 
         def toy_forward(sentences):
+            # like a transformers tokenizer, emit [CLS] tokens [SEP]: the
+            # matcher zeroes the first and last real position (reference
+            # user-path contract, `functional/text/bert.py` user_tokenizer doc)
             max_len = 12
             dim = 16
             emb = np.zeros((len(sentences), max_len, dim), dtype=np.float32)
             mask = np.zeros((len(sentences), max_len), dtype=np.float32)
             for i, s in enumerate(sentences):
-                for j, tok in enumerate(s.split()[:max_len]):
+                emb[i, 0] = np.random.RandomState(0).randn(dim)  # [CLS]
+                mask[i, 0] = 1.0
+                words = s.split()[: max_len - 2]
+                for j, tok in enumerate(words, start=1):
                     rng = np.random.RandomState(abs(hash(tok)) % (2**31))
                     emb[i, j] = rng.randn(dim)
                     mask[i, j] = 1.0
+                emb[i, len(words) + 1] = np.random.RandomState(1).randn(dim)  # [SEP]
+                mask[i, len(words) + 1] = 1.0
             return jnp.asarray(emb), jnp.asarray(mask)
 
         from metrics_tpu.functional import bert_score
