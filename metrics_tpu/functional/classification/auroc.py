@@ -57,11 +57,14 @@ def _auroc_compute(
             raise ValueError("`max_fpr` (partial AUC) is not supported for AUROC under jit; compute eagerly")
         if mode == DataType.BINARY:
             pl = 1 if pos_label is None else pos_label
-            return binary_auroc_sorted(preds, target == pl)
+            # single-class targets: the eager path warns and returns 0.0 (a
+            # flat ROC integrates to 0); a traced program can't warn, but it
+            # must agree on the value, so map the kernel's NaN to 0.0 here
+            return jnp.nan_to_num(binary_auroc_sorted(preds, target == pl), nan=0.0)
         if num_classes is None:
             raise ValueError("Detected multiclass/multilabel input but `num_classes` was not provided")
         if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
-            return binary_auroc_sorted(preds.reshape(-1), target.reshape(-1))
+            return jnp.nan_to_num(binary_auroc_sorted(preds.reshape(-1), target.reshape(-1)), nan=0.0)
         avg = "none" if average is None else getattr(average, "value", average)
         return multiclass_auroc_sorted(preds, target, num_classes, avg)
 

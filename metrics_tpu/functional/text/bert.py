@@ -12,9 +12,12 @@ results like the original bert-score package.
 TPU-first: embeddings come from a **Flax** transformer (`FlaxAutoModel`) so the
 model forward is a jitted XLA program on TPU — same HuggingFace hub, native
 JAX, replacing the reference's torch/CUDA path (SURVEY §2.9). The greedy
-matcher is one fused einsum/max program over the (B, L, S, D) stack. A
-``user_forward_fn`` escape hatch accepts any `(list[str]) -> (embeddings
-(N, L, D), mask (N, L))` callable for offline/custom models.
+matcher is a fused einsum/max program, batched over pairs so the similarity
+tensor never exceeds one (batch, L, S, S) block of HBM. A ``user_forward_fn``
+escape hatch accepts any `(list[str]) -> (embeddings (N, L, D), mask (N, L))`
+callable for offline/custom models; like the reference's user-tokenizer
+contract, the mask MUST cover a [CLS]-equivalent first position and a
+[SEP]-equivalent final real position — the matcher excludes both.
 """
 from __future__ import annotations
 
@@ -232,7 +235,11 @@ def bert_score(
 
     Either pass ``model_name_or_path`` (uses ``FlaxAutoModel``) or a
     ``user_forward_fn(sentences) -> (embeddings, mask)`` for custom/offline
-    embedding models. ``preds``/``target`` may also be pre-tokenized dicts of
+    embedding models. Like the reference's user-tokenizer contract, the
+    returned mask must include a [CLS]-equivalent first position and a
+    [SEP]-equivalent final real position: the matcher zeroes both before
+    scoring, so a forward that emits only real words loses its first and last
+    token. ``preds``/``target`` may also be pre-tokenized dicts of
     ``input_ids``/``attention_mask`` arrays (the reference's tensor-input path).
 
     With ``all_layers=True`` every hidden layer is scored and each result is a
@@ -298,12 +305,27 @@ def bert_score(
 
     pred_processed = _zero_special_tokens(jnp.asarray(pred_mask))
     target_processed = _zero_special_tokens(jnp.asarray(target_mask))
-    precision, recall, f1 = _greedy_layerwise_scores(
-        _prepare_embeddings(pred_emb, pred_processed),
-        _token_scale(pred_ids, pred_processed, idf_map, idf_default),
-        _prepare_embeddings(target_emb, target_processed),
-        _token_scale(target_ids, target_processed, idf_map, idf_default),
-    )
+    pred_scale = _token_scale(pred_ids, pred_processed, idf_map, idf_default)
+    target_scale = _token_scale(target_ids, target_processed, idf_map, idf_default)
+
+    # match in pair batches: embeddings accumulate on host, and one (B,L,P,R)
+    # similarity tensor for the whole corpus would dwarf HBM — only one
+    # batch-size block is device-resident at a time
+    n_pairs = pred_processed.shape[0]
+    chunks = []
+    for start in range(0, n_pairs, batch_size):
+        sl = slice(start, start + batch_size)
+        chunks.append(
+            _greedy_layerwise_scores(
+                _prepare_embeddings(pred_emb[sl], pred_processed[sl]),
+                pred_scale[sl],
+                _prepare_embeddings(target_emb[sl], target_processed[sl]),
+                target_scale[sl],
+            )
+        )
+    precision = jnp.concatenate([c[0] for c in chunks], axis=1)
+    recall = jnp.concatenate([c[1] for c in chunks], axis=1)
+    f1 = jnp.concatenate([c[2] for c in chunks], axis=1)
 
     if rescale_with_baseline:
         if baseline_path is None:

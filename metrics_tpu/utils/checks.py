@@ -50,7 +50,11 @@ def _minmax_pair(preds, target):
 
 _BENIGN_STATS = np.array([0.0, 0.0, 0.0, 1.0], dtype=np.float32)  # t_min, t_max, p_min, p_max
 _validation_mode: Optional[str] = None  # resolved lazily from env
-_seen_check_keys: set = set()
+# insertion-ordered signature memory for "first" mode; bounded FIFO so shape
+# churn (e.g. ragged final batches every epoch) can't grow it without limit —
+# an evicted signature simply gets value-checked again, the safe direction
+_seen_check_keys: dict = {}
+_SEEN_KEYS_CAP = 4096
 
 
 def set_validation_mode(mode: str) -> None:
@@ -96,7 +100,9 @@ def _should_value_check(preds, target, key_extra=()) -> bool:
     key = (preds.shape, str(preds.dtype), target.shape, str(target.dtype), key_extra)
     if key in _seen_check_keys:
         return False
-    _seen_check_keys.add(key)
+    _seen_check_keys[key] = None
+    while len(_seen_check_keys) > _SEEN_KEYS_CAP:
+        _seen_check_keys.pop(next(iter(_seen_check_keys)))
     return True
 
 
@@ -123,7 +129,22 @@ class _ValueStats:
 
     def _fetch(self) -> np.ndarray:
         if self._vals is None:
-            self._vals = np.asarray(_minmax_pair(self._preds, self._target))
+            if _is_concrete(self._preds, self._target):
+                self._vals = np.asarray(_minmax_pair(self._preds, self._target))
+            else:
+                # mixed concrete/traced pair: the fused kernel would hand back
+                # a tracer (np.asarray would raise). Read each concrete side
+                # on the host (jnp reductions would be staged by the ambient
+                # trace even on concrete data); the traced side reports benign
+                # values, matching the per-side concreteness guards upstream.
+                vals = _BENIGN_STATS.copy()
+                if _is_concrete(self._target) and self._target.size > 0:
+                    host = np.asarray(self._target)
+                    vals[0], vals[1] = float(host.min()), float(host.max())
+                if _is_concrete(self._preds) and self._preds.size > 0:
+                    host = np.asarray(self._preds)
+                    vals[2], vals[3] = float(host.min()), float(host.max())
+                self._vals = vals
         return self._vals
 
     @property

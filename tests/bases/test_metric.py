@@ -378,3 +378,52 @@ def test_compute_on_cpu_offloads_list_states():
 
     offloaded.reset()
     assert offloaded.preds == []
+
+
+def test_validation_first_mode_signature_memory_is_bounded():
+    """'first' mode under perpetual shape churn must not grow its signature
+    memory without bound (advisor regression): the FIFO cap evicts old
+    signatures, which then simply get value-checked again."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.utils import checks
+    from metrics_tpu.utils.checks import set_validation_mode
+
+    try:
+        set_validation_mode("first")
+        for n in range(1, 40):
+            checks._should_value_check(jnp.zeros((n,)), jnp.zeros((n,), jnp.int32))
+        assert len(checks._seen_check_keys) <= checks._SEEN_KEYS_CAP
+        cap, checks._SEEN_KEYS_CAP = checks._SEEN_KEYS_CAP, 16
+        try:
+            for n in range(40, 80):
+                checks._should_value_check(jnp.zeros((n,)), jnp.zeros((n,), jnp.int32))
+            assert len(checks._seen_check_keys) <= 16
+            # evicted signature checks again instead of being silently skipped
+            assert checks._should_value_check(jnp.zeros((1,)), jnp.zeros((1,), jnp.int32))
+        finally:
+            checks._SEEN_KEYS_CAP = cap
+    finally:
+        set_validation_mode("full")
+
+
+def test_value_stats_mixed_traced_concrete():
+    """Concrete target + traced preds must not crash the fused stats fetch
+    (advisor regression): each concrete side is read on its own."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.utils.checks import _ValueStats
+
+    target = jnp.asarray([0, 1, 2], jnp.int32)
+
+    seen = {}
+
+    def traced_preds_fn(preds):
+        stats = _ValueStats(preds, target, force=True)
+        seen["tmin"] = stats.target_min
+        seen["tmax"] = stats.target_max
+        return preds.sum()
+
+    jax.jit(traced_preds_fn)(jnp.asarray([0.1, 0.5, 0.9]))
+    assert seen["tmin"] == 0.0 and seen["tmax"] == 2.0

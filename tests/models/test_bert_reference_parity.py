@@ -143,3 +143,11 @@ def test_baseline_layer_out_of_range_raises(stacks, tmp_path):
     baseline.write_text("layer,P,R,F\n0,0.1,0.1,0.1\n1,0.1,0.1,0.1")
     with pytest.raises(ValueError, match="out of range for the baseline"):
         _ours(stacks, rescale_with_baseline=True, baseline_path=str(baseline), num_layers=2)
+
+
+def test_matcher_batching_is_invariant(stacks):
+    """Pair-batched matching (HBM guard) must not change any score."""
+    small = _ours(stacks, batch_size=1)
+    big = _ours(stacks, batch_size=64)
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(np.asarray(small[key]), np.asarray(big[key]), atol=1e-6)

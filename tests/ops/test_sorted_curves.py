@@ -169,3 +169,15 @@ class TestSPMD:
             else average_precision_score(target, preds)
         )
         assert float(out) == pytest.approx(oracle, abs=1e-5)
+
+
+def test_single_class_binary_traced_eq_eager():
+    """Single-class binary targets: eager warns and returns 0.0; the traced
+    path can't warn but must agree on the value (advisor regression)."""
+    preds = jnp.asarray(np.random.RandomState(0).rand(20).astype(np.float32))
+    for fill in (0, 1):
+        target = jnp.full((20,), fill, jnp.int32)
+        with pytest.warns(UserWarning):
+            eager = float(auroc(preds, target))
+        traced = float(jax.jit(auroc)(preds, target))
+        assert eager == traced == 0.0
