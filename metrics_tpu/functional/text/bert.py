@@ -312,6 +312,15 @@ def bert_score(
     # similarity tensor for the whole corpus would dwarf HBM — only one
     # batch-size block is device-resident at a time
     n_pairs = pred_processed.shape[0]
+    if n_pairs == 0:
+        # zero-row tensor/dict inputs (the list early-out above covers lists)
+        empty = jnp.zeros((jnp.asarray(pred_emb).shape[1], 0), jnp.float32)
+        return {
+            "precision": _squeeze_to_output(empty),
+            "recall": _squeeze_to_output(empty),
+            "f1": _squeeze_to_output(empty),
+            **({"hash": _get_hash(model_name_or_path, num_layers, idf)} if return_hash else {}),
+        }
     chunks = []
     for start in range(0, n_pairs, batch_size):
         sl = slice(start, start + batch_size)

@@ -67,7 +67,9 @@ class Metric(ABC):
             free HBM (reference ``compute_on_cpu``, `metric.py:404-414`).
         dist_sync_on_step: sync state when computing the batch value in
             ``forward`` (expensive; reference `metric.py:96-99`).
-        process_group: reserved for host-path process subsets; the SPMD path
+        process_group: host-path process subset — an iterable of process
+            indices whose states merge at sync (all processes still call
+            sync; see ``parallel.sync.gather_all_tensors``). The SPMD path
             expresses scope as a mesh axis instead (SURVEY §2.10).
         dist_sync_fn: custom gather callable (host path injection point).
         sync_on_compute: whether ``compute()`` syncs automatically.
@@ -99,6 +101,24 @@ class Metric(ABC):
             raise ValueError(f"Expected `dist_sync_fn` to be callable or None, got {dist_sync_fn}")
         if not isinstance(sync_on_compute, bool):
             raise ValueError(f"Expected `sync_on_compute` to be a bool, got {sync_on_compute}")
+        if process_group is not None and not isinstance(process_group, str):
+            # host-path groups (iterables of process indices) are materialized
+            # and structure-checked at construction — one-shot iterables would
+            # otherwise be consumed here and arrive exhausted at sync. Strings
+            # (or tuples of strings) name SPMD mesh axes and pass through; the
+            # range check against the process count runs at sync time, since
+            # metrics may be constructed before jax.distributed initializes.
+            from metrics_tpu.parallel.sync import _resolve_group, distributed_available, world_size
+
+            is_axis_names = (
+                isinstance(process_group, (tuple, list))
+                and len(process_group) > 0
+                and all(isinstance(g, str) for g in process_group)
+            )
+            if not is_axis_names:
+                process_group = _resolve_group(
+                    process_group, world_size() if distributed_available() else None
+                )
 
         self.compute_on_cpu = compute_on_cpu
         self.dist_sync_on_step = dist_sync_on_step

@@ -32,6 +32,41 @@ def world_size() -> int:
     return jax.process_count() if distributed_available() else 1
 
 
+def _resolve_group(group: Optional[Any], n_processes: Optional[int]) -> Optional[List[int]]:
+    """Validate a host-path process group: an iterable of distinct process
+    indices within ``[0, n_processes)``. ``group=None`` means "all processes";
+    ``n_processes=None`` skips the range check (construction may precede
+    ``jax.distributed`` initialization — sync re-validates against the real
+    world size)."""
+    if group is None:
+        return None
+    if isinstance(group, str):
+        raise ValueError(
+            f"Host-path `process_group` got the mesh-axis name {group!r}; axis names scope the"
+            " SPMD path (metrics_tpu.parallel.collectives). The host path takes an iterable of"
+            " process indices."
+        )
+    try:
+        members = sorted(int(idx) for idx in group)
+    except (TypeError, ValueError) as err:
+        raise ValueError(
+            "Host-path `process_group` must be an iterable of process indices"
+            f" (got {group!r}). The SPMD path scopes via mesh-axis names instead"
+            " (metrics_tpu.parallel.collectives)."
+        ) from err
+    if not members:
+        raise ValueError("Host-path `process_group` must contain at least one process index.")
+    if len(set(members)) != len(members):
+        raise ValueError(f"Host-path `process_group` contains duplicate indices: {group!r}")
+    if members[0] < 0:
+        raise ValueError(f"Host-path `process_group` indices must be non-negative, got {members}.")
+    if n_processes is not None and members[-1] >= n_processes:
+        raise ValueError(
+            f"Host-path `process_group` indices {members} out of range for {n_processes} process(es)."
+        )
+    return members
+
+
 def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
     """All-gather an array from every process; handles uneven dim sizes.
 
@@ -39,15 +74,16 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
     entries — all-gather, not gather-to-root), like the reference
     `utilities/distributed.py:102-151`.
 
-    ``group`` (process subsets) is not supported on the host path — scope
-    restriction is expressed as a mesh-axis subset in the SPMD path instead
-    (SURVEY §2.10). Passing a non-None group raises.
+    ``group`` scopes the gather to a subset of process indices (the host-path
+    analogue of the reference's ``torch.distributed`` group objects). One
+    deliberate divergence, forced by JAX's host collectives being global:
+    EVERY process participates in the exchange (all processes must call
+    ``sync``/``compute`` — there is no members-only collective), and every
+    caller receives the group members' entries in ascending process order.
+    The reference instead lets only members call and errors on outsiders.
     """
-    if group is not None:
-        raise ValueError(
-            "Process sub-groups are not supported by the host sync backend; "
-            "restrict scope via a mesh axis in the SPMD path (metrics_tpu.parallel.collectives)."
-        )
+    n_processes = world_size()
+    members = _resolve_group(group, n_processes)
     if not distributed_available():
         return [jnp.asarray(result)]
 
@@ -63,7 +99,7 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
     padded = jnp.pad(result, pad_width) if any(p[1] for p in pad_width) else result
     gathered = multihost_utils.process_allgather(padded)
     out = []
-    for idx in range(all_shapes.shape[0]):
+    for idx in range(all_shapes.shape[0]) if members is None else members:
         slices = tuple(slice(0, int(d)) for d in all_shapes[idx])
         out.append(jnp.asarray(gathered[idx])[slices])
     return out

@@ -26,9 +26,58 @@ def test_gather_single_process_identity():
     np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x))
 
 
-def test_gather_rejects_group():
-    with pytest.raises(ValueError, match="sub-groups"):
-        gather_all_tensors(jnp.zeros(2), group="not-none")
+def test_gather_group_contract():
+    """Host-path process groups: iterable of valid process indices; every
+    process participates, members' entries are returned (VERDICT #8 pin)."""
+    from metrics_tpu.parallel.sync import _resolve_group
+
+    # single-process: the only valid subset is [0], behaving like None
+    out = gather_all_tensors(jnp.arange(3.0), group=[0])
+    assert len(out) == 1
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(3.0))
+
+    with pytest.raises(ValueError, match="out of range"):
+        gather_all_tensors(jnp.zeros(2), group=[1])
+    with pytest.raises(ValueError, match="iterable of process indices"):
+        gather_all_tensors(jnp.zeros(2), group=123)
+    with pytest.raises(ValueError, match="at least one"):
+        gather_all_tensors(jnp.zeros(2), group=[])
+    with pytest.raises(ValueError, match="duplicate"):
+        _resolve_group([0, 0], 2)
+    # members come back sorted ascending regardless of input order
+    assert _resolve_group([2, 0], 4) == [0, 2]
+    assert _resolve_group(None, 4) is None
+
+
+def test_metric_accepts_process_group_single_process():
+    """A Metric constructed with a host-path process_group syncs fine in
+    single-process mode (the kwarg no longer errors at sync time)."""
+    import metrics_tpu as mt
+
+    m = mt.SumMetric(process_group=[0])
+    m.update(jnp.asarray([1.0, 2.0]))
+    assert float(m.compute()) == 3.0
+    # one-shot iterables are materialized at construction, not consumed
+    gen = mt.SumMetric(process_group=iter([0]))
+    assert gen.process_group == [0]
+    gen.update(jnp.asarray([1.0]))
+    assert float(gen.compute()) == 1.0
+    # structural misuse fails fast at construction...
+    with pytest.raises(ValueError, match="duplicate"):
+        mt.SumMetric(process_group=[0, 0])
+    with pytest.raises(ValueError, match="at least one"):
+        mt.SumMetric(process_group=[])
+    with pytest.raises(ValueError, match="non-negative"):
+        mt.SumMetric(process_group=[-1])
+    # ...but the range check defers to sync: metrics may be constructed
+    # before jax.distributed initializes (reference permits the same)
+    mt.SumMetric(process_group=[3])
+    # SPMD mesh-axis names pass through untouched
+    assert mt.SumMetric(process_group="dp").process_group == "dp"
+    mt.SumMetric(process_group=("dp", "tp"))
+    # a mesh-axis name reaching the host gather gets the routing error
+    with pytest.raises(ValueError, match="mesh-axis name"):
+        gather_all_tensors(jnp.zeros(2), group="dp")
 
 
 def test_injected_sync_sum():
