@@ -1,0 +1,156 @@
+"""The dynamic-shape seam, pinned per curve metric (VERDICT #10).
+
+The framework's key curve-family design decision: exact curves have
+data-dependent output shapes (one point per distinct score — reference
+`functional/classification/precision_recall_curve.py:49-51`), so under jit
+tracing they REFUSE with a pointer to the fixed-shape alternative; the
+scalar areas (AUROC / AveragePrecision) instead dispatch to static-shape
+sorted kernels (`ops/sorted_curves.py`) and must agree with their own eager
+path; the binned family is the blessed jit path and must trace end to end.
+Every curve metric's contract is asserted here explicitly (functional AND
+module), so a regression in any one dispatch seam fails by name.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.functional import auroc, average_precision, precision_recall_curve, roc
+
+_REFUSE = "cannot run under jit tracing"
+
+rng = np.random.RandomState(7)
+BIN_PREDS = jnp.asarray(rng.rand(32).astype(np.float32))
+BIN_TARGET = jnp.asarray(rng.randint(0, 2, 32).astype(np.int32))
+MC_PREDS_RAW = rng.rand(32, 4).astype(np.float32)
+MC_PREDS = jnp.asarray(MC_PREDS_RAW / MC_PREDS_RAW.sum(1, keepdims=True))
+MC_TARGET = jnp.asarray(rng.randint(0, 4, 32).astype(np.int32))
+
+
+class TestExactCurvesRefuseTrace:
+    """Exact curves: eager-only, with the documented refusal under jit."""
+
+    def test_precision_recall_curve_binary(self):
+        precision_recall_curve(BIN_PREDS, BIN_TARGET)  # eager path fine
+        with pytest.raises(ValueError, match=_REFUSE):
+            jax.jit(precision_recall_curve)(BIN_PREDS, BIN_TARGET)
+
+    def test_precision_recall_curve_multiclass(self):
+        fn = lambda p, t: precision_recall_curve(p, t, num_classes=4)
+        fn(MC_PREDS, MC_TARGET)
+        with pytest.raises(ValueError, match=_REFUSE):
+            jax.jit(fn)(MC_PREDS, MC_TARGET)
+
+    def test_roc_binary(self):
+        roc(BIN_PREDS, BIN_TARGET)
+        with pytest.raises(ValueError, match=_REFUSE):
+            jax.jit(roc)(BIN_PREDS, BIN_TARGET)
+
+    def test_roc_multiclass(self):
+        fn = lambda p, t: roc(p, t, num_classes=4)
+        fn(MC_PREDS, MC_TARGET)
+        with pytest.raises(ValueError, match=_REFUSE):
+            jax.jit(fn)(MC_PREDS, MC_TARGET)
+
+    @pytest.mark.parametrize(
+        "metric_cls, kwargs",
+        [(mt.PrecisionRecallCurve, {}), (mt.ROC, {})],
+        ids=["PrecisionRecallCurve", "ROC"],
+    )
+    def test_module_compute_is_host_only(self, metric_cls, kwargs):
+        """Module form: eager update+compute works; the functional seam it
+        rides refuses a traced compute."""
+        metric = metric_cls(**kwargs)
+        metric.update(BIN_PREDS, BIN_TARGET)
+        out = metric.compute()
+        assert len(out) == 3
+        init, upd, cmp = metric_cls(**kwargs).as_functions()
+        state = upd(init(), BIN_PREDS, BIN_TARGET)
+        with pytest.raises(ValueError, match=_REFUSE):
+            jax.jit(cmp)(state)
+
+
+class TestScalarAreasTraceExactly:
+    """AUROC / AveragePrecision: jit dispatches to the sorted static-shape
+    kernels and must equal the eager (host curve) value."""
+
+    def test_auroc_binary(self):
+        got = float(jax.jit(auroc)(BIN_PREDS, BIN_TARGET))
+        assert got == pytest.approx(float(auroc(BIN_PREDS, BIN_TARGET)), abs=1e-5)
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_auroc_multiclass(self, average):
+        fn = lambda p, t: auroc(p, t, num_classes=4, average=average)
+        assert float(jax.jit(fn)(MC_PREDS, MC_TARGET)) == pytest.approx(float(fn(MC_PREDS, MC_TARGET)), abs=1e-5)
+
+    def test_average_precision_binary(self):
+        got = float(jax.jit(average_precision)(BIN_PREDS, BIN_TARGET))
+        assert got == pytest.approx(float(average_precision(BIN_PREDS, BIN_TARGET)), abs=1e-5)
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_average_precision_multiclass(self, average):
+        fn = lambda p, t: average_precision(p, t, num_classes=4, average=average)
+        assert float(jax.jit(fn)(MC_PREDS, MC_TARGET)) == pytest.approx(float(fn(MC_PREDS, MC_TARGET)), abs=1e-5)
+
+    @pytest.mark.parametrize("metric_cls", [mt.AUROC, mt.AveragePrecision], ids=["AUROC", "AveragePrecision"])
+    def test_module_compute_traces(self, metric_cls):
+        """The module export's compute can run under jit (the sorted-kernel
+        dispatch), and matches the eager module value."""
+        metric = metric_cls()
+        metric.update(BIN_PREDS, BIN_TARGET)
+        want = float(metric.compute())
+        init, upd, cmp = metric_cls().as_functions()
+        state = upd(init(), BIN_PREDS, BIN_TARGET)
+        assert float(jax.jit(cmp)(state)) == pytest.approx(want, abs=1e-5)
+
+
+class TestBinnedFamilyIsTheJitPath:
+    """Binned curves: fixed thresholds grid — update AND compute jit end to end."""
+
+    @pytest.mark.parametrize(
+        "metric_cls, kwargs, n_outputs",
+        [
+            (mt.BinnedPrecisionRecallCurve, dict(num_classes=1, thresholds=11), 3),
+            (mt.BinnedAveragePrecision, dict(num_classes=1, thresholds=11), 1),
+            (mt.BinnedRecallAtFixedPrecision, dict(num_classes=1, min_precision=0.5, thresholds=11), 2),
+        ],
+        ids=["BinnedPrecisionRecallCurve", "BinnedAveragePrecision", "BinnedRecallAtFixedPrecision"],
+    )
+    def test_full_lifecycle_under_jit(self, metric_cls, kwargs, n_outputs):
+        eager = metric_cls(**kwargs)
+        eager.update(BIN_PREDS, BIN_TARGET)
+        want = eager.compute()
+        want = want if isinstance(want, (tuple, list)) else (want,)
+
+        init, upd, cmp = metric_cls(**kwargs).as_functions()
+        state = jax.jit(upd)(init(), BIN_PREDS, BIN_TARGET)
+        got = jax.jit(cmp)(state)
+        got = got if isinstance(got, (tuple, list)) else (got,)
+        assert len(got) == n_outputs == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-6)
+
+
+class TestRetrievalCurvesAreHostSide:
+    """Retrieval curve metrics group by query id on the host; eager lifecycle
+    works and their compute documents host-only execution."""
+
+    @pytest.mark.parametrize(
+        "metric_cls, kwargs",
+        [
+            (mt.RetrievalPrecisionRecallCurve, dict(max_k=4)),
+            (mt.RetrievalRecallAtFixedPrecision, dict(min_precision=0.3, max_k=4)),
+        ],
+        ids=["RetrievalPrecisionRecallCurve", "RetrievalRecallAtFixedPrecision"],
+    )
+    def test_eager_lifecycle(self, metric_cls, kwargs):
+        metric = metric_cls(**kwargs)
+        indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1], jnp.int64)
+        preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2, 0.7, 0.4], jnp.float32)
+        target = jnp.asarray([1, 0, 1, 0, 1, 1, 0], jnp.int32)
+        metric.update(preds, target, indexes=indexes)
+        out = metric.compute()
+        assert all(np.asarray(o).size > 0 for o in (out if isinstance(out, (tuple, list)) else (out,)))
