@@ -155,11 +155,13 @@ class MeanAveragePrecision(Metric):
         if iou_type not in allowed_iou_types:
             raise ValueError(f"Expected argument `iou_type` to be one of {allowed_iou_types} but got {iou_type}")
         self.iou_type = iou_type
+        # float bounds: the 1e10 "unbounded" sentinel overflows int32 when a
+        # comparison against a jax array coerces it to the array's weak type
         self.bbox_area_ranges = {
-            "all": (0**2, int(1e5**2)),
-            "small": (0**2, 32**2),
-            "medium": (32**2, 96**2),
-            "large": (96**2, int(1e5**2)),
+            "all": (0.0, float(1e5**2)),
+            "small": (0.0, float(32**2)),
+            "medium": (float(32**2), float(96**2)),
+            "large": (float(96**2), float(1e5**2)),
         }
         if not isinstance(class_metrics, bool):
             raise ValueError("Expected argument `class_metrics` to be a boolean")

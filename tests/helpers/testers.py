@@ -55,40 +55,59 @@ def _select_rank_batches(n_batches: int, rank: int, world: int) -> range:
 class _FakeGather:
     """Injectable ``dist_sync_fn`` emulating an N-rank all-gather on one host.
 
-    ``Metric._sync_dist`` walks the state dict in insertion order and calls the
-    gather once per array leaf; this object replays the same walk over every
-    rank's metric instance and hands back the matching leaves.
+    ``Metric._sync_dist`` builds an input dict (cat-lists pre-concatenated to
+    one array) and ``apply_to_collection`` calls the gather once per array
+    leaf, walking states in insertion order and list states element by
+    element. This object replays exactly that walk over every rank's metric
+    instance and hands back the matching leaves — which is also why, like the
+    real collective, it requires ``None``-spec list states to hold the same
+    number of elements on every rank (same number of gather calls).
     """
 
     def __init__(self, rank_metrics: Sequence[Metric]) -> None:
         self.rank_metrics = rank_metrics
-        self._leaf_names = None
+        self._schedule = None  # [(state name, element index | None), ...]
         self._call_idx = 0
 
-    def _leaves_of(self, m: Metric):
-        from metrics_tpu.utils.data import dim_zero_cat
-
-        names = []
+    def _build_schedule(self, m: Metric):
+        schedule = []
         for name, spec in m._reduction_specs.items():
             value = getattr(m, name)
             if isinstance(value, list):
-                if len(value) > 0:
-                    names.append(name)
+                if spec == "cat":
+                    empties = {len(getattr(rm, name)) == 0 for rm in self.rank_metrics}
+                    assert len(empties) == 1, (
+                        f"cat state {name!r} is empty on some ranks but not others; the"
+                        " schedule is built once from rank 0, so emptiness must agree"
+                        " across ranks for the replayed walk to line up"
+                    )
+                    if len(value) > 0:
+                        schedule.append((name, None))  # pre-concatenated → 1 call
+                else:
+                    lengths = {len(getattr(rm, name)) for rm in self.rank_metrics}
+                    assert len(lengths) == 1, (
+                        f"list state {name!r} has different lengths across ranks {lengths};"
+                        " the per-element gather protocol (ours and the reference's) needs"
+                        " equal update counts per rank"
+                    )
+                    schedule.extend((name, j) for j in range(len(value)))
             else:
-                names.append(name)
-        return names
+                schedule.append((name, None))
+        return schedule
 
     def __call__(self, tensor: jax.Array, group: Any = None):
         from metrics_tpu.utils.data import dim_zero_cat
 
-        if self._leaf_names is None:
-            self._leaf_names = self._leaves_of(self.rank_metrics[0])
-        name = self._leaf_names[self._call_idx]
+        if self._schedule is None:
+            self._schedule = self._build_schedule(self.rank_metrics[0])
+        name, elem = self._schedule[self._call_idx]
         self._call_idx += 1
         out = []
         for m in self.rank_metrics:
             value = getattr(m, name)
-            if isinstance(value, list):
+            if elem is not None:
+                out.append(jnp.asarray(value[elem]))
+            elif isinstance(value, list):
                 out.append(jnp.asarray(dim_zero_cat(value)))
             else:
                 out.append(jnp.asarray(value))
