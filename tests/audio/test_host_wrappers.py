@@ -1,0 +1,158 @@
+"""PESQ/STOI wrapper glue, executed in CI against stub backends (VERDICT #7).
+
+The real ``pesq``/``pystoi`` packages are standards-locked C/DSP code absent
+from this environment, so their import-gated tests skip. What CAN be locked
+is every line of OUR glue: argument order into the backend (target first —
+reference `functional/audio/pesq.py:79`), batch flattening/reshaping,
+per-clip iteration, dtype/device handling, validation errors, and the module
+metrics' mean accumulation. Stub modules with deterministic pseudo-scores
+are injected into ``sys.modules`` and the availability flags monkeypatched,
+so these paths execute even without the real backends.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _pseudo_score(ref: np.ndarray, deg: np.ndarray) -> float:
+    """Deterministic stand-in score: depends on BOTH signals and is
+    asymmetric, so swapped target/preds argument order fails the tests."""
+    return float(np.mean(ref) * 2.0 + np.mean(deg) + 1.0)
+
+
+@pytest.fixture()
+def stub_backends(monkeypatch):
+    calls = {"pesq": [], "stoi": []}
+
+    pesq_mod = types.ModuleType("pesq")
+
+    def fake_pesq(fs, ref, deg, mode):
+        calls["pesq"].append((fs, np.asarray(ref).copy(), np.asarray(deg).copy(), mode))
+        return _pseudo_score(np.asarray(ref), np.asarray(deg))
+
+    pesq_mod.pesq = fake_pesq
+
+    pystoi_mod = types.ModuleType("pystoi")
+
+    def fake_stoi(ref, deg, fs, extended):
+        calls["stoi"].append((np.asarray(ref).copy(), np.asarray(deg).copy(), fs, extended))
+        return _pseudo_score(np.asarray(ref), np.asarray(deg))
+
+    pystoi_mod.stoi = fake_stoi
+
+    monkeypatch.setitem(sys.modules, "pesq", pesq_mod)
+    monkeypatch.setitem(sys.modules, "pystoi", pystoi_mod)
+    import metrics_tpu.audio.metrics as audio_metrics
+    import metrics_tpu.functional.audio.host as host
+
+    monkeypatch.setattr(host, "_PESQ_AVAILABLE", True)
+    monkeypatch.setattr(host, "_PYSTOI_AVAILABLE", True)
+    monkeypatch.setattr(audio_metrics, "_PESQ_AVAILABLE", True)
+    monkeypatch.setattr(audio_metrics, "_PYSTOI_AVAILABLE", True)
+    return calls
+
+
+RNG = np.random.RandomState(3)
+PREDS_1D = RNG.randn(256).astype(np.float32)
+TARGET_1D = RNG.randn(256).astype(np.float32)
+PREDS_3D = RNG.randn(2, 3, 256).astype(np.float32)
+TARGET_3D = RNG.randn(2, 3, 256).astype(np.float32)
+
+
+class TestPesqGlue:
+    def test_single_clip_arg_order(self, stub_backends):
+        from metrics_tpu.functional.audio.host import perceptual_evaluation_speech_quality
+
+        out = perceptual_evaluation_speech_quality(jnp.asarray(PREDS_1D), jnp.asarray(TARGET_1D), 16000, "wb")
+        assert out.shape == ()
+        assert float(out) == pytest.approx(_pseudo_score(TARGET_1D, PREDS_1D), abs=1e-6)
+        (fs, ref, deg, mode), = stub_backends["pesq"]
+        assert fs == 16000 and mode == "wb"
+        np.testing.assert_array_equal(ref, TARGET_1D)  # target FIRST, like the reference
+        np.testing.assert_array_equal(deg, PREDS_1D)
+
+    def test_batch_reshape(self, stub_backends):
+        from metrics_tpu.functional.audio.host import perceptual_evaluation_speech_quality
+
+        out = perceptual_evaluation_speech_quality(jnp.asarray(PREDS_3D), jnp.asarray(TARGET_3D), 8000, "nb")
+        assert out.shape == (2, 3)
+        assert len(stub_backends["pesq"]) == 6  # one backend call per clip
+        want = np.asarray(
+            [[_pseudo_score(TARGET_3D[i, j], PREDS_3D[i, j]) for j in range(3)] for i in range(2)]
+        )
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-6)
+
+    def test_validation(self, stub_backends):
+        from metrics_tpu.functional.audio.host import perceptual_evaluation_speech_quality
+
+        with pytest.raises(ValueError, match="8000 or 16000"):
+            perceptual_evaluation_speech_quality(jnp.zeros(8), jnp.zeros(8), 44100, "wb")
+        with pytest.raises(ValueError, match="'wb' or 'nb'"):
+            perceptual_evaluation_speech_quality(jnp.zeros(8), jnp.zeros(8), 8000, "xx")
+        with pytest.raises(RuntimeError):
+            perceptual_evaluation_speech_quality(jnp.zeros(8), jnp.zeros(9), 8000, "wb")
+
+    def test_module_metric_mean(self, stub_backends):
+        from metrics_tpu import PerceptualEvaluationSpeechQuality
+
+        metric = PerceptualEvaluationSpeechQuality(8000, "nb")
+        metric.update(jnp.asarray(PREDS_3D[0]), jnp.asarray(TARGET_3D[0]))
+        metric.update(jnp.asarray(PREDS_1D), jnp.asarray(TARGET_1D))
+        scores = [_pseudo_score(TARGET_3D[0, j], PREDS_3D[0, j]) for j in range(3)]
+        scores.append(_pseudo_score(TARGET_1D, PREDS_1D))
+        assert float(metric.compute()) == pytest.approx(np.mean(scores), abs=1e-5)
+
+    def test_gated_without_backend(self):
+        from metrics_tpu.functional.audio.host import _PESQ_AVAILABLE
+
+        if _PESQ_AVAILABLE:
+            pytest.skip("real pesq installed")
+        from metrics_tpu.functional.audio.host import perceptual_evaluation_speech_quality
+
+        with pytest.raises(ModuleNotFoundError, match="pip install pesq"):
+            perceptual_evaluation_speech_quality(jnp.zeros(8), jnp.zeros(8), 8000, "nb")
+
+
+class TestStoiGlue:
+    def test_single_clip_arg_order(self, stub_backends):
+        from metrics_tpu.functional.audio.host import short_time_objective_intelligibility
+
+        out = short_time_objective_intelligibility(jnp.asarray(PREDS_1D), jnp.asarray(TARGET_1D), 16000, extended=True)
+        assert out.shape == ()
+        (ref, deg, fs, extended), = stub_backends["stoi"]
+        assert fs == 16000 and extended is True
+        np.testing.assert_array_equal(ref, TARGET_1D)
+        np.testing.assert_array_equal(deg, PREDS_1D)
+
+    def test_batch_reshape(self, stub_backends):
+        from metrics_tpu.functional.audio.host import short_time_objective_intelligibility
+
+        out = short_time_objective_intelligibility(jnp.asarray(PREDS_3D), jnp.asarray(TARGET_3D), 8000)
+        assert out.shape == (2, 3)
+        assert len(stub_backends["stoi"]) == 6
+        want = np.asarray(
+            [[_pseudo_score(TARGET_3D[i, j], PREDS_3D[i, j]) for j in range(3)] for i in range(2)]
+        )
+        np.testing.assert_allclose(np.asarray(out), want, atol=1e-6)
+
+    def test_module_metric_mean(self, stub_backends):
+        from metrics_tpu import ShortTimeObjectiveIntelligibility
+
+        metric = ShortTimeObjectiveIntelligibility(8000)
+        metric.update(jnp.asarray(PREDS_1D), jnp.asarray(TARGET_1D))
+        assert float(metric.compute()) == pytest.approx(_pseudo_score(TARGET_1D, PREDS_1D), abs=1e-5)
+
+    def test_gated_without_backend(self):
+        from metrics_tpu.functional.audio.host import _PYSTOI_AVAILABLE
+
+        if _PYSTOI_AVAILABLE:
+            pytest.skip("real pystoi installed")
+        from metrics_tpu.functional.audio.host import short_time_objective_intelligibility
+
+        with pytest.raises(ModuleNotFoundError, match="pip install pystoi"):
+            short_time_objective_intelligibility(jnp.zeros(8), jnp.zeros(8), 8000)

@@ -99,7 +99,7 @@ def run_spmd_state_merge(
         rank_states.append(state)
 
     stacked = jax.tree.map(lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *rank_states)
-    mesh = Mesh(np.array(jax.devices()[:NUM_RANKS]), ("dp",))
+    mesh = Mesh(np.array(jax.devices()[: len(rank_states)]), ("dp",))
     merged = jax.jit(
         shard_map(
             lambda s: compute_fn(jax.tree.map(lambda x: x[0], s), axis_name="dp"),
@@ -316,6 +316,7 @@ class TestWrapperSync:
             with base.sync_context(dist_sync_fn=gather, distributed_available=lambda: True):
                 synced = base._inner_compute()
             _values_close(synced, want, atol=1e-5)
+            assert base._is_synced is False
 
     def test_tracker_sync(self):
         """MetricTracker: the CURRENT step's metric syncs across ranks."""
@@ -337,3 +338,4 @@ class TestWrapperSync:
             with metric.sync_context(dist_sync_fn=gather, distributed_available=lambda: True):
                 synced = metric._inner_compute()
             _values_close(synced, want, atol=1e-5)
+            assert metric._is_synced is False

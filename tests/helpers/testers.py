@@ -66,7 +66,9 @@ class _FakeGather:
 
     def __init__(self, rank_metrics: Sequence[Metric]) -> None:
         self.rank_metrics = rank_metrics
-        self._schedule = None  # [(state name, element index | None), ...]
+        # built eagerly so the cross-rank agreement diagnostics fire even when
+        # the syncing rank itself would make zero gather calls
+        self._schedule = self._build_schedule(rank_metrics[0])
         self._call_idx = 0
 
     def _build_schedule(self, m: Metric):
@@ -98,8 +100,6 @@ class _FakeGather:
     def __call__(self, tensor: jax.Array, group: Any = None):
         from metrics_tpu.utils.data import dim_zero_cat
 
-        if self._schedule is None:
-            self._schedule = self._build_schedule(self.rank_metrics[0])
         name, elem = self._schedule[self._call_idx]
         self._call_idx += 1
         out = []
