@@ -40,3 +40,55 @@ _multidim_multiclass = Input(
     preds=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
     target=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
 )
+
+
+# --------------------------------------------------------------------------
+# Reference inventory completion (`tests/unittests/classification/inputs.py`):
+# logit-valued scores (outside [0,1] -> sigmoid/softmax autodetection), the
+# (N, C, X) multidim probability case, and DELIBERATE degenerate inputs —
+# the corner cases fuzz banks don't construct on purpose.
+
+_binary_logit = Input(
+    preds=jnp.asarray((_rng.randn(NUM_BATCHES, BATCH_SIZE) * 3).astype(np.float32)),
+    target=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))),
+)
+_multilabel_logit = Input(
+    preds=jnp.asarray((_rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES) * 3).astype(np.float32)),
+    target=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))),
+)
+_multiclass_logit = Input(
+    preds=jnp.asarray((_rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES) * 3).astype(np.float32)),
+    target=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))),
+)
+_multidim_multiclass_prob = Input(  # (N, C, X) class-dim probabilities
+    preds=jnp.asarray(
+        # axis 2 is the class dim of each (batch, sample, C, X) entry
+        (lambda p: p / p.sum(2, keepdims=True))(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)).astype(
+            np.float32
+        )
+    ),
+    target=jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
+)
+_multilabel_multidim_prob = Input(  # (N, C, X) independent labels
+    preds=jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM).astype(np.float32)),
+    target=jnp.asarray(_rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM))),
+)
+
+# single-class targets: every sample is class 2 (zero support elsewhere)
+_single_class_target = Input(
+    preds=jnp.asarray(
+        (lambda p: p / p.sum(-1, keepdims=True))(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+    ),
+    target=jnp.asarray(np.full((NUM_BATCHES, BATCH_SIZE), 2)),
+)
+# perfectly correct / perfectly wrong label predictions
+_perfect_target = jnp.asarray(_rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)))
+_perfect = Input(preds=_perfect_target, target=_perfect_target)
+_all_wrong = Input(
+    preds=jnp.asarray((np.asarray(_perfect_target) + 1) % NUM_CLASSES), target=_perfect_target
+)
+# multilabel with NO positive targets anywhere
+_multilabel_no_positives = Input(
+    preds=jnp.asarray(_rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)),
+    target=jnp.asarray(np.zeros((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), dtype=np.int64)),
+)

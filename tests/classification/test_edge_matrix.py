@@ -1,0 +1,307 @@
+"""Constructed corner-case matrices vs the mounted reference (VERDICT #4).
+
+The fuzz banks randomize over a domain; these cases are built ON PURPOSE —
+the reference's deliberate input inventory
+(`/root/reference/tests/unittests/classification/inputs.py:23-60`) plus the
+degenerate shapes that actually bite: logit autodetection, (N, C, X)
+probability tensors, all-ignored batches, single-class targets, zero-support
+classes, top_k == num_classes, no-positive multilabel targets, perfect and
+perfectly-wrong predictions. Every cell runs the identical data through our
+implementation and the reference on torch/CPU and requires agreement
+(NaN-for-NaN where the reference produces NaN).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.classification.inputs import (
+    _all_wrong,
+    _binary_logit,
+    _multiclass_logit,
+    _multidim_multiclass,
+    _multidim_multiclass_prob,
+    _multilabel_logit,
+    _multilabel_multidim_prob,
+    _multilabel_no_positives,
+    _perfect,
+    _single_class_target,
+)
+from tests.helpers.reference_oracle import get_reference
+from tests.helpers.testers import NUM_CLASSES
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+_STAT_METRICS = ["Accuracy", "Precision", "Recall", "F1Score", "Specificity"]
+
+
+def _to_torch(x):
+    return torch.tensor(np.asarray(x))
+
+
+def _run_pair(name, inputs, kwargs, atol=1e-6, ref_kwargs=None):
+    ours = getattr(mt, name)(**kwargs)
+    ref = getattr(_ref, name)(**(ref_kwargs if ref_kwargs is not None else kwargs))
+    for i in range(inputs.preds.shape[0]):
+        ours.update(inputs.preds[i], inputs.target[i])
+        ref.update(_to_torch(inputs.preds[i]), _to_torch(inputs.target[i]))
+    ours_val = np.asarray(ours.compute())
+    ref_val = ref.compute()
+    if isinstance(ref_val, (list, tuple)):
+        ref_val = torch.stack([torch.as_tensor(v) for v in ref_val])
+    np.testing.assert_allclose(ours_val, ref_val.numpy(), atol=atol, rtol=1e-5)
+
+
+# ---------------------------------------------------------------- logits
+
+class TestLogitInputs:
+    """Scores outside [0,1] must route through the same sigmoid/softmax
+    autodetection as the reference."""
+
+    @pytest.mark.parametrize("metric", _STAT_METRICS)
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    def test_binary_logits(self, metric, average):
+        kwargs = {"average": average}
+        if average != "micro":
+            kwargs["num_classes"] = 1
+        _run_pair(metric, _binary_logit, kwargs)
+
+    @pytest.mark.parametrize("metric", _STAT_METRICS)
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    def test_multiclass_logits(self, metric, average):
+        _run_pair(metric, _multiclass_logit, {"average": average, "num_classes": NUM_CLASSES})
+
+    @pytest.mark.parametrize("metric", ["Accuracy", "Precision", "Recall"])
+    @pytest.mark.parametrize("threshold", [0.35, 0.5, 0.65])
+    def test_multilabel_logits_threshold(self, metric, threshold):
+        """Threshold applies to the POST-sigmoid probabilities."""
+        _run_pair(
+            metric,
+            _multilabel_logit,
+            {"average": "micro", "threshold": threshold, "num_classes": NUM_CLASSES},
+        )
+
+    def test_confusion_matrix_logits(self):
+        _run_pair("ConfusionMatrix", _binary_logit, {"num_classes": 2})
+
+
+# ------------------------------------------------------- multidim (N, C, X)
+
+class TestMultidimProb:
+    """(N, C, X) probability tensors — class dim second, extra dims after."""
+
+    @pytest.mark.parametrize("metric", _STAT_METRICS)
+    @pytest.mark.parametrize("mdmc", ["global", "samplewise"])
+    def test_stat_family(self, metric, mdmc):
+        _run_pair(
+            metric,
+            _multidim_multiclass_prob,
+            {"average": "macro", "mdmc_average": mdmc, "num_classes": NUM_CLASSES},
+        )
+
+    @pytest.mark.parametrize("mdmc", ["global", "samplewise"])
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_top_k_multidim(self, mdmc, top_k):
+        _run_pair(
+            "Accuracy",
+            _multidim_multiclass_prob,
+            {"mdmc_average": mdmc, "num_classes": NUM_CLASSES, "top_k": top_k},
+        )
+
+    @pytest.mark.parametrize("metric", ["Accuracy", "Precision"])
+    def test_multilabel_multidim(self, metric):
+        # (N, C, X) float + int pair classifies as multilabel with C*X implied
+        # labels; num_classes must be omitted (both stacks reject a mismatch)
+        _run_pair(metric, _multilabel_multidim_prob, {"average": "micro"})
+
+    def test_multilabel_multidim_num_classes_mismatch_rejected(self):
+        ours = mt.Accuracy(average="micro", num_classes=NUM_CLASSES)
+        ref = _ref.Accuracy(average="micro", num_classes=NUM_CLASSES)
+        with pytest.raises(ValueError, match="does not match num_classes"):
+            ours.update(_multilabel_multidim_prob.preds[0], _multilabel_multidim_prob.target[0])
+        with pytest.raises(ValueError, match="does not match num_classes"):
+            ref.update(
+                _to_torch(_multilabel_multidim_prob.preds[0]), _to_torch(_multilabel_multidim_prob.target[0])
+            )
+
+
+# ----------------------------------------------------------- degenerate data
+
+class TestDegenerateTargets:
+    @pytest.mark.parametrize("metric", _STAT_METRICS)
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    def test_single_class_targets(self, metric, average):
+        """Zero support for 4 of 5 classes: macro means over 0/0 classes and
+        'none' rows for unobserved classes must match the reference exactly."""
+        _run_pair(metric, _single_class_target, {"average": average, "num_classes": NUM_CLASSES}, atol=1e-6)
+
+    @pytest.mark.parametrize("metric", _STAT_METRICS)
+    def test_perfect_predictions(self, metric):
+        _run_pair(metric, _perfect, {"average": "macro", "num_classes": NUM_CLASSES})
+
+    @pytest.mark.parametrize("metric", _STAT_METRICS)
+    def test_all_wrong_predictions(self, metric):
+        _run_pair(metric, _all_wrong, {"average": "macro", "num_classes": NUM_CLASSES})
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_multilabel_without_positives(self, average):
+        """Recall denominator is 0 everywhere."""
+        for metric in ("Precision", "Recall", "F1Score"):
+            _run_pair(metric, _multilabel_no_positives, {"average": average, "num_classes": NUM_CLASSES})
+
+    def test_statscores_raw_single_class(self):
+        _run_pair("StatScores", _single_class_target, {"num_classes": NUM_CLASSES, "reduce": "macro"})
+
+
+class TestIgnoreIndex:
+    @pytest.mark.parametrize("metric", ["Accuracy", "Precision", "Recall", "F1Score"])
+    def test_all_samples_ignored(self, metric):
+        """ignore_index covers EVERY sample (single-class target == ignored
+        class): the reference's 0/0 outcome must be reproduced bit-for-bit
+        (NaN compares equal to NaN)."""
+        _run_pair(metric, _single_class_target, {"average": "micro", "num_classes": NUM_CLASSES, "ignore_index": 2})
+
+    @pytest.mark.parametrize("mdmc", ["global", "samplewise"])
+    def test_ignore_index_multidim(self, mdmc):
+        _run_pair(
+            "Accuracy",
+            _multidim_multiclass,
+            {"average": "macro", "mdmc_average": mdmc, "num_classes": NUM_CLASSES, "ignore_index": 1},
+        )
+
+    @pytest.mark.parametrize("metric", ["Accuracy", "Precision"])
+    @pytest.mark.parametrize("ignore_index", [0, 4])
+    def test_ignore_index_with_none_average(self, metric, ignore_index):
+        _run_pair(
+            metric,
+            _single_class_target,
+            {"average": "none", "num_classes": NUM_CLASSES, "ignore_index": ignore_index},
+        )
+
+    def test_ignore_index_above_num_classes_rejected(self):
+        """Both stacks reject an ignore_index outside [0, C) at construction."""
+        with pytest.raises(ValueError, match="not valid"):
+            mt.Accuracy(num_classes=NUM_CLASSES, ignore_index=17)
+        with pytest.raises(ValueError, match="not valid"):
+            _ref.Accuracy(num_classes=NUM_CLASSES, ignore_index=17)
+
+    def test_negative_ignore_index(self):
+        """Negative ignore_index drops those target rows before scoring."""
+        rng = np.random.RandomState(8)
+        preds = jnp.asarray(rng.rand(1, 64, NUM_CLASSES).astype(np.float32))
+        target_np = rng.randint(0, NUM_CLASSES, (1, 64))
+        target_np[0, :10] = -1
+        from collections import namedtuple
+
+        case = namedtuple("Input", ["preds", "target"])(preds, jnp.asarray(target_np))
+        _run_pair("Accuracy", case, {"num_classes": NUM_CLASSES, "ignore_index": -1})
+
+
+class TestTopK:
+    def test_top_k_num_classes_minus_one(self):
+        """The largest admissible k (k = C - 1): only the argmin can miss."""
+        from tests.classification.inputs import _multiclass_prob
+
+        _run_pair("Accuracy", _multiclass_prob, {"num_classes": NUM_CLASSES, "top_k": NUM_CLASSES - 1})
+
+    @pytest.mark.parametrize("top_k", [NUM_CLASSES, NUM_CLASSES + 2])
+    def test_top_k_at_or_above_num_classes_raises(self, top_k):
+        """Both stacks require k strictly smaller than C (reference
+        `utilities/checks.py:202-203`)."""
+        from tests.classification.inputs import _multiclass_prob
+
+        ours = mt.Accuracy(num_classes=NUM_CLASSES, top_k=top_k)
+        ref = _ref.Accuracy(num_classes=NUM_CLASSES, top_k=top_k)
+        with pytest.raises(ValueError, match="strictly smaller"):
+            ours.update(_multiclass_prob.preds[0], _multiclass_prob.target[0])
+        with pytest.raises(ValueError, match="strictly smaller"):
+            ref.update(_to_torch(_multiclass_prob.preds[0]), _to_torch(_multiclass_prob.target[0]))
+
+    def test_top_k_on_label_preds_raises(self):
+        """top_k needs probability inputs; both stacks reject label preds."""
+        from tests.classification.inputs import _multiclass
+
+        ours = mt.Accuracy(num_classes=NUM_CLASSES, top_k=2)
+        ref = _ref.Accuracy(num_classes=NUM_CLASSES, top_k=2)
+        with pytest.raises(ValueError):
+            ours.update(_multiclass.preds[0], _multiclass.target[0])
+        with pytest.raises((ValueError, RuntimeError)):
+            ref.update(_to_torch(_multiclass.preds[0]), _to_torch(_multiclass.target[0]))
+
+    @pytest.mark.parametrize("top_k", [1, 2, 4])
+    def test_top_k_precision_recall(self, top_k):
+        from tests.classification.inputs import _multiclass_prob
+
+        for metric in ("Precision", "Recall"):
+            _run_pair(metric, _multiclass_prob, {"num_classes": NUM_CLASSES, "top_k": top_k, "average": "macro"})
+
+
+class TestSubsetAccuracy:
+    @pytest.mark.parametrize("case_name", ["multilabel_prob", "multilabel_logit", "mdmc"])
+    def test_subset_accuracy(self, case_name):
+        from tests.classification.inputs import _multilabel_prob
+
+        cases = {
+            "multilabel_prob": _multilabel_prob,
+            "multilabel_logit": _multilabel_logit,
+            "mdmc": _multidim_multiclass,
+        }
+        _run_pair("Accuracy", cases[case_name], {"subset_accuracy": True})
+
+
+class TestErrorParity:
+    """Invalid configurations must fail in BOTH stacks (same error class)."""
+
+    def test_float_target_rejected(self):
+        with pytest.raises(ValueError):
+            mt.Accuracy().update(jnp.asarray([0.1, 0.9]), jnp.asarray([0.0, 1.0]))
+        with pytest.raises(ValueError):
+            _ref.Accuracy().update(torch.tensor([0.1, 0.9]), torch.tensor([0.0, 1.0]))
+
+    def test_negative_target_rejected(self):
+        with pytest.raises(ValueError):
+            mt.Accuracy().update(jnp.asarray([0.1, 0.9]), jnp.asarray([-1, 1]))
+        with pytest.raises(ValueError):
+            _ref.Accuracy().update(torch.tensor([0.1, 0.9]), torch.tensor([-1, 1]))
+
+    def test_mismatched_batch_dim_rejected(self):
+        with pytest.raises(ValueError):
+            mt.Accuracy().update(jnp.zeros((3,)), jnp.zeros((4,), jnp.int32))
+        with pytest.raises(ValueError):
+            _ref.Accuracy().update(torch.zeros(3), torch.zeros(4, dtype=torch.long))
+
+    def test_multiclass_false_with_large_target_rejected(self):
+        preds = jnp.asarray([0.2, 0.7, 0.4])
+        target = jnp.asarray([0, 2, 1])
+        with pytest.raises(ValueError):
+            mt.Accuracy(multiclass=False).update(preds, target)
+        with pytest.raises(ValueError):
+            _ref.Accuracy(multiclass=False).update(_to_torch(preds), _to_torch(target))
+
+    def test_probabilities_above_one_treated_as_logits_consistently(self):
+        """A pred tensor mixing values in and out of [0,1] is logits in both."""
+        preds = jnp.asarray([[0.3, 1.7, -0.2], [2.0, 0.1, 0.4]])
+        target = jnp.asarray([1, 0])
+        ours = mt.Accuracy(num_classes=3)
+        ref = _ref.Accuracy(num_classes=3)
+        ours.update(preds, target)
+        ref.update(_to_torch(preds), _to_torch(target))
+        assert float(ours.compute()) == pytest.approx(float(ref.compute()))
+
+    @pytest.mark.parametrize("mdmc", [None, "bogus"])
+    def test_bad_mdmc_average_rejected(self, mdmc):
+        from tests.classification.inputs import _multidim_multiclass
+
+        with pytest.raises(ValueError):
+            m = mt.Precision(num_classes=NUM_CLASSES, average="macro", mdmc_average=mdmc)
+            m.update(_multidim_multiclass.preds[0], _multidim_multiclass.target[0])
+            m.compute()
+        with pytest.raises(ValueError):
+            r = _ref.Precision(num_classes=NUM_CLASSES, average="macro", mdmc_average=mdmc)
+            r.update(_to_torch(_multidim_multiclass.preds[0]), _to_torch(_multidim_multiclass.target[0]))
+            r.compute()
