@@ -32,6 +32,23 @@ from metrics_tpu.functional.detection.box_ops import box_convert, box_iou, mask_
 from metrics_tpu.metric import Metric
 
 
+def _box_convert_np(boxes: np.ndarray, in_fmt: str, out_fmt: str = "xyxy") -> np.ndarray:
+    """Host-side box format conversion (update appends to host lists; a device
+    round trip per image would dominate on remote backends). Same conventions
+    as the device kernel `functional/detection/box_ops.box_convert`."""
+    if in_fmt == out_fmt:
+        return boxes
+    if out_fmt != "xyxy":
+        raise ValueError(f"Unsupported host conversion {in_fmt}->{out_fmt}")
+    if in_fmt == "xywh":
+        x, y, w, h = boxes.T
+        return np.stack([x, y, x + w, y + h], axis=-1)
+    if in_fmt == "cxcywh":
+        cx, cy, w, h = boxes.T
+        return np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    raise ValueError(f"Unsupported host conversion {in_fmt}->{out_fmt}")
+
+
 def _box_iou_np(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     """Host mirror of the device `box_iou` — same float32 arithmetic, same
     (unguarded) inter/union division, so the host/device cutoff can never
@@ -81,8 +98,11 @@ def _input_validator(preds: Sequence[dict], targets: Sequence[dict], iou_type: s
             return 1
         if isinstance(value, (list, tuple)) and value and isinstance(value[0], dict):
             return len(value)
-        arr = np.asarray(value)
-        return arr.shape[0] if arr.size else 0
+        # shape is metadata — works for device arrays WITHOUT a host transfer
+        shape = getattr(value, "shape", None)
+        if shape is None:
+            shape = np.asarray(value).shape
+        return shape[0] if len(shape) and int(np.prod(shape)) else 0
 
     for i, item in enumerate(targets):
         n_boxes = _n_items(item[iou_attribute])
@@ -175,22 +195,103 @@ class MeanAveragePrecision(Metric):
 
     # ------------------------------------------------------------- update
     def update(self, preds: List[Dict[str, jax.Array]], target: List[Dict[str, jax.Array]]) -> None:
-        """Append per-image detection/groundtruth dicts (reference `mean_ap.py:333-393`)."""
+        """Append per-image detection/groundtruth dicts (reference `mean_ap.py:333-393`).
+
+        Zero-sync hot path: validation reads only shape metadata, and
+        device-array leaves are appended AS-IS (async — no blocking
+        device→host fetch). All pending leaves are fetched in one fused
+        transfer per dtype when ``compute()`` materializes the states; on
+        remote/tunneled backends a per-update blocking fetch costs a full
+        network round trip, which at COCO scale dominates everything else.
+        """
         _input_validator(preds, target, iou_type=self.iou_type)
 
         for item in preds:
-            self.detections.append(self._get_safe_item_values(item))
-            self.detection_labels.append(np.asarray(item["labels"]).reshape(-1))
-            self.detection_scores.append(np.asarray(item["scores"]).reshape(-1).astype(np.float32))
+            self.detections.append(self._raw_or_safe_item(item))
+            self.detection_labels.append(self._raw_or_host(item["labels"]))
+            self.detection_scores.append(self._raw_or_host(item["scores"], np.float32))
         for item in target:
-            self.groundtruths.append(self._get_safe_item_values(item))
-            self.groundtruth_labels.append(np.asarray(item["labels"]).reshape(-1))
+            self.groundtruths.append(self._raw_or_safe_item(item))
+            self.groundtruth_labels.append(self._raw_or_host(item["labels"]))
+
+    @staticmethod
+    def _raw_or_host(value: Any, dtype: Optional[np.dtype] = None) -> Any:
+        if isinstance(value, jax.Array):
+            return value  # raw — zero device ops here; normalized at materialize
+        out = np.asarray(value).reshape(-1)
+        return out.astype(dtype) if dtype is not None else out
+
+    def _raw_or_safe_item(self, item: Dict[str, Any]) -> Any:
+        key = "boxes" if self.iou_type == "bbox" else "masks"
+        value = item[key]
+        if isinstance(value, jax.Array):
+            # box format conversion happens HERE for device inputs too (async
+            # device kernel — no blocking fetch): it is the one non-idempotent
+            # normalization step, and materialize must stay idempotent because
+            # base-class machinery (sync gather, astype, state_dict round
+            # trips) can re-wrap already-normalized host entries as jax arrays
+            if self.iou_type == "bbox" and self.box_format != "xyxy" and value.size:
+                value = box_convert(value.reshape(-1, 4), in_fmt=self.box_format, out_fmt="xyxy")
+            return value
+        return self._get_safe_item_values(item)
+
+    def _materialize_states(self) -> None:
+        """Fetch every pending device-array leaf to host (all transfers in
+        flight at once), then normalize EVERY entry. Normalization here is
+        strictly idempotent (reshape + dtype casts — box format conversion
+        already happened at update time), so entries that base-class machinery
+        converted between numpy and jax (compute_on_cpu hook, sync gather,
+        astype, checkpoint round trips) stay correct either way."""
+        state_lists = (
+            self.detections,
+            self.detection_scores,
+            self.detection_labels,
+            self.groundtruths,
+            self.groundtruth_labels,
+        )
+        normalizers = {
+            id(self.detections): self._normalize_item,
+            id(self.groundtruths): self._normalize_item,
+            id(self.detection_scores): lambda v: v.reshape(-1).astype(np.float32),
+            id(self.detection_labels): lambda v: v.reshape(-1),
+            id(self.groundtruth_labels): lambda v: v.reshape(-1),
+        }
+        # Two passes: start EVERY device→host copy asynchronously (transfers
+        # overlap in flight — no per-leaf latency wait, no device ops, no
+        # compiles), then drain and normalize. Ragged per-image shapes make
+        # any concat-then-fetch scheme recompile per shape combination, which
+        # costs far more than the transfers themselves.
+        pending: List[Tuple[list, int]] = [
+            (lst, i)
+            for lst in state_lists
+            for i, value in enumerate(lst)
+            if isinstance(value, jax.Array)
+        ]
+        for lst, i in pending:
+            try:
+                lst[i].copy_to_host_async()
+            except AttributeError:  # pragma: no cover - older jax array types
+                pass
+        for lst in state_lists:
+            normalize = normalizers[id(lst)]
+            for i, value in enumerate(lst):
+                lst[i] = normalize(np.asarray(value))
+
+    def _normalize_item(self, value: np.ndarray) -> np.ndarray:
+        # idempotent by construction: reshape + dtype only (box format was
+        # converted exactly once at update time, on whichever side the input
+        # arrived)
+        if self.iou_type != "bbox":
+            from metrics_tpu.functional.detection.rle import masks_from_any
+
+            return masks_from_any(value)
+        return value.reshape(-1, 4).astype(np.float32) if value.size else np.zeros((0, 4), np.float32)
 
     def _get_safe_item_values(self, item: Dict[str, Any]) -> np.ndarray:
         if self.iou_type == "bbox":
             boxes = np.asarray(item["boxes"], dtype=np.float32).reshape(-1, 4) if np.asarray(item["boxes"]).size else np.zeros((0, 4), np.float32)
-            if boxes.size > 0:
-                boxes = np.asarray(box_convert(jnp.asarray(boxes), in_fmt=self.box_format, out_fmt="xyxy"))
+            if boxes.size > 0 and self.box_format != "xyxy":
+                boxes = _box_convert_np(boxes, in_fmt=self.box_format, out_fmt="xyxy")
             return boxes
         # segm: dense boolean masks [n, H, W], or COCO RLE dict(s) decoded on
         # host (metrics_tpu/functional/detection/rle.py)
@@ -523,6 +624,7 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> dict:
         """mAP/mAR summary dict (reference `mean_ap.py:879-933`)."""
+        self._materialize_states()  # one fused device fetch for all pending leaves
         classes = self._get_classes()
         precisions, recalls = self._calculate(classes)
         map_val, mar_val = self._summarize_results(precisions, recalls)

@@ -272,3 +272,74 @@ class TestBoxOps:
         b[:, 1:3] = True
         iou = np.asarray(mask_iou(jnp.asarray(a), jnp.asarray(b)))
         np.testing.assert_allclose(iou, [[4 / 12]], atol=1e-6)
+
+
+class TestDeferredMaterialization:
+    """The zero-sync update path defers device fetches to compute(); these pin
+    the review-found hazards: base-class machinery converting state entries
+    numpy<->jax must never skip or repeat normalization."""
+
+    @staticmethod
+    def _xywh_pair():
+        import jax.numpy as jnp
+
+        boxes_xywh = jnp.asarray([[10.0, 10.0, 20.0, 20.0], [40.0, 40.0, 20.0, 20.0]])
+        boxes_xyxy = jnp.asarray([[10.0, 10.0, 30.0, 30.0], [40.0, 40.0, 60.0, 60.0]])
+        labels = jnp.asarray([0, 1])
+        scores = jnp.asarray([0.9, 0.8])
+        return boxes_xywh, boxes_xyxy, labels, scores
+
+    def test_compute_on_cpu_still_converts_boxes(self):
+        import metrics_tpu as mt
+
+        boxes_xywh, boxes_xyxy, labels, scores = self._xywh_pair()
+        metric = mt.MeanAveragePrecision(box_format="xywh", compute_on_cpu=True)
+        metric.update(
+            [dict(boxes=boxes_xywh, scores=scores, labels=labels)],
+            [dict(boxes=boxes_xywh, labels=labels)],
+        )
+        assert float(metric.compute()["map"]) == pytest.approx(1.0)
+
+    def test_astype_round_trip_does_not_double_convert(self):
+        import metrics_tpu as mt
+
+        boxes_xywh, boxes_xyxy, labels, scores = self._xywh_pair()
+        metric = mt.MeanAveragePrecision(box_format="xywh")
+        # numpy inputs: normalized (converted to xyxy) at update time
+        metric.update(
+            [dict(boxes=np.asarray(boxes_xywh), scores=np.asarray(scores), labels=np.asarray(labels))],
+            [dict(boxes=np.asarray(boxes_xywh), labels=np.asarray(labels))],
+        )
+        metric.float()  # re-wraps host state entries as jax arrays
+        assert float(metric.compute()["map"]) == pytest.approx(1.0)
+
+    def test_device_and_host_inputs_agree(self):
+        import jax.numpy as jnp
+
+        import metrics_tpu as mt
+
+        rng = np.random.RandomState(4)
+        n = 12
+        xy = rng.rand(n, 2).astype(np.float32) * 100
+        wh = 10 + rng.rand(n, 2).astype(np.float32) * 40
+        boxes = np.concatenate([xy, wh], 1)
+        labels = rng.randint(0, 3, n)
+        scores = rng.rand(n).astype(np.float32)
+        gxy = rng.rand(5, 2).astype(np.float32) * 100
+        gwh = 10 + rng.rand(5, 2).astype(np.float32) * 40
+        gboxes = np.concatenate([gxy, gwh], 1)
+        glabels = rng.randint(0, 3, 5)
+
+        host = mt.MeanAveragePrecision(box_format="xywh")
+        host.update(
+            [dict(boxes=boxes, scores=scores, labels=labels)], [dict(boxes=gboxes, labels=glabels)]
+        )
+        device = mt.MeanAveragePrecision(box_format="xywh")
+        device.update(
+            [dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(scores), labels=jnp.asarray(labels))],
+            [dict(boxes=jnp.asarray(gboxes), labels=jnp.asarray(glabels))],
+        )
+        for key, value in host.compute().items():
+            np.testing.assert_allclose(
+                np.asarray(value), np.asarray(device.compute()[key]), atol=1e-6, err_msg=key
+            )

@@ -1,0 +1,158 @@
+"""COCO-scale MeanAveragePrecision wall-clock: ours vs the mounted reference.
+
+VERDICT #6 gate: >= 5k detections on identical data, compute() wall-clock
+must be <= the reference CPU path. Run:
+
+    PYTHONPATH=/root/repo:/root/.axon_site python tools/bench_map.py [--images 500]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def make_dataset(n_images: int, n_classes: int = 20, seed: int = 0):
+    """Realistic detection batches: ~10 dets & ~7 gts per image."""
+    rng = np.random.RandomState(seed)
+    batches = []
+    for _ in range(n_images):
+        n_det = rng.randint(6, 15)
+        n_gt = rng.randint(4, 10)
+        gxy = rng.rand(n_gt, 2) * 500
+        gwh = 20 + rng.rand(n_gt, 2) * 120
+        gboxes = np.concatenate([gxy, gxy + gwh], 1).astype(np.float32)
+        glabels = rng.randint(0, n_classes, n_gt)
+        # detections: jittered copies of gts + noise boxes
+        idx = rng.randint(0, n_gt, n_det)
+        noise = rng.randn(n_det, 4).astype(np.float32) * 8
+        dboxes = gboxes[idx] + noise
+        dboxes[:, 2:] = np.maximum(dboxes[:, 2:], dboxes[:, :2] + 1)
+        dlabels = np.where(rng.rand(n_det) < 0.85, glabels[idx], rng.randint(0, n_classes, n_det))
+        scores = rng.rand(n_det).astype(np.float32)
+        batches.append(
+            (
+                dict(boxes=dboxes, scores=scores, labels=dlabels.astype(np.int64)),
+                dict(boxes=gboxes, labels=glabels.astype(np.int64)),
+            )
+        )
+    return batches
+
+
+def bench_ours(batches):
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+
+    metric = mt.MeanAveragePrecision()
+    t0 = time.perf_counter()
+    for det, gt in batches:
+        metric.update(
+            [dict(boxes=jnp.asarray(det["boxes"]), scores=jnp.asarray(det["scores"]), labels=jnp.asarray(det["labels"]))],
+            [dict(boxes=jnp.asarray(gt["boxes"]), labels=jnp.asarray(gt["labels"]))],
+        )
+    t_update = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = metric.compute()
+    t_compute = time.perf_counter() - t0
+    return float(out["map"]), t_update, t_compute
+
+
+def _install_torchvision_shim():
+    """Minimal torch implementations of the three torchvision box ops the
+    reference mAP uses (torchvision is not installed here; these are the
+    standard published formulas, xyxy convention)."""
+    import types
+
+    import torch
+
+    def box_area(boxes):
+        return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+    def box_iou(boxes1, boxes2):
+        area1, area2 = box_area(boxes1), box_area(boxes2)
+        lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+        rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+        wh = (rb - lt).clamp(min=0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    def box_convert(boxes, in_fmt, out_fmt):
+        if in_fmt == out_fmt:
+            return boxes
+        if in_fmt == "xywh" and out_fmt == "xyxy":
+            x, y, w, h = boxes.unbind(-1)
+            return torch.stack([x, y, x + w, y + h], dim=-1)
+        if in_fmt == "cxcywh" and out_fmt == "xyxy":
+            cx, cy, w, h = boxes.unbind(-1)
+            return torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+        if in_fmt == "xyxy" and out_fmt == "xywh":
+            x1, y1, x2, y2 = boxes.unbind(-1)
+            return torch.stack([x1, y1, x2 - x1, y2 - y1], dim=-1)
+        raise ValueError(f"unsupported conversion {in_fmt}->{out_fmt}")
+
+    tv = types.ModuleType("torchvision")
+    tv.__version__ = "0.15.0"
+    ops = types.ModuleType("torchvision.ops")
+    ops.box_area, ops.box_iou, ops.box_convert = box_area, box_iou, box_convert
+    tv.ops = ops
+    sys.modules["torchvision"] = tv
+    sys.modules["torchvision.ops"] = ops
+
+
+def bench_reference(batches):
+    from tests.helpers.reference_oracle import get_reference
+
+    ref = get_reference()
+    if ref is None:
+        return None
+    import torch
+
+    _install_torchvision_shim()
+    import torchmetrics.detection.mean_ap as ref_map_mod
+    import torchvision.ops as tv_ops
+
+    ref_map_mod._TORCHVISION_GREATER_EQUAL_0_8 = True
+    ref_map_mod.box_area = tv_ops.box_area
+    ref_map_mod.box_iou = tv_ops.box_iou
+    ref_map_mod.box_convert = tv_ops.box_convert
+
+    metric = ref_map_mod.MeanAveragePrecision()
+    t0 = time.perf_counter()
+    for det, gt in batches:
+        metric.update(
+            [dict(boxes=torch.from_numpy(det["boxes"]), scores=torch.from_numpy(det["scores"]), labels=torch.from_numpy(det["labels"]))],
+            [dict(boxes=torch.from_numpy(gt["boxes"]), labels=torch.from_numpy(gt["labels"]))],
+        )
+    t_update = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = metric.compute()
+    t_compute = time.perf_counter() - t0
+    return float(out["map"]), t_update, t_compute
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--images", type=int, default=500)
+    parser.add_argument("--skip-reference", action="store_true")
+    args = parser.parse_args()
+
+    batches = make_dataset(args.images)
+    n_det = sum(len(b[0]["scores"]) for b in batches)
+    print(f"{args.images} images, {n_det} detections")
+
+    ours = bench_ours(batches)
+    print(f"ours:      map={ours[0]:.4f}  update={ours[1]:.2f}s  compute={ours[2]:.2f}s")
+    if not args.skip_reference:
+        theirs = bench_reference(batches)
+        if theirs is None:
+            print("reference: unavailable")
+        else:
+            print(f"reference: map={theirs[0]:.4f}  update={theirs[1]:.2f}s  compute={theirs[2]:.2f}s")
+            print(f"compute speedup vs reference: {theirs[2] / ours[2]:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
