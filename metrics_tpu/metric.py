@@ -265,8 +265,94 @@ class Metric(ABC):
         self.compute_on_cpu = compute_on_cpu
         return batch_val
 
+    # class-level defaults so unpickled/copied instances lazily rebuild
+    _fused_forward: Optional[Callable] = None
+    _fused_template: Optional["Metric"] = None
+    _fused_forward_ok: bool = True
+    _forward_seen_once: bool = False
+
+    def _build_fused_forward(self) -> Callable:
+        """One jitted program for the whole reduce-path forward: batch update
+        from the default state + batch compute + merge into the global state.
+
+        The eager forward issues ~20-30 tiny device ops per step (snapshot,
+        reset, update, compute, merge) — each a dispatch round trip, which is
+        what per-step overhead IS on remote/tunneled backends. Fused, a step
+        is ONE dispatch. Only simple reductions fuse (sum/mean/max/min over
+        array states); list/cat states grow (retrace per step) and custom
+        reductions may not be traceable, so those metrics keep the eager path.
+        """
+        if any(isinstance(v, list) for v in self._defaults.values()):
+            raise TypeError("list states cannot fuse (state grows per update)")
+        allowed = ("sum", "mean", "max", "min")
+        if any(self._reduction_specs[name] not in allowed for name in self._defaults):
+            raise TypeError("only sum/mean/max/min reductions fuse")
+        template = self._bare_clone()
+        specs = {name: self._reduction_specs[name] for name in self._defaults}
+
+        def step(state: Dict[str, Any], update_count: jax.Array, *args: Any, **kwargs: Any):
+            m = template._bare_clone()
+            m._inner_update(*args, **kwargs)
+            _propagate_static_attrs(m, template)
+            batch_state = m._state_snapshot()
+            batch_value = m._inner_compute()
+            merged = {
+                name: self._merge_leaf(spec, state[name], batch_state[name], update_count)
+                for name, spec in specs.items()
+            }
+            return merged, batch_value
+
+        self._fused_template = template
+        # NOTE: the program caches per instance (step closes over this
+        # instance's template). Identically-configured instances each compile
+        # once per input signature; XLA's persistent compilation cache dedupes
+        # the identical HLO across them when enabled.
+        return jax.jit(step)
+
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
-        """Single-update fast path: batch state is merged into global state."""
+        """Single-update fast path: batch state is merged into global state.
+
+        After the first (always eager, fully validated) call, metrics with
+        fusable states run the whole step as one jitted program — unless the
+        validation mode is "full", which asks for per-update value checks that
+        a traced program cannot perform.
+        """
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        if self._fused_forward_ok and self._forward_seen_once and _get_validation_mode() != "full":
+            try:
+                if self._fused_forward is None:
+                    self._fused_forward = self._build_fused_forward()
+                state = {name: getattr(self, name) for name in self._defaults}
+                merged, batch_val = self._fused_forward(state, self._update_count + 1, *args, **kwargs)
+            except Exception:
+                # fall back; if the eager path then succeeds, the metric is
+                # genuinely unfusable — stop re-tracing every step. If eager
+                # raises too, the input itself was bad: surface that error and
+                # keep the fused path enabled.
+                result = self._forward_reduce_state_update_eager(*args, **kwargs)
+                self._fused_forward_ok = False
+                self._fused_forward = None
+                self._fused_template = None
+                return result
+            for name, value in merged.items():
+                setattr(self, name, value)
+            self._fused_applying = True
+            try:
+                _propagate_static_attrs(self._fused_template, self)
+            finally:
+                self._fused_applying = False
+            self._update_count += 1
+            self._is_synced = False
+            self._should_unsync = True
+            self._to_sync = self.sync_on_compute
+            self._computed = None
+            return batch_val
+        result = self._forward_reduce_state_update_eager(*args, **kwargs)
+        self._forward_seen_once = True
+        return result
+
+    def _forward_reduce_state_update_eager(self, *args: Any, **kwargs: Any) -> Any:
         global_state = self._state_snapshot()
         update_count = self._update_count
         self.reset()
@@ -288,20 +374,26 @@ class Metric(ABC):
         self.compute_on_cpu = compute_on_cpu
         return batch_val
 
+    @staticmethod
+    def _merge_leaf(spec: str, incoming: Any, local: Any, update_count: Any) -> Any:
+        """The sum/mean/max/min merge table — single source of truth shared by
+        the eager `_reduce_states` and the fused forward program."""
+        if spec == "sum":
+            return incoming + local
+        if spec == "mean":
+            return ((update_count - 1) * incoming + local) / update_count
+        if spec == "max":
+            return jnp.maximum(incoming, local)
+        return jnp.minimum(incoming, local)
+
     def _reduce_states(self, incoming_state: Dict[str, Any]) -> None:
         """Merge an incoming state into the current one (reference `metric.py:327-354`)."""
         for name in self._defaults:
             local = getattr(self, name)
             incoming = incoming_state[name]
             spec = self._reduction_specs[name]
-            if spec == "sum":
-                reduced = incoming + local
-            elif spec == "mean":
-                reduced = ((self._update_count - 1) * incoming + local) / self._update_count
-            elif spec == "max":
-                reduced = jnp.maximum(incoming, local)
-            elif spec == "min":
-                reduced = jnp.minimum(incoming, local)
+            if spec in ("sum", "mean", "max", "min"):
+                reduced = self._merge_leaf(spec, incoming, local, self._update_count)
             elif spec == "cat":
                 reduced = incoming + local if isinstance(incoming, list) else jnp.concatenate([incoming, local])
             elif spec is None and isinstance(incoming, list):
@@ -535,8 +627,11 @@ class Metric(ABC):
             self._persistent[name] = mode
 
     def __getstate__(self) -> Dict[str, Any]:
-        # drop the wrapped bound methods; re-wrapped on unpickle (reference `metric.py:568-577`)
-        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute")}
+        # drop the wrapped bound methods (re-wrapped on unpickle, reference
+        # `metric.py:568-577`) and the fused-forward machinery (jit closures
+        # don't pickle/deepcopy; rebuilt lazily on first fused call)
+        drop = ("update", "compute", "_fused_forward", "_fused_template")
+        return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
@@ -546,6 +641,22 @@ class Metric(ABC):
     def __setattr__(self, name: str, value: Any) -> None:
         if name in ("higher_is_better", "is_differentiable", "full_state_update"):
             raise RuntimeError(f"Can't change const `{name}`.")
+        # mutating a non-state attribute (a hyperparameter like `threshold`)
+        # invalidates the fused forward program: its trace baked in the old
+        # value, and the next fused call would both ignore the change and
+        # overwrite it from the stale template. States and private attrs
+        # mutate every step and are part of the program's inputs, not its
+        # constants. The _fused_applying flag exempts the program's own
+        # static-attr write-back.
+        if (
+            not name.startswith("_")
+            and self.__dict__.get("_fused_forward") is not None
+            and not self.__dict__.get("_fused_applying", False)
+            and name not in self.__dict__.get("_defaults", {})
+            and name not in ("update", "compute")
+        ):
+            object.__setattr__(self, "_fused_forward", None)
+            object.__setattr__(self, "_fused_template", None)
         object.__setattr__(self, name, value)
 
     def __hash__(self) -> int:

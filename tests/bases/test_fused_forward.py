@@ -1,0 +1,146 @@
+"""The fused (single-dispatch) forward path: semantics pinned vs eager.
+
+After the first always-eager call, fusable metrics run forward as ONE jitted
+program (batch update + batch compute + state merge). These tests require
+bit-level agreement with the eager path across every reduction spec, the
+documented fallbacks (list states, validation mode "full"), inferred-attr
+propagation, and pickling after fused use.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utils import checks
+
+RNG = np.random.RandomState(2)
+BATCHES = [
+    (jnp.asarray(RNG.rand(64).astype(np.float32)), jnp.asarray(RNG.randint(0, 2, 64)))
+    for _ in range(5)
+]
+
+
+@pytest.fixture(autouse=True)
+def _first_mode():
+    checks.set_validation_mode("first")
+    yield
+    checks.set_validation_mode("full")
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda: mt.Accuracy(),                      # sum states
+        lambda: mt.MeanMetric(),                    # mean state
+        lambda: mt.MaxMetric(),                     # max state
+        lambda: mt.MinMetric(),                     # min state
+        lambda: mt.MeanSquaredError(),              # sum + count
+        lambda: mt.F1Score(num_classes=1, average="macro"),
+    ],
+    ids=["Accuracy", "MeanMetric", "MaxMetric", "MinMetric", "MSE", "F1"],
+)
+def test_fused_equals_eager(factory):
+    fused = factory()
+    eager = factory()
+    eager._fused_forward_ok = False  # force the reference eager path
+
+    single_input = factory().update.__wrapped__.__code__.co_argcount == 2
+
+    for p, t in BATCHES:
+        args = (p,) if single_input else (p, t)
+        np.testing.assert_allclose(
+            np.asarray(fused(*args)), np.asarray(eager(*args)), atol=1e-6
+        )
+    np.testing.assert_allclose(np.asarray(fused.compute()), np.asarray(eager.compute()), atol=1e-6)
+    # the fused path really engaged (first call is eager by design)
+    assert fused._fused_forward is not None
+
+
+def test_list_state_metric_falls_back():
+    metric = mt.CatMetric()
+    for p, _ in BATCHES:
+        metric(p)
+    assert metric._fused_forward_ok is False  # tried once, disabled
+    assert np.asarray(metric.compute()).shape == (len(BATCHES) * 64,)
+
+
+def test_full_validation_mode_keeps_eager_checks():
+    checks.set_validation_mode("full")
+    metric = mt.Accuracy()
+    p, t = BATCHES[0]
+    metric(p, t)
+    metric(p, t)
+    assert metric._fused_forward is None  # never fused in full mode
+    with pytest.raises(ValueError, match="non-negative"):
+        metric(p, jnp.asarray([-1] * 64))
+
+
+def test_inferred_attrs_propagate_through_fused_forward():
+    """Accuracy infers its input mode from the first batch; forward-only usage
+    followed by compute() must still see it after fused calls."""
+    rng = np.random.RandomState(0)
+    probs = rng.rand(4, 32, 5).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    targets = rng.randint(0, 5, (4, 32))
+    metric = mt.Accuracy(num_classes=5, average="macro")
+    for i in range(4):
+        metric(jnp.asarray(probs[i]), jnp.asarray(targets[i]))
+    assert metric._fused_forward is not None
+    want = mt.Accuracy(num_classes=5, average="macro")
+    for i in range(4):
+        want.update(jnp.asarray(probs[i]), jnp.asarray(targets[i]))
+    np.testing.assert_allclose(float(metric.compute()), float(want.compute()), atol=1e-6)
+
+
+def test_pickle_and_clone_after_fused_use():
+    metric = mt.Accuracy()
+    for p, t in BATCHES:
+        metric(p, t)
+    assert metric._fused_forward is not None
+    clone = pickle.loads(pickle.dumps(metric))
+    assert clone._fused_forward is None  # machinery dropped, rebuilt lazily
+    p, t = BATCHES[0]
+    clone(p, t)
+    clone(p, t)
+    assert clone._fused_forward is not None  # rebuilt
+    deep = metric.clone()
+    deep(p, t)
+
+
+def test_bad_input_error_still_surfaces_and_does_not_disable_fusion():
+    metric = mt.Accuracy()
+    p, t = BATCHES[0]
+    metric(p, t)
+    metric(p, t)  # fused engaged
+    assert metric._fused_forward is not None
+    with pytest.raises(ValueError):
+        metric(jnp.zeros((3,)), jnp.zeros((4,), jnp.int32))  # shape mismatch
+    assert metric._fused_forward_ok is True  # input error, not a fusion defect
+    metric(p, t)  # keeps working fused
+
+
+def test_hyperparameter_mutation_invalidates_fused_program():
+    """Mutating a public hyperparameter after fusion engaged must take effect
+    (the old trace baked in the previous value) and must not be reverted by
+    the template write-back (review regression)."""
+    p, t = BATCHES[0]
+    metric = mt.Accuracy()
+    metric(p, t)
+    metric(p, t)
+    assert metric._fused_forward is not None
+    metric.threshold = 0.9
+    assert metric._fused_forward is None  # program invalidated
+    got = float(metric(p, t))
+    assert metric.threshold == 0.9  # not reverted
+    eager = mt.Accuracy(threshold=0.9)
+    eager._fused_forward_ok = False
+    want = float(eager(p, t))
+    assert got == pytest.approx(want, abs=1e-6)
+    # and fusion re-engages with the new value baked in
+    metric(p, t)
+    assert metric._fused_forward is not None
+    assert float(metric(p, t)) == pytest.approx(want, abs=1e-6)
