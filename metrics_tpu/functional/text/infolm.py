@@ -136,6 +136,22 @@ def _sentence_distribution(
     input_ids = enc["input_ids"]
     attention_mask = enc["attention_mask"]
     batch, _ = input_ids.shape
+    # special tokens ([CLS]/[SEP]/pad) carry zero aggregation weight — the
+    # reference's token mask (`functional/text/infolm.py:351-371`): the
+    # per-sentence distribution averages over real word positions only
+    special_ids = [
+        tid
+        for tid in (tokenizer.pad_token_id, tokenizer.sep_token_id, tokenizer.cls_token_id)
+        if tid is not None
+    ]
+    token_mask = attention_mask.astype(bool) & ~np.isin(input_ids, special_ids)
+    # an empty sentence tokenizes to specials only (all-zero row): fall back
+    # to the attention mask so its distribution stays a finite probability
+    # vector instead of zeros that NaN every divergence downstream (the
+    # reference NaNs here; a defined value keeps corpus means usable)
+    empty_rows = ~token_mask.any(axis=1)
+    if empty_rows.any():
+        token_mask = np.where(empty_rows[:, None], attention_mask.astype(bool), token_mask)
     # only mask positions holding a real token somewhere in the batch; correct
     # for either tokenizer padding side, and skips always-padding positions
     # (their weight is zero, so dropping them is exact)
@@ -168,7 +184,7 @@ def _sentence_distribution(
         dist = jnp.stack(distributions, axis=1)  # (b, n_real_positions, V)
 
         w = jnp.asarray(idf_w[start : start + batch_size][:, real_positions])
-        w = w * am_c[:, jnp.asarray(real_positions)].astype(jnp.float32)
+        w = w * jnp.asarray(token_mask[start : start + batch_size][:, real_positions], jnp.float32)
         w = w / jnp.clip(w.sum(axis=1, keepdims=True), min=1e-12)
         chunks.append(jnp.einsum("bl,blv->bv", w, dist))
     return jnp.concatenate(chunks, axis=0)

@@ -269,7 +269,17 @@ class Metric(ABC):
     _fused_forward: Optional[Callable] = None
     _fused_template: Optional["Metric"] = None
     _fused_forward_ok: bool = True
-    _forward_seen_once: bool = False
+    _fused_seen_signatures: Optional[set] = None
+    _FUSED_SIG_CAP = 4096
+
+    @staticmethod
+    def _forward_signature(args: tuple, kwargs: dict) -> tuple:
+        def leaf(a: Any):
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                return (tuple(a.shape), str(a.dtype))
+            return repr(a)
+
+        return tuple(leaf(a) for a in args) + tuple((k, leaf(v)) for k, v in sorted(kwargs.items()))
 
     def _build_fused_forward(self) -> Callable:
         """One jitted program for the whole reduce-path forward: batch update
@@ -312,14 +322,21 @@ class Metric(ABC):
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Single-update fast path: batch state is merged into global state.
 
-        After the first (always eager, fully validated) call, metrics with
-        fusable states run the whole step as one jitted program — unless the
-        validation mode is "full", which asks for per-update value checks that
-        a traced program cannot perform.
+        The first call PER INPUT SIGNATURE is always eager and fully
+        validated (preserving validation mode "first"'s per-signature
+        contract — and costing nothing, since a new signature would retrace
+        the fused program anyway); subsequent same-signature calls on metrics
+        with fusable states run the whole step as one jitted program — unless
+        the validation mode is "full", which asks for per-update value checks
+        that a traced program cannot perform.
         """
         from metrics_tpu.utils.checks import _get_validation_mode
 
-        if self._fused_forward_ok and self._forward_seen_once and _get_validation_mode() != "full":
+        if self._fused_seen_signatures is None:
+            self._fused_seen_signatures = set()
+        signature = self._forward_signature(args, kwargs)
+        seen = signature in self._fused_seen_signatures
+        if self._fused_forward_ok and seen and _get_validation_mode() != "full":
             try:
                 if self._fused_forward is None:
                     self._fused_forward = self._build_fused_forward()
@@ -337,11 +354,9 @@ class Metric(ABC):
                 return result
             for name, value in merged.items():
                 setattr(self, name, value)
-            self._fused_applying = True
-            try:
-                _propagate_static_attrs(self._fused_template, self)
-            finally:
-                self._fused_applying = False
+            # writes via object.__setattr__, so it cannot re-trigger the
+            # fused-program invalidation in our __setattr__
+            _propagate_static_attrs(self._fused_template, self)
             self._update_count += 1
             self._is_synced = False
             self._should_unsync = True
@@ -349,7 +364,9 @@ class Metric(ABC):
             self._computed = None
             return batch_val
         result = self._forward_reduce_state_update_eager(*args, **kwargs)
-        self._forward_seen_once = True
+        self._fused_seen_signatures.add(signature)
+        while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
+            self._fused_seen_signatures.pop()
         return result
 
     def _forward_reduce_state_update_eager(self, *args: Any, **kwargs: Any) -> Any:
@@ -361,17 +378,25 @@ class Metric(ABC):
         self._should_unsync = False
         compute_on_cpu, self.compute_on_cpu = self.compute_on_cpu, False
 
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
-
-        self._update_count = update_count + 1
-        self._reduce_states(global_state)
-
-        self._is_synced = False
-        self._should_unsync = True
-        self._to_sync = self.sync_on_compute
-        self._computed = None
-        self.compute_on_cpu = compute_on_cpu
+        try:
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
+            self._update_count = update_count + 1
+            self._reduce_states(global_state)
+        except Exception:
+            # a bad batch must not destroy accumulated history: the reset
+            # above zeroed the states, so put the snapshot back before
+            # surfacing the error (callers that catch and continue keep a
+            # consistent metric)
+            self._restore_state(global_state)
+            self._update_count = update_count
+            raise
+        finally:
+            self._is_synced = False
+            self._should_unsync = True
+            self._to_sync = self.sync_on_compute
+            self._computed = None
+            self.compute_on_cpu = compute_on_cpu
         return batch_val
 
     @staticmethod
@@ -646,12 +671,11 @@ class Metric(ABC):
         # value, and the next fused call would both ignore the change and
         # overwrite it from the stale template. States and private attrs
         # mutate every step and are part of the program's inputs, not its
-        # constants. The _fused_applying flag exempts the program's own
-        # static-attr write-back.
+        # constants. (The program's own static-attr write-back uses
+        # object.__setattr__ and never reaches this guard.)
         if (
             not name.startswith("_")
             and self.__dict__.get("_fused_forward") is not None
-            and not self.__dict__.get("_fused_applying", False)
             and name not in self.__dict__.get("_defaults", {})
             and name not in ("update", "compute")
         ):

@@ -144,3 +144,41 @@ def test_hyperparameter_mutation_invalidates_fused_program():
     metric(p, t)
     assert metric._fused_forward is not None
     assert float(metric(p, t)) == pytest.approx(want, abs=1e-6)
+
+
+def test_new_signature_gets_eager_validation():
+    """'first' mode validates the FIRST update of each input signature; a new
+    batch shape arriving after fusion engaged must still be value-checked
+    (review regression: the fused program can't check values)."""
+    metric = mt.Accuracy()
+    p, t = BATCHES[0]
+    metric(p, t)
+    metric(p, t)  # fused for the (64,) signature
+    assert metric._fused_forward is not None
+    bad = jnp.asarray([-1] * 128)
+    with pytest.raises(ValueError, match="non-negative"):
+        metric(jnp.asarray(np.random.rand(128).astype(np.float32)), bad)
+    # and a GOOD new signature works eagerly once, then fuses
+    p2 = jnp.asarray(np.random.rand(128).astype(np.float32))
+    t2 = jnp.asarray(np.random.randint(0, 2, 128))
+    metric(p2, t2)
+    metric(p2, t2)
+
+
+def test_bad_batch_preserves_accumulated_state():
+    """A malformed batch must not wipe history (review regression: the eager
+    forward resets before updating; the snapshot must come back on error)."""
+    metric = mt.Accuracy()
+    eager = mt.Accuracy()
+    eager._fused_forward_ok = False
+    for p, t in BATCHES:
+        metric(p, t)
+        eager(p, t)
+    for m in (metric, eager):
+        with pytest.raises(ValueError):
+            m(jnp.zeros((3,)), jnp.zeros((4,), jnp.int32))
+    np.testing.assert_allclose(float(metric.compute()), float(eager.compute()), atol=1e-6)
+    want = mt.Accuracy()
+    for p, t in BATCHES:
+        want.update(p, t)
+    np.testing.assert_allclose(float(metric.compute()), float(want.compute()), atol=1e-6)
