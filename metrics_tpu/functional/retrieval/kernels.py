@@ -19,11 +19,15 @@ def _check_retrieval_functional_inputs(preds, target, allow_non_binary_target: b
     if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
         raise ValueError("`preds` must be a tensor of floats")
     t = jnp.asarray(target)
-    if not (jnp.issubdtype(t.dtype, jnp.integer) or t.dtype == jnp.bool_):
-        if not allow_non_binary_target or not jnp.issubdtype(t.dtype, jnp.floating):
-            raise ValueError("`target` must be a tensor of booleans or integers")
-    if not allow_non_binary_target and not isinstance(t, jax.core.Tracer) and t.size and int(t.max()) > 1:
-        raise ValueError("`target` must contain binary values")
+    if not (
+        jnp.issubdtype(t.dtype, jnp.integer) or t.dtype == jnp.bool_ or jnp.issubdtype(t.dtype, jnp.floating)
+    ):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    # float relevance is allowed like the reference (`utilities/checks.py:507-527`):
+    # the "binary" requirement constrains VALUES to [0, 1], not the dtype
+    if not allow_non_binary_target and not isinstance(t, jax.core.Tracer) and t.size:
+        if float(t.max()) > 1 or float(t.min()) < 0:
+            raise ValueError("`target` must contain binary values")
     return jnp.asarray(preds, dtype=jnp.float32), t
 
 
@@ -40,7 +44,9 @@ def retrieval_average_precision(preds, target) -> jax.Array:
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     order = jnp.argsort(-preds, stable=True)
-    rel = target[order].astype(jnp.float32)
+    # positions binarize via > 0 like the reference (`average_precision.py:46`)
+    # — fractional float relevances count as hits here, not as weights
+    rel = (target[order] > 0).astype(jnp.float32)
     ranks = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
     precision_at_i = jnp.cumsum(rel) / ranks
     denom = jnp.maximum(rel.sum(), 1.0)

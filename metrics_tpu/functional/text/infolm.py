@@ -145,17 +145,6 @@ def _sentence_distribution(
         if tid is not None
     ]
     token_mask = attention_mask.astype(bool) & ~np.isin(input_ids, special_ids)
-    # an empty sentence tokenizes to specials only (all-zero row): fall back
-    # to the attention mask so its distribution stays a finite probability
-    # vector instead of zeros that NaN every divergence downstream (the
-    # reference NaNs here; a defined value keeps corpus means usable)
-    empty_rows = ~token_mask.any(axis=1)
-    if empty_rows.any():
-        token_mask = np.where(empty_rows[:, None], attention_mask.astype(bool), token_mask)
-    # only mask positions holding a real token somewhere in the batch; correct
-    # for either tokenizer padding side, and skips always-padding positions
-    # (their weight is zero, so dropping them is exact)
-    real_positions = np.nonzero(attention_mask.any(axis=0))[0] if batch else np.zeros((0,), dtype=np.int64)
     mask_token_id = tokenizer.mask_token_id
 
     if idf:
@@ -170,6 +159,21 @@ def _sentence_distribution(
     else:
         idf_w = np.ones_like(input_ids, dtype=np.float32)
 
+    # final per-position aggregation weights. Rows whose weights are all zero
+    # (an empty sentence tokenizes to specials only — and under idf even the
+    # attention-mask fallback would zero out, since [CLS]/[SEP] appear in
+    # every document) fall back to uniform weights over the attended
+    # positions, keeping the sentence distribution a finite probability
+    # vector instead of zeros that NaN every divergence downstream (the
+    # reference NaNs here; a defined value keeps corpus means usable)
+    weights = idf_w * token_mask
+    dead_rows = ~(weights > 0).any(axis=1)
+    if dead_rows.any():
+        weights = np.where(dead_rows[:, None], attention_mask.astype(np.float32), weights)
+    # only pay a masked-LM forward for positions some row actually weights
+    # (always-special columns like [CLS] carry zero weight batch-wide)
+    real_positions = np.nonzero((weights > 0).any(axis=0))[0] if batch else np.zeros((0,), dtype=np.int64)
+
     chunks = []
     for start in range(0, batch, batch_size):
         ids_c = input_ids[start : start + batch_size]
@@ -183,8 +187,7 @@ def _sentence_distribution(
             distributions.append(probs)
         dist = jnp.stack(distributions, axis=1)  # (b, n_real_positions, V)
 
-        w = jnp.asarray(idf_w[start : start + batch_size][:, real_positions])
-        w = w * jnp.asarray(token_mask[start : start + batch_size][:, real_positions], jnp.float32)
+        w = jnp.asarray(weights[start : start + batch_size][:, real_positions])
         w = w / jnp.clip(w.sum(axis=1, keepdims=True), min=1e-12)
         chunks.append(jnp.einsum("bl,blv->bv", w, dist))
     return jnp.concatenate(chunks, axis=0)

@@ -244,25 +244,37 @@ class Metric(ABC):
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Two-update path: metrics whose update depends on pre-existing state."""
-        self.update(*args, **kwargs)
-        update_count = self._update_count
+        entry_state = self._state_snapshot()
+        entry_count = self._update_count
+        compute_on_cpu = self.compute_on_cpu
+        try:
+            self.update(*args, **kwargs)
+            update_count = self._update_count
 
-        self._to_sync = self.dist_sync_on_step
-        self._should_unsync = False
-        compute_on_cpu, self.compute_on_cpu = self.compute_on_cpu, False
+            self._to_sync = self.dist_sync_on_step
+            self._should_unsync = False
+            self.compute_on_cpu = False
 
-        cache = self._state_snapshot()
-        self.reset()
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
+            cache = self._state_snapshot()
+            self.reset()
+            self.update(*args, **kwargs)
+            batch_val = self.compute()
 
-        self._restore_state(cache)
-        self._update_count = update_count
-        self._is_synced = False
-        self._should_unsync = True
-        self._to_sync = self.sync_on_compute
-        self._computed = None
-        self.compute_on_cpu = compute_on_cpu
+            self._restore_state(cache)
+            self._update_count = update_count
+        except Exception:
+            # a bad batch must not corrupt accumulated history (the first
+            # update may have partially mutated it, and the reset below it
+            # zeroes everything): restore the entry snapshot before surfacing
+            self._restore_state(entry_state)
+            self._update_count = entry_count
+            raise
+        finally:
+            self._is_synced = False
+            self._should_unsync = True
+            self._to_sync = self.sync_on_compute
+            self._computed = None
+            self.compute_on_cpu = compute_on_cpu
         return batch_val
 
     # class-level defaults so unpickled/copied instances lazily rebuild
@@ -277,7 +289,10 @@ class Metric(ABC):
         def leaf(a: Any):
             if hasattr(a, "shape") and hasattr(a, "dtype"):
                 return (tuple(a.shape), str(a.dtype))
-            return repr(a)
+            r = repr(a)
+            # long non-array reprs are hashed, not retained (the signature
+            # set would otherwise pin arbitrarily large strings)
+            return r if len(r) <= 64 else hash(r)
 
         return tuple(leaf(a) for a in args) + tuple((k, leaf(v)) for k, v in sorted(kwargs.items()))
 
@@ -332,11 +347,21 @@ class Metric(ABC):
         """
         from metrics_tpu.utils.checks import _get_validation_mode
 
+        fusable = (
+            self._fused_forward_ok
+            and _get_validation_mode() != "full"
+            and not any(isinstance(v, list) for v in self._defaults.values())
+        )
+        if not fusable:
+            # permanently-unfusable metrics (and mode "full") skip the
+            # signature bookkeeping entirely — no repr of text batches, no
+            # retained signature strings, just the eager path
+            return self._forward_reduce_state_update_eager(*args, **kwargs)
         if self._fused_seen_signatures is None:
             self._fused_seen_signatures = set()
         signature = self._forward_signature(args, kwargs)
         seen = signature in self._fused_seen_signatures
-        if self._fused_forward_ok and seen and _get_validation_mode() != "full":
+        if seen:
             try:
                 if self._fused_forward is None:
                     self._fused_forward = self._build_fused_forward()

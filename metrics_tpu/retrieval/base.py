@@ -61,7 +61,10 @@ class GroupedRows:
         fall-out kernel and the empty-group validity check)."""
         cached = self.__dict__.get("_n_neg")
         if cached is None:
-            nonrel = 1.0 - (self.rel > 0).astype(jnp.float32)
+            # RAW 1 - relevance, like the reference (`fall_out.py:56`): with
+            # graded float targets, partial relevance contributes partial
+            # non-relevance — both in the kernel and in the empty-group check
+            nonrel = 1.0 - self.rel.astype(jnp.float32)
             cached = segment_sum(nonrel, self.seg, self.num_groups)
             object.__setattr__(self, "_n_neg", cached)
         return cached

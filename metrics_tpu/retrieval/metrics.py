@@ -22,10 +22,15 @@ class RetrievalMAP(RetrievalMetric):
     """Mean average precision over queries."""
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
-        # AP = sum_ranks rel * (cumrel / rank) / n_pos
-        terms = ctx.rel * ctx.cumrel / ctx.ranks.astype(jnp.float32)
+        # AP = sum_ranks hit * (cumhits / rank) / n_hits, with hits BINARIZED
+        # via > 0 like the reference (`average_precision.py:46`) — graded
+        # float relevances count as hits here, not as weights
+        rel_bin = (ctx.rel > 0).astype(jnp.float32)
+        cum_bin = segment_cumsum(rel_bin, ctx.seg, ctx.num_groups)
+        terms = rel_bin * cum_bin / ctx.ranks.astype(jnp.float32)
         ap_sum = segment_sum(terms, ctx.seg, ctx.num_groups)
-        return jnp.where(ctx.n_pos > 0, ap_sum / jnp.maximum(ctx.n_pos, 1.0), 0.0)
+        n_hits = segment_sum(rel_bin, ctx.seg, ctx.num_groups)
+        return jnp.where(n_hits > 0, ap_sum / jnp.maximum(n_hits, 1.0), 0.0)
 
 
 class RetrievalMRR(RetrievalMetric):
@@ -110,7 +115,8 @@ class RetrievalFallOut(_RetrievalKMetric):
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         kv = ctx.k_eff(self.k)
-        nonrel = 1.0 - (ctx.rel > 0).astype(jnp.float32)
+        # raw 1 - relevance (reference `fall_out.py:56`), matching n_neg
+        nonrel = 1.0 - ctx.rel.astype(jnp.float32)
         cum_nonrel = segment_cumsum(nonrel, ctx.seg, ctx.num_groups)
         n_neg = ctx.n_neg()
         found = cum_nonrel[ctx.idx_at(kv)]

@@ -441,8 +441,9 @@ def _check_retrieval_inputs(
         raise ValueError("`indexes` must be a tensor of long integers")
     if not jnp.issubdtype(preds.dtype, jnp.floating):
         raise ValueError("`preds` must be a tensor of floats")
-    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
-        raise ValueError("`target` must be a tensor of booleans or integers")
+    target_is_float = jnp.issubdtype(target.dtype, jnp.floating)
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_ or target_is_float):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
 
     indexes = indexes.reshape(-1)
     preds = preds.reshape(-1).astype(jnp.float32)
@@ -456,10 +457,18 @@ def _check_retrieval_inputs(
     if preds.size == 0:
         raise ValueError("`indexes`, `preds` and `target` must be non-empty")
 
-    if _is_concrete(target) and not allow_non_binary_target and target.size and int(target.max()) > 1:
-        raise ValueError("`target` must contain binary values")
+    # float relevance targets are allowed like the reference
+    # (`utilities/checks.py:507-527`): the "binary" requirement constrains
+    # VALUES to [0, 1], not the dtype
+    if _is_concrete(target) and not allow_non_binary_target and target.size:
+        if float(target.max()) > 1 or float(target.min()) < 0:
+            raise ValueError("`target` must contain binary values")
 
-    return indexes.astype(jnp.int32) if indexes.dtype != jnp.int64 else indexes, preds, target.astype(jnp.int32)
+    if target_is_float:
+        target = target.astype(jnp.float32)
+    else:
+        target = target.astype(jnp.int32)
+    return indexes.astype(jnp.int32) if indexes.dtype != jnp.int64 else indexes, preds, target
 
 
 def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:

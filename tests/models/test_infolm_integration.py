@@ -84,3 +84,16 @@ def test_injection_requires_pair(tiny_mlm):
     model, _ = tiny_mlm
     with pytest.raises(ValueError, match="together"):
         infolm(["a"], ["a"], model=model)
+
+
+def test_empty_sentence_stays_finite_with_idf(tiny_mlm):
+    """Empty hypotheses must not NaN the corpus score even under idf, where
+    the attention-mask fallback alone would still zero out ([CLS]/[SEP]
+    appear in every document so their idf weight is 0) — review regression."""
+    model, tokenizer = tiny_mlm
+    for idf in (False, True):
+        score = infolm(
+            ["", "a dog ran"], ["the cat sat", "a dog ran fast"],
+            model=model, user_tokenizer=tokenizer, max_length=16, idf=idf,
+        )
+        assert np.isfinite(float(np.asarray(score))), f"idf={idf}"

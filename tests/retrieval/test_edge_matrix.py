@@ -1,0 +1,159 @@
+"""Constructed retrieval corner cases vs the mounted reference.
+
+The grouping engine's deliberate degenerate inputs: queries with no positive
+documents crossed with every `empty_target_action`, all-positive queries,
+single-document queries, heavily tied scores, and `ignore_index` row
+filtering — each cell runs identical data through both stacks.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+_METRICS = ["RetrievalMAP", "RetrievalMRR", "RetrievalNormalizedDCG", "RetrievalHitRate", "RetrievalRPrecision"]
+
+
+def _run_pair(name, idx, preds, target, our_kwargs=None, ref_kwargs=None):
+    our_kwargs = our_kwargs or {}
+    ours = getattr(mt, name)(**our_kwargs)
+    ref = getattr(_ref, name)(**(ref_kwargs if ref_kwargs is not None else our_kwargs))
+    ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(idx))
+    ours_val, ref_val = ours.compute(), ref.compute()
+    np.testing.assert_allclose(np.asarray(ours_val), np.asarray(ref_val), atol=1e-6)
+
+
+RNG = np.random.RandomState(3)
+# query 0: no positives; query 1: mixed; query 2: all positive; query 3: single doc
+IDX = np.asarray([0, 0, 0, 1, 1, 1, 1, 2, 2, 3], dtype=np.int64)
+PREDS = RNG.rand(10).astype(np.float32)
+TARGET = np.asarray([0, 0, 0, 1, 0, 1, 0, 1, 1, 1], dtype=np.int64)
+
+
+class TestEmptyTargetAction:
+    @pytest.mark.parametrize("metric", _METRICS)
+    @pytest.mark.parametrize("action", ["skip", "neg", "pos"])
+    def test_matches_reference(self, metric, action):
+        _run_pair(metric, IDX, PREDS, TARGET, {"empty_target_action": action})
+
+    @pytest.mark.parametrize("metric", _METRICS)
+    def test_error_action_raises_in_both(self, metric):
+        ours = getattr(mt, metric)(empty_target_action="error")
+        ref = getattr(_ref, metric)(empty_target_action="error")
+        ours.update(jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(IDX))
+        ref.update(torch.tensor(PREDS), torch.tensor(TARGET), indexes=torch.tensor(IDX))
+        with pytest.raises(ValueError):
+            ours.compute()
+        with pytest.raises(ValueError):
+            ref.compute()
+
+    def test_all_queries_empty_skip(self):
+        """Every query empty + skip: the reference returns 0.0."""
+        idx = np.asarray([0, 0, 1, 1], dtype=np.int64)
+        preds = RNG.rand(4).astype(np.float32)
+        target = np.zeros(4, dtype=np.int64)
+        _run_pair("RetrievalMAP", idx, preds, target, {"empty_target_action": "skip"})
+
+
+class TestDegenerateGroups:
+    @pytest.mark.parametrize("metric", _METRICS)
+    def test_single_document_queries(self, metric):
+        idx = np.arange(6, dtype=np.int64)  # six queries of one doc each
+        preds = RNG.rand(6).astype(np.float32)
+        target = np.asarray([1, 0, 1, 1, 0, 1], dtype=np.int64)
+        _run_pair(metric, idx, preds, target, {"empty_target_action": "skip"})
+
+    @pytest.mark.parametrize("metric", _METRICS)
+    def test_fully_tied_scores(self, metric):
+        """All scores identical: ranking is order-of-appearance in both stacks."""
+        idx = np.asarray([0] * 6 + [1] * 6, dtype=np.int64)
+        preds = np.full(12, 0.5, dtype=np.float32)
+        target = np.asarray([1, 0, 0, 1, 0, 1] * 2, dtype=np.int64)
+        _run_pair(metric, idx, preds, target)
+
+    @pytest.mark.parametrize("metric", _METRICS)
+    def test_interleaved_query_ids(self, metric):
+        """Group ids arrive interleaved, unsorted, and non-contiguous."""
+        idx = np.asarray([7, 2, 7, 2, 7, 9, 2, 9], dtype=np.int64)
+        preds = RNG.rand(8).astype(np.float32)
+        target = np.asarray([1, 0, 0, 1, 1, 1, 0, 0], dtype=np.int64)
+        _run_pair(metric, idx, preds, target)
+
+
+class TestIgnoreIndex:
+    @pytest.mark.parametrize("metric", _METRICS)
+    def test_rows_filtered(self, metric):
+        """Rows whose target equals ignore_index drop before grouping."""
+        idx = np.asarray([0, 0, 0, 1, 1, 1], dtype=np.int64)
+        preds = RNG.rand(6).astype(np.float32)
+        target = np.asarray([1, -1, 0, -1, 1, 0], dtype=np.int64)
+        _run_pair(metric, idx, preds, target, {"ignore_index": -1, "empty_target_action": "skip"})
+
+    def test_ignoring_everything_raises_in_both(self):
+        """ignore_index filtering happens before the non-empty check: removing
+        every row raises at update in both stacks."""
+        idx = np.asarray([0, 0], dtype=np.int64)
+        preds = RNG.rand(2).astype(np.float32)
+        target = np.asarray([-1, -1], dtype=np.int64)
+        with pytest.raises(ValueError, match="non-empty"):
+            mt.RetrievalMAP(ignore_index=-1).update(
+                jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx)
+            )
+        with pytest.raises(ValueError, match="non-empty"):
+            _ref.RetrievalMAP(ignore_index=-1).update(
+                torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(idx)
+            )
+
+
+class TestValidationParity:
+    @pytest.mark.parametrize("metric", ["RetrievalMAP", "RetrievalMRR", "RetrievalPrecision", "RetrievalRecall", "RetrievalHitRate"])
+    def test_float_relevance_in_unit_interval_accepted(self, metric):
+        """The reference allows FLOAT relevance targets whose values lie in
+        [0, 1] (its binary check constrains values, not dtype); AP/MRR
+        binarize via > 0, precision/recall sum raw values. Same data, same
+        numbers, both stacks."""
+        idx = np.asarray([0, 0, 0, 1, 1, 1], dtype=np.int64)
+        preds = RNG.rand(6).astype(np.float32)
+        target = np.asarray([0.3, 0.0, 0.7, 1.0, 0.0, 0.5], dtype=np.float32)
+        _run_pair(metric, idx, preds, target)
+
+    def test_float_target_above_one_rejected_in_both(self):
+        preds = jnp.asarray([0.5, 0.2])
+        bad = jnp.asarray([1.5, 0.7])
+        with pytest.raises(ValueError, match="binary"):
+            mt.RetrievalMAP().update(preds, bad, indexes=jnp.asarray([0, 0]))
+        with pytest.raises(ValueError, match="binary"):
+            _ref.RetrievalMAP().update(
+                torch.tensor([0.5, 0.2]), torch.tensor([1.5, 0.7]), indexes=torch.tensor([0, 0])
+            )
+
+    def test_missing_indexes_rejected_in_both(self):
+        with pytest.raises(ValueError):
+            mt.RetrievalMAP().update(jnp.asarray([0.5]), jnp.asarray([1]), indexes=None)
+        with pytest.raises(ValueError):
+            _ref.RetrievalMAP().update(torch.tensor([0.5]), torch.tensor([1]), indexes=None)
+
+
+def test_fall_out_float_relevance_raw_semantics():
+    """FallOut with graded float targets uses RAW 1 - relevance (reference
+    `fall_out.py:56`): partial relevance contributes partial non-relevance —
+    module, functional, and reference must all agree (review regression)."""
+    idx = np.asarray([0, 0], dtype=np.int64)
+    preds = np.asarray([0.9, 0.1], dtype=np.float32)
+    target = np.asarray([0.5, 0.0], dtype=np.float32)
+    _run_pair("RetrievalFallOut", idx, preds, target, {"k": 1})
+    from metrics_tpu.functional import retrieval_fall_out
+
+    ours_fn = float(retrieval_fall_out(jnp.asarray(preds), jnp.asarray(target), k=1))
+    module = mt.RetrievalFallOut(k=1)
+    module.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    assert ours_fn == pytest.approx(float(module.compute()), abs=1e-6)
