@@ -130,10 +130,16 @@ def retrieval_hit_rate(preds, target, k: Optional[int] = None) -> jax.Array:
 
 
 def retrieval_r_precision(preds, target) -> jax.Array:
-    """Precision at R where R = number of relevant documents."""
+    """Precision at R where R = number of relevant documents.
+
+    Graded float relevances BINARIZE via > 0 for both R and the hit count
+    (like AP/MRR). Deliberate divergence: the reference crashes on float
+    targets here (its R indexes a slice with a float tensor); a defined
+    binarized value beats a TypeError.
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     order = jnp.argsort(-preds, stable=True)
-    rel = target[order].astype(jnp.float32)
+    rel = (target[order] > 0).astype(jnp.float32)
     r = rel.sum().astype(jnp.int32)
     n = rel.shape[0]
     mask = jnp.arange(n) < r

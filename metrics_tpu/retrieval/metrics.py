@@ -154,8 +154,13 @@ class RetrievalRPrecision(RetrievalMetric):
     """Mean R-precision over queries (precision at R = #relevant)."""
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
-        r = ctx.n_pos.astype(jnp.int32)
-        found = ctx.cumrel[ctx.idx_at(r)]
+        # graded float relevances binarize via > 0 for R and the hit count,
+        # like AP/MRR (deliberate divergence: the reference crashes on float
+        # targets here — see functional retrieval_r_precision)
+        rel_bin = (ctx.rel > 0).astype(jnp.float32)
+        cum_bin = segment_cumsum(rel_bin, ctx.seg, ctx.num_groups)
+        r = segment_sum(rel_bin, ctx.seg, ctx.num_groups).astype(jnp.int32)
+        found = cum_bin[ctx.idx_at(r)]
         return jnp.where(r > 0, found / jnp.maximum(r, 1).astype(jnp.float32), 0.0)
 
 

@@ -1,0 +1,161 @@
+"""Constructed regression corner cases vs the mounted reference.
+
+Degenerate numerics built on purpose: zero-variance inputs for the
+correlation family, heavy rank ties, sub-minimal sample counts, zero
+targets for percentage errors, zero vectors for cosine similarity, and
+negative-R2 regimes — identical data through both stacks.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+RNG = np.random.RandomState(17)
+
+
+def _run_pair(name, preds, target, our_kwargs=None, atol=1e-5, equal_nan=True):
+    our_kwargs = our_kwargs or {}
+    ours = getattr(mt, name)(**our_kwargs)
+    ref = getattr(_ref, name)(**our_kwargs)
+    ours.update(jnp.asarray(preds), jnp.asarray(target))
+    ref.update(torch.tensor(preds), torch.tensor(target))
+    np.testing.assert_allclose(
+        np.asarray(ours.compute(), np.float64),
+        np.asarray(ref.compute().numpy(), np.float64),
+        atol=atol,
+        rtol=1e-4,
+        equal_nan=equal_nan,
+    )
+
+
+class TestCorrelationDegenerates:
+    def test_pearson_constant_preds(self):
+        """Zero prediction variance: 0/0 correlation must agree (NaN-for-NaN)."""
+        preds = np.full(32, 2.5, dtype=np.float32)
+        target = RNG.randn(32).astype(np.float32)
+        _run_pair("PearsonCorrCoef", preds, target)
+
+    def test_pearson_constant_both(self):
+        preds = np.full(16, 1.0, dtype=np.float32)
+        target = np.full(16, 3.0, dtype=np.float32)
+        _run_pair("PearsonCorrCoef", preds, target)
+
+    def test_pearson_perfect_anticorrelation(self):
+        x = RNG.randn(64).astype(np.float32)
+        _run_pair("PearsonCorrCoef", x, (-x).astype(np.float32))
+
+    def test_pearson_two_samples(self):
+        _run_pair("PearsonCorrCoef", np.asarray([1.0, 2.0], np.float32), np.asarray([3.0, 1.0], np.float32))
+
+    def test_spearman_heavy_ties(self):
+        preds = np.asarray([1, 1, 1, 2, 2, 3, 3, 3, 3, 4] * 3, dtype=np.float32)
+        target = np.asarray([2, 1, 2, 2, 3, 1, 3, 2, 3, 4] * 3, dtype=np.float32)
+        _run_pair("SpearmanCorrCoef", preds, target)
+
+    def test_spearman_constant_target(self):
+        preds = RNG.randn(20).astype(np.float32)
+        target = np.zeros(20, dtype=np.float32)
+        _run_pair("SpearmanCorrCoef", preds, target)
+
+
+class TestR2Degenerates:
+    def test_r2_fewer_than_two_samples_raises_in_both(self):
+        ours = mt.R2Score()
+        ref = _ref.R2Score()
+        ours.update(jnp.asarray([1.0]), jnp.asarray([2.0]))
+        ref.update(torch.tensor([1.0]), torch.tensor([2.0]))
+        with pytest.raises(ValueError, match="Needs at least two samples"):
+            ours.compute()
+        with pytest.raises(ValueError, match="Needs at least two samples"):
+            ref.compute()
+
+    def test_r2_worse_than_mean_is_negative(self):
+        target = RNG.randn(64).astype(np.float32)
+        preds = (-3 * target + 5).astype(np.float32)
+        _run_pair("R2Score", preds, target)
+
+    def test_r2_constant_target(self):
+        """Zero target variance: both stacks divide by a zero total sum of
+        squares and must agree on the (infinite) result."""
+        preds = RNG.randn(32).astype(np.float32)
+        target = np.full(32, 4.0, dtype=np.float32)
+        _run_pair("R2Score", preds, target)
+
+    @pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+    def test_r2_multioutput_with_one_degenerate_column(self, multioutput):
+        preds = RNG.randn(32, 3).astype(np.float32)
+        target = RNG.randn(32, 3).astype(np.float32)
+        target[:, 1] = 7.0  # constant column
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _run_pair("R2Score", preds, target, {"multioutput": multioutput, "num_outputs": 3})
+
+    def test_adjusted_r2(self):
+        preds = RNG.randn(64).astype(np.float32)
+        target = (preds + 0.5 * RNG.randn(64)).astype(np.float32)
+        _run_pair("R2Score", preds, target, {"adjusted": 5})
+
+
+class TestPercentageErrors:
+    def test_mape_with_zero_targets(self):
+        """Zero targets exercise the epsilon-clamped denominator identically."""
+        preds = RNG.rand(16).astype(np.float32)
+        target = np.concatenate([np.zeros(4), RNG.rand(12)]).astype(np.float32)
+        _run_pair("MeanAbsolutePercentageError", preds, target, atol=1e-4)
+
+    def test_smape_with_opposite_signs(self):
+        preds = RNG.randn(32).astype(np.float32)
+        target = (-preds + 0.1 * RNG.randn(32)).astype(np.float32)
+        _run_pair("SymmetricMeanAbsolutePercentageError", preds, target, atol=1e-4)
+
+    def test_wmape_zero_target_sum(self):
+        preds = RNG.rand(8).astype(np.float32)
+        target = np.zeros(8, dtype=np.float32)
+        _run_pair("WeightedMeanAbsolutePercentageError", preds, target, atol=1e-4)
+
+
+class TestCosineDegenerates:
+    def test_zero_vector(self):
+        preds = np.zeros((4, 8), dtype=np.float32)
+        preds[1:] = RNG.randn(3, 8)
+        target = RNG.randn(4, 8).astype(np.float32)
+        _run_pair("CosineSimilarity", preds, target)
+
+    def test_antiparallel(self):
+        x = RNG.randn(4, 8).astype(np.float32)
+        _run_pair("CosineSimilarity", x, (-x).astype(np.float32))
+
+
+class TestStreamingConsistency:
+    """Many tiny batches must equal one big batch — the moment-accumulator
+    merge identities under extreme batch fragmentation."""
+
+    @pytest.mark.parametrize(
+        "name,kwargs",
+        [
+            ("PearsonCorrCoef", {}),
+            ("ExplainedVariance", {}),
+            ("R2Score", {}),
+            ("MeanSquaredError", {}),
+        ],
+    )
+    def test_one_sample_batches(self, name, kwargs):
+        preds = RNG.randn(32).astype(np.float32)
+        target = (preds + 0.3 * RNG.randn(32)).astype(np.float32)
+        big = getattr(mt, name)(**kwargs)
+        big.update(jnp.asarray(preds), jnp.asarray(target))
+        tiny = getattr(mt, name)(**kwargs)
+        for i in range(32):
+            tiny.update(jnp.asarray(preds[i : i + 1]), jnp.asarray(target[i : i + 1]))
+        np.testing.assert_allclose(float(big.compute()), float(tiny.compute()), atol=1e-4)

@@ -157,3 +157,27 @@ def test_fall_out_float_relevance_raw_semantics():
     module = mt.RetrievalFallOut(k=1)
     module.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
     assert ours_fn == pytest.approx(float(module.compute()), abs=1e-6)
+
+
+def test_r_precision_float_relevance_binarizes():
+    """RPrecision defines graded float relevance by binarizing hits via > 0
+    (documented divergence: the reference raises a TypeError indexing with a
+    float R on the same input). Module and functional must agree with the
+    integer-binarized ground truth."""
+    idx = np.asarray([0, 0, 0, 1, 1, 1], dtype=np.int64)
+    preds = RNG.rand(6).astype(np.float32)
+    graded = np.asarray([0.3, 0.0, 0.7, 1.0, 0.0, 0.5], dtype=np.float32)
+    binary = (graded > 0).astype(np.int64)
+
+    want = mt.RetrievalRPrecision()
+    want.update(jnp.asarray(preds), jnp.asarray(binary), indexes=jnp.asarray(idx))
+
+    got = mt.RetrievalRPrecision()
+    got.update(jnp.asarray(preds), jnp.asarray(graded), indexes=jnp.asarray(idx))
+    np.testing.assert_allclose(float(got.compute()), float(want.compute()), atol=1e-6)
+
+    from metrics_tpu.functional import retrieval_r_precision
+
+    fn_graded = float(retrieval_r_precision(jnp.asarray(preds[:3]), jnp.asarray(graded[:3])))
+    fn_binary = float(retrieval_r_precision(jnp.asarray(preds[:3]), jnp.asarray(binary[:3])))
+    assert fn_graded == pytest.approx(fn_binary, abs=1e-6)
