@@ -56,6 +56,31 @@ class GroupedRows:
         """Row index of rank ``kv`` (clamped to [1, count]) in each group."""
         return self.starts + jnp.clip(kv, 1, self.counts) - 1
 
+    def rel_bin(self) -> jax.Array:
+        """Per-row relevance BINARIZED via > 0 (memoized) — graded float
+        targets count as hits for the hit-counting metrics (AP/MRR/RPrec)."""
+        cached = self.__dict__.get("_rel_bin")
+        if cached is None:
+            cached = (self.rel > 0).astype(jnp.float32)
+            object.__setattr__(self, "_rel_bin", cached)
+        return cached
+
+    def cum_bin(self) -> jax.Array:
+        """Within-group inclusive cumsum of the binarized relevance (memoized)."""
+        cached = self.__dict__.get("_cum_bin")
+        if cached is None:
+            cached = segment_cumsum(self.rel_bin(), self.seg, self.num_groups)
+            object.__setattr__(self, "_cum_bin", cached)
+        return cached
+
+    def n_hits(self) -> jax.Array:
+        """Per-group count of binarized hits (memoized)."""
+        cached = self.__dict__.get("_n_hits")
+        if cached is None:
+            cached = segment_sum(self.rel_bin(), self.seg, self.num_groups)
+            object.__setattr__(self, "_n_hits", cached)
+        return cached
+
     def n_neg(self) -> jax.Array:
         """Per-group count of non-relevant rows (memoized — shared by the
         fall-out kernel and the empty-group validity check)."""

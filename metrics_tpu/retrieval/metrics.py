@@ -25,11 +25,9 @@ class RetrievalMAP(RetrievalMetric):
         # AP = sum_ranks hit * (cumhits / rank) / n_hits, with hits BINARIZED
         # via > 0 like the reference (`average_precision.py:46`) — graded
         # float relevances count as hits here, not as weights
-        rel_bin = (ctx.rel > 0).astype(jnp.float32)
-        cum_bin = segment_cumsum(rel_bin, ctx.seg, ctx.num_groups)
-        terms = rel_bin * cum_bin / ctx.ranks.astype(jnp.float32)
+        terms = ctx.rel_bin() * ctx.cum_bin() / ctx.ranks.astype(jnp.float32)
         ap_sum = segment_sum(terms, ctx.seg, ctx.num_groups)
-        n_hits = segment_sum(rel_bin, ctx.seg, ctx.num_groups)
+        n_hits = ctx.n_hits()
         return jnp.where(n_hits > 0, ap_sum / jnp.maximum(n_hits, 1.0), 0.0)
 
 
@@ -157,10 +155,8 @@ class RetrievalRPrecision(RetrievalMetric):
         # graded float relevances binarize via > 0 for R and the hit count,
         # like AP/MRR (deliberate divergence: the reference crashes on float
         # targets here — see functional retrieval_r_precision)
-        rel_bin = (ctx.rel > 0).astype(jnp.float32)
-        cum_bin = segment_cumsum(rel_bin, ctx.seg, ctx.num_groups)
-        r = segment_sum(rel_bin, ctx.seg, ctx.num_groups).astype(jnp.int32)
-        found = cum_bin[ctx.idx_at(r)]
+        r = ctx.n_hits().astype(jnp.int32)
+        found = ctx.cum_bin()[ctx.idx_at(r)]
         return jnp.where(r > 0, found / jnp.maximum(r, 1).astype(jnp.float32), 0.0)
 
 

@@ -1,0 +1,184 @@
+"""Constructed text corner cases vs the mounted reference.
+
+Degenerate strings built on purpose: empty hypotheses/references,
+whitespace-only input, single characters, exact matches, unicode,
+repetition (n-gram clipping), and hypotheses longer/shorter than every
+reference (brevity penalty edges) — identical data through both stacks.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu.functional as F  # noqa: E402
+
+
+def _close(ours, theirs, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(ours, np.float64), float(theirs), atol=atol, equal_nan=True)
+
+
+class TestBleuEdges:
+    def test_empty_hypothesis(self):
+        _close(F.bleu_score([""], [["the cat sat"]]), _ref.functional.bleu_score([""], [["the cat sat"]]))
+
+    def test_empty_reference(self):
+        _close(F.bleu_score(["the cat"], [[""]]), _ref.functional.bleu_score(["the cat"], [[""]]))
+
+    def test_exact_match_is_one(self):
+        sent = ["the quick brown fox jumps over the lazy dog"]
+        ours = F.bleu_score(sent, [[sent[0]]])
+        _close(ours, _ref.functional.bleu_score(sent, [[sent[0]]]))
+        assert float(np.asarray(ours)) == pytest.approx(1.0)
+
+    def test_hypothesis_shorter_than_ngram_order(self):
+        """2-word hypothesis under the default 4-gram order."""
+        _close(F.bleu_score(["the cat"], [["the cat sat on the mat"]]),
+               _ref.functional.bleu_score(["the cat"], [["the cat sat on the mat"]]))
+
+    @pytest.mark.parametrize("smooth", [False, True])
+    def test_repetition_clipping(self, smooth):
+        """'the the the...' exercises modified-precision clipping."""
+        preds = ["the the the the the the the"]
+        target = [["the cat is on the mat"]]
+        _close(F.bleu_score(preds, target, smooth=smooth),
+               _ref.functional.bleu_score(preds, target, smooth=smooth))
+
+    def test_brevity_penalty_long_hypothesis(self):
+        preds = ["a b c d e f g h i j k l m n o p"]
+        target = [["a b c d"]]
+        _close(F.bleu_score(preds, target), _ref.functional.bleu_score(preds, target))
+
+    @pytest.mark.parametrize("weights", [[1.0], [0.5, 0.5], [0.25, 0.25, 0.25, 0.25]])
+    def test_custom_weights(self, weights):
+        preds = ["the cat sat on the mat"]
+        target = [["a cat sat on the mat"]]
+        _close(F.bleu_score(preds, target, n_gram=len(weights), weights=weights),
+               _ref.functional.bleu_score(preds, target, n_gram=len(weights), weights=weights))
+
+
+class TestEditDistanceEdges:
+    @pytest.mark.parametrize("fn", ["word_error_rate", "match_error_rate", "word_information_lost", "char_error_rate"])
+    def test_exact_match_is_zero(self, fn):
+        sent = ["the quick brown fox"]
+        _close(getattr(F, fn)(sent, sent), getattr(_ref.functional, fn)(sent, sent))
+
+    @pytest.mark.parametrize("fn", ["word_error_rate", "char_error_rate"])
+    def test_empty_hypothesis(self, fn):
+        _close(getattr(F, fn)([""], ["the cat"]), getattr(_ref.functional, fn)([""], ["the cat"]))
+
+    def test_single_characters(self):
+        _close(F.char_error_rate(["a"], ["b"]), _ref.functional.char_error_rate(["a"], ["b"]))
+
+    def test_unicode(self):
+        preds = ["caffè résumé 日本語"]
+        target = ["caffé résumé 日本語 テスト"]
+        _close(F.char_error_rate(preds, target), _ref.functional.char_error_rate(preds, target))
+        _close(F.word_error_rate(preds, target), _ref.functional.word_error_rate(preds, target))
+
+    def test_completely_disjoint(self):
+        """WER above 1.0 when the hypothesis is longer and fully wrong."""
+        preds = ["x y z w v u"]
+        target = ["a b"]
+        _close(F.word_error_rate(preds, target), _ref.functional.word_error_rate(preds, target))
+
+
+class TestChrfEdges:
+    def test_empty_hypothesis(self):
+        _close(F.chrf_score([""], [["the cat"]]), _ref.functional.chrf_score([""], [["the cat"]]))
+
+    def test_whitespace_handling(self):
+        preds = ["  the   cat  "]
+        target = [["the cat"]]
+        _close(F.chrf_score(preds, target), _ref.functional.chrf_score(preds, target))
+
+    @pytest.mark.parametrize("beta", [0.5, 1.0, 3.0])
+    def test_beta_sweep(self, beta):
+        preds = ["the cat sat on a mat"]
+        target = [["the cat sat on the mat"]]
+        _close(F.chrf_score(preds, target, beta=beta), _ref.functional.chrf_score(preds, target, beta=beta))
+
+    def test_lowercase(self):
+        preds = ["The CAT Sat"]
+        target = [["the cat sat"]]
+        _close(F.chrf_score(preds, target, lowercase=True),
+               _ref.functional.chrf_score(preds, target, lowercase=True))
+        _close(F.chrf_score(preds, target, lowercase=False),
+               _ref.functional.chrf_score(preds, target, lowercase=False))
+
+
+def _ref_rouge(*args, **kwargs):
+    """The reference's rouge update sentence-splits unconditionally, which
+    needs the punkt nltk corpus — not downloadable here; skip like the rest
+    of the suite when the offline data is missing."""
+    try:
+        return _ref.functional.rouge_score(*args, **kwargs)
+    except LookupError:
+        pytest.skip("reference ROUGE needs nltk data unavailable offline")
+
+
+class TestRougeEdges:
+    KEYS = ("rouge1", "rouge2", "rougeL")
+
+    def test_empty_hypothesis(self):
+        theirs = _ref_rouge([""], ["the cat sat"], rouge_keys=self.KEYS)
+        ours = F.rouge_score([""], ["the cat sat"], rouge_keys=self.KEYS)
+        for key in ("rouge1_fmeasure", "rougeL_fmeasure"):
+            _close(ours[key], float(theirs[key]))
+
+    def test_single_word(self):
+        theirs = _ref_rouge(["cat"], ["cat"], rouge_keys=self.KEYS)
+        ours = F.rouge_score(["cat"], ["cat"], rouge_keys=self.KEYS)
+        for key in ("rouge1_fmeasure", "rouge2_fmeasure", "rougeL_fmeasure"):
+            _close(ours[key], float(theirs[key]))
+
+    def test_punctuation_tokenization(self):
+        preds = ["the cat, sat. on; the mat!"]
+        target = ["the cat sat on the mat"]
+        theirs = _ref_rouge(preds, target, rouge_keys=self.KEYS)
+        ours = F.rouge_score(preds, target, rouge_keys=self.KEYS)
+        for key in ("rouge1_fmeasure", "rougeL_fmeasure"):
+            _close(ours[key], float(theirs[key]))
+
+
+class TestTerEdges:
+    def test_exact_match_is_zero(self):
+        sent = ["the quick brown fox"]
+        _close(F.translation_edit_rate(sent, [[sent[0]]]),
+               _ref.functional.translation_edit_rate(sent, [[sent[0]]]))
+
+    def test_shift_heavy_case(self):
+        """A pure reordering exercises the shift heuristics."""
+        preds = ["d c b a"]
+        target = [["a b c d"]]
+        _close(F.translation_edit_rate(preds, target),
+               _ref.functional.translation_edit_rate(preds, target))
+
+    @pytest.mark.parametrize("kwargs", [{"normalize": True}, {"lowercase": False}, {"no_punctuation": True}])
+    def test_flag_parity(self, kwargs):
+        preds = ["The CAT, sat on-the mat."]
+        target = [["the cat sat on the mat"]]
+        _close(F.translation_edit_rate(preds, target, **kwargs),
+               _ref.functional.translation_edit_rate(preds, target, **kwargs))
+
+
+class TestSquadEdges:
+    def test_articles_and_punctuation_normalization(self):
+        preds = [{"prediction_text": "The  Eiffel-Tower!", "id": "1"}]
+        target = [{"answers": {"answer_start": [0], "text": ["eiffel tower"]}, "id": "1"}]
+        ours = F.squad(preds, target)
+        theirs = _ref.functional.squad(preds, target)
+        for key in ("exact_match", "f1"):
+            _close(ours[key], float(theirs[key]))
+
+    def test_multiple_gold_answers_takes_max(self):
+        preds = [{"prediction_text": "blue whale", "id": "1"}]
+        target = [{"answers": {"answer_start": [0, 0], "text": ["a whale", "the blue whale"]}, "id": "1"}]
+        ours = F.squad(preds, target)
+        theirs = _ref.functional.squad(preds, target)
+        for key in ("exact_match", "f1"):
+            _close(ours[key], float(theirs[key]))
