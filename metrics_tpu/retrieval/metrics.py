@@ -36,7 +36,7 @@ class RetrievalMRR(RetrievalMetric):
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         # the first relevant row has the largest 1/rank among relevant rows
-        rr = jnp.where(ctx.rel > 0, 1.0 / ctx.ranks.astype(jnp.float32), 0.0)
+        rr = jnp.where(ctx.rel_bin() > 0, 1.0 / ctx.ranks.astype(jnp.float32), 0.0)
         return jnp.maximum(segment_max(rr, ctx.seg, ctx.num_groups), 0.0)
 
 

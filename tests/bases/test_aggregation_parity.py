@@ -1,0 +1,113 @@
+"""Aggregation metrics vs the mounted reference: nan strategies × dtypes ×
+scalar/array/weighted inputs on identical data."""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+RNG = np.random.RandomState(23)
+CLEAN = [RNG.randn(8).astype(np.float32) for _ in range(3)]
+WITH_NAN = [np.where(RNG.rand(8) < 0.25, np.nan, v).astype(np.float32) for v in CLEAN]
+
+_AGGREGATORS = ["MeanMetric", "SumMetric", "MaxMetric", "MinMetric"]
+
+
+def _run_pair(name, batches, our_kwargs=None, weights=None):
+    our_kwargs = our_kwargs or {}
+    ours = getattr(mt, name)(**our_kwargs)
+    ref = getattr(_ref, name)(**our_kwargs)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for batch in batches:
+            if weights is not None:
+                ours.update(jnp.asarray(batch), jnp.asarray(weights))
+                ref.update(torch.tensor(batch), torch.tensor(weights))
+            else:
+                ours.update(jnp.asarray(batch))
+                ref.update(torch.tensor(batch))
+        np.testing.assert_allclose(
+            np.asarray(ours.compute(), np.float64),
+            np.asarray(ref.compute().numpy(), np.float64),
+            atol=1e-5,
+            rtol=1e-5,
+            equal_nan=True,
+        )
+
+
+@pytest.mark.parametrize("name", _AGGREGATORS)
+def test_clean_arrays(name):
+    _run_pair(name, CLEAN)
+
+
+@pytest.mark.parametrize("name", _AGGREGATORS)
+@pytest.mark.parametrize("strategy", ["warn", "ignore", 0.0, 2.5])
+def test_nan_strategies(name, strategy):
+    _run_pair(name, WITH_NAN, {"nan_strategy": strategy})
+
+
+@pytest.mark.parametrize("name", _AGGREGATORS)
+def test_nan_strategy_error_raises_in_both(name):
+    ours = getattr(mt, name)(nan_strategy="error")
+    ref = getattr(_ref, name)(nan_strategy="error")
+    with pytest.raises(RuntimeError):
+        ours.update(jnp.asarray(WITH_NAN[0]))
+    with pytest.raises(RuntimeError):
+        ref.update(torch.tensor(WITH_NAN[0]))
+
+
+def test_scalar_updates():
+    _run_pair("MeanMetric", [1.0, 2.5, -3.0])
+    _run_pair("SumMetric", [1.0, 2.5, -3.0])
+
+
+def test_weighted_mean():
+    weights = RNG.rand(8).astype(np.float32)
+    _run_pair("MeanMetric", CLEAN, weights=weights)
+
+
+def test_weighted_mean_with_nan_values():
+    """Divergence in our favor: the reference crashes here (it drops NaN
+    values but broadcasts the unfiltered weights against the filtered shape,
+    `aggregation.py:352`). We drop the weight rows alongside their values;
+    pin that against a manual oracle."""
+    weights = RNG.rand(8).astype(np.float32)
+    metric = mt.MeanMetric(nan_strategy="ignore")
+    total_num = total_den = 0.0
+    for batch in WITH_NAN:
+        metric.update(jnp.asarray(batch), jnp.asarray(weights))
+        keep = ~np.isnan(batch)
+        total_num += float((batch[keep] * weights[keep]).sum())
+        total_den += float(weights[keep].sum())
+    np.testing.assert_allclose(float(metric.compute()), total_num / total_den, atol=1e-5)
+
+    import torch as _torch
+
+    ref = _ref.MeanMetric(nan_strategy="ignore")
+    with pytest.raises(RuntimeError):
+        ref.update(_torch.tensor(WITH_NAN[0]), _torch.tensor(weights))
+
+
+def test_cat_metric_preserves_order():
+    ours = mt.CatMetric()
+    ref = _ref.CatMetric()
+    for batch in CLEAN:
+        ours.update(jnp.asarray(batch))
+        ref.update(torch.tensor(batch))
+    np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-6)
+
+
+def test_int_dtype_inputs():
+    batches = [np.asarray([1, 2, 3]), np.asarray([4, 5, 6])]
+    for name in ("SumMetric", "MaxMetric", "MinMetric"):
+        _run_pair(name, batches)
