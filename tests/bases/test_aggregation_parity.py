@@ -91,11 +91,9 @@ def test_weighted_mean_with_nan_values():
         total_den += float(weights[keep].sum())
     np.testing.assert_allclose(float(metric.compute()), total_num / total_den, atol=1e-5)
 
-    import torch as _torch
-
     ref = _ref.MeanMetric(nan_strategy="ignore")
     with pytest.raises(RuntimeError):
-        ref.update(_torch.tensor(WITH_NAN[0]), _torch.tensor(weights))
+        ref.update(torch.tensor(WITH_NAN[0]), torch.tensor(weights))
 
 
 def test_cat_metric_preserves_order():
