@@ -38,8 +38,13 @@ class MetricTracker:
 
     @property
     def n_steps(self) -> int:
-        """Number of tracked steps (the initial base copy does not count)."""
-        return len(self._history) - 1
+        """Number of times the tracker has been incremented.
+
+        The reference computes ``len(self) - 1`` because its ModuleList holds
+        the base metric at index 0; our history holds only the incremented
+        copies, so its length IS the step count (one per ``increment()``).
+        """
+        return len(self._history)
 
     def increment(self) -> None:
         """Start a new time step: append a fresh copy of the base metric."""

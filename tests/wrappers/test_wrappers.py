@@ -125,7 +125,7 @@ class TestTracker:
         np.testing.assert_allclose(np.asarray(all_vals), [1.0, 5.0, 3.0])
         best, idx = tracker.best_metric(return_step=True)
         assert best == 5.0 and idx == 1
-        assert tracker.n_steps == 2  # reference counts len(history) - 1
+        assert tracker.n_steps == 3  # one per increment(), like the reference
 
     def test_collection_history(self):
         col = MetricCollection([Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")])
