@@ -1,0 +1,132 @@
+"""MetricCollection differential tests vs the mounted reference.
+
+The composition layer's observable contract on identical data: output dict
+keys under prefix/postfix/nesting, compute-group results matching ungrouped
+results, kwarg filtering across heterogeneous update signatures, and clone
+independence — each cell runs both stacks side by side.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+RNG = np.random.RandomState(37)
+PREDS = RNG.rand(64, 5).astype(np.float32)
+PREDS /= PREDS.sum(1, keepdims=True)
+TARGET = RNG.randint(0, 5, 64)
+
+
+def _suites(**kwargs):
+    ours = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=5, average="macro"),
+            "prec": mt.Precision(num_classes=5, average="macro"),
+            "rec": mt.Recall(num_classes=5, average="macro"),
+        },
+        **kwargs,
+    )
+    ref = _ref.MetricCollection(
+        {
+            "acc": _ref.Accuracy(num_classes=5, average="macro"),
+            "prec": _ref.Precision(num_classes=5, average="macro"),
+            "rec": _ref.Recall(num_classes=5, average="macro"),
+        },
+        **kwargs,
+    )
+    return ours, ref
+
+
+def _assert_same_outputs(ours_out, ref_out):
+    assert set(ours_out) == set(ref_out)
+    for key in ref_out:
+        np.testing.assert_allclose(float(ours_out[key]), float(ref_out[key]), atol=1e-6, err_msg=key)
+
+
+@pytest.mark.parametrize("kwargs", [{}, {"prefix": "train_"}, {"postfix": "_val"}, {"prefix": "a/", "postfix": "/b"}])
+def test_naming_parity(kwargs):
+    ours, ref = _suites(**kwargs)
+    ours.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ref.update(torch.tensor(PREDS), torch.tensor(TARGET))
+    _assert_same_outputs(ours.compute(), ref.compute())
+
+
+@pytest.mark.parametrize("compute_groups", [True, False])
+def test_compute_groups_value_equivalence(compute_groups):
+    """Grouped (state-shared) and ungrouped collections must agree with the
+    reference bit-for-bit over multiple updates."""
+    ours, ref = _suites(compute_groups=compute_groups)
+    for start in (0, 32):
+        ours.update(jnp.asarray(PREDS[start : start + 32]), jnp.asarray(TARGET[start : start + 32]))
+        ref.update(torch.tensor(PREDS[start : start + 32]), torch.tensor(TARGET[start : start + 32]))
+    _assert_same_outputs(ours.compute(), ref.compute())
+
+
+def test_nested_collection_key_parity():
+    """Constructor-list nesting flattens, keeping the inner prefix; the keys
+    and values must match the reference. add_metrics(collection) is rejected
+    by BOTH stacks (only the constructor flattens)."""
+    ours = mt.MetricCollection(
+        [mt.MetricCollection({"mse": mt.MeanSquaredError()}, prefix="reg_"), mt.MeanAbsoluteError()]
+    )
+    ref = _ref.MetricCollection(
+        [_ref.MetricCollection({"mse": _ref.MeanSquaredError()}, prefix="reg_"), _ref.MeanAbsoluteError()]
+    )
+    p = RNG.randn(16).astype(np.float32)
+    t = RNG.randn(16).astype(np.float32)
+    ours.update(jnp.asarray(p), jnp.asarray(t))
+    ref.update(torch.tensor(p), torch.tensor(t))
+    _assert_same_outputs(ours.compute(), ref.compute())
+
+    with pytest.raises(ValueError, match="Unknown input"):
+        mt.MetricCollection({"mae": mt.MeanAbsoluteError()}).add_metrics(
+            mt.MetricCollection({"mse": mt.MeanSquaredError()})
+        )
+    with pytest.raises(ValueError, match="Unknown input"):
+        _ref.MetricCollection({"mae": _ref.MeanAbsoluteError()}).add_metrics(
+            _ref.MetricCollection({"mse": _ref.MeanSquaredError()})
+        )
+
+
+def test_kwarg_filtering_across_signatures():
+    """A collection mixing metrics whose updates take different kwargs must
+    route each metric only the kwargs its signature accepts."""
+    ours = mt.MetricCollection({"map": mt.RetrievalMAP(), "mrr": mt.RetrievalMRR()})
+    ref = _ref.MetricCollection({"map": _ref.RetrievalMAP(), "mrr": _ref.RetrievalMRR()})
+    idx = np.asarray([0, 0, 1, 1], dtype=np.int64)
+    preds = RNG.rand(4).astype(np.float32)
+    target = np.asarray([1, 0, 0, 1], dtype=np.int64)
+    ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(idx))
+    _assert_same_outputs(ours.compute(), ref.compute())
+
+
+def test_clone_is_independent_in_both():
+    ours, ref = _suites()
+    ours.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ref.update(torch.tensor(PREDS), torch.tensor(TARGET))
+    ours_clone = ours.clone(prefix="c_")
+    ref_clone = ref.clone(prefix="c_")
+    ours_clone.reset()
+    ref_clone.reset()
+    # resetting the clone must not touch the original
+    _assert_same_outputs(ours.compute(), ref.compute())
+    assert set(ours_clone.keys()) == set(ref_clone.keys())
+
+
+def test_missing_kwarg_raises_in_both():
+    ours = mt.MetricCollection({"map": mt.RetrievalMAP()})
+    ref = _ref.MetricCollection({"map": _ref.RetrievalMAP()})
+    with pytest.raises((ValueError, TypeError)):
+        ours.update(jnp.asarray([0.5, 0.2]), jnp.asarray([1, 0]))
+    with pytest.raises((ValueError, TypeError)):
+        ref.update(torch.tensor([0.5, 0.2]), torch.tensor([1, 0]))

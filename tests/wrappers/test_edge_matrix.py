@@ -118,6 +118,22 @@ class TestBootstrapperSurface:
             _ref.BootStrapper(_ref.MeanSquaredError(), sampling_strategy="bogus")
 
 
+class TestMinMaxEdges:
+    def test_extrema_around_moving_value(self):
+        """MinMax around a value that dips then recovers: raw tracks the
+        current value, min/max keep the running extrema — both stacks."""
+        target = RNG.randn(32).astype(np.float32)
+        ours = mt.MinMaxMetric(mt.MeanSquaredError())
+        ref = _ref.MinMaxMetric(_ref.MeanSquaredError())
+        for noise in (0.8, 0.1, 0.5):
+            preds = (target + noise * RNG.randn(32)).astype(np.float32)
+            ours(jnp.asarray(preds), jnp.asarray(target))
+            ref(torch.tensor(preds), torch.tensor(target))
+        ours_out, ref_out = ours.compute(), ref.compute()
+        for key in ("raw", "min", "max"):
+            np.testing.assert_allclose(float(ours_out[key]), float(ref_out[key]), atol=1e-5, err_msg=key)
+
+
 class TestTrackerEdges:
     def test_best_across_increments(self):
         """Three training epochs of decreasing MSE; best_metric and which_epoch."""
@@ -133,11 +149,13 @@ class TestTrackerEdges:
         np.testing.assert_allclose(
             np.asarray(ours.compute_all()).reshape(-1), ref.compute_all().numpy().reshape(-1), atol=1e-5
         )
-        # documented divergence: our best_metric returns the VALUE; the
-        # reference returns the argmax index due to an upstream unpacking bug,
-        # so compare against its best_metric(return_step=True) value instead
+        # documented divergence: our bare best_metric() returns the VALUE (the
+        # reference returns the argmax index due to an upstream unpacking
+        # bug); with return_step=True the reference yields the correct
+        # (value, step) pair, so THAT is the differential oracle
         ref_value, ref_step = ref.best_metric(return_step=True)
         ours_value = ours.best_metric()
+        np.testing.assert_allclose(float(ours_value), float(ref_value), atol=1e-5)
         np.testing.assert_allclose(float(ours_value), float(min(np.asarray(ours.compute_all()))), atol=1e-6)
         assert ref_step == 2  # lowest-noise epoch
 
