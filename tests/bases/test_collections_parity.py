@@ -14,6 +14,7 @@ import torch
 import jax.numpy as jnp
 
 from tests.helpers.reference_oracle import get_reference
+from tests.helpers.testers import assert_dict_outputs_equal
 
 _ref = get_reference()
 pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
@@ -47,9 +48,7 @@ def _suites(**kwargs):
 
 
 def _assert_same_outputs(ours_out, ref_out):
-    assert set(ours_out) == set(ref_out)
-    for key in ref_out:
-        np.testing.assert_allclose(float(ours_out[key]), float(ref_out[key]), atol=1e-6, err_msg=key)
+    assert_dict_outputs_equal(ours_out, {k: v.numpy() for k, v in ref_out.items()})
 
 
 @pytest.mark.parametrize("kwargs", [{}, {"prefix": "train_"}, {"postfix": "_val"}, {"prefix": "a/", "postfix": "/b"}])
@@ -100,8 +99,10 @@ def test_nested_collection_key_parity():
 def test_kwarg_filtering_across_signatures():
     """A collection mixing metrics whose updates take different kwargs must
     route each metric only the kwargs its signature accepts."""
-    ours = mt.MetricCollection({"map": mt.RetrievalMAP(), "mrr": mt.RetrievalMRR()})
-    ref = _ref.MetricCollection({"map": _ref.RetrievalMAP(), "mrr": _ref.RetrievalMRR()})
+    # MSE takes only (preds, target): the collection must DROP `indexes`
+    # for it while the retrieval members receive it
+    ours = mt.MetricCollection({"map": mt.RetrievalMAP(), "mrr": mt.RetrievalMRR(), "mse": mt.MeanSquaredError()})
+    ref = _ref.MetricCollection({"map": _ref.RetrievalMAP(), "mrr": _ref.RetrievalMRR(), "mse": _ref.MeanSquaredError()})
     idx = np.asarray([0, 0, 1, 1], dtype=np.int64)
     preds = RNG.rand(4).astype(np.float32)
     target = np.asarray([1, 0, 0, 1], dtype=np.int64)
@@ -126,7 +127,14 @@ def test_clone_is_independent_in_both():
 def test_missing_kwarg_raises_in_both():
     ours = mt.MetricCollection({"map": mt.RetrievalMAP()})
     ref = _ref.MetricCollection({"map": _ref.RetrievalMAP()})
-    with pytest.raises((ValueError, TypeError)):
+    ours_exc = ref_exc = None
+    try:
         ours.update(jnp.asarray([0.5, 0.2]), jnp.asarray([1, 0]))
-    with pytest.raises((ValueError, TypeError)):
+    except (ValueError, TypeError) as err:
+        ours_exc = type(err)
+    try:
         ref.update(torch.tensor([0.5, 0.2]), torch.tensor([1, 0]))
+    except (ValueError, TypeError) as err:
+        ref_exc = type(err)
+    assert ours_exc is not None and ref_exc is not None
+    assert ours_exc is ref_exc  # exception-type parity for migrating catch blocks

@@ -1,0 +1,105 @@
+"""CompositionalMetric operators vs the mounted reference on identical data."""
+from __future__ import annotations
+
+import operator
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+RNG = np.random.RandomState(41)
+PREDS = RNG.rand(32).astype(np.float32)
+TARGET = RNG.rand(32).astype(np.float32)
+
+
+def _pair():
+    ours_a, ours_b = mt.MeanSquaredError(), mt.MeanAbsoluteError()
+    ref_a, ref_b = _ref.MeanSquaredError(), _ref.MeanAbsoluteError()
+    return (ours_a, ours_b), (ref_a, ref_b)
+
+
+def _drive(composed_ours, composed_ref, metrics_ours, metrics_ref):
+    for m in metrics_ours:
+        m.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    for m in metrics_ref:
+        m.update(torch.tensor(PREDS), torch.tensor(TARGET))
+    np.testing.assert_allclose(
+        float(composed_ours.compute()), float(composed_ref.compute()), atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize(
+    "op", [operator.add, operator.sub, operator.mul, operator.truediv], ids=["add", "sub", "mul", "div"]
+)
+def test_metric_op_metric(op):
+    (oa, ob), (ra, rb) = _pair()
+    _drive(op(oa, ob), op(ra, rb), (oa, ob), (ra, rb))
+
+
+@pytest.mark.parametrize("scalar", [2.0, -0.5])
+@pytest.mark.parametrize("op", [operator.add, operator.mul, operator.pow], ids=["add", "mul", "pow"])
+def test_metric_op_scalar(op, scalar):
+    if op is operator.pow and scalar < 0:
+        pytest.skip("fractional root of positive value only")
+    (oa, _), (ra, _) = _pair()
+    _drive(op(oa, scalar), op(ra, scalar), (oa,), (ra,))
+
+
+@pytest.mark.parametrize("op", [abs, operator.neg], ids=["abs", "neg"])
+def test_unary(op):
+    (oa, _), (ra, _) = _pair()
+    _drive(op(oa), op(ra), (oa,), (ra,))
+
+
+def test_nested_expression():
+    (oa, ob), (ra, rb) = _pair()
+    ours = abs(oa - ob) * 2.0
+    ref = abs(ra - rb) * 2.0
+    _drive(ours, ref, (oa, ob), (ra, rb))
+
+
+def test_comparison_ops():
+    (oa, ob), (ra, rb) = _pair()
+    ours = oa > ob
+    ref = ra > rb
+    for m in (oa, ob):
+        m.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    for m in (ra, rb):
+        m.update(torch.tensor(PREDS), torch.tensor(TARGET))
+    assert bool(np.asarray(ours.compute())) == bool(ref.compute())
+
+
+def test_forward_through_composition():
+    (oa, ob), (ra, rb) = _pair()
+    ours = oa + ob
+    ref = ra + rb
+    ours_val = ours(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ref_val = ref(torch.tensor(PREDS), torch.tensor(TARGET))
+    np.testing.assert_allclose(float(ours_val), float(ref_val), atol=1e-5)
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5)
+
+
+def test_reset_propagates():
+    (oa, ob), (ra, rb) = _pair()
+    ours = oa + ob
+    ref = ra + rb
+    for m in (oa, ob):
+        m.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    for m in (ra, rb):
+        m.update(torch.tensor(PREDS), torch.tensor(TARGET))
+    ours.reset()
+    ref.reset()
+    oa.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ob.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ra.update(torch.tensor(PREDS), torch.tensor(TARGET))
+    rb.update(torch.tensor(PREDS), torch.tensor(TARGET))
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5)

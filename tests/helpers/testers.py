@@ -384,3 +384,13 @@ __all__ = [
     "EXTRA_DIM",
     "THRESHOLD",
 ]
+
+
+def assert_dict_outputs_equal(ours: dict, theirs: dict, atol: float = 1e-6) -> None:
+    """Shared oracle for dict-valued metric outputs: key sets must match and
+    every value must agree within tolerance."""
+    assert set(ours) == set(theirs), set(ours) ^ set(theirs)
+    for key in theirs:
+        np.testing.assert_allclose(
+            np.asarray(ours[key], np.float64), np.asarray(theirs[key], np.float64), atol=atol, err_msg=str(key)
+        )
