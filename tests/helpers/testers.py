@@ -383,6 +383,7 @@ __all__ = [
     "NUM_CLASSES",
     "EXTRA_DIM",
     "THRESHOLD",
+    "assert_dict_outputs_equal",
 ]
 
 

@@ -69,11 +69,9 @@ class TestClasswiseEdges:
         ref = _ref.ClasswiseWrapper(_ref.Accuracy(num_classes=4, average="none"))
         ours.update(jnp.asarray(preds), jnp.asarray(target))
         ref.update(torch.tensor(preds), torch.tensor(target))
-        ours_out = ours.compute()
-        ref_out = ref.compute()
-        assert set(ours_out) == set(ref_out)
-        for key in ref_out:
-            np.testing.assert_allclose(float(ours_out[key]), float(ref_out[key]), atol=1e-6, err_msg=key)
+        from tests.helpers.testers import assert_dict_outputs_equal
+
+        assert_dict_outputs_equal(ours.compute(), {k: v.numpy() for k, v in ref.compute().items()})
 
     def test_custom_labels(self):
         preds, target = self._data()
@@ -82,8 +80,10 @@ class TestClasswiseEdges:
         ref = _ref.ClasswiseWrapper(_ref.Recall(num_classes=4, average="none"), labels=labels)
         ours.update(jnp.asarray(preds), jnp.asarray(target))
         ref.update(torch.tensor(preds), torch.tensor(target))
+        from tests.helpers.testers import assert_dict_outputs_equal
+
         ours_out, ref_out = ours.compute(), ref.compute()
-        assert set(ours_out) == set(ref_out)
+        assert_dict_outputs_equal(ours_out, {k: v.numpy() for k, v in ref_out.items()})
         assert "recall_cat" in ours_out
 
 
