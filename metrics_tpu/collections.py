@@ -16,7 +16,7 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 
 import jax
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _propagate_static_attrs
 from metrics_tpu.utils.data import _flatten_dict, allclose
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -57,9 +57,111 @@ class MetricCollection:
         return self.forward(*args, **kwargs)
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Call ``forward`` on every metric; kwargs filtered per update signature."""
-        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True, copy_state=False)}
+        """Call ``forward`` on every metric; kwargs filtered per update signature.
+
+        When every member is fusable (and the validation mode permits traced
+        forwards), the whole collection runs as ONE jitted program per step:
+        each member's batch update + batch value + state merge, with XLA
+        CSE sharing the canonicalization work across members — the module-API
+        analogue of the ``as_functions`` whole-suite export.
+        """
+        fused = self._forward_fused(*args, **kwargs)
+        if fused is not None:
+            return fused
+        return self._forward_member_wise(list(self.items(keep_base=True, copy_state=False)), *args, **kwargs)
+
+    def _forward_member_wise(self, members: List[Tuple[str, Metric]], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in members}
         res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    # ------------------------------------------------- fused whole-suite step
+    _fused_program = None
+    _fused_templates: Optional[Dict[str, Metric]] = None
+    _fused_versions: Optional[Dict[str, int]] = None
+    _fused_seen: Optional[set] = None
+    _fused_disabled: bool = False
+
+    def _forward_fused(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        if self._fused_disabled:
+            return None
+        members = list(self.items(keep_base=True, copy_state=False))
+        if (
+            _get_validation_mode() == "full"
+            or not members
+            or any(not (m._fused_forward_ok and m._fusable_states()) for _, m in members)
+            or any(m.full_state_update or m.full_state_update is None or m.dist_sync_on_step for _, m in members)
+            or any(m._is_synced for _, m in members)
+            or len({m._update_count for _, m in members}) != 1
+        ):
+            return None
+        if self._fused_versions is not None and any(
+            self._fused_versions.get(name) != m._fused_version for name, m in members
+        ):
+            self._fused_program = None  # a member hyperparameter changed
+        # signature (and the program call) covers only the kwargs SOME member
+        # consumes: an ignored, varying kwarg (e.g. a step counter) must not
+        # defeat fusion or leak non-traceable values into jit
+        consumed: Dict[str, Any] = {}
+        for _, m in members:
+            consumed.update(m._filter_kwargs(**kwargs))
+        signature = Metric._forward_signature(args, consumed)
+        if self._fused_seen is None:
+            self._fused_seen = set()
+        if signature not in self._fused_seen:
+            # first sight of a signature: member-wise eager forwards (full
+            # validation; a new signature would retrace the program anyway)
+            self._fused_seen.add(signature)
+            while len(self._fused_seen) > Metric._FUSED_SIG_CAP:
+                self._fused_seen.pop()
+            return None
+        try:
+            if self._fused_program is None:
+                steps = {}
+                templates = {}
+                for name, m in members:
+                    templates[name], steps[name] = m._build_fused_step()
+                member_filters = {name: m._filter_kwargs for name, m in members}
+
+                def program(states: Dict[str, Any], update_count, *a: Any, **k: Any):
+                    out_states, values = {}, {}
+                    for name, step in steps.items():
+                        filtered = member_filters[name](**k)
+                        out_states[name], values[name] = step(states[name], update_count, *a, **filtered)
+                    return out_states, values
+
+                self._fused_program = jax.jit(program)
+                self._fused_templates = templates
+                self._fused_versions = {name: m._fused_version for name, m in members}
+            states = {name: {s: getattr(m, s) for s in m._defaults} for name, m in members}
+            count = members[0][1]._update_count + 1
+            merged, values = self._fused_program(states, count, *args, **consumed)
+        except Exception:
+            # member-wise fallback (full member-level semantics, incl. their
+            # own fused paths); if that succeeds, this collection's combined
+            # program is genuinely untraceable — stop re-trying every step.
+            # If the fallback raises too, the input was bad: surface it and
+            # keep the fused path enabled.
+            result = self._forward_member_wise(members, *args, **kwargs)
+            self._fused_disabled = True
+            self._fused_program = None
+            self._fused_templates = None
+            return result
+        for name, m in members:
+            for state_name, value in merged[name].items():
+                setattr(m, state_name, value)
+            # template write-back uses object.__setattr__, so it cannot
+            # re-trigger the member's fused-program invalidation
+            _propagate_static_attrs(self._fused_templates[name], m)
+            m._update_count += 1
+            m._is_synced = False
+            m._should_unsync = True
+            m._to_sync = m.sync_on_compute
+            m._computed = None
+            m._forward_cache = values[name]
+        res = _flatten_dict(values)
         return {self._set_name(k): v for k, v in res.items()}
 
     def update(self, *args: Any, **kwargs: Any) -> None:
@@ -266,6 +368,15 @@ class MetricCollection:
             self._init_compute_groups()
         else:
             self._groups = {}
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # the fused whole-suite program is a jit closure: unpicklable and not
+        # deepcopy-able — dropped here, rebuilt lazily on the next forward
+        drop = ("_fused_program", "_fused_templates")
+        return {k: v for k, v in self.__dict__.items() if k not in drop}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)

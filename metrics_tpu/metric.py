@@ -282,7 +282,14 @@ class Metric(ABC):
     _fused_template: Optional["Metric"] = None
     _fused_forward_ok: bool = True
     _fused_seen_signatures: Optional[set] = None
+    _fused_version: int = 0  # bumped on invalidation; lets collections detect staleness
     _FUSED_SIG_CAP = 4096
+
+    def _fusable_states(self) -> bool:
+        """True when every state merges by sum/mean/max/min (no list states)."""
+        if any(isinstance(v, list) for v in self._defaults.values()):
+            return False
+        return all(self._reduction_specs[name] in ("sum", "mean", "max", "min") for name in self._defaults)
 
     @staticmethod
     def _forward_signature(args: tuple, kwargs: dict) -> tuple:
@@ -296,22 +303,11 @@ class Metric(ABC):
 
         return tuple(leaf(a) for a in args) + tuple((k, leaf(v)) for k, v in sorted(kwargs.items()))
 
-    def _build_fused_forward(self) -> Callable:
-        """One jitted program for the whole reduce-path forward: batch update
-        from the default state + batch compute + merge into the global state.
-
-        The eager forward issues ~20-30 tiny device ops per step (snapshot,
-        reset, update, compute, merge) — each a dispatch round trip, which is
-        what per-step overhead IS on remote/tunneled backends. Fused, a step
-        is ONE dispatch. Only simple reductions fuse (sum/mean/max/min over
-        array states); list/cat states grow (retrace per step) and custom
-        reductions may not be traceable, so those metrics keep the eager path.
-        """
-        if any(isinstance(v, list) for v in self._defaults.values()):
-            raise TypeError("list states cannot fuse (state grows per update)")
-        allowed = ("sum", "mean", "max", "min")
-        if any(self._reduction_specs[name] not in allowed for name in self._defaults):
-            raise TypeError("only sum/mean/max/min reductions fuse")
+    def _build_fused_step(self) -> Tuple["Metric", Callable]:
+        """(template, UNJITTED step fn) for the fused forward — also composed
+        by MetricCollection into one whole-suite program."""
+        if not self._fusable_states():
+            raise TypeError("only sum/mean/max/min array states fuse")
         template = self._bare_clone()
         specs = {name: self._reduction_specs[name] for name in self._defaults}
 
@@ -327,6 +323,20 @@ class Metric(ABC):
             }
             return merged, batch_value
 
+        return template, step
+
+    def _build_fused_forward(self) -> Callable:
+        """One jitted program for the whole reduce-path forward: batch update
+        from the default state + batch compute + merge into the global state.
+
+        The eager forward issues ~20-30 tiny device ops per step (snapshot,
+        reset, update, compute, merge) — each a dispatch round trip, which is
+        what per-step overhead IS on remote/tunneled backends. Fused, a step
+        is ONE dispatch. Only simple reductions fuse (sum/mean/max/min over
+        array states); list/cat states grow (retrace per step) and custom
+        reductions may not be traceable, so those metrics keep the eager path.
+        """
+        template, step = self._build_fused_step()
         self._fused_template = template
         # NOTE: the program caches per instance (step closes over this
         # instance's template). Identically-configured instances each compile
@@ -347,11 +357,7 @@ class Metric(ABC):
         """
         from metrics_tpu.utils.checks import _get_validation_mode
 
-        fusable = (
-            self._fused_forward_ok
-            and _get_validation_mode() != "full"
-            and not any(isinstance(v, list) for v in self._defaults.values())
-        )
+        fusable = self._fused_forward_ok and _get_validation_mode() != "full" and self._fusable_states()
         if not fusable:
             # permanently-unfusable metrics (and mode "full") skip the
             # signature bookkeeping entirely — no repr of text batches, no
@@ -700,12 +706,19 @@ class Metric(ABC):
         # object.__setattr__ and never reaches this guard.)
         if (
             not name.startswith("_")
-            and self.__dict__.get("_fused_forward") is not None
             and name not in self.__dict__.get("_defaults", {})
-            and name not in ("update", "compute")
+            # compute_on_cpu only gates list-state host moves, which fusable
+            # metrics don't have — and the eager forward toggles it per call,
+            # so counting it would invalidate suite programs constantly
+            and name not in ("update", "compute", "compute_on_cpu")
         ):
-            object.__setattr__(self, "_fused_forward", None)
-            object.__setattr__(self, "_fused_template", None)
+            # the version counter always moves (a MetricCollection's fused
+            # whole-suite program watches it even when this metric never
+            # built its own); the member-level program is dropped if present
+            object.__setattr__(self, "_fused_version", self.__dict__.get("_fused_version", 0) + 1)
+            if self.__dict__.get("_fused_forward") is not None:
+                object.__setattr__(self, "_fused_forward", None)
+                object.__setattr__(self, "_fused_template", None)
         object.__setattr__(self, name, value)
 
     def __hash__(self) -> int:

@@ -184,3 +184,108 @@ def test_bad_batch_preserves_accumulated_state():
     for p, t in BATCHES:
         want.update(p, t)
     np.testing.assert_allclose(float(metric.compute()), float(want.compute()), atol=1e-6)
+
+
+class TestCollectionFusedForward:
+    """The whole-suite fused forward: one program per step across members."""
+
+    @staticmethod
+    def _suite():
+        return mt.MetricCollection(
+            {
+                "acc": mt.Accuracy(num_classes=1, average="macro"),
+                "f1": mt.F1Score(num_classes=1, average="macro"),
+                "mean": mt.MeanMetric(),
+            }
+        )
+
+    def test_fused_equals_eager(self):
+        fused = self._suite()
+        eager = self._suite()
+        eager._fused_disabled = True
+        for p, t in BATCHES:
+            fused_out = fused(p, t)
+            eager_out = eager(p, t)
+            assert set(fused_out) == set(eager_out)
+            for key in eager_out:
+                np.testing.assert_allclose(
+                    np.asarray(fused_out[key]), np.asarray(eager_out[key]), atol=1e-6, err_msg=key
+                )
+        for key, value in eager.compute().items():
+            np.testing.assert_allclose(np.asarray(fused.compute()[key]), np.asarray(value), atol=1e-6)
+        assert fused._fused_program is not None  # the suite really fused
+
+    def test_member_mutation_invalidates_suite_program(self):
+        suite = self._suite()
+        p, t = BATCHES[0]
+        suite(p, t)
+        suite(p, t)
+        assert suite._fused_program is not None
+        suite["acc"].threshold = 0.9
+        out = suite(p, t)  # must not use the stale program
+        want = mt.Accuracy(num_classes=1, average="macro", threshold=0.9)
+        want._fused_forward_ok = False
+        np.testing.assert_allclose(np.asarray(out["acc"]), np.asarray(want(p, t)), atol=1e-6)
+        assert suite["acc"].threshold == 0.9
+
+    def test_unfusable_member_keeps_member_wise_path(self):
+        suite = mt.MetricCollection({"mean": mt.MeanMetric(), "cat": mt.CatMetric()})
+        for p, _ in BATCHES:
+            suite(p)
+        assert suite._fused_program is None  # CatMetric blocks suite fusion
+        assert np.asarray(suite.compute()["cat"]).shape == (len(BATCHES) * 64,)
+
+    def test_pickle_and_clone_after_fused_use(self):
+        suite = self._suite()
+        for p, t in BATCHES:
+            suite(p, t)
+        assert suite._fused_program is not None
+        clone = pickle.loads(pickle.dumps(suite))
+        assert clone._fused_program is None
+        p, t = BATCHES[0]
+        clone(p, t)
+        deep = suite.clone(prefix="x_")
+        deep(p, t)
+
+    def test_prefix_naming_preserved(self):
+        suite = mt.MetricCollection({"mean": mt.MeanMetric()}, prefix="tr_")
+        p, _ = BATCHES[0]
+        suite(p)
+        out = suite(p)
+        assert set(out) == {"tr_mean"}
+
+
+def test_collection_fusion_survives_ignored_varying_kwarg():
+    """A kwarg no member consumes (e.g. a step counter) must neither defeat
+    suite fusion nor leak into the jitted program (review regression)."""
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    p, _ = BATCHES[0]
+    for step in range(4):
+        suite(p, step_label=f"step{step}")
+    assert suite._fused_program is not None
+
+
+def test_collection_program_survives_new_signature_eager_pass():
+    """A partial final batch (new shape -> eager member-wise pass) must not
+    invalidate the suite program for the shapes already compiled (review
+    regression: the eager path's compute_on_cpu toggle bumped versions)."""
+    suite = mt.MetricCollection({"mean": mt.MeanMetric(), "mx": mt.MaxMetric()})
+    p, _ = BATCHES[0]
+    suite(p)
+    suite(p)
+    program = suite._fused_program
+    assert program is not None
+    suite(jnp.asarray(np.random.rand(17).astype(np.float32)))  # new shape: eager
+    suite(p)  # the original shape keeps its compiled program
+    assert suite._fused_program is program
+
+
+def test_collection_seen_signatures_bounded():
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    cap, mt.Metric._FUSED_SIG_CAP = mt.Metric._FUSED_SIG_CAP, 8
+    try:
+        for n in range(1, 20):
+            suite(jnp.asarray(np.random.rand(n).astype(np.float32)))
+        assert len(suite._fused_seen) <= 8
+    finally:
+        mt.Metric._FUSED_SIG_CAP = cap
