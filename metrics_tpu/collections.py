@@ -79,7 +79,7 @@ class MetricCollection:
     _fused_program = None
     _fused_templates: Optional[Dict[str, Metric]] = None
     _fused_versions: Optional[Dict[str, int]] = None
-    _fused_seen: Optional[set] = None
+    _fused_seen: Optional[dict] = None
     _fused_disabled: bool = False
 
     def _forward_fused(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
@@ -95,6 +95,9 @@ class MetricCollection:
             or any(m.full_state_update or m.full_state_update is None or m.dist_sync_on_step for _, m in members)
             or any(m._is_synced for _, m in members)
             or len({m._update_count for _, m in members}) != 1
+            # the same instance registered under two keys must forward (and
+            # merge) once PER KEY — only the member-wise path does that
+            or len({id(m) for _, m in members}) != len(members)
         ):
             return None
         if self._fused_versions is not None and any(
@@ -109,13 +112,13 @@ class MetricCollection:
             consumed.update(m._filter_kwargs(**kwargs))
         signature = Metric._forward_signature(args, consumed)
         if self._fused_seen is None:
-            self._fused_seen = set()
+            self._fused_seen = {}  # insertion-ordered → FIFO eviction
         if signature not in self._fused_seen:
             # first sight of a signature: member-wise eager forwards (full
             # validation; a new signature would retrace the program anyway)
-            self._fused_seen.add(signature)
+            self._fused_seen[signature] = None
             while len(self._fused_seen) > Metric._FUSED_SIG_CAP:
-                self._fused_seen.pop()
+                self._fused_seen.pop(next(iter(self._fused_seen)))
             return None
         try:
             if self._fused_program is None:

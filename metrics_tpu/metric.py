@@ -281,7 +281,7 @@ class Metric(ABC):
     _fused_forward: Optional[Callable] = None
     _fused_template: Optional["Metric"] = None
     _fused_forward_ok: bool = True
-    _fused_seen_signatures: Optional[set] = None
+    _fused_seen_signatures: Optional[dict] = None
     _fused_version: int = 0  # bumped on invalidation; lets collections detect staleness
     _FUSED_SIG_CAP = 4096
 
@@ -364,7 +364,7 @@ class Metric(ABC):
             # retained signature strings, just the eager path
             return self._forward_reduce_state_update_eager(*args, **kwargs)
         if self._fused_seen_signatures is None:
-            self._fused_seen_signatures = set()
+            self._fused_seen_signatures = {}  # insertion-ordered → FIFO eviction
         signature = self._forward_signature(args, kwargs)
         seen = signature in self._fused_seen_signatures
         if seen:
@@ -395,9 +395,11 @@ class Metric(ABC):
             self._computed = None
             return batch_val
         result = self._forward_reduce_state_update_eager(*args, **kwargs)
-        self._fused_seen_signatures.add(signature)
+        self._fused_seen_signatures[signature] = None
         while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
-            self._fused_seen_signatures.pop()
+            # FIFO: evict the OLDEST signature (set.pop would be arbitrary and
+            # could flap the hot signature out of the cache)
+            self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
         return result
 
     def _forward_reduce_state_update_eager(self, *args: Any, **kwargs: Any) -> Any:

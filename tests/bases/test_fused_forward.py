@@ -289,3 +289,44 @@ def test_collection_seen_signatures_bounded():
         assert len(suite._fused_seen) <= 8
     finally:
         mt.Metric._FUSED_SIG_CAP = cap
+
+
+def test_aliased_member_instance_stays_member_wise():
+    """The same Metric instance under two keys must accumulate the batch once
+    PER KEY (the member-wise contract); suite fusion would merge it once, so
+    it must not engage (review regression)."""
+    shared = mt.MeanMetric()
+    suite = mt.MetricCollection({"a": shared, "b": shared})
+    p, _ = BATCHES[0]
+    for _ in range(3):
+        suite(p)
+    assert suite._fused_program is None
+    want = mt.MeanMetric()
+    want._fused_forward_ok = False
+    for _ in range(3):
+        want(p)
+        want(p)  # twice per step, like the shared instance
+    np.testing.assert_allclose(float(shared.compute()), float(want.compute()), atol=1e-6)
+
+
+def test_signature_eviction_is_fifo():
+    """Recurring (hot) signatures must survive eviction when distinct
+    signatures exceed the cap (review regression: set.pop is arbitrary)."""
+    metric = mt.MeanMetric()
+    cap, mt.Metric._FUSED_SIG_CAP = mt.Metric._FUSED_SIG_CAP, 4
+    try:
+        hot = BATCHES[0][0]
+        metric(hot)
+        metric(hot)  # hot signature fused
+        for n in range(70, 73):  # a few cold signatures, below cap pressure
+            metric(jnp.asarray(np.random.rand(n).astype(np.float32)))
+        # hot signature was inserted FIRST; after 3 cold inserts the cache is
+        # full (4) — one more cold insert evicts the OLDEST (hot)
+        metric(jnp.asarray(np.random.rand(99).astype(np.float32)))
+        assert len(metric._fused_seen_signatures) <= 4
+        # FIFO evicted `hot`: its next call re-validates eagerly, then re-fuses
+        metric(hot)
+        metric(hot)
+        assert metric._fused_forward is not None
+    finally:
+        mt.Metric._FUSED_SIG_CAP = cap
