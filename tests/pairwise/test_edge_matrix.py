@@ -1,0 +1,92 @@
+"""Pairwise distance corner cases vs the mounted reference.
+
+Zero vectors, duplicate rows (zero-diagonal semantics), single-row inputs,
+and the reduction surface — identical matrices through both stacks.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu.functional as F  # noqa: E402
+
+RNG = np.random.RandomState(47)
+X = RNG.randn(6, 5).astype(np.float32)
+Y = RNG.randn(4, 5).astype(np.float32)
+
+_FNS = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_manhattan_distance",
+    "pairwise_linear_similarity",
+]
+
+
+def _close(ours, theirs, atol=1e-4):
+    np.testing.assert_allclose(
+        np.asarray(ours, np.float64), theirs.numpy().astype(np.float64), atol=atol, rtol=1e-4, equal_nan=True
+    )
+
+
+@pytest.mark.parametrize("fn", _FNS)
+def test_two_input_parity(fn):
+    _close(getattr(F, fn)(jnp.asarray(X), jnp.asarray(Y)), getattr(_ref.functional, fn)(torch.tensor(X), torch.tensor(Y)))
+
+
+@pytest.mark.parametrize("fn", _FNS)
+def test_single_input_zero_diagonal(fn):
+    _close(getattr(F, fn)(jnp.asarray(X)), getattr(_ref.functional, fn)(torch.tensor(X)))
+
+
+@pytest.mark.parametrize("fn", _FNS)
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_reductions(fn, reduction):
+    _close(
+        getattr(F, fn)(jnp.asarray(X), jnp.asarray(Y), reduction=reduction),
+        getattr(_ref.functional, fn)(torch.tensor(X), torch.tensor(Y), reduction=reduction),
+    )
+
+
+@pytest.mark.parametrize("fn", _FNS)
+def test_zero_vector_rows(fn):
+    """A zero row makes cosine 0/0 — both stacks must agree cell-for-cell."""
+    x = X.copy()
+    x[0] = 0.0
+    _close(getattr(F, fn)(jnp.asarray(x), jnp.asarray(Y)), getattr(_ref.functional, fn)(torch.tensor(x), torch.tensor(Y)))
+
+
+@pytest.mark.parametrize("fn", _FNS)
+def test_duplicate_rows(fn):
+    """Identical rows across the two inputs: exact zeros / perfect similarity."""
+    y = np.concatenate([X[:2], Y[:2]], axis=0)
+    _close(getattr(F, fn)(jnp.asarray(X), jnp.asarray(y)), getattr(_ref.functional, fn)(torch.tensor(X), torch.tensor(y)))
+
+
+@pytest.mark.parametrize("fn", _FNS)
+def test_single_row_each(fn):
+    _close(
+        getattr(F, fn)(jnp.asarray(X[:1]), jnp.asarray(Y[:1])),
+        getattr(_ref.functional, fn)(torch.tensor(X[:1]), torch.tensor(Y[:1])),
+    )
+
+
+def test_invalid_ndim_rejected_in_both():
+    with pytest.raises(ValueError):
+        F.pairwise_cosine_similarity(jnp.zeros((2, 3, 4)))
+    with pytest.raises(ValueError):
+        _ref.functional.pairwise_cosine_similarity(torch.zeros(2, 3, 4))
+
+
+def test_bad_reduction_rejected_in_both():
+    with pytest.raises(ValueError):
+        F.pairwise_euclidean_distance(jnp.asarray(X), reduction="bogus")
+    with pytest.raises(ValueError):
+        _ref.functional.pairwise_euclidean_distance(torch.tensor(X), reduction="bogus")
