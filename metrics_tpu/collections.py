@@ -141,13 +141,19 @@ class MetricCollection:
             states = {name: {s: getattr(m, s) for s in m._defaults} for name, m in members}
             count = members[0][1]._update_count + 1
             merged, values = self._fused_program(states, count, *args, **consumed)
-        except Exception:
+        except Exception as exc:
             # member-wise fallback (full member-level semantics, incl. their
             # own fused paths); if that succeeds, this collection's combined
             # program is genuinely untraceable — stop re-trying every step.
             # If the fallback raises too, the input was bad: surface it and
             # keep the fused path enabled.
             result = self._forward_member_wise(members, *args, **kwargs)
+            rank_zero_warn(
+                f"Whole-suite fused forward for this MetricCollection raised "
+                f"{type(exc).__name__}: {exc}. Falling back to member-wise "
+                "forwards permanently for this collection — expect higher "
+                "per-step overhead. Construct a fresh collection to retry fusion."
+            )
             self._fused_disabled = True
             self._fused_program = None
             self._fused_templates = None

@@ -31,7 +31,7 @@ import copy
 import functools
 import inspect
 from abc import ABC, abstractmethod
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +48,9 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 def jit_distributed_available() -> bool:
     return _dist_available()
+
+
+_UNSET = object()  # sentinel: distinguishes "attribute absent" from "set to None"
 
 
 class Metric(ABC):
@@ -80,6 +83,7 @@ class Metric(ABC):
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = None
+    _full_state_warned: set = set()  # class names already warned about unset full_state_update
 
     def __init__(
         self,
@@ -142,6 +146,27 @@ class Metric(ABC):
         # wrap user update/compute with bookkeeping (reference `metric.py:121-122`)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute: Callable = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+        # A subclass that leaves `full_state_update` unset silently takes the
+        # two-update slow path in forward AND never engages the fused
+        # single-dispatch program — warn once per class with the remedy
+        # (reference `metric.py:139-151` warns likewise at construction)
+        cls = type(self)
+        cls_key = f"{cls.__module__}.{cls.__qualname__}"
+        if (
+            cls.full_state_update is None
+            and cls.forward is Metric.forward
+            and cls_key not in Metric._full_state_warned
+        ):
+            Metric._full_state_warned.add(cls_key)
+            rank_zero_warn(
+                f"Metric `{cls.__name__}` does not set `full_state_update`, so `forward` "
+                "defaults to the slow two-update path and the fused single-dispatch "
+                "program never engages. Set the class attribute `full_state_update=False` "
+                "if `update` does not read pre-existing state (verify with "
+                "`metrics_tpu.utils.checks.check_forward_full_state_property`), "
+                "or `True` to silence this warning and keep the current behaviour."
+            )
 
     # ------------------------------------------------------------------ state
     def add_state(
@@ -293,6 +318,21 @@ class Metric(ABC):
 
     @staticmethod
     def _forward_signature(args: tuple, kwargs: dict) -> tuple:
+        """Key a forward call by its input shapes/dtypes (arrays) and values
+        (python leaves).
+
+        Known limitation: a NON-array leaf that varies per call (a step
+        counter passed as a python int, a changing string) yields a new
+        signature every step, so such a metric never takes the fused path and
+        churns the FIFO signature cache — which is also semantically correct:
+        a python leaf is baked into the trace as a constant, so every distinct
+        value would force a retrace anyway. Pass per-step-varying values as
+        0-d ``jax.Array``s to make them traced inputs instead. Long reprs are
+        reduced to their hash (not retained); a hash collision between two
+        long reprs would skip the one-time eager validation pass for the
+        second one — validation mode "full" validates every call regardless.
+        """
+
         def leaf(a: Any):
             if hasattr(a, "shape") and hasattr(a, "dtype"):
                 return (tuple(a.shape), str(a.dtype))
@@ -373,12 +413,18 @@ class Metric(ABC):
                     self._fused_forward = self._build_fused_forward()
                 state = {name: getattr(self, name) for name in self._defaults}
                 merged, batch_val = self._fused_forward(state, self._update_count + 1, *args, **kwargs)
-            except Exception:
+            except Exception as exc:
                 # fall back; if the eager path then succeeds, the metric is
                 # genuinely unfusable — stop re-tracing every step. If eager
                 # raises too, the input itself was bad: surface that error and
                 # keep the fused path enabled.
                 result = self._forward_reduce_state_update_eager(*args, **kwargs)
+                rank_zero_warn(
+                    f"Fused forward for `{type(self).__name__}` raised "
+                    f"{type(exc).__name__}: {exc}. Falling back to the eager "
+                    "per-op path permanently for this instance — expect higher "
+                    "per-step overhead. Construct a fresh instance to retry fusion."
+                )
                 self._fused_forward_ok = False
                 self._fused_forward = None
                 self._fused_template = None
@@ -716,11 +762,27 @@ class Metric(ABC):
         ):
             # the version counter always moves (a MetricCollection's fused
             # whole-suite program watches it even when this metric never
-            # built its own); the member-level program is dropped if present
-            object.__setattr__(self, "_fused_version", self.__dict__.get("_fused_version", 0) + 1)
-            if self.__dict__.get("_fused_forward") is not None:
-                object.__setattr__(self, "_fused_forward", None)
-                object.__setattr__(self, "_fused_template", None)
+            # built its own); the member-level program is dropped if present.
+            # Re-assigning the SAME value (metrics that recompute an inferred
+            # hyperparameter like `mode` inside update) is not a change and
+            # must not churn the suite program — compare only python scalars,
+            # where == is cheap and unambiguous (arrays are never equal by
+            # identity semantics worth trusting here).
+            # only immutable scalar types qualify: a mutable container
+            # re-assigned after in-place mutation is identical by `is` yet its
+            # baked-in trace constants are stale, so it must still invalidate
+            old = self.__dict__.get(name, _UNSET)
+            unchanged = (
+                old is not _UNSET
+                and isinstance(value, (bool, int, float, str, bytes, type(None)))
+                and type(old) is type(value)
+                and (old is value or old == value)
+            )
+            if not unchanged:
+                object.__setattr__(self, "_fused_version", self.__dict__.get("_fused_version", 0) + 1)
+                if self.__dict__.get("_fused_forward") is not None:
+                    object.__setattr__(self, "_fused_forward", None)
+                    object.__setattr__(self, "_fused_template", None)
         object.__setattr__(self, name, value)
 
     def __hash__(self) -> int:

@@ -330,3 +330,64 @@ def test_signature_eviction_is_fifo():
         assert metric._fused_forward is not None
     finally:
         mt.Metric._FUSED_SIG_CAP = cap
+
+
+def test_same_value_reassignment_keeps_fused_program():
+    """Re-assigning an unchanged public attribute (a metric that re-derives an
+    inferred hyperparameter inside update) must NOT invalidate the fused
+    program (advisor regression: every write bumped _fused_version)."""
+    metric = mt.MeanMetric()
+    p, _ = BATCHES[0]
+    metric(p)
+    metric(p)
+    assert metric._fused_forward is not None
+    version = metric._fused_version
+    metric.sync_on_compute = metric.sync_on_compute  # same value
+    assert metric._fused_version == version
+    assert metric._fused_forward is not None
+    metric.sync_on_compute = not metric.sync_on_compute  # genuine change
+    assert metric._fused_version == version + 1
+
+
+def test_fused_disable_emits_warning():
+    """Permanently disabling a fused path must warn (advisor: silent
+    performance degradation is undiagnosable)."""
+
+    class _Flaky(mt.MeanMetric):
+        boom = False
+
+    metric = _Flaky()
+    p, _ = BATCHES[0]
+    metric(p)
+    # sabotage the built program so the NEXT fused call raises
+    metric._fused_forward = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("synthetic"))
+    with pytest.warns(UserWarning, match="Falling back to the eager"):
+        metric(p)
+    assert metric._fused_forward_ok is False
+
+
+def test_unset_full_state_update_warns_once_per_class():
+    """Reference parity (`metric.py:139-151`): leaving full_state_update=None
+    silently picks the slow two-update forward — warn once, with the remedy."""
+    import warnings
+
+    class _Unset(mt.Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", 0.0, "sum")
+
+        def update(self, v):
+            self.total = self.total + jnp.sum(v)
+
+        def compute(self):
+            return self.total
+
+    # the dedup set is process-global; drop this class's key so the test is
+    # independent of prior constructions (e.g. under pytest-repeat)
+    mt.Metric._full_state_warned.discard(f"{_Unset.__module__}.{_Unset.__qualname__}")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        _Unset()
+        _Unset()
+    hits = [w for w in caught if "full_state_update" in str(w.message)]
+    assert len(hits) == 1
