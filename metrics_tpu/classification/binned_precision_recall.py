@@ -16,6 +16,7 @@ from typing import Any, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute_with_precision_recall,
@@ -62,13 +63,18 @@ class BinnedPrecisionRecallCurve(Metric):
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
+        # thresholds live on HOST (numpy): they're a static hyperparameter that
+        # jit traces bake in as a constant, and embedding a DEVICE array as a
+        # compile-time constant forces a device->host fetch at trace time —
+        # which on tunneled backends permanently degrades blocking-sync cost
+        # for the whole session (docs/performance.md "The D2H sync cliff")
         if isinstance(thresholds, int):
             self.num_thresholds = thresholds
-            self.thresholds = jnp.linspace(0, 1.0, thresholds)
+            self.thresholds = np.linspace(0, 1.0, thresholds, dtype=np.float32)
         elif thresholds is not None:
-            if not isinstance(thresholds, (list, jnp.ndarray, jax.Array)):
+            if not isinstance(thresholds, (list, np.ndarray, jnp.ndarray, jax.Array)):
                 raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
-            self.thresholds = jnp.asarray(thresholds)
+            self.thresholds = np.asarray(thresholds, dtype=np.float32)
             self.num_thresholds = self.thresholds.size
 
         for name in ("TPs", "FPs", "FNs"):
@@ -97,9 +103,10 @@ class BinnedPrecisionRecallCurve(Metric):
         recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
         precisions = jnp.concatenate([precisions, jnp.ones((self.num_classes, 1), dtype=precisions.dtype)], axis=1)
         recalls = jnp.concatenate([recalls, jnp.zeros((self.num_classes, 1), dtype=recalls.dtype)], axis=1)
+        thresholds = jnp.asarray(self.thresholds)  # host constant -> device array for the API
         if self.num_classes == 1:
-            return precisions[0, :], recalls[0, :], self.thresholds
-        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+            return precisions[0, :], recalls[0, :], thresholds
+        return list(precisions), list(recalls), [thresholds for _ in range(self.num_classes)]
 
 
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):

@@ -1,18 +1,32 @@
-"""CalibrationError module metric (reference `classification/calibration_error.py`)."""
+"""CalibrationError module metric (reference `classification/calibration_error.py`).
+
+TPU-first redesign: the reference accumulates RAW ``confidences``/
+``accuracies`` lists (`calibration_error.py:77-80` adds them to cat states) —
+O(N) memory, unbounded shapes, an all_gather to sync. But every supported norm
+(l1/l2/max) is a function of the PER-BIN sums only, and the bin boundaries are
+a fixed uniform grid, so per-element bucketization commutes with batching:
+three ``(n_bins,)`` sum states carry the identical information with O(1)
+memory, a single ``psum`` to sync, and a fully jittable fixed-shape update
+(the cat formulation can never fuse — its pytree grows every step).
+"""
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from metrics_tpu.functional.classification.calibration_error import _ce_compute, _ce_update
+from metrics_tpu.functional.classification.calibration_error import (
+    _bin_sums,
+    _ce_from_bin_sums,
+    _ce_update,
+)
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CalibrationError(Metric):
-    """Expected/max/RMS calibration error over accumulated confidences."""
+    """Expected/max/RMS calibration error over accumulated per-bin statistics."""
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = False
@@ -27,19 +41,42 @@ class CalibrationError(Metric):
             raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
         self.n_bins = n_bins
         self.norm = norm
-        self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        # host-resident (numpy): a static trace constant — a device array here
+        # would force a D2H fetch at every jit trace that closes over it
+        # (docs/performance.md "The D2H sync cliff")
+        self.bin_boundaries = np.linspace(0, 1, n_bins + 1, dtype=np.float32)
+        # counts AND accuracy sums are int32 — both integer-valued, so they
+        # accumulate exactly to 2^31 samples per bin (a float32 running sum
+        # stops incrementing at 2^24). conf_bin is a float32 sum of values in
+        # [0, 1]: once a bin's sum passes ~2^24 its per-sample additions lose
+        # low bits, bounding the per-bin mean-confidence error at roughly
+        # n_updates · ulp(sum) / count — negligible below tens of millions of
+        # samples per bin, documented rather than hidden.
+        self.add_state("count_bin", jnp.zeros(n_bins, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("conf_bin", jnp.zeros(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("acc_bin", jnp.zeros(n_bins, dtype=jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds, target) -> None:
         confidences, accuracies = _ce_update(preds, target)
-        self.confidences.append(confidences)
-        self.accuracies.append(accuracies)
+        count, conf, acc = _bin_sums(confidences, accuracies, self.bin_boundaries)
+        self.count_bin = self.count_bin + count
+        self.conf_bin = self.conf_bin + conf
+        # accuracies are exact 0/1 floats; the per-batch sum is integer-valued
+        # and well under 2^24, so the int32 cast is exact
+        self.acc_bin = self.acc_bin + acc.astype(jnp.int32)
 
     def compute(self) -> jax.Array:
-        confidences = dim_zero_cat(self.confidences)
-        accuracies = dim_zero_cat(self.accuracies)
-        return _ce_compute(confidences, accuracies, self.bin_boundaries, norm=self.norm)
+        # parity with the cat-state formulation (and the reference), which
+        # raised from concatenating an empty list — a silent all-NaN would
+        # hide the misuse. The python-level count check keeps the common
+        # module path free of device reads; the state-sum check covers
+        # `as_functions` exports (whose bare clone has no update count) and
+        # only runs when the python count says "never updated". Under jit the
+        # values are unknowable: the traced result is NaN, as for any 0/0.
+        if self._update_count == 0 and not isinstance(self.count_bin, jax.core.Tracer):
+            if int(jnp.sum(self.count_bin)) == 0:
+                raise ValueError("No samples to compute calibration error over; call `update` first")
+        return _ce_from_bin_sums(self.count_bin, self.conf_bin, self.acc_bin, self.norm)
 
 
 __all__ = ["CalibrationError"]

@@ -15,20 +15,48 @@ from metrics_tpu.utils.checks import _input_format_classification
 from metrics_tpu.utils.enums import DataType
 
 
+def _bin_sums(
+    confidences: jax.Array, accuracies: jax.Array, bin_boundaries: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-bin (count, conf-sum, acc-sum) — the sufficient statistics for every
+    supported norm; shared by the one-shot functional path and the streaming
+    module metric's sum states."""
+    n_bins = bin_boundaries.shape[0] - 1
+    indices = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="left") - 1, 0, n_bins - 1)
+    # counts accumulate EXACTLY in int32 (a float32 counter silently stops
+    # incrementing at 2^24 samples per bin); value sums stay float32
+    count_bin = jnp.zeros(n_bins, dtype=jnp.int32).at[indices].add(1)
+    conf_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(confidences)
+    acc_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(accuracies)
+    return count_bin, conf_bin, acc_bin
+
+
+def _ce_from_bin_sums(
+    count_bin: jax.Array, conf_bin: jax.Array, acc_bin: jax.Array, norm: str = "l1"
+) -> jax.Array:
+    """Calibration error from per-bin sufficient statistics (any norm)."""
+    counts = count_bin.astype(conf_bin.dtype)
+    safe = jnp.where(count_bin == 0, 1.0, counts)
+    conf = jnp.where(count_bin == 0, 0.0, conf_bin / safe)
+    acc = jnp.where(count_bin == 0, 0.0, acc_bin / safe)
+    prop = counts / counts.sum()
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc - conf) * prop)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc - conf))
+    ce = jnp.sum((acc - conf) ** 2 * prop)
+    return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
+
+
 def _binning_bucketize(
     confidences: jax.Array, accuracies: jax.Array, bin_boundaries: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    n_bins = bin_boundaries.shape[0] - 1
-    indices = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="left") - 1, 0, n_bins - 1)
-
-    count_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(1.0)
-    conf_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(confidences)
-    acc_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(accuracies)
-
-    safe = jnp.where(count_bin == 0, 1.0, count_bin)
-    conf_bin = jnp.where(count_bin == 0, 0.0, conf_bin / safe)
-    acc_bin = jnp.where(count_bin == 0, 0.0, acc_bin / safe)
-    prop_bin = count_bin / count_bin.sum()
+    count_bin, conf_sum, acc_sum = _bin_sums(confidences, accuracies, bin_boundaries)
+    counts = count_bin.astype(confidences.dtype)
+    safe = jnp.where(count_bin == 0, 1.0, counts)
+    conf_bin = jnp.where(count_bin == 0, 0.0, conf_sum / safe)
+    acc_bin = jnp.where(count_bin == 0, 0.0, acc_sum / safe)
+    prop_bin = counts / counts.sum()
     return acc_bin, conf_bin, prop_bin
 
 
@@ -42,18 +70,13 @@ def _ce_compute(
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
 
-    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
-
-    if norm == "l1":
-        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
-    if norm == "max":
-        return jnp.max(jnp.abs(acc_bin - conf_bin))
-    # l2
-    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
-    if debias:
+    if norm == "l2" and debias:
+        acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+        ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
         debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * accuracies.shape[0] - 1)
         ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
-    return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
+        return jnp.where(ce > 0, jnp.sqrt(jnp.where(ce > 0, ce, 1.0)), 0.0)
+    return _ce_from_bin_sums(*_bin_sums(confidences, accuracies, bin_boundaries), norm=norm)
 
 
 def _ce_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array]:

@@ -183,7 +183,14 @@ class Metric(ABC):
         if is_list and len(default) != 0:
             raise ValueError("State defaults of list kind must be empty lists")
         if not is_list:
+            # canonicalize to a STRONG dtype: `jnp.asarray(0.0)` is weak-typed,
+            # and a weak-typed default state retraces every jitted consumer
+            # (fused forward, `as_functions` update) on its second call, when
+            # the first update's strong-typed result replaces it — one hidden
+            # ~seconds recompile per metric on remote backends
             default = jnp.asarray(default)
+            if getattr(default, "weak_type", False):
+                default = jax.lax.convert_element_type(default, default.dtype)
 
         spec, fn = resolve_reduction(dist_reduce_fx)
         self._defaults[name] = default
@@ -383,6 +390,228 @@ class Metric(ABC):
         # once per input signature; XLA's persistent compilation cache dedupes
         # the identical HLO across them when enabled.
         return jax.jit(step)
+
+    # ------------------------------------------------- batched-step (scan) API
+    # Even the fused forward pays one dispatch round trip per step, and on
+    # remote/tunneled backends a D2H value read (any `float(metric.compute())`)
+    # permanently stops the backend overlapping dependent dispatches — each
+    # step then costs a full round trip (~ms). `update_many`/`forward_many`
+    # take inputs with a leading steps axis and run ALL steps as one
+    # `lax.scan` program: one dispatch per chunk, amortizing the round trip
+    # to chunk_len⁻¹ of a step. This is the per-step-overhead hot path for
+    # train loops that keep the module API (reference integration surface,
+    # `src/torchmetrics/metric.py:228-325`, which has no batched analogue).
+    _many_program_vals: Optional[Callable] = None
+    _many_program_novals: Optional[Callable] = None
+    # one template PER program: each trace populates its template's inferred
+    # static attrs, and propagating attrs from the other program's template
+    # would cross-contaminate (e.g. a mode inferred from different inputs)
+    _many_template_vals: Optional["Metric"] = None
+    _many_template_novals: Optional["Metric"] = None
+    _many_ok: bool = True  # batched-path health; independent of _fused_forward_ok
+
+    @staticmethod
+    def _split_many_leaves(args: tuple, kwargs: dict):
+        """Partition (args, kwargs) leaves for the scan program.
+
+        Three kinds: **scanned** leaves (arrays with the leading steps axis,
+        ndim>=1 — `lax.scan` xs), **array constants** (0-d arrays — traced
+        per-chunk operands, so their values stay out of the program cache
+        key), and **python constants** (scalars/strings — baked into the
+        trace; the chunk signature keys on their repr, so a changed value
+        retraces). The eager loop applies the same slicing rule.
+        """
+        leaves, treedef = jax.tree.flatten((args, kwargs))
+        scanned_idx, aconst_idx = [], []
+        python_leaves = []
+        for i, x in enumerate(leaves):
+            if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1:
+                scanned_idx.append(i)
+                python_leaves.append(None)  # replaced per step; not retained
+            elif hasattr(x, "shape"):
+                aconst_idx.append(i)
+                python_leaves.append(None)  # replaced per call; not retained
+            else:
+                python_leaves.append(x)
+        scanned = tuple(leaves[i] for i in scanned_idx)
+        array_consts = tuple(leaves[i] for i in aconst_idx)
+        if not scanned:
+            raise ValueError(
+                "update_many/forward_many need at least one array argument with a leading steps axis"
+            )
+        lengths = {int(x.shape[0]) for x in scanned}
+        if len(lengths) != 1:
+            # silent length mismatch would be worse than an error: jnp gather
+            # CLAMPS out-of-bounds indices, so the eager slicing loop would
+            # quietly reuse the last step of the short array
+            raise ValueError(
+                f"All chunked (ndim>=1) arguments must share the same leading steps-axis "
+                f"length; got lengths {sorted(lengths)}. Pass per-chunk constants as "
+                f"python scalars or 0-d arrays."
+            )
+        return python_leaves, treedef, scanned_idx, aconst_idx, scanned, array_consts
+
+    def update_many(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate a CHUNK of update calls in one dispatch.
+
+        Every array argument carries a leading ``steps`` axis: calling
+        ``update_many(preds, target)`` with shapes ``(n, *batch_shape)`` is
+        equivalent to ``n`` sequential ``update(preds[i], target[i])`` calls.
+        """
+        self._run_many(False, args, kwargs)
+
+    def forward_many(self, *args: Any, **kwargs: Any) -> Any:
+        """``forward`` over a chunk of steps in one dispatch.
+
+        Returns the per-step batch values stacked along a leading axis —
+        ``forward_many(preds, target)[i]`` equals what
+        ``forward(preds[i], target[i])`` would have returned at that step.
+        """
+        return self._run_many(True, args, kwargs)
+
+    def _run_many(self, with_values: bool, args: tuple, kwargs: dict) -> Any:
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        if self._is_synced:
+            # same guard as forward (reference `metric.py:240-244`): merging
+            # batch state into globally-reduced state double-counts at resync
+            raise MetricsUserError(
+                "The Metric shouldn't be synced when performing `forward_many`/`update_many`. "
+                "HINT: Did you forget to call `unsync()`?"
+            )
+        fusable = (
+            self._many_ok
+            and self._fused_forward_ok
+            and _get_validation_mode() != "full"
+            and self._fusable_states()
+            and not (self.full_state_update or self.full_state_update is None or self.dist_sync_on_step)
+            # a subclass overriding forward() defines its own step semantics;
+            # the scan program is built from update/compute and would bypass it
+            and type(self).forward is Metric.forward
+        )
+        if not fusable:
+            return self._run_many_eager(with_values, args, kwargs)
+        if self._fused_seen_signatures is None:
+            self._fused_seen_signatures = {}
+        signature = ("__many__", with_values, self._forward_signature(args, kwargs))
+        if signature not in self._fused_seen_signatures:
+            # first sight of a chunk signature: eager per-step forwards (full
+            # validation; the scan program would have to trace anyway). The
+            # per-step REDUCE-eager path is forced so the chunk does not also
+            # register the single-step signature and jit-compile the
+            # single-step fused program the scan path will never use. The
+            # signature is recorded only AFTER the chunk validates — a failed
+            # chunk must not license the unvalidated scan path for a retry
+            # (same contract as the single-step path below).
+            result = self._run_many_eager(with_values, args, kwargs, force_reduce_eager=True)
+            self._fused_seen_signatures[signature] = None
+            while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
+                self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
+            return result
+        try:
+            program = self._many_program_vals if with_values else self._many_program_novals
+            python_leaves, treedef, scanned_idx, aconst_idx, scanned, array_consts = (
+                self._split_many_leaves(args, kwargs)
+            )
+            # the program closure bakes in the call LAYOUT (tree structure,
+            # leaf-kind partition) and the python-constant VALUES; a call with
+            # a different layout or changed python constants must rebuild —
+            # jax.jit would otherwise reuse a trace with stale baked values
+            # (the aval-keyed jit cache cannot see python-leaf changes)
+            layout = (treedef, tuple(scanned_idx), tuple(aconst_idx), repr(python_leaves))
+            layout_attr = "_many_layout_vals" if with_values else "_many_layout_novals"
+            if program is not None and getattr(self, layout_attr, None) != layout:
+                program = None
+            if program is None:
+                template, step = self._build_fused_step()
+
+                def program(state, update_count, xs, const_vals):
+                    def body(carry, xs_leaves):
+                        st, cnt = carry
+                        cnt = cnt + 1
+                        step_leaves = list(python_leaves)
+                        for i, leaf in zip(scanned_idx, xs_leaves):
+                            step_leaves[i] = leaf
+                        for i, leaf in zip(aconst_idx, const_vals):
+                            step_leaves[i] = leaf
+                        a, k = jax.tree.unflatten(treedef, step_leaves)
+                        new_st, val = step(st, cnt, *a, **k)
+                        return (new_st, cnt), (val if with_values else 0)
+
+                    (final, _), vals = jax.lax.scan(
+                        body, (state, jnp.asarray(update_count, jnp.int32)), xs
+                    )
+                    return final, vals
+
+                program = jax.jit(program)
+                if with_values:
+                    self._many_program_vals = program
+                    self._many_template_vals = template
+                else:
+                    self._many_program_novals = program
+                    self._many_template_novals = template
+                object.__setattr__(self, layout_attr, layout)
+            template = self._many_template_vals if with_values else self._many_template_novals
+            state = {name: getattr(self, name) for name in self._defaults}
+            n_steps = int(scanned[0].shape[0])
+            merged, values = program(state, self._update_count, scanned, array_consts)
+        except Exception as exc:
+            # eager fallback; if it succeeds, only the BATCHED path is deemed
+            # untraceable — the single-step fused forward keeps its own flag
+            # (one bad chunk must not cost every later forward() its fast
+            # path). If the fallback raises too, the input was bad: surface
+            # it and keep the batched path enabled.
+            result = self._run_many_eager(with_values, args, kwargs)
+            rank_zero_warn(
+                f"Batched-step program for `{type(self).__name__}` raised "
+                f"{type(exc).__name__}: {exc}. Falling back to per-step eager "
+                "forwards permanently for this instance's batched API."
+            )
+            self._many_ok = False
+            self._many_program_vals = None
+            self._many_program_novals = None
+            self._many_template_vals = None
+            self._many_template_novals = None
+            return result
+        for name, value in merged.items():
+            setattr(self, name, value)
+        _propagate_static_attrs(template, self)
+        self._update_count += n_steps
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        if with_values:
+            # keep the forward contract: _forward_cache is the LAST step's
+            # batch value, exactly as n sequential forward calls would leave it
+            self._forward_cache = jax.tree.map(lambda v: v[-1], values)
+            return values
+        return None
+
+    def _run_many_eager(
+        self, with_values: bool, args: tuple, kwargs: dict, force_reduce_eager: bool = False
+    ) -> Any:
+        # the same partition (and length validation) as the scan path — the
+        # first-chunk-eager licensing contract requires both paths to slice
+        # identically
+        _, _, _, _, scanned, _ = self._split_many_leaves(args, kwargs)
+        n_steps = int(scanned[0].shape[0])
+        values = []
+        for i in range(n_steps):
+            # array leaves carry the steps axis; python scalars/strings and
+            # 0-d arrays are per-chunk constants and pass through to every step
+            a, k = jax.tree.map(
+                lambda x: x[i] if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 else x,
+                (args, kwargs),
+            )
+            if force_reduce_eager:
+                self._forward_cache = self._forward_reduce_state_update_eager(*a, **k)
+                values.append(self._forward_cache)
+            else:
+                values.append(self.forward(*a, **k))
+        if not with_values:
+            return None
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *values)
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Single-update fast path: batch state is merged into global state.
@@ -734,7 +963,18 @@ class Metric(ABC):
         # drop the wrapped bound methods (re-wrapped on unpickle, reference
         # `metric.py:568-577`) and the fused-forward machinery (jit closures
         # don't pickle/deepcopy; rebuilt lazily on first fused call)
-        drop = ("update", "compute", "_fused_forward", "_fused_template")
+        drop = (
+            "update",
+            "compute",
+            "_fused_forward",
+            "_fused_template",
+            "_many_program_vals",
+            "_many_program_novals",
+            "_many_template_vals",
+            "_many_template_novals",
+            "_many_layout_vals",
+            "_many_layout_novals",
+        )
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -783,6 +1023,14 @@ class Metric(ABC):
                 if self.__dict__.get("_fused_forward") is not None:
                     object.__setattr__(self, "_fused_forward", None)
                     object.__setattr__(self, "_fused_template", None)
+                if (
+                    self.__dict__.get("_many_program_vals") is not None
+                    or self.__dict__.get("_many_program_novals") is not None
+                ):
+                    object.__setattr__(self, "_many_program_vals", None)
+                    object.__setattr__(self, "_many_program_novals", None)
+                    object.__setattr__(self, "_many_template_vals", None)
+                    object.__setattr__(self, "_many_template_novals", None)
         object.__setattr__(self, name, value)
 
     def __hash__(self) -> int:

@@ -391,3 +391,262 @@ def test_unset_full_state_update_warns_once_per_class():
         _Unset()
     hits = [w for w in caught if "full_state_update" in str(w.message)]
     assert len(hits) == 1
+
+
+class TestBatchedStepAPI:
+    """`update_many`/`forward_many`: N steps in one `lax.scan` dispatch must
+    agree bit-for-bit in semantics with N sequential `forward` calls."""
+
+    def _chunk(self, n=6, batch=32):
+        rng = np.random.RandomState(7)
+        return (
+            jnp.asarray(rng.rand(n, batch).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 2, (n, batch))),
+        )
+
+    @pytest.mark.parametrize(
+        "factory",
+        [
+            lambda: mt.Accuracy(),
+            lambda: mt.MeanMetric(),
+            lambda: mt.MaxMetric(),
+            lambda: mt.MeanSquaredError(),
+        ],
+        ids=["Accuracy", "MeanMetric", "MaxMetric", "MSE"],
+    )
+    def test_matches_sequential_forward(self, factory):
+        p, t = self._chunk()
+        single_input = factory().update.__wrapped__.__code__.co_argcount == 2
+
+        many = factory()
+        seq = factory()
+        seq._fused_forward_ok = False  # reference eager path
+        args_many = (p,) if single_input else (p, t)
+        vals_first = many.forward_many(*args_many)   # first call: eager-validated
+        vals_fused = factory()
+        vals2 = vals_fused.forward_many(*args_many)  # fresh instance, same shapes
+        vals3 = vals_fused.forward_many(*args_many)  # second call: scan program
+        assert vals_fused._many_program_vals is not None
+
+        seq_vals = []
+        for i in range(p.shape[0]):
+            a = (p[i],) if single_input else (p[i], t[i])
+            seq_vals.append(seq(*a))
+            seq_vals.append(seq(*a))  # vals_fused saw each chunk twice
+        want = np.asarray(seq_vals[::2])[: p.shape[0]]
+        np.testing.assert_allclose(np.asarray(vals_first), want, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vals2), np.asarray(vals_first), atol=1e-6)
+        # state after two chunks == 2x sequential updates
+        np.testing.assert_allclose(
+            np.asarray(vals_fused.compute()), np.asarray(seq.compute()), atol=1e-6
+        )
+        assert vals_fused._update_count == 2 * p.shape[0]
+
+    def test_update_many_returns_none_and_accumulates(self):
+        p, t = self._chunk()
+        m = mt.Accuracy()
+        assert m.update_many(p, t) is None
+        m.update_many(p, t)  # second call takes the scan program
+        assert m._many_program_novals is not None
+        ref = mt.Accuracy()
+        for i in range(p.shape[0]):
+            ref.update(p[i], t[i])
+            ref.update(p[i], t[i])
+        np.testing.assert_allclose(float(m.compute()), float(ref.compute()), atol=1e-6)
+
+    def test_list_state_metric_uses_eager_loop(self):
+        p, _ = self._chunk()
+        m = mt.CatMetric()
+        vals = m.forward_many(p)
+        assert m._many_program_vals is None
+        assert np.asarray(vals).shape[0] == p.shape[0]
+        assert np.asarray(m.compute()).shape == (p.shape[0] * p.shape[1],)
+
+    def test_hyperparameter_mutation_invalidates_many_program(self):
+        p, t = self._chunk()
+        m = mt.Accuracy()
+        m.update_many(p, t)
+        m.update_many(p, t)
+        assert m._many_program_novals is not None
+        m.threshold = 0.7
+        assert m._many_program_novals is None
+
+    def test_pickle_after_many_use(self):
+        p, t = self._chunk()
+        m = mt.Accuracy()
+        m.forward_many(p, t)
+        m.forward_many(p, t)
+        m2 = pickle.loads(pickle.dumps(m))
+        np.testing.assert_allclose(float(m2.compute()), float(m.compute()), atol=1e-6)
+        m2.forward_many(p, t)  # program rebuilds lazily
+
+
+def test_forward_override_keeps_eager_many_path():
+    """A subclass with a custom forward() must not have forward_many swap in
+    scan semantics that bypass the override (review regression)."""
+
+    class _Halving(mt.MeanMetric):
+        def forward(self, v):
+            return super().forward(v * 0.5)
+
+    rng = np.random.RandomState(3)
+    chunk = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    m = _Halving()
+    m.forward_many(chunk)
+    vals = m.forward_many(chunk)
+    assert m._many_program_vals is None  # never fused
+    want = _Halving()
+    for i in range(4):
+        want.forward(chunk[i])
+        want.forward(chunk[i])
+    np.testing.assert_allclose(float(m.compute()), float(want.compute()), atol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(vals)[-1]), float(want._forward_cache), atol=1e-6)
+
+
+def test_forward_cache_tracks_last_step_through_fused_many():
+    rng = np.random.RandomState(4)
+    chunk = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    m = mt.MeanMetric()
+    m.forward_many(chunk)
+    vals = m.forward_many(chunk)  # scan program
+    assert m._many_program_vals is not None
+    np.testing.assert_allclose(
+        float(m._forward_cache), float(np.asarray(vals)[-1]), atol=1e-6
+    )
+
+
+def test_first_many_chunk_does_not_compile_single_step_program():
+    """The eager first chunk must not register per-step signatures (the
+    single-step fused program would compile and never be used)."""
+    rng = np.random.RandomState(5)
+    chunk = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    m = mt.MeanMetric()
+    m.forward_many(chunk)
+    assert m._fused_forward is None
+    per_step = [s for s in (m._fused_seen_signatures or {}) if not (isinstance(s, tuple) and s and s[0] == "__many__")]
+    assert per_step == []
+
+
+def test_many_signature_not_registered_on_failed_chunk():
+    """A chunk that fails validation must not register the chunk signature —
+    a same-shaped retry stays on the eager path (matching the single-step
+    contract, which registers only after the eager call succeeds); the scan
+    program may only build after a chunk that completed (review regression)."""
+    m = mt.Accuracy()
+    p = jnp.asarray(np.random.RandomState(8).rand(3, 16).astype(np.float32))
+    bad = jnp.asarray([[-1] * 16] * 3)
+    with pytest.raises(ValueError):
+        m.forward_many(p, bad)
+    assert not (m._fused_seen_signatures or {})  # failed chunk left no license
+    good = jnp.asarray((np.random.RandomState(8).rand(3, 16) > 0.5).astype(np.int64))
+    m.forward_many(p, good)  # first SUCCESSFUL chunk: eager, registers
+    assert m._many_program_vals is None
+    m.forward_many(p, good)  # now the scan program builds
+    assert m._many_program_vals is not None
+
+
+def test_scalar_kwarg_rides_fused_many_path():
+    """Python-scalar and 0-d-array kwargs are per-chunk constants; they must
+    not defeat fusion (review regression: silent permanent eager loop)."""
+    rng = np.random.RandomState(9)
+    chunk = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    m = mt.MeanMetric()
+    m.forward_many(chunk, weight=0.5)
+    m.forward_many(chunk, weight=0.5)
+    assert m._many_program_vals is not None  # fused despite the scalar kwarg
+    want = mt.MeanMetric()
+    for i in range(4):
+        want(chunk[i], weight=0.5)
+        want(chunk[i], weight=0.5)
+    np.testing.assert_allclose(float(m.compute()), float(want.compute()), atol=1e-6)
+    # changed python constant: new signature -> eager validation -> rebuilt
+    # program with the NEW value baked (not the stale 0.5 trace)
+    m2 = mt.MeanMetric()
+    m2.forward_many(chunk, weight=0.5)
+    m2.forward_many(chunk, weight=0.5)
+    m2.forward_many(chunk, weight=2.0)
+    m2.forward_many(chunk, weight=2.0)
+    want2 = mt.MeanMetric()
+    for w in (0.5, 0.5, 2.0, 2.0):
+        for i in range(4):
+            want2(chunk[i], weight=w)
+    np.testing.assert_allclose(float(m2.compute()), float(want2.compute()), atol=1e-6)
+
+
+def test_0d_array_kwarg_rides_fused_many_path():
+    rng = np.random.RandomState(10)
+    chunk = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    m = mt.MeanMetric()
+    w = jnp.asarray(0.25)
+    m.forward_many(chunk, weight=w)
+    m.forward_many(chunk, weight=w)
+    assert m._many_program_vals is not None
+    want = mt.MeanMetric()
+    for i in range(4):
+        want(chunk[i], weight=w)
+        want(chunk[i], weight=w)
+    np.testing.assert_allclose(float(m.compute()), float(want.compute()), atol=1e-6)
+
+
+def test_many_on_synced_metric_raises():
+    from metrics_tpu.utils.exceptions import MetricsUserError
+
+    m = mt.MeanMetric()
+    p = jnp.asarray(np.random.RandomState(11).rand(2, 8).astype(np.float32))
+    m.forward_many(p)
+    m.sync(dist_sync_fn=lambda x, group=None: [x], distributed_available=lambda: True)
+    with pytest.raises(MetricsUserError, match="synced"):
+        m.forward_many(p)
+    m.unsync()
+    m.forward_many(p)
+
+
+def test_separate_templates_for_vals_and_novals_programs():
+    """update_many and forward_many trace separately; attr propagation must
+    use the matching template (review regression: shared slot)."""
+    rng = np.random.RandomState(12)
+    p5 = rng.rand(3, 32, 5).astype(np.float32)
+    p5 /= p5.sum(-1, keepdims=True)
+    t5 = rng.randint(0, 5, (3, 32))
+    m = mt.Accuracy(num_classes=5, average="macro")
+    m.update_many(jnp.asarray(p5), jnp.asarray(t5))
+    m.update_many(jnp.asarray(p5), jnp.asarray(t5))
+    m.forward_many(jnp.asarray(p5), jnp.asarray(t5))
+    m.forward_many(jnp.asarray(p5), jnp.asarray(t5))
+    assert m._many_template_vals is not m._many_template_novals
+    want = mt.Accuracy(num_classes=5, average="macro")
+    for _ in range(4):
+        for i in range(3):
+            want.update(jnp.asarray(p5[i]), jnp.asarray(t5[i]))
+    np.testing.assert_allclose(float(m.compute()), float(want.compute()), atol=1e-6)
+
+
+def test_mismatched_chunk_lengths_raise():
+    """Silent index clamping on a leading-axis mismatch would corrupt state;
+    both the eager and scan paths must reject it (review regression)."""
+    m = mt.Accuracy()
+    p = jnp.asarray(np.random.RandomState(13).rand(4, 16).astype(np.float32))
+    t = jnp.asarray((np.random.RandomState(13).rand(3, 16) > 0.5).astype(np.int64))
+    with pytest.raises(ValueError, match="same leading steps-axis"):
+        m.forward_many(p, t)
+
+
+def test_batched_fallback_does_not_disable_single_step_fusion():
+    """One bad chunk may disable only the batched path; plain forward() keeps
+    its fused program (review regression: shared flag)."""
+    rng = np.random.RandomState(14)
+    chunk = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    m = mt.MeanMetric()
+    m.forward_many(chunk)
+    m.forward_many(chunk)  # scan program built, layout recorded
+    assert m._many_program_vals is not None
+    # sabotage the built program so the next chunk raises inside it
+    m._many_program_vals = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("synthetic"))
+    with pytest.warns(UserWarning, match="batched API"):
+        m.forward_many(chunk)
+    assert m._many_ok is False
+    assert m._fused_forward_ok is True
+    p = chunk[0]
+    m(p)
+    m(p)
+    assert m._fused_forward is not None  # single-step fusion unaffected

@@ -1,0 +1,38 @@
+"""Streaming (per-bin sum state) CalibrationError vs the one-shot functional.
+
+The module redesign replaced cat states with `(n_bins,)` sufficient
+statistics; these tests pin batch-invariance, the empty-compute error, and
+int32 count exactness.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+
+
+def test_multibatch_equals_oneshot_all_norms():
+    rng = np.random.RandomState(0)
+    chunks = [(rng.rand(257).astype(np.float32), rng.randint(0, 2, 257)) for _ in range(4)]
+    p = np.concatenate([c[0] for c in chunks])
+    t = np.concatenate([c[1] for c in chunks])
+    for norm in ("l1", "l2", "max"):
+        m = mt.CalibrationError(norm=norm)
+        for cp, ct in chunks:
+            m.update(jnp.asarray(cp), jnp.asarray(ct))
+        want = float(mt.functional.calibration_error(jnp.asarray(p), jnp.asarray(t), norm=norm))
+        assert float(m.compute()) == pytest.approx(want, abs=1e-6)
+
+
+def test_empty_compute_raises():
+    with pytest.raises(ValueError, match="No samples"):
+        mt.CalibrationError().compute()
+
+
+def test_count_state_is_int32():
+    m = mt.CalibrationError()
+    m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+    assert m.count_bin.dtype == jnp.int32
+    assert int(m.count_bin.sum()) == 2

@@ -106,7 +106,24 @@ def main() -> None:
 
     rng = np.random.RandomState(0)
     results = []
-    for name, ctor, kind, samples in SWEEP:
+    # jit-mode metrics (no list states) run FIRST: they never read device
+    # values, so they measure in the backend's fully-pipelined regime. The
+    # first eager module-API update performs a D2H value check, after which
+    # the tunneled backend charges a full blocking-sync round trip per
+    # synchronization for the rest of the session (see
+    # docs/performance.md "The device-to-host sync cliff") — so all eager
+    # rows share one post-D2H regime instead of poisoning jit rows.
+    def _is_jit_mode(entry):
+        name, ctor, kind, samples = entry
+        try:
+            state = ctor(mt).as_functions()[0]()
+            return not any(isinstance(v, list) for v in state.values())
+        except Exception:
+            return True
+
+    modes = [_is_jit_mode(e) for e in SWEEP]
+    ordered = [e for e, m in zip(SWEEP, modes) if m] + [e for e, m in zip(SWEEP, modes) if not m]
+    for name, ctor, kind, samples in ordered:
         try:
             if kind == "probs2":
                 p = rng.rand(BATCH, C).astype(np.float32)
@@ -115,6 +132,14 @@ def main() -> None:
                 data = (rng.randn(BATCH).astype(np.float32),)
             else:
                 data = _data(kind, rng)
+            # the BASELINE target is metric.update()/sec/chip — the cost of the
+            # update program itself. Inputs are placed on device up front (in a
+            # training loop they already live there, produced by the previous
+            # step); passing numpy per call would time the host->device
+            # transfer through the (variable-latency) backend tunnel instead,
+            # which is what made early sweep recordings report 100x outliers.
+            data = tuple(jax.device_put(jax.numpy.asarray(d)) for d in data)
+            jax.block_until_ready(data)
             metric = ctor(mt)
             init, upd, _ = metric.as_functions()
             state0 = init()
@@ -125,7 +150,7 @@ def main() -> None:
                 # path is the eager module update (device kernels inside, no
                 # trace) — time that instead
                 mode = "eager"
-                jdata = [jax.numpy.asarray(d) for d in data]
+                jdata = list(data)
                 metric.update(*jdata)  # warmup (device transfer + compile)
                 best = float("inf")
                 for _ in range(TRIALS):
@@ -138,7 +163,12 @@ def main() -> None:
             else:
                 mode = "jit"
                 fused = jax.jit(upd, donate_argnums=(0,))
+                # two warmup calls: the first compiles for the default state,
+                # the second catches any residual state-avals drift (a dtype
+                # the update widens, a weak type a custom default kept) so the
+                # timed region never contains a recompile
                 state = fused(state0, *data)
+                state = fused(state, *data)
                 jax.block_until_ready(state)
                 best = float("inf")
                 for _ in range(TRIALS):
