@@ -31,15 +31,23 @@ def _bin_sums(
     return count_bin, conf_bin, acc_bin
 
 
+def _bin_means(
+    count_bin: jax.Array, conf_sum: jax.Array, acc_sum: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(acc_bin, conf_bin, prop_bin) means from per-bin sums; empty bins -> 0."""
+    counts = count_bin.astype(conf_sum.dtype)
+    safe = jnp.where(count_bin == 0, 1.0, counts)
+    conf_bin = jnp.where(count_bin == 0, 0.0, conf_sum / safe)
+    acc_bin = jnp.where(count_bin == 0, 0.0, acc_sum / safe)
+    prop_bin = counts / counts.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
 def _ce_from_bin_sums(
     count_bin: jax.Array, conf_bin: jax.Array, acc_bin: jax.Array, norm: str = "l1"
 ) -> jax.Array:
     """Calibration error from per-bin sufficient statistics (any norm)."""
-    counts = count_bin.astype(conf_bin.dtype)
-    safe = jnp.where(count_bin == 0, 1.0, counts)
-    conf = jnp.where(count_bin == 0, 0.0, conf_bin / safe)
-    acc = jnp.where(count_bin == 0, 0.0, acc_bin / safe)
-    prop = counts / counts.sum()
+    acc, conf, prop = _bin_means(count_bin, conf_bin, acc_bin)
     if norm == "l1":
         return jnp.sum(jnp.abs(acc - conf) * prop)
     if norm == "max":
@@ -51,13 +59,7 @@ def _ce_from_bin_sums(
 def _binning_bucketize(
     confidences: jax.Array, accuracies: jax.Array, bin_boundaries: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    count_bin, conf_sum, acc_sum = _bin_sums(confidences, accuracies, bin_boundaries)
-    counts = count_bin.astype(confidences.dtype)
-    safe = jnp.where(count_bin == 0, 1.0, counts)
-    conf_bin = jnp.where(count_bin == 0, 0.0, conf_sum / safe)
-    acc_bin = jnp.where(count_bin == 0, 0.0, acc_sum / safe)
-    prop_bin = counts / counts.sum()
-    return acc_bin, conf_bin, prop_bin
+    return _bin_means(*_bin_sums(confidences, accuracies, bin_boundaries))
 
 
 def _ce_compute(

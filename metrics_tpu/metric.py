@@ -604,7 +604,12 @@ class Metric(ABC):
                 lambda x: x[i] if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 else x,
                 (args, kwargs),
             )
-            if force_reduce_eager:
+            if not with_values:
+                # update_many semantics are n sequential update() calls; the
+                # forward dance (snapshot/reset/compute/merge) would compute
+                # and discard a batch value per step
+                self.update(*a, **k)
+            elif force_reduce_eager:
                 self._forward_cache = self._forward_reduce_state_update_eager(*a, **k)
                 values.append(self._forward_cache)
             else:
