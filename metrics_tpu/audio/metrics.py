@@ -23,9 +23,9 @@ from metrics_tpu.functional.audio.snr import (
     signal_noise_ratio,
 )
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
-__doctest_skip__ = ["PerceptualEvaluationSpeechQuality", "ShortTimeObjectiveIntelligibility"]
+__doctest_skip__ = ["PerceptualEvaluationSpeechQuality"]
 
 
 class _MeanAudioMetric(Metric):
@@ -232,12 +232,21 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
 
 
 class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
-    """Average STOI via the host ``pystoi`` backend (reference `audio/stoi.py:25-120`).
+    """Average STOI over clips (reference `audio/stoi.py:25-120`).
+
+    Uses the native in-tree DSP implementation (`functional/audio/stoi.py`) —
+    the reference requires the ``pystoi`` package; here it is only the
+    optional cross-check backend.
 
     Example:
         >>> import jax.numpy as jnp
+        >>> import numpy as np
         >>> from metrics_tpu import ShortTimeObjectiveIntelligibility
-        >>> stoi = ShortTimeObjectiveIntelligibility(8000)  # doctest: +SKIP
+        >>> rng = np.random.RandomState(0)
+        >>> target = jnp.asarray(np.sin(2 * np.pi * 440 * np.arange(16000) / 10000) * (1 + 0.5 * rng.rand(16000)))
+        >>> stoi = ShortTimeObjectiveIntelligibility(10000)
+        >>> float(stoi(target + 0.1 * jnp.asarray(rng.randn(16000)), target)) > 0.5
+        True
     """
 
     full_state_update = False
@@ -247,11 +256,6 @@ class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
 
     def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not _PYSTOI_AVAILABLE:
-            raise ModuleNotFoundError(
-                "ShortTimeObjectiveIntelligibility metric requires that pystoi is installed."
-                " Install it with `pip install pystoi`."
-            )
         self.fs = fs
         self.extended = extended
 

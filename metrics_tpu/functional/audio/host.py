@@ -13,9 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.utils.checks import _check_same_shape
-from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
-__doctest_skip__ = ["perceptual_evaluation_speech_quality", "short_time_objective_intelligibility"]
+__doctest_skip__ = ["perceptual_evaluation_speech_quality"]
 
 
 def perceptual_evaluation_speech_quality(
@@ -59,33 +59,27 @@ def perceptual_evaluation_speech_quality(
 def short_time_objective_intelligibility(
     preds: jax.Array, target: jax.Array, fs: int, extended: bool = False, keep_same_device: bool = False
 ) -> jax.Array:
-    """STOI via the ``pystoi`` package (Taal et al. 2010).
+    """STOI / ESTOI (Taal et al. 2010 / Jensen & Taal 2016).
+
+    Runs the NATIVE in-tree implementation (`functional/audio/stoi.py`) — no
+    external package needed, unlike the reference's hard `pystoi` dependency
+    (`functional/audio/stoi.py:21-76`). When `pystoi` IS installed the test
+    suite cross-checks the native result against it.
 
     Example:
         >>> import jax.numpy as jnp
+        >>> import numpy as np
         >>> from metrics_tpu.functional import short_time_objective_intelligibility
-        >>> preds = jnp.zeros(8000)
-        >>> short_time_objective_intelligibility(preds, preds, 8000)  # doctest: +SKIP
+        >>> rng = np.random.RandomState(0)
+        >>> target = jnp.asarray(np.sin(2 * np.pi * 440 * np.arange(16000) / 10000) * (1 + 0.5 * rng.rand(16000)))
+        >>> preds = target + 0.1 * jnp.asarray(rng.randn(16000))
+        >>> float(short_time_objective_intelligibility(preds, target, 10000)) > 0.5
+        True
     """
-    if not _PYSTOI_AVAILABLE:
-        raise ModuleNotFoundError(
-            "STOI metric requires that pystoi is installed. Install it with `pip install pystoi`."
-        )
-    from pystoi import stoi as stoi_backend
+    from metrics_tpu.functional.audio.stoi import native_stoi
 
-    _check_same_shape(preds, target)
-
-    if preds.ndim == 1:
-        stoi_val_np = stoi_backend(np.asarray(target), np.asarray(preds), fs, extended)
-        stoi_val = jnp.asarray(stoi_val_np, dtype=jnp.float32)
-    else:
-        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
-        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
-        stoi_val_np = np.empty(preds_np.shape[0])
-        for b in range(preds_np.shape[0]):
-            stoi_val_np[b] = stoi_backend(target_np[b, :], preds_np[b, :], fs, extended)
-        stoi_val = jnp.asarray(stoi_val_np.astype(np.float32)).reshape(preds.shape[:-1])
-    if keep_same_device:
+    stoi_val = native_stoi(preds, target, fs, extended)
+    if keep_same_device and hasattr(preds, "devices"):
         stoi_val = jax.device_put(stoi_val, next(iter(preds.devices())))
     return stoi_val
 

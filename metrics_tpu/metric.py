@@ -743,6 +743,11 @@ class Metric(ABC):
             setattr(self, name, reduced)
 
     # ------------------------------------------------------------------- sync
+    def _sync_children(self) -> List["Metric"]:
+        """Child metrics whose states must sync with this one (wrappers and
+        compositions override; plain metrics have none)."""
+        return []
+
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
         input_dict = {name: getattr(self, name) for name in self._reductions}
         for name, spec in self._reduction_specs.items():
@@ -790,6 +795,17 @@ class Metric(ABC):
         self._cache = self._state_snapshot()
         self._sync_dist(dist_sync_fn, process_group=process_group)
         self._is_synced = True
+        # wrappers/compositions hold their accumulators in child metrics, not
+        # in their own state registry — sync recurses so the wrapper's
+        # distributed value equals the reference's module-tree sync
+        # (reference wrappers' child states are registered submodule states)
+        for child in self._sync_children():
+            child.sync(
+                dist_sync_fn=dist_sync_fn,
+                process_group=process_group,
+                should_sync=should_sync,
+                distributed_available=distributed_available,
+            )
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local state (reference `metric.py:452-472`)."""
@@ -802,6 +818,9 @@ class Metric(ABC):
         self._restore_state(self._cache)
         self._is_synced = False
         self._cache = None
+        for child in self._sync_children():
+            if child._is_synced:
+                child.unsync(should_unsync)
 
     class _SyncContext:
         def __init__(self, metric: "Metric", **kwargs: Any) -> None:
@@ -810,11 +829,19 @@ class Metric(ABC):
             self.should_unsync = kwargs.pop("should_unsync", True)
 
         def __enter__(self) -> "Metric":
-            self.metric.sync(**self.kwargs)
+            # a metric synced before entering (e.g. a wrapper's child, synced
+            # by the parent's recursion) just computes on the merged state —
+            # double-syncing would raise, and unsyncing on exit would undo
+            # the parent's sync from under it
+            self._presynced = self.metric._is_synced
+            if not self._presynced:
+                self.metric.sync(**self.kwargs)
             return self.metric
 
         def __exit__(self, *exc: Any) -> None:
-            self.metric.unsync(should_unsync=self.should_unsync and self.metric._is_synced)
+            self.metric.unsync(
+                should_unsync=self.should_unsync and self.metric._is_synced and not self._presynced
+            )
 
     def sync_context(
         self,
@@ -1265,7 +1292,10 @@ class CompositionalMetric(Metric):
         self.metric_b = metric_b if isinstance(metric_b, Metric) else _maybe_asarray(metric_b)
 
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
-        pass  # children sync themselves
+        pass  # no own states; components sync via _sync_children recursion
+
+    def _sync_children(self) -> List[Metric]:
+        return [m for m in (self.metric_a, self.metric_b) if isinstance(m, Metric)]
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         if isinstance(self.metric_a, Metric):
@@ -1319,6 +1349,20 @@ class CompositionalMetric(Metric):
     def _wrap_compute(self, compute: Callable) -> Callable:
         # no caching/sync wrapping: children handle their own (reference `metric.py:957-961`)
         return compute
+
+    def _inner_compute(self) -> Any:
+        # compute is unwrapped (no __wrapped__); components' own wrapped
+        # computes run inside it
+        return _squeeze_scalar(self.compute())
+
+    def as_functions(self) -> tuple:
+        # the composition registers no states of its own — the base export
+        # would produce an empty state dict and silently compute on reset
+        # components
+        raise NotImplementedError(
+            "CompositionalMetric holds no states of its own; export each component's "
+            "as_functions() and compose the computed values instead."
+        )
 
 
 def _maybe_asarray(value: Any) -> Any:

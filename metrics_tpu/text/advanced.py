@@ -249,7 +249,14 @@ class ExtendedEditDistance(Metric):
         )
 
     def compute(self):
-        average = _eed_compute([jnp.atleast_1d(s) for s in self.sentence_eed]) if self.sentence_eed else jnp.asarray(0.0)
+        # post-sync the cat state arrives as ONE concatenated array, not a
+        # list — `if self.sentence_eed` on a multi-element array is ambiguous
+        have_data = (
+            len(self.sentence_eed) > 0
+            if isinstance(self.sentence_eed, (list, tuple))
+            else self.sentence_eed.size > 0
+        )
+        average = _eed_compute([jnp.atleast_1d(s) for s in self.sentence_eed]) if have_data else jnp.asarray(0.0)
         if self.return_sentence_level_score:
             return average, dim_zero_cat(self.sentence_eed)
         return average

@@ -301,34 +301,11 @@ def test_pesq_batch_path_with_fake_backend(monkeypatch):
     np.testing.assert_allclose(calls[1][2], np.asarray(preds[0, 1]))
 
 
-def test_stoi_batch_path_with_fake_backend(monkeypatch):
-    import sys
-    import types
-
-    import metrics_tpu.functional.audio.host as host
-
-    fake = types.ModuleType("pystoi")
-    fake.stoi = lambda target, preds, fs, extended: float(target[0])
-    monkeypatch.setitem(sys.modules, "pystoi", fake)
-    monkeypatch.setattr(host, "_PYSTOI_AVAILABLE", True)
-
-    preds = jnp.arange(4 * 16, dtype=jnp.float32).reshape(4, 16)
-    target = preds + 500.0
-    out = host.short_time_objective_intelligibility(preds, target, 8000)
-    assert out.shape == (4,)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(target[..., 0]))
-
-
-def test_pesq_stoi_gated():
-    from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+def test_pesq_gated():
+    from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
     if not _PESQ_AVAILABLE:
         with pytest.raises(ModuleNotFoundError, match="pesq"):
             from metrics_tpu import PerceptualEvaluationSpeechQuality
 
             PerceptualEvaluationSpeechQuality(8000, "nb")
-    if not _PYSTOI_AVAILABLE:
-        with pytest.raises(ModuleNotFoundError, match="pystoi"):
-            from metrics_tpu import ShortTimeObjectiveIntelligibility
-
-            ShortTimeObjectiveIntelligibility(8000)

@@ -128,3 +128,13 @@ def test_bitwise_ops():
     assert int((a & b).compute()) == 1
     assert int((a | b).compute()) == 7
     assert int((a ^ b).compute()) == 6
+
+
+def test_composition_as_functions_refuses():
+    """The composition has no states of its own — a silent empty-state export
+    would compute on reset components (review regression)."""
+    import metrics_tpu as mt
+
+    comp = mt.MeanMetric() + mt.MeanMetric()
+    with pytest.raises(NotImplementedError, match="component"):
+        comp.as_functions()

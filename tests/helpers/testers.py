@@ -71,40 +71,52 @@ class _FakeGather:
         self._schedule = self._build_schedule(rank_metrics[0])
         self._call_idx = 0
 
-    def _build_schedule(self, m: Metric):
+    @staticmethod
+    def _resolve(m: Metric, path: tuple) -> Metric:
+        for child_idx in path:
+            m = m._sync_children()[child_idx]
+        return m
+
+    def _build_schedule(self, m: Metric, path: tuple = ()):
+        """Schedule entries are ``(path, name, elem)`` — ``path`` drills into
+        ``_sync_children()`` (wrappers/compositions recurse their children
+        through the same gather, in sync's child order)."""
         schedule = []
+        rank_subs = [self._resolve(rm, path) for rm in self.rank_metrics]
         for name, spec in m._reduction_specs.items():
             value = getattr(m, name)
             if isinstance(value, list):
                 if spec == "cat":
-                    empties = {len(getattr(rm, name)) == 0 for rm in self.rank_metrics}
+                    empties = {len(getattr(rm, name)) == 0 for rm in rank_subs}
                     assert len(empties) == 1, (
                         f"cat state {name!r} is empty on some ranks but not others; the"
                         " schedule is built once from rank 0, so emptiness must agree"
                         " across ranks for the replayed walk to line up"
                     )
                     if len(value) > 0:
-                        schedule.append((name, None))  # pre-concatenated → 1 call
+                        schedule.append((path, name, None))  # pre-concatenated → 1 call
                 else:
-                    lengths = {len(getattr(rm, name)) for rm in self.rank_metrics}
+                    lengths = {len(getattr(rm, name)) for rm in rank_subs}
                     assert len(lengths) == 1, (
                         f"list state {name!r} has different lengths across ranks {lengths};"
                         " the per-element gather protocol (ours and the reference's) needs"
                         " equal update counts per rank"
                     )
-                    schedule.extend((name, j) for j in range(len(value)))
+                    schedule.extend((path, name, j) for j in range(len(value)))
             else:
-                schedule.append((name, None))
+                schedule.append((path, name, None))
+        for i, child in enumerate(m._sync_children()):
+            schedule.extend(self._build_schedule(child, path + (i,)))
         return schedule
 
     def __call__(self, tensor: jax.Array, group: Any = None):
         from metrics_tpu.utils.data import dim_zero_cat
 
-        name, elem = self._schedule[self._call_idx]
+        path, name, elem = self._schedule[self._call_idx]
         self._call_idx += 1
         out = []
         for m in self.rank_metrics:
-            value = getattr(m, name)
+            value = getattr(self._resolve(m, path), name)
             if elem is not None:
                 out.append(jnp.asarray(value[elem]))
             elif isinstance(value, list):
