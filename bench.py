@@ -150,7 +150,7 @@ def bench_fid_ours(real, fake) -> float:
 
     from metrics_tpu.image.generative import FrechetInceptionDistance
 
-    fid = FrechetInceptionDistance(feature=2048)
+    fid = FrechetInceptionDistance(feature=2048, allow_random_weights=True)
 
     def cycle():
         fid.reset()

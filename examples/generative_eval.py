@@ -34,7 +34,9 @@ def main() -> None:
         for b in real_batches
     ]
 
-    kwargs = {"npz_path": npz} if npz else {}
+    # without --weights this is an API demo on random-init extractors —
+    # scores are meaningless vs published numbers, hence the explicit waiver
+    kwargs = {"npz_path": npz} if npz else {"allow_random_weights": True}
     fid = mt.image.FrechetInceptionDistance(feature=2048, **kwargs)
     kid = mt.image.KernelInceptionDistance(feature=2048, subsets=4, subset_size=32, **kwargs)
     iscore = mt.image.InceptionScore(**kwargs)
@@ -53,7 +55,7 @@ def main() -> None:
     print(f"IS:  {float(is_mean):.4f} +- {float(is_std):.4f}")
 
     # LPIPS expects float images in [-1, 1]
-    lpips = mt.image.LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    lpips = mt.image.LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)
     for real, fake in zip(real_batches, fake_batches):
         lpips.update(
             (real[:8].astype(np.float32) / 127.5 - 1.0),

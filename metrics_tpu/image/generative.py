@@ -93,19 +93,45 @@ def _fid_from_features_host(real: np.ndarray, fake: np.ndarray) -> float:
     return float(diff @ diff + np.trace(cov1) + np.trace(cov2) - 2 * tr_covmean)
 
 
-def _resolve_extractor(feature: Union[int, str, Callable], valid: tuple, params: Any, seed: int) -> Callable:
+_RANDOM_WEIGHTS_MSG = (
+    "No pretrained parameters supplied for the {net} — its scores would come from a RANDOM "
+    "initialization and carry no meaning vs published numbers. Fetch and convert the reference "
+    "checkpoint (see docs/weights.md):\n    {hint}\nthen pass `npz_path=\"out.npz\"` (or the "
+    "loaded pytree via `params`). To intentionally run with random weights (pipeline smoke "
+    "tests, wall-clock benchmarks), construct with `allow_random_weights=True`."
+)
+
+
+def _gate_random_weights(params: Any, npz_path: Optional[str], allow_random_weights: bool, net: str, hint: str) -> None:
+    """Raise unless weights were supplied or random init explicitly waived."""
+    if params is not None or npz_path is not None:
+        return
+    if not allow_random_weights:
+        raise RuntimeError(_RANDOM_WEIGHTS_MSG.format(net=net, hint=hint))
+    rank_zero_warn(
+        f"No pretrained parameters supplied for the {net}; using a deterministic random"
+        " initialization (allow_random_weights=True). Scores are NOT comparable to"
+        " published numbers."
+    )
+
+
+def _resolve_extractor(
+    feature: Union[int, str, Callable], valid: tuple, params: Any, seed: int,
+    npz_path: Optional[str], allow_random_weights: bool, metric_name: str,
+) -> Callable:
     if isinstance(feature, (int, str)) and not callable(feature):
         if feature not in valid:
             raise ValueError(f"Input to argument `feature` must be one of {list(valid)}, but got {feature}.")
         from metrics_tpu.models.inception import InceptionV3Extractor
 
-        if params is None:
-            rank_zero_warn(
-                "No pretrained parameters supplied for the InceptionV3 feature extractor; using a"
-                " deterministic random initialization. Pass converted torch-fidelity weights via the"
-                " `params`/`npz_path` arguments of `InceptionV3Extractor` for published-number parity."
-            )
-        return InceptionV3Extractor(feature=str(feature), params=params, seed=seed)
+        _gate_random_weights(
+            params,
+            npz_path,
+            allow_random_weights,
+            net=f"InceptionV3 feature extractor of `{metric_name}`",
+            hint="python tools/convert_inception_weights.py <torch-fidelity .pth> out.npz",
+        )
+        return InceptionV3Extractor(feature=str(feature), params=params, npz_path=npz_path, seed=seed)
     if callable(feature):
         return feature
     raise TypeError("Got unknown input to argument `feature`")
@@ -165,6 +191,8 @@ class FrechetInceptionDistance(_FeatureBufferMetric):
         feature: Union[int, Callable] = 2048,
         reset_real_features: bool = True,
         params: Any = None,
+        npz_path: Optional[str] = None,
+        allow_random_weights: bool = False,
         seed: int = 0,
         **kwargs: Any,
     ) -> None:
@@ -174,7 +202,10 @@ class FrechetInceptionDistance(_FeatureBufferMetric):
             " For large datasets this may lead to large memory footprint.",
             UserWarning,
         )
-        self.inception = _resolve_extractor(feature, _VALID_FEATURE_INTS, params, seed)
+        self.inception = _resolve_extractor(
+            feature, _VALID_FEATURE_INTS, params, seed, npz_path, allow_random_weights,
+            "FrechetInceptionDistance",
+        )
 
     def compute(self) -> jax.Array:
         real_features = dim_zero_cat(self.real_features)
@@ -261,6 +292,8 @@ class KernelInceptionDistance(_FeatureBufferMetric):
         coef: float = 1.0,
         reset_real_features: bool = True,
         params: Any = None,
+        npz_path: Optional[str] = None,
+        allow_random_weights: bool = False,
         seed: int = 0,
         **kwargs: Any,
     ) -> None:
@@ -270,7 +303,10 @@ class KernelInceptionDistance(_FeatureBufferMetric):
             " For large datasets this may lead to large memory footprint.",
             UserWarning,
         )
-        self.inception = _resolve_extractor(feature, _VALID_FEATURE_INTS, params, seed)
+        self.inception = _resolve_extractor(
+            feature, _VALID_FEATURE_INTS, params, seed, npz_path, allow_random_weights,
+            "KernelInceptionDistance",
+        )
 
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")
@@ -342,6 +378,8 @@ class InceptionScore(Metric):
         feature: Union[str, int, Callable] = "logits_unbiased",
         splits: int = 10,
         params: Any = None,
+        npz_path: Optional[str] = None,
+        allow_random_weights: bool = False,
         seed: int = 0,
         **kwargs: Any,
     ) -> None:
@@ -351,7 +389,10 @@ class InceptionScore(Metric):
             " For large datasets this may lead to large memory footprint.",
             UserWarning,
         )
-        self.inception = _resolve_extractor(feature, ("logits_unbiased",) + _VALID_FEATURE_INTS, params, seed)
+        self.inception = _resolve_extractor(
+            feature, ("logits_unbiased",) + _VALID_FEATURE_INTS, params, seed, npz_path,
+            allow_random_weights, "InceptionScore",
+        )
         self.splits = splits
         self.seed = seed
         self.add_state("features", default=[], dist_reduce_fx=None)
@@ -391,7 +432,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         >>> import jax
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.image.generative import LearnedPerceptualImagePatchSimilarity
-        >>> lpips = LearnedPerceptualImagePatchSimilarity(net_type='alex')
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(net_type='alex', allow_random_weights=True)
         >>> img1 = jax.random.uniform(jax.random.PRNGKey(0), (4, 3, 64, 64))
         >>> img2 = jax.random.uniform(jax.random.PRNGKey(1), (4, 3, 64, 64))
         >>> float(lpips(img1, img2)) >= 0
@@ -407,6 +448,8 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         net_type: str = "alex",
         reduction: str = "mean",
         params: Any = None,
+        npz_path: Optional[str] = None,
+        allow_random_weights: bool = False,
         seed: int = 0,
         **kwargs: Any,
     ) -> None:
@@ -414,15 +457,21 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         if callable(net_type):
             self.net = net_type
         else:
-            from metrics_tpu.models.lpips import LPIPSExtractor
+            from metrics_tpu.models.lpips import _BACKBONES, LPIPSExtractor
 
-            if params is None:
-                rank_zero_warn(
-                    "No pretrained parameters supplied for the LPIPS network; using a deterministic"
-                    " random initialization. Pass converted `lpips` weights via `params` for"
-                    " published-number parity."
-                )
-            self.net = LPIPSExtractor(net_type=net_type, params=params, seed=seed)
+            # validate the backbone BEFORE the weights gate: an invalid
+            # net_type must get the ValueError naming valid choices, not a
+            # converter hint embedding the bogus name
+            if net_type not in _BACKBONES:
+                raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
+            _gate_random_weights(
+                params,
+                npz_path,
+                allow_random_weights,
+                net="LPIPS network",
+                hint=f"python tools/convert_lpips_weights.py {net_type} <lpips .pth> out.npz",
+            )
+            self.net = LPIPSExtractor(net_type=net_type, params=params, npz_path=npz_path, seed=seed)
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")

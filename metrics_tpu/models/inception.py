@@ -209,11 +209,25 @@ class InceptionV3Extractor:
             raise ValueError(f"Expected `feature` to be one of {VALID_FEATURES}, got {feature}")
         self.feature = str(feature)
         self.model = InceptionV3()
-        if params is None and npz_path is not None:
+        if params is not None and npz_path is not None:
+            raise ValueError(
+                "Pass EITHER `params` or `npz_path`, not both — silently preferring one would "
+                "hide which weights actually score."
+            )
+        if npz_path is not None:
             params = params_from_npz(npz_path)
+        dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
         if params is None:
-            dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
             params = self.model.init(jax.random.PRNGKey(seed), dummy)
+        else:
+            from metrics_tpu.models.manifest import validate_params
+
+            validate_params(
+                params,
+                self.model,
+                (dummy,),
+                "python tools/convert_inception_weights.py <torch-fidelity .pth> out.npz",
+            )
         self.params = params
         self._forward = functools.partial(_jitted_apply, self.model)
 

@@ -157,13 +157,27 @@ class LPIPSExtractor:
             raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
         self.net_type = net_type
         self.model = LPIPSNet(net_type=net_type)
-        if params is None and npz_path is not None:
+        if params is not None and npz_path is not None:
+            raise ValueError(
+                "Pass EITHER `params` or `npz_path`, not both — silently preferring one would "
+                "hide which weights actually score."
+            )
+        if npz_path is not None:
             from metrics_tpu.models.inception import params_from_npz
 
             params = params_from_npz(npz_path)
+        dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
         if params is None:
-            dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
             params = self.model.init(jax.random.PRNGKey(seed), dummy, dummy)
+        else:
+            from metrics_tpu.models.manifest import validate_params
+
+            validate_params(
+                params,
+                self.model,
+                (dummy, dummy),
+                f"python tools/convert_lpips_weights.py {net_type} <lpips .pth> out.npz",
+            )
         self.params = params
         self._forward = functools.partial(_jitted_apply, self.model)
 

@@ -1,0 +1,73 @@
+"""Parameter-manifest validation for the model-backed metrics.
+
+The published numbers of FID/KID/IS/LPIPS are only meaningful with the
+reference checkpoints (torch-fidelity's InceptionV3, the ``lpips`` package
+nets — reference `image/fid.py:41-58`, `image/lpip.py:24-77`). This
+environment has no egress, so weights arrive as user-converted ``.npz``
+files — and a silently mis-keyed or mis-shaped file would produce
+plausible-looking garbage. Every supplied params pytree is therefore
+validated against the MANIFEST — the exact key set and shapes of the Flax
+model's own parameter tree (derived via ``jax.eval_shape``, so it can never
+drift from the architecture) — with actionable errors naming the offending
+keys and the converter command.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+
+def _flatten_with_paths(tree: Any) -> Dict[str, Tuple[int, ...]]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        flat[key] = tuple(np.shape(leaf) if not hasattr(leaf, "shape") else leaf.shape)
+    return flat
+
+
+def expected_manifest(model: Any, *dummy_args: Any) -> Dict[str, Tuple[int, ...]]:
+    """Flat ``key -> shape`` manifest of ``model.init``'s parameter tree,
+    computed shape-only (no FLOPs, no RNG materialization)."""
+    shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), *dummy_args))
+    return _flatten_with_paths(shapes)
+
+
+def validate_params(params: Any, model: Any, dummy_args: tuple, converter_hint: str) -> None:
+    """Raise with an actionable message when ``params`` does not match the
+    model's manifest (missing keys, unexpected keys, shape mismatches)."""
+    want = expected_manifest(model, *dummy_args)
+    got = _flatten_with_paths(params)
+
+    missing = sorted(set(want) - set(got))
+    extra = sorted(set(got) - set(want))
+    wrong = sorted(k for k in set(want) & set(got) if want[k] != got[k])
+    if not (missing or extra or wrong):
+        return
+
+    def _fmt(keys, detail=None):
+        shown = keys[:5]
+        lines = [f"  - {k}" + (f": expected {want[k]}, got {got[k]}" if detail else "") for k in shown]
+        if len(keys) > len(shown):
+            lines.append(f"  ... and {len(keys) - len(shown)} more")
+        return "\n".join(lines)
+
+    sections = []
+    if missing:
+        sections.append(f"missing {len(missing)} parameter(s):\n{_fmt(missing)}")
+    if extra:
+        sections.append(f"unexpected {len(extra)} parameter(s):\n{_fmt(extra)}")
+    if wrong:
+        sections.append(f"shape mismatch on {len(wrong)} parameter(s):\n{_fmt(wrong, detail=True)}")
+    raise ValueError(
+        f"Supplied parameters do not match the {type(model).__name__} manifest:\n"
+        + "\n".join(sections)
+        + f"\nConvert the reference checkpoint with `{converter_hint}` and pass the resulting"
+        " .npz via `npz_path` (or its loaded pytree via `params`)."
+    )
+
+
+__all__ = ["expected_manifest", "validate_params"]

@@ -153,21 +153,21 @@ class TestInceptionScore:
 
 class TestLPIPS:
     def test_zero_for_identical(self):
-        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)
         img = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
         lpips.update(img, img)
         assert float(lpips.compute()) == pytest.approx(0.0, abs=1e-6)
 
     @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
     def test_backbones_run(self, net_type):
-        lpips = LearnedPerceptualImagePatchSimilarity(net_type=net_type)
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type=net_type, allow_random_weights=True)
         img1 = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
         img2 = jax.random.uniform(jax.random.PRNGKey(1), (2, 3, 64, 64))
         val = lpips(img1, img2)
         assert float(val) >= 0
 
     def test_symmetry(self):
-        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)
         img1 = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
         img2 = jax.random.uniform(jax.random.PRNGKey(1), (2, 3, 64, 64))
         a = float(lpips(img1, img2))
@@ -176,25 +176,25 @@ class TestLPIPS:
         assert a == pytest.approx(b, rel=1e-5)
 
     def test_sum_reduction_and_accumulation(self):
-        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", reduction="sum")
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", reduction="sum", allow_random_weights=True)
         img1 = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
         img2 = jax.random.uniform(jax.random.PRNGKey(1), (2, 3, 64, 64))
         lpips.update(img1, img2)
         lpips.update(img1, img2)
         total = float(lpips.compute())
-        lpips2 = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        lpips2 = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)
         lpips2.update(img1, img2)
         lpips2.update(img1, img2)
         assert total == pytest.approx(float(lpips2.compute()) * 4, rel=1e-5)
 
     def test_invalid_inputs(self):
-        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)
         with pytest.raises(ValueError, match="normalized"):
             lpips.update(jnp.ones((2, 3, 32, 32)) * 2.0, jnp.ones((2, 3, 32, 32)))
         with pytest.raises(ValueError, match="net_type"):
-            LearnedPerceptualImagePatchSimilarity(net_type="resnet")
+            LearnedPerceptualImagePatchSimilarity(net_type="resnet", allow_random_weights=True)
         with pytest.raises(ValueError, match="reduction"):
-            LearnedPerceptualImagePatchSimilarity(reduction="max")
+            LearnedPerceptualImagePatchSimilarity(reduction="max", allow_random_weights=True)
 
 
 class TestInceptionV3Model:
