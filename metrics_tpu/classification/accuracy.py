@@ -33,7 +33,11 @@ class Accuracy(StatScores):
         threshold: float = 0.5,
         num_classes: Optional[int] = None,
         average: Optional[str] = "micro",
-        mdmc_average: Optional[str] = "global",
+        # the CLASS defaults to None (reference `classification/accuracy.py:168`)
+        # while the FUNCTIONAL accuracy defaults to "global"
+        # (`functional/classification/accuracy.py:262`) — a reference asymmetry
+        # the full-grid enumeration pinned
+        mdmc_average: Optional[str] = None,
         ignore_index: Optional[int] = None,
         top_k: Optional[int] = None,
         multiclass: Optional[bool] = None,
@@ -74,6 +78,14 @@ class Accuracy(StatScores):
             self.correct = self.correct + correct
             self.total = self.total + total
         else:
+            # reference parity (`functional/classification/accuracy.py:104-105`):
+            # accuracy deliberately rejects top_k on multilabel inputs (the
+            # subset path above raises the same error inside
+            # `_subset_accuracy_update`, matching the reference's `:228-229`)
+            if self.mode == DataType.MULTILABEL and self.top_k:
+                raise ValueError(
+                    "You can not use the `top_k` parameter to calculate accuracy for multi-label inputs."
+                )
             super().update(preds, target)
 
     def compute(self) -> jax.Array:

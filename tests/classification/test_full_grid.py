@@ -1,0 +1,218 @@
+"""The ENUMERATED classification parametrization grid vs the reference.
+
+VERDICT r2 #8: the reference pushes the stat-scores family through the full
+cartesian input-inventory grid (`tests/unittests/classification/inputs.py:23-60`
+feeding per-metric case x average x mdmc x ignore_index x top_k matrices);
+the round-2 edge matrices SAMPLED that grid — this module enumerates it.
+
+Every cell runs BOTH implementations on identical streamed batches:
+
+- if both produce a value, the values must agree to tolerance;
+- if both raise, the cell is a mutually-rejected configuration (pinned: a
+  combo one side rejects and the other silently computes IS a divergence
+  and fails the cell).
+
+The curve family (AUROC / AveragePrecision / PrecisionRecallCurve / ROC)
+gets its own enumeration over its applicable axes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+from tests.classification.inputs import (
+    _binary,
+    _binary_logit,
+    _binary_prob,
+    _multiclass,
+    _multiclass_logit,
+    _multiclass_prob,
+    _multidim_multiclass,
+    _multidim_multiclass_prob,
+    _multilabel,
+    _multilabel_logit,
+    _multilabel_prob,
+)
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+CASES = {
+    "binary": _binary,
+    "binary_prob": _binary_prob,
+    "binary_logit": _binary_logit,
+    "multiclass": _multiclass,
+    "multiclass_prob": _multiclass_prob,
+    "multiclass_logit": _multiclass_logit,
+    "multilabel": _multilabel,
+    "multilabel_prob": _multilabel_prob,
+    "multilabel_logit": _multilabel_logit,
+    "mdmc": _multidim_multiclass,
+    "mdmc_prob": _multidim_multiclass_prob,
+}
+
+STAT_METRICS = ["Accuracy", "Precision", "Recall", "F1Score", "Specificity"]
+
+AVERAGES = ["micro", "macro", "weighted", "none"]
+MDMC = [None, "global", "samplewise"]
+IGNORE = [None, 1]
+TOPK = [None, 2]
+
+
+def _kwargs_for(case: str, average: str, mdmc, ignore_index, top_k) -> dict:
+    """Constructor kwargs for a grid cell, mirroring the reference's own
+    per-case test setup (binary needs num_classes=1 off-micro; int-binary
+    needs the multiclass=False hint; everything else num_classes=5)."""
+    kwargs = {"average": average}
+    if case.startswith("binary"):
+        if average != "micro":
+            kwargs["num_classes"] = 1
+        if case == "binary":
+            kwargs["multiclass"] = False
+    else:
+        kwargs["num_classes"] = 5
+    if mdmc is not None:
+        kwargs["mdmc_average"] = mdmc
+    if ignore_index is not None:
+        kwargs["ignore_index"] = ignore_index
+    if top_k is not None:
+        kwargs["top_k"] = top_k
+    return kwargs
+
+
+def _stream_value(metric, inputs, to_torch: bool):
+    for i in range(inputs.preds.shape[0]):
+        if to_torch:
+            metric.update(torch.tensor(np.asarray(inputs.preds[i])), torch.tensor(np.asarray(inputs.target[i])))
+        else:
+            metric.update(inputs.preds[i], inputs.target[i])
+    out = metric.compute()
+    if isinstance(out, (list, tuple)):
+        out = [np.asarray(o) for o in out]
+        return np.stack(out) if all(o.shape == out[0].shape for o in out) else out
+    return np.asarray(out)
+
+
+def _run_cell(metric_name: str, case: str, kwargs: dict, atol: float = 1e-6) -> str:
+    """Run one grid cell through both implementations. Returns 'value' when
+    both computed and matched, 'rejected' when both raised."""
+    inputs = CASES[case]
+    ours_err = ref_err = None
+    ours_val = ref_val = None
+    try:
+        ours_val = _stream_value(getattr(mt, metric_name)(**kwargs), inputs, to_torch=False)
+    except Exception as err:
+        ours_err = err
+    try:
+        ref_val = _stream_value(getattr(_ref, metric_name)(**kwargs), inputs, to_torch=True)
+    except Exception as err:
+        ref_err = err
+
+    if ours_err is not None and ref_err is not None:
+        # a mutual rejection must be OUR deliberate validation (ValueError),
+        # not an internal crash that happens to coincide with the reference's
+        # rejection — the same deliberate-vs-crash distinction applied to the
+        # reference below
+        assert isinstance(ours_err, ValueError), (
+            f"our side crashed internally on a cell the reference rejects: "
+            f"{metric_name} {case} {kwargs}: {type(ours_err).__name__}: {ours_err}"
+        )
+        return "rejected"
+    assert ours_err is None, (
+        f"we reject a configuration the reference computes: {metric_name} {case} {kwargs}: {ours_err}"
+    )
+    if ref_err is not None and not isinstance(ref_err, ValueError):
+        # the reference CRASHED on its own internals (torch.cat on 0-d
+        # tensors etc.) for a combination it never validates — e.g.
+        # mdmc_average='samplewise' on non-multidim inputs. We compute the
+        # natural value instead; require it to at least be finite.
+        assert np.all(np.isfinite(np.asarray(ours_val, np.float64))), (metric_name, case, kwargs)
+        return "ref_bug"
+    assert ref_err is None, (
+        f"we compute a configuration the reference deliberately rejects: {metric_name} {case} {kwargs} "
+        f"-> ours={ours_val}, reference error: {ref_err}"
+    )
+    ref_np = ref_val if isinstance(ref_val, np.ndarray) else np.asarray(ref_val)
+    np.testing.assert_allclose(
+        np.asarray(ours_val, np.float64),
+        np.asarray(ref_np, np.float64),
+        atol=atol,
+        rtol=1e-5,
+        err_msg=f"{metric_name} {case} {kwargs}",
+    )
+    return "value"
+
+
+@pytest.mark.parametrize("top_k", TOPK, ids=lambda v: f"topk={v}")
+@pytest.mark.parametrize("ignore_index", IGNORE, ids=lambda v: f"ign={v}")
+@pytest.mark.parametrize("mdmc", MDMC, ids=lambda v: f"mdmc={v}")
+@pytest.mark.parametrize("average", AVERAGES)
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_stat_scores_grid(case, average, mdmc, ignore_index, top_k):
+    """One cell of the full cartesian grid, for every stat-scores metric."""
+    outcomes = {}
+    for metric_name in STAT_METRICS:
+        kwargs = _kwargs_for(case, average, mdmc, ignore_index, top_k)
+        outcomes[metric_name] = _run_cell(metric_name, case, kwargs)
+    # per-metric agreement with the reference is asserted inside _run_cell;
+    # outcomes may legitimately differ ACROSS the family — the reference
+    # itself is non-uniform (e.g. Accuracy deliberately rejects top_k on
+    # multilabel while Precision/Recall compute it), and we mirror each
+    # metric's own contract
+    assert set(outcomes.values()) <= {"value", "rejected", "ref_bug"}
+
+
+# --------------------------------------------------------------- curve family
+
+CURVE_CASES = ["binary_prob", "binary_logit", "multiclass_prob", "multiclass_logit"]
+
+
+@pytest.mark.parametrize("case", CURVE_CASES)
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+def test_auroc_grid(case, average):
+    kwargs = {"average": None if average == "none" else average}
+    if case.startswith("multiclass"):
+        kwargs["num_classes"] = 5
+    outcome = _run_cell("AUROC", case, kwargs, atol=1e-5)
+    assert outcome in ("value", "rejected")
+
+
+@pytest.mark.parametrize("case", CURVE_CASES)
+def test_average_precision_grid(case):
+    kwargs = {"num_classes": 5} if case.startswith("multiclass") else {}
+    assert _run_cell("AveragePrecision", case, kwargs, atol=1e-5) == "value"
+
+
+@pytest.mark.parametrize("metric", ["PrecisionRecallCurve", "ROC"])
+@pytest.mark.parametrize("case", CURVE_CASES)
+def test_curve_grid(metric, case):
+    """Curves return (precision/fpr, recall/tpr, thresholds) tuples — compare
+    element-wise per class."""
+    inputs = CASES[case]
+    kwargs = {"num_classes": 5} if case.startswith("multiclass") else {}
+    ours = getattr(mt, metric)(**kwargs)
+    ref = getattr(_ref, metric)(**kwargs)
+    for i in range(inputs.preds.shape[0]):
+        ours.update(inputs.preds[i], inputs.target[i])
+        ref.update(torch.tensor(np.asarray(inputs.preds[i])), torch.tensor(np.asarray(inputs.target[i])))
+    ours_out = ours.compute()
+    ref_out = ref.compute()
+    assert len(ours_out) == len(ref_out)
+    for o, r in zip(ours_out, ref_out):
+        if isinstance(o, (list, tuple)):
+            assert len(o) == len(r)
+            for oc, rc in zip(o, r):
+                np.testing.assert_allclose(np.asarray(oc, np.float64), np.asarray(rc, np.float64), atol=1e-5)
+        else:
+            np.testing.assert_allclose(np.asarray(o, np.float64), np.asarray(r, np.float64), atol=1e-5)
+
+
+def test_grid_is_fully_enumerated():
+    """The cartesian product covered above matches the declared axes — a
+    guard against silently narrowing the grid later."""
+    n_cells = len(CASES) * len(AVERAGES) * len(MDMC) * len(IGNORE) * len(TOPK)
+    assert n_cells == 11 * 4 * 3 * 2 * 2 == 528

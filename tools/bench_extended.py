@@ -136,8 +136,116 @@ def bench_native_text() -> dict:
     return out
 
 
+def bench_map_scale(n_images: int = 500) -> dict:
+    """COCO-scale mAP (>=500 images / ~5k+ detections) vs the reference on
+    identical data — the committed artifact behind docs/performance.md's
+    COCO-scale table (VERDICT r2 #7)."""
+    from tools.bench_map import bench_ours, bench_reference, make_dataset
+
+    batches = make_dataset(n_images)
+    n_det = sum(len(b[0]["scores"]) for b in batches)
+    ours_map, ours_upd, ours_cmp = bench_ours(batches)
+    out = {
+        "metric": f"coco_map_{n_images}img_scale",
+        "n_detections": n_det,
+        "ours_update_s": round(ours_upd, 2),
+        "ours_compute_s": round(ours_cmp, 2),
+        "map": round(ours_map, 4),
+    }
+    try:
+        ref = bench_reference(batches)
+    except Exception as err:  # keep the measured ours-side numbers
+        out["ref_error"] = str(err)[:120]
+        ref = None
+    if ref is not None:
+        ref_map, ref_upd, ref_cmp = ref
+        out.update(
+            ref_update_s=round(ref_upd, 2),
+            ref_compute_s=round(ref_cmp, 2),
+            ref_map=round(ref_map, 4),
+            compute_speedup=round(ref_cmp / ours_cmp, 2),
+            cycle_speedup=round((ref_upd + ref_cmp) / (ours_upd + ours_cmp), 2),
+        )
+    return out
+
+
+def bench_fid_scale(n_images: int = 1024, batch: int = 64) -> dict:
+    """FID at >=1k images per side (random weights — wall-clock only) vs the
+    torch-CPU architecture mirror on identical data (VERDICT r2 #7)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.image import FrechetInceptionDistance
+
+    rng = np.random.RandomState(0)
+    n_batches = n_images // batch
+    n_images = n_batches * batch  # label the workload actually processed
+    real = [rng.randint(0, 256, (batch, 3, 299, 299), dtype=np.uint8) for _ in range(n_batches)]
+    fake = [rng.randint(0, 256, (batch, 3, 299, 299), dtype=np.uint8) for _ in range(n_batches)]
+
+    fid = FrechetInceptionDistance(feature=2048, allow_random_weights=True)
+    fid.update(jnp.asarray(real[0]), real=True)  # compile warmup
+    fid.reset()
+    start = time.perf_counter()
+    for r, f in zip(real, fake):
+        fid.update(jnp.asarray(r), real=True)
+        fid.update(jnp.asarray(f), real=False)
+    t_update = time.perf_counter() - start
+    start = time.perf_counter()
+    ours_val = float(fid.compute())
+    t_compute = time.perf_counter() - start
+    out = {
+        "metric": f"fid_{2 * n_images}img_scale",
+        "ours_update_s": round(t_update, 2),
+        "ours_compute_s": round(t_compute, 2),
+        "fid": round(ours_val, 4),
+    }
+
+    try:
+        import torch
+
+        from tests.helpers.torch_mirrors import TorchInceptionMirror, randomize_inception_
+
+        mirror = TorchInceptionMirror()
+        randomize_inception_(mirror)
+        start = time.perf_counter()
+        feats = {"real": [], "fake": []}
+        with torch.no_grad():
+            for r, f in zip(real, fake):
+                feats["real"].append(mirror(torch.from_numpy(r).float() / 255.0 * 2.0 - 1.0)["2048"].numpy())
+                feats["fake"].append(mirror(torch.from_numpy(f).float() / 255.0 * 2.0 - 1.0)["2048"].numpy())
+        ref_update = time.perf_counter() - start
+        import scipy.linalg
+
+        start = time.perf_counter()
+        rr = np.concatenate(feats["real"]).astype(np.float64)
+        ff = np.concatenate(feats["fake"]).astype(np.float64)
+        mu1, mu2 = rr.mean(0), ff.mean(0)
+        cov1, cov2 = np.cov(rr, rowvar=False), np.cov(ff, rowvar=False)
+        covmean = scipy.linalg.sqrtm(cov1 @ cov2)
+        if np.iscomplexobj(covmean):
+            covmean = covmean.real
+        _ = float((mu1 - mu2) @ (mu1 - mu2) + np.trace(cov1) + np.trace(cov2) - 2 * np.trace(covmean))
+        ref_compute = time.perf_counter() - start
+        out.update(
+            ref_update_s=round(ref_update, 2),
+            ref_compute_s=round(ref_compute, 2),
+            cycle_speedup=round((ref_update + ref_compute) / (t_update + t_compute), 2),
+        )
+    except Exception as err:
+        out["ref_error"] = str(err)[:120]
+    return out
+
+
 def main() -> None:
-    for fn in (bench_retrieval, bench_map, bench_native_text, bench_fid):
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scale", action="store_true", help="run the COCO/FID-scale workloads too")
+    args = parser.parse_args()
+    benches = [bench_retrieval, bench_map, bench_native_text, bench_fid]
+    if args.scale:
+        benches += [bench_map_scale, bench_fid_scale]
+    for fn in benches:
         try:
             print(json.dumps(fn()))
         except Exception as err:  # keep the other benches running

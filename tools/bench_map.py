@@ -42,16 +42,20 @@ def make_dataset(n_images: int, n_classes: int = 20, seed: int = 0):
 
 
 def bench_ours(batches):
-    import jax.numpy as jnp
-
     import metrics_tpu as mt
 
     metric = mt.MeanAveragePrecision()
     t0 = time.perf_counter()
     for det, gt in batches:
+        # host numpy passes through AS-IS (update stores it without any
+        # host->device transfer; compute materializes in bulk) — the same
+        # host-resident inputs the reference receives. Wrapping each image in
+        # jnp.asarray would TIME 5 tunnel transfers per image instead of the
+        # metric (22 ms/image measured) — a detector running on device hands
+        # over device arrays, which ride the zero-sync append path instead.
         metric.update(
-            [dict(boxes=jnp.asarray(det["boxes"]), scores=jnp.asarray(det["scores"]), labels=jnp.asarray(det["labels"]))],
-            [dict(boxes=jnp.asarray(gt["boxes"]), labels=jnp.asarray(gt["labels"]))],
+            [dict(boxes=det["boxes"], scores=det["scores"], labels=det["labels"])],
+            [dict(boxes=gt["boxes"], labels=gt["labels"])],
         )
     t_update = time.perf_counter() - t0
     t0 = time.perf_counter()
