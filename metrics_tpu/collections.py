@@ -15,6 +15,7 @@ from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric, _propagate_static_attrs
 from metrics_tpu.utils.data import _flatten_dict, allclose
@@ -172,6 +173,175 @@ class MetricCollection:
             m._forward_cache = values[name]
         res = _flatten_dict(values)
         return {self._set_name(k): v for k, v in res.items()}
+
+    # ------------------------------------------------- batched-step (scan) API
+    # program/template/layout per with_values flavor (True/False): alternating
+    # update_many and forward_many must not recompile the most expensive
+    # program in the library on every switch
+    _many_programs: Optional[Dict[bool, Any]] = None
+    _many_templates: Optional[Dict[bool, Dict[str, Metric]]] = None
+    _many_layouts: Optional[Dict[bool, tuple]] = None
+    _many_versions: Optional[Dict[str, int]] = None
+    _many_ok: bool = True  # batched-path health; independent of _fused_disabled
+
+    def update_many(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate a CHUNK of steps into every member in ONE dispatch
+        (leading steps axis on array arguments; see ``Metric.update_many``)."""
+        self._run_many(False, args, kwargs)
+
+    def forward_many(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        """``forward`` over a chunk of steps for the WHOLE suite in one
+        `lax.scan` program — returns ``{name: stacked per-step values}``."""
+        return self._run_many(True, args, kwargs)
+
+    def _run_many(self, with_values: bool, args: tuple, kwargs: dict) -> Any:
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        members = list(self.items(keep_base=True, copy_state=False))
+        eligible = (
+            self._many_ok
+            and not self._fused_disabled
+            and _get_validation_mode() != "full"
+            and bool(members)
+            and all(m._many_ok and m._fused_forward_ok and m._fusable_states() for _, m in members)
+            and not any(
+                m.full_state_update or m.full_state_update is None or m.dist_sync_on_step for _, m in members
+            )
+            and all(type(m).forward is Metric.forward for _, m in members)
+            and not any(m._is_synced for _, m in members)
+            and len({m._update_count for _, m in members}) == 1
+            and len({id(m) for _, m in members}) == len(members)
+        )
+        if not eligible:
+            return self._run_many_eager(with_values, args, kwargs)
+        if self._many_versions is not None and any(
+            self._many_versions.get(name) != m._fused_version for name, m in members
+        ):
+            self._many_programs = None  # a member hyperparameter changed
+        consumed: Dict[str, Any] = {}
+        for _, m in members:
+            consumed.update(m._filter_kwargs(**kwargs))
+        signature = ("__many__", with_values, Metric._forward_signature(args, consumed))
+        if self._fused_seen is None:
+            self._fused_seen = {}
+        if signature not in self._fused_seen:
+            # first sight of a chunk signature: per-step REDUCE-eager member
+            # updates (full validation) — self.forward would register the
+            # single-step signature and compile the whole-suite single-step
+            # program the scan path never uses. The signature is recorded only
+            # after the chunk validates.
+            result = self._run_many_eager(with_values, args, kwargs, force_reduce_eager=True)
+            self._fused_seen[signature] = None
+            while len(self._fused_seen) > Metric._FUSED_SIG_CAP:
+                self._fused_seen.pop(next(iter(self._fused_seen)))
+            return result
+        try:
+            python_leaves, treedef, scanned_idx, aconst_idx, scanned, array_consts = (
+                Metric._split_many_leaves(args, consumed)
+            )
+            layout = (treedef, tuple(scanned_idx), tuple(aconst_idx), repr(python_leaves))
+            if self._many_programs is None:
+                self._many_programs, self._many_templates, self._many_layouts = {}, {}, {}
+            if with_values in self._many_programs and self._many_layouts.get(with_values) != layout:
+                del self._many_programs[with_values]
+            if with_values not in self._many_programs:
+                steps, templates = {}, {}
+                for name, m in members:
+                    templates[name], steps[name] = m._build_fused_step()
+                member_filters = {name: m._filter_kwargs for name, m in members}
+
+                def program(states, update_count, xs, const_vals):
+                    def body(carry, xs_leaves):
+                        st, cnt = carry
+                        cnt = cnt + 1
+                        step_leaves = list(python_leaves)
+                        for i, leaf in zip(scanned_idx, xs_leaves):
+                            step_leaves[i] = leaf
+                        for i, leaf in zip(aconst_idx, const_vals):
+                            step_leaves[i] = leaf
+                        a, k = jax.tree.unflatten(treedef, step_leaves)
+                        new_states, vals = {}, {}
+                        for name, step in steps.items():
+                            filtered = member_filters[name](**k)
+                            new_states[name], vals[name] = step(st[name], cnt, *a, **filtered)
+                        return (new_states, cnt), (vals if with_values else 0)
+
+                    (final, _), vals = jax.lax.scan(
+                        body, (states, jnp.asarray(update_count, jnp.int32)), xs
+                    )
+                    return final, vals
+
+                self._many_programs[with_values] = jax.jit(program)
+                self._many_templates[with_values] = templates
+                self._many_layouts[with_values] = layout
+                self._many_versions = {name: m._fused_version for name, m in members}
+            states = {name: {s: getattr(m, s) for s in m._defaults} for name, m in members}
+            n_steps = int(scanned[0].shape[0])
+            count = members[0][1]._update_count
+            merged, values = self._many_programs[with_values](states, count, scanned, array_consts)
+        except Exception as exc:
+            # eager fallback; only the BATCHED suite path is disabled — the
+            # single-step fused forward keeps its own _fused_disabled flag
+            result = self._run_many_eager(with_values, args, kwargs)
+            rank_zero_warn(
+                f"Batched-step suite program for this MetricCollection raised "
+                f"{type(exc).__name__}: {exc}. Falling back to per-step eager "
+                "forwards permanently for this collection's batched API."
+            )
+            self._many_ok = False
+            self._many_programs = None
+            self._many_templates = None
+            return result
+        templates = self._many_templates[with_values]
+        for name, m in members:
+            for state_name, value in merged[name].items():
+                setattr(m, state_name, value)
+            _propagate_static_attrs(templates[name], m)
+            m._update_count += n_steps
+            m._is_synced = False
+            m._should_unsync = True
+            m._to_sync = m.sync_on_compute
+            m._computed = None
+            if with_values:
+                m._forward_cache = jax.tree.map(lambda v: v[-1], values[name])
+        if with_values:
+            res = _flatten_dict({name: values[name] for name, _ in members})
+            return {self._set_name(k): v for k, v in res.items()}
+        return None
+
+    def _run_many_eager(
+        self, with_values: bool, args: tuple, kwargs: dict, force_reduce_eager: bool = False
+    ) -> Any:
+        members = list(self.items(keep_base=True, copy_state=False))
+        # partition over the kwargs SOME member consumes — an ignored array
+        # kwarg with a different leading length must not defeat the chunk
+        # (same contract as the single-step fused path)
+        consumed: Dict[str, Any] = {}
+        for _, m in members:
+            consumed.update(m._filter_kwargs(**kwargs))
+        _, _, _, _, scanned, _ = Metric._split_many_leaves(args, consumed)
+        n_steps = int(scanned[0].shape[0])
+        values = []
+        for i in range(n_steps):
+            a, k = jax.tree.map(
+                lambda x: x[i] if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 else x,
+                (args, consumed),
+            )
+            if force_reduce_eager:
+                step_vals = {}
+                for name, m in members:
+                    step_vals[name] = m._forward_reduce_state_update_eager(*a, **m._filter_kwargs(**k))
+                    m._forward_cache = step_vals[name]
+                if with_values:
+                    res = _flatten_dict(step_vals)
+                    values.append({self._set_name(kk): v for kk, v in res.items()})
+            elif with_values:
+                values.append(self.forward(*a, **k))
+            else:
+                self.update(*a, **k)
+        if not with_values:
+            return None
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *values)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update every metric (or just each compute-group leader)."""
@@ -381,7 +551,7 @@ class MetricCollection:
     def __getstate__(self) -> Dict[str, Any]:
         # the fused whole-suite program is a jit closure: unpicklable and not
         # deepcopy-able — dropped here, rebuilt lazily on the next forward
-        drop = ("_fused_program", "_fused_templates")
+        drop = ("_fused_program", "_fused_templates", "_many_programs", "_many_templates", "_many_layouts")
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:

@@ -440,6 +440,8 @@ class Metric(ABC):
                 "update_many/forward_many need at least one array argument with a leading steps axis"
             )
         lengths = {int(x.shape[0]) for x in scanned}
+        if lengths == {0}:
+            raise ValueError("update_many/forward_many got a zero-length steps axis (empty chunk)")
         if len(lengths) != 1:
             # silent length mismatch would be worse than an error: jnp gather
             # CLAMPS out-of-bounds indices, so the eager slicing loop would

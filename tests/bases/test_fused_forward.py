@@ -650,3 +650,164 @@ def test_batched_fallback_does_not_disable_single_step_fusion():
     m(p)
     m(p)
     assert m._fused_forward is not None  # single-step fusion unaffected
+
+
+class TestCollectionBatchedStepAPI:
+    """Suite-level `update_many`/`forward_many`: the whole collection's chunk
+    runs as ONE scan program; semantics equal member-wise sequential forward."""
+
+    @staticmethod
+    def _suite():
+        return mt.MetricCollection(
+            {
+                "acc": mt.Accuracy(num_classes=1, average="macro"),
+                "f1": mt.F1Score(num_classes=1, average="macro"),
+                "mean": mt.MeanMetric(),
+            }
+        )
+
+    def _chunk(self, n=5, batch=24):
+        rng = np.random.RandomState(21)
+        return (
+            jnp.asarray(rng.rand(n, batch).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 2, (n, batch))),
+        )
+
+    def test_matches_sequential_forward(self):
+        p, t = self._chunk()
+        suite = self._suite()
+        v1 = suite.forward_many(p, t)
+        v2 = suite.forward_many(p, t)  # scan program
+        assert suite._many_programs and True in suite._many_programs
+        want = self._suite()
+        want._fused_disabled = True
+        seq_last = None
+        for _ in range(2):
+            for i in range(p.shape[0]):
+                seq_last = want(p[i], t[i])
+        got = suite.compute()
+        expect = want.compute()
+        for k in expect:
+            np.testing.assert_allclose(float(got[k]), float(expect[k]), atol=1e-6)
+            np.testing.assert_allclose(
+                float(np.asarray(v2[k])[-1]), float(seq_last[k]), atol=1e-6
+            )
+            assert np.asarray(v1[k]).shape[0] == p.shape[0]
+
+    def test_update_many_accumulates(self):
+        p, t = self._chunk()
+        suite = self._suite()
+        assert suite.update_many(p, t) is None
+        suite.update_many(p, t)
+        assert suite._many_programs and False in suite._many_programs
+        want = self._suite()
+        for _ in range(2):
+            for i in range(p.shape[0]):
+                want.update(p[i], t[i])
+        got, expect = suite.compute(), want.compute()
+        for k in expect:
+            np.testing.assert_allclose(float(got[k]), float(expect[k]), atol=1e-6)
+
+    def test_member_mutation_rebuilds_suite_program(self):
+        p, t = self._chunk()
+        suite = self._suite()
+        suite.forward_many(p, t)
+        suite.forward_many(p, t)
+        assert suite._many_programs and True in suite._many_programs
+        suite["acc"].threshold = 0.8
+        suite.forward_many(p, t)  # must run with the NEW threshold baked in
+        want = mt.Accuracy(num_classes=1, average="macro")
+        want._fused_forward_ok = False
+        for i in range(p.shape[0]):  # chunks 1-2 at the default threshold
+            want(p[i], t[i])
+            want(p[i], t[i])
+        want.threshold = 0.8
+        for i in range(p.shape[0]):  # chunk 3 at the mutated threshold
+            want(p[i], t[i])
+        np.testing.assert_allclose(
+            float(suite.compute()["acc"]), float(want.compute()), atol=1e-6
+        )
+
+    def test_unfusable_member_uses_eager_loop(self):
+        p, _ = self._chunk()
+        suite = mt.MetricCollection({"cat": mt.CatMetric(), "mean": mt.MeanMetric()})
+        vals = suite.forward_many(p)
+        assert not suite._many_programs
+        assert np.asarray(vals["mean"]).shape[0] == p.shape[0]
+
+    def test_prefix_naming_preserved(self):
+        p, t = self._chunk()
+        suite = mt.MetricCollection({"acc": mt.Accuracy()}, prefix="val_")
+        suite.forward_many(p, t)
+        out = suite.forward_many(p, t)
+        assert set(out) == {"val_acc"}
+
+
+def test_empty_chunk_raises_clearly():
+    m = mt.MeanMetric()
+    with pytest.raises(ValueError, match="zero-length"):
+        m.forward_many(jnp.zeros((0, 8)))
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    with pytest.raises(ValueError, match="zero-length"):
+        suite.forward_many(jnp.zeros((0, 8)))
+
+
+def test_collection_batched_fallback_keeps_single_step_fusion():
+    """A failed scan program disables only the collection's batched API; the
+    per-step whole-suite fused forward keeps working (review regression)."""
+    rng = np.random.RandomState(31)
+    chunk = jnp.asarray(rng.rand(3, 16).astype(np.float32))
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    suite.forward_many(chunk)
+    suite.forward_many(chunk)
+    assert suite._many_programs
+    suite._many_programs[True] = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("synthetic"))
+    with pytest.warns(UserWarning, match="batched API"):
+        suite.forward_many(chunk)
+    assert suite._many_ok is False
+    assert suite._fused_disabled is False
+    p = chunk[0]
+    suite(p)
+    suite(p)
+    assert suite._fused_program is not None  # single-step suite fusion unaffected
+
+
+def test_collection_first_chunk_skips_single_step_compile():
+    rng = np.random.RandomState(32)
+    chunk = jnp.asarray(rng.rand(3, 16).astype(np.float32))
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    suite.forward_many(chunk)
+    assert suite._fused_program is None
+    per_step = [
+        s for s in (suite._fused_seen or {}) if not (isinstance(s, tuple) and s and s[0] == "__many__")
+    ]
+    assert per_step == []
+    for _, m in suite.items(keep_base=True, copy_state=False):
+        assert m._fused_forward is None
+
+
+def test_collection_ignored_varying_kwarg_does_not_defeat_chunk():
+    rng = np.random.RandomState(33)
+    chunk = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    # `aux` is consumed by no member and has a DIFFERENT leading length
+    suite.forward_many(chunk, aux=jnp.zeros(3))
+    out = suite.forward_many(chunk, aux=jnp.zeros(3))
+    assert suite._many_programs and True in suite._many_programs
+    assert np.asarray(out["mean"]).shape[0] == 4
+
+
+def test_collection_alternating_many_flavors_keep_both_programs():
+    rng = np.random.RandomState(34)
+    chunk = jnp.asarray(rng.rand(3, 16).astype(np.float32))
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    suite.forward_many(chunk)
+    suite.update_many(chunk)
+    suite.forward_many(chunk)
+    suite.update_many(chunk)
+    assert set(suite._many_programs) == {True, False}
+    want = mt.MeanMetric()
+    for _ in range(4):
+        for i in range(3):
+            want.update(chunk[i])
+    np.testing.assert_allclose(float(suite.compute()["mean"]), float(want.compute()), atol=1e-6)
