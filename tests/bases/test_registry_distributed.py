@@ -407,3 +407,87 @@ def test_registry_spmd_merge(name):
         f"{name} grew a list state; move it to SPMD_EXCLUDE with the reason"
     )
     run_spmd_state_merge(factory, _rank_updates(batches), atol=atol)
+
+
+# ------------------------------------------------- batched-step (chunk) API
+
+# entries whose update arguments are not stackable arrays (host-side strings,
+# per-image dict lists, ragged shapes) have no chunked contract — their hot
+# path is the host loop
+CHUNK_SKIP = {
+    "BLEUScore": "string inputs",
+    "CHRFScore": "string inputs",
+    "CharErrorRate": "string inputs",
+    "ExtendedEditDistance": "string inputs",
+    "MatchErrorRate": "string inputs",
+    "MeanAveragePrecision": "per-image dict lists",
+    "ROUGEScore": "string inputs",
+    "SQuAD": "dict inputs",
+    "SacreBLEUScore": "string inputs",
+    "TranslationEditRate": "string inputs",
+    "WordErrorRate": "string inputs",
+    "WordInfoLost": "string inputs",
+    "WordInfoPreserved": "string inputs",
+}
+
+
+def _stackable(batches):
+    import jax
+
+    norm = _rank_updates(batches)  # reuse arg/kwargs normalization
+    flat_batches = [b for rank in norm for b in rank]
+    structure0 = jax.tree.structure((flat_batches[0][0], flat_batches[0][1]))
+    leaves0 = jax.tree.leaves((flat_batches[0][0], flat_batches[0][1]))
+    if not all(hasattr(x, "shape") for x in leaves0):
+        return None
+    shapes0 = [x.shape for x in leaves0]
+    for args, kwargs in flat_batches[1:]:
+        if jax.tree.structure((args, kwargs)) != structure0:
+            return None
+        leaves = jax.tree.leaves((args, kwargs))
+        if any(not hasattr(x, "shape") or x.shape != s for x, s in zip(leaves, shapes0)):
+            return None
+    return flat_batches
+
+
+def test_chunk_skip_is_consistent():
+    assert set(CHUNK_SKIP) <= set(SPEC), sorted(set(CHUNK_SKIP) - set(SPEC))
+
+
+@pytest.mark.parametrize("name", sorted(set(SPEC) - set(CHUNK_SKIP)))
+def test_registry_update_many_matches_sequential(name):
+    """`update_many` over the stacked chunk must equal sequential `update`
+    calls for every exported metric with stackable inputs — and the SECOND
+    identical chunk must cross the compiled scan path (the first chunk per
+    signature is eager-validated by design), so a scan-program bug in any
+    registry metric fails here."""
+    import jax
+
+    from metrics_tpu.utils import checks
+
+    factory, batches, atol = SPEC[name]
+    flat = _stackable(batches)
+    assert flat is not None, (
+        f"{name}: inputs not stackable — declare it in CHUNK_SKIP with the reason"
+    )
+
+    chunk_args, chunk_kwargs = jax.tree.map(lambda *xs: jnp.stack(xs), *[(a, k) for a, k in flat])
+
+    # validation mode "first" lets the scan path engage on the second chunk —
+    # the default "full" mode keeps every chunk on the eager loop by design
+    checks.set_validation_mode("first")
+    try:
+        chunked = factory()
+        chunked.update_many(*chunk_args, **chunk_kwargs)  # eager-validated first chunk
+        chunked.update_many(*chunk_args, **chunk_kwargs)  # scan path (when fusable)
+        sequential = factory()
+        for _ in range(2):
+            for args, kwargs in flat:
+                sequential.update(*args, **kwargs)
+    finally:
+        checks.set_validation_mode("full")
+
+    from tests.bases.test_distributed_contract import _values_close
+
+    _values_close(chunked.compute(), sequential.compute(), atol)
+    assert chunked._update_count == 2 * len(flat)
