@@ -10,8 +10,10 @@ Workloads (BASELINE.md "Targets" table):
   on 299x299 uint8 images, + compute with the covariance/sqrtm statistics).
 - ``coco_map_wallclock`` — COCO-style MeanAveragePrecision update+compute
   over realistic per-image detections.
-- ``per_step_overhead`` — eager module-API ``forward()`` per training step
-  (the integration-surface hot path, no jit wrapping).
+- ``per_step_overhead`` — per-step metric cost through the module API: the
+  batched ``forward_many`` path (one `lax.scan` dispatch per 1024-step
+  chunk) as the headline value, with the eager fused-forward steps/s and
+  the measured backend sync/submission floor reported alongside.
 
 Baselines: the mounted reference (`/root/reference/src`, TorchMetrics) on
 torch-CPU — labeled in the output; no CUDA exists in this environment. FID's
