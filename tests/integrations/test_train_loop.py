@@ -9,6 +9,7 @@ return value every step.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -217,3 +218,21 @@ class TestTrainLoopSPMD:
             return cmp(st)
 
         assert float(epoch(init(), losses)) == pytest.approx(float(losses.mean()), rel=1e-6)
+
+
+def test_batched_eval_example_runs():
+    """examples/batched_eval.py end to end: chunked forward_many totals must
+    equal a per-sample oracle over the identical data."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "examples/batched_eval.py"],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "epoch: acc=" in out.stdout
+    assert "MSE over 2 chunks: 0.010000" in out.stdout  # (0.1)^2 exactly
