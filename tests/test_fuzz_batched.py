@@ -1,0 +1,172 @@
+"""Fuzz-parity for the batched-step API (`update_many` / `forward_many`).
+
+Randomized chunk lengths, batch shapes, dtypes, kwarg styles (python scalar
+vs 0-d array), interleavings of single-step `forward` with chunks, and
+mid-stream hyperparameter mutation — every draw must agree exactly with the
+sequential eager oracle on the identical data. The structured contract
+tests pin the designed cases; this bank hunts the unplanned interactions.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utils import checks
+
+N_DRAWS = 12
+
+
+@pytest.fixture(autouse=True)
+def _first_mode():
+    checks.set_validation_mode("first")
+    yield
+    checks.set_validation_mode("full")
+
+
+FACTORIES = [
+    ("Accuracy", lambda: mt.Accuracy(), 2),
+    ("MeanMetric", lambda: mt.MeanMetric(), 1),
+    ("MSE", lambda: mt.MeanSquaredError(), 2),
+    ("MaxMetric", lambda: mt.MaxMetric(), 1),
+    ("F1", lambda: mt.F1Score(num_classes=1, average="macro"), 2),
+    ("CalibrationError", lambda: mt.CalibrationError(), 2),
+    ("CatMetric(eager)", lambda: mt.CatMetric(), 1),
+]
+
+
+def _args_for(rng, n_args, n_steps, batch):
+    p = jnp.asarray(rng.rand(n_steps, batch).astype(np.float32))
+    if n_args == 1:
+        return (p,)
+    return (p, jnp.asarray(rng.randint(0, 2, (n_steps, batch))))
+
+
+@pytest.mark.parametrize("draw", range(N_DRAWS))
+@pytest.mark.parametrize("name,factory,n_args", FACTORIES, ids=[f[0] for f in FACTORIES])
+def test_random_chunk_schedule_matches_sequential(name, factory, n_args, draw):
+    """A random schedule of forward_many / update_many / single forward calls
+    with varying chunk lengths equals the flattened sequential run."""
+    rng = np.random.RandomState(1000 + draw)
+    batch = int(rng.randint(8, 48))
+    chunked, sequential = factory(), factory()
+    sequential._fused_forward_ok = False
+
+    for _ in range(int(rng.randint(2, 5))):
+        kind = rng.choice(["forward_many", "update_many", "forward"])
+        if kind == "forward":
+            args = _args_for(rng, n_args, 1, batch)
+            single = tuple(a[0] for a in args)
+            chunked(*single)
+            sequential(*single)
+        else:
+            n_steps = int(rng.randint(1, 6))
+            args = _args_for(rng, n_args, n_steps, batch)
+            if kind == "forward_many":
+                vals = chunked.forward_many(*args)
+                seq_vals = [sequential(*tuple(a[i] for a in args)) for i in range(n_steps)]
+                np.testing.assert_allclose(
+                    np.asarray(vals), np.asarray(seq_vals), atol=1e-6, rtol=1e-5
+                )
+            else:
+                chunked.update_many(*args)
+                for i in range(n_steps):
+                    sequential.update(*tuple(a[i] for a in args))
+    np.testing.assert_allclose(
+        np.asarray(chunked.compute()), np.asarray(sequential.compute()), atol=1e-6, rtol=1e-5
+    )
+    assert chunked._update_count == sequential._update_count
+
+
+@pytest.mark.parametrize("draw", range(N_DRAWS))
+def test_random_weighted_mean_chunks(draw):
+    """MeanMetric with a weight argument drawn as: stacked array, 0-d array,
+    or python scalar — all three must ride the chunk correctly."""
+    rng = np.random.RandomState(2000 + draw)
+    batch = int(rng.randint(4, 32))
+    chunked, sequential = mt.MeanMetric(), mt.MeanMetric()
+    sequential._fused_forward_ok = False
+    for _ in range(int(rng.randint(2, 4))):
+        n_steps = int(rng.randint(1, 5))
+        v = jnp.asarray(rng.rand(n_steps, batch).astype(np.float32))
+        style = rng.choice(["stacked", "zero_d", "scalar", "none"])
+        if style == "stacked":
+            w = jnp.asarray(rng.rand(n_steps, batch).astype(np.float32) + 0.1)
+            chunked.forward_many(v, weight=w)
+            for i in range(n_steps):
+                sequential(v[i], weight=w[i])
+        elif style == "zero_d":
+            w0 = jnp.asarray(float(rng.rand() + 0.1))
+            chunked.forward_many(v, weight=w0)
+            for i in range(n_steps):
+                sequential(v[i], weight=w0)
+        elif style == "scalar":
+            ws = float(rng.rand() + 0.1)
+            chunked.forward_many(v, weight=ws)
+            for i in range(n_steps):
+                sequential(v[i], weight=ws)
+        else:
+            chunked.forward_many(v)
+            for i in range(n_steps):
+                sequential(v[i])
+    np.testing.assert_allclose(
+        float(chunked.compute()), float(sequential.compute()), atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("draw", range(6))
+def test_random_suite_chunks(draw):
+    """MetricCollection chunks with random member sets and chunk lengths."""
+    rng = np.random.RandomState(3000 + draw)
+    batch = int(rng.randint(8, 32))
+    pool = {
+        "acc": lambda: mt.Accuracy(num_classes=1, average="macro"),
+        "f1": lambda: mt.F1Score(num_classes=1, average="macro"),
+        "mean": lambda: mt.MeanMetric(),
+        "mse": lambda: mt.MeanSquaredError(),
+    }
+    names = sorted(rng.choice(sorted(pool), size=int(rng.randint(2, 4)), replace=False))
+    chunked = mt.MetricCollection({n: pool[n]() for n in names})
+    sequential = mt.MetricCollection({n: pool[n]() for n in names})
+    sequential._fused_disabled = True
+    for _, m in sequential.items(keep_base=True, copy_state=False):
+        m._fused_forward_ok = False  # the oracle must be the EAGER path
+    for _ in range(int(rng.randint(2, 4))):
+        n_steps = int(rng.randint(1, 5))
+        p = jnp.asarray(rng.rand(n_steps, batch).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, (n_steps, batch)))
+        vals = chunked.forward_many(p, t)
+        for i in range(n_steps):
+            seq_vals = sequential(p[i], t[i])
+        for k in seq_vals:
+            np.testing.assert_allclose(
+                float(np.asarray(vals[k])[-1]), float(seq_vals[k]), atol=1e-6, rtol=1e-5
+            )
+    got, want = chunked.compute(), sequential.compute()
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]), atol=1e-6, rtol=1e-5)
+
+
+def test_mutation_mid_schedule_takes_effect():
+    """Hyperparameter mutation between chunks must apply to later chunks and
+    never be reverted by template write-back."""
+    rng = np.random.RandomState(7)
+    p = jnp.asarray(rng.rand(4, 16).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, (4, 16)))
+    chunked, sequential = mt.Accuracy(), mt.Accuracy()
+    sequential._fused_forward_ok = False
+    chunked.update_many(p, t)
+    chunked.update_many(p, t)
+    for _ in range(2):
+        for i in range(4):
+            sequential.update(p[i], t[i])
+    chunked.threshold = 0.9
+    sequential.threshold = 0.9
+    chunked.update_many(p, t)
+    for i in range(4):
+        sequential.update(p[i], t[i])
+    assert chunked.threshold == 0.9
+    np.testing.assert_allclose(
+        float(chunked.compute()), float(sequential.compute()), atol=1e-6
+    )
