@@ -88,7 +88,17 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max (reference `aggregation.py:119-166`)."""
+    """Running max (reference `aggregation.py:119-166`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(3., dtype=float32)
+    """
 
     _nan_neutral = float("-inf")
 
@@ -102,7 +112,17 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running min (reference `aggregation.py:169-216`)."""
+    """Running min (reference `aggregation.py:169-216`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     _nan_neutral = float("inf")
 
@@ -116,7 +136,17 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum (reference `aggregation.py:219-265`)."""
+    """Running sum (reference `aggregation.py:219-265`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(6., dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
@@ -127,7 +157,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values (reference `aggregation.py:268-313`)."""
+    """Concatenate all seen values (reference `aggregation.py:268-313`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array([1., 2., 3.], dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)
@@ -144,7 +184,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean (reference `aggregation.py:316-364`)."""
+    """Weighted running mean (reference `aggregation.py:316-364`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(2., dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)

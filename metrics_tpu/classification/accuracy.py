@@ -22,7 +22,17 @@ from metrics_tpu.utils.enums import DataType
 
 
 class Accuracy(StatScores):
-    """Accuracy (micro/macro/weighted/samplewise, top-k, subset mode)."""
+    """Accuracy (micro/macro/weighted/samplewise, top-k, subset mode).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> accuracy = Accuracy()
+        >>> accuracy(preds, target)
+        Array(0.5, dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

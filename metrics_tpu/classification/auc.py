@@ -11,7 +11,16 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class AUC(Metric):
-    """Area under any accumulated (x, y) curve."""
+    """Area under any accumulated (x, y) curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUC
+        >>> auc = AUC(reorder=True)
+        >>> auc.update(jnp.asarray([0.0, 1.0, 2.0, 3.0]), jnp.asarray([0.0, 1.0, 2.0, 2.0]))
+        >>> auc.compute()
+        Array(4., dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = None

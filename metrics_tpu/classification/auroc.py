@@ -12,7 +12,17 @@ from metrics_tpu.utils.enums import AverageMethod
 
 
 class AUROC(Metric):
-    """Area under the ROC curve from accumulated scores."""
+    """Area under the ROC curve from accumulated scores.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> auroc = AUROC(pos_label=1)
+        >>> auroc(preds, target)
+        Array(0.5, dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

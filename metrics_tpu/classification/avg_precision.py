@@ -15,7 +15,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class AveragePrecision(Metric):
-    """Average precision from accumulated scores."""
+    """Average precision from accumulated scores.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AveragePrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> average_precision = AveragePrecision(pos_label=1)
+        >>> average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

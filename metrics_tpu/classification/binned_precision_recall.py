@@ -49,7 +49,24 @@ def _recall_at_precision(
 
 
 class BinnedPrecisionRecallCurve(Metric):
-    """Constant-memory PR curve over a fixed threshold grid."""
+    """Constant-memory PR curve over a fixed threshold grid.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedPrecisionRecallCurve
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> metric = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        >>> precision, recall, thresholds = metric(preds, target)
+        >>> precision
+        Array([0.5000001 , 0.66666675, 1.        , 1.        , 1.        ,
+               1.        ], dtype=float32)
+        >>> recall
+        Array([0.9999995 , 0.9999995 , 0.49999976, 0.49999976, 0.        ,
+               0.        ], dtype=float32)
+        >>> thresholds
+        Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = None
@@ -110,7 +127,17 @@ class BinnedPrecisionRecallCurve(Metric):
 
 
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
-    """Average precision from the binned curve (constant memory)."""
+    """Average precision from the binned curve (constant memory).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedAveragePrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> metric = BinnedAveragePrecision(num_classes=1, thresholds=5)
+        >>> metric(preds, target)
+        Array(0.833333, dtype=float32)
+    """
 
     def compute(self) -> Union[List[jax.Array], jax.Array]:
         precisions, recalls, _ = super().compute()
@@ -120,7 +147,17 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
 
 
 class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
-    """Highest recall (and its threshold) with precision >= min_precision."""
+    """Highest recall (and its threshold) with precision >= min_precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedRecallAtFixedPrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> metric = BinnedRecallAtFixedPrecision(num_classes=1, min_precision=0.5, thresholds=5)
+        >>> metric(preds, target)
+        (Array(0.9999995, dtype=float32), Array(0.25, dtype=float32))
+    """
 
     def __init__(
         self,

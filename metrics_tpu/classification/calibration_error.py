@@ -26,7 +26,17 @@ from metrics_tpu.metric import Metric
 
 
 class CalibrationError(Metric):
-    """Expected/max/RMS calibration error over accumulated per-bin statistics."""
+    """Expected/max/RMS calibration error over accumulated per-bin statistics.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CalibrationError
+        >>> preds = jnp.asarray([0.25, 0.35, 0.8, 0.9])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> metric = CalibrationError(n_bins=3, norm='l1')
+        >>> round(float(metric(preds, target)), 4)
+        0.225
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = False

@@ -14,7 +14,18 @@ from metrics_tpu.metric import Metric
 
 
 class ConfusionMatrix(Metric):
-    """(C, C) confusion matrix ((C, 2, 2) for multilabel)."""
+    """(C, C) confusion matrix ((C, 2, 2) for multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> confmat = ConfusionMatrix(num_classes=2)
+        >>> confmat(preds, target)
+        Array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = None

@@ -10,7 +10,17 @@ from metrics_tpu.functional.classification.dice import _dice_compute
 
 
 class Dice(StatScores):
-    """Dice coefficient = 2·tp / (2·tp + fp + fn)."""
+    """Dice coefficient = 2·tp / (2·tp + fp + fn).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Dice
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> dice = Dice(average='micro')
+        >>> dice(preds, target)
+        Array(0.25, dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

@@ -13,7 +13,17 @@ from metrics_tpu.functional.classification.f_beta import _fbeta_compute
 
 
 class FBetaScore(StatScores):
-    """Weighted harmonic mean of precision and recall."""
+    """Weighted harmonic mean of precision and recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import FBetaScore
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> f_beta = FBetaScore(num_classes=3, beta=0.5)
+        >>> round(float(f_beta(preds, target)), 4)
+        0.3333
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True
@@ -53,7 +63,17 @@ class FBetaScore(StatScores):
 
 
 class F1Score(FBetaScore):
-    """F1 = FBeta with beta=1."""
+    """F1 = FBeta with beta=1.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import F1Score
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> f1 = F1Score(num_classes=3)
+        >>> round(float(f1(preds, target)), 4)
+        0.3333
+    """
 
     def __init__(
         self,

@@ -14,7 +14,17 @@ from metrics_tpu.metric import Metric
 
 
 class HammingDistance(Metric):
-    """Share of wrongly predicted labels over all label positions."""
+    """Share of wrongly predicted labels over all label positions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> hamming = HammingDistance()
+        >>> hamming(preds, target)
+        Array(0.25, dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = False

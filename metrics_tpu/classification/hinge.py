@@ -15,7 +15,17 @@ from metrics_tpu.metric import Metric
 
 
 class HingeLoss(Metric):
-    """Mean hinge loss over all seen samples."""
+    """Mean hinge loss over all seen samples.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HingeLoss
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> hinge = HingeLoss()
+        >>> round(float(hinge(preds, target)), 4)
+        0.3
+    """
 
     is_differentiable: Optional[bool] = True
     higher_is_better: Optional[bool] = False

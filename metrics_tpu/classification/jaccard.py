@@ -10,7 +10,17 @@ from metrics_tpu.functional.classification.jaccard import _jaccard_from_confmat
 
 
 class JaccardIndex(ConfusionMatrix):
-    """Jaccard index (IoU) from an accumulated confusion matrix."""
+    """Jaccard index (IoU) from an accumulated confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import JaccardIndex
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> jaccard = JaccardIndex(num_classes=2)
+        >>> round(float(jaccard(preds, target)), 4)
+        0.5833
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

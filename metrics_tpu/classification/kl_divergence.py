@@ -12,7 +12,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class KLDivergence(Metric):
-    """KL(P ‖ Q) accumulated over batches."""
+    """KL(P ‖ Q) accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> kl_divergence = KLDivergence()
+        >>> round(float(kl_divergence(p, q)), 4)
+        0.0853
+    """
 
     is_differentiable: Optional[bool] = True
     higher_is_better: Optional[bool] = False

@@ -14,7 +14,17 @@ from metrics_tpu.metric import Metric
 
 
 class MatthewsCorrCoef(Metric):
-    """Matthews correlation coefficient from an accumulated confusion matrix."""
+    """Matthews correlation coefficient from an accumulated confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrCoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> matthews_corrcoef = MatthewsCorrCoef(num_classes=2)
+        >>> round(float(matthews_corrcoef(preds, target)), 4)
+        0.5774
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

@@ -45,7 +45,17 @@ class _PrecisionRecallBase(StatScores):
 
 
 class Precision(_PrecisionRecallBase):
-    """Precision = tp / (tp + fp)."""
+    """Precision = tp / (tp + fp).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> precision = Precision(average='macro', num_classes=3)
+        >>> round(float(precision(preds, target)), 4)
+        0.1667
+    """
 
     def compute(self) -> jax.Array:
         tp, fp, tn, fn = self._get_final_stats()
@@ -53,7 +63,17 @@ class Precision(_PrecisionRecallBase):
 
 
 class Recall(_PrecisionRecallBase):
-    """Recall = tp / (tp + fn)."""
+    """Recall = tp / (tp + fn).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Recall
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> recall = Recall(average='macro', num_classes=3)
+        >>> round(float(recall(preds, target)), 4)
+        0.3333
+    """
 
     def compute(self) -> jax.Array:
         tp, fp, tn, fn = self._get_final_stats()

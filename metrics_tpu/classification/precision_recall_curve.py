@@ -19,7 +19,22 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class PrecisionRecallCurve(Metric):
-    """Exact PR curve from all accumulated scores (epoch-end, eager)."""
+    """Exact PR curve from all accumulated scores (epoch-end, eager).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PrecisionRecallCurve
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> pr_curve = PrecisionRecallCurve(pos_label=1)
+        >>> precision, recall, thresholds = pr_curve(preds, target)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0. , 0. ], dtype=float32)
+        >>> thresholds
+        Array([1., 2., 3.], dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = None

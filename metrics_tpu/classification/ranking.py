@@ -30,7 +30,17 @@ class _RankingBase(Metric):
 
 
 class CoverageError(_RankingBase):
-    """Average depth of ranking needed to cover all relevant labels."""
+    """Average depth of ranking needed to cover all relevant labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CoverageError
+        >>> preds = jnp.asarray([[-0.25, 0.50, 0.10], [-0.05, 0.75, 0.95]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0]])
+        >>> metric = CoverageError()
+        >>> metric(preds, target)
+        Array(2.5, dtype=float32)
+    """
 
     higher_is_better: Optional[bool] = False
 
@@ -47,7 +57,17 @@ class CoverageError(_RankingBase):
 
 
 class LabelRankingAveragePrecision(_RankingBase):
-    """Label ranking average precision for multilabel data."""
+    """Label ranking average precision for multilabel data.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingAveragePrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.80, 0.90]])
+        >>> target = jnp.asarray([[1, 0, 0], [0, 0, 1]])
+        >>> metric = LabelRankingAveragePrecision()
+        >>> metric(preds, target)
+        Array(1., dtype=float32)
+    """
 
     higher_is_better: Optional[bool] = True
 
@@ -66,7 +86,17 @@ class LabelRankingAveragePrecision(_RankingBase):
 
 
 class LabelRankingLoss(_RankingBase):
-    """Average number of wrongly-ordered label pairs."""
+    """Average number of wrongly-ordered label pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingLoss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.80, 0.90]])
+        >>> target = jnp.asarray([[1, 0, 0], [0, 0, 1]])
+        >>> metric = LabelRankingLoss()
+        >>> metric(preds, target)
+        Array(0., dtype=float32)
+    """
 
     higher_is_better: Optional[bool] = False
 

@@ -12,7 +12,22 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class ROC(Metric):
-    """Receiver operating characteristic curve from accumulated scores."""
+    """Receiver operating characteristic curve from accumulated scores.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ROC
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> roc = ROC(pos_label=1)
+        >>> fpr, tpr, thresholds = roc(preds, target)
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+        >>> tpr
+        Array([0.        , 0.33333334, 0.6666667 , 1.        , 1.        ],      dtype=float32)
+        >>> thresholds
+        Array([4., 3., 2., 1., 0.], dtype=float32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = None

@@ -10,7 +10,17 @@ from metrics_tpu.functional.classification.specificity import _specificity_compu
 
 
 class Specificity(StatScores):
-    """Specificity = tn / (tn + fp)."""
+    """Specificity = tn / (tn + fp).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> specificity = Specificity(average='macro', num_classes=3)
+        >>> round(float(specificity(preds, target)), 4)
+        0.6111
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

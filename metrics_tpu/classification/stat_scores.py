@@ -19,7 +19,17 @@ from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
 
 
 class StatScores(Metric):
-    """Accumulates tp/fp/tn/fn; ``compute`` returns ``[..., 5]`` with support."""
+    """Accumulates tp/fp/tn/fn; ``compute`` returns ``[..., 5]`` with support.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> preds = jnp.asarray([1, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> stat_scores = StatScores(reduce='micro')
+        >>> stat_scores(preds, target)
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = None

@@ -33,6 +33,16 @@ class MetricCollection:
             state (only the group leader updates — "2x-3x lower computational
             cost", reference `docs/source/pages/overview.rst:313-316`); a list of
             lists to pin groups manually; ``False`` to disable.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricCollection, Precision
+        >>> collection = MetricCollection([Accuracy(num_classes=3), Precision(num_classes=3, average="macro")])
+        >>> preds = jnp.asarray([0, 2, 1, 2])
+        >>> target = jnp.asarray([0, 1, 1, 2])
+        >>> collection.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in sorted(collection.compute().items())}
+        {'Accuracy': 0.75, 'Precision': 0.8333}
     """
 
     def __init__(

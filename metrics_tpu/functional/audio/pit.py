@@ -99,7 +99,22 @@ def permutation_invariant_training(
 
 
 def pit_permutate(preds: jax.Array, perm: jax.Array) -> jax.Array:
-    """Reorder ``preds`` speakers according to ``perm`` (reference `pit.py:193-216`)."""
+    """Reorder ``preds`` speakers according to ``perm`` (reference `pit.py:193-216`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import permutation_invariant_training, pit_permutate
+        >>> preds = jnp.asarray([[[1.0, 2.0], [3.0, 4.0]]])  # (batch, spk, time)
+        >>> target = jnp.asarray([[[3.0, 4.0], [1.0, 2.0]]])
+        >>> def neg_l1(p, t):
+        ...     return -jnp.abs(p - t).mean(axis=-1)
+        >>> best_metric, best_perm = permutation_invariant_training(preds, target, neg_l1, eval_func='max')
+        >>> best_perm
+        Array([[1, 0]], dtype=int32)
+        >>> pit_permutate(preds, best_perm)
+        Array([[[3., 4.],
+                [1., 2.]]], dtype=float32)
+    """
     return jnp.take_along_axis(preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)), axis=1)
 
 

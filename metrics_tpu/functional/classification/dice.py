@@ -91,7 +91,16 @@ def dice_score(
     no_fg_score: float = 0.0,
     reduction: str = "elementwise_mean",
 ) -> jax.Array:
-    """Legacy dice over softmax probability maps (reference `dice.py:27-104`)."""
+    """Legacy dice over softmax probability maps (reference `dice.py:27-104`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import dice_score
+        >>> preds = jnp.asarray([[0.1, 0.8, 0.1], [0.6, 0.2, 0.2], [0.2, 0.2, 0.6]])
+        >>> target = jnp.asarray([1, 0, 2])
+        >>> round(float(dice_score(preds, target)), 4)
+        1.0
+    """
     from metrics_tpu.parallel.sync import reduce as _reduce
 
     num_classes = preds.shape[1]

@@ -172,7 +172,17 @@ def precision_recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Both precision and recall from one stat-scores pass."""
+    """Both precision and recall from one stat-scores pass.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> prec, rec = precision_recall(preds, target, average='macro', num_classes=3)
+        >>> (round(float(prec), 4), round(float(rec), 4))
+        (0.1667, 0.3333)
+    """
     _check_average_arg(average, mdmc_average, num_classes, ignore_index)
     tp, fp, tn, fn = _prf_update(
         preds, target, average, mdmc_average, num_classes, threshold, top_k, multiclass, ignore_index

@@ -94,7 +94,16 @@ def _label_ranking_average_precision_compute(score, n_elements, sample_weight=No
 
 
 def label_ranking_average_precision(preds, target, sample_weight: Optional[jax.Array] = None) -> jax.Array:
-    """Average over relevant labels of (relevant-rank / overall-rank)."""
+    """Average over relevant labels of (relevant-rank / overall-rank).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import label_ranking_average_precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.80, 0.90]])
+        >>> target = jnp.asarray([[1, 0, 0], [0, 0, 1]])
+        >>> label_ranking_average_precision(preds, target)
+        Array(1., dtype=float32)
+    """
     score, n_elements, sample_weight = _label_ranking_average_precision_update(preds, target, sample_weight)
     return _label_ranking_average_precision_compute(score, n_elements, sample_weight)
 
@@ -130,7 +139,16 @@ def _label_ranking_loss_compute(loss, n_elements, sample_weight=None) -> jax.Arr
 
 
 def label_ranking_loss(preds, target, sample_weight: Optional[jax.Array] = None) -> jax.Array:
-    """Average fraction of wrongly-ordered label pairs."""
+    """Average fraction of wrongly-ordered label pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import label_ranking_loss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.80, 0.90]])
+        >>> target = jnp.asarray([[1, 0, 0], [0, 0, 1]])
+        >>> label_ranking_loss(preds, target)
+        Array(0., dtype=float32)
+    """
     loss, n_elements, sample_weight = _label_ranking_loss_update(preds, target, sample_weight)
     return _label_ranking_loss_compute(loss, n_elements, sample_weight)
 

@@ -138,7 +138,16 @@ def spectral_distortion_index(
     p: int = 1,
     reduction: Optional[str] = "elementwise_mean",
 ) -> jax.Array:
-    """D-lambda: distance between band-pair UQI matrices of preds vs target."""
+    """D-lambda: distance between band-pair UQI matrices of preds vs target.
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.functional import spectral_distortion_index
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (2, 3, 16, 16))
+        >>> round(float(spectral_distortion_index(preds, target)), 4)
+        0.0595
+    """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
     preds, target = _image_update(preds, target)

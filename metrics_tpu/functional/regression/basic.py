@@ -80,7 +80,16 @@ def _mean_squared_log_error_compute(sum_squared_log_error, n_obs) -> jax.Array:
 
 
 def mean_squared_log_error(preds, target) -> jax.Array:
-    """MSLE over log1p-transformed values."""
+    """MSLE over log1p-transformed values.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_log_error
+        >>> preds = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> target = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> round(float(mean_squared_log_error(preds, target)), 4)
+        0.0397
+    """
     sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)
 
@@ -96,7 +105,16 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error, n_obs) -> jax.Arr
 
 
 def mean_absolute_percentage_error(preds, target) -> jax.Array:
-    """MAPE with epsilon-clipped denominators."""
+    """MAPE with epsilon-clipped denominators.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_percentage_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> round(float(mean_absolute_percentage_error(preds, target)), 4)
+        0.3274
+    """
     sum_abs_per_error, n_obs = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(sum_abs_per_error, n_obs)
 
@@ -108,7 +126,16 @@ def _symmetric_mape_update(preds, target, epsilon: float = _EPS) -> Tuple[jax.Ar
 
 
 def symmetric_mean_absolute_percentage_error(preds, target) -> jax.Array:
-    """SMAPE = mean(2|p - t| / (|t| + |p|))."""
+    """SMAPE = mean(2|p - t| / (|t| + |p|)).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> round(float(symmetric_mean_absolute_percentage_error(preds, target)), 4)
+        0.5788
+    """
     sum_abs_per_error, n_obs = _symmetric_mape_update(preds, target)
     return sum_abs_per_error / n_obs
 
@@ -123,7 +150,16 @@ def _weighted_mape_compute(sum_abs_error, sum_scale, epsilon: float = _EPS) -> j
 
 
 def weighted_mean_absolute_percentage_error(preds, target) -> jax.Array:
-    """WMAPE = Σ|p - t| / Σ|t|."""
+    """WMAPE = Σ|p - t| / Σ|t|.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import weighted_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> round(float(weighted_mean_absolute_percentage_error(preds, target)), 4)
+        0.16
+    """
     sum_abs_error, sum_scale = _weighted_mape_update(preds, target)
     return _weighted_mape_compute(sum_abs_error, sum_scale)
 

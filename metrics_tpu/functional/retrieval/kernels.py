@@ -86,6 +86,14 @@ def retrieval_precision(preds, target, k: Optional[int] = None, adaptive_k: bool
     Parity: reference `functional/retrieval/precision.py:21-66` — only
     ``min(k, n)`` docs are examined, but the divisor stays ``k`` unless
     ``adaptive_k`` caps it at the number of documents.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_precision(preds, target, k=2)
+        Array(0.5, dtype=float32)
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
@@ -101,7 +109,16 @@ def retrieval_precision(preds, target, k: Optional[int] = None, adaptive_k: bool
 
 
 def retrieval_recall(preds, target, k: Optional[int] = None) -> jax.Array:
-    """Fraction of relevant documents found in the top-k."""
+    """Fraction of relevant documents found in the top-k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_recall
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_recall(preds, target, k=2)
+        Array(0.5, dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     kk = _resolve_k(preds.shape[0], k)
     order = jnp.argsort(-preds, stable=True)
@@ -111,7 +128,16 @@ def retrieval_recall(preds, target, k: Optional[int] = None) -> jax.Array:
 
 
 def retrieval_fall_out(preds, target, k: Optional[int] = None) -> jax.Array:
-    """Fraction of NON-relevant documents retrieved in the top-k."""
+    """Fraction of NON-relevant documents retrieved in the top-k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_fall_out
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_fall_out(preds, target, k=2)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     kk = _resolve_k(preds.shape[0], k)
     order = jnp.argsort(-preds, stable=True)
@@ -121,7 +147,16 @@ def retrieval_fall_out(preds, target, k: Optional[int] = None) -> jax.Array:
 
 
 def retrieval_hit_rate(preds, target, k: Optional[int] = None) -> jax.Array:
-    """1.0 if any relevant document appears in the top-k."""
+    """1.0 if any relevant document appears in the top-k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_hit_rate
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_hit_rate(preds, target, k=2)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     kk = _resolve_k(preds.shape[0], k)
     order = jnp.argsort(-preds, stable=True)
@@ -136,6 +171,14 @@ def retrieval_r_precision(preds, target) -> jax.Array:
     (like AP/MRR). Deliberate divergence: the reference crashes on float
     targets here (its R indexes a slice with a float tensor); a defined
     binarized value beats a TypeError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_r_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_r_precision(preds, target)
+        Array(0.5, dtype=float32)
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     order = jnp.argsort(-preds, stable=True)
@@ -181,6 +224,19 @@ def retrieval_precision_recall_curve(
     the output always has ``max_k`` entries; past the number of documents the
     cumulated hits stay flat, so precision DECAYS as hits/k — unless
     ``adaptive_k``, which clamps the divisor (and reported k) at ``n``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision_recall_curve
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> precisions, recalls, top_k = retrieval_precision_recall_curve(preds, target, max_k=2)
+        >>> precisions
+        Array([1. , 0.5], dtype=float32)
+        >>> recalls
+        Array([0.5, 0.5], dtype=float32)
+        >>> top_k
+        Array([1, 2], dtype=int32)
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     n = preds.shape[0]

@@ -248,6 +248,15 @@ def bert_score(
     for drop-in signature compatibility with the reference and are no-ops
     here: device placement is JAX-managed and baselines load from
     ``baseline_path`` only.
+
+    Example:
+        >>> from metrics_tpu.functional import bert_score
+        >>> preds = ["hello there", "general kenobi"]
+        >>> target = ["hello there", "master kenobi"]
+        >>> score = bert_score(preds, target,
+        ...     model_name_or_path="roberta-large")  # doctest: +SKIP
+        >>> {k: [round(float(s), 3) for s in v] for k, v in score.items()}  # doctest: +SKIP
+        {'precision': [1.0, 0.996], 'recall': [1.0, 0.996], 'f1': [1.0, 0.996]}
     """
     del device, num_threads, baseline_url  # torch runtime knobs; see docstring
     preds = [preds] if isinstance(preds, str) else preds if isinstance(preds, dict) else list(preds)

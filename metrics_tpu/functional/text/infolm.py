@@ -222,6 +222,16 @@ def infolm(
     ``device``/``num_threads``/``verbose`` are accepted for drop-in signature
     compatibility with the reference and are no-ops here (JAX manages device
     placement; the forward is jitted, not a tqdm-wrapped dataloader loop).
+
+    Example:
+        >>> from metrics_tpu.functional import infolm
+        >>> preds = ["he read the book because he was interested in world history"]
+        >>> target = ["he was interested in world history because he read the book"]
+        >>> score = infolm(preds, target,
+        ...     model_name_or_path="google/bert_uncased_L-2_H-128_A-2",
+        ...     idf=False)  # doctest: +SKIP
+        >>> round(float(score), 4)  # doctest: +SKIP
+        -0.1784
     """
     del device, num_threads, verbose  # torch runtime knobs; see docstring
     preds = [preds] if isinstance(preds, str) else list(preds)

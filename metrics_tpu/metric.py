@@ -76,6 +76,25 @@ class Metric(ABC):
             expresses scope as a mesh axis instead (SURVEY §2.10).
         dist_sync_fn: custom gather callable (host path injection point).
         sync_on_compute: whether ``compute()`` syncs automatically.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Metric
+        >>> class CountPositives(Metric):
+        ...     full_state_update = False
+        ...     def __init__(self):
+        ...         super().__init__()
+        ...         self.add_state("count", default=jnp.asarray(0), dist_reduce_fx="sum")
+        ...     def update(self, x):
+        ...         self.count = self.count + (x > 0).sum()
+        ...     def compute(self):
+        ...         return self.count
+        >>> metric = CountPositives()
+        >>> metric(jnp.asarray([1.0, -2.0, 3.0]))
+        Array(2, dtype=int32)
+        >>> metric.update(jnp.asarray([5.0]))
+        >>> metric.compute()
+        Array(3, dtype=int32)
     """
 
     __jit_unused_properties__: List[str] = ["update_called", "update_count"]
@@ -1278,7 +1297,17 @@ def _squeeze_scalar(value: Any) -> Any:
 
 
 class CompositionalMetric(Metric):
-    """Lazy arithmetic composition of metrics (reference `metric.py:853-961`)."""
+    """Lazy arithmetic composition of metrics (reference `metric.py:853-961`).
+
+    Example:
+        >>> from metrics_tpu import MeanMetric, SumMetric
+        >>> ratio = MeanMetric() / SumMetric()
+        >>> type(ratio).__name__
+        'CompositionalMetric'
+        >>> ratio.update([2.0, 4.0])
+        >>> ratio.compute()
+        Array(0.5, dtype=float32)
+    """
 
     full_state_update: Optional[bool] = True
 

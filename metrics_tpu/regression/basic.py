@@ -26,7 +26,17 @@ from metrics_tpu.metric import Metric
 
 
 class MeanSquaredError(Metric):
-    """MSE (or RMSE with ``squared=False``)."""
+    """MSE (or RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> mean_squared_error = MeanSquaredError()
+        >>> mean_squared_error(preds, target)
+        Array(0.375, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -52,7 +62,17 @@ class MeanSquaredError(Metric):
 
 
 class MeanAbsoluteError(Metric):
-    """MAE."""
+    """MAE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> mean_absolute_error = MeanAbsoluteError()
+        >>> mean_absolute_error(preds, target)
+        Array(0.5, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -73,7 +93,17 @@ class MeanAbsoluteError(Metric):
 
 
 class MeanSquaredLogError(Metric):
-    """MSLE."""
+    """MSLE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredLogError
+        >>> preds = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> target = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> mean_squared_log_error = MeanSquaredLogError()
+        >>> round(float(mean_squared_log_error(preds, target)), 4)
+        0.0397
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -94,7 +124,17 @@ class MeanSquaredLogError(Metric):
 
 
 class MeanAbsolutePercentageError(Metric):
-    """MAPE."""
+    """MAPE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsolutePercentageError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> mape = MeanAbsolutePercentageError()
+        >>> round(float(mape(preds, target)), 4)
+        0.3274
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -115,7 +155,17 @@ class MeanAbsolutePercentageError(Metric):
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
-    """SMAPE."""
+    """SMAPE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> smape = SymmetricMeanAbsolutePercentageError()
+        >>> round(float(smape(preds, target)), 4)
+        0.5788
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -136,7 +186,17 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
-    """WMAPE."""
+    """WMAPE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import WeightedMeanAbsolutePercentageError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> wmape = WeightedMeanAbsolutePercentageError()
+        >>> round(float(wmape(preds, target)), 4)
+        0.16
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -127,7 +127,16 @@ def group_rows(indexes: jax.Array, preds: jax.Array, target: jax.Array) -> Group
 
 
 class RetrievalMetric(Metric):
-    """Base for retrieval metrics evaluated per query group."""
+    """Base for retrieval metrics evaluated per query group.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> metric = RetrievalMRR()  # every subclass shares the (preds, target, indexes) lifecycle
+        >>> metric.update(jnp.asarray([0.3, 0.7, 0.4]), jnp.asarray([0, 1, 1]), jnp.asarray([0, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     is_differentiable: Optional[bool] = False
     higher_is_better: Optional[bool] = True

@@ -19,7 +19,18 @@ from metrics_tpu.retrieval.base import GroupedRows, RetrievalMetric
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision over queries."""
+    """Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalMAP()
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        0.7917
+    """
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         # AP = sum_ranks hit * (cumhits / rank) / n_hits, with hits BINARIZED
@@ -32,7 +43,18 @@ class RetrievalMAP(RetrievalMetric):
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean reciprocal rank over queries."""
+    """Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalMRR()
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        0.75
+    """
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         # the first relevant row has the largest 1/rank among relevant rows
@@ -60,6 +82,16 @@ class RetrievalPrecision(_RetrievalKMetric):
     Parity note: the divisor is ``k`` itself even when a query has fewer
     documents (reference `functional/retrieval/precision.py:55-66`);
     ``adaptive_k`` caps it at the per-query document count.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalPrecision(k=2)
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        0.5
     """
 
     def __init__(
@@ -85,7 +117,18 @@ class RetrievalPrecision(_RetrievalKMetric):
 
 
 class RetrievalRecall(_RetrievalKMetric):
-    """Mean recall@k over queries."""
+    """Mean recall@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRecall(k=2)
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        0.75
+    """
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         kv = ctx.k_eff(self.k)
@@ -97,7 +140,18 @@ class RetrievalFallOut(_RetrievalKMetric):
     """Mean fall-out@k over queries; the "empty" convention is inverted —
     a query with no NEGATIVE docs is the degenerate one, and the default
     empty action is "pos" (pessimistic for this lower-is-better metric) —
-    reference `retrieval/fall_out.py:78`."""
+    reference `retrieval/fall_out.py:78`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalFallOut(k=2)
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        0.5
+    """
 
     higher_is_better = False
     _empty_when_no = "neg"
@@ -122,7 +176,18 @@ class RetrievalFallOut(_RetrievalKMetric):
 
 
 class RetrievalHitRate(_RetrievalKMetric):
-    """Mean hit-rate@k over queries."""
+    """Mean hit-rate@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalHitRate
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalHitRate(k=2)
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        1.0
+    """
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         kv = ctx.k_eff(self.k)
@@ -130,7 +195,18 @@ class RetrievalHitRate(_RetrievalKMetric):
 
 
 class RetrievalNormalizedDCG(_RetrievalKMetric):
-    """Mean NDCG@k over queries; targets may carry graded gains."""
+    """Mean NDCG@k over queries; targets may carry graded gains.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalNormalizedDCG()
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        0.8467
+    """
 
     allow_non_binary_target = True
 
@@ -149,7 +225,18 @@ class RetrievalNormalizedDCG(_RetrievalKMetric):
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """Mean R-precision over queries (precision at R = #relevant)."""
+    """Mean R-precision over queries (precision at R = #relevant).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRPrecision()
+        >>> round(float(metric(preds, target, indexes=indexes)), 4)
+        0.75
+    """
 
     def _segment_metric(self, ctx: GroupedRows) -> jax.Array:
         # graded float relevances binarize via > 0 for R and the hit count,
@@ -165,6 +252,21 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
     Parity: reference `retrieval/precision_recall_curve.py`. Queries shorter
     than ``max_k`` repeat their final value (clamped-rank gather).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecisionRecallCurve
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalPrecisionRecallCurve(max_k=2)
+        >>> precisions, recalls, top_k = metric(preds, target, indexes=indexes)
+        >>> precisions
+        Array([0.5, 0.5], dtype=float32)
+        >>> recalls
+        Array([0.5 , 0.75], dtype=float32)
+        >>> top_k
+        Array([1, 2], dtype=int32)
     """
 
     higher_is_better = None
@@ -217,7 +319,21 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
     """Highest recall@k whose precision@k >= min_precision (reference
-    `retrieval/recall_at_precision.py`)."""
+    `retrieval/recall_at_precision.py`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecallAtFixedPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.3)
+        >>> recall, top_k = metric(preds, target, indexes=indexes)
+        >>> recall
+        Array(1., dtype=float32)
+        >>> top_k
+        Array(4, dtype=int32)
+    """
 
     def __init__(
         self,

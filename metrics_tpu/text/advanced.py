@@ -48,7 +48,16 @@ def _cat_packed_groups(state) -> List[List[str]]:
 
 
 class ROUGEScore(Metric):
-    """ROUGE-1/2/L/Lsum accumulated per sentence."""
+    """ROUGE-1/2/L/Lsum accumulated per sentence.
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> preds = 'My name is John'
+        >>> target = 'Is your name John'
+        >>> rouge = ROUGEScore(rouge_keys='rouge1')
+        >>> {k: round(float(v), 4) for k, v in sorted(rouge(preds, target).items())}
+        {'rouge1_fmeasure': 0.75, 'rouge1_precision': 0.75, 'rouge1_recall': 0.75}
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -122,6 +131,14 @@ class CHRFScore(Metric):
     (:func:`~metrics_tpu.utils.data.pack_strings`) so the standard cross-device
     gather protocol syncs them, and the corpus statistics are recomputed at
     ``compute`` — identical result, first-class distributed story.
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> chrf = CHRFScore()
+        >>> round(float(chrf(preds, target)), 4)
+        0.4942
     """
 
     is_differentiable = False
@@ -170,7 +187,16 @@ class CHRFScore(Metric):
 
 
 class TranslationEditRate(Metric):
-    """Corpus TER accumulated over batches."""
+    """Corpus TER accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> ter = TranslationEditRate()
+        >>> round(float(ter(preds, target)), 4)
+        0.4286
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -213,7 +239,16 @@ class TranslationEditRate(Metric):
 
 
 class ExtendedEditDistance(Metric):
-    """Corpus EED accumulated per sentence."""
+    """Corpus EED accumulated per sentence.
+
+    Example:
+        >>> from metrics_tpu import ExtendedEditDistance
+        >>> preds = ['this is the prediction', 'here is an other sample']
+        >>> target = ['this is the reference', 'here is another one']
+        >>> eed = ExtendedEditDistance()
+        >>> round(float(eed(preds, target)), 4)
+        0.3078
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -263,7 +298,17 @@ class ExtendedEditDistance(Metric):
 
 
 class BERTScore(Metric):
-    """BERTScore over accumulated sentence pairs (Flax transformer forward)."""
+    """BERTScore over accumulated sentence pairs (Flax transformer forward).
+
+    Example:
+        >>> from metrics_tpu import BERTScore
+        >>> preds = ["hello there", "general kenobi"]
+        >>> target = ["hello there", "master kenobi"]
+        >>> bertscore = BERTScore(model_name_or_path="roberta-large")  # doctest: +SKIP
+        >>> {k: [round(float(s), 3) for s in v]
+        ...  for k, v in bertscore(preds, target).items()}  # doctest: +SKIP
+        {'precision': [1.0, 0.996], 'recall': [1.0, 0.996], 'f1': [1.0, 0.996]}
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -346,7 +391,16 @@ class BERTScore(Metric):
 
 
 class InfoLM(Metric):
-    """InfoLM over accumulated sentence pairs (Flax masked-LM forward)."""
+    """InfoLM over accumulated sentence pairs (Flax masked-LM forward).
+
+    Example:
+        >>> from metrics_tpu import InfoLM
+        >>> preds = ["he read the book because he was interested in world history"]
+        >>> target = ["he was interested in world history because he read the book"]
+        >>> infolm = InfoLM("google/bert_uncased_L-2_H-128_A-2", idf=False)  # doctest: +SKIP
+        >>> round(float(infolm(preds, target)), 4)  # doctest: +SKIP
+        -0.1784
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -23,7 +23,16 @@ from metrics_tpu.metric import Metric
 
 
 class BLEUScore(Metric):
-    """Corpus BLEU accumulated over batches."""
+    """Corpus BLEU accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import BLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu = BLEUScore()
+        >>> round(float(bleu(preds, target)), 4)
+        0.7598
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -57,7 +66,16 @@ class BLEUScore(Metric):
 
 
 class SacreBLEUScore(BLEUScore):
-    """BLEU with sacrebleu tokenizers."""
+    """BLEU with sacrebleu tokenizers.
+
+    Example:
+        >>> from metrics_tpu import SacreBLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu = SacreBLEUScore()
+        >>> round(float(sacre_bleu(preds, target)), 4)
+        0.7598
+    """
 
     def __init__(
         self,
@@ -106,19 +124,46 @@ class _ErrorRateMetric(Metric):
 
 
 class WordErrorRate(_ErrorRateMetric):
-    """WER accumulated over batches."""
+    """WER accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import WordErrorRate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> wer = WordErrorRate()
+        >>> round(float(wer(preds, target)), 4)
+        0.5
+    """
 
     _update_fn = staticmethod(_wer_update)
 
 
 class CharErrorRate(_ErrorRateMetric):
-    """CER accumulated over batches."""
+    """CER accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import CharErrorRate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> cer = CharErrorRate()
+        >>> round(float(cer(preds, target)), 4)
+        0.3415
+    """
 
     _update_fn = staticmethod(_cer_update)
 
 
 class MatchErrorRate(_ErrorRateMetric):
-    """MER accumulated over batches."""
+    """MER accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> mer = MatchErrorRate()
+        >>> round(float(mer(preds, target)), 4)
+        0.4444
+    """
 
     _update_fn = staticmethod(_mer_update)
 
@@ -141,7 +186,16 @@ class _WordInfoMetric(Metric):
 
 
 class WordInfoPreserved(_WordInfoMetric):
-    """WIP accumulated over batches."""
+    """WIP accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> wip = WordInfoPreserved()
+        >>> round(float(wip(preds, target)), 4)
+        0.3472
+    """
 
     higher_is_better = True
 
@@ -150,7 +204,16 @@ class WordInfoPreserved(_WordInfoMetric):
 
 
 class WordInfoLost(_WordInfoMetric):
-    """WIL accumulated over batches."""
+    """WIL accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> preds = ['this is the prediction', 'there is an other sample']
+        >>> target = ['this is the reference', 'there is another one']
+        >>> wil = WordInfoLost()
+        >>> round(float(wil(preds, target)), 4)
+        0.6528
+    """
 
     higher_is_better = False
 
@@ -159,7 +222,17 @@ class WordInfoLost(_WordInfoMetric):
 
 
 class Perplexity(Metric):
-    """Perplexity over accumulated token NLL."""
+    """Perplexity over accumulated token NLL.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Perplexity
+        >>> logits = jnp.log(jnp.asarray([[[0.75, 0.25], [0.25, 0.75]], [[0.6, 0.4], [0.9, 0.1]]]))
+        >>> target = jnp.asarray([[0, 1], [0, 0]])
+        >>> perplexity = Perplexity()
+        >>> round(float(perplexity(logits, target)), 4)
+        1.347
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -183,7 +256,16 @@ class Perplexity(Metric):
 
 
 class SQuAD(Metric):
-    """SQuAD v1 EM/F1 accumulated over batches."""
+    """SQuAD v1 EM/F1 accumulated over batches.
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> preds = [{'prediction_text': '1976', 'id': '56e10a3be3433e1400422b22'}]
+        >>> target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e10a3be3433e1400422b22'}]
+        >>> squad = SQuAD()
+        >>> {k: round(float(v), 1) for k, v in sorted(squad(preds, target).items())}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
 
     is_differentiable = False
     higher_is_better = True

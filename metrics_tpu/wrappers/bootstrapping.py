@@ -33,7 +33,16 @@ def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optio
 
 
 class BootStrapper(Metric):
-    """Maintains ``num_bootstraps`` resampled clones of a base metric."""
+    """Maintains ``num_bootstraps`` resampled clones of a base metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BootStrapper, MeanMetric
+        >>> bootstrap = BootStrapper(MeanMetric(), num_bootstraps=4)
+        >>> bootstrap.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> sorted(bootstrap.compute().keys())
+        ['mean', 'std']
+    """
 
     full_state_update: Optional[bool] = True
 

@@ -12,7 +12,18 @@ from metrics_tpu.metric import Metric
 
 
 class ClasswiseWrapper(Metric):
-    """Wraps a per-class metric and returns ``{name_class: value}``."""
+    """Wraps a per-class metric and returns ``{name_class: value}``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, ClasswiseWrapper
+        >>> metric = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+        >>> preds = jnp.asarray([0, 2, 1, 2])
+        >>> target = jnp.asarray([0, 1, 1, 2])
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'accuracy_0': 1.0, 'accuracy_1': 0.5, 'accuracy_2': 1.0}
+    """
 
     full_state_update: Optional[bool] = True
 

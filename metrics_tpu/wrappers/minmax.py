@@ -13,7 +13,17 @@ from metrics_tpu.metric import Metric
 
 
 class MinMaxMetric(Metric):
-    """Returns ``{raw, min, max}`` of the base metric over time."""
+    """Returns ``{raw, min, max}`` of the base metric over time.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MinMaxMetric
+        >>> metric = MinMaxMetric(Accuracy())
+        >>> _ = metric(jnp.asarray([1, 1, 0, 0]), jnp.asarray([1, 0, 0, 0]))
+        >>> _ = metric(jnp.asarray([1, 0, 0, 0]), jnp.asarray([1, 0, 0, 0]))
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'raw': 1.0, 'max': 1.0, 'min': 0.75}
+    """
 
     full_state_update: Optional[bool] = True
 

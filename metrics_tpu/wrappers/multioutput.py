@@ -27,7 +27,17 @@ def _get_nan_indices(*tensors: jax.Array) -> jax.Array:
 
 
 class MultioutputWrapper(Metric):
-    """Evaluate one metric per output dimension and return the list of values."""
+    """Evaluate one metric per output dimension and return the list of values.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MultioutputWrapper, R2Score
+        >>> preds = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]])
+        >>> target = jnp.asarray([[1.0, 12.0], [2.0, 21.0], [3.5, 29.0]])
+        >>> r2 = MultioutputWrapper(R2Score(), num_outputs=2)
+        >>> [round(float(v), 4) for v in r2(preds, target)]
+        [0.9211, 0.9585]
+    """
 
     is_differentiable = False
     full_state_update: Optional[bool] = True

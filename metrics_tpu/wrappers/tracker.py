@@ -17,7 +17,23 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class MetricTracker:
-    """List of metric copies over time steps."""
+    """List of metric copies over time steps.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricTracker
+        >>> tracker = MetricTracker(Accuracy(), maximize=True)
+        >>> batches = [jnp.asarray([0, 1, 1, 0]), jnp.asarray([1, 1, 1, 0])]
+        >>> target = jnp.asarray([1, 1, 1, 0])
+        >>> for preds in batches:
+        ...     tracker.increment()
+        ...     tracker.update(preds, target)
+        >>> tracker.compute_all()
+        Array([0.75, 1.  ], dtype=float32)
+        >>> best, step = tracker.best_metric(return_step=True)
+        >>> (round(float(best), 4), step)
+        (1.0, 1)
+    """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
         if not isinstance(metric, (Metric, MetricCollection)):

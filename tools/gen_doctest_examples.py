@@ -1,0 +1,125 @@
+"""Dev tool: generate and insert executable docstring examples.
+
+Given ``{symbol_name: [statement, ...]}``, runs each statement REPL-style on
+the pinned CPU backend, captures exactly what an interactive session would
+print, formats the transcript as a doctest ``Example:`` block, and inserts it
+into the symbol's docstring (before the closing quotes). The suite's doctest
+runner (tests/test_doctests.py) then executes the block forever after — this
+tool is only for authoring, parity with the reference's doctest-bearing
+docstrings (reference `Makefile:22-25` runs every docstring example as a test).
+
+Usage: import from a scratch script, call ``insert_examples(mapping)``.
+Statements may be multi-line (compiled in 'single' mode when possible so bare
+expressions print their repr, like the REPL).
+"""
+from __future__ import annotations
+
+import contextlib
+import importlib
+import inspect
+import io
+import re
+from typing import Dict, List, Sequence
+
+
+def run_repl(statements: Sequence[str]) -> List[tuple]:
+    """Execute statements in a shared namespace, REPL-style; return (src, out) pairs."""
+    ns: dict = {}
+    pairs = []
+    for stmt in statements:
+        buf = io.StringIO()
+        try:
+            code_obj = compile(stmt, "<example>", "single")
+        except SyntaxError:
+            code_obj = compile(stmt, "<example>", "exec")
+        with contextlib.redirect_stdout(buf):
+            exec(code_obj, ns)
+        out = buf.getvalue()
+        if "\n\n" in out.strip("\n"):
+            raise ValueError(f"blank line in doctest output of {stmt!r}; pick a different example")
+        pairs.append((stmt, out))
+    return pairs
+
+
+def format_block(pairs: Sequence[tuple], indent: str) -> str:
+    lines = [f"{indent}Example:"]
+    body = indent + "    "
+    for src, out in pairs:
+        src_lines = src.split("\n")
+        lines.append(f"{body}>>> {src_lines[0]}")
+        for cont in src_lines[1:]:
+            lines.append(f"{body}... {cont}")
+        for out_line in out.splitlines():
+            lines.append(f"{body}{out_line}" if out_line.strip() else body.rstrip())
+    return "\n".join(lines)
+
+
+def _docstring_span(source: str, obj_name: str) -> tuple:
+    """(open_end, close_start, indent) of the docstring of def/class obj_name.
+
+    AST-located so a symbol without a docstring errors instead of hijacking
+    the next triple-quote in the file.
+    """
+    import ast
+
+    tree = ast.parse(source)
+    node = next(
+        (
+            n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)) and n.name == obj_name
+        ),
+        None,
+    )
+    if node is None:
+        raise ValueError(f"definition of {obj_name} not found")
+    if ast.get_docstring(node) is None:
+        raise ValueError(f"no docstring for {obj_name}")
+    doc_expr = node.body[0]
+    lines = source.splitlines(keepends=True)
+    start = sum(len(ln) for ln in lines[: doc_expr.lineno - 1]) + doc_expr.col_offset
+    end = sum(len(ln) for ln in lines[: doc_expr.end_lineno - 1]) + doc_expr.end_col_offset
+    mo = re.match(r'[rRbBuU]*("""|\'\'\')', source[start:end])
+    if not mo:
+        raise ValueError(f"{obj_name} docstring is not triple-quoted")
+    quotes = mo.group(1)
+    open_end = start + mo.end()
+    close_start = end - len(quotes)
+    indent = " " * (node.col_offset + 4)
+    return open_end, close_start, indent
+
+
+def insert_example(obj, statements: Sequence[str], dry: bool = False) -> str:
+    """Run the example and splice it into obj's docstring file. Returns the block."""
+    fname = inspect.getsourcefile(obj)
+    with open(fname) as fh:
+        source = fh.read()
+    name = obj.__name__
+    if f">>> " in (inspect.getdoc(obj) or ""):
+        raise ValueError(f"{name} already has an example")
+    open_end, close_start, indent = _docstring_span(source, name)
+    pairs = run_repl(statements)
+    block = format_block(pairs, indent)
+    # works for single- and multi-line docstrings alike: body is re-terminated
+    # with a newline + closing-quote indent
+    new_body = source[open_end:close_start].rstrip() + "\n\n" + block + "\n" + indent
+    new_source = source[:open_end] + new_body + source[close_start:]
+    if not dry:
+        with open(fname, "w") as fh:
+            fh.write(new_source)
+    return block
+
+
+def insert_examples(mapping: Dict[str, Sequence[str]], module: str = "metrics_tpu") -> None:
+    mod = importlib.import_module(module)
+    done, failed = [], []
+    for name, stmts in mapping.items():
+        obj = getattr(mod, name)
+        try:
+            insert_example(obj, stmts)
+            done.append(name)
+        except Exception as err:  # report and continue: authoring tool
+            failed.append((name, repr(err)))
+    print(f"inserted: {len(done)}")
+    for name, err in failed:
+        print(f"FAILED {name}: {err}")
