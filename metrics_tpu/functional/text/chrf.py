@@ -7,7 +7,6 @@ F-beta per order averaged over all orders; with multiple references the best
 """
 from __future__ import annotations
 
-import re
 from collections import Counter, defaultdict
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -18,16 +17,38 @@ _EPS_SMOOTHING = 1e-16
 
 
 def _get_characters(sentence: str, whitespace: bool) -> List[str]:
+    # without whitespace, edge whitespace is stripped and interior spaces
+    # removed (reference `functional/text/chrf.py:81-93`: strip() + replace)
     if whitespace:
         return list(sentence)
-    return list(sentence.replace(" ", ""))
+    return list(sentence.strip().replace(" ", ""))
+
+
+_PUNCTUATIONS = frozenset("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+
+
+def _separate_word_and_punctuation(word: str) -> List[str]:
+    """At most ONE trailing-else-leading ASCII punctuation char splits off.
+
+    The m-popovic/chrF rule sacrebleu and the reference implement
+    (reference `functional/text/chrf.py:96-113`): single-char words are kept
+    whole, a trailing punctuation char wins over a leading one, and the
+    remainder is not re-split (``"well!!"`` -> ``["well!", "!"]``). Non-ASCII
+    punctuation (e.g. ``。``) is NOT separated.
+    """
+    if len(word) == 1:
+        return [word]
+    if word[-1] in _PUNCTUATIONS:
+        return [word[:-1], word[-1]]
+    if word[0] in _PUNCTUATIONS:
+        return [word[0], word[1:]]
+    return [word]
 
 
 def _get_words_and_punctuation(sentence: str) -> List[str]:
-    """Split words and separate trailing/leading punctuation (sacrebleu rule)."""
     out: List[str] = []
     for word in sentence.split():
-        out.extend(re.findall(r"[\w\d]+|[^\w\s]", word))
+        out.extend(_separate_word_and_punctuation(word))
     return out
 
 

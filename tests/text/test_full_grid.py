@@ -1,0 +1,224 @@
+"""The full text parametrization grid vs the mounted reference.
+
+The reference enumerates each text metric over its whole option space
+(`tests/unittests/text/`, ~2.5k LoC: BLEU n_gram x smooth, SacreBLEU's five
+tokenizers x lowercase, CHRF orders x beta x whitespace, ROUGE keys x stemmer
+x accumulate, TER/EED normalization grids); the in-repo text tests sample it.
+This file enumerates those grids on two fixed corpora — one Latin-script with
+punctuation/case/numbers, one with CJK segments for the zh/intl/char
+tokenizers and `asian_support` — every cell differentially checked against
+the reference on identical data.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+# corpus 1: Latin script, punctuation, casing, numerals, repeated n-grams
+PREDS_EN = [
+    "the cat sat on the Mat, twice.",
+    "It is a truth universally acknowledged!",
+    "42 grams of flour; mix well",
+    "the the the the",
+]
+TARGET_EN = [
+    ["the cat sat on the mat twice", "a cat sat twice on the mat."],
+    ["It is a truth universally acknowledged.", "Universally, it is an acknowledged truth!"],
+    ["42 grams of flour, mixed well", "mix 42 grams of flour well"],
+    ["the cat", "the dog"],
+]
+# corpus 2: CJK + mixed-width punctuation for zh/intl/char tokenizers
+PREDS_ZH = ["猫坐在垫子上。", "天气很好 today", "他读了 3 本书"]
+TARGET_ZH = [["猫坐在垫子上"], ["今天天气很好", "the weather is fine today"], ["他读了三本书。"]]
+
+CORPORA = {"en": (PREDS_EN, TARGET_EN), "zh": (PREDS_ZH, TARGET_ZH)}
+# single-reference flat corpora for the error-rate family
+FLAT = {
+    "en": ([p for p in PREDS_EN], [t[0] for t in TARGET_EN]),
+    "zh": ([p for p in PREDS_ZH], [t[0] for t in TARGET_ZH]),
+}
+
+
+def _assert_cell(name, kwargs, preds, target, atol=1e-5):
+    ours = getattr(mt, name)(**kwargs)
+    ref = getattr(_ref, name)(**kwargs)
+    # stream in two chunks to cross the accumulation path
+    half = max(1, len(preds) // 2)
+    for sl in (slice(0, half), slice(half, None)):
+        if len(preds[sl]) == 0:
+            continue
+        ours.update(preds[sl], target[sl])
+        ref.update(preds[sl], target[sl])
+    ours_val, ref_val = ours.compute(), ref.compute()
+    _assert_value(ours_val, ref_val, atol)
+
+
+def _assert_value(ours_val, ref_val, atol):
+    if isinstance(ours_val, dict):
+        assert set(ours_val) == set(ref_val)
+        for k in ours_val:
+            _assert_value(ours_val[k], ref_val[k], atol)
+    elif isinstance(ours_val, (tuple, list)):
+        assert len(ours_val) == len(ref_val)
+        for o, r in zip(ours_val, ref_val):
+            _assert_value(o, r, atol)
+    else:
+        np.testing.assert_allclose(np.asarray(ours_val), np.asarray(ref_val), atol=atol)
+
+
+class TestBleuGrid:
+    @pytest.mark.parametrize("n_gram", (1, 2, 3, 4))
+    @pytest.mark.parametrize("smooth", (False, True))
+    @pytest.mark.parametrize("corpus", ("en", "zh"))
+    def test_bleu(self, n_gram, smooth, corpus):
+        preds, target = CORPORA[corpus]
+        _assert_cell("BLEUScore", {"n_gram": n_gram, "smooth": smooth}, preds, target)
+
+    @pytest.mark.parametrize("n_gram", (2, 4))
+    def test_bleu_custom_weights(self, n_gram):
+        weights = [1.0 / n_gram + (0.1 if i == 0 else -0.1 / (n_gram - 1)) for i in range(n_gram)]
+        _assert_cell("BLEUScore", {"n_gram": n_gram, "weights": weights}, PREDS_EN, TARGET_EN)
+
+    @pytest.mark.parametrize("tokenize", ("none", "13a", "intl", "char", "zh"))
+    @pytest.mark.parametrize("lowercase", (False, True))
+    @pytest.mark.parametrize("corpus", ("en", "zh"))
+    def test_sacre_bleu(self, tokenize, lowercase, corpus):
+        preds, target = CORPORA[corpus]
+        _assert_cell("SacreBLEUScore", {"tokenize": tokenize, "lowercase": lowercase}, preds, target)
+
+
+class TestChrfGrid:
+    @pytest.mark.parametrize("n_char_order", (1, 3, 6))
+    @pytest.mark.parametrize("n_word_order", (0, 1, 2))
+    @pytest.mark.parametrize("corpus", ("en", "zh"))
+    def test_orders(self, n_char_order, n_word_order, corpus):
+        preds, target = CORPORA[corpus]
+        _assert_cell(
+            "CHRFScore", {"n_char_order": n_char_order, "n_word_order": n_word_order}, preds, target
+        )
+
+    @pytest.mark.parametrize("beta", (0.5, 1.0, 3.0))
+    @pytest.mark.parametrize("lowercase", (False, True))
+    @pytest.mark.parametrize("whitespace", (False, True))
+    def test_flags(self, beta, lowercase, whitespace):
+        _assert_cell(
+            "CHRFScore",
+            {"beta": beta, "lowercase": lowercase, "whitespace": whitespace},
+            PREDS_EN,
+            TARGET_EN,
+        )
+
+    def test_sentence_level(self):
+        _assert_cell("CHRFScore", {"return_sentence_level_score": True}, PREDS_EN, TARGET_EN)
+
+    @pytest.mark.parametrize("whitespace", (False, True))
+    def test_edge_whitespace(self, whitespace):
+        """Leading/trailing tabs/newlines: stripped when whitespace=False."""
+        preds = ["hello world\n", "\tthe cat  sat "]
+        target = [["\thello world"], ["the cat sat\n"]]
+        _assert_cell("CHRFScore", {"whitespace": whitespace}, preds, target)
+
+
+class TestRougeGrid:
+    @pytest.mark.parametrize("rouge_keys", ("rouge1", "rouge2", "rougeL", "rougeLsum", ("rouge1", "rougeL")))
+    @pytest.mark.parametrize("use_stemmer", (False, True))
+    @pytest.mark.parametrize("accumulate", ("best", "avg"))
+    def test_rouge(self, rouge_keys, use_stemmer, accumulate, monkeypatch):
+        # The reference's module class lives behind the nltk gate in
+        # torchmetrics.text.rouge; its punkt-backed _split_sentence needs an
+        # offline-unavailable download, so stub it with the newline convention
+        # both stacks share (same convention as tests/text/test_text.py).
+        import torchmetrics.functional.text.rouge as ref_rouge_fn
+        from torchmetrics.text.rouge import ROUGEScore as RefROUGEScore
+
+        monkeypatch.setattr(ref_rouge_fn, "_split_sentence", lambda x: x.split("\n"))
+        kwargs = {"rouge_keys": rouge_keys, "use_stemmer": use_stemmer, "accumulate": accumulate}
+        ours = mt.ROUGEScore(**kwargs)
+        ref = RefROUGEScore(**kwargs)
+        half = len(PREDS_EN) // 2
+        for sl in (slice(0, half), slice(half, None)):
+            ours.update(PREDS_EN[sl], TARGET_EN[sl])
+            ref.update(PREDS_EN[sl], TARGET_EN[sl])
+        _assert_value(ours.compute(), ref.compute(), 1e-5)
+
+
+class TestTerGrid:
+    @pytest.mark.parametrize("normalize", (False, True))
+    @pytest.mark.parametrize("no_punctuation", (False, True))
+    @pytest.mark.parametrize("lowercase", (False, True))
+    @pytest.mark.parametrize("corpus", ("en", "zh"))
+    def test_flags(self, normalize, no_punctuation, lowercase, corpus):
+        preds, target = CORPORA[corpus]
+        _assert_cell(
+            "TranslationEditRate",
+            {"normalize": normalize, "no_punctuation": no_punctuation, "lowercase": lowercase},
+            preds,
+            target,
+        )
+
+    @pytest.mark.parametrize("asian_support", (False, True))
+    def test_asian_support(self, asian_support):
+        _assert_cell(
+            "TranslationEditRate", {"asian_support": asian_support, "normalize": True}, PREDS_ZH, TARGET_ZH
+        )
+
+    def test_sentence_level(self):
+        _assert_cell("TranslationEditRate", {"return_sentence_level_score": True}, PREDS_EN, TARGET_EN)
+
+
+class TestEedGrid:
+    @pytest.mark.parametrize("language", ("en", "ja"))
+    @pytest.mark.parametrize("corpus", ("en", "zh"))
+    def test_language(self, language, corpus):
+        preds, target = CORPORA[corpus]
+        _assert_cell("ExtendedEditDistance", {"language": language}, preds, target)
+
+    @pytest.mark.parametrize(
+        "alpha,rho,deletion,insertion",
+        [(2.0, 0.3, 0.2, 1.0), (1.0, 0.5, 0.0, 0.5), (3.0, 0.1, 1.0, 2.0)],
+    )
+    def test_costs(self, alpha, rho, deletion, insertion):
+        _assert_cell(
+            "ExtendedEditDistance",
+            {"alpha": alpha, "rho": rho, "deletion": deletion, "insertion": insertion},
+            PREDS_EN,
+            TARGET_EN,
+        )
+
+    def test_sentence_level(self):
+        _assert_cell("ExtendedEditDistance", {"return_sentence_level_score": True}, PREDS_EN, TARGET_EN)
+
+
+class TestErrorRateGrid:
+    @pytest.mark.parametrize(
+        "name", ["WordErrorRate", "CharErrorRate", "MatchErrorRate", "WordInfoLost", "WordInfoPreserved"]
+    )
+    @pytest.mark.parametrize("corpus", ("en", "zh"))
+    def test_corpus(self, name, corpus):
+        preds, target = FLAT[corpus]
+        _assert_cell(name, {}, preds, target)
+
+
+class TestPerplexityGrid:
+    @pytest.mark.parametrize("ignore_index", (None, -100))
+    def test_perplexity(self, ignore_index):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(7)
+        logits = rng.randn(2, 6, 5).astype(np.float32)
+        target = rng.randint(0, 5, size=(2, 6))
+        if ignore_index is not None:
+            target[0, :2] = ignore_index
+        ours = mt.Perplexity(ignore_index=ignore_index)
+        ref = _ref.Perplexity(ignore_index=ignore_index)
+        ours.update(jnp.asarray(logits), jnp.asarray(target))
+        ref.update(torch.tensor(logits), torch.tensor(target))
+        np.testing.assert_allclose(np.asarray(ours.compute()), np.asarray(ref.compute()), atol=1e-4)
