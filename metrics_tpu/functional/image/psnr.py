@@ -23,9 +23,20 @@ def _psnr_update(
         n_obs = jnp.asarray(target.size)
         return sum_squared_error, n_obs
     diff = preds - target
-    sum_squared_error = jnp.sum(diff * diff, axis=dim)
     dim_list = [dim] if isinstance(dim, int) else list(dim)
-    n_obs = jnp.asarray(int(jnp.prod(jnp.asarray([target.shape[d] for d in dim_list]))))
+    if not dim_list:
+        # torch.sum(dim=()) reduces ALL dims, jnp.sum(axis=()) reduces none —
+        # mirror the reference's explicit empty-dim branch
+        # (`functional/image/psnr.py:84-85`): full reduction over numel
+        return jnp.sum(diff * diff), jnp.asarray(target.size)
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    count = 1
+    for d in dim_list:
+        count *= target.shape[d]
+    # per-element observation counts, broadcast to the kept dims (reference
+    # `functional/image/psnr.py` n_obs.expand_as) so streamed per-batch
+    # reductions concatenate consistently in the module's cat states
+    n_obs = jnp.full(sum_squared_error.shape, count, dtype=jnp.int32)
     return sum_squared_error, n_obs
 
 

@@ -9,3 +9,14 @@ def seed_all(seed: int = 42) -> None:
 
 
 __all__ = ["seed_all"]
+
+
+def cell_seed(*parts) -> int:
+    """Deterministic per-cell RNG seed from grid coordinates.
+
+    Shared by the full-grid suites so every cell sees distinct data without a
+    dataset multiplier, and so the seeding convention can't drift per domain.
+    """
+    import zlib
+
+    return zlib.crc32("|".join(str(p) for p in parts).encode()) & 0x7FFFFFFF

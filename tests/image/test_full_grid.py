@@ -1,0 +1,132 @@
+"""The full image option grid vs the mounted reference.
+
+Enumerates the pure-statistics image metrics over their constructor spaces
+(reference `tests/unittests/image/`, ~1.7k LoC: PSNR data_range x base x
+dim/reduction, SSIM kernel x sigma x k1/k2 x gaussian/uniform, MS-SSIM betas
+x normalize, UQI kernels, ERGAS ratios, SAM/D-lambda reductions) on seeded
+streamed batches, every cell differentially checked against the reference on
+identical data. Model-backed metrics (FID/KID/IS/LPIPS) have their own
+weight-sharing golden tests under tests/models/.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers import cell_seed as _cell_seed
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+N_BATCHES = 2
+
+
+def _make_batches(seed: int, shape=(2, 3, 24, 24), scale=1.0):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.rand(*shape).astype(np.float32) * scale, rng.rand(*shape).astype(np.float32) * scale)
+        for _ in range(N_BATCHES)
+    ]
+
+
+def _run_cell(name, kwargs, seed, shape=(2, 3, 24, 24), scale=1.0, atol=1e-4, ref_name=None):
+    ours = getattr(mt, name)(**kwargs)
+    ref = getattr(_ref, ref_name or name)(**kwargs)
+    for preds, target in _make_batches(seed, shape, scale):
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.tensor(preds), torch.tensor(target))
+    np.testing.assert_allclose(np.asarray(ours.compute()), np.asarray(ref.compute()), atol=atol, rtol=1e-4)
+
+
+class TestPsnrGrid:
+    @pytest.mark.parametrize("data_range", (None, 1.0, 255.0))
+    @pytest.mark.parametrize("base", (10.0, 2.0))
+    def test_range_base(self, data_range, base):
+        _run_cell(
+            "PeakSignalNoiseRatio",
+            {"data_range": data_range, "base": base},
+            _cell_seed("psnr", data_range, base),
+        )
+
+    @pytest.mark.parametrize("reduction", ("elementwise_mean", "sum", "none"))
+    def test_dim_reduction(self, reduction):
+        _run_cell(
+            "PeakSignalNoiseRatio",
+            {"data_range": 1.0, "dim": (1, 2, 3), "reduction": reduction},
+            _cell_seed("psnr-dim", reduction),
+        )
+
+
+class TestSsimGrid:
+    @pytest.mark.parametrize("gaussian_kernel", (True, False))
+    @pytest.mark.parametrize("kernel_size", (11, 7))
+    @pytest.mark.parametrize("sigma", (1.5, 0.8))
+    def test_kernels(self, gaussian_kernel, kernel_size, sigma):
+        _run_cell(
+            "StructuralSimilarityIndexMeasure",
+            {"gaussian_kernel": gaussian_kernel, "kernel_size": kernel_size, "sigma": sigma, "data_range": 1.0},
+            _cell_seed("ssim", gaussian_kernel, kernel_size, sigma),
+        )
+
+    @pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.05, 0.1)])
+    def test_stability_constants(self, k1, k2):
+        _run_cell(
+            "StructuralSimilarityIndexMeasure",
+            {"k1": k1, "k2": k2, "data_range": 1.0},
+            _cell_seed("ssim-k", k1, k2),
+        )
+
+
+class TestMsSsimGrid:
+    SHAPE = (2, 3, 180, 180)  # >= (kernel-1)*2**4 per side for 5 scales
+
+    @pytest.mark.parametrize("normalize", (None, "relu", "simple"))
+    def test_normalize(self, normalize):
+        _run_cell(
+            "MultiScaleStructuralSimilarityIndexMeasure",
+            {"normalize": normalize, "data_range": 1.0},
+            _cell_seed("msssim", normalize),
+            shape=self.SHAPE,
+        )
+
+    def test_custom_betas(self):
+        _run_cell(
+            "MultiScaleStructuralSimilarityIndexMeasure",
+            {"betas": (0.3, 0.4, 0.3), "data_range": 1.0},
+            _cell_seed("msssim-betas"),
+            shape=(2, 3, 48, 48),
+        )
+
+
+class TestSpectralGrid:
+    @pytest.mark.parametrize("kernel_size", ((11, 11), (5, 5)))
+    def test_uqi(self, kernel_size):
+        _run_cell(
+            "UniversalImageQualityIndex", {"kernel_size": kernel_size}, _cell_seed("uqi", kernel_size)
+        )
+
+    @pytest.mark.parametrize("ratio", (2, 4))
+    @pytest.mark.parametrize("reduction", ("elementwise_mean", "sum", "none"))
+    def test_ergas(self, ratio, reduction):
+        _run_cell(
+            "ErrorRelativeGlobalDimensionlessSynthesis",
+            {"ratio": ratio, "reduction": reduction},
+            _cell_seed("ergas", ratio, reduction),
+            scale=255.0,
+            atol=1e-2,
+        )
+
+    @pytest.mark.parametrize("reduction", ("elementwise_mean", "sum", "none"))
+    def test_sam(self, reduction):
+        _run_cell(
+            "SpectralAngleMapper", {"reduction": reduction}, _cell_seed("sam", reduction), atol=1e-5
+        )
+
+    @pytest.mark.parametrize("p", (1, 3))
+    def test_d_lambda(self, p):
+        _run_cell("SpectralDistortionIndex", {"p": p}, _cell_seed("dlambda", p), shape=(2, 3, 16, 16))

@@ -10,13 +10,12 @@ each cell sees distinct data without a dataset multiplier.
 """
 from __future__ import annotations
 
-import zlib
-
 import jax.numpy as jnp
 import numpy as np
 import pytest
 import torch
 
+from tests.helpers import cell_seed as _cell_seed
 from tests.helpers.reference_oracle import get_reference
 
 _ref = get_reference()
@@ -29,10 +28,6 @@ IGNORE = (None, -100)
 KS = (None, 1, 2, 4, 10)
 N_BATCHES, BATCH = 3, 10
 N_QUERIES = 6
-
-
-def _cell_seed(*parts) -> int:
-    return zlib.crc32("|".join(str(p) for p in parts).encode()) & 0x7FFFFFFF
 
 
 def _make_batches(seed: int, ignore_index):

@@ -15,6 +15,7 @@ import numpy as np
 import pytest
 import torch
 
+from tests.helpers import cell_seed as _cell_seed
 from tests.helpers.reference_oracle import get_reference
 
 _ref = get_reference()
