@@ -1,0 +1,88 @@
+"""The mAP option grid vs the mounted reference.
+
+Densifies tests/detection/test_mean_ap.py's sampled options into a grid:
+seeds x iou_thresholds x max_detection_thresholds x class_metrics, plus
+degenerate-image cells (no detections / no ground truth / both empty mixed
+into a normal stream). Every cell runs identical data through both stacks
+(reference `detection/mean_ap.py:543-877` greedy matching + 101-pt interp).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from tests.detection.test_mean_ap import (
+    _assert_results_close,
+    _make_reference_map,
+    _random_scenario,
+    _to_jnp,
+    _to_torch,
+)
+from tests.helpers import cell_seed
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference implementation not importable")
+
+
+def _run_cell(preds, targets, **kwargs):
+    metric = MeanAveragePrecision(**kwargs)
+    metric.update(_to_jnp(preds), _to_jnp(targets))
+    got = metric.compute()
+    ref_metric = _make_reference_map(**kwargs)
+    ref_metric.update(_to_torch(preds), _to_torch(targets))
+    _assert_results_close(got, ref_metric.compute())
+
+
+class TestOptionGrid:
+    @pytest.mark.parametrize("seed", (0, 1))
+    @pytest.mark.parametrize(
+        "iou_thresholds", (None, [0.5], [0.35, 0.55, 0.75]), ids=("coco", "single", "custom")
+    )
+    @pytest.mark.parametrize("max_detection_thresholds", (None, [1, 3, 6]), ids=("coco", "custom"))
+    @pytest.mark.parametrize("class_metrics", (False, True))
+    def test_cell(self, seed, iou_thresholds, max_detection_thresholds, class_metrics):
+        rng = np.random.RandomState(cell_seed("map", seed, str(iou_thresholds), str(max_detection_thresholds)))
+        preds, targets = _random_scenario(rng)
+        _run_cell(
+            preds,
+            targets,
+            iou_thresholds=iou_thresholds,
+            max_detection_thresholds=max_detection_thresholds,
+            class_metrics=class_metrics,
+        )
+
+    @pytest.mark.parametrize("rec_thresholds", ([0.0, 0.5, 1.0],), ids=("coarse",))
+    def test_rec_thresholds(self, rec_thresholds):
+        rng = np.random.RandomState(cell_seed("map-rec"))
+        preds, targets = _random_scenario(rng)
+        _run_cell(preds, targets, rec_thresholds=rec_thresholds)
+
+
+class TestDegenerateImages:
+    """Empty-side images interleaved into a normal stream."""
+
+    def _scenario_with_empties(self, seed):
+        rng = np.random.RandomState(seed)
+        preds, targets = _random_scenario(rng, n_images=4)
+        empty_det = dict(
+            boxes=np.zeros((0, 4), np.float32), scores=np.zeros((0,), np.float32), labels=np.zeros((0,), np.int64)
+        )
+        empty_gt = dict(boxes=np.zeros((0, 4), np.float32), labels=np.zeros((0,), np.int64))
+        full_det, full_gt = preds[0], targets[0]
+        preds += [empty_det, full_det, empty_det]
+        targets += [full_gt, empty_gt, empty_gt]
+        return preds, targets
+
+    @pytest.mark.parametrize("class_metrics", (False, True))
+    def test_empties(self, class_metrics):
+        preds, targets = self._scenario_with_empties(cell_seed("map-empty", class_metrics))
+        _run_cell(preds, targets, class_metrics=class_metrics)
+
+    def test_all_images_empty(self):
+        empty_det = dict(
+            boxes=np.zeros((0, 4), np.float32), scores=np.zeros((0,), np.float32), labels=np.zeros((0,), np.int64)
+        )
+        empty_gt = dict(boxes=np.zeros((0, 4), np.float32), labels=np.zeros((0,), np.int64))
+        _run_cell([empty_det] * 3, [empty_gt] * 3)
