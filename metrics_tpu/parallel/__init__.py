@@ -1,6 +1,7 @@
 """Distributed state synchronisation: SPMD collectives + multi-host backend."""
 from metrics_tpu.parallel.collectives import sync_array, sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
+from metrics_tpu.parallel.sharding import shard_states, state_shardings
 from metrics_tpu.parallel.sync import (
     class_reduce,
     distributed_available,
@@ -10,6 +11,8 @@ from metrics_tpu.parallel.sync import (
 )
 
 __all__ = [
+    "shard_states",
+    "state_shardings",
     "sync_array",
     "sync_pytree",
     "resolve_reduction",

@@ -1,0 +1,80 @@
+"""Shard metric STATE itself over a device mesh.
+
+The reference's only parallelism axis is replicated state + gather
+(`src/torchmetrics/metric.py:356-382`): every process holds the full
+accumulator. On TPU meshes there is a second, TPU-native axis the reference
+cannot express: partition the accumulator arrays themselves — a
+``(num_classes, n_thresholds)`` binned-curve state or a stat-scores class
+vector sharded over the class axis — so states larger than one chip's HBM
+(long-tail vocabularies, million-class retrieval) evaluate at full speed.
+XLA propagates the input sharding through ``state + counts`` updates and
+elementwise computes, so the per-device working set is ``1/n_shards`` with
+no code changes to the metric: the same ``as_functions()`` kernels run
+sharded or replicated.
+
+Usage::
+
+    init, update, compute = metric.as_functions()
+    states = shard_states(init(), mesh, {"TPs": P("c", None), ...})
+    update = jax.jit(update, donate_argnums=0)    # respects input shardings
+    states = update(states, preds, target)        # stays class-sharded
+
+See docs/distributed.md "Sharding the state itself" and
+tests/bases/test_sharded_state.py for the invariants under test.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def state_shardings(
+    states: Dict[str, Any], mesh: Mesh, specs: Mapping[str, PartitionSpec]
+) -> Dict[str, Optional[NamedSharding]]:
+    """A pytree of ``NamedSharding`` matching ``states``.
+
+    States named in ``specs`` get their spec; every other array state is
+    replicated (``PartitionSpec()``). List ("cat") states are not shardable
+    this way — they grow per update — and raise. Spec keys that name no
+    state raise too: a typo would otherwise silently replicate everything,
+    defeating the memory scaling with zero diagnostics.
+    """
+    unknown = set(specs) - set(states)
+    if unknown:
+        raise ValueError(
+            f"specs name states that do not exist: {sorted(unknown)}; this metric's states are {sorted(states)}"
+        )
+    out: Dict[str, Optional[NamedSharding]] = {}
+    for name, value in states.items():
+        if isinstance(value, list):
+            if name in specs:
+                raise ValueError(
+                    f"State `{name}` is a list ('cat') state; shard the inputs or use a "
+                    "binned/sufficient-statistics metric for sharded accumulation."
+                )
+            out[name] = None
+            continue
+        spec = specs.get(name, PartitionSpec())
+        out[name] = NamedSharding(mesh, spec)
+    return out
+
+
+def shard_states(
+    states: Dict[str, Any], mesh: Mesh, specs: Mapping[str, PartitionSpec]
+) -> Dict[str, Any]:
+    """Place each array state on ``mesh`` under its ``specs`` partition.
+
+    Returns a new state dict whose arrays are committed to the requested
+    shardings; subsequent jitted updates keep them there (XLA sharding
+    propagation), so accumulation never re-gathers.
+    """
+    shardings = state_shardings(states, mesh, specs)
+    return {
+        name: value if shardings[name] is None else jax.device_put(value, shardings[name])
+        for name, value in states.items()
+    }
+
+
+__all__ = ["shard_states", "state_shardings"]
