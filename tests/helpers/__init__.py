@@ -20,3 +20,24 @@ def cell_seed(*parts) -> int:
     import zlib
 
     return zlib.crc32("|".join(str(p) for p in parts).encode()) & 0x7FFFFFFF
+
+
+def assert_tree_close(a, b, atol=1e-5, rtol=1e-4):
+    """Recursive allclose over dict/list/tuple trees of array-likes.
+
+    The one shared tree comparator for the parity/grid suites (keys must
+    match exactly for dicts, lengths for sequences).
+    """
+    import numpy as np
+
+    if isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            assert_tree_close(a[k], b[k], atol, rtol)
+        return
+    if isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert_tree_close(x, y, atol, rtol)
+        return
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=atol, rtol=rtol)

@@ -24,18 +24,7 @@ import metrics_tpu.functional as F  # noqa: E402
 N_VARIATIONS = 3
 
 
-def _assert_tree_close(a, b, atol=1e-5, rtol=1e-4):
-    if isinstance(a, dict):
-        assert set(a) == set(b)
-        for k in a:
-            _assert_tree_close(a[k], b[k], atol, rtol)
-        return
-    if isinstance(a, (list, tuple)):
-        assert len(a) == len(b)
-        for x, y in zip(a, b):
-            _assert_tree_close(x, y, atol, rtol)
-        return
-    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=atol, rtol=rtol)
+from tests.helpers import assert_tree_close as _assert_tree_close  # noqa: E402
 
 
 @pytest.mark.parametrize("seed", range(N_VARIATIONS))

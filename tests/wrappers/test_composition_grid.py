@@ -1,0 +1,195 @@
+"""Wrapper COMPOSITION cells vs the mounted reference.
+
+The per-wrapper behavior is covered by the edge matrix and parity files; the
+cells here cross wrappers with the composition layer the way training code
+does — wrappers inside `MetricCollection`, trackers over whole collections
+with per-metric `maximize` lists, wrappers wrapping wrappers — on identical
+data both stacks (reference `tests/unittests/wrappers/`, nesting scenarios).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers import assert_tree_close as _assert_tree
+from tests.helpers import cell_seed
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+N_CLASSES = 4
+
+
+def _cls_batches(seed, n_batches=3, batch=24):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.randint(0, N_CLASSES, size=batch), rng.randint(0, N_CLASSES, size=batch))
+        for _ in range(n_batches)
+    ]
+
+
+def _reg_batches(seed, n_batches=3, batch=24):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        p = rng.randn(batch).astype(np.float32)
+        out.append((p, (p + 0.3 * rng.randn(batch)).astype(np.float32)))
+    return out
+
+
+
+
+
+class TestClasswiseInCollection:
+    @pytest.mark.parametrize("prefix", (None, "val_"))
+    def test_naming_and_values(self, prefix):
+        kwargs = {} if prefix is None else {"prefix": prefix}
+        ours = mt.MetricCollection(
+            {
+                "acc_cw": mt.ClasswiseWrapper(mt.Accuracy(num_classes=N_CLASSES, average=None)),
+                "rec": mt.Recall(num_classes=N_CLASSES, average="macro"),
+            },
+            **kwargs,
+        )
+        ref = _ref.MetricCollection(
+            {
+                "acc_cw": _ref.ClasswiseWrapper(_ref.Accuracy(num_classes=N_CLASSES, average=None)),
+                "rec": _ref.Recall(num_classes=N_CLASSES, average="macro"),
+            },
+            **kwargs,
+        )
+        for p, t in _cls_batches(cell_seed("cw-col", prefix)):
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.tensor(p), torch.tensor(t))
+        _assert_tree(ours.compute(), ref.compute())
+
+
+class TestTrackerOverCollection:
+    @pytest.mark.parametrize("maximize", (True, [False, True]), ids=("scalar", "list"))
+    def test_best_across_steps(self, maximize):
+        def build(ns):
+            return ns.MetricTracker(
+                ns.MetricCollection([ns.MeanSquaredError(), ns.ExplainedVariance()]), maximize=maximize
+            )
+
+        ours, ref = build(mt), build(_ref)
+        for step in range(3):
+            ours.increment()
+            ref.increment()
+            for p, t in _reg_batches(cell_seed("tracker", step), n_batches=2):
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(torch.tensor(p), torch.tensor(t))
+        _assert_tree(ours.compute_all(), ref.compute_all())
+        our_best, our_step = ours.best_metric(return_step=True)
+        ref_best, ref_step = ref.best_metric(return_step=True)
+        _assert_tree(our_best, ref_best)
+        assert our_step == ref_step
+
+    def test_single_metric_minimize_divergence_pinned(self):
+        """Documented divergence (README ledger): the reference unpacks
+        ``torch.min(t, 0)`` as ``idx, best`` — `(values, indices)` in torch —
+        so its no-arg ``best_metric()`` returns the argmin INDEX. Ours returns
+        the actual best value. The exact relationship is pinned here."""
+        ours = mt.MetricTracker(mt.MeanSquaredError(), maximize=False)
+        ref = _ref.MetricTracker(_ref.MeanSquaredError(), maximize=False)
+        for step in range(3):
+            ours.increment()
+            ref.increment()
+            for p, t in _reg_batches(cell_seed("tracker-min", step), n_batches=1):
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(torch.tensor(p), torch.tensor(t))
+        ref_val_swapped, ref_step_swapped = ref.best_metric(return_step=True)
+        our_val, our_step = ours.best_metric(return_step=True)
+        np.testing.assert_allclose(our_val, ref_val_swapped, atol=1e-6)  # same (value, step) order
+        assert our_step == ref_step_swapped
+        assert ours.best_metric() == pytest.approx(our_val)
+        assert ref.best_metric() == ref_step_swapped  # the reference returns the INDEX
+
+
+class TestNestedWrappers:
+    def test_minmax_across_epochs(self):
+        """MinMax extrema of a plain metric across two epochs of updates."""
+
+        def run(ns, to_tensor):
+            metric = ns.MinMaxMetric(ns.Accuracy(num_classes=N_CLASSES))
+            vals = []
+            for step in range(2):
+                for p, t in _cls_batches(cell_seed("minmax", step), n_batches=2):
+                    metric.update(to_tensor(p), to_tensor(t))
+                vals.append({k: float(v) for k, v in metric.compute().items()})
+            return vals
+
+        ours = run(mt, lambda x: jnp.asarray(x))
+        theirs = run(_ref, lambda x: torch.tensor(x))
+        _assert_tree(ours, theirs)
+
+    def test_minmax_inside_collection(self):
+        """MinMax as a COLLECTION member, updated through the collection."""
+
+        def build(ns):
+            return ns.MetricCollection(
+                {
+                    "acc_minmax": ns.MinMaxMetric(ns.Accuracy(num_classes=N_CLASSES)),
+                    "acc": ns.Accuracy(num_classes=N_CLASSES),
+                }
+            )
+
+        ours, ref = build(mt), build(_ref)
+        for p, t in _cls_batches(cell_seed("minmax-col")):
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.tensor(p), torch.tensor(t))
+        _assert_tree(ours.compute(), ref.compute())
+
+    def test_multioutput_in_collection(self):
+        def build(ns):
+            return ns.MetricCollection({"r2_multi": ns.MultioutputWrapper(ns.R2Score(), num_outputs=2)})
+
+        ours, ref = build(mt), build(_ref)
+        rng = np.random.RandomState(cell_seed("mo-col"))
+        for _ in range(2):
+            p = rng.randn(16, 2).astype(np.float32)
+            t = (p + 0.2 * rng.randn(16, 2)).astype(np.float32)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.tensor(p), torch.tensor(t))
+        _assert_tree(ours.compute(), ref.compute())
+
+
+class TestBootstrapperSurfaceGrid:
+    """RNG paths differ by design; the contract is keys/shapes across the
+    mean x std x quantile x raw option grid, plus mean's convergence to the
+    base metric on degenerate (constant) inputs where resampling is a no-op."""
+
+    @pytest.mark.parametrize("mean", (True, False))
+    @pytest.mark.parametrize("std", (True, False))
+    @pytest.mark.parametrize("raw", (True, False))
+    def test_output_surface(self, mean, std, raw):
+        if not (mean or std or raw):
+            pytest.skip("empty output")
+        kwargs = dict(num_bootstraps=4, mean=mean, std=std, raw=raw)
+        ours = mt.BootStrapper(mt.MeanSquaredError(), **kwargs)
+        ref = _ref.BootStrapper(_ref.MeanSquaredError(), **kwargs)
+        for p, t in _reg_batches(cell_seed("boot-surface"), n_batches=1):
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.tensor(p), torch.tensor(t))
+        o, r = ours.compute(), ref.compute()
+        assert set(o) == set(r)
+        for k in o:
+            assert np.asarray(o[k]).shape == np.asarray(r[k]).shape
+
+    def test_constant_input_exact(self):
+        """On constant data every resample sees the same rows: both stacks
+        must produce the base metric's exact value with zero std."""
+        ours = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4)
+        ref = _ref.BootStrapper(_ref.MeanSquaredError(), num_bootstraps=4)
+        p, t = np.full(16, 2.0, np.float32), np.full(16, 3.0, np.float32)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.tensor(p), torch.tensor(t))
+        o, r = ours.compute(), ref.compute()
+        np.testing.assert_allclose(float(o["mean"]), float(r["mean"]), atol=1e-6)
+        np.testing.assert_allclose(float(o["std"]), 0.0, atol=1e-6)
+        np.testing.assert_allclose(float(r["std"]), 0.0, atol=1e-6)
