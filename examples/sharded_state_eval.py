@@ -1,0 +1,76 @@
+"""Class-sharded metric STATE over a device mesh.
+
+The accumulator arrays themselves are partitioned over a mesh axis — here a
+binned PR curve's ``(num_classes, n_thresholds)`` TP/FP/FN counts over the
+class axis — so long-tail class counts whose state exceeds one chip's HBM
+evaluate with ``1/n_devices`` per-device memory. No metric code changes:
+the ``as_functions()`` kernels run sharded or replicated, and XLA keeps the
+placement through jitted accumulation (docs/distributed.md "Sharding the
+state itself").
+
+Runs on whatever devices JAX sees; to demo an N-way mesh without N real
+chips, ask for virtual CPU devices (an env var the example applies itself,
+before backend init — exporting JAX_PLATFORMS in the shell is not enough on
+hosts whose site config pins a platform):
+
+    FORCE_CPU_DEVICES=8 python examples/sharded_state_eval.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_n_cpu = os.environ.get("FORCE_CPU_DEVICES")
+if _n_cpu:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={_n_cpu}"
+    ).strip()
+
+import jax
+
+if _n_cpu:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu.parallel import shard_states
+
+
+def main() -> None:
+    devices = jax.devices()
+    num_classes = 1024 * len(devices)  # class axis divides the mesh
+    n_thresholds, batch, n_batches = 128, 256, 4
+
+    mesh = Mesh(np.array(devices), ("c",))
+    metric = mt.BinnedPrecisionRecallCurve(num_classes=num_classes, thresholds=n_thresholds)
+    init, update, compute = metric.as_functions()
+
+    states = shard_states(init(), mesh, {name: P("c", None) for name in ("TPs", "FPs", "FNs")})
+    update = jax.jit(update, donate_argnums=0)
+
+    rng = np.random.RandomState(0)
+    for _ in range(n_batches):
+        # a multi-label head's sigmoid scores in [0, 1], with labels drawn
+        # Bernoulli(score): every class sweeps the threshold grid and
+        # precision at threshold t concentrates near (1 + t) / 2
+        scores = rng.rand(batch, num_classes).astype(np.float32)
+        labels = (rng.rand(batch, num_classes) < scores).astype(np.int32)
+        states = update(states, jnp.asarray(scores), jnp.asarray(labels))
+
+    shard = states["TPs"].addressable_shards[0].data.shape
+    full = states["TPs"].shape
+    assert states["TPs"].sharding.is_equivalent_to(NamedSharding(mesh, P("c", None)), ndim=2)
+    print(f"devices: {len(devices)}; state {full} held as per-device {shard} slices")
+
+    # read ONE class's curve straight from the sharded counts — full compute()
+    # would materialize num_classes python lists just to print four numbers
+    tps, fps = states["TPs"][0], states["FPs"][0]
+    precision0 = np.asarray((tps + 1e-6) / (tps + fps + 1e-6))
+    print(f"class-0 precision across thresholds (head): {[round(float(v), 4) for v in precision0[:4]]}")
+    del compute  # full curves: precisions, recalls, thresholds = compute(states)
+
+
+if __name__ == "__main__":
+    main()
