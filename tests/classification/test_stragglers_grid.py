@@ -1,0 +1,155 @@
+"""Option grids for classification metrics OUTSIDE the stat-scores engine.
+
+The 528-cell full grid (test_full_grid.py) enumerates the stat-scores family
+and the curve family; these cells cover the remaining per-metric option
+spaces — Hinge squared x multiclass_mode, KLDivergence log_prob x reduction,
+Jaccard average x absent_score x ignore_index, AUROC max_fpr,
+AveragePrecision average modes, CalibrationError norm x n_bins — each vs the
+mounted reference on identical streamed batches. (AUROC multiclass averages
+and CohenKappa weights are already enumerated in test_reference_parity.py.)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers import assert_tree_close, cell_seed
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+
+import metrics_tpu as mt  # noqa: E402
+
+N_CLASSES = 5
+N_BATCHES, BATCH = 3, 32
+
+
+def _run_cell(name, kwargs, batches, atol=1e-5):
+    ours = getattr(mt, name)(**kwargs)
+    ref = getattr(_ref, name)(**kwargs)
+    for preds, target in batches:
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.tensor(preds), torch.tensor(target))
+    assert_tree_close(ours.compute(), ref.compute(), atol=atol)
+
+
+def _logit_batches(seed, binary=False):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(N_BATCHES):
+        if binary:
+            out.append((rng.randn(BATCH).astype(np.float32), rng.randint(0, 2, BATCH)))
+        else:
+            out.append((rng.randn(BATCH, N_CLASSES).astype(np.float32), rng.randint(0, N_CLASSES, BATCH)))
+    return out
+
+
+def _prob_batches(seed, binary=False):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(N_BATCHES):
+        if binary:
+            out.append((rng.rand(BATCH).astype(np.float32), rng.randint(0, 2, BATCH)))
+        else:
+            p = rng.rand(BATCH, N_CLASSES).astype(np.float32)
+            out.append((p / p.sum(axis=1, keepdims=True), rng.randint(0, N_CLASSES, BATCH)))
+    return out
+
+
+def _label_batches(seed):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.randint(0, N_CLASSES, BATCH), rng.randint(0, N_CLASSES, BATCH)) for _ in range(N_BATCHES)
+    ]
+
+
+class TestHingeGrid:
+    @pytest.mark.parametrize("squared", (False, True))
+    def test_binary(self, squared):
+        _run_cell("HingeLoss", {"squared": squared}, _logit_batches(cell_seed("hinge-b", squared), binary=True))
+
+    @pytest.mark.parametrize("squared", (False, True))
+    @pytest.mark.parametrize("multiclass_mode", ("crammer-singer", "one-vs-all"))
+    def test_multiclass(self, squared, multiclass_mode):
+        _run_cell(
+            "HingeLoss",
+            {"squared": squared, "multiclass_mode": multiclass_mode},
+            _logit_batches(cell_seed("hinge-m", squared, multiclass_mode)),
+        )
+
+
+class TestKLDivergenceGrid:
+    @pytest.mark.parametrize("log_prob", (False, True))
+    @pytest.mark.parametrize("reduction", ("mean", "sum"))
+    def test_cell(self, log_prob, reduction):
+        rng = np.random.RandomState(cell_seed("kld", log_prob, reduction))
+        batches = []
+        for _ in range(N_BATCHES):
+            p = rng.rand(BATCH, N_CLASSES).astype(np.float32) + 1e-3
+            q = rng.rand(BATCH, N_CLASSES).astype(np.float32) + 1e-3
+            p /= p.sum(axis=1, keepdims=True)
+            q /= q.sum(axis=1, keepdims=True)
+            if log_prob:
+                p, q = np.log(p), np.log(q)
+            batches.append((p, q))
+        _run_cell("KLDivergence", {"log_prob": log_prob, "reduction": reduction}, batches, atol=1e-4)
+
+
+class TestJaccardGrid:
+    @pytest.mark.parametrize("average", ("macro", "micro", "weighted", "none"))
+    @pytest.mark.parametrize("absent_score", (0.0, 1.0))
+    @pytest.mark.parametrize("ignore_index", (None, 0))
+    def test_cell(self, average, absent_score, ignore_index):
+        kwargs = {
+            "num_classes": N_CLASSES,
+            "average": average,
+            "absent_score": absent_score,
+            "ignore_index": ignore_index,
+        }
+        batches = _label_batches(cell_seed("jaccard", average, absent_score, ignore_index))
+        if average == "weighted" and ignore_index is not None:
+            # reference-internal crash (`functional/classification/jaccard.py:91`):
+            # with ignore_index its `weights` stays length C while `scores`
+            # shrinks to C-1, so torch broadcasts and raises. Our side must
+            # compute a finite value (full-grid ref_bug convention).
+            ours = mt.JaccardIndex(**kwargs)
+            ref = getattr(_ref, "JaccardIndex")(**kwargs)
+            for p, t in batches:
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(torch.tensor(p), torch.tensor(t))
+            with pytest.raises(RuntimeError):
+                ref.compute()
+            assert np.all(np.isfinite(np.asarray(ours.compute())))
+            return
+        _run_cell("JaccardIndex", kwargs, batches)
+
+
+class TestAurocApGrid:
+    @pytest.mark.parametrize("max_fpr", (None, 0.5, 0.9))
+    def test_auroc_binary_max_fpr(self, max_fpr):
+        _run_cell(
+            "AUROC", {"max_fpr": max_fpr}, _prob_batches(cell_seed("auroc-fpr", max_fpr), binary=True)
+        )
+
+    @pytest.mark.parametrize("average", ("macro", "weighted"))
+    def test_average_precision_multiclass(self, average):
+        _run_cell(
+            "AveragePrecision",
+            {"num_classes": N_CLASSES, "average": average},
+            _prob_batches(cell_seed("ap", average)),
+        )
+
+
+class TestCalibrationGrid:
+    @pytest.mark.parametrize("norm", ("l1", "l2", "max"))
+    @pytest.mark.parametrize("n_bins", (5, 15, 30))
+    def test_cell(self, norm, n_bins):
+        _run_cell(
+            "CalibrationError",
+            {"norm": norm, "n_bins": n_bins},
+            _prob_batches(cell_seed("cal", norm, n_bins), binary=True),
+            atol=1e-4,
+        )
