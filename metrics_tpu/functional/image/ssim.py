@@ -69,7 +69,13 @@ def _ssim_compute(
     c1 = (k1 * data_range) ** 2
     c2 = (k2 * data_range) ** 2
 
+    # statistics run at >= f32 even for bf16/f16 inputs: the variance terms
+    # are differences of products, and half-precision cancellation there
+    # drives the MS-SSIM contrast terms negative (NaN under fractional
+    # powers) — inputs stay whatever the model produced, accumulation is
+    # metric-grade (README "Metric-grade numerics")
     dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
+    dtype = jnp.promote_types(dtype, jnp.float32)
     preds = preds.astype(dtype)
     target = target.astype(dtype)
 

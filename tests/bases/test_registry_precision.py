@@ -1,0 +1,137 @@
+"""EVERY exported module metric accepts bf16 inputs — the TPU-native dtype.
+
+The reference runs fp16 precision tests per metric
+(`tests/unittests/helpers/testers.py:478-534` run_precision_test_cpu/gpu);
+the TPU equivalent is bfloat16, the MXU's native input dtype. This module
+auto-enumerates the same registry SPEC as the distributed contract: every
+metric whose canned inputs carry float arrays is fed the identical data cast
+to bf16 and must (a) run, (b) produce finite values, (c) agree with its own
+f32 result to bf16-appropriate tolerance. Metrics with no float inputs
+(label-pair, text, SQuAD) have nothing to cast and are skipped by detection,
+not by hand.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from tests.bases.test_registry_distributed import SPEC
+from tests.helpers import assert_tree_close
+
+# bf16 has ~8 mantissa bits: elementwise accumulations land within ~1e-2
+# relative; these metrics amplify input rounding beyond that and get a
+# documented looser bound instead of a skip.
+LOOSE = {
+    "SpearmanCorrCoef": 0.12,  # rank transform: ties created by rounding reorder ranks
+    "PearsonCorrCoef": 5e-2,  # variance cancellation on correlated streams
+    "R2Score": 5e-2,
+    "ExplainedVariance": 5e-2,
+    "KLDivergence": 5e-2,  # log of rounded ratios
+    "SignalDistortionRatio": 0.6,  # Toeplitz solve conditioning, dB scale
+    "ScaleInvariantSignalDistortionRatio": 0.25,  # log10 of residual ratios, dB scale
+    "SignalNoiseRatio": 0.25,
+    "ScaleInvariantSignalNoiseRatio": 0.25,
+    "PermutationInvariantTraining": 0.25,
+    "PeakSignalNoiseRatio": 0.12,  # log10 of bf16-rounded MSE, dB scale
+    "MeanSquaredLogError": 5e-2,
+    "MeanAbsolutePercentageError": 5e-2,
+    "SymmetricMeanAbsolutePercentageError": 5e-2,
+    "WeightedMeanAbsolutePercentageError": 5e-2,
+    "TweedieDevianceScore": 5e-2,
+    "CosineSimilarity": 5e-2,
+    "ErrorRelativeGlobalDimensionlessSynthesis": 0.35,  # per-band RMSE/mean ratios
+    "SpectralAngleMapper": 5e-2,
+    "SpectralDistortionIndex": 5e-2,
+    "UniversalImageQualityIndex": 5e-2,
+    "StructuralSimilarityIndexMeasure": 5e-2,
+    "MultiScaleStructuralSimilarityIndexMeasure": 5e-2,
+    "MultioutputWrapper": 5e-2,  # wraps R2Score
+    "MinMaxMetric": 5e-2,
+    "BinnedRecallAtFixedPrecision": 0.25,  # threshold selection flips a whole bin
+    "MeanAveragePrecision": 0.15,  # IoU threshold crossings flip matches
+}
+DEFAULT_RTOL = 2e-2
+
+# Exact curves emit one point per DISTINCT score: bf16 rounding merges
+# nearby scores, so the output length itself legitimately changes. The
+# contract for them is finiteness + same area to loose tolerance, not
+# pointwise equality.
+EXACT_CURVES = {"ROC", "PrecisionRecallCurve"}
+
+
+def _curve_area(xs, ys) -> float:
+    order = np.argsort(xs)
+    return float(np.trapezoid(np.asarray(ys, np.float64)[order], np.asarray(xs, np.float64)[order]))
+
+
+def _is_float_array(x) -> bool:
+    return isinstance(x, jax.Array) and bool(jnp.issubdtype(x.dtype, jnp.floating))
+
+
+def _cast_tree_bf16(x):
+    return jax.tree_util.tree_map(lambda v: v.astype(jnp.bfloat16) if _is_float_array(v) else v, x)
+
+
+def _has_float_array(x) -> bool:
+    return any(_is_float_array(v) for v in jax.tree_util.tree_leaves(x))
+
+
+def _split(batch):
+    # retrieval batches end in an {"indexes": ...} kwargs dict; detection
+    # batches are (preds_list, target_list) and fall through as plain args
+    if isinstance(batch[-1], dict) and "indexes" in batch[-1]:
+        return batch[:-1], batch[-1]
+    return batch, {}
+
+
+def _run(factory, batches, cast):
+    metric = factory()
+    for batch in batches:
+        args, kwargs = _split(batch)
+        if cast:
+            args = _cast_tree_bf16(args)
+            kwargs = _cast_tree_bf16(kwargs)
+        metric.update(*args, **kwargs)
+    return metric.compute()
+
+
+def _finite(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            _finite(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            _finite(v)
+    else:
+        arr = np.asarray(tree, np.float64)
+        assert np.all(np.isfinite(arr)), f"non-finite bf16 result: {arr}"
+
+
+@pytest.mark.parametrize("name", sorted(SPEC))
+def test_bf16_inputs(name):
+    factory, batches, _ = SPEC[name]
+    if not any(_has_float_array(b) for b in batches):
+        pytest.skip("no float inputs to cast")
+    f32 = _run(factory, batches, cast=False)
+    bf16 = _run(factory, batches, cast=True)
+    _finite(bf16)
+    if name in EXACT_CURVES:
+        (bx, by, _), (fx, fy, _) = bf16, f32
+        np.testing.assert_allclose(_curve_area(bx, by), _curve_area(fx, fy), atol=5e-2)
+        return
+    rtol = LOOSE.get(name, DEFAULT_RTOL)
+    assert_tree_close(bf16, f32, atol=rtol, rtol=rtol)
+
+
+def test_state_dtype_stays_accumulation_grade():
+    """bf16 INPUTS must not demote the accumulator dtypes: states are where
+    rounding compounds over thousands of updates, so they stay f32/int."""
+    metric = mt.MeanSquaredError()
+    metric.update(jnp.ones(8, jnp.bfloat16), jnp.zeros(8, jnp.bfloat16))
+    assert metric.sum_squared_error.dtype == jnp.float32
+    acc = mt.Accuracy()
+    acc.update(jnp.asarray([0.9, 0.2], jnp.bfloat16), jnp.asarray([1, 0]))
+    assert acc.correct.dtype in (jnp.int32, jnp.float32)
