@@ -765,9 +765,13 @@ class Metric(ABC):
 
     # ------------------------------------------------------------------- sync
     def _sync_children(self) -> List["Metric"]:
-        """Child metrics whose states must sync with this one (wrappers and
-        compositions override; plain metrics have none)."""
-        return []
+        """Child metrics whose states must sync with this one.
+
+        Derived from :meth:`_named_child_metrics` so sync and checkpointing
+        share ONE child-discovery mechanism — a wrapper whose children sync
+        must also have them persisted, and vice versa.
+        """
+        return [child for _, child in self._named_child_metrics()]
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
         input_dict = {name: getattr(self, name) for name in self._reductions}
@@ -978,11 +982,35 @@ class Metric(ABC):
     def clone(self) -> "Metric":
         return copy.deepcopy(self)
 
+    _CHILD_SKIP_PREFIXES = ("_fused", "_many")  # export/jit machinery templates
+
+    def _named_child_metrics(self) -> List[tuple]:
+        """(dotted-name, child) pairs for Metric-valued attributes.
+
+        Wrappers and compositions hold their children as plain attributes
+        (``self.metric``, ``self._base_metric``, ``self.metrics`` lists); the
+        reference gets recursive ``state_dict`` for free from ``nn.Module``
+        registration, so child discovery here is the equivalent surface.
+        Fused-forward templates are machinery, not children, and are skipped.
+        """
+        out = []
+        for attr in sorted(self.__dict__):
+            if attr.startswith(self._CHILD_SKIP_PREFIXES):
+                continue
+            value = self.__dict__[attr]
+            if isinstance(value, Metric):
+                out.append((attr, value))
+            elif isinstance(value, (list, tuple)):
+                out.extend((f"{attr}.{i}", v) for i, v in enumerate(value) if isinstance(v, Metric))
+        return out
+
     def state_dict(self, prefix: str = "", keep_vars: bool = False) -> Dict[str, Any]:
         """Persistent states as host numpy arrays (checkpointable pytree leaves).
 
         Parity: reference ``state_dict`` `metric.py:662-680`; the result is a
-        plain dict so it drops into orbax/flax checkpoints.
+        plain dict so it drops into orbax/flax checkpoints. Child metrics
+        (wrappers, compositions) recurse under dotted prefixes, matching the
+        reference's ``nn.Module`` hierarchy.
         """
         destination: Dict[str, Any] = {}
         for name in self._defaults:
@@ -993,6 +1021,8 @@ class Metric(ABC):
                 destination[prefix + name] = [np.asarray(jax.device_get(v)) for v in value]
             else:
                 destination[prefix + name] = np.asarray(jax.device_get(value))
+        for child_name, child in self._named_child_metrics():
+            destination.update(child.state_dict(prefix=f"{prefix}{child_name}."))
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
@@ -1006,11 +1036,16 @@ class Metric(ABC):
                     setattr(self, name, jnp.asarray(value))
             elif strict and self._persistent[name]:
                 raise KeyError(f"Missing key {key!r} in state_dict")
+        for child_name, child in self._named_child_metrics():
+            child.load_state_dict(state_dict, prefix=f"{prefix}{child_name}.", strict=strict)
 
     def persistent(self, mode: bool = False) -> None:
-        """Toggle the persistent flag on all states (reference `metric.py:657-660`)."""
+        """Toggle the persistent flag on all states, children included
+        (reference `metric.py:657-660`)."""
         for name in self._persistent:
             self._persistent[name] = mode
+        for _, child in self._named_child_metrics():
+            child.persistent(mode)
 
     def __getstate__(self) -> Dict[str, Any]:
         # drop the wrapped bound methods (re-wrapped on unpickle, reference
@@ -1149,103 +1184,103 @@ class Metric(ABC):
 
     # ------------------------------------------------------- composition ops
     def __add__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, self, other)
+        return CompositionalMetric(_op_add, self, other)
 
     def __radd__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, other, self)
+        return CompositionalMetric(_op_add, other, self)
 
     def __sub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, self, other)
+        return CompositionalMetric(_op_sub, self, other)
 
     def __rsub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, other, self)
+        return CompositionalMetric(_op_sub, other, self)
 
     def __mul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, self, other)
+        return CompositionalMetric(_op_mul, self, other)
 
     def __rmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, other, self)
+        return CompositionalMetric(_op_mul, other, self)
 
     def __truediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.divide, self, other)
+        return CompositionalMetric(_op_div, self, other)
 
     def __rtruediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.divide, other, self)
+        return CompositionalMetric(_op_div, other, self)
 
     def __floordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, self, other)
+        return CompositionalMetric(_op_floordiv, self, other)
 
     def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, other, self)
+        return CompositionalMetric(_op_floordiv, other, self)
 
     def __mod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, self, other)
+        return CompositionalMetric(_op_mod, self, other)
 
     def __rmod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, other, self)
+        return CompositionalMetric(_op_mod, other, self)
 
     def __pow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, self, other)
+        return CompositionalMetric(_op_pow, self, other)
 
     def __rpow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, other, self)
+        return CompositionalMetric(_op_pow, other, self)
 
     def __matmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, self, other)
+        return CompositionalMetric(_op_matmul, self, other)
 
     def __rmatmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, other, self)
+        return CompositionalMetric(_op_matmul, other, self)
 
     def __and__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(_op_and, self, other)
 
     def __rand__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_and, other, self)
+        return CompositionalMetric(_op_and, other, self)
 
     def __or__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, self, other)
+        return CompositionalMetric(_op_or, self, other)
 
     def __ror__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, other, self)
+        return CompositionalMetric(_op_or, other, self)
 
     def __xor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, self, other)
+        return CompositionalMetric(_op_xor, self, other)
 
     def __rxor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, other, self)
+        return CompositionalMetric(_op_xor, other, self)
 
     def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.equal, self, other)
+        return CompositionalMetric(_op_eq, self, other)
 
     def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.not_equal, self, other)
+        return CompositionalMetric(_op_ne, self, other)
 
     def __lt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less, self, other)
+        return CompositionalMetric(_op_lt, self, other)
 
     def __le__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less_equal, self, other)
+        return CompositionalMetric(_op_le, self, other)
 
     def __gt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater, self, other)
+        return CompositionalMetric(_op_gt, self, other)
 
     def __ge__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater_equal, self, other)
+        return CompositionalMetric(_op_ge, self, other)
 
     def __abs__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(_op_abs, self, None)
 
     def __neg__(self) -> "CompositionalMetric":
         return CompositionalMetric(_neg, self, None)
 
     def __pos__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(_op_abs, self, None)
 
     def __invert__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.logical_not, self, None)
+        return CompositionalMetric(_op_not, self, None)
 
     def __getitem__(self, idx: Any) -> "CompositionalMetric":
-        return CompositionalMetric(lambda x: x[idx], self, None)
+        return CompositionalMetric(functools.partial(_op_getitem, idx=idx), self, None)
 
     def __getnewargs__(self) -> tuple:
         return tuple()
@@ -1283,6 +1318,91 @@ def _propagate_static_attrs(src: "Metric", dst: "Metric") -> None:
             continue
         if dst.__dict__.get(name, object()) != value:
             object.__setattr__(dst, name, value)
+
+
+# Module-level named operator wrappers: CompositionalMetric stores its
+# operator on the instance, and `jnp.add`-style ufunc objects do not pickle
+# (their qualified name resolves to a different wrapper object). Named
+# functions pickle by reference, keeping composed metrics checkpointable
+# like the reference's torch.add-built ones.
+def _op_add(a, b):
+    return jnp.add(a, b)
+
+
+def _op_sub(a, b):
+    return jnp.subtract(a, b)
+
+
+def _op_mul(a, b):
+    return jnp.multiply(a, b)
+
+
+def _op_div(a, b):
+    return jnp.divide(a, b)
+
+
+def _op_floordiv(a, b):
+    return jnp.floor_divide(a, b)
+
+
+def _op_mod(a, b):
+    return jnp.mod(a, b)
+
+
+def _op_pow(a, b):
+    return jnp.power(a, b)
+
+
+def _op_matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+def _op_and(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+def _op_or(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+def _op_xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+def _op_eq(a, b):
+    return jnp.equal(a, b)
+
+
+def _op_ne(a, b):
+    return jnp.not_equal(a, b)
+
+
+def _op_lt(a, b):
+    return jnp.less(a, b)
+
+
+def _op_le(a, b):
+    return jnp.less_equal(a, b)
+
+
+def _op_gt(a, b):
+    return jnp.greater(a, b)
+
+
+def _op_ge(a, b):
+    return jnp.greater_equal(a, b)
+
+
+def _op_abs(x):
+    return jnp.abs(x)
+
+
+def _op_not(x):
+    return jnp.logical_not(x)
+
+
+def _op_getitem(x, idx):
+    return x[idx]
 
 
 def _neg(x: jax.Array) -> jax.Array:
@@ -1325,9 +1445,6 @@ class CompositionalMetric(Metric):
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
         pass  # no own states; components sync via _sync_children recursion
 
-    def _sync_children(self) -> List[Metric]:
-        return [m for m in (self.metric_a, self.metric_b) if isinstance(m, Metric)]
-
     def update(self, *args: Any, **kwargs: Any) -> None:
         if isinstance(self.metric_a, Metric):
             self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
@@ -1366,12 +1483,6 @@ class CompositionalMetric(Metric):
             self.metric_a.reset()
         if isinstance(self.metric_b, Metric):
             self.metric_b.reset()
-
-    def persistent(self, mode: bool = False) -> None:
-        if isinstance(self.metric_a, Metric):
-            self.metric_a.persistent(mode=mode)
-        if isinstance(self.metric_b, Metric):
-            self.metric_b.persistent(mode=mode)
 
     def __repr__(self) -> str:
         _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else self.op}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"

@@ -78,9 +78,6 @@ class BootStrapper(Metric):
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.RandomState()
 
-    def _sync_children(self):
-        return list(self.metrics)
-
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each."""
         for idx in range(self.num_bootstraps):

@@ -42,9 +42,6 @@ class ClasswiseWrapper(Metric):
             return {f"{name}_{i}": val for i, val in enumerate(x)}
         return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
 
-    def _sync_children(self):
-        return [self.metric]
-
     def update(self, *args: Any, **kwargs: Any) -> None:
         self.metric.update(*args, **kwargs)
 

@@ -37,9 +37,6 @@ class MinMaxMetric(Metric):
         self.min_val = jnp.asarray(jnp.inf)
         self.max_val = jnp.asarray(-jnp.inf)
 
-    def _sync_children(self):
-        return [self._base_metric]
-
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
 

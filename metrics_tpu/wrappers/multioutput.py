@@ -78,9 +78,6 @@ class MultioutputWrapper(Metric):
             args_kwargs_by_output.append((selected_args, selected_kwargs))
         return args_kwargs_by_output
 
-    def _sync_children(self):
-        return list(self.metrics)
-
     def update(self, *args: Any, **kwargs: Any) -> None:
         reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
         for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
