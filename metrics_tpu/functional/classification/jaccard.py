@@ -25,7 +25,7 @@ def _jaccard_from_confmat(
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
 
     if ignore_index is not None and 0 <= ignore_index < num_classes:
-        confmat = confmat.at[ignore_index].set(0.0)
+        confmat = confmat.at[ignore_index].set(jnp.zeros((), dtype=confmat.dtype))
 
     if average in ("none", None):
         intersection = jnp.diag(confmat)

@@ -982,7 +982,18 @@ class Metric(ABC):
     def clone(self) -> "Metric":
         return copy.deepcopy(self)
 
-    _CHILD_SKIP_PREFIXES = ("_fused", "_many")  # export/jit machinery templates
+    # Export/jit machinery template attributes, matched by exact name so a
+    # future Metric-valued attribute that merely *starts* with "_fused"/"_many"
+    # still participates in sync, state_dict, and persistent recursion.
+    _CHILD_SKIP_ATTRS = frozenset(
+        {
+            "_fused_template",
+            "_fused_templates",
+            "_many_template_vals",
+            "_many_template_novals",
+            "_many_templates",
+        }
+    )
 
     def _named_child_metrics(self) -> List[tuple]:
         """(dotted-name, child) pairs for Metric-valued attributes.
@@ -995,7 +1006,7 @@ class Metric(ABC):
         """
         out = []
         for attr in sorted(self.__dict__):
-            if attr.startswith(self._CHILD_SKIP_PREFIXES):
+            if attr in self._CHILD_SKIP_ATTRS:
                 continue
             value = self.__dict__[attr]
             if isinstance(value, Metric):

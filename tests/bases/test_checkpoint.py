@@ -76,6 +76,8 @@ class TestOrbaxRoundTrip:
 
     def test_persistent_flag_controls_state_dict(self):
         class P(mt.Metric):
+            full_state_update = False
+
             def __init__(self):
                 super().__init__()
                 self.add_state("kept", jnp.asarray(0.0), dist_reduce_fx="sum", persistent=True)

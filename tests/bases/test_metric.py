@@ -259,6 +259,8 @@ def test_is_overridden():
     from metrics_tpu.utils.checks import is_overridden
 
     class Sub(Metric):
+        full_state_update = False
+
         def update(self):
             pass
 

@@ -198,6 +198,7 @@ class TestLPIPS:
 
 
 class TestInceptionV3Model:
+    @pytest.mark.slow
     def test_feature_taps_and_dtypes(self):
         from metrics_tpu.models.inception import InceptionV3Extractor
 

@@ -220,6 +220,7 @@ class TestTrainLoopSPMD:
         assert float(epoch(init(), losses)) == pytest.approx(float(losses.mean()), rel=1e-6)
 
 
+@pytest.mark.slow
 def test_batched_eval_example_runs():
     """examples/batched_eval.py end to end: the fully-seeded run must print
     the exact epoch totals (pinned below) and the analytically-known MSE."""

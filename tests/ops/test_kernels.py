@@ -141,6 +141,7 @@ class TestSegmentOps:
         got = segment_cumsum(jnp.zeros((0,)), jnp.zeros((0,), jnp.int32), 0)
         assert got.shape == (0,)
 
+    @pytest.mark.slow
     def test_cumsum_no_cancellation_after_huge_group(self):
         # a tiny group following a 2M-row group must not inherit float32
         # rounding from the global prefix (segmented scan, not cumsum-minus-offset)
