@@ -42,7 +42,13 @@ def _load_flax_model(model_name_or_path: str):
     from transformers import AutoTokenizer, FlaxAutoModel
 
     tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
-    model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    try:
+        model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    except OSError:
+        # checkpoint directory carries torch weights only (the layout HF hub
+        # checkpoints and local `save_pretrained` dirs usually have) —
+        # convert on load rather than demanding a flax re-export
+        model = FlaxAutoModel.from_pretrained(model_name_or_path, from_pt=True)
     return tokenizer, model
 
 

@@ -1,0 +1,310 @@
+"""Real-checkpoint layout fidelity for the weight converters.
+
+The reference loads torch-fidelity's ``FeatureExtractorInceptionV3``
+(`/root/reference/src/torchmetrics/image/fid.py:41-58`), the ``lpips``
+package nets (`image/lpip.py:24-77`), and HF checkpoints for BERTScore
+(`functional/text/bert.py:45-123`). This repo's converters were previously
+validated only against in-repo torch mirrors — a key-layout drift between
+mirror and upstream would have passed every test and still broken the first
+real user.
+
+These tests anchor everything to the VENDORED manifests in
+``tests/fixtures/manifests/`` — the exact upstream state-dict key names,
+shapes, and dtypes, transcribed from the published module definitions by
+``tools/gen_checkpoint_manifests.py`` (independent of this repo's Flax models
+and torch mirrors). A failure here means a converter key-mapping (or a
+mirror) drifted from the real checkpoint layout.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_MANIFEST_DIR = os.path.join(os.path.dirname(__file__), "..", "fixtures", "manifests")
+_TOOLS_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+
+def _manifest(name: str) -> dict:
+    with open(os.path.join(_MANIFEST_DIR, name)) as handle:
+        return json.load(handle)
+
+
+def _synthetic_numpy_state(manifest: dict, seed: int = 0, include_optional: bool = True) -> dict:
+    """A synthetic checkpoint with EXACTLY the upstream layout."""
+    rng = np.random.RandomState(seed)
+    state = {}
+    for key, spec in manifest.items():
+        if spec.get("optional") and not include_optional:
+            continue
+        shape = spec["shape"]
+        if spec["dtype"] == "int64":
+            state[key] = np.asarray(rng.randint(0, 100), dtype=np.int64).reshape(shape)
+        elif key.endswith("running_var") or key.endswith("bn.weight") or key.endswith(".scale"):
+            state[key] = (rng.rand(*shape).astype(np.float32) * 0.5 + 0.75)
+        elif len(shape) >= 2:
+            # fan-in-scaled weights keep activations (and hence feature
+            # covariances) non-degenerate through the deep nets
+            fan_in = int(np.prod(shape[1:]))
+            state[key] = rng.randn(*shape).astype(np.float32) * (2.0 / max(fan_in, 1)) ** 0.5
+        else:
+            state[key] = rng.randn(*shape).astype(np.float32) * 0.1
+    return state
+
+
+# ----------------------------------------------------------------- Inception
+
+
+class TestInceptionLayout:
+    def test_manifest_is_the_published_layout(self):
+        """Structural invariants of the pt_inception-2015-12-05 artifact:
+        94 conv+bn modules, 1008-way fc, 2048-d final features."""
+        man = _manifest("torch_fidelity_inception_v3.json")
+        conv_keys = [k for k in man if k.endswith(".conv.weight")]
+        assert len(conv_keys) == 94
+        assert man["fc.weight"]["shape"] == [1008, 2048]
+        assert man["Mixed_7c.branch_pool.conv.weight"]["shape"][1] == 2048
+        # every conv has its full BN quartet + the optional tracked counter
+        for key in conv_keys:
+            stem = key[: -len(".conv.weight")]
+            for suffix in ("weight", "bias", "running_mean", "running_var"):
+                assert f"{stem}.bn.{suffix}" in man, f"{stem} missing bn.{suffix}"
+            assert man[f"{stem}.bn.num_batches_tracked"]["optional"] is True
+
+    def test_torch_mirror_matches_upstream_layout(self):
+        """The in-repo torch mirror must carry the REAL checkpoint's key set
+        and shapes — this is the test that breaks if mirror and upstream
+        drift apart."""
+        torch = pytest.importorskip("torch")
+        from tests.helpers.torch_mirrors import TorchInceptionMirror
+
+        man = _manifest("torch_fidelity_inception_v3.json")
+        mirror_state = TorchInceptionMirror().state_dict()
+        assert set(mirror_state) == set(man)
+        for key, value in mirror_state.items():
+            assert list(value.shape) == man[key]["shape"], key
+
+    def test_converter_accepts_real_layout(self):
+        """convert_state_dict over a synthetic REAL-layout checkpoint must
+        produce exactly the Flax model's parameter manifest."""
+        jnp = pytest.importorskip("jax.numpy")
+        from convert_inception_weights import convert_state_dict
+
+        from metrics_tpu.models.inception import InceptionV3Extractor
+        from metrics_tpu.models.manifest import _flatten_with_paths, expected_manifest
+
+        man = _manifest("torch_fidelity_inception_v3.json")
+        converted = convert_state_dict(_synthetic_numpy_state(man))
+
+        tree: dict = {}
+        for key, value in converted.items():
+            node = tree
+            parts = key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = value
+
+        extractor = InceptionV3Extractor(feature="2048", seed=0)
+        dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
+        want = expected_manifest(extractor.model, dummy)
+        got = _flatten_with_paths(tree)
+        assert want == got
+
+    def test_converter_accepts_pre_tracked_bn_checkpoints(self):
+        """The 2015 artifact predates BN's num_batches_tracked buffer — the
+        converter must accept a checkpoint without those keys too."""
+        from convert_inception_weights import convert_state_dict
+
+        man = _manifest("torch_fidelity_inception_v3.json")
+        with_opt = convert_state_dict(_synthetic_numpy_state(man, include_optional=True))
+        without_opt = convert_state_dict(_synthetic_numpy_state(man, include_optional=False))
+        assert set(with_opt) == set(without_opt)
+
+    def test_converter_rejects_foreign_keys(self):
+        from convert_inception_weights import convert_state_dict
+
+        with pytest.raises(ValueError, match="Unrecognized torch key"):
+            convert_state_dict({"some.unknown.module.weight": np.zeros((1,), np.float32)})
+
+    @pytest.mark.slow
+    def test_fid_end_to_end_from_real_layout_checkpoint(self, tmp_path):
+        """Full user path: real-layout .pth-equivalent -> converter -> .npz ->
+        FrechetInceptionDistance -> finite score. Fails if any converter key
+        mapping drifts from the upstream layout."""
+        jnp = pytest.importorskip("jax.numpy")
+        from convert_inception_weights import convert_state_dict
+
+        import metrics_tpu as mt
+
+        man = _manifest("torch_fidelity_inception_v3.json")
+        converted = convert_state_dict(_synthetic_numpy_state(man))
+        npz_path = tmp_path / "inception.npz"
+        np.savez(npz_path, **converted)
+
+        fid = mt.FrechetInceptionDistance(feature=2048, npz_path=str(npz_path))
+        rng = np.random.RandomState(0)
+        real = jnp.asarray(rng.randint(0, 256, (2, 3, 299, 299), dtype=np.uint8))
+        fake = jnp.asarray(rng.randint(0, 256, (2, 3, 299, 299), dtype=np.uint8))
+        fid.update(real, real=True)
+        fid.update(fake, real=False)
+        assert np.isfinite(float(fid.compute()))
+
+
+# --------------------------------------------------------------------- LPIPS
+
+
+class TestLPIPSLayout:
+    @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+    def test_manifest_head_and_backbone_invariants(self, net_type):
+        man = _manifest(f"lpips_{net_type}.json")
+        # scaling buffers + the double-registered heads are part of the layout
+        assert man["scaling_layer.shift"]["shape"] == [1, 3, 1, 1]
+        lin_keys = sorted(k for k in man if k.startswith("lin") and not k.startswith("lins."))
+        dup_keys = sorted(k for k in man if k.startswith("lins."))
+        assert len(lin_keys) == len(dup_keys) == {"alex": 5, "vgg": 5, "squeeze": 7}[net_type]
+        for k, dup in zip(lin_keys, dup_keys):
+            assert man[k]["shape"] == man[dup]["shape"]
+        # heads are 1x1 single-output convs over the tap channels
+        for k in lin_keys:
+            shape = man[k]["shape"]
+            assert shape[0] == 1 and shape[2:] == [1, 1]
+
+    def test_alex_mirror_backbone_matches_upstream_layout(self):
+        """The alex mirror's backbone/head keys must be a subset of the real
+        lpips.LPIPS(net='alex') state dict with identical shapes (the mirror
+        omits the constant scaling buffers and the ModuleList duplicates)."""
+        torch = pytest.importorskip("torch")
+        from tests.helpers.torch_mirrors import TorchAlexLPIPSMirror
+
+        man = _manifest("lpips_alex.json")
+        mirror_state = TorchAlexLPIPSMirror().state_dict()
+        assert set(mirror_state) <= set(man)
+        for key, value in mirror_state.items():
+            assert list(value.shape) == man[key]["shape"], key
+        # everything the mirror omits is either constant or a duplicate
+        omitted = set(man) - set(mirror_state)
+        assert all(k.startswith(("scaling_layer.", "lins.")) for k in omitted)
+
+    @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+    def test_converter_accepts_real_layout(self, net_type):
+        """convert_state_dict over the full real-layout LPIPS state dict must
+        produce exactly the Flax LPIPSNet parameter manifest."""
+        jnp = pytest.importorskip("jax.numpy")
+        from convert_lpips_weights import convert_state_dict
+
+        from metrics_tpu.models.lpips import LPIPSExtractor
+        from metrics_tpu.models.manifest import _flatten_with_paths, expected_manifest
+
+        man = _manifest(f"lpips_{net_type}.json")
+        converted = convert_state_dict(net_type, _synthetic_numpy_state(man))
+
+        tree: dict = {}
+        for key, value in converted.items():
+            node = tree
+            parts = key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = value
+
+        extractor = LPIPSExtractor(net_type=net_type, seed=0)
+        dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)  # model is NHWC inside
+        want = expected_manifest(extractor.model, dummy, dummy)
+        got = _flatten_with_paths(tree)
+        assert want == got
+
+    def test_converter_rejects_untapped_backbone_index(self):
+        from convert_lpips_weights import convert_state_dict
+
+        with pytest.raises(ValueError, match="not a tapped conv"):
+            convert_state_dict("alex", {"net.slice1.1.weight": np.zeros((1,), np.float32)})
+
+    @pytest.mark.slow
+    def test_lpips_end_to_end_from_real_layout_checkpoint(self, tmp_path):
+        jnp = pytest.importorskip("jax.numpy")
+        from convert_lpips_weights import convert_state_dict
+
+        import metrics_tpu as mt
+
+        man = _manifest("lpips_alex.json")
+        converted = convert_state_dict("alex", _synthetic_numpy_state(man))
+        npz_path = tmp_path / "lpips_alex.npz"
+        np.savez(npz_path, **converted)
+
+        metric = mt.LearnedPerceptualImagePatchSimilarity(net_type="alex", npz_path=str(npz_path))
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+        b = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+        metric.update(a, b)
+        assert np.isfinite(float(metric.compute()))
+
+
+# ---------------------------------------------------------------------- BERT
+
+
+class TestBERTLayout:
+    def test_vendored_manifest_matches_installed_bert_definition(self):
+        """The vendored bert-base-uncased manifest must equal the installed
+        transformers BertModel definition (meta-device instantiation — the
+        published module definition itself)."""
+        pytest.importorskip("transformers")
+        from gen_checkpoint_manifests import bert_manifest
+
+        assert bert_manifest() == _manifest("hf_bert_base_uncased.json")
+
+    def test_manifest_invariants(self):
+        man = _manifest("hf_bert_base_uncased.json")
+        assert man["embeddings.word_embeddings.weight"]["shape"] == [30522, 768]
+        assert man["pooler.dense.weight"]["shape"] == [768, 768]
+        # 12 encoder layers, each with the full attention + FFN parameter set
+        for layer in range(12):
+            prefix = f"encoder.layer.{layer}."
+            assert f"{prefix}attention.self.query.weight" in man
+            assert man[f"{prefix}intermediate.dense.weight"]["shape"] == [3072, 768]
+
+    @pytest.mark.slow
+    def test_bert_score_from_local_torch_checkpoint(self, tmp_path):
+        """Full user path: a local HF directory holding only TORCH weights
+        (the layout `save_pretrained` and hub snapshots produce) must load
+        through the flax path and produce a finite BERTScore."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from transformers import BertConfig, BertModel, BertTokenizer
+
+        import metrics_tpu as mt
+
+        ckpt_dir = tmp_path / "tiny-bert"
+        ckpt_dir.mkdir()
+        cfg = BertConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=64, max_position_embeddings=64,
+        )
+        torch.manual_seed(0)
+        model = BertModel(cfg)
+        # per-layer key pattern must match the vendored real manifest
+        man_keys = set(_manifest("hf_bert_base_uncased.json"))
+        tiny_keys = {
+            k.replace("layer.0.", "layer.N.").replace("layer.1.", "layer.N.")
+            for k in model.state_dict()
+        }
+        real_keys = {
+            k.replace("layer.0.", "layer.N.") if ".layer.0." in k else k
+            for k in man_keys
+            if ".layer." not in k or ".layer.0." in k
+        }
+        assert tiny_keys == real_keys
+        model.save_pretrained(ckpt_dir, safe_serialization=False)  # pytorch_model.bin
+
+        vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "hello", "world", "the", "cat", "sat"]
+        vocab += [f"tok{i}" for i in range(64 - len(vocab))]
+        (ckpt_dir / "vocab.txt").write_text("\n".join(vocab))
+        BertTokenizer(str(ckpt_dir / "vocab.txt"), model_max_length=64).save_pretrained(ckpt_dir)
+
+        res = mt.functional.bert_score(
+            ["hello world"], ["hello the cat"], model_name_or_path=str(ckpt_dir), num_layers=2,
+        )
+        assert np.isfinite(float(np.asarray(res["f1"]).mean()))
