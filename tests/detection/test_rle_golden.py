@@ -1,0 +1,118 @@
+"""Golden COCO RLE vectors (spec-derived) for the segm path.
+
+The reference defers segm mask I/O to pycocotools ``mask_utils``
+(`/root/reference/src/torchmetrics/detection/mean_ap.py:127-143`); this repo
+ships its own codec (`functional/detection/rle.py`). Previously the codec was
+tested only round-trip against itself — these fixtures pin it to the
+PUBLISHED encoding: `tests/fixtures/coco_rle_golden.json` holds hand-derived
+counts arrays, compressed strings (each derivation documented in the file),
+and analytically-known mask IoUs, so an encoding drift from the COCO spec
+fails here even though pycocotools itself is not installed.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.detection.rle import rle_decode, rle_encode
+
+_FIXTURE = os.path.join(os.path.dirname(__file__), "..", "fixtures", "coco_rle_golden.json")
+
+
+def _load():
+    with open(_FIXTURE) as handle:
+        return json.load(handle)
+
+
+def _dense_from_counts(size, counts) -> np.ndarray:
+    """Independent decoder: expand counts column-major with plain python."""
+    h, w = size
+    flat = []
+    bit = 0
+    for run in counts:
+        flat.extend([bit] * run)
+        bit ^= 1
+    assert len(flat) == h * w
+    return np.asarray(flat, dtype=bool).reshape((w, h)).T
+
+
+_CASES = {c["name"]: c for c in _load()["cases"]}
+_IOU_CASES = {c["name"]: c for c in _load()["iou_cases"]}
+
+
+class TestGoldenVectors:
+    @pytest.mark.parametrize("name", sorted(_CASES))
+    def test_decode_compressed_matches_golden_mask(self, name):
+        case = _CASES[name]
+        want = _dense_from_counts(case["size"], case["counts_uncompressed"])
+        if "mask" in case:  # the human-readable form must agree with counts
+            rows = np.asarray([[ch == "1" for ch in row] for row in case["mask"]])
+            np.testing.assert_array_equal(rows, want)
+        got = rle_decode({"size": case["size"], "counts": case["counts_compressed"]})
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("name", sorted(_CASES))
+    def test_decode_uncompressed_matches_golden_mask(self, name):
+        case = _CASES[name]
+        want = _dense_from_counts(case["size"], case["counts_uncompressed"])
+        got = rle_decode({"size": case["size"], "counts": case["counts_uncompressed"]})
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("name", sorted(_CASES))
+    def test_encode_produces_golden_counts_and_string(self, name):
+        case = _CASES[name]
+        mask = _dense_from_counts(case["size"], case["counts_uncompressed"])
+        assert rle_encode(mask, compress=False)["counts"] == case["counts_uncompressed"]
+        got = rle_encode(mask, compress=True)["counts"]
+        got = got.decode("ascii") if isinstance(got, bytes) else got
+        assert got == case["counts_compressed"]
+
+
+class TestGoldenIoU:
+    @pytest.mark.parametrize("name", sorted(_IOU_CASES))
+    def test_mask_iou_matches_analytic(self, name):
+        from metrics_tpu.functional.detection.box_ops import mask_iou
+
+        case = _IOU_CASES[name]
+        a = _dense_from_counts(case["size"], case["a"]["counts"])
+        b = _dense_from_counts(case["size"], case["b"]["counts"])
+        assert int((a & b).sum()) == case["intersection"]
+        assert int((a | b).sum()) == case["union"]
+        got = float(np.asarray(mask_iou(jnp.asarray(a[None]), jnp.asarray(b[None])))[0, 0])
+        assert got == pytest.approx(case["iou"], abs=1e-6)
+
+
+class TestSegmMapGolden:
+    """Analytic segm-mAP anchors through the full metric."""
+
+    def _run(self, det_mask, gt_mask):
+        from metrics_tpu import MeanAveragePrecision
+
+        metric = MeanAveragePrecision(iou_type="segm")
+        metric.update(
+            [{
+                "masks": [rle_encode(det_mask)],
+                "scores": jnp.asarray([0.9]),
+                "labels": jnp.asarray([0]),
+            }],
+            [{"masks": [rle_encode(gt_mask)], "labels": jnp.asarray([0])}],
+        )
+        return float(metric.compute()["map"])
+
+    def test_perfect_prediction_is_one(self):
+        mask = _dense_from_counts([16, 16], [32, 64, 160])
+        assert self._run(mask, mask) == pytest.approx(1.0, abs=1e-6)
+
+    def test_052_overlap_matches_one_threshold(self):
+        """IoU = 13/25 = 0.52: above 0.50 only, so exactly one of the ten
+        COCO thresholds matches -> mAP 0.1 (values chosen away from
+        threshold-equality so float rounding cannot flip the comparison)."""
+        gt = _dense_from_counts([1, 25], [0, 19, 6])    # cols 0-18
+        det = _dense_from_counts([1, 25], [6, 19])      # cols 6-24
+        inter, union = 13, 25
+        assert int((gt & det).sum()) == inter and int((gt | det).sum()) == union
+        assert self._run(det, gt) == pytest.approx(0.1, abs=1e-6)
