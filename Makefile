@@ -1,0 +1,51 @@
+.PHONY: test test-fast test-full doctest dryrun bench bench-smoke sweep ci clean
+
+# All targets run offline against the already-installed environment
+# (jax/flax/optax/pytest are assumed present — no network access needed).
+# Mirrors the reference's Makefile test/doctest entry points
+# (`/root/reference/Makefile:22-25`) with the stages its CI matrix runs
+# (`/root/reference/.github/workflows/ci_test-full.yml:29-36`), adapted to
+# the TPU-native layout: the multichip stage is an 8-device virtual CPU mesh
+# dryrun rather than a 2-GPU pipeline.
+
+PY ?= python
+
+# Fast tier: everything not marked `slow` (see docs/testing.md). This is the
+# default developer loop; CI runs it before the full suite.
+test-fast:
+	$(PY) -m pytest tests -q -m "not slow"
+
+# Full tier: the complete suite, including the >15 s `slow` tests.
+test-full:
+	$(PY) -m pytest tests -q
+
+test: test-fast
+
+# Executable docstring examples for every exported symbol.
+doctest:
+	$(PY) -m pytest tests/test_doctests.py -q
+
+# Multi-chip SPMD validation: jit the full training step over an 8-device
+# mesh (dp=4 x tp=2) with real shardings, on virtual CPU devices.
+dryrun:
+	$(PY) -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+# Headline benchmark (one JSON line; runs on whatever jax backend is live).
+bench:
+	$(PY) bench.py
+
+# Quick structural check of the bench harness without the full timed runs.
+bench-smoke:
+	BENCH_SMOKE=1 $(PY) bench.py
+
+# Per-metric throughput sweep vs the reference baseline -> SWEEP.json
+sweep:
+	$(PY) tools/bench_sweep.py
+
+# What CI runs, in order (see .github/workflows/ci.yml).
+ci: doctest test-fast dryrun bench-smoke test-full
+
+clean:
+	rm -rf .pytest_cache tests/.pytest_cache .mypy_cache
+	rm -rf build dist *.egg-info
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

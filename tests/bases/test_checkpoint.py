@@ -94,3 +94,26 @@ class TestOrbaxRoundTrip:
         m.update(jnp.asarray(5.0))
         sd = m.state_dict()
         assert "kept" in sd and "dropped" not in sd
+
+
+def test_wrapper_persistent_recurses_divergence_pinned():
+    """Documented divergence (README ledger): `persistent()` recurses into
+    child metrics for EVERY wrapper here, so a wrapper's checkpoint carries
+    its children's states. The reference forwards the flag only from
+    CompositionalMetric (`src/torchmetrics/metric.py:893-897`) — there,
+    BootStrapper.persistent(True) would leave the bootstrap copies out of
+    state_dict."""
+    boot = mt.BootStrapper(mt.MeanMetric(), num_bootstraps=3)
+    boot.update(jnp.asarray([1.0, 2.0]))
+    boot.persistent(True)
+    sd = boot.state_dict()
+    # children's states present under dotted prefixes — the divergent behaviour
+    assert {f"metrics.{i}.{s}" for i in range(3) for s in ("value", "weight")} == set(sd), sorted(sd)
+    restored = mt.BootStrapper(mt.MeanMetric(), num_bootstraps=3)
+    restored.persistent(True)
+    restored.load_state_dict(sd)
+    restored._update_count = 1
+    for child in restored.metrics:
+        child._update_count = 1
+    out = restored.compute()
+    assert jnp.isfinite(out["mean"])

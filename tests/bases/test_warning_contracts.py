@@ -35,6 +35,15 @@ class TestBufferWarning:
         with _catch("will save all targets and predictions in buffer"):
             mt.UniversalImageQualityIndex()
 
+    def test_fid_warns_on_construction(self):
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            mt.FrechetInceptionDistance(feature=64, allow_random_weights=True)
+        messages = [str(w.message) for w in caught]
+        assert any("will save all extracted features in buffer" in m for m in messages)
+        # the random-weights waiver is its own load-bearing warning
+        assert any("NOT comparable to published numbers" in m for m in messages)
+
 
 class TestBatchedFallbackWarning:
     """Host-callback metrics cannot be traced under `lax.scan`; the batched

@@ -151,6 +151,7 @@ class TestInceptionScore:
         assert float(std) == pytest.approx(0.0, abs=1e-6)
 
 
+@pytest.mark.slow
 class TestLPIPS:
     def test_zero_for_identical(self):
         lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)

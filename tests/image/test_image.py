@@ -155,6 +155,7 @@ class TestSSIM(MetricTester):
 class TestMSSSIM(MetricTester):
     atol = 1e-4
 
+    @pytest.mark.slow
     def test_functional(self):
         self.run_functional_metric_test(
             _preds_big,

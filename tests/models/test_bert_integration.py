@@ -18,6 +18,8 @@ from transformers import BertConfig, BertTokenizerFast, FlaxBertModel  # noqa: E
 
 from metrics_tpu.functional.text.bert import bert_score  # noqa: E402
 
+pytestmark = pytest.mark.slow  # deep-coverage tier (see docs/testing.md)
+
 _WORDS = ["the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "slow"]
 
 

@@ -29,6 +29,8 @@ from convert_inception_weights import convert_state_dict  # noqa: E402
 
 from tests.helpers.torch_mirrors import TorchInceptionMirror, randomize_inception_  # noqa: E402
 
+pytestmark = pytest.mark.slow  # deep-coverage tier (see docs/testing.md)
+
 TAPS = ("64", "192", "768", "2048", "logits_unbiased", "logits")
 
 

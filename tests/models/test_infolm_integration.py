@@ -38,6 +38,7 @@ def tiny_mlm(tmp_path_factory):
     return FlaxBertForMaskedLM(cfg, seed=0), tokenizer
 
 
+@pytest.mark.slow
 def test_identical_sentences_zero_divergence(tiny_mlm):
     model, tokenizer = tiny_mlm
     sents = ["the cat sat on mat", "a dog ran fast"]

@@ -18,6 +18,8 @@ transformers = pytest.importorskip("transformers")
 
 from tests.helpers.reference_oracle import get_reference  # noqa: E402
 
+pytestmark = pytest.mark.slow  # deep-coverage tier (see docs/testing.md)
+
 _WORDS = ["the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "slow"]
 
 PREDS = ["the cat sat on mat", "a dog ran fast", "the mat sat"]

@@ -92,6 +92,7 @@ def _np_rprec(p, t):
 
 
 class TestFunctionalKernels:
+    @pytest.mark.slow
     def test_ap(self):
         for g in np.unique(_indexes):
             m = _indexes == g
@@ -162,6 +163,7 @@ class TestFunctionalKernels:
     ],
 )
 class TestRetrievalModules:
+    @pytest.mark.slow
     def test_module_vs_grouped_oracle(self, module_cls, np_fn):
         m = module_cls(empty_target_action="skip")
         half = N // 2

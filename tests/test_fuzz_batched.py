@@ -15,6 +15,8 @@ import pytest
 import metrics_tpu as mt
 from metrics_tpu.utils import checks
 
+pytestmark = pytest.mark.slow  # deep-coverage tier (see docs/testing.md)
+
 N_DRAWS = 12
 
 

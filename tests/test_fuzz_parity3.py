@@ -14,7 +14,8 @@ import torch
 from tests.helpers.reference_oracle import get_reference
 
 _ref = get_reference()
-pytestmark = pytest.mark.skipif(_ref is None, reason="reference mount unavailable")
+pytestmark = [pytest.mark.skipif(_ref is None, reason="reference mount unavailable"),
+              pytest.mark.slow]  # deep-coverage tier (see docs/testing.md)
 
 import metrics_tpu as mt  # noqa: E402
 
