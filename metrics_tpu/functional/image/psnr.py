@@ -28,15 +28,21 @@ def _psnr_update(
         # torch.sum(dim=()) reduces ALL dims, jnp.sum(axis=()) reduces none —
         # mirror the reference's explicit empty-dim branch
         # (`functional/image/psnr.py:84-85`): full reduction over numel
-        return jnp.sum(diff * diff), jnp.asarray(target.size)
+        # float32 count (not int): keeps a restored pre-change int32 `total`
+        # state from staying int32 through `total + n_obs` accumulation
+        return jnp.sum(diff * diff), jnp.asarray(float(target.size), dtype=jnp.float32)
     sum_squared_error = jnp.sum(diff * diff, axis=dim)
     count = 1
     for d in dim_list:
         count *= target.shape[d]
     # per-element observation counts, broadcast to the kept dims (reference
     # `functional/image/psnr.py` n_obs.expand_as) so streamed per-batch
-    # reductions concatenate consistently in the module's cat states
-    n_obs = jnp.full(sum_squared_error.shape, count, dtype=jnp.int32)
+    # reductions concatenate consistently in the module's cat states.
+    # float32 matches the division consumer and, unlike int32, holds exact
+    # integers to 2**24 per REDUCED ELEMENT and does not wrap beyond it
+    # (the reference builds int64 counts; int32 would silently overflow
+    # above 2**31 reduced-dim elements)
+    n_obs = jnp.full(sum_squared_error.shape, float(count), dtype=jnp.float32)
     return sum_squared_error, n_obs
 
 

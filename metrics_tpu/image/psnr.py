@@ -48,7 +48,11 @@ class PeakSignalNoiseRatio(Metric):
 
         if dim is None:
             self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+            # float32 count: int32 would WRAP (-> NaN PSNR) past 2**31 total
+            # pixels, a realistic long-stream volume; float32 rounds benignly
+            # (~1e-7 relative) past 2**24 instead. The reference uses int64,
+            # which jax only has under x64.
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
             self.add_state("total", default=[], dist_reduce_fx="cat")

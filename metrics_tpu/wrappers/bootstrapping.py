@@ -80,17 +80,21 @@ class BootStrapper(Metric):
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each."""
+        args_sizes = apply_to_collection(args, jax.Array, len)
+        kwargs_sizes = apply_to_collection(kwargs, jax.Array, len)
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = next(iter(kwargs_sizes.values()))
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
         for idx in range(self.num_bootstraps):
-            args_sizes = apply_to_collection(args, jax.Array, len)
-            kwargs_sizes = apply_to_collection(kwargs, jax.Array, len)
-            if len(args_sizes) > 0:
-                size = args_sizes[0]
-            elif len(kwargs_sizes) > 0:
-                size = next(iter(kwargs_sizes.values()))
-            else:
-                raise ValueError("None of the input contained tensors, so could not determine the sampling size")
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             if sample_idx.size == 0:
+                # an empty poisson draw still counts as this clone's update —
+                # without this, compute() would emit a spurious
+                # compute-before-update warning for the skipped clone
+                self.metrics[idx]._update_count += 1
                 continue
             new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
             new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
