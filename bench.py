@@ -331,13 +331,28 @@ def bench_dispatch_floor() -> dict:
     for _ in range(100):
         s = f(s)
     submission_ms = (time.perf_counter() - start) / 100 * 1000.0
+    jax.block_until_ready(s)
+    # steady-state per-PROGRAM cost of a minimal chained jitted step with the
+    # final sync amortized away: the floor under ANY eager loop that runs one
+    # program per step, however small the program
+    program_ms = float("inf")
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        for _ in range(200):
+            s = f(s)
+        jax.block_until_ready(s)
+        program_ms = min(program_ms, (time.perf_counter() - start) / 200 * 1000.0)
     sync_ms = float("inf")
     for _ in range(TRIALS):
         s = f(s)
         start = time.perf_counter()
         jax.block_until_ready(s)
         sync_ms = min(sync_ms, (time.perf_counter() - start) * 1000.0)
-    return {"submission_ms_per_dispatch": submission_ms, "sync_roundtrip_ms": sync_ms}
+    return {
+        "submission_ms_per_dispatch": submission_ms,
+        "sync_roundtrip_ms": sync_ms,
+        "program_roundtrip_ms": program_ms,
+    }
 
 
 MANY_STEPS = 32 if SMOKE else 4096  # larger chunks amortize the sync round
@@ -465,7 +480,6 @@ def main() -> None:
             "baseline": round(ref_overhead, 1),
             "baseline_hardware": "torch-cpu",
             "vs_baseline": ratio(ours_overhead_batched, ref_overhead),
-            "eager_steps_per_s": round(ours_overhead, 1),
             "sync_roundtrip_ms": round(floor["sync_roundtrip_ms"], 1),
             "submission_ms_per_dispatch": round(floor["submission_ms_per_dispatch"], 4),
             "note": (
@@ -474,6 +488,41 @@ def main() -> None:
                 "orders of magnitude above the torch-CPU whole step, which is "
                 "why any per-step-synchronizing eager loop is red here; "
                 "forward_many amortizes one sync across the chunk"
+            ),
+        },
+        "eager_per_step": {
+            # first-class tracked row (BASELINE.md "eager_per_step"): the
+            # reference-style one-metric(preds, target)-per-step loop.
+            "value": round(ours_overhead, 1),
+            "unit": "forward steps/s (eager fused single-dispatch forward)",
+            "baseline": round(ref_overhead, 1),
+            "baseline_hardware": "torch-cpu",
+            "vs_baseline": ratio(ours_overhead, ref_overhead),
+            # floor-bound evidence: the backend's steady per-program cost for
+            # a MINIMAL chained jitted step. eager cannot beat
+            # 1000/program_roundtrip_ms steps/s while it runs one program per
+            # step — when that ceiling is itself below the torch-CPU baseline,
+            # a >=1x eager target is structurally unreachable on this backend.
+            # floor_bound_factor = eager step time / minimal-program time; the
+            # excess over 1.0 is the metric's real state/value buffer traffic
+            # through the tunnel plus the python wrapper (~0.4 ms measured)
+            "program_roundtrip_ms": round(floor["program_roundtrip_ms"], 3),
+            "floor_steps_per_s_ceiling": round(1000.0 / floor["program_roundtrip_ms"], 1)
+            if floor["program_roundtrip_ms"] > 0
+            else None,
+            "floor_bound_factor": round(
+                (1000.0 / ours_overhead) / floor["program_roundtrip_ms"], 2
+            )
+            if ours_overhead > 0 and floor["program_roundtrip_ms"] > 0
+            else None,
+            "note": (
+                "bounded by the tunneled backend's per-program round trip, "
+                "not metric code: even an EMPTY chained program tops out at "
+                "floor_steps_per_s_ceiling steps/s — below the torch-CPU "
+                "baseline, so >=1x eager is structurally unreachable here. "
+                "Use forward_many/update_many (per_step_overhead row) to "
+                "amortize; on a locally-attached TPU the same eager path has "
+                "no tunnel in the loop"
             ),
         },
     }

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _should_value_check
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -45,6 +46,10 @@ class BaseAggregator(Metric):
     # value substituted for dropped NaNs when shapes must stay static (jit
     # tracing); the identity element of the subclass's reduction.
     _nan_neutral: float = 0.0
+    # True for aggregators whose state keeps the raw values themselves
+    # (CatMetric): masking cannot stand in for removal there, so nan handling
+    # needs the real value read.
+    _keeps_raw_values: bool = False
 
     def _cast_and_nan_check_input(
         self, x: Union[float, jax.Array], weight: Optional[Union[float, jax.Array]] = None
@@ -60,25 +65,54 @@ class BaseAggregator(Metric):
         state_dtype = self.value.dtype if not isinstance(self.value, list) else jnp.float32
         acc_dtype = state_dtype if jnp.issubdtype(state_dtype, jnp.floating) else jnp.float32
         x = jnp.asarray(x, dtype=acc_dtype)
-        weight = jnp.ones_like(x) if weight is None else jnp.broadcast_to(
-            jnp.asarray(weight, dtype=acc_dtype), x.shape
-        )
-        nans = jnp.isnan(x) | jnp.isnan(weight)
+        # weight stays None for the unweighted aggregators (Sum/Max/Min/Cat
+        # discard it) — materializing ones_like would be a wasted dispatch
+        # on every update
+        if weight is not None:
+            weight = jnp.broadcast_to(jnp.asarray(weight, dtype=acc_dtype), x.shape)
+        # `nans` is computed lazily inside the branches: the gated-off fast
+        # path must not submit even the isnan/or programs (each tiny dispatch
+        # is ~ms through a tunneled backend)
         is_tracer = isinstance(x, jax.core.Tracer) or isinstance(weight, jax.core.Tracer)
         if isinstance(self.nan_strategy, str):
-            if not is_tracer and bool(jnp.any(nans)):
-                if self.nan_strategy == "error":
-                    raise RuntimeError("Encounted `nan` values in tensor")
-                if self.nan_strategy == "warn":
-                    rank_zero_warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
-                x, weight = x[~nans], weight[~nans]
-            elif is_tracer and self.nan_strategy == "ignore":
+            if is_tracer or (self.nan_strategy == "ignore" and not self._keeps_raw_values):
+                # reduction aggregators drop nans by masking to the reduction
+                # identity with zero weight — pure device ops, no value read.
+                # (Traced error/warn cannot inspect values; they fall through.)
+                if self.nan_strategy == "ignore":
+                    nans = jnp.isnan(x) if weight is None else jnp.isnan(x) | jnp.isnan(weight)
+                    x = jnp.where(nans, self._nan_neutral, x)
+                    if weight is not None:
+                        weight = jnp.where(nans, 0.0, weight)
+            elif _should_value_check(x, x if weight is None else weight, key_extra=("agg-nan", self.nan_strategy)):
+                # `bool(jnp.any(...))` is a blocking device->host read (~100 ms
+                # per update through a tunnel), so it honors the validation
+                # mode: "full" (default) checks every update like the
+                # reference, "first" once per input signature, "off" never
+                nans = jnp.isnan(x) if weight is None else jnp.isnan(x) | jnp.isnan(weight)
+                if bool(jnp.any(nans)):
+                    if self.nan_strategy == "error":
+                        raise RuntimeError("Encounted `nan` values in tensor")
+                    if self.nan_strategy == "warn":
+                        rank_zero_warn("Encounted `nan` values in tensor. Will be removed.", UserWarning)
+                    x = x[~nans]
+                    if weight is not None:
+                        weight = weight[~nans]
+            elif self.nan_strategy == "warn" and not self._keeps_raw_values:
+                # check gated off: the warning is skipped but the VALUES stay
+                # reference-exact — masked removal equals filtered removal
+                # under every reduction
+                nans = jnp.isnan(x) if weight is None else jnp.isnan(x) | jnp.isnan(weight)
                 x = jnp.where(nans, self._nan_neutral, x)
-                weight = jnp.where(nans, 0.0, weight)
+                if weight is not None:
+                    weight = jnp.where(nans, 0.0, weight)
+            # "error" gated off appends raw: a nan then poisons the result
+            # visibly rather than being silently dropped
         else:
             x = jnp.where(jnp.isnan(x), float(self.nan_strategy), x)
-            weight = jnp.where(jnp.isnan(weight), float(self.nan_strategy), weight)
-        return x.reshape(-1), weight.reshape(-1)
+            if weight is not None:
+                weight = jnp.where(jnp.isnan(weight), float(self.nan_strategy), weight)
+        return x.reshape(-1), (None if weight is None else weight.reshape(-1))
 
     def update(self, value: Union[float, jax.Array]) -> None:  # noqa: D102
         raise NotImplementedError
@@ -168,6 +202,8 @@ class CatMetric(BaseAggregator):
         >>> metric.compute()
         Array([1., 2., 3.], dtype=float32)
     """
+
+    _keeps_raw_values = True  # cat state: masking is not removal
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)

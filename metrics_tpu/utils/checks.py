@@ -459,9 +459,17 @@ def _check_retrieval_inputs(
 
     # float relevance targets are allowed like the reference
     # (`utilities/checks.py:507-527`): the "binary" requirement constrains
-    # VALUES to [0, 1], not the dtype
-    if _is_concrete(target) and not allow_non_binary_target and target.size:
-        if float(target.max()) > 1 or float(target.min()) < 0:
+    # VALUES to [0, 1], not the dtype. The read is a blocking D2H sync
+    # (~100 ms/update through a tunnel), so it honors the validation mode:
+    # "first" checks once per input signature, "off" never
+    if (
+        _is_concrete(target)
+        and not allow_non_binary_target
+        and target.size
+        and _should_value_check(preds, target, key_extra=("retrieval", ignore_index))
+    ):
+        tmin, tmax = np.asarray(jnp.stack([target.min(), target.max()]))
+        if tmax > 1 or tmin < 0:
             raise ValueError("`target` must contain binary values")
 
     if target_is_float:
