@@ -1,0 +1,156 @@
+"""The validation mode governs EVERY value-dependent check.
+
+Round 4 gated the two remaining unconditional device->host reads — the
+retrieval binary-target bound and the aggregators' NaN inspection — behind
+`METRICS_TPU_VALIDATION` (each read costs a ~100 ms blocking sync through a
+tunneled backend; see docs/performance.md "Input validation cost"). These
+tests pin the mode contract for both: "full" = reference parity on every
+update, "first" = first update per input signature only, with values staying
+reference-exact for the reduction aggregators even when the check (and its
+warning) is gated off.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utils import checks
+
+
+@pytest.fixture()
+def mode():
+    """Set-and-restore validation mode, clearing the seen-signature cache."""
+    prev = checks._get_validation_mode()
+
+    def _set(value):
+        checks._seen_check_keys.clear()
+        checks.set_validation_mode(value)
+
+    yield _set
+    checks._seen_check_keys.clear()
+    checks.set_validation_mode(prev)
+
+
+class TestRetrievalBinaryBound:
+    BAD = (jnp.asarray([0.5, 0.2]), jnp.asarray([2, 0]), jnp.asarray([0, 0]))
+    OK = (jnp.asarray([0.5, 0.2]), jnp.asarray([1, 0]), jnp.asarray([0, 0]))
+
+    def test_full_mode_checks_every_update(self, mode):
+        mode("full")
+        m = mt.RetrievalMAP()
+        m.update(*self.OK)
+        with pytest.raises(ValueError, match="binary"):
+            m.update(*self.BAD)  # not the first update — still checked
+
+    def test_first_mode_checks_first_signature_only(self, mode):
+        mode("first")
+        m = mt.RetrievalMAP()
+        with pytest.raises(ValueError, match="binary"):
+            m.update(*self.BAD)  # first update of the signature: checked
+        m.update(*self.OK)
+        # same signature again, bad values: gated off by contract
+        m.update(*self.BAD)
+
+    def test_off_mode_never_checks(self, mode):
+        mode("off")
+        m = mt.RetrievalMAP()
+        m.update(*self.BAD)
+
+
+class TestAggregatorNanGate:
+    def test_full_mode_warns_every_update(self, mode):
+        mode("full")
+        m = mt.SumMetric()
+        for _ in range(2):
+            with pytest.warns(UserWarning, match="nan"):
+                m.update(jnp.asarray([1.0, float("nan")]))
+        assert float(m.compute()) == 2.0
+
+    def test_first_mode_values_stay_exact_without_warning(self, mode):
+        """The warning is gated off after the first signature, but masked
+        removal keeps the VALUES reference-exact for reduction aggregators."""
+        mode("first")
+        m = mt.SumMetric()
+        with pytest.warns(UserWarning, match="nan"):
+            m.update(jnp.asarray([1.0, float("nan")]))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            m.update(jnp.asarray([2.0, float("nan")]))  # no warning, no read
+        assert float(m.compute()) == 3.0  # nans dropped both times
+
+    @pytest.mark.parametrize(
+        "ctor, batches, expected",
+        [
+            (mt.MaxMetric, ([1.0, float("nan")], [5.0, float("nan")]), 5.0),
+            (mt.MinMetric, ([1.0, float("nan")], [-3.0, float("nan")]), -3.0),
+            (mt.MeanMetric, ([2.0, float("nan")], [4.0, float("nan")]), 3.0),
+        ],
+    )
+    def test_first_mode_masking_matches_removal(self, mode, ctor, batches, expected):
+        mode("first")
+        m = ctor()
+        with pytest.warns(UserWarning, match="nan"):
+            m.update(jnp.asarray(batches[0]))
+        m.update(jnp.asarray(batches[1]))  # gated off; masked on device
+        assert float(m.compute()) == pytest.approx(expected)
+
+    def test_cat_metric_gated_off_appends_raw(self, mode):
+        """Documented CatMetric divergence under "first": masking cannot
+        remove from a cat state, so later-batch NaNs pass through."""
+        mode("first")
+        m = mt.CatMetric()
+        with pytest.warns(UserWarning, match="nan"):
+            m.update(jnp.asarray([1.0, float("nan")]))  # first: removed
+        m.update(jnp.asarray([2.0, float("nan")]))  # gated: raw append
+        out = np.asarray(m.compute())
+        assert out[0] == 1.0 and out[1] == 2.0 and np.isnan(out[2])
+
+    def test_error_strategy_gated_off_poisons_not_drops(self, mode):
+        mode("off")
+        m = mt.SumMetric(nan_strategy="error")
+        m.update(jnp.asarray([1.0, float("nan")]))
+        assert np.isnan(float(m.compute()))  # visible, not silently dropped
+
+    def test_ignore_strategy_never_needs_the_read(self, mode):
+        mode("full")  # even in full mode, ignore is pure device masking
+        m = mt.MeanMetric(nan_strategy="ignore")
+        m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+        assert float(m.compute()) == pytest.approx(2.0)
+
+
+class TestFusedCountElision:
+    def test_mean_reduced_state_metric_keeps_count_path(self, mode):
+        """PSNR's data_range state reduces by 'mean' — the fused program must
+        keep the update_count argument and stay value-equal to eager."""
+        mode("first")
+        rng = np.random.RandomState(0)
+        p = jnp.asarray(rng.rand(2, 8, 8).astype(np.float32))
+        t = jnp.asarray(rng.rand(2, 8, 8).astype(np.float32))
+        fused = mt.PeakSignalNoiseRatio(data_range=1.0)
+        for _ in range(3):
+            fused(p, t)
+        assert fused._fused_needs_count is True
+        mode("full")
+        eager = mt.PeakSignalNoiseRatio(data_range=1.0)
+        for _ in range(3):
+            eager(p, t)
+        np.testing.assert_allclose(float(fused.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_sum_reduced_metric_elides_count(self, mode):
+        mode("first")
+        rng = np.random.RandomState(0)
+        p = jnp.asarray(rng.rand(64).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, 64))
+        fused = mt.Accuracy()
+        for _ in range(3):
+            fused(p, t)
+        assert fused._fused_needs_count is False
+        mode("full")
+        eager = mt.Accuracy()
+        for _ in range(3):
+            eager(p, t)
+        np.testing.assert_allclose(float(fused.compute()), float(eager.compute()), rtol=1e-6)
