@@ -74,6 +74,18 @@ class TestBatchedFallbackWarning:
         assert jnp.isfinite(stoi.compute())
 
 
+class TestEmptyCorpusWarning:
+    def test_bert_score_empty_inputs_warn(self):
+        """Empty preds+references warn and return the zero triple (reference
+        `functional/text/bert.py` emits the same text). The warning fires
+        before any model work, so placeholder model objects suffice."""
+        from metrics_tpu.functional.text.bert import bert_score
+
+        with _catch("Predictions and references are empty"):
+            out = bert_score([], [], model=object(), user_tokenizer=object())
+        assert out == {"precision": [0.0], "recall": [0.0], "f1": [0.0]}
+
+
 class TestComputeBeforeUpdateWarning:
     def test_compute_before_update_warns(self):
         m = mt.MeanMetric()
