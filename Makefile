@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-full doctest dryrun bench bench-smoke sweep ci clean
+.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep ci clean
 
 # All targets run offline against the already-installed environment
 # (jax/flax/optax/pytest are assumed present — no network access needed).
@@ -25,6 +25,11 @@ test: test-fast
 doctest:
 	$(PY) -m pytest tests/test_doctests.py -q
 
+# Documentation integrity (the reference builds sphinx here; our markdown
+# docs are validated instead: links + named in-repo files must resolve).
+docs:
+	$(PY) tools/check_docs.py
+
 # Multi-chip SPMD validation: jit the full training step over an 8-device
 # mesh (dp=4 x tp=2) with real shardings, on virtual CPU devices.
 dryrun:
@@ -43,7 +48,7 @@ sweep:
 	$(PY) tools/bench_sweep.py
 
 # What CI runs, in order (see .github/workflows/ci.yml).
-ci: doctest test-fast dryrun bench-smoke test-full
+ci: docs doctest test-fast dryrun bench-smoke test-full
 
 clean:
 	rm -rf .pytest_cache tests/.pytest_cache .mypy_cache

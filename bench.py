@@ -156,17 +156,24 @@ def _fid_data():
 
 def bench_fid_ours(real, fake) -> float:
     """Seconds per full FID cycle (2x2 batches of 16 images + compute)."""
+    import jax
     import jax.numpy as jnp
 
     from metrics_tpu.image.generative import FrechetInceptionDistance
 
     fid = FrechetInceptionDistance(feature=2048, allow_random_weights=True)
+    # pre-place like every other workload: generated images are model
+    # outputs already on device; timing their host->device transfer would
+    # measure tunnel latency, not the metric
+    real_d = [jnp.asarray(r) for r in real]
+    fake_d = [jnp.asarray(f) for f in fake]
+    jax.block_until_ready((real_d, fake_d))
 
     def cycle():
         fid.reset()
-        for r, f in zip(real, fake):
-            fid.update(jnp.asarray(r), real=True)
-            fid.update(jnp.asarray(f), real=False)
+        for r, f in zip(real_d, fake_d):
+            fid.update(r, real=True)
+            fid.update(f, real=False)
         return float(fid.compute())
 
     cycle()  # compile warmup

@@ -18,6 +18,7 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
 
@@ -26,9 +27,15 @@ _MAX_EXHAUSTIVE_SPK = 7
 
 
 @lru_cache(maxsize=None)
-def _permutation_table(spk_num: int) -> jax.Array:
-    """Cached [perm_num, spk] device table (the reference's `_ps_dict`, `pit.py:37-63`)."""
-    return jnp.asarray(list(permutations(range(spk_num))))
+def _permutation_table(spk_num: int) -> np.ndarray:
+    """Cached [perm_num, spk] table (the reference's `_ps_dict`, `pit.py:37-63`).
+
+    Host numpy on purpose: a ``jnp`` array created while a trace is active
+    (jit/eval_shape) would be a TRACER, and caching a tracer poisons every
+    later call (jax raises UnexpectedTracerError). numpy constants are
+    trace-independent and jnp ops consume them directly.
+    """
+    return np.asarray(list(permutations(range(spk_num))))
 
 
 def _find_best_perm_exhaustive(
@@ -36,7 +43,7 @@ def _find_best_perm_exhaustive(
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact assignment by evaluating every permutation in one gather."""
     spk_num = metric_mtx.shape[-1]
-    ps = _permutation_table(spk_num)  # [perm_num, spk]
+    ps = jnp.asarray(_permutation_table(spk_num))  # [perm_num, spk]
     # metric_of_ps[b, p] = mean_i mtx[b, i, ps[p, i]]
     gathered = metric_mtx[..., jnp.arange(spk_num)[None, :], ps]  # [batch, perm_num, spk]
     metric_of_ps = gathered.mean(axis=-1)
