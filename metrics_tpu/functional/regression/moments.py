@@ -9,8 +9,9 @@ from typing import Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _check_same_shape, _is_concrete, _should_value_check
 from metrics_tpu.utils.compute import _safe_xlogy
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -159,29 +160,49 @@ def _tweedie_deviance_score_update(preds, targets, power: float = 0.0) -> Tuple[
     if 0 < power < 1:
         raise ValueError(f"Deviance Score is not defined for power={power}.")
 
-    concrete = not (isinstance(preds, jax.core.Tracer) or isinstance(targets, jax.core.Tracer))
+    # domain validation reads values (one fused blocking D2H sync per call
+    # through a tunneled backend); it honors the validation mode like every
+    # other value-dependent check ("full" = every call, reference parity)
+    concrete = _is_concrete(preds, targets) and _should_value_check(
+        preds, targets, key_extra=("tweedie", power)
+    )
+
+    def _domain_flags():
+        # ONE fused program + one transfer for all four domain predicates
+        return np.asarray(
+            jnp.stack([jnp.any(preds <= 0), jnp.any(targets < 0), jnp.any(targets <= 0)])
+        )
+
     if power == 0:
         deviance_score = (targets - preds) ** 2
     elif power == 1:
-        if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
-            raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+        if concrete:
+            flags = _domain_flags()
+            if flags[0] or flags[1]:
+                raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
         deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
     elif power == 2:
-        if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
-            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        if concrete:
+            flags = _domain_flags()
+            if flags[0] or flags[2]:
+                raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
         deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)
     else:
         if power < 0:
-            if concrete and bool(jnp.any(preds <= 0)):
+            if concrete and _domain_flags()[0]:
                 raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
         elif 1 < power < 2:
-            if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
-                raise ValueError(
-                    f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
-                )
+            if concrete:
+                flags = _domain_flags()
+                if flags[0] or flags[1]:
+                    raise ValueError(
+                        f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+                    )
         else:
-            if concrete and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
-                raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+            if concrete:
+                flags = _domain_flags()
+                if flags[0] or flags[2]:
+                    raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
 
         term_1 = jnp.maximum(targets, 0) ** (2 - power) / ((1 - power) * (2 - power))
         term_2 = targets * preds ** (1 - power) / (1 - power)

@@ -11,6 +11,9 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.checks import _should_value_check
 
 
 def _check_retrieval_functional_inputs(preds, target, allow_non_binary_target: bool = False):
@@ -24,9 +27,17 @@ def _check_retrieval_functional_inputs(preds, target, allow_non_binary_target: b
     ):
         raise ValueError("`target` must be a tensor of booleans, integers or floats")
     # float relevance is allowed like the reference (`utilities/checks.py:507-527`):
-    # the "binary" requirement constrains VALUES to [0, 1], not the dtype
-    if not allow_non_binary_target and not isinstance(t, jax.core.Tracer) and t.size:
-        if float(t.max()) > 1 or float(t.min()) < 0:
+    # the "binary" requirement constrains VALUES to [0, 1], not the dtype.
+    # The read is one fused blocking D2H sync, gated by the validation mode
+    # (full = every call / first = once per signature / off = never)
+    if (
+        not allow_non_binary_target
+        and not isinstance(t, jax.core.Tracer)
+        and t.size
+        and _should_value_check(preds, t, key_extra=("retrieval-functional",))
+    ):
+        tmin, tmax = np.asarray(jnp.stack([t.min(), t.max()]))
+        if tmax > 1 or tmin < 0:
             raise ValueError("`target` must contain binary values")
     return jnp.asarray(preds, dtype=jnp.float32), t
 

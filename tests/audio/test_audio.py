@@ -309,3 +309,27 @@ def test_pesq_gated():
             from metrics_tpu import PerceptualEvaluationSpeechQuality
 
             PerceptualEvaluationSpeechQuality(8000, "nb")
+
+
+def test_pit_survives_abstract_trace_before_real_call():
+    """Regression: the lru-cached permutation table must be host numpy. A jnp
+    table built under an active trace (jax.eval_shape / jit) is a TRACER;
+    caching it poisoned every later real PIT call with
+    UnexpectedTracerError (found by the sweep's eval_shape mode probe)."""
+    import jax
+
+    from metrics_tpu.functional.audio.pit import _permutation_table
+
+    _permutation_table.cache_clear()
+    p = jnp.asarray(np.random.RandomState(0).randn(3, 2, 200).astype(np.float32))
+    t = jnp.asarray(np.random.RandomState(1).randn(3, 2, 200).astype(np.float32))
+
+    def fn(a, b):
+        return permutation_invariant_training(a, b, scale_invariant_signal_distortion_ratio, "max")[0]
+
+    # abstract trace FIRST (this is what populates the cache under a trace)
+    jax.eval_shape(fn, p, t)
+    # then the real call must still work and produce finite values
+    metric, perm = permutation_invariant_training(p, t, scale_invariant_signal_distortion_ratio, "max")
+    assert np.isfinite(np.asarray(metric)).all()
+    assert perm.shape == (3, 2)
