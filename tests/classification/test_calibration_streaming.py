@@ -27,8 +27,9 @@ def test_multibatch_equals_oneshot_all_norms():
 
 
 def test_empty_compute_raises():
-    with pytest.raises(ValueError, match="No samples"):
-        mt.CalibrationError().compute()
+    with pytest.warns(UserWarning, match="was called before the ``update``"):
+        with pytest.raises(ValueError, match="No samples"):
+            mt.CalibrationError().compute()
 
 
 def test_count_state_is_int32():

@@ -148,7 +148,7 @@ class TestRetrievalCurvesAreHostSide:
     )
     def test_eager_lifecycle(self, metric_cls, kwargs):
         metric = metric_cls(**kwargs)
-        indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1], jnp.int64)
+        indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])  # int32: x64 is off
         preds = jnp.asarray([0.9, 0.3, 0.5, 0.8, 0.2, 0.7, 0.4], jnp.float32)
         target = jnp.asarray([1, 0, 1, 0, 1, 1, 0], jnp.int32)
         metric.update(preds, target, indexes=indexes)
