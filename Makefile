@@ -43,7 +43,10 @@ bench:
 bench-smoke:
 	BENCH_SMOKE=1 $(PY) bench.py
 
-# Per-metric throughput sweep vs the reference baseline -> SWEEP.json
+# Per-metric throughput sweep vs the reference baseline -> SWEEP.json.
+# Round-over-round gate: python tools/sweep_regress.py OLD.json NEW.json
+# (compares vs-baseline ratios and jit/eager modes, not absolute updates/s —
+# absolute throughput swings 2-3x with tunnel latency).
 sweep:
 	$(PY) tools/bench_sweep.py
 

@@ -1,0 +1,73 @@
+"""Compare two per-metric sweep artifacts and flag regressions.
+
+Round-over-round gate for `tools/bench_sweep.py` output: absolute updates/s
+through the tunneled backend swing 2-3x run to run with tunnel latency, so
+the comparison is on the **vs-torch-CPU ratios** (both sides of a ratio move
+with the host, cancelling the machine's mood) and on mode changes (a jit row
+silently degrading to eager is a regression even at equal throughput).
+
+    python tools/sweep_regress.py SWEEP_r04.json SWEEP_r05.json
+    python tools/sweep_regress.py --threshold 2.5 old.json new.json
+
+Exit 1 when any metric's ratio worsened by more than ``threshold``x, a row's
+mode flipped jit->eager, or a previously-present metric disappeared.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def compare(old: dict, new: dict, threshold: float = 2.0) -> list:
+    old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
+    new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
+    problems = []
+    for name, old_row in old_rows.items():
+        new_row = new_rows.get(name)
+        if new_row is None:
+            problems.append(f"{name}: present in old sweep, missing from new")
+            continue
+        if old_row["mode"] == "jit" and new_row["mode"] != "jit":
+            problems.append(f"{name}: mode regressed jit -> {new_row['mode']}")
+        old_ratio, new_ratio = old_row.get("vs_baseline"), new_row.get("vs_baseline")
+        if old_ratio:
+            if not new_ratio:
+                # a collapsed (rounds-to-0) or vanished ratio IS the
+                # worst-case regression, not a row to skip
+                problems.append(
+                    f"{name}: vs_baseline {old_ratio} -> {new_ratio!r} (ratio lost or collapsed)"
+                )
+            elif old_ratio / new_ratio > threshold:
+                problems.append(
+                    f"{name}: vs_baseline {old_ratio} -> {new_ratio} ({old_ratio / new_ratio:.1f}x worse)"
+                )
+    return problems
+
+
+def main(argv) -> int:
+    threshold = 2.0
+    if "--threshold" in argv:
+        i = argv.index("--threshold")
+        try:
+            threshold = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: sweep_regress.py [--threshold X] OLD.json NEW.json")
+            return 2
+        argv = argv[:i] + argv[i + 2 :]
+    if len(argv) != 2:
+        print("usage: sweep_regress.py [--threshold X] OLD.json NEW.json")
+        return 2
+    with open(argv[0]) as f_old, open(argv[1]) as f_new:
+        old, new = json.load(f_old), json.load(f_new)
+    problems = compare(old, new, threshold)
+    if problems:
+        print("\n".join(problems))
+        print(f"\n{len(problems)} sweep regression(s) beyond {threshold}x")
+        return 1
+    n = len([r for r in new["rows"] if "updates_per_s" in r])
+    print(f"sweep ok: {n} rows, no ratio regression beyond {threshold}x, no mode downgrades")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
