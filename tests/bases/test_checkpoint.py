@@ -103,7 +103,10 @@ def test_wrapper_persistent_recurses_divergence_pinned():
     CompositionalMetric (`src/torchmetrics/metric.py:893-897`) — there,
     BootStrapper.persistent(True) would leave the bootstrap copies out of
     state_dict."""
-    boot = mt.BootStrapper(mt.MeanMetric(), num_bootstraps=3)
+    # multinomial: every clone draws exactly n samples, so no clone can get
+    # an empty draw (poisson's unseeded empty draws made clone means NaN
+    # depending on suite ordering)
+    boot = mt.BootStrapper(mt.MeanMetric(), num_bootstraps=3, sampling_strategy="multinomial")
     boot.update(jnp.asarray([1.0, 2.0]))
     boot.persistent(True)
     sd = boot.state_dict()
