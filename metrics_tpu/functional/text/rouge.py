@@ -50,21 +50,20 @@ def _rouge_tokenize(text: str, stemmer=None, normalizer=None, tokenizer=None) ->
     return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
 
 
-def _pr_f(hits: int, pred_len: int, target_len: int) -> Dict[str, jax.Array]:
+def _pr_f(hits: int, pred_len: int, target_len: int) -> Dict[str, float]:
+    # host-pure floats: one jnp scalar per (sentence x key x field) would
+    # dispatch ~768 device programs per 64-sentence update through a remote
+    # backend; conversion happens once per update/compute instead
     precision = hits / pred_len if pred_len > 0 else 0.0
     recall = hits / target_len if target_len > 0 else 0.0
     if precision + recall > 0:
         fmeasure = 2 * precision * recall / (precision + recall)
     else:
         fmeasure = 0.0
-    return {
-        "precision": jnp.asarray(precision, dtype=jnp.float32),
-        "recall": jnp.asarray(recall, dtype=jnp.float32),
-        "fmeasure": jnp.asarray(fmeasure, dtype=jnp.float32),
-    }
+    return {"precision": precision, "recall": recall, "fmeasure": fmeasure}
 
 
-def _rouge_n_score(pred: List[str], target: List[str], n_gram: int) -> Dict[str, jax.Array]:
+def _rouge_n_score(pred: List[str], target: List[str], n_gram: int) -> Dict[str, float]:
     def _ngrams(tokens: List[str]) -> Counter:
         return Counter(tuple(tokens[i : i + n_gram]) for i in range(len(tokens) - n_gram + 1))
 
@@ -99,7 +98,7 @@ def _lcs_length(pred: List[str], target: List[str]) -> int:
     return int(prev[n])
 
 
-def _rouge_l_score(pred: List[str], target: List[str]) -> Dict[str, jax.Array]:
+def _rouge_l_score(pred: List[str], target: List[str]) -> Dict[str, float]:
     lcs = _lcs_length(pred, target)
     return _pr_f(lcs, len(pred), len(target))
 
@@ -109,7 +108,7 @@ def _split_sentences(x: str) -> List[str]:
     return [s for s in re.split(r"\n", x) if len(s) > 0]
 
 
-def _rouge_lsum_score(pred: str, target: str, stemmer=None, normalizer=None, tokenizer=None) -> Dict[str, jax.Array]:
+def _rouge_lsum_score(pred: str, target: str, stemmer=None, normalizer=None, tokenizer=None) -> Dict[str, float]:
     """Summary-level LCS: union-LCS over sentence pairs (rouge_score convention)."""
     pred_sents = [_rouge_tokenize(s, stemmer, normalizer, tokenizer) for s in _split_sentences(pred)]
     target_sents = [_rouge_tokenize(s, stemmer, normalizer, tokenizer) for s in _split_sentences(target)]
@@ -175,14 +174,14 @@ def _rouge_score_update(
     stemmer=None,
     normalizer=None,
     tokenizer=None,
-) -> Dict[Union[int, str], List[Dict[str, jax.Array]]]:
-    results: Dict[Union[int, str], List[Dict[str, jax.Array]]] = {rk: [] for rk in rouge_keys_values}
+) -> Dict[Union[int, str], List[Dict[str, float]]]:
+    results: Dict[Union[int, str], List[Dict[str, float]]] = {rk: [] for rk in rouge_keys_values}
     for pred_raw, target_raw_list in zip(preds, target):
-        per_ref: List[Dict[Union[int, str], Dict[str, jax.Array]]] = []
+        per_ref: List[Dict[Union[int, str], Dict[str, float]]] = []
         pred_tokens = _rouge_tokenize(pred_raw, stemmer, normalizer, tokenizer)
         for target_raw in target_raw_list:
             tgt_tokens = _rouge_tokenize(target_raw, stemmer, normalizer, tokenizer)
-            scores_for_ref: Dict[Union[int, str], Dict[str, jax.Array]] = {}
+            scores_for_ref: Dict[Union[int, str], Dict[str, float]] = {}
             for rouge_key in rouge_keys_values:
                 if isinstance(rouge_key, int):
                     score = _rouge_n_score(pred_tokens, tgt_tokens, rouge_key)
@@ -203,13 +202,25 @@ def _rouge_score_update(
         else:  # avg
             for rouge_key in rouge_keys_values:
                 scores = [r[rouge_key] for r in per_ref]
-                avg = {k: jnp.mean(jnp.stack([s[k] for s in scores])) for k in ("precision", "recall", "fmeasure")}
+                avg = {
+                    k: sum(float(s[k]) for s in scores) / len(scores)
+                    for k in ("precision", "recall", "fmeasure")
+                }
                 results[rouge_key].append(avg)
     return results
 
 
-def _rouge_score_compute(sentence_results: Dict[str, List[jax.Array]]) -> Dict[str, jax.Array]:
-    return {k: jnp.mean(jnp.stack(v)) if v else jnp.asarray(0.0) for k, v in sentence_results.items()}
+def _rouge_score_compute(sentence_results: Dict[str, List]) -> Dict[str, jax.Array]:
+    """Mean per key over per-sentence scores (floats or batched arrays)."""
+    out: Dict[str, jax.Array] = {}
+    for k, v in sentence_results.items():
+        if not v:
+            out[k] = jnp.asarray(0.0)
+        elif isinstance(v[0], (int, float)):
+            out[k] = jnp.mean(jnp.asarray(v, dtype=jnp.float32))
+        else:  # module states: one (batch,) array appended per update call
+            out[k] = jnp.mean(jnp.concatenate([jnp.atleast_1d(x) for x in v]))
+    return out
 
 
 def rouge_score(

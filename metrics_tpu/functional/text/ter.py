@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu import native
+
 _MAX_SHIFT_SIZE = 10
 _MAX_SHIFT_DIST = 50
 _MAX_SHIFT_CANDIDATES = 1000
@@ -86,23 +88,40 @@ class _TercomTokenizer:
         return sentence
 
 
+def _edit_distance_only(pred: Sequence[int], ref: Sequence[int]) -> int:
+    """Word edit distance without the alignment backtrack.
+
+    The shift-search gain loop only needs the distance, so the O(m*n) table
+    fill runs in the native C++ kernel when available (the python fallback
+    shares `_edit_distance_with_alignment`'s table). ``ref`` is already an
+    int32 array in the hot loop (asarray is then a no-op)."""
+    if native.available():
+        return native.levenshtein(np.asarray(pred, np.int32), np.asarray(ref, np.int32))
+    return _edit_distance_with_alignment(pred, ref)[0]
+
+
 def _edit_distance_with_alignment(
-    pred: List[str], ref: List[str]
+    pred: List[int], ref: List[int]
 ) -> Tuple[int, Dict[int, int], List[int], List[int]]:
-    """Word edit distance + optimal-path alignment.
+    """Word edit distance + optimal-path alignment (tokens are interned ids).
 
     Returns (distance, alignment ref_idx->pred_idx, ref_errors, pred_errors)
     where the error lists flag positions touched by a non-match operation along
-    one optimal path.
+    one optimal path. The table fill uses the native C++ kernel when available;
+    the backtrack is O(m+n) python either way.
     """
     m, n = len(pred), len(ref)
-    d = np.zeros((m + 1, n + 1), dtype=np.int32)
-    d[:, 0] = np.arange(m + 1)
-    d[0, :] = np.arange(n + 1)
-    for i in range(1, m + 1):
-        for j in range(1, n + 1):
-            cost = 0 if pred[i - 1] == ref[j - 1] else 1
-            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1, d[i - 1, j - 1] + cost)
+    d = None
+    if native.available():
+        d = native.levenshtein_matrix(np.asarray(pred, np.int32), np.asarray(ref, np.int32))
+    if d is None:
+        d = np.zeros((m + 1, n + 1), dtype=np.int32)
+        d[:, 0] = np.arange(m + 1)
+        d[0, :] = np.arange(n + 1)
+        for i in range(1, m + 1):
+            for j in range(1, n + 1):
+                cost = 0 if pred[i - 1] == ref[j - 1] else 1
+                d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1, d[i - 1, j - 1] + cost)
 
     alignments: Dict[int, int] = {}
     pred_errors = [0] * m
@@ -128,7 +147,7 @@ def _edit_distance_with_alignment(
     return int(d[m, n]), alignments, ref_errors, pred_errors
 
 
-def _matching_spans(pred: List[str], ref: List[str]) -> Iterator[Tuple[int, int, int]]:
+def _matching_spans(pred: List[int], ref: Sequence[int]) -> Iterator[Tuple[int, int, int]]:
     """(pred_start, ref_start, length) of equal word spans within shift range."""
     for pred_start in range(len(pred)):
         for ref_start in range(len(ref)):
@@ -144,7 +163,7 @@ def _matching_spans(pred: List[str], ref: List[str]) -> Iterator[Tuple[int, int,
                     break
 
 
-def _apply_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
+def _apply_shift(words: List[int], start: int, length: int, target: int) -> List[int]:
     if target < start:
         return words[:target] + words[start : start + length] + words[target:start] + words[start + length :]
     if target > start + length:
@@ -158,12 +177,12 @@ def _apply_shift(words: List[str], start: int, length: int, target: int) -> List
 
 
 def _best_shift(
-    pred: List[str], ref: List[str], checked_candidates: int
-) -> Tuple[int, List[str], int]:
+    pred: List[int], ref: Sequence[int], checked_candidates: int
+) -> Tuple[int, List[int], int]:
     """One round of Tercom shift search: returns (gain, shifted_words, n_checked)."""
     base_distance, alignments, ref_errors, pred_errors = _edit_distance_with_alignment(pred, ref)
 
-    best: Optional[Tuple[int, int, int, int, List[str]]] = None
+    best: Optional[Tuple[int, int, int, int, List[int]]] = None
     for pred_start, ref_start, length in _matching_spans(pred, ref):
         # skip if the pred span is already fully correct, or the ref span
         # already matches, or the shift would land inside its own span
@@ -187,7 +206,7 @@ def _best_shift(
             prev_idx = idx
 
             shifted = _apply_shift(pred, pred_start, length, idx)
-            gain = base_distance - _edit_distance_with_alignment(shifted, ref)[0]
+            gain = base_distance - _edit_distance_only(shifted, ref)
             candidate = (gain, length, -pred_start, -idx, shifted)
             checked_candidates += 1
             if best is None or candidate[:4] > best[:4]:
@@ -204,6 +223,13 @@ def _translation_edit_rate(pred: List[str], ref: List[str]) -> float:
     """Minimum (shifts + edits) against one reference."""
     if len(ref) == 0:
         return 0.0
+    # intern words to dense ids once: every comparison below (span matching,
+    # DP cells, native kernels) runs on ints instead of strings. pred stays
+    # a list (shifts permute it); ref is invariant across all candidates, so
+    # it stays the int32 array — the per-candidate asarray in
+    # `_edit_distance_only` is then a no-op
+    pred_ids, ref = native.intern_ids(pred, ref)
+    pred = pred_ids.tolist()
     num_shifts = 0
     checked = 0
     words = pred

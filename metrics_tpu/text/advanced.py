@@ -98,10 +98,14 @@ class ROUGEScore(Metric):
         output = _rouge_score_update(
             preds, target, self.rouge_keys_values, self.accumulate, self.stemmer, self.normalizer, self.tokenizer
         )
+        # one (batch,) device constant per (key, field) per update — the
+        # per-sentence scores are host floats (see functional `_pr_f`)
         for rouge_key, metrics in output.items():
-            for metric in metrics:
-                for tp, value in metric.items():
-                    getattr(self, f"rouge{rouge_key}_{tp}").append(value)
+            if not metrics:
+                continue
+            for tp in ("fmeasure", "precision", "recall"):
+                vals = [float(metric[tp]) for metric in metrics]
+                getattr(self, f"rouge{rouge_key}_{tp}").append(jnp.asarray(vals, dtype=jnp.float32))
 
     def compute(self) -> Dict[str, jax.Array]:
         update_output = {

@@ -10,6 +10,7 @@ from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
@@ -58,7 +59,9 @@ class MultioutputWrapper(Metric):
         self.squeeze_outputs = squeeze_outputs
 
     def _get_args_kwargs_by_output(self, *args: jax.Array, **kwargs: jax.Array) -> List[Tuple]:
-        args_kwargs_by_output = []
+        # column slices + per-column NaN masks, all async device programs
+        per_column: List[Tuple[List, dict]] = []
+        masks: List[Optional[jax.Array]] = []
         for i in range(len(self.metrics)):
             selected_args = apply_to_collection(
                 args, jax.Array, jnp.take, indices=jnp.asarray([i]), axis=self.output_dim
@@ -66,12 +69,29 @@ class MultioutputWrapper(Metric):
             selected_kwargs = apply_to_collection(
                 kwargs, jax.Array, jnp.take, indices=jnp.asarray([i]), axis=self.output_dim
             )
-            if self.remove_nans:
-                tensors = list(selected_args) + list(selected_kwargs.values())
-                if tensors:
-                    nan_idxs = _get_nan_indices(*tensors)
-                    selected_args = [arg[~nan_idxs] for arg in selected_args]
-                    selected_kwargs = {k: v[~nan_idxs] for k, v in selected_kwargs.items()}
+            tensors = list(selected_args) + list(selected_kwargs.values())
+            masks.append(_get_nan_indices(*tensors) if self.remove_nans and tensors else None)
+            per_column.append((list(selected_args), dict(selected_kwargs)))
+
+        # NaN-row removal makes the output shape data-dependent, so each
+        # boolean-mask gather would force its own blocking device->host sync
+        # (~100 ms each through a remote backend). Instead: ONE stacked read
+        # for every column's mask, then static-index gathers (async) — and no
+        # gather at all for columns without NaNs (the common case).
+        host_masks = None
+        if any(m is not None for m in masks):
+            host_masks = np.asarray(jnp.stack([m for m in masks if m is not None]))
+
+        args_kwargs_by_output = []
+        mask_pos = 0
+        for (selected_args, selected_kwargs), mask in zip(per_column, masks):
+            if mask is not None:
+                host_mask = host_masks[mask_pos]
+                mask_pos += 1
+                if host_mask.any():
+                    keep = np.flatnonzero(~host_mask)
+                    selected_args = [arg[keep] for arg in selected_args]
+                    selected_kwargs = {k: v[keep] for k, v in selected_kwargs.items()}
             if self.squeeze_outputs:
                 selected_args = [jnp.squeeze(arg, axis=self.output_dim) for arg in selected_args]
                 selected_kwargs = {k: jnp.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}

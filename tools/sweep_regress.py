@@ -2,9 +2,12 @@
 
 Round-over-round gate for `tools/bench_sweep.py` output: absolute updates/s
 through the tunneled backend swing 2-3x run to run with tunnel latency, so
-the comparison is on the **vs-torch-CPU ratios** (both sides of a ratio move
-with the host, cancelling the machine's mood) and on mode changes (a jit row
-silently degrading to eager is a regression even at equal throughput).
+the comparison is on the **vs-torch-CPU ratios** and on mode changes (a jit
+row silently degrading to eager is a regression even at equal throughput).
+The ratios themselves still carry noise: two same-code runs measured ratio
+swings up to ~4x on individual rows (the torch-CPU reference arm is
+host-contention-sensitive, our arm tunnel-latency-sensitive), so the default
+threshold sits at 5x — it catches collapses and mode flips, not weather.
 
     python tools/sweep_regress.py SWEEP_r04.json SWEEP_r05.json
     python tools/sweep_regress.py --threshold 2.5 old.json new.json
@@ -18,7 +21,7 @@ import json
 import sys
 
 
-def compare(old: dict, new: dict, threshold: float = 2.0) -> list:
+def compare(old: dict, new: dict, threshold: float = 5.0) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
     problems = []
@@ -45,7 +48,7 @@ def compare(old: dict, new: dict, threshold: float = 2.0) -> list:
 
 
 def main(argv) -> int:
-    threshold = 2.0
+    threshold = 5.0
     if "--threshold" in argv:
         i = argv.index("--threshold")
         try:
