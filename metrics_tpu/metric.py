@@ -348,7 +348,10 @@ class Metric(ABC):
         per-step forward hot path.
         """
         if self._fusable_cached is None:
-            self._fusable_cached = not any(
+            # a metric with NO own states (child-holding wrappers) must never
+            # count as fusable: its exported update is an empty-state no-op
+            # that XLA dead-code-eliminates, silently dropping child updates
+            self._fusable_cached = bool(self._defaults) and not any(
                 isinstance(v, list) for v in self._defaults.values()
             ) and all(
                 self._reduction_specs[name] in ("sum", "mean", "max", "min") for name in self._defaults
@@ -960,6 +963,17 @@ class Metric(ABC):
         collective (psum/pmax/all_gather) — the TPU-native replacement for the
         reference's ``_sync_dist`` gather path.
         """
+        if not self._defaults and self._named_child_metrics():
+            # child-holding wrappers register no states of their own — the
+            # base export would be an empty state dict whose update XLA
+            # dead-code-eliminates, silently dropping every child update
+            raise NotImplementedError(
+                f"{type(self).__name__} holds its state in child metrics; the base "
+                "export would produce an empty state dict and a no-op update. "
+                "Export the wrapped metric's as_functions() directly, or use a "
+                "wrapper that provides its own export (ClasswiseWrapper; "
+                "MultioutputWrapper(remove_nans=False))."
+            )
         template = self._bare_clone()
 
         def init() -> Dict[str, Any]:

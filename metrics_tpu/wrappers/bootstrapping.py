@@ -119,5 +119,15 @@ class BootStrapper(Metric):
             m.reset()
         super().reset()
 
+    def as_functions(self) -> tuple:
+        """Not exportable: each update draws fresh host-side bootstrap
+        indices (numpy RNG), so the update is not a pure function of
+        ``(state, batch)``."""
+        raise NotImplementedError(
+            "BootStrapper resamples with host-side numpy RNG per update and is not "
+            "a pure function of its inputs; export the base metric's as_functions() "
+            "and drive resampled batches from your own PRNG instead."
+        )
+
 
 __all__ = ["BootStrapper"]

@@ -54,5 +54,16 @@ class ClasswiseWrapper(Metric):
     def reset(self) -> None:
         self.metric.reset()
 
+    def as_functions(self) -> tuple:
+        """Pure export: the wrapper adds no state of its own, so the kernels
+        are the wrapped metric's with the compute labeled per class — the
+        whole update jits exactly like the bare metric."""
+        init, update_fn, child_compute = self.metric.as_functions()
+
+        def compute_fn(state, axis_name=None):
+            return self._convert(child_compute(state, axis_name=axis_name))
+
+        return init, update_fn, compute_fn
+
 
 __all__ = ["ClasswiseWrapper"]

@@ -56,6 +56,16 @@ class MinMaxMetric(Metric):
         super().reset()
         self._base_metric.reset()
 
+    def as_functions(self) -> tuple:
+        """Not exportable: ``compute`` MUTATES the running min/max (reference
+        semantics — extrema advance per compute call), which the pure
+        ``(init, update, compute)`` contract cannot express."""
+        raise NotImplementedError(
+            "MinMaxMetric tracks extrema ACROSS compute() calls (stateful compute); "
+            "export the wrapped metric's as_functions() and track min/max of the "
+            "computed values in your evaluation loop instead."
+        )
+
     @staticmethod
     def _is_suitable_val(val: Any) -> bool:
         if isinstance(val, (int, float)):

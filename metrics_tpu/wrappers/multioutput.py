@@ -121,5 +121,34 @@ class MultioutputWrapper(Metric):
             metric.reset()
         super().reset()
 
+    def as_functions(self) -> tuple:
+        """Pure export over ``{output_i: child_state}`` when shapes are static.
+
+        ``remove_nans=True`` (the reference's default) filters rows by a NaN
+        mask — a data-dependent shape jit cannot trace — so only
+        ``remove_nans=False`` instances export."""
+        if self.remove_nans:
+            raise NotImplementedError(
+                "MultioutputWrapper(remove_nans=True) filters rows by a data-dependent "
+                "NaN mask and cannot be traced; construct with remove_nans=False for "
+                "the pure export (see docs/performance.md 'Data-dependent shapes')."
+            )
+        subs = [m.as_functions() for m in self.metrics]
+
+        def init():
+            return {f"output_{i}": fns[0]() for i, fns in enumerate(subs)}
+
+        def update_fn(state, *args, **kwargs):
+            columns = self._get_args_kwargs_by_output(*args, **kwargs)
+            return {
+                f"output_{i}": subs[i][1](state[f"output_{i}"], *col_args, **col_kwargs)
+                for i, (col_args, col_kwargs) in enumerate(columns)
+            }
+
+        def compute_fn(state, axis_name=None):
+            return [fns[2](state[f"output_{i}"], axis_name=axis_name) for i, fns in enumerate(subs)]
+
+        return init, update_fn, compute_fn
+
 
 __all__ = ["MultioutputWrapper"]

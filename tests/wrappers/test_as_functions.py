@@ -1,0 +1,113 @@
+"""Pure-function export contract for wrapper metrics.
+
+Child-holding wrappers register no states of their own, so the base
+``as_functions`` export would be an empty state dict whose update XLA
+dead-code-eliminates — every export here must either compose the child
+kernels (ClasswiseWrapper, MultioutputWrapper without NaN removal) or raise
+with guidance (stateful-compute MinMax, host-RNG BootStrapper, tracker).
+The reference has no functional counterpart for wrappers; the module-API
+behavior these exports must match is `wrappers/*.py` (reference
+`classwise.py:8-78`, `multioutput.py:24-145`).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanSquaredError,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
+
+_rng = np.random.RandomState(7)
+
+
+class TestClasswiseExport:
+    def test_matches_module_api(self):
+        preds = jnp.asarray(_rng.rand(64, 3).astype(np.float32))
+        target = jnp.asarray(_rng.randint(0, 3, 64))
+
+        module = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+        module.update(preds, target)
+        expected = module.compute()
+
+        init, upd, cmp = ClasswiseWrapper(Accuracy(num_classes=3, average=None)).as_functions()
+        state = jax.jit(upd)(init(), preds, target)
+        got = cmp(state)
+        assert set(got) == set(expected)
+        for key in expected:
+            np.testing.assert_allclose(np.asarray(got[key]), np.asarray(expected[key]), atol=1e-6)
+
+    def test_labels_respected(self):
+        wrapper = ClasswiseWrapper(Accuracy(num_classes=2, average=None), labels=["cat", "dog"])
+        init, upd, cmp = wrapper.as_functions()
+        state = upd(init(), jnp.asarray([0, 1]), jnp.asarray([0, 0]))
+        assert set(cmp(state)) == {"accuracy_cat", "accuracy_dog"}
+
+    def test_update_is_jittable_with_donation(self):
+        init, upd, _ = ClasswiseWrapper(Accuracy(num_classes=3, average=None)).as_functions()
+        fused = jax.jit(upd, donate_argnums=(0,))
+        state = fused(init(), jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        state = fused(state, jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        assert state  # non-empty: the child's states flow through
+
+
+class TestMultioutputExport:
+    def test_matches_module_api(self):
+        preds = jnp.asarray(_rng.rand(32, 4).astype(np.float32))
+        target = jnp.asarray(_rng.rand(32, 4).astype(np.float32))
+
+        module = MultioutputWrapper(MeanSquaredError(), num_outputs=4, remove_nans=False)
+        module.update(preds, target)
+        expected = [float(v) for v in module.compute()]
+
+        init, upd, cmp = MultioutputWrapper(
+            MeanSquaredError(), num_outputs=4, remove_nans=False
+        ).as_functions()
+        state = jax.jit(upd)(init(), preds, target)
+        got = [float(v) for v in cmp(state)]
+        np.testing.assert_allclose(got, expected, atol=1e-6)
+
+    def test_remove_nans_raises(self):
+        with pytest.raises(NotImplementedError, match="remove_nans"):
+            MultioutputWrapper(MeanSquaredError(), num_outputs=2).as_functions()
+
+    def test_streaming_accumulation(self):
+        init, upd, cmp = MultioutputWrapper(
+            MeanSquaredError(), num_outputs=2, remove_nans=False
+        ).as_functions()
+        fused = jax.jit(upd, donate_argnums=(0,))
+        p1 = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        t1 = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        p2 = jnp.asarray([[0.0, 0.0], [0.0, 0.0]])
+        t2 = jnp.asarray([[2.0, 2.0], [2.0, 2.0]])
+        state = fused(init(), p1, t1)
+        state = fused(state, p2, t2)
+        vals = [float(v) for v in cmp(state)]
+        np.testing.assert_allclose(vals, [2.0, 2.0], atol=1e-6)
+
+
+class TestNonExportableWrappersRaise:
+    def test_minmax_raises_with_guidance(self):
+        with pytest.raises(NotImplementedError, match="stateful compute"):
+            MinMaxMetric(Accuracy()).as_functions()
+
+    def test_bootstrapper_raises_with_guidance(self):
+        with pytest.raises(NotImplementedError, match="RNG"):
+            BootStrapper(MeanSquaredError(), num_bootstraps=4).as_functions()
+
+    def test_tracker_has_no_export(self):
+        # MetricTracker is a bookkeeping container, not a Metric subclass —
+        # there is deliberately no as_functions surface to misuse
+        assert not hasattr(MetricTracker(Accuracy()), "as_functions")
+
+    def test_child_holding_wrappers_are_not_fusable(self):
+        # defense-in-depth: an empty-state wrapper must never look fusable to
+        # the fused-forward machinery (a fused no-op would drop child updates)
+        assert not MinMaxMetric(Accuracy())._fusable_states()
+        assert not BootStrapper(MeanSquaredError())._fusable_states()
