@@ -16,19 +16,20 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
 
 
-def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None):
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None) -> np.ndarray:
     """Resampling indices for one bootstrap draw (reference `:26-47`).
 
-    Host-side randomness: bootstrap draws are part of the evaluation harness,
-    not the jitted compute path, so numpy RNG keeps the API free of explicit
-    PRNG-key plumbing.
+    Host-side randomness AND a host-side result: bootstrap draws are part of
+    the evaluation harness, not the jitted compute path, so numpy RNG keeps
+    the API free of explicit PRNG-key plumbing — and the caller slices the
+    host array freely before anything touches the device.
     """
     rng = rng or np.random
     if sampling_strategy == "poisson":
         p = rng.poisson(1, size=size)
-        return jnp.asarray(np.repeat(np.arange(size), p))
+        return np.repeat(np.arange(size), p)
     if sampling_strategy == "multinomial":
-        return jnp.asarray(rng.randint(0, size, size=size))
+        return rng.randint(0, size, size=size)
     raise ValueError("Unknown sampling strategy")
 
 
@@ -79,7 +80,18 @@ class BootStrapper(Metric):
         self._rng = np.random.RandomState()
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Resample the batch per bootstrap clone and update each."""
+        """Resample the batch per bootstrap clone and update each.
+
+        Poisson draws have a different length almost every time, and XLA
+        compiles one program per novel shape — fed whole, each draw forces a
+        fresh take+update compile (measured 0.1 updates/s through a remote
+        backend). Every draw is therefore split into power-of-two chunks
+        (order-preserving consecutive slices), bounding the compile cache to
+        ~log2(N) shapes; streaming equivalence of chunked updates is the
+        framework's core invariant (reduce-state commutes with batch
+        concatenation), pinned suite-wide by the multi-batch differential
+        tests. Multinomial draws are already fixed-shape and go whole.
+        """
         args_sizes = apply_to_collection(args, jax.Array, len)
         kwargs_sizes = apply_to_collection(kwargs, jax.Array, len)
         if len(args_sizes) > 0:
@@ -96,9 +108,23 @@ class BootStrapper(Metric):
                 # compute-before-update warning for the skipped clone
                 self.metrics[idx]._update_count += 1
                 continue
-            new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
-            new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
-            self.metrics[idx].update(*new_args, **new_kwargs)
+            update_count_before = self.metrics[idx]._update_count
+            offset, remaining = 0, int(sample_idx.size)
+            while remaining:
+                # multinomial draws always have the input's (static) length —
+                # one whole-batch program; only poisson needs the chunking
+                chunk_len = remaining if self.sampling_strategy == "multinomial" else 1 << (remaining.bit_length() - 1)
+                # host-side slice, then ONE transfer of a power-of-two-sized
+                # index array: the take+update programs are keyed only by
+                # chunk length, never by the draw's total length or offset
+                chunk = jnp.asarray(sample_idx[offset : offset + chunk_len])
+                new_args = apply_to_collection(args, jax.Array, jnp.take, chunk, axis=0)
+                new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, chunk, axis=0)
+                self.metrics[idx].update(*new_args, **new_kwargs)
+                offset += chunk_len
+                remaining -= chunk_len
+            # one draw = one update, however many chunks carried it
+            self.metrics[idx]._update_count = update_count_before + 1
 
     def compute(self) -> Dict[str, jax.Array]:
         """mean/std/quantile/raw over the bootstrap distribution."""

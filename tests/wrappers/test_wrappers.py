@@ -47,6 +47,34 @@ class TestBootStrapper:
         with pytest.raises(ValueError, match="sampling_strategy"):
             BootStrapper(SumMetric(), sampling_strategy="bogus")
 
+    @pytest.mark.parametrize("strategy", ["poisson", "multinomial"])
+    def test_chunked_update_equals_one_shot_draw(self, strategy):
+        # the wrapper splits poisson draws into power-of-two chunks (bounded
+        # compile cache); the result must equal feeding each FULL draw to a
+        # fresh clone in one update — same seed, same indices, same numbers
+        from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+        p = jnp.asarray(_rng.rand(100).astype(np.float32))  # non-power-of-two
+        t = jnp.asarray(_rng.rand(100).astype(np.float32))
+
+        boot = BootStrapper(MeanSquaredError(), num_bootstraps=3, raw=True, sampling_strategy=strategy)
+        boot._rng = np.random.RandomState(1234)
+        boot.update(p, t)
+        boot.update(p, t)
+        chunked = np.asarray(boot.compute()["raw"])
+
+        rng = np.random.RandomState(1234)
+        clones = [MeanSquaredError() for _ in range(3)]
+        for _ in range(2):  # two updates, draw order matches the wrapper's
+            for clone in clones:
+                idx = jnp.asarray(_bootstrap_sampler(100, strategy, rng))
+                if idx.size:
+                    clone.update(jnp.take(p, idx), jnp.take(t, idx))
+        expected = np.asarray([np.asarray(c.compute()) for c in clones])
+        np.testing.assert_allclose(chunked, expected, atol=1e-6)
+        # chunking is bookkept as ONE update per draw
+        assert all(m._update_count == 2 for m in boot.metrics)
+
 
 class TestClasswiseWrapper:
     def test_names_and_values(self):
