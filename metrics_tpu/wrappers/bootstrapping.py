@@ -110,21 +110,27 @@ class BootStrapper(Metric):
                 continue
             update_count_before = self.metrics[idx]._update_count
             offset, remaining = 0, int(sample_idx.size)
-            while remaining:
-                # multinomial draws always have the input's (static) length —
-                # one whole-batch program; only poisson needs the chunking
-                chunk_len = remaining if self.sampling_strategy == "multinomial" else 1 << (remaining.bit_length() - 1)
-                # host-side slice, then ONE transfer of a power-of-two-sized
-                # index array: the take+update programs are keyed only by
-                # chunk length, never by the draw's total length or offset
-                chunk = jnp.asarray(sample_idx[offset : offset + chunk_len])
-                new_args = apply_to_collection(args, jax.Array, jnp.take, chunk, axis=0)
-                new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, chunk, axis=0)
-                self.metrics[idx].update(*new_args, **new_kwargs)
-                offset += chunk_len
-                remaining -= chunk_len
-            # one draw = one update, however many chunks carried it
-            self.metrics[idx]._update_count = update_count_before + 1
+            try:
+                while remaining:
+                    # multinomial draws always have the input's (static)
+                    # length — one whole-batch program; only poisson needs
+                    # the chunking
+                    chunk_len = remaining if self.sampling_strategy == "multinomial" else 1 << (remaining.bit_length() - 1)
+                    # host-side slice, then ONE transfer of a power-of-two-
+                    # sized index array: the take+update programs are keyed
+                    # only by chunk length, never by the draw's total length
+                    # or offset
+                    chunk = jnp.asarray(sample_idx[offset : offset + chunk_len])
+                    new_args = apply_to_collection(args, jax.Array, jnp.take, chunk, axis=0)
+                    new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, chunk, axis=0)
+                    self.metrics[idx].update(*new_args, **new_kwargs)
+                    offset += chunk_len
+                    remaining -= chunk_len
+            finally:
+                # one draw = one update, however many chunks carried it — and
+                # however many completed before a child update raised (the
+                # count must not stay inflated if the caller catches + retries)
+                self.metrics[idx]._update_count = update_count_before + 1
 
     def compute(self) -> Dict[str, jax.Array]:
         """mean/std/quantile/raw over the bootstrap distribution."""

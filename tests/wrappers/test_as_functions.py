@@ -16,6 +16,7 @@ import pytest
 
 from metrics_tpu import (
     Accuracy,
+    MetricCollection,
     BootStrapper,
     ClasswiseWrapper,
     MeanSquaredError,
@@ -90,6 +91,26 @@ class TestMultioutputExport:
         state = fused(state, p2, t2)
         vals = [float(v) for v in cmp(state)]
         np.testing.assert_allclose(vals, [2.0, 2.0], atol=1e-6)
+
+
+class TestCollectionWithWrapperMembers:
+    def test_collection_with_classwise_member_exports(self):
+        coll = MetricCollection(
+            {"acc": Accuracy(num_classes=3), "cw": ClasswiseWrapper(Accuracy(num_classes=3, average=None))}
+        )
+        init, upd, cmp = coll.as_functions()
+        p = jnp.asarray(_rng.rand(32, 3).astype(np.float32))
+        t = jnp.asarray(_rng.randint(0, 3, 32))
+        out = cmp(jax.jit(upd)(init(), p, t))
+        assert "acc" in out and any(k.startswith("accuracy_") for k in out)
+
+    def test_collection_with_minmax_member_raises_from_export(self):
+        coll = MetricCollection({"acc": Accuracy(num_classes=3), "mm": MinMaxMetric(Accuracy(num_classes=3))})
+        with pytest.raises(NotImplementedError, match="stateful compute"):
+            coll.as_functions()
+        # the module API is unaffected: eager fan-out still works
+        coll.update(jnp.asarray(_rng.rand(8, 3).astype(np.float32)), jnp.asarray(_rng.randint(0, 3, 8)))
+        assert set(coll.compute()) >= {"acc", "raw", "max", "min"}
 
 
 class TestNonExportableWrappersRaise:

@@ -1,11 +1,14 @@
 """Per-metric update-throughput sweep across the device-path metric suite.
 
 The BASELINE.md target "metric.update()/sec/chip over the 80-metric suite",
-as a harness: every listed metric gets synthetic data, its `as_functions`
-update jitted (donated state), and a steady-state samples/sec measurement —
-one JSON line each, plus a summary line. Host-side metrics (text, detection)
-are excluded: their cost is host string/matching work benchmarked separately
-in `tools/bench_extended.py`.
+as a harness covering the FULL exported surface: device-path metrics run
+their `as_functions` update jitted (donated state) or the eager module
+update (cat states), host-side text metrics run the same update-only
+protocol on the host (both sides are string processing), and wrappers run
+around same-named bases — one JSON line each, plus a summary line whose
+`not_swept` map enumerates everything a sweep row cannot measure and where
+its cost IS measured (model-backed metrics, detection mAP:
+`tools/bench_extended.py` and bench.py).
 
     python tools/bench_sweep.py            # current default backend
     JAX_PLATFORMS=cpu python tools/bench_sweep.py
@@ -268,7 +271,7 @@ OUTLIER_NOTES = {
     "RetrievalRecallAtFixedPrecision": "append-only update both sides; ratio reflects tunnel dispatch overhead",
     "MinMaxMetric(Accuracy)": "wrapper state lives in the child metric, so the update runs the eager module protocol; ratio reflects tunnel dispatch overhead when below 1x",
     "ClasswiseWrapper(Accuracy)": "the wrapper's own as_functions composes the child kernels (labeling happens at compute), so the update is the child's fused jit program; the reference fans out eagerly",
-    "BootStrapper(MeanSquaredError)": "poisson draws are split into power-of-two chunks (bounded compile cache — 8-13 ms/update steady-state in a fresh session, vs 10 s/update when every draw recompiled) but still run ~10 chunk programs x 4 clones per step against torch-CPU's zero dispatch cost, so the row sits at the tunnel session's per-program floor; the multinomial row is the single-program static-shape configuration (docs/performance.md)",
+    "BootStrapper(MeanSquaredError)": "poisson draws are split into power-of-two chunks (bounded compile cache — 8-19 ms/update steady-state in a fresh session, vs 10 s/update when every draw recompiled) but still run ~10 chunk programs x 4 clones per step against torch-CPU's zero dispatch cost, so the row sits at the tunnel session's per-program floor; the multinomial row is the single-program static-shape configuration (docs/performance.md)",
     "BootStrapper(MeanSquaredError,multinomial)": "static-shape resampling: every draw reuses one compiled take+update program per clone; ratio reflects tunnel dispatch overhead when below 1x",
     "MultioutputWrapper(MeanSquaredError)": "remove_nans=True makes output shapes data-dependent: one blocking mask read per update (the remote backend's ~100ms sync floor) vs torch-CPU's free in-process read; all per-column gathers are async behind that single read",
     # host-side text rows: both sides are host string processing; large
