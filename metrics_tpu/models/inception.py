@@ -194,7 +194,42 @@ def _jitted_apply(model: "InceptionV3", params: Any, imgs: jax.Array) -> Dict[st
     return model.apply(params, imgs)
 
 
-class InceptionV3Extractor:
+class LazyParamsPickleExtractor:
+    """Shared extractor plumbing: lazy random-init + pickle-safe forward.
+
+    Subclasses set ``self._params`` (None = lazy), ``self._seed``, and
+    ``self._forward`` in ``__init__`` and implement ``_init_params`` /
+    ``_make_forward``. The random fallback initializes on first parameter
+    access (a full backbone init costs up to ~1 min on one CPU core — metric
+    construction must not pay it before the first input arrives), and the
+    jitted-apply partial — an unpicklable function object — is dropped and
+    rebuilt across pickling so model-backed metrics checkpoint like any
+    other metric.
+    """
+
+    def _init_params(self) -> Any:
+        raise NotImplementedError
+
+    def _make_forward(self) -> Callable:
+        raise NotImplementedError
+
+    @property
+    def params(self) -> Any:
+        if self._params is None:
+            self._params = self._init_params()
+        return self._params
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        state.pop("_forward", None)
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._forward = self._make_forward()
+
+
+class InceptionV3Extractor(LazyParamsPickleExtractor):
     """Callable imgs → [N, d] features, the ``NoTrainInceptionV3`` analogue.
 
     Accepts NCHW uint8 (0-255) or float images, resizes to 299×299, rescales
@@ -216,20 +251,30 @@ class InceptionV3Extractor:
             )
         if npz_path is not None:
             params = params_from_npz(npz_path)
-        dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
-        if params is None:
-            params = self.model.init(jax.random.PRNGKey(seed), dummy)
-        else:
+        if params is not None:
             from metrics_tpu.models.manifest import validate_params
 
             validate_params(
                 params,
                 self.model,
-                (dummy,),
+                (jnp.zeros((1, 299, 299, 3), jnp.float32),),
                 "python tools/convert_inception_weights.py <torch-fidelity .pth> out.npz",
             )
-        self.params = params
-        self._forward = functools.partial(_jitted_apply, self.model)
+        # supplied weights are validated above; the RANDOM fallback stays
+        # lazy — a full flax init of InceptionV3 costs ~1 min on one CPU
+        # core, and metric construction (FID/KID/IS) must not pay it before
+        # the first image arrives
+        self._params = params
+        self._seed = seed
+        self._forward = self._make_forward()
+
+    def _init_params(self) -> Any:
+        return self.model.init(
+            jax.random.PRNGKey(self._seed), jnp.zeros((1, 299, 299, 3), jnp.float32)
+        )
+
+    def _make_forward(self) -> Callable:
+        return functools.partial(_jitted_apply, self.model)
 
     def __call__(self, imgs: jax.Array) -> jax.Array:
         imgs = jnp.asarray(imgs)

@@ -21,6 +21,8 @@ import jax
 from metrics_tpu.utils.compute import high_precision
 import jax.numpy as jnp
 
+from metrics_tpu.models.inception import LazyParamsPickleExtractor
+
 import flax.linen as nn
 
 # input normalization constants from the published LPIPS scaling layer
@@ -149,7 +151,7 @@ def _jitted_apply(model: "LPIPSNet", params: Any, img1: jax.Array, img2: jax.Arr
     return model.apply(params, img1, img2)
 
 
-class LPIPSExtractor:
+class LPIPSExtractor(LazyParamsPickleExtractor):
     """Callable ``(img1, img2) → [N]`` LPIPS scores (NCHW inputs in [-1, 1])."""
 
     def __init__(self, net_type: str = "alex", params: Any = None, npz_path: str = None, seed: int = 0) -> None:
@@ -166,20 +168,28 @@ class LPIPSExtractor:
             from metrics_tpu.models.inception import params_from_npz
 
             params = params_from_npz(npz_path)
-        dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
-        if params is None:
-            params = self.model.init(jax.random.PRNGKey(seed), dummy, dummy)
-        else:
+        if params is not None:
             from metrics_tpu.models.manifest import validate_params
 
+            dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
             validate_params(
                 params,
                 self.model,
                 (dummy, dummy),
                 f"python tools/convert_lpips_weights.py {net_type} <lpips .pth> out.npz",
             )
-        self.params = params
-        self._forward = functools.partial(_jitted_apply, self.model)
+        # supplied weights are validated above; lazy random fallback + pickle
+        # rebuild come from LazyParamsPickleExtractor
+        self._params = params
+        self._seed = seed
+        self._forward = self._make_forward()
+
+    def _init_params(self) -> Any:
+        dummy = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        return self.model.init(jax.random.PRNGKey(self._seed), dummy, dummy)
+
+    def _make_forward(self) -> Any:
+        return functools.partial(_jitted_apply, self.model)
 
     def __call__(self, img1: jax.Array, img2: jax.Array) -> jax.Array:
         img1 = jnp.transpose(jnp.asarray(img1), (0, 2, 3, 1))

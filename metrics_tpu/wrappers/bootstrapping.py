@@ -126,10 +126,15 @@ class BootStrapper(Metric):
                     self.metrics[idx].update(*new_args, **new_kwargs)
                     offset += chunk_len
                     remaining -= chunk_len
-            finally:
-                # one draw = one update, however many chunks carried it — and
-                # however many completed before a child update raised (the
-                # count must not stay inflated if the caller catches + retries)
+            except Exception:
+                # match the base Metric's failure contract: a raising update
+                # does not count (chunked state ingestion is non-atomic — rows
+                # from completed chunks remain, as they would for any metric
+                # whose update mutated state before raising)
+                self.metrics[idx]._update_count = update_count_before
+                raise
+            else:
+                # one draw = one update, however many chunks carried it
                 self.metrics[idx]._update_count = update_count_before + 1
 
     def compute(self) -> Dict[str, jax.Array]:

@@ -190,6 +190,7 @@ class TestLPIPSLayout:
         omitted = set(man) - set(mirror_state)
         assert all(k.startswith(("scaling_layer.", "lins.")) for k in omitted)
 
+    @pytest.mark.slow  # ~17s/net: builds a full synthetic checkpoint + eval_shape validation
     @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
     def test_converter_accepts_real_layout(self, net_type):
         """convert_state_dict over the full real-layout LPIPS state dict must

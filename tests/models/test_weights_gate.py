@@ -130,3 +130,39 @@ def test_params_and_npz_path_conflict_raises(tmp_path):
         LPIPSExtractor(net_type="squeeze", params={"params": {}}, npz_path=str(path))
     with pytest.raises(ValueError, match="not both"):
         InceptionV3Extractor(feature="64", params={"params": {}}, npz_path=str(path))
+
+
+class TestExtractorPickle:
+    """Model-backed metrics checkpoint via pickle like any other metric —
+    the jitted-apply partial is dropped and rebuilt across the round trip,
+    and a lazy (not-yet-initialized) random-weights extractor stays lazy."""
+
+    def test_fid_pickles_while_lazy(self):
+        import pickle
+
+        with pytest.warns(UserWarning, match="NOT comparable"):
+            fid = mt.FrechetInceptionDistance(feature=64, allow_random_weights=True)
+        clone = pickle.loads(pickle.dumps(fid))  # params still lazy: tiny payload
+        assert clone.inception._params is None
+        assert callable(clone.inception._forward)  # rebuilt on load
+
+    @pytest.mark.slow  # materializes the full InceptionV3 random init (~40s on one core)
+    def test_fid_pickles_after_first_use(self):
+        import pickle
+
+        with pytest.warns(UserWarning, match="NOT comparable"):
+            fid = mt.FrechetInceptionDistance(feature=64, allow_random_weights=True)
+        imgs = np.random.RandomState(0).randint(0, 255, (2, 3, 32, 32), dtype=np.uint8)
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        again = pickle.loads(pickle.dumps(fid))  # now with materialized params
+        assert again.inception._params is not None
+        assert float(again.compute()) == pytest.approx(0.0, abs=1e-3)
+
+    def test_lpips_extractor_pickle_round_trip(self):
+        import pickle
+
+        ex = LPIPSExtractor(net_type="alex")
+        clone = pickle.loads(pickle.dumps(ex))
+        a = jnp.asarray(np.random.RandomState(1).rand(1, 3, 64, 64).astype(np.float32) * 2 - 1)
+        np.testing.assert_allclose(np.asarray(clone(a, a)), np.asarray(ex(a, a)), atol=1e-6)

@@ -47,6 +47,25 @@ class TestBootStrapper:
         with pytest.raises(ValueError, match="sampling_strategy"):
             BootStrapper(SumMetric(), sampling_strategy="bogus")
 
+    def test_raising_child_update_does_not_count(self):
+        # base-Metric failure contract: an update that raises is not counted,
+        # so a caller that catches and retries does not double-count the draw
+        class Exploding(MeanSquaredError):
+            calls = 0
+
+            def update(self, p, t):
+                Exploding.calls += 1
+                if Exploding.calls >= 3:  # raise mid-chunk-loop
+                    raise RuntimeError("boom")
+                super().update(p, t)
+
+        boot = BootStrapper(Exploding(), num_bootstraps=1, sampling_strategy="poisson")
+        boot._rng = np.random.RandomState(0)
+        p = jnp.asarray(_rng.rand(100).astype(np.float32))
+        with pytest.raises(RuntimeError, match="boom"):
+            boot.update(p, p)
+        assert boot.metrics[0]._update_count == 0
+
     @pytest.mark.parametrize("strategy", ["poisson", "multinomial"])
     def test_chunked_update_equals_one_shot_draw(self, strategy):
         # the wrapper splits poisson draws into power-of-two chunks (bounded
