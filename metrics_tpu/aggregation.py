@@ -9,10 +9,10 @@ from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.checks import _should_value_check
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -52,7 +52,10 @@ class BaseAggregator(Metric):
     _keeps_raw_values: bool = False
 
     def _cast_and_nan_check_input(
-        self, x: Union[float, jax.Array], weight: Optional[Union[float, jax.Array]] = None
+        self,
+        x: Union[float, jax.Array],
+        weight: Optional[Union[float, jax.Array]] = None,
+        force_value_check: Optional[bool] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         """Cast to float and apply the NaN strategy (to values AND weights).
 
@@ -84,7 +87,11 @@ class BaseAggregator(Metric):
                     x = jnp.where(nans, self._nan_neutral, x)
                     if weight is not None:
                         weight = jnp.where(nans, 0.0, weight)
-            elif _should_value_check(x, x if weight is None else weight, key_extra=("agg-nan", self.nan_strategy)):
+            elif (
+                force_value_check
+                if force_value_check is not None
+                else _should_value_check(x, x if weight is None else weight, key_extra=("agg-nan", self.nan_strategy))
+            ):
                 # `bool(jnp.any(...))` is a blocking device->host read (~100 ms
                 # per update through a tunnel), so it honors the validation
                 # mode: "full" (default) checks every update like the
@@ -209,13 +216,30 @@ class CatMetric(BaseAggregator):
         super().__init__("cat", [], nan_strategy, **kwargs)
 
     def update(self, value: Union[float, jax.Array]) -> None:
-        value, _ = self._cast_and_nan_check_input(value)
+        # raw-row buffering: when the (validation-mode-gated) NaN check is off
+        # for this signature, the cast/flatten dispatches are deferred to
+        # observation time and update is a bare list append
+        if not isinstance(value, (jax.Array, np.ndarray)):
+            value = np.asarray(value, dtype=np.float32)
+        needs_check = (
+            isinstance(value, jax.core.Tracer)
+            or not isinstance(self.nan_strategy, str)
+            or _should_value_check(value, value, key_extra=("agg-nan", self.nan_strategy))
+        )
+        if needs_check:
+            value, _ = self._cast_and_nan_check_input(value, force_value_check=True)
         if value.size:
             self.value.append(value)
 
+    def _canonicalize_list_states(self) -> None:
+        if not isinstance(self.value, list):
+            return  # post-sync "cat" reduction left one bare canonical array
+        for i, v in enumerate(self.value):
+            self.value[i] = v.reshape(-1).astype(np.float32)
+
     def compute(self) -> jax.Array:
         if isinstance(self.value, list) and self.value:
-            return dim_zero_cat(self.value)
+            return jnp.concatenate([jnp.ravel(jnp.asarray(v)) for v in self.value]).astype(jnp.float32)
         return self.value
 
 

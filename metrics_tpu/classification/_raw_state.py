@@ -1,0 +1,62 @@
+"""Raw-row buffering shared by the exact-curve module family.
+
+The exact curve metrics (PrecisionRecallCurve / ROC / AUROC /
+AveragePrecision — reference `classification/precision_recall_curve.py`,
+`roc.py`, `auroc.py`, `avg_precision.py`) accumulate every score as
+list ("cat") states. The reference canonicalizes per update; through a
+remote TPU backend those per-step reshape/cast dispatches cost hundreds of
+µs each (docs/performance.md), so here ``update`` appends the RAW inputs —
+a ~1 µs list append — after metadata-only validation, and the layout
+transform runs at observation time:
+
+- ``compute``: one concat per state, then ONE formatting program over the
+  concatenated array (the transform commutes with batch concatenation —
+  pinned by ``tests/bases/test_raw_state_deferral.py``);
+- sync / ``state_dict`` / pickling: per-row via
+  :meth:`Metric._canonicalize_list_states` (rows must share rank for the
+  pad-to-max gather protocol, and checkpoints keep the canonical layout).
+
+Rows of heterogeneous trailing shape (a multidim extra dim that varies
+across batches) cannot concat raw; those fall back to per-row
+canonicalization first.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class _RawPairStateMixin:
+    """Deferred canonicalization for metrics buffering raw (preds, target) rows.
+
+    Subclasses define ``_format_row(preds, target) -> (preds, target)``, the
+    idempotent canonical per-row transform.
+    """
+
+    def _format_row(self, preds, target) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    def _canonicalize_list_states(self) -> None:
+        if not isinstance(self.preds, list):
+            # post-sync: the "cat" reduction already concatenated the rows
+            # into one bare (canonical) array — nothing to canonicalize
+            return
+        for i in range(len(self.preds)):
+            self.preds[i], self.target[i] = self._format_row(self.preds[i], self.target[i])
+
+    def _cat_raw(self) -> Tuple[jax.Array, jax.Array]:
+        """Concatenate buffered rows, canonicalizing per row only if shapes force it."""
+        if not isinstance(self.preds, list):
+            return self.preds, self.target
+        if (
+            len({tuple(p.shape[1:]) for p in self.preds}) > 1
+            or len({tuple(t.shape[1:]) for t in self.target}) > 1
+        ):
+            self._canonicalize_list_states()
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+
+__all__ = ["_RawPairStateMixin"]

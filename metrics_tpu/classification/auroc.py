@@ -5,13 +5,13 @@ from typing import Any, Optional
 
 import jax
 
+from metrics_tpu.classification._raw_state import _RawPairStateMixin
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import AverageMethod
 
 
-class AUROC(Metric):
+class AUROC(_RawPairStateMixin, Metric):
     """Area under the ROC curve from accumulated scores.
 
     Example:
@@ -55,7 +55,9 @@ class AUROC(Metric):
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds, target) -> None:
-        preds, target, mode = _auroc_update(preds, target)
+        # raw-row buffering: mode resolution + validation here, layout
+        # transform deferred to observation time (see `_raw_state.py`)
+        preds, target, mode = _auroc_update(preds, target, format_tensors=False)
         self.preds.append(preds)
         self.target.append(target)
         if self.mode and self.mode != mode:
@@ -65,6 +67,10 @@ class AUROC(Metric):
             )
         self.mode = mode
 
+    def _format_row(self, preds, target):
+        p, t, _ = _auroc_update(preds, target)
+        return p, t
+
     def compute(self) -> jax.Array:
         # preds may be a list of per-batch arrays OR a bare array (post-sync
         # cat states are reduced to one array) — guard emptiness explicitly
@@ -73,13 +79,13 @@ class AUROC(Metric):
         )
         if not self.mode and not have_data:
             raise RuntimeError("You have to have determined mode.")
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        mode = self.mode
-        if not mode:
-            # state restored in a fresh process: re-derive the data mode from
-            # the stored arrays (the formatter is idempotent on its own output)
-            _, _, mode = _auroc_update(preds, target)
+        if isinstance(self.preds, (list, tuple)):
+            preds, target = self._cat_raw()
+        else:
+            preds, target = self.preds, self.target
+        # one formatting program over the concatenated arrays (also re-derives
+        # the mode when the state was restored in a fresh process)
+        preds, target, mode = _auroc_update(preds, target)
         return _auroc_compute(
             preds, target, mode, self.num_classes, self.pos_label, self.average, self.max_fpr
         )

@@ -5,16 +5,16 @@ from typing import Any, List, Optional, Union
 
 import jax
 
+from metrics_tpu.classification._raw_state import _RawPairStateMixin
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
-from metrics_tpu.functional.classification.precision_recall_curve import _rederive_curve_hparams
+from metrics_tpu.functional.classification.precision_recall_curve import _precision_recall_curve_update
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import dim_zero_cat
 
 
-class AveragePrecision(Metric):
+class AveragePrecision(_RawPairStateMixin, Metric):
     """Average precision from accumulated scores.
 
     Example:
@@ -50,19 +50,26 @@ class AveragePrecision(Metric):
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds, target) -> None:
+        # raw-row buffering: metadata-only validation here, layout transform
+        # deferred to observation time (see `_raw_state.py`)
         preds, target, num_classes, pos_label = _average_precision_update(
-            preds, target, self.num_classes, self.pos_label, self.average
+            preds, target, self.num_classes, self.pos_label, self.average, format_tensors=False
         )
         self.preds.append(preds)
         self.target.append(target)
         self.num_classes = num_classes
         self.pos_label = pos_label
 
+    def _format_row(self, preds, target):
+        p, t, _, _ = _precision_recall_curve_update(
+            preds, target, self.num_classes, self.pos_label, warn=False
+        )
+        return p, t
+
     def compute(self) -> Union[jax.Array, List[jax.Array]]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        preds, target, num_classes, pos_label = _rederive_curve_hparams(
-            preds, target, self.num_classes, self.pos_label
+        preds, target = self._cat_raw()
+        preds, target, num_classes, pos_label = _precision_recall_curve_update(
+            preds, target, self.num_classes, self.pos_label, warn=False
         )
         return _average_precision_compute(preds, target, num_classes, pos_label, self.average)
 

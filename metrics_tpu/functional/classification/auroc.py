@@ -13,22 +13,31 @@ import numpy as np
 
 from metrics_tpu.functional.classification.auc import _auc_compute_without_check
 from metrics_tpu.functional.classification.roc import roc
-from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.checks import _classification_case
 from metrics_tpu.utils.data import _bincount
 from metrics_tpu.utils.enums import AverageMethod, DataType
 
 
-def _auroc_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array, DataType]:
-    _, _, mode = _input_format_classification(preds, target)
+def _auroc_update(
+    preds: jax.Array, target: jax.Array, format_tensors: bool = True
+) -> Tuple[jax.Array, jax.Array, DataType]:
+    """Resolve the input mode and (optionally) flatten the extra dims.
 
-    if mode == DataType.MULTIDIM_MULTICLASS:
+    ``format_tensors=False`` validates and returns the raw tensors — the
+    module path buffers raw rows and defers the layout transform (which
+    commutes with batch concatenation) to observation time. The transform
+    uses array methods, so host rows stay host arrays.
+    """
+    mode = _classification_case(preds, target)
+
+    if format_tensors and mode == DataType.MULTIDIM_MULTICLASS:
         n_classes = preds.shape[1]
-        preds = jnp.moveaxis(preds, 0, 1).reshape(n_classes, -1).T
+        preds = preds.swapaxes(0, 1).reshape(n_classes, -1).T
         target = target.reshape(-1)
-    if mode == DataType.MULTILABEL and preds.ndim > 2:
+    if format_tensors and mode == DataType.MULTILABEL and preds.ndim > 2:
         n_classes = preds.shape[1]
-        preds = jnp.moveaxis(preds, 0, 1).reshape(n_classes, -1).T
-        target = jnp.moveaxis(target, 0, 1).reshape(n_classes, -1).T
+        preds = preds.swapaxes(0, 1).reshape(n_classes, -1).T
+        target = target.swapaxes(0, 1).reshape(n_classes, -1).T
     return preds, target, mode
 
 

@@ -22,10 +22,16 @@ def _average_precision_update(
     num_classes: Optional[int] = None,
     pos_label: Optional[int] = None,
     average: Optional[str] = "macro",
+    format_tensors: bool = True,
 ) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
-    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
-    if average == "micro" and preds.ndim != target.ndim:
+    # the micro/multi-class conflict shows post-format as a preds/target ndim
+    # mismatch; pre-format (raw-row buffering) the same condition is the
+    # multiclass branch itself: preds carrying one extra (class) dimension
+    if average == "micro" and preds.ndim == target.ndim + 1:
         raise ValueError("Cannot use `micro` average with multi-class input")
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(
+        preds, target, num_classes, pos_label, format_tensors=format_tensors
+    )
     return preds, target, num_classes, pos_label
 
 

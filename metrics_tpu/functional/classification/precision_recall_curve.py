@@ -64,8 +64,19 @@ def _precision_recall_curve_update(
     target: jax.Array,
     num_classes: Optional[int] = None,
     pos_label: Optional[int] = None,
+    format_tensors: bool = True,
+    warn: bool = True,
 ) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
-    """Flatten/transpose inputs to (flat-preds, flat-target) + resolved classes."""
+    """Flatten/transpose inputs to (flat-preds, flat-target) + resolved classes.
+
+    ``format_tensors=False`` runs only the shape-metadata half (hparam
+    resolution, raises, warnings) and returns the tensors untouched — the
+    module path buffers raw rows and defers the layout transform to
+    observation time (the transform commutes with batch concatenation, see
+    `classification/precision_recall_curve.py`). ``warn=False`` suppresses
+    the repeat ``pos_label`` warning when re-formatting already-warned data
+    at compute time.
+    """
     if preds.ndim == target.ndim:
         if pos_label is None:
             pos_label = 1
@@ -77,14 +88,16 @@ def _precision_recall_curve_update(
                     f" metric `precision_recall_curve` but detected {preds.shape[1]}"
                     " number of classes from predictions"
                 )
-            preds = jnp.moveaxis(preds, 0, 1).reshape(num_classes, -1).T
-            target = jnp.moveaxis(target, 0, 1).reshape(num_classes, -1).T
+            if format_tensors:
+                preds = preds.swapaxes(0, 1).reshape(num_classes, -1).T
+                target = target.swapaxes(0, 1).reshape(num_classes, -1).T
         else:
-            preds = preds.reshape(-1)
-            target = target.reshape(-1)
+            if format_tensors:
+                preds = preds.reshape(-1)
+                target = target.reshape(-1)
             num_classes = 1
     elif preds.ndim == target.ndim + 1:
-        if pos_label is not None:
+        if pos_label is not None and warn:
             rank_zero_warn(
                 f"Argument `pos_label` should be `None` when running multiclass precision recall curve. Got {pos_label}"
             )
@@ -94,29 +107,11 @@ def _precision_recall_curve_update(
                 f" metric `precision_recall_curve` but detected {preds.shape[1]}"
                 " number of classes from predictions"
             )
-        preds = jnp.moveaxis(preds, 0, 1).reshape(num_classes, -1).T
-        target = target.reshape(-1)
+        if format_tensors:
+            preds = preds.swapaxes(0, 1).reshape(num_classes, -1).T
+            target = target.reshape(-1)
     else:
         raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
-    return preds, target, num_classes, pos_label
-
-
-def _rederive_curve_hparams(
-    preds: jax.Array,
-    target: jax.Array,
-    num_classes: Optional[int],
-    pos_label: Optional[int],
-) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
-    """Resolve shape-inferred curve hyperparameters at compute time.
-
-    Used when a state is restored in a process whose update never ran (the
-    pure-function export / checkpoint path): re-runs the update formatter on
-    the stored data, which is safe because the formatter is idempotent on its
-    own output — it only flattens/reshapes. A `num_classes=None` multiclass
-    state cannot reach here: update would already have raised.
-    """
-    if num_classes is None:
-        return _precision_recall_curve_update(preds, target, None, pos_label)
     return preds, target, num_classes, pos_label
 
 

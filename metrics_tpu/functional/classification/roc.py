@@ -22,8 +22,9 @@ def _roc_update(
     target: jax.Array,
     num_classes: Optional[int] = None,
     pos_label: Optional[int] = None,
+    format_tensors: bool = True,
 ) -> Tuple[jax.Array, jax.Array, int, Optional[int]]:
-    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label, format_tensors=format_tensors)
 
 
 def _roc_compute_single_class(

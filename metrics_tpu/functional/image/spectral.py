@@ -14,12 +14,18 @@ from metrics_tpu.parallel.sync import reduce as _reduce
 from metrics_tpu.utils.checks import _check_same_shape
 
 
-def _image_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    if preds.dtype != target.dtype:
-        target = target.astype(preds.dtype)
+def _image_update(
+    preds: jax.Array, target: jax.Array, format_tensors: bool = True
+) -> Tuple[jax.Array, jax.Array]:
+    """Validate BxCxHxW pairs; ``format_tensors=False`` skips the float32
+    casts (the raw-row buffering path defers them to observation time)."""
     _check_same_shape(preds, target)
     if preds.ndim != 4:
         raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if not format_tensors:
+        return preds, target
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
     return preds.astype(jnp.float32), target.astype(jnp.float32)
 
 

@@ -4,6 +4,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.image.spectral import _image_update
 from metrics_tpu.metric import Metric
@@ -28,12 +29,24 @@ class _CatImageMetric(Metric):
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: jax.Array, target: jax.Array) -> None:
-        preds, target = self._input_check(preds, target)
+        # raw-row buffering: shape/ndim validation is metadata-only, the
+        # float32 cast is deferred to observation time (concat promotes, then
+        # one cast) — a steady-state update is two list appends
+        preds, target = self._input_check(preds, target, format_tensors=False)
         self.preds.append(preds)
         self.target.append(target)
 
+    def _canonicalize_list_states(self) -> None:
+        if not isinstance(self.preds, list):
+            return  # post-sync "cat" reduction left one bare canonical array
+        for i in range(len(self.preds)):
+            self.preds[i], self.target[i] = self._input_check(self.preds[i], self.target[i])
+
     def _cat_states(self):
-        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        return (
+            dim_zero_cat(self.preds).astype(jnp.float32),
+            dim_zero_cat(self.target).astype(jnp.float32),
+        )
 
 
 __all__ = ["_CatImageMetric"]

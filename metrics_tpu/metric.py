@@ -242,6 +242,22 @@ class Metric(ABC):
         for name, value in state.items():
             setattr(self, name, list(value) if isinstance(value, list) else value)
 
+    def _canonicalize_list_states(self) -> None:
+        """Bring lazily-buffered list-state rows to canonical per-row form, in place.
+
+        Cat-state metrics defer per-row canonicalization (flatten / dtype
+        cast / layout formatting) out of ``update``: appending the raw input
+        costs ~1 µs, while the reshape/cast dispatches cost hundreds of µs
+        per step through a remote backend (docs/performance.md). ``compute``
+        canonicalizes after concatenation — one fused program — but any
+        consumer that observes *individual rows* needs them canonical:
+        cross-device sync (rows must share rank for the pad-to-max gather
+        protocol), ``state_dict`` (checkpoint layout stability), pickling.
+        Those paths call this hook; overrides MUST be idempotent. Rows that
+        were offloaded to host numpy (``compute_on_cpu``) must stay on host —
+        use the row's own ``reshape``/``astype`` methods, not ``jnp``.
+        """
+
     # ----------------------------------------------------------------- update
     @abstractmethod
     def update(self, *args: Any, **kwargs: Any) -> None:
@@ -842,6 +858,7 @@ class Metric(ABC):
         if dist_sync_fn is None:
             dist_sync_fn = self.dist_sync_fn or gather_all_tensors
 
+        self._canonicalize_list_states()
         self._cache = self._state_snapshot()
         self._sync_dist(dist_sync_fn, process_group=process_group)
         self._is_synced = True
@@ -1060,6 +1077,7 @@ class Metric(ABC):
         reference's ``nn.Module`` hierarchy.
         """
         destination: Dict[str, Any] = {}
+        self._canonicalize_list_states()
         for name in self._defaults:
             if not self._persistent[name]:
                 continue
@@ -1098,6 +1116,7 @@ class Metric(ABC):
         # drop the wrapped bound methods (re-wrapped on unpickle, reference
         # `metric.py:568-577`) and the fused-forward machinery (jit closures
         # don't pickle/deepcopy; rebuilt lazily on first fused call)
+        self._canonicalize_list_states()
         drop = (
             "update",
             "compute",

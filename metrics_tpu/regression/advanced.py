@@ -11,16 +11,16 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.functional.regression.correlation import (
     _cosine_similarity_compute,
-    _cosine_similarity_update,
     _pearson_corrcoef_compute,
     _pearson_corrcoef_update,
     _pearson_final_aggregation,
     _spearman_corrcoef_compute,
-    _spearman_corrcoef_update,
 )
+from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.functional.regression.moments import (
     _explained_variance_compute,
     _explained_variance_update,
@@ -60,13 +60,22 @@ class CosineSimilarity(Metric):
         self.add_state("target", [], dist_reduce_fx="cat")
 
     def update(self, preds, target) -> None:
-        preds, target = _cosine_similarity_update(preds, target)
+        # raw-row buffering: the float32 cast is deferred to observation time
+        # (see `Metric._canonicalize_list_states`) — update is two appends
+        _check_same_shape(preds, target)
         self.preds.append(preds)
         self.target.append(target)
 
+    def _canonicalize_list_states(self) -> None:
+        if not isinstance(self.preds, list):
+            return  # post-sync "cat" reduction left one bare canonical array
+        for i in range(len(self.preds)):
+            self.preds[i] = self.preds[i].astype(np.float32)
+            self.target[i] = self.target[i].astype(np.float32)
+
     def compute(self) -> jax.Array:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = dim_zero_cat(self.preds).astype(jnp.float32)
+        target = dim_zero_cat(self.target).astype(jnp.float32)
         return _cosine_similarity_compute(preds, target, self.reduction)
 
 
@@ -239,13 +248,30 @@ class SpearmanCorrCoef(Metric):
         self.add_state("target", [], dist_reduce_fx="cat")
 
     def update(self, preds, target) -> None:
-        preds, target = _spearman_corrcoef_update(preds, target)
+        # raw-row buffering: dtype/shape checks are metadata-only; the squeeze
+        # is validated here from shapes and applied at observation time
+        if preds.dtype != target.dtype:
+            raise TypeError(
+                "Expected `preds` and `target` to have the same data type."
+                f" Got preds: {preds.dtype} and target: {target.dtype}."
+            )
+        _check_same_shape(preds, target)
+        squeezed = tuple(d for d in preds.shape if d != 1)
+        if len(squeezed) > 1:
+            raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
         self.preds.append(preds)
         self.target.append(target)
 
+    def _canonicalize_list_states(self) -> None:
+        if not isinstance(self.preds, list):
+            return  # post-sync "cat" reduction left one bare canonical array
+        for i in range(len(self.preds)):
+            self.preds[i] = self.preds[i].reshape(-1)
+            self.target[i] = self.target[i].reshape(-1)
+
     def compute(self) -> jax.Array:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.preds]) if isinstance(self.preds, list) else jnp.ravel(self.preds)
+        target = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.target]) if isinstance(self.target, list) else jnp.ravel(self.target)
         return _spearman_corrcoef_compute(preds, target)
 
 

@@ -20,6 +20,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.segments import (
@@ -29,8 +30,7 @@ from metrics_tpu.ops.segments import (
     segment_starts,
     segment_sum,
 )
-from metrics_tpu.utils.checks import _check_retrieval_inputs
-from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.checks import _check_retrieval_metadata
 
 
 @dataclass(frozen=True)
@@ -169,12 +169,22 @@ class RetrievalMetric(Metric):
         self.add_state("target", default=[], dist_reduce_fx=None)
 
     def update(self, preds, target, indexes) -> None:
+        """Validate and buffer one batch of (preds, target, indexes) rows.
+
+        TPU-first hot path: rows are appended RAW — flatten/cast/
+        ignore-filtering are deferred to observation time (`compute`, sync,
+        `state_dict` via :meth:`_canonicalize_list_states`), so a steady-state
+        update is metadata checks plus three list appends, with zero device
+        dispatches. The reference canonicalizes per update
+        (`retrieval/base.py:122-131`), which costs hundreds of µs/step in
+        eager dispatches through a remote backend.
+        """
         if indexes is None:
             raise ValueError("Argument `indexes` cannot be None")
-        indexes, preds, target = _check_retrieval_inputs(
-            jnp.asarray(indexes),
-            jnp.asarray(preds),
-            jnp.asarray(target),
+        indexes, preds, target = _check_retrieval_metadata(
+            preds=preds,
+            target=target,
+            indexes=indexes,
             allow_non_binary_target=self.allow_non_binary_target,
             ignore_index=self.ignore_index,
         )
@@ -182,12 +192,40 @@ class RetrievalMetric(Metric):
         self.preds.append(preds)
         self.target.append(target)
 
+    def _canonicalize_list_states(self) -> None:
+        """Flatten/cast/filter buffered raw rows in place (idempotent).
+
+        Canonical per-row form (matching what the reference stores after its
+        per-update `_check_retrieval_inputs`): 1-D, preds float32, target
+        float32/int32 by input family, indexes int32 (int64 kept), rows with
+        ``target == ignore_index`` dropped. Host rows stay host arrays.
+        """
+        if not isinstance(self.indexes, list):
+            return  # post-sync reduced state: rows already canonical
+        for i in range(len(self.indexes)):
+            idx, p, t = self.indexes[i], self.preds[i], self.target[i]
+            idx = idx.reshape(-1)
+            p = p.reshape(-1).astype(np.float32)
+            t = t.reshape(-1)
+            if self.ignore_index is not None:
+                valid = t != self.ignore_index
+                idx, p, t = idx[valid], p[valid], t[valid]
+            t = t.astype(np.float32) if jnp.issubdtype(t.dtype, jnp.floating) else t.astype(np.int32)
+            if idx.dtype != jnp.int64:
+                idx = idx.astype(np.int32)
+            self.indexes[i], self.preds[i], self.target[i] = idx, p, t
+
     def _grouped_state(self) -> Optional[GroupedRows]:
         if not self.indexes:
             return None
-        indexes = dim_zero_cat(self.indexes)
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        # one concat per state canonicalizes everything at once; per-row
+        # flatten keeps raw rows of any rank concatenable
+        indexes = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.indexes])
+        preds = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.preds]).astype(jnp.float32)
+        target = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.target])
+        if self.ignore_index is not None:
+            valid = target != self.ignore_index
+            indexes, preds, target = indexes[valid], preds[valid], target[valid]
         if indexes.size == 0:
             return None
         return group_rows(indexes, preds, target)

@@ -19,7 +19,7 @@ TPU-first rework:
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -176,12 +176,19 @@ def _check_for_empty(preds, target) -> bool:
 
 
 def _squeeze_excess_dims(preds, target):
-    """Drop all size-1 dims except the leading N dim (reference `_input_squeeze`)."""
+    """Drop all size-1 dims except the leading N dim (reference `_input_squeeze`).
+
+    Type-preserving (host arrays stay host) and dispatch-free when there is
+    nothing to squeeze — this sits on eager per-update hot paths.
+    """
     if preds.shape[:1] == (1,):
-        preds = jnp.expand_dims(jnp.squeeze(preds), 0)
-        target = jnp.expand_dims(jnp.squeeze(target), 0)
+        preds = preds.squeeze()[None]
+        target = target.squeeze()[None]
     else:
-        preds, target = jnp.squeeze(preds), jnp.squeeze(target)
+        if 1 in preds.shape:
+            preds = preds.squeeze()
+        if 1 in target.shape:
+            target = target.squeeze()
     return preds, target
 
 
@@ -344,6 +351,23 @@ def _check_classification_inputs(
     return case
 
 
+def _classification_case(preds, target, threshold: float = 0.5) -> DataType:
+    """Resolve the :class:`DataType` case with full validation but NO formatting.
+
+    The raw-row buffering paths (e.g. `classification/auroc.py`) need the
+    input case for mode-consistency checks at ``update`` time while deferring
+    the layout transform to observation time; this runs the same validation
+    as :func:`_input_format_classification` (value checks honoring the
+    validation mode) without dispatching any formatting ops.
+    """
+    preds = preds if isinstance(preds, (jax.Array, np.ndarray)) else np.asarray(preds)
+    target = target if isinstance(target, (jax.Array, np.ndarray)) else np.asarray(target)
+    preds, target = _squeeze_excess_dims(preds, target)
+    return _check_classification_inputs(
+        preds, target, threshold=threshold, num_classes=None, multiclass=None, top_k=None
+    )
+
+
 def _input_format_classification(
     preds,
     target,
@@ -425,6 +449,75 @@ def _input_format_classification(
 
 def _input_squeeze(preds, target):
     return _squeeze_excess_dims(jnp.asarray(preds), jnp.asarray(target))
+
+
+def _check_retrieval_metadata(
+    indexes,
+    preds,
+    target,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Any, Any, Any]:
+    """Fail-fast validation for retrieval triples WITHOUT canonicalizing.
+
+    The module path (`retrieval/base.py`) buffers RAW rows and defers
+    flatten/cast/ignore-filtering to observation time (sync/state_dict/
+    compute), so its ``update`` must not dispatch device ops. This runs the
+    same checks as :func:`_check_retrieval_inputs` — shape/dtype checks from
+    array metadata only, the binary-target value check honoring the
+    validation mode — and returns the inputs untouched (host arrays stay on
+    host, device arrays stay device-committed, no reshape/cast dispatches).
+    """
+    indexes = indexes if isinstance(indexes, (jax.Array, np.ndarray)) else np.asarray(indexes)
+    preds = preds if isinstance(preds, (jax.Array, np.ndarray)) else np.asarray(preds)
+    target = target if isinstance(target, (jax.Array, np.ndarray)) else np.asarray(target)
+
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    target_is_float = jnp.issubdtype(target.dtype, jnp.floating)
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_ or target_is_float):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if preds.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+
+    # value-dependent checks (binary target range; batch left empty by
+    # ignore_index filtering) — one fused read, honoring the validation mode
+    needs_range = not allow_non_binary_target
+    if (
+        _is_concrete(target)
+        and (needs_range or ignore_index is not None)
+        and _should_value_check(preds, target, key_extra=("retrieval", ignore_index))
+    ):
+        if isinstance(target, np.ndarray):
+            t = target.reshape(-1)
+            if ignore_index is not None:
+                t = t[t != ignore_index]
+            if t.size == 0:
+                raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+            if needs_range and (t.max() > 1 or t.min() < 0):
+                raise ValueError("`target` must contain binary values")
+        else:
+            t = target.reshape(-1).astype(jnp.float32)
+            valid = jnp.ones_like(t, dtype=bool) if ignore_index is None else (target.reshape(-1) != ignore_index)
+            stats = np.asarray(
+                jnp.stack(
+                    [
+                        valid.any().astype(jnp.float32),
+                        jnp.where(valid, t, jnp.inf).min(),
+                        jnp.where(valid, t, -jnp.inf).max(),
+                    ]
+                )
+            )
+            if not stats[0]:
+                raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+            if needs_range and (stats[2] > 1 or stats[1] < 0):
+                raise ValueError("`target` must contain binary values")
+
+    return indexes, preds, target
 
 
 def _check_retrieval_inputs(

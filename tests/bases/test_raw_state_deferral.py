@@ -1,0 +1,221 @@
+"""Deferred canonicalization contract for cat-state metrics.
+
+Cat-state ("list") metrics buffer RAW input rows at ``update`` time — zero
+device dispatches on the steady-state hot path — and canonicalize at
+observation time: per-row via ``Metric._canonicalize_list_states`` before
+sync/state_dict/pickle, post-concat inside ``compute``. These tests pin:
+
+1. raw appends — the buffered row IS the input object (no copy, no cast);
+2. fail-fast parity — invalid inputs still raise at ``update``;
+3. observation canonicalizes — state_dict/pickle rows are 1-D/formatted and
+   idempotent under repeated canonicalization;
+4. commutation — multi-batch compute equals single-shot compute on the
+   concatenated data, including the heterogeneous-trailing-shape fallback;
+5. emulated multi-rank sync still reduces correctly over raw rows.
+
+Reference behavior being preserved: per-update canonicalization in
+`retrieval/base.py:122-131`, `classification/precision_recall_curve.py`,
+`image/uqi.py`, `aggregation.py:268-313` of the reference tree.
+"""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from tests.helpers.testers import _FakeGather
+
+
+def test_retrieval_update_appends_raw_rows():
+    m = mt.RetrievalMRR()
+    p = jnp.asarray([[0.3, 0.7], [0.4, 0.1]])
+    t = jnp.asarray([[0, 1], [1, 0]])
+    idx = jnp.asarray([[0, 0], [1, 1]])
+    m.update(p, t, idx)
+    assert m.preds[0] is p and m.target[0] is t and m.indexes[0] is idx
+
+
+def test_curve_update_appends_raw_rows():
+    m = mt.PrecisionRecallCurve(num_classes=3)
+    p = jnp.asarray(np.random.RandomState(0).rand(6, 3).astype(np.float32))
+    t = jnp.asarray([0, 1, 2, 0, 1, 2])
+    m.update(p, t)
+    assert m.preds[0] is p and m.target[0] is t
+
+
+def test_cat_metric_gated_update_appends_raw(monkeypatch):
+    from metrics_tpu.utils import checks
+
+    monkeypatch.setattr(checks, "_validation_mode", "off")
+    m = mt.CatMetric()
+    v = jnp.asarray([1.0, 2.0])
+    m.update(v)
+    assert m.value[0] is v
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0])
+
+
+def test_update_still_fails_fast():
+    m = mt.RetrievalMRR()
+    with pytest.raises(ValueError, match="same shape"):
+        m.update(jnp.asarray([[0.5]]), jnp.asarray([1]), jnp.asarray([0]))
+    with pytest.raises(ValueError, match="long integers"):
+        m.update(jnp.asarray([0.5]), jnp.asarray([1]), jnp.asarray([0.5]))
+    with pytest.raises(ValueError, match="binary values"):
+        m.update(jnp.asarray([0.5]), jnp.asarray([7]), jnp.asarray([0]))
+
+    c = mt.PrecisionRecallCurve(num_classes=2)
+    with pytest.raises(ValueError, match="number of classes"):
+        c.update(jnp.asarray(np.random.rand(4, 3)), jnp.asarray([0, 1, 2, 1]))
+
+    s = mt.SpearmanCorrCoef()
+    with pytest.raises(ValueError, match="1 dimensional"):
+        s.update(jnp.asarray(np.random.rand(4, 3)), jnp.asarray(np.random.rand(4, 3)))
+
+    img = mt.UniversalImageQualityIndex()
+    with pytest.raises(ValueError, match="BxCxHxW"):
+        img.update(jnp.zeros((3, 4, 4)), jnp.zeros((3, 4, 4)))
+
+
+def test_state_dict_rows_are_canonical_and_idempotent():
+    m = mt.RetrievalNormalizedDCG(ignore_index=-1)
+    rng = np.random.RandomState(0)
+    for _ in range(2):
+        t = rng.randint(0, 2, (4, 8))
+        t[0, 0] = -1
+        m.update(rng.rand(4, 8).astype(np.float32), t, np.repeat(np.arange(4), 8).reshape(4, 8))
+    before = float(m.compute())
+    m.persistent(True)
+    sd = m.state_dict()
+    # flattened, filtered, canonically typed
+    assert all(v.ndim == 1 and v.shape[0] == 31 for v in sd["preds"])
+    assert sd["target"][0].dtype == np.int32
+    assert sd["preds"][0].dtype == np.float32
+    # idempotent: canonicalizing again changes nothing
+    m._canonicalize_list_states()
+    assert float(m.compute()) == before
+    # host rows stayed host arrays (compute_on_cpu compatibility)
+    assert isinstance(m.preds[0], np.ndarray)
+
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == before
+
+
+@pytest.mark.parametrize("cls", [mt.PrecisionRecallCurve, mt.ROC])
+def test_curve_multibatch_commutation_multidim(cls):
+    """Varying extra-dim batches hit the per-row canonicalization fallback."""
+    rng = np.random.RandomState(1)
+    batches = []
+    for x in (3, 5):  # heterogeneous trailing shape across batches
+        p = rng.rand(4, 5, x).astype(np.float32)
+        p /= p.sum(1, keepdims=True)
+        t = rng.randint(0, 5, (4, x))
+        batches.append((p, t))
+
+    m = cls(num_classes=5)
+    for p, t in batches:
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    streamed = m.compute()
+
+    # single-shot on the flattened equivalent (canonical formatting applied
+    # per batch, concatenated): the reference's per-update storage layout
+    from metrics_tpu.functional.classification.precision_recall_curve import (
+        _precision_recall_curve_update,
+    )
+
+    fp, ft = [], []
+    for p, t in batches:
+        a, b, _, _ = _precision_recall_curve_update(jnp.asarray(p), jnp.asarray(t), 5, None)
+        fp.append(a)
+        ft.append(b)
+    m2 = cls(num_classes=5)
+    m2.update(jnp.concatenate(fp), jnp.concatenate(ft))
+    oneshot = m2.compute()
+
+    for a, b in zip(streamed, oneshot):
+        if isinstance(a, (list, tuple)):
+            for ai, bi in zip(a, b):
+                np.testing.assert_allclose(np.asarray(ai), np.asarray(bi), atol=1e-6)
+        else:
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_auroc_multibatch_matches_oneshot():
+    rng = np.random.RandomState(2)
+    p1, p2 = rng.rand(16).astype(np.float32), rng.rand(24).astype(np.float32)
+    t1, t2 = rng.randint(0, 2, 16), rng.randint(0, 2, 24)
+    m = mt.AUROC(pos_label=1)
+    m.update(jnp.asarray(p1), jnp.asarray(t1))
+    m.update(jnp.asarray(p2), jnp.asarray(t2))
+    one = mt.AUROC(pos_label=1)
+    one.update(jnp.asarray(np.concatenate([p1, p2])), jnp.asarray(np.concatenate([t1, t2])))
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(one.compute()), atol=1e-6)
+
+
+def test_fake_gather_sync_over_raw_rows():
+    """Emulated 2-rank sync: raw rows on the non-syncing rank canonicalize."""
+    ranks = [mt.RetrievalMRR() for _ in range(2)]
+    rng = np.random.RandomState(3)
+    for r, rank in enumerate(ranks):
+        # different RAW shapes per rank: (2, 4) vs (8,) — rank-1 would break
+        # the pad-to-max gather without symmetric canonicalization
+        if r == 0:
+            rank.update(rng.rand(2, 4).astype(np.float32), rng.randint(0, 2, (2, 4)), np.zeros((2, 4), np.int64))
+        else:
+            rank.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.ones(8, np.int64))
+    expected = mt.RetrievalMRR()
+    for rank_src in [0, 1]:
+        expected.update(
+            ranks[rank_src].preds[0].reshape(-1),
+            ranks[rank_src].target[0].reshape(-1),
+            ranks[rank_src].indexes[0].reshape(-1),
+        )
+    gather = _FakeGather(ranks)
+    m = ranks[0]
+    m.sync(dist_sync_fn=gather, distributed_available=lambda: True)
+    synced = float(m.compute())
+    m._computed = None
+    np.testing.assert_allclose(synced, float(expected.compute()), atol=1e-6)
+    m.unsync()
+
+
+def test_post_sync_state_dict_and_compute_on_reduced_cat_state():
+    """After sync reduces a "cat" list state to one bare array, the
+    canonicalization hooks must no-op (state_dict/pickle inside the sync
+    context used to item-assign into the immutable array) and compute must
+    not iterate the array row-by-row."""
+    ranks = [mt.PrecisionRecallCurve(pos_label=1) for _ in range(2)]
+    rng = np.random.RandomState(7)
+    for rank in ranks:
+        rank.update(jnp.asarray(rng.rand(16).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 16)))
+        rank.persistent(True)
+    gather = _FakeGather(ranks)
+    m = ranks[0]
+    with m.sync_context(dist_sync_fn=gather, distributed_available=lambda: True):
+        assert not isinstance(m.preds, list)  # reduced to one bare array
+        sd = m.state_dict()  # must not raise on the immutable array
+        assert sd["preds"].shape == (32,)
+        pickle.dumps(m)
+        p, r, t = m.compute()  # bare-array fast path in _cat_raw
+        assert p.shape[0] == r.shape[0]
+    assert isinstance(m.preds, list)  # local state restored
+
+
+def test_cosine_similarity_defers_cast():
+    m = mt.CosineSimilarity(reduction="mean")
+    p = jnp.asarray([[2.0, 0.0], [1.0, 1.0]])
+    t = jnp.asarray([[1.0, 0.0], [1.0, 0.0]])
+    m.update(p, t)
+    assert m.preds[0] is p
+    assert round(float(m.compute()), 4) == 0.8536
+
+
+def test_spearman_raw_rows_and_squeeze_semantics():
+    m = mt.SpearmanCorrCoef()
+    p = jnp.asarray(np.random.RandomState(4).rand(8, 1).astype(np.float32))
+    t = jnp.asarray(np.random.RandomState(5).rand(8, 1).astype(np.float32))
+    m.update(p, t)  # (N, 1) squeezes to (N,) — allowed
+    assert m.preds[0] is p
+    ref = mt.SpearmanCorrCoef()
+    ref.update(p.reshape(-1), t.reshape(-1))
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(ref.compute()), atol=1e-6)

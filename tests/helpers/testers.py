@@ -66,10 +66,21 @@ class _FakeGather:
 
     def __init__(self, rank_metrics: Sequence[Metric]) -> None:
         self.rank_metrics = rank_metrics
+        # real sync is symmetric: every rank's sync() canonicalizes its lazily
+        # buffered list states before gathering. Only the syncing rank's
+        # sync() runs in this emulation, so canonicalize the others here.
+        for rm in rank_metrics:
+            self._canon_recursive(rm)
         # built eagerly so the cross-rank agreement diagnostics fire even when
         # the syncing rank itself would make zero gather calls
         self._schedule = self._build_schedule(rank_metrics[0])
         self._call_idx = 0
+
+    @classmethod
+    def _canon_recursive(cls, m: Metric) -> None:
+        m._canonicalize_list_states()
+        for child in m._sync_children():
+            cls._canon_recursive(child)
 
     @staticmethod
     def _resolve(m: Metric, path: tuple) -> Metric:
