@@ -13,6 +13,7 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.checks import _should_value_check
+from metrics_tpu.utils.data import dim_zero_cat_ravel
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -81,8 +82,14 @@ class BaseAggregator(Metric):
             if is_tracer or (self.nan_strategy == "ignore" and not self._keeps_raw_values):
                 # reduction aggregators drop nans by masking to the reduction
                 # identity with zero weight — pure device ops, no value read.
-                # (Traced error/warn cannot inspect values; they fall through.)
-                if self.nan_strategy == "ignore":
+                # Under tracing (jit / as_functions / the fused update
+                # program) "warn" ALSO masks: the warning cannot fire, but
+                # masked removal keeps the VALUES reference-exact — the same
+                # equivalence the gated-off eager path uses below. Traced
+                # "error" falls through so a NaN poisons visibly.
+                if self.nan_strategy == "ignore" or (
+                    is_tracer and self.nan_strategy == "warn" and not self._keeps_raw_values
+                ):
                     nans = jnp.isnan(x) if weight is None else jnp.isnan(x) | jnp.isnan(weight)
                     x = jnp.where(nans, self._nan_neutral, x)
                     if weight is not None:
@@ -239,7 +246,7 @@ class CatMetric(BaseAggregator):
 
     def compute(self) -> jax.Array:
         if isinstance(self.value, list) and self.value:
-            return jnp.concatenate([jnp.ravel(jnp.asarray(v)) for v in self.value]).astype(jnp.float32)
+            return dim_zero_cat_ravel(self.value).astype(jnp.float32)
         return self.value
 
 

@@ -6,7 +6,7 @@ from typing import Any, Optional
 import jax
 
 from metrics_tpu.classification._raw_state import _RawPairStateMixin
-from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_format, _auroc_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.enums import AverageMethod
 
@@ -68,8 +68,12 @@ class AUROC(_RawPairStateMixin, Metric):
         self.mode = mode
 
     def _format_row(self, preds, target):
-        p, t, _ = _auroc_update(preds, target)
-        return p, t
+        # rows were validated at update; apply only the mode-resolved layout
+        # transform (no per-row value-check reads at sync/checkpoint time)
+        if self.mode is None:
+            p, t, _ = _auroc_update(preds, target)
+            return p, t
+        return _auroc_format(preds, target, self.mode)
 
     def compute(self) -> jax.Array:
         # preds may be a list of per-batch arrays OR a bare array (post-sync
@@ -79,13 +83,14 @@ class AUROC(_RawPairStateMixin, Metric):
         )
         if not self.mode and not have_data:
             raise RuntimeError("You have to have determined mode.")
-        if isinstance(self.preds, (list, tuple)):
-            preds, target = self._cat_raw()
+        preds, target = self._cat_raw()
+        mode = self.mode
+        if mode is None:
+            # state restored in a fresh process: re-derive the mode (and
+            # format) from the stored canonical arrays
+            preds, target, mode = _auroc_update(preds, target)
         else:
-            preds, target = self.preds, self.target
-        # one formatting program over the concatenated arrays (also re-derives
-        # the mode when the state was restored in a fresh process)
-        preds, target, mode = _auroc_update(preds, target)
+            preds, target = _auroc_format(preds, target, mode)
         return _auroc_compute(
             preds, target, mode, self.num_classes, self.pos_label, self.average, self.max_fpr
         )

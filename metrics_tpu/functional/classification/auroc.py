@@ -18,6 +18,24 @@ from metrics_tpu.utils.data import _bincount
 from metrics_tpu.utils.enums import AverageMethod, DataType
 
 
+def _auroc_format(preds: jax.Array, target: jax.Array, mode: DataType) -> Tuple[jax.Array, jax.Array]:
+    """The mode-resolved layout transform alone (idempotent, no validation).
+
+    Used by the raw-row buffering path to canonicalize already-validated
+    rows without re-running value checks. Array methods keep host rows on
+    the host.
+    """
+    if mode == DataType.MULTIDIM_MULTICLASS:
+        n_classes = preds.shape[1]
+        preds = preds.swapaxes(0, 1).reshape(n_classes, -1).T
+        target = target.reshape(-1)
+    if mode == DataType.MULTILABEL and preds.ndim > 2:
+        n_classes = preds.shape[1]
+        preds = preds.swapaxes(0, 1).reshape(n_classes, -1).T
+        target = target.swapaxes(0, 1).reshape(n_classes, -1).T
+    return preds, target
+
+
 def _auroc_update(
     preds: jax.Array, target: jax.Array, format_tensors: bool = True
 ) -> Tuple[jax.Array, jax.Array, DataType]:
@@ -25,19 +43,11 @@ def _auroc_update(
 
     ``format_tensors=False`` validates and returns the raw tensors — the
     module path buffers raw rows and defers the layout transform (which
-    commutes with batch concatenation) to observation time. The transform
-    uses array methods, so host rows stay host arrays.
+    commutes with batch concatenation) to observation time.
     """
     mode = _classification_case(preds, target)
-
-    if format_tensors and mode == DataType.MULTIDIM_MULTICLASS:
-        n_classes = preds.shape[1]
-        preds = preds.swapaxes(0, 1).reshape(n_classes, -1).T
-        target = target.reshape(-1)
-    if format_tensors and mode == DataType.MULTILABEL and preds.ndim > 2:
-        n_classes = preds.shape[1]
-        preds = preds.swapaxes(0, 1).reshape(n_classes, -1).T
-        target = target.swapaxes(0, 1).reshape(n_classes, -1).T
+    if format_tensors:
+        preds, target = _auroc_format(preds, target, mode)
     return preds, target, mode
 
 

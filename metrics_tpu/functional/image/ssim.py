@@ -24,14 +24,18 @@ from metrics_tpu.parallel.sync import reduce as _reduce
 from metrics_tpu.utils.checks import _check_same_shape
 
 
-def _ssim_check_inputs(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    if preds.dtype != target.dtype:
-        target = target.astype(preds.dtype)
+def _ssim_check_inputs(
+    preds: jax.Array, target: jax.Array, format_tensors: bool = True
+) -> Tuple[jax.Array, jax.Array]:
+    """Validate (B,C,H,W)/(B,C,D,H,W) pairs; ``format_tensors=False`` skips
+    the dtype-match cast (raw-row buffering defers it to observation time)."""
     _check_same_shape(preds, target)
     if preds.ndim not in (4, 5):
         raise ValueError(
             f"Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape. Got preds: {preds.shape}."
         )
+    if format_tensors and preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
     return preds, target
 
 

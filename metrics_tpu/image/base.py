@@ -4,7 +4,6 @@ from __future__ import annotations
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 
 from metrics_tpu.functional.image.spectral import _image_update
 from metrics_tpu.metric import Metric
@@ -43,10 +42,14 @@ class _CatImageMetric(Metric):
             self.preds[i], self.target[i] = self._input_check(self.preds[i], self.target[i])
 
     def _cat_states(self):
-        return (
-            dim_zero_cat(self.preds).astype(jnp.float32),
-            dim_zero_cat(self.target).astype(jnp.float32),
-        )
+        if not isinstance(self.preds, list):
+            # post-sync "cat" reduction left one bare canonical array per state
+            preds, target = self.preds, self.target
+        else:
+            preds, target = dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        # the family's own canonical transform (float32 cast for the spectral
+        # metrics, dtype matching for SSIM), applied ONCE post-concat
+        return self._input_check(preds, target)
 
 
 __all__ = ["_CatImageMetric"]

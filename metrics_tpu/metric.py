@@ -270,17 +270,77 @@ class Metric(ABC):
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped(*args: Any, **kwargs: Any) -> None:
+            from metrics_tpu.utils.checks import _get_validation_mode
+
             self._computed = None
             self._update_count += 1
+            # fused bare-update: for sum/mean/max/min array-state metrics the
+            # whole update runs as ONE cached jitted program per input
+            # signature (same gating contract as the fused forward: first
+            # call per signature is eager and fully validated; "full"
+            # validation mode keeps every call eager)
+            signature = None
+            if (
+                self._fused_update_ok
+                and not self._suppress_update_fusion
+                and _get_validation_mode() != "full"
+                and self._fusable_states()
+                and not any(
+                    isinstance(leaf, jax.core.Tracer) for leaf in jax.tree.flatten((args, kwargs))[0]
+                )
+            ):
+                if self._fused_seen_signatures is None:
+                    self._fused_seen_signatures = {}
+                signature = ("__update__", self._forward_signature(args, kwargs))
+                if signature in self._fused_seen_signatures:
+                    try:
+                        if self._fused_update_program is None:
+                            self._fused_update_program = self._build_fused_update()
+                        state = {name: getattr(self, name) for name in self._defaults}
+                        new_state = self._fused_update_program(state, *args, **kwargs)
+                    except Exception as exc:  # noqa: BLE001 — any trace/compile failure
+                        rank_zero_warn(
+                            f"Fused update for `{type(self).__name__}` raised "
+                            f"{type(exc).__name__}: {exc}. Falling back to the eager "
+                            "per-op update permanently for this instance."
+                        )
+                        object.__setattr__(self, "_fused_update_ok", False)
+                        object.__setattr__(self, "_fused_update_program", None)
+                        object.__setattr__(self, "_fused_update_template", None)
+                    else:
+                        for name, value in new_state.items():
+                            setattr(self, name, value)
+                        _propagate_static_attrs(self._fused_update_template, self)
+                        return
             # TraceAnnotation shows up in jax.profiler / xprof timelines —
             # the analogue of the reference's TorchScript profiling markers
             # (SURVEY §5 "Tracing / profiling")
             with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
                 update(*args, **kwargs)
+            if signature is not None:
+                # recorded only AFTER the eager call validated this signature
+                self._fused_seen_signatures[signature] = None
+                while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
+                    self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
             if self.compute_on_cpu:
                 self._move_list_states_to_host()
 
         return wrapped
+
+    def _build_fused_update(self) -> Callable:
+        """One jitted program for a bare ``update`` call: restore state into a
+        template clone, run the real update, return the new state pytree."""
+        template = self._bare_clone()
+        object.__setattr__(self, "_fused_update_template", template)
+
+        def ustep(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+            m = template._bare_clone()
+            m._restore_state(state)
+            m._inner_update(*args, **kwargs)
+            _propagate_static_attrs(m, template)
+            return m._state_snapshot()
+
+        return jax.jit(ustep)
 
     def _move_list_states_to_host(self) -> None:
         """Offload list states to host RAM to free HBM (``compute_on_cpu`` analogue)."""
@@ -349,6 +409,16 @@ class Metric(ABC):
     _fused_forward: Optional[Callable] = None
     _fused_template: Optional["Metric"] = None
     _fused_forward_ok: bool = True
+    # fused BARE-update path (no batch compute/merge): `metric.update(...)`
+    # loops pay one program dispatch per step instead of the eager
+    # canonicalization op-stream; health tracked independently of forward
+    _fused_update_program: Optional[Callable] = None
+    _fused_update_template: Optional["Metric"] = None
+    _fused_update_ok: bool = True
+    # set by the batched-step eager loop: its per-step update calls must not
+    # register per-step signatures or compile the single-step program the
+    # scan path will never use (same hygiene as force_reduce_eager)
+    _suppress_update_fusion: bool = False
     _fused_needs_count: bool = True  # set on build; True passes update_count
     _fused_seen_signatures: Optional[dict] = None
     _fused_version: int = 0  # bumped on invalidation; lets collections detect staleness
@@ -656,23 +726,27 @@ class Metric(ABC):
         _, _, _, _, scanned, _ = self._split_many_leaves(args, kwargs)
         n_steps = int(scanned[0].shape[0])
         values = []
-        for i in range(n_steps):
-            # array leaves carry the steps axis; python scalars/strings and
-            # 0-d arrays are per-chunk constants and pass through to every step
-            a, k = jax.tree.map(
-                lambda x: x[i] if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 else x,
-                (args, kwargs),
-            )
-            if not with_values:
-                # update_many semantics are n sequential update() calls; the
-                # forward dance (snapshot/reset/compute/merge) would compute
-                # and discard a batch value per step
-                self.update(*a, **k)
-            elif force_reduce_eager:
-                self._forward_cache = self._forward_reduce_state_update_eager(*a, **k)
-                values.append(self._forward_cache)
-            else:
-                values.append(self.forward(*a, **k))
+        object.__setattr__(self, "_suppress_update_fusion", True)
+        try:
+            for i in range(n_steps):
+                # array leaves carry the steps axis; python scalars/strings and
+                # 0-d arrays are per-chunk constants and pass through to every step
+                a, k = jax.tree.map(
+                    lambda x: x[i] if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 else x,
+                    (args, kwargs),
+                )
+                if not with_values:
+                    # update_many semantics are n sequential update() calls; the
+                    # forward dance (snapshot/reset/compute/merge) would compute
+                    # and discard a batch value per step
+                    self.update(*a, **k)
+                elif force_reduce_eager:
+                    self._forward_cache = self._forward_reduce_state_update_eager(*a, **k)
+                    values.append(self._forward_cache)
+                else:
+                    values.append(self.forward(*a, **k))
+        finally:
+            object.__setattr__(self, "_suppress_update_fusion", False)
         if not with_values:
             return None
         return jax.tree.map(lambda *xs: jnp.stack(xs), *values)
@@ -1042,6 +1116,7 @@ class Metric(ABC):
         {
             "_fused_template",
             "_fused_templates",
+            "_fused_update_template",
             "_many_template_vals",
             "_many_template_novals",
             "_many_templates",
@@ -1122,6 +1197,8 @@ class Metric(ABC):
             "compute",
             "_fused_forward",
             "_fused_template",
+            "_fused_update_program",
+            "_fused_update_template",
             "_many_program_vals",
             "_many_program_novals",
             "_many_template_vals",
@@ -1177,6 +1254,9 @@ class Metric(ABC):
                 if self.__dict__.get("_fused_forward") is not None:
                     object.__setattr__(self, "_fused_forward", None)
                     object.__setattr__(self, "_fused_template", None)
+                if self.__dict__.get("_fused_update_program") is not None:
+                    object.__setattr__(self, "_fused_update_program", None)
+                    object.__setattr__(self, "_fused_update_template", None)
                 if (
                     self.__dict__.get("_many_program_vals") is not None
                     or self.__dict__.get("_many_program_novals") is not None

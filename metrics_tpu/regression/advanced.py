@@ -21,6 +21,7 @@ from metrics_tpu.functional.regression.correlation import (
     _spearman_corrcoef_compute,
 )
 from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import dim_zero_cat_ravel
 from metrics_tpu.functional.regression.moments import (
     _explained_variance_compute,
     _explained_variance_update,
@@ -270,9 +271,9 @@ class SpearmanCorrCoef(Metric):
             self.target[i] = self.target[i].reshape(-1)
 
     def compute(self) -> jax.Array:
-        preds = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.preds]) if isinstance(self.preds, list) else jnp.ravel(self.preds)
-        target = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.target]) if isinstance(self.target, list) else jnp.ravel(self.target)
-        return _spearman_corrcoef_compute(preds, target)
+        return _spearman_corrcoef_compute(
+            dim_zero_cat_ravel(self.preds), dim_zero_cat_ravel(self.target)
+        )
 
 
 class TweedieDevianceScore(Metric):

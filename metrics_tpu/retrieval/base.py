@@ -31,6 +31,7 @@ from metrics_tpu.ops.segments import (
     segment_sum,
 )
 from metrics_tpu.utils.checks import _check_retrieval_metadata
+from metrics_tpu.utils.data import dim_zero_cat_ravel
 
 
 @dataclass(frozen=True)
@@ -220,9 +221,9 @@ class RetrievalMetric(Metric):
             return None
         # one concat per state canonicalizes everything at once; per-row
         # flatten keeps raw rows of any rank concatenable
-        indexes = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.indexes])
-        preds = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.preds]).astype(jnp.float32)
-        target = jnp.concatenate([jnp.ravel(jnp.asarray(r)) for r in self.target])
+        indexes = dim_zero_cat_ravel(self.indexes)
+        preds = dim_zero_cat_ravel(self.preds).astype(jnp.float32)
+        target = dim_zero_cat_ravel(self.target)
         if self.ignore_index is not None:
             valid = target != self.ignore_index
             indexes, preds, target = indexes[valid], preds[valid], target[valid]

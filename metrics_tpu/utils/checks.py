@@ -467,6 +467,13 @@ def _check_retrieval_metadata(
     array metadata only, the binary-target value check honoring the
     validation mode — and returns the inputs untouched (host arrays stay on
     host, device arrays stay device-committed, no reshape/cast dispatches).
+
+    Note one deliberate divergence: the "batch left empty by ignore_index
+    filtering" raise is value-dependent (it needs a read of ``target``), so
+    like every value check it follows the validation mode — ``full``
+    (default) raises on every such batch exactly like
+    :func:`_check_retrieval_inputs`; under ``first``/``off`` a gated-off
+    all-ignored batch is buffered and simply contributes no rows at compute.
     """
     indexes = indexes if isinstance(indexes, (jax.Array, np.ndarray)) else np.asarray(indexes)
     preds = preds if isinstance(preds, (jax.Array, np.ndarray)) else np.asarray(preds)

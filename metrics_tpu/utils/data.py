@@ -35,6 +35,22 @@ def dim_zero_cat(x: TensorOrList) -> Array:
     return jnp.concatenate(x, axis=0)
 
 
+def dim_zero_cat_ravel(x: TensorOrList) -> Array:
+    """Flatten each buffered row, then concatenate.
+
+    The raw-row buffering paths (deferred canonicalization — see
+    `Metric._canonicalize_list_states`) store rows of arbitrary rank; this
+    canonicalizes them to one 1-D array in a single concat, accepting host
+    numpy rows alongside device arrays. A post-sync reduced state (bare
+    array) is flattened and returned as-is.
+    """
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return jnp.ravel(x)
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate([jnp.ravel(jnp.asarray(v)) for v in x])
+
+
 def dim_zero_sum(x: Array) -> Array:
     return jnp.sum(x, axis=0)
 

@@ -1,0 +1,143 @@
+"""Fused bare-update contract.
+
+`metric.update(...)` on sum/mean/max/min array-state metrics runs as ONE
+cached jitted program per input signature (after the first, eager-validated
+call per signature) — the bare-update analogue of the fused forward
+(`tests/bases/test_fused_forward.py`), for epoch loops that update per step
+and compute once at the end. Pins: fused == eager values, first-call eager
+validation, permanent per-instance fallback on trace failure (host/string
+metrics), hyperparameter invalidation, pickle hygiene, and tracer bypass.
+"""
+from __future__ import annotations
+
+import pickle
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utils import checks
+
+RNG = np.random.RandomState(3)
+BATCHES = [
+    (jnp.asarray(RNG.rand(64).astype(np.float32)), jnp.asarray(RNG.randint(0, 2, 64)))
+    for _ in range(5)
+]
+
+
+@pytest.fixture(autouse=True)
+def _first_mode():
+    checks.set_validation_mode("first")
+    yield
+    checks.set_validation_mode("full")
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda: mt.Accuracy(),
+        lambda: mt.MeanMetric(),
+        lambda: mt.MaxMetric(),
+        lambda: mt.MeanSquaredError(),
+        lambda: mt.F1Score(num_classes=1, average="macro"),
+    ],
+    ids=["Accuracy", "MeanMetric", "MaxMetric", "MSE", "F1"],
+)
+def test_fused_update_equals_eager(factory):
+    fused = factory()
+    for p, t in BATCHES:
+        if isinstance(fused, (mt.MeanMetric, mt.MaxMetric)):
+            fused.update(p)
+        else:
+            fused.update(p, t)
+    assert fused._fused_update_program is not None, "fused update never engaged"
+
+    checks.set_validation_mode("full")  # forces the eager path throughout
+    eager = factory()
+    for p, t in BATCHES:
+        if isinstance(eager, (mt.MeanMetric, mt.MaxMetric)):
+            eager.update(p)
+        else:
+            eager.update(p, t)
+    assert eager._fused_update_program is None
+    np.testing.assert_allclose(
+        np.asarray(fused.compute()), np.asarray(eager.compute()), rtol=1e-6
+    )
+    assert fused._update_count == eager._update_count == len(BATCHES)
+
+
+def test_first_signature_call_stays_eager():
+    m = mt.Accuracy()
+    p, t = BATCHES[0]
+    m.update(p, t)
+    assert m._fused_update_program is None  # first call validated eagerly
+    m.update(p, t)
+    assert m._fused_update_program is not None
+    # a NEW signature drops to eager once, then fuses again
+    m.update(p[:32], t[:32])
+    m.update(p[:32], t[:32])
+    assert m._update_count == 4
+
+
+def test_host_string_metric_falls_back_permanently():
+    w = mt.WordErrorRate()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(3):
+            w.update(["hello world"], ["hello there"])
+    assert w._fused_update_ok is False
+    assert round(float(w.compute()), 4) == 0.5
+
+
+def test_hyperparameter_mutation_invalidates_program():
+    m = mt.Accuracy()
+    p, t = BATCHES[0]
+    m.update(p, t)
+    m.update(p, t)
+    assert m._fused_update_program is not None
+    m.threshold = 0.7
+    assert m._fused_update_program is None
+    m.update(p, t)  # rebuilds against the new constant without error
+    m.update(p, t)
+    assert m._fused_update_program is not None
+
+
+def test_pickle_drops_program_and_resumes():
+    m = mt.MeanMetric()
+    for p, _ in BATCHES:
+        m.update(p)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2._fused_update_program is None
+    m2.update(BATCHES[0][0])
+    np.testing.assert_allclose(
+        float(m2.compute()),
+        float(np.mean([np.asarray(p).mean() for p, _ in BATCHES] + [np.asarray(BATCHES[0][0]).mean()])),
+        rtol=1e-6,
+    )
+
+
+def test_traced_update_bypasses_fusion():
+    m = mt.SumMetric()
+    m.update(jnp.ones(8))
+    m.update(jnp.ones(8))  # fused from here on for this signature
+
+    @jax.jit
+    def step(x):
+        inner = mt.SumMetric()
+        inner.update(x)  # tracer input: must run inline, not dispatch a program
+        return inner.value
+
+    out = step(jnp.ones(8))
+    assert float(out) == 8.0
+    assert float(m.compute()) == 16.0
+
+
+def test_weighted_kwargs_fuse():
+    m = mt.MeanMetric()
+    for v in range(4):
+        m.update(jnp.asarray([float(v)]), weight=jnp.asarray([2.0]))
+    assert m._fused_update_program is not None
+    assert float(m.compute()) == 1.5
