@@ -319,9 +319,7 @@ class Metric(ABC):
                 update(*args, **kwargs)
             if signature is not None:
                 # recorded only AFTER the eager call validated this signature
-                self._fused_seen_signatures[signature] = None
-                while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
-                    self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
+                self._record_fused_signature(signature)
             if self.compute_on_cpu:
                 self._move_list_states_to_host()
 
@@ -425,6 +423,15 @@ class Metric(ABC):
     _FUSED_SIG_CAP = 4096
 
     _fusable_cached: Optional[bool] = None
+
+    def _record_fused_signature(self, signature: tuple) -> None:
+        """Record an eager-validated input signature in the FIFO-capped cache
+        (single source of truth for the cap/eviction policy)."""
+        self._fused_seen_signatures[signature] = None
+        while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
+            # FIFO: evict the OLDEST signature (set.pop would be arbitrary
+            # and could flap the hot signature out of the cache)
+            self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
 
     def _fusable_states(self) -> bool:
         """True when every state merges by sum/mean/max/min (no list states).
@@ -633,9 +640,7 @@ class Metric(ABC):
             # chunk must not license the unvalidated scan path for a retry
             # (same contract as the single-step path below).
             result = self._run_many_eager(with_values, args, kwargs, force_reduce_eager=True)
-            self._fused_seen_signatures[signature] = None
-            while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
-                self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
+            self._record_fused_signature(signature)
             return result
         try:
             program = self._many_program_vals if with_values else self._many_program_novals
@@ -811,11 +816,7 @@ class Metric(ABC):
             self._computed = None
             return batch_val
         result = self._forward_reduce_state_update_eager(*args, **kwargs)
-        self._fused_seen_signatures[signature] = None
-        while len(self._fused_seen_signatures) > self._FUSED_SIG_CAP:
-            # FIFO: evict the OLDEST signature (set.pop would be arbitrary and
-            # could flap the hot signature out of the cache)
-            self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
+        self._record_fused_signature(signature)
         return result
 
     def _forward_reduce_state_update_eager(self, *args: Any, **kwargs: Any) -> Any:

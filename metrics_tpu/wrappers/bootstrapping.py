@@ -14,6 +14,7 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None) -> np.ndarray:
@@ -79,8 +80,27 @@ class BootStrapper(Metric):
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.RandomState()
 
+    # one-program multinomial fast path (lazily built; dropped on pickle)
+    _boot_program = None
+    _boot_versions = None  # clone _fused_version tuple the program was built against
+    _boot_ok = True
+    _record_boot_signature_after = None
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = super().__getstate__()
+        state.pop("_boot_program", None)  # jit closure: rebuilt lazily
+        return state
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each.
+
+        Multinomial draws are fixed-shape, so after the first (eager, fully
+        validated) call per input signature ALL clones run as ONE jitted
+        program: the program takes every clone's state pytree plus a
+        ``(num_bootstraps, N)`` index matrix, vmaps resample+update across
+        clones, and returns the new per-clone states — one dispatch per
+        step instead of ~3 per clone. Clone states stay materialized on the
+        instances (direct ``boot.metrics[i]`` access is always current).
 
         Poisson draws have a different length almost every time, and XLA
         compiles one program per novel shape — fed whole, each draw forces a
@@ -90,7 +110,7 @@ class BootStrapper(Metric):
         ~log2(N) shapes; streaming equivalence of chunked updates is the
         framework's core invariant (reduce-state commutes with batch
         concatenation), pinned suite-wide by the multi-batch differential
-        tests. Multinomial draws are already fixed-shape and go whole.
+        tests.
         """
         args_sizes = apply_to_collection(args, jax.Array, len)
         kwargs_sizes = apply_to_collection(kwargs, jax.Array, len)
@@ -100,8 +120,17 @@ class BootStrapper(Metric):
             size = next(iter(kwargs_sizes.values()))
         else:
             raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        object.__setattr__(self, "_record_boot_signature_after", None)
+        handled, predrawn = self._try_fused_multinomial(size, args, kwargs)
+        if handled:
+            return
         for idx in range(self.num_bootstraps):
-            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            # a failed fused attempt already consumed this step's draws: reuse
+            # them so the seeded RNG stream stays identical to a never-fused run
+            sample_idx = (
+                predrawn[idx] if predrawn is not None
+                else _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            )
             if sample_idx.size == 0:
                 # an empty poisson draw still counts as this clone's update —
                 # without this, compute() would emit a spurious
@@ -136,6 +165,87 @@ class BootStrapper(Metric):
             else:
                 # one draw = one update, however many chunks carried it
                 self.metrics[idx]._update_count = update_count_before + 1
+        sig = self._record_boot_signature_after
+        if sig is not None:
+            # the eager pass validated this signature: license the fused path
+            object.__setattr__(self, "_record_boot_signature_after", None)
+            self._record_fused_signature(sig)
+
+    def _try_fused_multinomial(self, size: int, args: tuple, kwargs: dict):
+        """Run all clones' resample+update as ONE jitted program.
+
+        Returns ``(handled, predrawn)``: ``handled`` True when the fused
+        program ran; ``predrawn`` carries this step's already-consumed index
+        draws when a fused attempt failed AFTER drawing, so the eager
+        fallback reuses them and the seeded RNG stream stays identical to a
+        never-fused run.
+
+        Gating mirrors the fused-update contract (`metric.py`): multinomial
+        strategy only (static shapes), a fusable base metric (array states —
+        a cat-state base would retrace per step as its lists grow),
+        validation mode not "full", concrete inputs, first call per input
+        signature eager, permanent fallback on trace failure.
+        """
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        if (
+            not self._boot_ok
+            or self.sampling_strategy != "multinomial"
+            or not self.metrics[0]._fusable_states()
+            or _get_validation_mode() == "full"
+            or any(isinstance(leaf, jax.core.Tracer) for leaf in jax.tree.flatten((args, kwargs))[0])
+        ):
+            return False, None
+        if self._fused_seen_signatures is None:
+            self._fused_seen_signatures = {}
+        signature = ("__boot__", size, self._forward_signature(args, kwargs))
+        if signature not in self._fused_seen_signatures:
+            # eager (validating) first pass runs below; record only on success
+            self._record_boot_signature_after = signature
+            return False, None
+        versions = tuple(m._fused_version for m in self.metrics)
+        if len(set(versions)) != 1:
+            # a single clone was individually mutated: clone configs may
+            # diverge, and the program bakes clone 0's — stay eager
+            return False, None
+        # draw BEFORE the fallible block: on failure the eager fallback
+        # reuses these, so the stream is consumed exactly once per step
+        draws = np.stack(
+            [_bootstrap_sampler(size, "multinomial", self._rng) for _ in range(self.num_bootstraps)]
+        )
+        try:
+            if self._boot_program is None or self._boot_versions != versions:
+                init, upd, _ = self.metrics[0].as_functions()
+
+                def program(states, idx, *a, **k):
+                    def one(state, rows):
+                        ra = apply_to_collection(a, jax.Array, jnp.take, rows, axis=0)
+                        rk = apply_to_collection(k, jax.Array, jnp.take, rows, axis=0)
+                        return upd(state, *ra, **rk)
+
+                    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+                    out = jax.vmap(one)(stacked, idx)
+                    return [jax.tree.map(lambda x: x[i], out) for i in range(len(states))]
+
+                object.__setattr__(self, "_boot_program", jax.jit(program))
+                object.__setattr__(self, "_boot_versions", versions)
+            states = [m.metric_state for m in self.metrics]
+            new_states = self._boot_program(states, jnp.asarray(draws), *args, **kwargs)
+        except Exception as exc:  # noqa: BLE001 — any trace/compile failure
+            rank_zero_warn(
+                f"Fused bootstrap program for `{type(self.metrics[0]).__name__}` raised "
+                f"{type(exc).__name__}: {exc}. Falling back to the per-clone eager path "
+                "permanently for this instance."
+            )
+            object.__setattr__(self, "_boot_ok", False)
+            object.__setattr__(self, "_boot_program", None)
+            return False, draws
+        for m, st in zip(self.metrics, new_states):
+            for name, value in st.items():
+                setattr(m, name, value)
+            m._update_count += 1
+            m._computed = None
+        return True, None
 
     def compute(self) -> Dict[str, jax.Array]:
         """mean/std/quantile/raw over the bootstrap distribution."""

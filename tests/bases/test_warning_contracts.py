@@ -73,6 +73,29 @@ class TestBatchedFallbackWarning:
         assert stoi._update_count > 0
         assert jnp.isfinite(stoi.compute())
 
+    def test_stoi_fused_update_warns_and_falls_back(self):
+        """The fused bare-update path hits the same host-DSP trace wall: it
+        must warn once and permanently drop to the eager per-op update."""
+        from metrics_tpu.utils import checks
+
+        fs = 10000
+        rng = np.random.RandomState(1)
+        target = jnp.asarray(rng.randn(6000).astype(np.float32))
+        preds = target + 0.1 * jnp.asarray(rng.randn(6000).astype(np.float32))
+        stoi = mt.ShortTimeObjectiveIntelligibility(fs)
+        prev_mode = checks._get_validation_mode()
+        checks.set_validation_mode("first")
+        try:
+            stoi.update(preds, target)  # first signature call: eager
+            with _catch("Fused update for `ShortTimeObjectiveIntelligibility`"):
+                stoi.update(preds, target)  # fusion attempt -> fallback
+        finally:
+            checks.set_validation_mode(prev_mode)
+        assert stoi._fused_update_ok is False
+        stoi.update(preds, target)
+        assert stoi._update_count == 3
+        assert jnp.isfinite(stoi.compute())
+
 
 class TestEmptyCorpusWarning:
     def test_bert_score_empty_inputs_warn(self):

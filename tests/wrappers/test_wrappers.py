@@ -95,6 +95,86 @@ class TestBootStrapper:
         assert all(m._update_count == 2 for m in boot.metrics)
 
 
+class TestBootStrapperFused:
+    def test_fused_multinomial_matches_eager_bit_exact(self):
+        """The one-program multinomial path replays the eager per-clone RNG
+        stream, so seeded clone states are identical either way."""
+        from metrics_tpu.utils import checks
+
+        batches = [
+            (jnp.asarray(_rng.randn(64).astype(np.float32)), jnp.asarray(_rng.randn(64).astype(np.float32)))
+            for _ in range(4)
+        ]
+
+        def run(mode):
+            checks.set_validation_mode(mode)
+            checks._seen_check_keys.clear()
+            b = BootStrapper(MeanSquaredError(), num_bootstraps=5, sampling_strategy="multinomial")
+            b._rng = np.random.RandomState(42)
+            for p, t in batches:
+                b.update(p, t)
+            return b
+
+        prev_mode = checks._get_validation_mode()
+        try:
+            fused = run("first")
+            eager = run("full")
+        finally:
+            checks.set_validation_mode(prev_mode)
+        assert fused._boot_program is not None, "fused bootstrap never engaged"
+        assert eager._boot_program is None
+        for fm, em in zip(fused.metrics, eager.metrics):
+            np.testing.assert_allclose(
+                np.asarray(fm.sum_squared_error), np.asarray(em.sum_squared_error), rtol=1e-6
+            )
+            assert fm._update_count == em._update_count == len(batches)
+        np.testing.assert_allclose(
+            np.asarray(fused.compute()["mean"]), np.asarray(eager.compute()["mean"]), rtol=1e-6
+        )
+
+    def test_fused_multinomial_cat_state_base_stays_eager(self):
+        """A cat-state base metric would retrace the program every step as
+        its lists grow (unbounded compile cache) — the gate must keep it on
+        the eager path."""
+        from metrics_tpu import SpearmanCorrCoef
+        from metrics_tpu.utils import checks
+
+        prev_mode = checks._get_validation_mode()
+        try:
+            checks.set_validation_mode("first")
+            b = BootStrapper(SpearmanCorrCoef(), num_bootstraps=3, sampling_strategy="multinomial")
+            p = jnp.asarray(_rng.rand(32).astype(np.float32))
+            t = jnp.asarray(_rng.rand(32).astype(np.float32))
+            for _ in range(3):
+                b.update(p, t)
+            assert b._boot_program is None
+            assert b._boot_ok  # gated, not failed
+            assert all(m._update_count == 3 for m in b.metrics)
+        finally:
+            checks.set_validation_mode(prev_mode)
+
+    def test_fused_multinomial_clone_mutation_falls_back(self):
+        """Mutating one clone's hyperparameters de-uniformizes the clone set:
+        the baked program would apply clone 0's config, so the path must
+        drop to eager (which honors each clone's own config)."""
+        from metrics_tpu.utils import checks
+
+        p = jnp.asarray(_rng.rand(32).astype(np.float32))
+        t = jnp.asarray(_rng.rand(32).astype(np.float32))
+        prev_mode = checks._get_validation_mode()
+        try:
+            checks.set_validation_mode("first")
+            b = BootStrapper(MeanSquaredError(), num_bootstraps=3, sampling_strategy="multinomial")
+            b.update(p, t)
+            b.update(p, t)
+            assert b._boot_program is not None
+            b.metrics[1].squared = False  # version bump on one clone only
+            b.update(p, t)
+            assert all(m._update_count == 3 for m in b.metrics)
+        finally:
+            checks.set_validation_mode(prev_mode)
+
+
 class TestClasswiseWrapper:
     def test_names_and_values(self):
         metric = ClasswiseWrapper(Accuracy(average="none", num_classes=NUM_CLASSES))
