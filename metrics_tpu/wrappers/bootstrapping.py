@@ -91,6 +91,17 @@ class BootStrapper(Metric):
         state.pop("_boot_program", None)  # jit closure: rebuilt lazily
         return state
 
+    @staticmethod
+    def _clone_config(m: Metric) -> Dict[str, str]:
+        """Comparable snapshot of a clone's hyperparameters (non-state public
+        attrs, by repr — a false inequality only costs the fast path)."""
+        skip = ("update", "compute", "compute_on_cpu")
+        return {
+            k: repr(v)
+            for k, v in sorted(m.__dict__.items())
+            if not k.startswith("_") and k not in m._defaults and k not in skip
+        }
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each.
 
@@ -204,10 +215,23 @@ class BootStrapper(Metric):
             self._record_boot_signature_after = signature
             return False, None
         versions = tuple(m._fused_version for m in self.metrics)
-        if len(set(versions)) != 1:
-            # a single clone was individually mutated: clone configs may
-            # diverge, and the program bakes clone 0's — stay eager
-            return False, None
+        if versions != self._boot_versions:
+            # some clone's hyperparameters changed since the program was
+            # built (or never built). The program bakes clone 0's config for
+            # ALL clones, so it is only valid while the clones are
+            # identically configured — verify actual config equality (the
+            # version counters alone cannot distinguish a uniform mutation
+            # from per-clone divergent ones).
+            cfg0 = self._clone_config(self.metrics[0])
+            if any(self._clone_config(m) != cfg0 for m in self.metrics[1:]):
+                rank_zero_warn(
+                    "BootStrapper clones are no longer identically configured; the "
+                    "one-program multinomial fast path is disabled for this instance "
+                    "and updates run the per-clone eager path."
+                )
+                object.__setattr__(self, "_boot_ok", False)
+                object.__setattr__(self, "_boot_program", None)
+                return False, None
         # draw BEFORE the fallible block: on failure the eager fallback
         # reuses these, so the stream is consumed exactly once per step
         draws = np.stack(

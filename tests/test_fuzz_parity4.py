@@ -1,0 +1,205 @@
+"""Fuzz-parity wave 4: the raw-row deferral paths under hostile streams.
+
+Round 4 moved cat-state canonicalization out of ``update`` (raw-row
+buffering — `docs/performance.md`). This wave fuzzes exactly the edges that
+rework touched, always against the mounted reference: random batch ranks
+and dtypes, heterogeneous extra dims across batches, ``ignore_index``
+filtering, and OBSERVATIONS INTERLEAVED MID-STREAM (canonicalization hook,
+pickle round-trip, state_dict) — the result must match the reference no
+matter when the rows were canonicalized.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers.reference_oracle import get_reference
+
+_ref = get_reference()
+pytestmark = [
+    pytest.mark.skipif(_ref is None, reason="reference mount unavailable"),
+    pytest.mark.slow,  # deep-coverage tier (see docs/testing.md)
+]
+
+import metrics_tpu as mt  # noqa: E402
+
+N_VARIATIONS = 4
+
+
+def _observe(m, rng):
+    """Randomly observe the metric mid-stream; must not perturb the result."""
+    k = rng.randint(0, 3)
+    if k == 0:
+        m._canonicalize_list_states()
+        return m
+    if k == 1:
+        return pickle.loads(pickle.dumps(m))
+    m.persistent(True)
+    m.state_dict()
+    return m
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+@pytest.mark.parametrize(
+    "name,kwargs",
+    [
+        ("RetrievalMRR", {}),
+        ("RetrievalMAP", {"ignore_index": -1}),
+        ("RetrievalNormalizedDCG", {}),
+        ("RetrievalFallOut", {"ignore_index": -1}),
+        ("RetrievalPrecision", {"k": 3}),
+    ],
+)
+def test_retrieval_raw_rows_fuzz(name, kwargs, seed):
+    rng = np.random.RandomState(100 + seed)
+    ours = getattr(mt, name)(**kwargs)
+    ref = getattr(_ref, name)(**kwargs)
+    for _ in range(rng.randint(2, 5)):
+        # random rank: flat rows or (queries, docs) matrices
+        if rng.rand() < 0.5:
+            q, d = rng.randint(2, 5), rng.randint(4, 9)
+            shape = (q, d)
+            idx = np.repeat(np.arange(q), d).reshape(q, d)
+        else:
+            n = rng.randint(8, 33)
+            shape = (n,)
+            idx = rng.randint(0, 4, n)
+        preds = rng.rand(*shape).astype(np.float32)
+        target = rng.randint(0, 2, shape)
+        if kwargs.get("ignore_index") == -1:
+            mask = rng.rand(*shape) < 0.2
+            target = np.where(mask & (target.sum() > 1), -1, target)
+        ours.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx))
+        ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(idx))
+        ours = _observe(ours, rng)
+    np.testing.assert_allclose(
+        np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5, rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+@pytest.mark.parametrize("case", ["binary", "multiclass", "multidim_varying", "multilabel"])
+def test_exact_curves_raw_rows_fuzz(case, seed):
+    rng = np.random.RandomState(200 + seed)
+    C = 4
+    if case == "binary":
+        ours, ref = mt.PrecisionRecallCurve(pos_label=1), _ref.PrecisionRecallCurve(pos_label=1)
+        make = lambda: (rng.rand(rng.randint(8, 33)).astype(np.float32),)
+        batches = [(p, rng.randint(0, 2, p.shape[0])) for (p,) in (make() for _ in range(3))]
+    elif case == "multiclass":
+        ours, ref = mt.PrecisionRecallCurve(num_classes=C), _ref.PrecisionRecallCurve(num_classes=C)
+        batches = []
+        for _ in range(3):
+            n = rng.randint(8, 33)
+            p = rng.rand(n, C).astype(np.float32)
+            batches.append((p / p.sum(1, keepdims=True), rng.randint(0, C, n)))
+    elif case == "multidim_varying":
+        # extra dim varies per batch: hits the heterogeneous-shape fallback
+        ours, ref = mt.PrecisionRecallCurve(num_classes=C), _ref.PrecisionRecallCurve(num_classes=C)
+        batches = []
+        for x in rng.randint(2, 7, size=3):
+            n = rng.randint(4, 9)
+            p = rng.rand(n, C, x).astype(np.float32)
+            batches.append((p / p.sum(1, keepdims=True), rng.randint(0, C, (n, x))))
+    else:  # multilabel
+        ours, ref = mt.PrecisionRecallCurve(num_classes=C), _ref.PrecisionRecallCurve(num_classes=C)
+        batches = []
+        for _ in range(3):
+            n = rng.randint(8, 33)
+            batches.append((rng.rand(n, C).astype(np.float32), rng.randint(0, 2, (n, C))))
+    for p, t in batches:
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.tensor(p), torch.tensor(t))
+        ours = _observe(ours, rng)
+    a, b = ours.compute(), ref.compute()
+    for xs, ys in zip(a, b):
+        xs = xs if isinstance(xs, list) else [xs]
+        ys = ys if isinstance(ys, list) else [ys]
+        for x, y in zip(xs, ys):
+            np.testing.assert_allclose(np.asarray(x), y.numpy(), atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+@pytest.mark.parametrize("mode", ["binary", "multiclass", "multilabel"])
+def test_auroc_raw_rows_fuzz(mode, seed):
+    rng = np.random.RandomState(300 + seed)
+    C = 4
+    if mode == "binary":
+        ours, ref = mt.AUROC(pos_label=1), _ref.AUROC(pos_label=1)
+        batches = [
+            (rng.rand(n).astype(np.float32), rng.randint(0, 2, n))
+            for n in rng.randint(16, 49, size=3)
+        ]
+    elif mode == "multiclass":
+        ours, ref = mt.AUROC(num_classes=C), _ref.AUROC(num_classes=C)
+        batches = []
+        for n in rng.randint(16, 49, size=3):
+            p = rng.rand(n, C).astype(np.float32)
+            t = rng.randint(0, C, n)
+            t[:C] = np.arange(C)  # every class present
+            batches.append((p / p.sum(1, keepdims=True), t))
+    else:
+        ours, ref = mt.AUROC(num_classes=C, average="macro"), _ref.AUROC(num_classes=C, average="macro")
+        batches = []
+        for n in rng.randint(16, 49, size=3):
+            t = rng.randint(0, 2, (n, C))
+            t[0], t[1] = 0, 1  # no degenerate single-class columns
+            batches.append((rng.rand(n, C).astype(np.float32), t))
+    for p, t in batches:
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.tensor(p), torch.tensor(t))
+        ours = _observe(ours, rng)
+    np.testing.assert_allclose(
+        np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5, rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+def test_regression_and_cat_raw_rows_fuzz(seed):
+    rng = np.random.RandomState(400 + seed)
+    pairs = [
+        (mt.SpearmanCorrCoef(), _ref.SpearmanCorrCoef(), True),
+        (mt.CosineSimilarity(reduction="mean"), _ref.CosineSimilarity(reduction="mean"), False),
+        (mt.CatMetric(), _ref.CatMetric(), None),
+    ]
+    for ours, ref, flat in pairs:
+        for _ in range(3):
+            n = rng.randint(8, 33)
+            if flat is None:  # CatMetric: any shape
+                v = rng.randn(n).astype(np.float32)
+                ours.update(jnp.asarray(v))
+                ref.update(torch.tensor(v))
+            elif flat:
+                p, t = rng.randn(n).astype(np.float32), rng.randn(n).astype(np.float32)
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(torch.tensor(p), torch.tensor(t))
+            else:
+                p = rng.randn(n, 6).astype(np.float32)
+                t = (p + 0.3 * rng.randn(n, 6)).astype(np.float32)
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(torch.tensor(p), torch.tensor(t))
+            ours = _observe(ours, rng)
+        np.testing.assert_allclose(
+            np.asarray(ours.compute()).ravel(), ref.compute().numpy().ravel(), atol=1e-5, rtol=1e-4
+        )
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
+@pytest.mark.parametrize("name", ["UniversalImageQualityIndex", "SpectralAngleMapper"])
+def test_image_raw_rows_fuzz(name, seed):
+    rng = np.random.RandomState(500 + seed)
+    ours, ref = getattr(mt, name)(), getattr(_ref, name)()
+    for _ in range(2):
+        b = rng.randint(1, 4)
+        t = rng.rand(b, 3, 16, 16).astype(np.float32)
+        p = np.clip(t + 0.05 * rng.randn(*t.shape), 0, 1).astype(np.float32)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.tensor(p), torch.tensor(t))
+        ours = _observe(ours, rng)
+    np.testing.assert_allclose(
+        np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-4, rtol=1e-4
+    )

@@ -96,9 +96,10 @@ class TestBootStrapper:
 
 
 class TestBootStrapperFused:
-    def test_fused_multinomial_matches_eager_bit_exact(self):
+    def test_fused_multinomial_matches_eager_seeded(self):
         """The one-program multinomial path replays the eager per-clone RNG
-        stream, so seeded clone states are identical either way."""
+        stream: seeded runs use identical resamples, and clone states agree
+        with the eager path up to XLA float reassociation (rtol ~1e-6)."""
         from metrics_tpu.utils import checks
 
         batches = [
@@ -169,8 +170,51 @@ class TestBootStrapperFused:
             b.update(p, t)
             assert b._boot_program is not None
             b.metrics[1].squared = False  # version bump on one clone only
-            b.update(p, t)
+            with pytest.warns(UserWarning, match="no longer identically configured"):
+                b.update(p, t)
+            assert b._boot_ok is False  # divergent configs: fast path disabled
             assert all(m._update_count == 3 for m in b.metrics)
+        finally:
+            checks.set_validation_mode(prev_mode)
+
+    def test_fused_multinomial_divergent_uniform_bumps_fall_back(self):
+        """Every clone mutated ONCE to a DIFFERENT value keeps the version
+        counters uniform — the gate must compare actual configs, not bump
+        counts, and honor each clone's own config (review regression)."""
+        from metrics_tpu import Accuracy
+        from metrics_tpu.utils import checks
+
+        rng = np.random.RandomState(5)
+        p = jnp.asarray(rng.rand(64).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, 64))
+        prev_mode = checks._get_validation_mode()
+        try:
+            checks.set_validation_mode("first")
+
+            def run(fused):
+                checks._seen_check_keys.clear()
+                b = BootStrapper(Accuracy(), num_bootstraps=3, sampling_strategy="multinomial")
+                b._rng = np.random.RandomState(11)
+                b.update(p, t)
+                b.update(p, t)
+                if fused:
+                    assert b._boot_program is not None
+                for i, thr in enumerate((0.1, 0.2, 0.9)):
+                    b.metrics[i].threshold = thr  # uniform bump, divergent values
+                if not fused:
+                    object.__setattr__(b, "_boot_ok", False)  # force eager truth
+                import warnings
+
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    b.update(p, t)
+                return b
+
+            got = run(fused=True)
+            want = run(fused=False)
+            assert got._boot_ok is False  # divergence detected and disabled
+            for gm, wm in zip(got.metrics, want.metrics):
+                np.testing.assert_allclose(np.asarray(gm.tp), np.asarray(wm.tp))
         finally:
             checks.set_validation_mode(prev_mode)
 
