@@ -1,0 +1,108 @@
+"""Shared machinery: one-program fan-out over identically-configured clones.
+
+`BootStrapper` (resampled clones) and `MultioutputWrapper` (per-column
+clones) both run their whole clone fleet as ONE jitted program — stack the
+clone states, vmap the base metric's pure update, unstack — after an
+eager-validated first call per input signature. This module holds the parts
+that must stay in sync between them: the config-drift guard (version
+counters alone cannot distinguish a uniform mutation from divergent
+per-clone ones), program build/refresh keyed on the wrapper's AND every
+clone's ``_fused_version`` (a wrapper-level hyperparameter like
+``output_dim`` is baked into the program closure too), execution with
+permanent per-instance fallback, and the clone state write-back.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence
+
+import jax
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def clone_config(m: Metric) -> Dict[str, str]:
+    """Comparable snapshot of a clone's hyperparameters (non-state public
+    attrs, by repr — a false inequality only costs the fast path)."""
+    skip = ("update", "compute", "compute_on_cpu")
+    return {
+        k: repr(v)
+        for k, v in sorted(m.__dict__.items())
+        if not k.startswith("_") and k not in m._defaults and k not in skip
+    }
+
+
+def run_fanout(
+    wrapper: Metric,
+    clones: Sequence[Metric],
+    build_program: Callable[[Callable], Callable],
+    call_args: tuple,
+    call_kwargs: dict,
+    *,
+    label: str,
+    program_attr: str,
+    versions_attr: str,
+    ok_attr: str,
+) -> bool:
+    """Build/refresh and execute the fused clone program; True on success.
+
+    ``build_program(upd)`` receives the base metric's pure update and returns
+    ``program(states, *call_args, **call_kwargs) -> list[state_dict]``. Any
+    failure (config drift across clones, trace/compile error) warns once,
+    permanently disables the fast path for this instance, and returns False
+    so the caller falls back to the per-clone eager path.
+    """
+    versions = (wrapper._fused_version,) + tuple(m._fused_version for m in clones)
+    if versions != getattr(wrapper, versions_attr):
+        cfg0 = clone_config(clones[0])
+        if any(clone_config(m) != cfg0 for m in clones[1:]):
+            rank_zero_warn(
+                f"{label} clones are no longer identically configured; the "
+                "one-program fan-out is disabled for this instance and updates "
+                "run the per-clone eager path."
+            )
+            object.__setattr__(wrapper, ok_attr, False)
+            object.__setattr__(wrapper, program_attr, None)
+            return False
+    try:
+        if getattr(wrapper, program_attr) is None or getattr(wrapper, versions_attr) != versions:
+            _, upd, _ = clones[0].as_functions()
+            object.__setattr__(wrapper, program_attr, jax.jit(build_program(upd)))
+            object.__setattr__(wrapper, versions_attr, versions)
+        states = [m.metric_state for m in clones]
+        new_states = getattr(wrapper, program_attr)(states, *call_args, **call_kwargs)
+    except Exception as exc:  # noqa: BLE001 — any trace/compile failure
+        rank_zero_warn(
+            f"Fused fan-out program for `{type(clones[0]).__name__}` raised "
+            f"{type(exc).__name__}: {exc}. Falling back to the per-clone eager "
+            "path permanently for this instance."
+        )
+        object.__setattr__(wrapper, ok_attr, False)
+        object.__setattr__(wrapper, program_attr, None)
+        return False
+    for m, st in zip(clones, new_states):
+        for name, value in st.items():
+            setattr(m, name, value)
+        m._update_count += 1
+        m._computed = None
+    return True
+
+
+def fanout_gate(wrapper: Metric, clones: List[Metric], args: tuple, kwargs: dict, ok_attr: str) -> bool:
+    """The shared preconditions: healthy, fusable base, gated validation
+    mode, concrete device-array inputs (numpy leaves stay eager — the
+    validated eager path is what defines accepted inputs)."""
+    from metrics_tpu.utils.checks import _get_validation_mode
+
+    return (
+        getattr(wrapper, ok_attr)
+        and clones[0]._fusable_states()
+        and _get_validation_mode() != "full"
+        and all(
+            isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer)
+            for leaf in jax.tree.flatten((args, kwargs))[0]
+        )
+    )
+
+
+__all__ = ["clone_config", "run_fanout", "fanout_gate"]

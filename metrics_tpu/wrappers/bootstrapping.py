@@ -14,7 +14,7 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.wrappers._fanout import fanout_gate, run_fanout
 
 
 def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None) -> np.ndarray:
@@ -90,17 +90,6 @@ class BootStrapper(Metric):
         state = super().__getstate__()
         state.pop("_boot_program", None)  # jit closure: rebuilt lazily
         return state
-
-    @staticmethod
-    def _clone_config(m: Metric) -> Dict[str, str]:
-        """Comparable snapshot of a clone's hyperparameters (non-state public
-        attrs, by repr — a false inequality only costs the fast path)."""
-        skip = ("update", "compute", "compute_on_cpu")
-        return {
-            k: repr(v)
-            for k, v in sorted(m.__dict__.items())
-            if not k.startswith("_") and k not in m._defaults and k not in skip
-        }
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each.
@@ -194,17 +183,12 @@ class BootStrapper(Metric):
         Gating mirrors the fused-update contract (`metric.py`): multinomial
         strategy only (static shapes), a fusable base metric (array states —
         a cat-state base would retrace per step as its lists grow),
-        validation mode not "full", concrete inputs, first call per input
-        signature eager, permanent fallback on trace failure.
+        validation mode not "full", concrete device-array inputs, first call
+        per input signature eager, permanent fallback on trace failure —
+        shared machinery in `wrappers/_fanout.py`.
         """
-        from metrics_tpu.utils.checks import _get_validation_mode
-
-        if (
-            not self._boot_ok
-            or self.sampling_strategy != "multinomial"
-            or not self.metrics[0]._fusable_states()
-            or _get_validation_mode() == "full"
-            or any(isinstance(leaf, jax.core.Tracer) for leaf in jax.tree.flatten((args, kwargs))[0])
+        if self.sampling_strategy != "multinomial" or not fanout_gate(
+            self, self.metrics, args, kwargs, "_boot_ok"
         ):
             return False, None
         if self._fused_seen_signatures is None:
@@ -214,62 +198,37 @@ class BootStrapper(Metric):
             # eager (validating) first pass runs below; record only on success
             self._record_boot_signature_after = signature
             return False, None
-        versions = tuple(m._fused_version for m in self.metrics)
-        if versions != self._boot_versions:
-            # some clone's hyperparameters changed since the program was
-            # built (or never built). The program bakes clone 0's config for
-            # ALL clones, so it is only valid while the clones are
-            # identically configured — verify actual config equality (the
-            # version counters alone cannot distinguish a uniform mutation
-            # from per-clone divergent ones).
-            cfg0 = self._clone_config(self.metrics[0])
-            if any(self._clone_config(m) != cfg0 for m in self.metrics[1:]):
-                rank_zero_warn(
-                    "BootStrapper clones are no longer identically configured; the "
-                    "one-program multinomial fast path is disabled for this instance "
-                    "and updates run the per-clone eager path."
-                )
-                object.__setattr__(self, "_boot_ok", False)
-                object.__setattr__(self, "_boot_program", None)
-                return False, None
         # draw BEFORE the fallible block: on failure the eager fallback
         # reuses these, so the stream is consumed exactly once per step
         draws = np.stack(
             [_bootstrap_sampler(size, "multinomial", self._rng) for _ in range(self.num_bootstraps)]
         )
-        try:
-            if self._boot_program is None or self._boot_versions != versions:
-                init, upd, _ = self.metrics[0].as_functions()
 
-                def program(states, idx, *a, **k):
-                    def one(state, rows):
-                        ra = apply_to_collection(a, jax.Array, jnp.take, rows, axis=0)
-                        rk = apply_to_collection(k, jax.Array, jnp.take, rows, axis=0)
-                        return upd(state, *ra, **rk)
+        def build(upd):
+            def program(states, idx, *a, **k):
+                def one(state, rows):
+                    ra = apply_to_collection(a, jax.Array, jnp.take, rows, axis=0)
+                    rk = apply_to_collection(k, jax.Array, jnp.take, rows, axis=0)
+                    return upd(state, *ra, **rk)
 
-                    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
-                    out = jax.vmap(one)(stacked, idx)
-                    return [jax.tree.map(lambda x: x[i], out) for i in range(len(states))]
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+                out = jax.vmap(one)(stacked, idx)
+                return [jax.tree.map(lambda x: x[i], out) for i in range(len(states))]
 
-                object.__setattr__(self, "_boot_program", jax.jit(program))
-                object.__setattr__(self, "_boot_versions", versions)
-            states = [m.metric_state for m in self.metrics]
-            new_states = self._boot_program(states, jnp.asarray(draws), *args, **kwargs)
-        except Exception as exc:  # noqa: BLE001 — any trace/compile failure
-            rank_zero_warn(
-                f"Fused bootstrap program for `{type(self.metrics[0]).__name__}` raised "
-                f"{type(exc).__name__}: {exc}. Falling back to the per-clone eager path "
-                "permanently for this instance."
-            )
-            object.__setattr__(self, "_boot_ok", False)
-            object.__setattr__(self, "_boot_program", None)
-            return False, draws
-        for m, st in zip(self.metrics, new_states):
-            for name, value in st.items():
-                setattr(m, name, value)
-            m._update_count += 1
-            m._computed = None
-        return True, None
+            return program
+
+        ok = run_fanout(
+            self,
+            self.metrics,
+            build,
+            (jnp.asarray(draws),) + args,
+            kwargs,
+            label="BootStrapper",
+            program_attr="_boot_program",
+            versions_attr="_boot_versions",
+            ok_attr="_boot_ok",
+        )
+        return ok, (None if ok else draws)
 
     def compute(self) -> Dict[str, jax.Array]:
         """mean/std/quantile/raw over the bootstrap distribution."""

@@ -14,6 +14,7 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
+from metrics_tpu.wrappers._fanout import fanout_gate, run_fanout
 
 
 def _get_nan_indices(*tensors: jax.Array) -> jax.Array:
@@ -98,10 +99,81 @@ class MultioutputWrapper(Metric):
             args_kwargs_by_output.append((selected_args, selected_kwargs))
         return args_kwargs_by_output
 
+    # one-program column fan-out (remove_nans=False; lazily built, dropped on pickle)
+    _mo_program = None
+    _mo_versions = None
+    _mo_ok = True
+    _record_mo_signature_after = None
+
+    def __getstate__(self) -> dict:
+        state = super().__getstate__()
+        state.pop("_mo_program", None)  # jit closure: rebuilt lazily
+        return state
+
+    def _try_fused_columns(self, args: tuple, kwargs: dict) -> bool:
+        """Run every column clone's slice+update as ONE jitted program.
+
+        Same gating contract as the fused bootstrap: only for configurations
+        with static per-clone shapes (``remove_nans=False``,
+        ``squeeze_outputs=True``), a fusable base metric, validation mode not
+        "full", concrete device-array inputs, first call per signature eager,
+        identically-configured clones, permanent fallback on trace failure —
+        shared machinery in `wrappers/_fanout.py`. The program bakes
+        ``output_dim``; mutating it bumps this wrapper's ``_fused_version``,
+        which `run_fanout` watches for the rebuild.
+        """
+        if self.remove_nans or not self.squeeze_outputs or not fanout_gate(
+            self, self.metrics, args, kwargs, "_mo_ok"
+        ):
+            return False
+        if self._fused_seen_signatures is None:
+            self._fused_seen_signatures = {}
+        signature = ("__multioutput__", self._forward_signature(args, kwargs))
+        if signature not in self._fused_seen_signatures:
+            self._record_mo_signature_after = signature
+            return False
+        axis = self.output_dim
+
+        def build(upd):
+            def program(states, *a, **k):
+                # move the output axis to the front once, then vmap the child
+                # update over (columns, clone states) — the vmapped axis
+                # removal IS the squeeze
+                cols = jax.tree.map(lambda x: jnp.moveaxis(x, axis, 0), (a, k))
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+                def one(state, col):
+                    ca, ck = col
+                    return upd(state, *ca, **ck)
+
+                out = jax.vmap(one)(stacked, cols)
+                return [jax.tree.map(lambda x: x[i], out) for i in range(len(states))]
+
+            return program
+
+        return run_fanout(
+            self,
+            self.metrics,
+            build,
+            args,
+            kwargs,
+            label="MultioutputWrapper",
+            program_attr="_mo_program",
+            versions_attr="_mo_versions",
+            ok_attr="_mo_ok",
+        )
+
     def update(self, *args: Any, **kwargs: Any) -> None:
+        object.__setattr__(self, "_record_mo_signature_after", None)
+        if self._try_fused_columns(args, kwargs):
+            return
         reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
         for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
             metric.update(*selected_args, **selected_kwargs)
+        sig = self._record_mo_signature_after
+        if sig is not None:
+            object.__setattr__(self, "_record_mo_signature_after", None)
+            self._record_fused_signature(sig)
 
     def compute(self) -> List[jax.Array]:
         return [m.compute() for m in self.metrics]

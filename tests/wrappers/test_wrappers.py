@@ -219,6 +219,92 @@ class TestBootStrapperFused:
             checks.set_validation_mode(prev_mode)
 
 
+class TestMultioutputFused:
+    def test_fused_columns_match_eager(self):
+        """remove_nans=False runs all column clones as ONE program; values
+        must match the per-column eager path."""
+        from metrics_tpu.utils import checks
+
+        rng = np.random.RandomState(6)
+        p = rng.randn(64, 8).astype(np.float32)
+        t = (p + 0.3 * rng.randn(64, 8)).astype(np.float32)
+
+        def run(mode):
+            checks.set_validation_mode(mode)
+            checks._seen_check_keys.clear()
+            m = MultioutputWrapper(MeanSquaredError(), num_outputs=8, remove_nans=False)
+            for _ in range(3):
+                m.update(jnp.asarray(p), jnp.asarray(t))
+            return m
+
+        prev_mode = checks._get_validation_mode()
+        try:
+            fused = run("first")
+            eager = run("full")
+        finally:
+            checks.set_validation_mode(prev_mode)
+        assert fused._mo_program is not None, "fused column fan-out never engaged"
+        assert eager._mo_program is None
+        np.testing.assert_allclose(
+            [float(v) for v in fused.compute()], [float(v) for v in eager.compute()], rtol=1e-6
+        )
+        assert all(m._update_count == 3 for m in fused.metrics)
+
+    def test_output_dim_mutation_rebuilds_program(self):
+        """The program bakes output_dim; mutating it must trigger a rebuild
+        (wrapper-level version is part of the staleness key), not silently
+        slice the wrong axis (review regression)."""
+        from metrics_tpu.utils import checks
+
+        rng = np.random.RandomState(8)
+        p = rng.randn(8, 8).astype(np.float32)  # square: wrong axis = silent corruption
+        t = (p + 0.3 * rng.randn(8, 8)).astype(np.float32)
+        prev_mode = checks._get_validation_mode()
+        try:
+            checks.set_validation_mode("first")
+            m = MultioutputWrapper(MeanSquaredError(), num_outputs=8, remove_nans=False)
+            m.update(jnp.asarray(p), jnp.asarray(t))
+            m.update(jnp.asarray(p), jnp.asarray(t))
+            assert m._mo_program is not None
+            m.output_dim = 0
+            m.update(jnp.asarray(p), jnp.asarray(t))
+            want = MultioutputWrapper(MeanSquaredError(), num_outputs=8, output_dim=0, remove_nans=False)
+            object.__setattr__(want, "_mo_ok", False)  # eager truth
+            want.update(jnp.asarray(p), jnp.asarray(t))
+            # last update must have sliced ROWS (axis 0): compare against one
+            # eager row-sliced update on top of two column-sliced ones
+            base = MultioutputWrapper(MeanSquaredError(), num_outputs=8, remove_nans=False)
+            object.__setattr__(base, "_mo_ok", False)
+            base.update(jnp.asarray(p), jnp.asarray(t))
+            base.update(jnp.asarray(p), jnp.asarray(t))
+            base.output_dim = 0
+            base.update(jnp.asarray(p), jnp.asarray(t))
+            np.testing.assert_allclose(
+                [float(v) for v in m.compute()], [float(v) for v in base.compute()], rtol=1e-6
+            )
+        finally:
+            checks.set_validation_mode(prev_mode)
+
+    def test_remove_nans_default_stays_eager(self):
+        """remove_nans=True has data-dependent shapes — must never fuse."""
+        from metrics_tpu.utils import checks
+
+        rng = np.random.RandomState(7)
+        p = rng.randn(32, 4).astype(np.float32)
+        p[0, 0] = np.nan
+        t = rng.randn(32, 4).astype(np.float32)
+        prev_mode = checks._get_validation_mode()
+        try:
+            checks.set_validation_mode("first")
+            m = MultioutputWrapper(MeanSquaredError(), num_outputs=4)
+            for _ in range(3):
+                m.update(jnp.asarray(p), jnp.asarray(t))
+            assert m._mo_program is None
+            assert np.isfinite(float(m.compute()[0]))  # nan row removed
+        finally:
+            checks.set_validation_mode(prev_mode)
+
+
 class TestClasswiseWrapper:
     def test_names_and_values(self):
         metric = ClasswiseWrapper(Accuracy(average="none", num_classes=NUM_CLASSES))

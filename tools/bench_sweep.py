@@ -227,6 +227,7 @@ SWEEP = [
     ("BootStrapper(MeanSquaredError)", lambda mt: mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4), "reg", BATCH),
     ("BootStrapper(MeanSquaredError,multinomial)", lambda mt: mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4, sampling_strategy="multinomial"), "reg", BATCH),
     ("MultioutputWrapper(MeanSquaredError)", lambda mt: mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=8), "reg2d", BATCH),
+    ("MultioutputWrapper(MeanSquaredError,no_nan_filter)", lambda mt: mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=8, remove_nans=False), "reg2d", BATCH),
 ]
 
 # Explanations attached to outlier rows so no ratio is "unexplained".
@@ -274,6 +275,7 @@ OUTLIER_NOTES = {
     "BootStrapper(MeanSquaredError)": "poisson draws are split into power-of-two chunks (bounded compile cache — 8-19 ms/update steady-state in a fresh session, vs 10 s/update when every draw recompiled) but still run ~10 chunk programs x 4 clones per step against torch-CPU's zero dispatch cost, so the row sits at the tunnel session's per-program floor; the multinomial row is the single-program static-shape configuration (docs/performance.md)",
     "BootStrapper(MeanSquaredError,multinomial)": "static-shape resampling: every draw reuses one compiled take+update program per clone; ratio reflects tunnel dispatch overhead when below 1x",
     "MultioutputWrapper(MeanSquaredError)": "remove_nans=True makes output shapes data-dependent: one blocking mask read per update (the remote backend's ~100ms sync floor) vs torch-CPU's free in-process read; all per-column gathers are async behind that single read",
+    "MultioutputWrapper(MeanSquaredError,no_nan_filter)": "remove_nans=False has static shapes: all column clones run as ONE vmapped program per update (wrappers/multioutput.py fused fan-out)",
     # host-side text rows: both sides are host string processing; large
     # ratios come from the native C++ DP kernels (metrics_tpu/native/)
     "WordErrorRate": "native C++ Levenshtein kernel (metrics_tpu/native) vs the reference's python DP",
