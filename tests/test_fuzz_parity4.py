@@ -189,6 +189,35 @@ def test_regression_and_cat_raw_rows_fuzz(seed):
 
 
 @pytest.mark.parametrize("seed", range(N_VARIATIONS))
+def test_multioutput_fused_fanout_vs_reference(seed):
+    """The one-program column fan-out (remove_nans=False, deterministic) must
+    match the reference's per-column eager wrapper exactly."""
+    from metrics_tpu.utils import checks
+
+    rng = np.random.RandomState(600 + seed)
+    n_out = int(rng.choice([3, 8]))
+    prev_mode = checks._get_validation_mode()
+    try:
+        checks.set_validation_mode("first")
+        ours = mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=n_out, remove_nans=False)
+        ref = _ref.MultioutputWrapper(_ref.MeanSquaredError(), num_outputs=n_out, remove_nans=False)
+        n = rng.randint(8, 33)  # fixed per stream: fusion engages on the repeat
+        for _ in range(3):
+            p = rng.randn(n, n_out).astype(np.float32)
+            t = (p + 0.3 * rng.randn(n, n_out)).astype(np.float32)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(torch.tensor(p), torch.tensor(t))
+        assert ours._mo_program is not None  # fused path actually exercised
+        np.testing.assert_allclose(
+            [float(v) for v in ours.compute()],
+            [float(v) for v in ref.compute()],
+            rtol=1e-5,
+        )
+    finally:
+        checks.set_validation_mode(prev_mode)
+
+
+@pytest.mark.parametrize("seed", range(N_VARIATIONS))
 @pytest.mark.parametrize("name", ["UniversalImageQualityIndex", "SpectralAngleMapper"])
 def test_image_raw_rows_fuzz(name, seed):
     rng = np.random.RandomState(500 + seed)

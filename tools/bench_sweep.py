@@ -26,9 +26,12 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 BATCH, C = 4096, 16
 STEPS, TRIALS = 20, 3
 
-# eager rows pinned at documented sync/recompile floors (single-digit
-# updates/s): fewer timed steps keeps the whole sweep under ~10 minutes
-# without changing what the row measures
+# per-row timed-step overrides, two directions: sync/recompile-floor rows
+# (single-digit updates/s) get FEWER steps so the whole sweep stays under
+# ~10 minutes, while the fused multinomial fan-out gets MORE steps so its
+# one blocking clone-state sync per trial amortizes instead of dominating
+# the short trial (at the default 20 steps the ~110 ms sync reads as
+# ~5x fewer updates/s than steady state)
 EAGER_STEPS_OVERRIDE = {
     "BootStrapper(MeanSquaredError)": 10,
     "BootStrapper(MeanSquaredError,multinomial)": 100,
@@ -410,9 +413,11 @@ def main() -> None:
                 # AND trace-failing host-DSP metrics (e.g. native STOI) run
                 # the eager module update — their supported hot path
                 mode = "eager"
-                # single-digit-updates/s rows (documented sync/recompile
-                # floors) get fewer steps: at 20 steps x 3 trials the poisson
-                # BootStrapper row alone costs ~5 wall-clock minutes
+                # per-row step override (see EAGER_STEPS_OVERRIDE): fewer
+                # steps for sync/recompile-floor rows (at 20 steps x 3 trials
+                # the poisson BootStrapper row alone costs ~5 wall-clock
+                # minutes), more for the fused fan-out row whose per-trial
+                # sync must amortize
                 steps = EAGER_STEPS_OVERRIDE.get(name, STEPS)
                 jdata = list(data)
 
