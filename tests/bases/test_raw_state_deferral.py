@@ -201,6 +201,27 @@ def test_post_sync_state_dict_and_compute_on_reduced_cat_state():
     assert isinstance(m.preds, list)  # local state restored
 
 
+def test_compute_on_cpu_with_raw_curve_rows():
+    """Host-offloaded raw rows must stay numpy through canonicalization and
+    still compute correctly (multidim multiclass exercises the full layout
+    transform on host arrays)."""
+    rng = np.random.RandomState(9)
+    m = mt.PrecisionRecallCurve(num_classes=3, compute_on_cpu=True)
+    ref = mt.PrecisionRecallCurve(num_classes=3)
+    for _ in range(2):
+        p = rng.rand(4, 3, 5).astype(np.float32)
+        p /= p.sum(1, keepdims=True)
+        t = rng.randint(0, 3, (4, 5))
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(jnp.asarray(p), jnp.asarray(t))
+    assert all(isinstance(r, np.ndarray) for r in m.preds)  # offloaded raw rows
+    m._canonicalize_list_states()
+    assert all(isinstance(r, np.ndarray) for r in m.preds)  # still host-side
+    for a, b in zip(m.compute(), ref.compute()):
+        for x, y in zip(a if isinstance(a, list) else [a], b if isinstance(b, list) else [b]):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+
 def test_cosine_similarity_defers_cast():
     m = mt.CosineSimilarity(reduction="mean")
     p = jnp.asarray([[2.0, 0.0], [1.0, 1.0]])
