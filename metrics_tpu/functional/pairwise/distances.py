@@ -16,9 +16,13 @@ from metrics_tpu.utils.compute import high_precision
 
 
 def _check_pairwise_input(x: jax.Array, y: Optional[jax.Array], zero_diagonal: Optional[bool]) -> Tuple:
+    # jnp.asarray first: callers may pass numpy arrays (or nested lists), and the
+    # zero-diagonal path below relies on the jax-only ``.at[]`` updater.
+    x = jnp.asarray(x)
     if x.ndim != 2:
         raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
     if y is not None:
+        y = jnp.asarray(y)
         if y.ndim != 2 or y.shape[1] != x.shape[1]:
             raise ValueError(
                 "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"

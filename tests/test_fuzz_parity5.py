@@ -1,0 +1,158 @@
+"""Fuzz-parity wave 5: input-container tolerance across the functional surface.
+
+Round 4's only crash was `pairwise_euclidean_distance(numpy_array)` — the
+parity suite fed jax arrays everywhere, so a numpy-only code path
+(`.at[]` on an ndarray) shipped broken. This wave closes that matrix hole
+mechanically: every exported functional symbol's doctest is executed twice,
+once with the real ``jnp`` and once with a shim whose array *constructors*
+return numpy arrays (everything else delegates), and the results must match.
+Any symbol whose implementation assumes jax-array-only input crashes here.
+
+A second targeted wave feeds plain nested python lists to the callable
+surface that the reference accepts tensor-likes for
+(reference `functional/pairwise/helpers.py:20-45` via ``torch.as_tensor``).
+"""
+from __future__ import annotations
+
+import doctest
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as functional
+
+_CTOR_NAMES = frozenset(
+    {"asarray", "array", "arange", "zeros", "ones", "full", "linspace", "eye"}
+)
+
+
+class _NumpyCtorShim:
+    """Looks like ``jax.numpy`` but its array constructors return numpy arrays.
+
+    Everything else (dtypes, ufuncs the doctest may apply to already-built
+    arrays) delegates to the real ``jnp``, so only the *inputs handed to the
+    metric* change container type.
+    """
+
+    def __getattr__(self, name):
+        if name in _CTOR_NAMES:
+            return getattr(np, name)
+        return getattr(jnp, name)
+
+
+_IMPORT_JNP = re.compile(r"^\s*(import\s+jax\.numpy\s+as\s+jnp|from\s+jax\s+import\s+numpy\s+as\s+jnp)\s*$")
+
+
+def _examples_for(name):
+    fn = getattr(functional, name)
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    examples = []
+    for test in finder.find(fn, name):
+        examples.extend(test.examples)
+    return examples
+
+
+def _run_examples(examples, jnp_like):
+    """Execute doctest examples with ``jnp`` bound to *jnp_like*; collect the
+    value of every output-producing expression."""
+    ns = {"jnp": jnp_like, "np": np, "jax": jax}
+    values = []
+    for ex in examples:
+        src = ex.source
+        if _IMPORT_JNP.match(src.strip()):
+            continue  # jnp is pre-seeded (shimmed in the numpy run)
+        if ex.want:
+            try:
+                code = compile(src, "<fuzz5>", "eval")
+            except SyntaxError:
+                exec(compile(src, "<fuzz5>", "exec"), ns)
+                ns["jnp"] = jnp_like  # combined imports must not unbind the shim
+                continue
+            values.append(eval(code, ns))
+        else:
+            exec(compile(src, "<fuzz5>", "exec"), ns)
+            ns["jnp"] = jnp_like  # e.g. `import jax, jax.numpy as jnp`
+    return values
+
+
+def _assert_trees_match(a, b, name):
+    la, sa = jax.tree_util.tree_flatten(a)
+    lb, sb = jax.tree_util.tree_flatten(b)
+    assert sa == sb, f"{name}: result tree structure differs between jax and numpy inputs"
+    for x, y in zip(la, lb):
+        if isinstance(x, str):
+            assert x == y, f"{name}: {x!r} != {y!r}"
+        else:
+            np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), atol=1e-5, rtol=1e-4,
+                err_msg=f"{name}: jax-input vs numpy-input result mismatch",
+            )
+
+
+def _runnable_symbols():
+    out = []
+    for name in sorted(functional.__all__):
+        examples = _examples_for(name)
+        if not examples:
+            continue
+        if any(ex.options.get(doctest.SKIP) for ex in examples):
+            continue  # model-backed examples (weights unfetchable here)
+        out.append(name)
+    return out
+
+
+@pytest.mark.parametrize("name", _runnable_symbols())
+def test_functional_accepts_numpy_inputs(name):
+    examples = _examples_for(name)
+    try:
+        with_jax = _run_examples(examples, jnp)
+    except ModuleNotFoundError as err:  # optional dependency gate
+        pytest.skip(f"optional dependency missing: {err}")
+    with_numpy = _run_examples(examples, _NumpyCtorShim())
+    _assert_trees_match(with_jax, with_numpy, name)
+
+
+@pytest.mark.parametrize(
+    "name,args",
+    [
+        ("pairwise_cosine_similarity", ([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]],)),
+        ("pairwise_euclidean_distance", ([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]],)),
+        ("pairwise_linear_similarity", ([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]],)),
+        ("pairwise_manhattan_distance", ([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]],)),
+        ("accuracy", ([0, 1, 1, 0], [0, 1, 0, 0])),
+    ],
+)
+def test_functional_accepts_python_lists(name, args):
+    """Where an input-conversion layer exists (pairwise ``_check_pairwise_input``,
+    the classification input-format engine), nested python lists must convert
+    rather than crash. Regression metrics mirror the reference in requiring
+    array inputs (reference `_check_same_shape` would raise on lists too)."""
+    fn = getattr(functional, name)
+    got = fn(*args)
+    want = fn(*(jnp.asarray(a) for a in args))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6, rtol=1e-5)
+
+
+def test_pairwise_numpy_zero_diagonal_regression():
+    """The round-4 crash: one-argument numpy input hits the zero-diagonal
+    ``.at[]`` path. Must produce the same matrix as the jax-input call."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(6, 4).astype(np.float32)
+    for fname in (
+        "pairwise_cosine_similarity",
+        "pairwise_euclidean_distance",
+        "pairwise_linear_similarity",
+        "pairwise_manhattan_distance",
+    ):
+        fn = getattr(functional, fname)
+        got = fn(x)  # zero_diagonal defaults to True in the one-argument form
+        want = fn(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+        assert float(np.asarray(got)[np.arange(6), np.arange(6)].max()) == 0.0
+        got2 = fn(x, x.copy(), zero_diagonal=True)
+        np.testing.assert_allclose(
+            np.asarray(got2)[np.arange(6), np.arange(6)], np.zeros(6), atol=1e-6
+        )
