@@ -101,8 +101,8 @@ class BaseAggregator(Metric):
             ):
                 # `bool(jnp.any(...))` is a blocking device->host read (~100 ms
                 # per update through a tunnel), so it honors the validation
-                # mode: "full" (default) checks every update like the
-                # reference, "first" once per input signature, "off" never
+                # mode: "full" checks every update like the reference,
+                # "first" (default) once per input signature, "off" never
                 nans = jnp.isnan(x) if weight is None else jnp.isnan(x) | jnp.isnan(weight)
                 if bool(jnp.any(nans)):
                     if self.nan_strategy == "error":
@@ -225,13 +225,19 @@ class CatMetric(BaseAggregator):
     def update(self, value: Union[float, jax.Array]) -> None:
         # raw-row buffering: when the (validation-mode-gated) NaN check is off
         # for this signature, the cast/flatten dispatches are deferred to
-        # observation time and update is a bare list append
+        # observation time and update is a bare list append. "ignore" never
+        # needs the per-update value read at all: removal is deferred to
+        # compute(), which drops NaNs from the concatenated result — exactly
+        # equal to the reference's update-time filtering for a cat state.
         if not isinstance(value, (jax.Array, np.ndarray)):
             value = np.asarray(value, dtype=np.float32)
         needs_check = (
             isinstance(value, jax.core.Tracer)
             or not isinstance(self.nan_strategy, str)
-            or _should_value_check(value, value, key_extra=("agg-nan", self.nan_strategy))
+            or (
+                self.nan_strategy != "ignore"
+                and _should_value_check(value, value, key_extra=("agg-nan", self.nan_strategy))
+            )
         )
         if needs_check:
             value, _ = self._cast_and_nan_check_input(value, force_value_check=True)
@@ -246,8 +252,21 @@ class CatMetric(BaseAggregator):
 
     def compute(self) -> jax.Array:
         if isinstance(self.value, list) and self.value:
-            return dim_zero_cat_ravel(self.value).astype(jnp.float32)
-        return self.value
+            out = dim_zero_cat_ravel(self.value).astype(jnp.float32)
+        else:
+            out = self.value
+        # "ignore"/"warn" remove NaNs (reference aggregation.py:66-117); any
+        # row whose update-time check was gated off by the validation mode
+        # still buffered them, so removal happens here — values stay
+        # reference-exact in every mode, only the "warn" warning is gated.
+        # "error" gated off keeps the NaN: visible poison beats silent drop.
+        if (
+            self.nan_strategy in ("ignore", "warn")
+            and not isinstance(out, jax.core.Tracer)
+            and getattr(out, "size", 0)
+        ):
+            out = out[~jnp.isnan(out)]
+        return out
 
 
 class MeanMetric(BaseAggregator):

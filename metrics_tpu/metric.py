@@ -315,8 +315,15 @@ class Metric(ABC):
             # TraceAnnotation shows up in jax.profiler / xprof timelines —
             # the analogue of the reference's TorchScript profiling markers
             # (SURVEY §5 "Tracing / profiling")
-            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-                update(*args, **kwargs)
+            from metrics_tpu.utils import checks as _checks
+
+            prev_owner = _checks._check_owner
+            _checks._check_owner = self  # scope "first"-mode memory per instance
+            try:
+                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+                    update(*args, **kwargs)
+            finally:
+                _checks._check_owner = prev_owner
             if signature is not None:
                 # recorded only AFTER the eager call validated this signature
                 self._record_fused_signature(signature)

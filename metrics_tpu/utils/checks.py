@@ -55,25 +55,39 @@ _validation_mode: Optional[str] = None  # resolved lazily from env
 # an evicted signature simply gets value-checked again, the safe direction
 _seen_check_keys: dict = {}
 _SEEN_KEYS_CAP = 4096
+_eviction_count = 0
+_eviction_warned = False
+# Metric._wrap_update points this at the instance whose eager update is
+# running, scoping "first"-mode signature memory PER METRIC: a fresh instance
+# always gets its first-update validation even if another instance already
+# saw the same input signature. Bare functional calls (no instance) fall back
+# to the process-global cache above.
+_check_owner = None
+_cache_generation = 0  # bumped by set_validation_mode to invalidate owner caches
 
 
 def set_validation_mode(mode: str) -> None:
-    """Control value-dependent input validation: ``"full"`` (default — every
-    update, reference parity), ``"first"`` (first update per input signature,
-    skipped after), or ``"off"``.
+    """Control value-dependent input validation: ``"first"`` (default — first
+    update per input signature fully validated, skipped after), ``"full"``
+    (every update, strict reference parity), or ``"off"``.
 
     Shape/dtype validation always runs; this only gates checks that must read
     data values (label ranges, probability bounds). Each such read costs one
     blocking device→host sync — microseconds locally, but a full network
-    round-trip per ``update()`` on remote/tunneled TPU backends, where
-    ``"first"`` keeps misuse protection for the common case at zero
-    steady-state cost. Also settable via ``METRICS_TPU_VALIDATION``.
+    round-trip per ``update()`` on remote/tunneled TPU backends. ``"first"``
+    keeps reference-grade misuse errors on the first occurrence of every input
+    signature at zero steady-state cost, and is what enables the fused
+    one-program update/forward paths. Also settable via
+    ``METRICS_TPU_VALIDATION``.
     """
     if mode not in ("full", "first", "off"):
         raise ValueError(f"validation mode must be 'full', 'first' or 'off', got {mode!r}")
-    global _validation_mode
+    global _validation_mode, _eviction_count, _eviction_warned, _cache_generation
     _validation_mode = mode
     _seen_check_keys.clear()
+    _cache_generation += 1  # invalidates every per-instance cache lazily
+    _eviction_count = 0
+    _eviction_warned = False
 
 
 def _get_validation_mode() -> str:
@@ -81,13 +95,14 @@ def _get_validation_mode() -> str:
     if _validation_mode is None:
         import os
 
-        _validation_mode = os.environ.get("METRICS_TPU_VALIDATION", "full")
+        _validation_mode = os.environ.get("METRICS_TPU_VALIDATION", "first")
         if _validation_mode not in ("full", "first", "off"):
-            _validation_mode = "full"
+            _validation_mode = "first"
     return _validation_mode
 
 
 def _should_value_check(preds, target, key_extra=()) -> bool:
+    global _eviction_count, _eviction_warned
     mode = _get_validation_mode()
     if mode == "off":
         return False
@@ -98,11 +113,35 @@ def _should_value_check(preds, target, key_extra=()) -> bool:
         # a later eager update with the same shapes must still get checked
         return False
     key = (preds.shape, str(preds.dtype), target.shape, str(target.dtype), key_extra)
-    if key in _seen_check_keys:
+    owner = _check_owner
+    if owner is not None:
+        cache = owner.__dict__.get("_value_check_seen")
+        if cache is None or owner.__dict__.get("_value_check_gen") != _cache_generation:
+            cache = {}
+            owner.__dict__["_value_check_seen"] = cache
+            owner.__dict__["_value_check_gen"] = _cache_generation
+    else:
+        cache = _seen_check_keys
+    if key in cache:
         return False
-    _seen_check_keys[key] = None
-    while len(_seen_check_keys) > _SEEN_KEYS_CAP:
-        _seen_check_keys.pop(next(iter(_seen_check_keys)))
+    cache[key] = None
+    while len(cache) > _SEEN_KEYS_CAP:
+        cache.pop(next(iter(cache)))
+        _eviction_count += 1
+        if _eviction_count > _SEEN_KEYS_CAP and not _eviction_warned:
+            _eviction_warned = True
+            from metrics_tpu.utils.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "Validation mode 'first' has evicted more than"
+                f" {_SEEN_KEYS_CAP} input signatures from its seen-signature"
+                " cache: this pipeline churns through more distinct input"
+                " shapes/dtypes than the cache holds, so evicted signatures"
+                " are re-validated (re-paying the device sync 'first' mode is"
+                " meant to elide). Pad/bucket inputs to stable shapes, or set"
+                " METRICS_TPU_VALIDATION=off if inputs are already trusted.",
+                UserWarning,
+            )
     return True
 
 

@@ -28,7 +28,7 @@ BATCHES = [
 def _first_mode():
     checks.set_validation_mode("first")
     yield
-    checks.set_validation_mode("full")
+    checks.set_validation_mode("first")
 
 
 @pytest.mark.parametrize(
@@ -71,7 +71,7 @@ def test_list_state_metric_falls_back():
 
 
 def test_full_validation_mode_keeps_eager_checks():
-    checks.set_validation_mode("full")
+    checks.set_validation_mode("full")  # strict reference-parity mode
     metric = mt.Accuracy()
     p, t = BATCHES[0]
     metric(p, t)

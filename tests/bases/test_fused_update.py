@@ -32,7 +32,7 @@ BATCHES = [
 def _first_mode():
     checks.set_validation_mode("first")
     yield
-    checks.set_validation_mode("full")
+    checks.set_validation_mode("first")
 
 
 @pytest.mark.parametrize(

@@ -485,7 +485,7 @@ def test_registry_update_many_matches_sequential(name):
             for args, kwargs in flat:
                 sequential.update(*args, **kwargs)
     finally:
-        checks.set_validation_mode("full")
+        checks.set_validation_mode("first")
 
     from tests.bases.test_distributed_contract import _values_close
 

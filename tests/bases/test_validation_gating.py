@@ -98,16 +98,31 @@ class TestAggregatorNanGate:
         m.update(jnp.asarray(batches[1]))  # gated off; masked on device
         assert float(m.compute()) == pytest.approx(expected)
 
-    def test_cat_metric_gated_off_appends_raw(self, mode):
-        """Documented CatMetric divergence under "first": masking cannot
-        remove from a cat state, so later-batch NaNs pass through."""
+    def test_cat_metric_gated_off_still_removes_at_compute(self, mode):
+        """CatMetric "warn"/"ignore" removal is deferred to compute(): a
+        gated-off batch buffers its NaNs raw, but the concatenated result
+        drops them — reference-exact values in every validation mode."""
         mode("first")
         m = mt.CatMetric()
         with pytest.warns(UserWarning, match="nan"):
-            m.update(jnp.asarray([1.0, float("nan")]))  # first: removed
+            m.update(jnp.asarray([1.0, float("nan")]))  # first: checked + warned
         m.update(jnp.asarray([2.0, float("nan")]))  # gated: raw append
-        out = np.asarray(m.compute())
-        assert out[0] == 1.0 and out[1] == 2.0 and np.isnan(out[2])
+        np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0])
+
+    def test_cat_metric_ignore_never_reads_values(self, mode):
+        """nan_strategy='ignore' needs no per-update device read at all —
+        removal happens once at compute()."""
+        mode("full")  # even full mode: no value check is *needed* for ignore
+        m = mt.CatMetric(nan_strategy="ignore")
+        m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+        m.update(jnp.asarray([float("nan"), 2.0]))
+        np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 3.0, 2.0])
+
+    def test_cat_metric_error_gated_off_keeps_nan_visible(self, mode):
+        mode("off")
+        m = mt.CatMetric(nan_strategy="error")
+        m.update(jnp.asarray([1.0, float("nan")]))
+        assert np.isnan(np.asarray(m.compute())).any()
 
     def test_error_strategy_gated_off_poisons_not_drops(self, mode):
         mode("off")
@@ -120,6 +135,33 @@ class TestAggregatorNanGate:
         m = mt.MeanMetric(nan_strategy="ignore")
         m.update(jnp.asarray([1.0, float("nan"), 3.0]))
         assert float(m.compute()) == pytest.approx(2.0)
+
+
+class TestDefaultModeAndEvictions:
+    def test_default_mode_is_first(self, mode, monkeypatch):
+        """Out-of-box behavior IS the benched behavior: with no env var set,
+        the mode resolves to "first" and the fused fast paths engage."""
+        monkeypatch.delenv("METRICS_TPU_VALIDATION", raising=False)
+        checks._validation_mode = None  # force re-resolution from env
+        try:
+            assert checks._get_validation_mode() == "first"
+        finally:
+            checks._validation_mode = None
+            mode("first")  # fixture restore path needs a concrete mode
+
+    def test_eviction_counter_warns_once_on_churn(self, mode, monkeypatch):
+        mode("first")
+        monkeypatch.setattr(checks, "_SEEN_KEYS_CAP", 8)
+        arrs = [jnp.zeros(n) for n in range(1, 26)]
+        with pytest.warns(UserWarning, match="evicted more than"):
+            for a in arrs:
+                checks._should_value_check(a, a)
+        assert checks._eviction_count > 8
+        # one-shot: further churn stays silent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            for a in [jnp.zeros((n, 2)) for n in range(1, 20)]:
+                checks._should_value_check(a, a)
 
 
 class TestFusedCountElision:

@@ -24,7 +24,7 @@ N_DRAWS = 12
 def _first_mode():
     checks.set_validation_mode("first")
     yield
-    checks.set_validation_mode("full")
+    checks.set_validation_mode("first")
 
 
 FACTORIES = [
