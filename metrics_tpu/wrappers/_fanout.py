@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Sequence
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -105,4 +106,57 @@ def fanout_gate(wrapper: Metric, clones: List[Metric], args: tuple, kwargs: dict
     )
 
 
-__all__ = ["clone_config", "run_fanout", "fanout_gate"]
+def sum_linear_base(m: Metric) -> bool:
+    """True when every state reduces by "sum" — the merge contract that makes
+    an update additive across batches, which the weighted-row programs below
+    extend to additivity across ROWS (each instance's first fused step
+    verifies that extension numerically before committing to it)."""
+    return bool(m._defaults) and all(spec == "sum" for spec in m._reduction_specs.values())
+
+
+def row_deltas(upd: Callable, init_state: Dict[str, Any], a: tuple, k: dict):
+    """Per-row state contributions ``upd(init, row) - init``, vmapped over the
+    batch axis: one program computes every row's delta, shared by all clones."""
+
+    def one_row(row):
+        ra, rk = jax.tree.map(lambda x: x[None], row)
+        new = upd(init_state, *ra, **rk)
+        return jax.tree.map(lambda n, i: n - i, new, init_state)
+
+    return jax.vmap(one_row)((a, k))
+
+
+def weighted_state_apply(stacked_states, deltas, weights):
+    """``new_c = old_c + sum_i weights[c, i] * delta_i`` for every clone c —
+    the resample/filter itself, as one contraction per state leaf."""
+
+    def apply(old, d):
+        w = weights.astype(d.dtype if jnp.issubdtype(d.dtype, jnp.floating) else jnp.float32)
+        contrib = jnp.tensordot(w, d.astype(w.dtype), axes=(1, 0))
+        return (old + contrib).astype(old.dtype)
+
+    return jax.tree.map(apply, stacked_states, deltas)
+
+
+def states_allclose(states_a: Sequence[Dict[str, Any]], states_b: Sequence[Dict[str, Any]], rtol=1e-3, atol=1e-4) -> bool:
+    """Host-side comparison of two clone-state lists (one blocking read; used
+    once per instance to certify the weighted-row path)."""
+    import numpy as np
+
+    for sa, sb in zip(states_a, states_b):
+        for name in sa:
+            va, vb = np.asarray(sa[name], np.float64), np.asarray(sb[name], np.float64)
+            if va.shape != vb.shape or not np.allclose(va, vb, rtol=rtol, atol=atol):
+                return False
+    return True
+
+
+__all__ = [
+    "clone_config",
+    "run_fanout",
+    "fanout_gate",
+    "sum_linear_base",
+    "row_deltas",
+    "weighted_state_apply",
+    "states_allclose",
+]

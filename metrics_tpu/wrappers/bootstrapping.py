@@ -14,7 +14,15 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
-from metrics_tpu.wrappers._fanout import fanout_gate, run_fanout
+from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.wrappers._fanout import (
+    fanout_gate,
+    row_deltas,
+    run_fanout,
+    states_allclose,
+    sum_linear_base,
+    weighted_state_apply,
+)
 
 
 def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.RandomState] = None) -> np.ndarray:
@@ -80,11 +88,14 @@ class BootStrapper(Metric):
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.RandomState()
 
-    # one-program multinomial fast path (lazily built; dropped on pickle)
+    # one-program fast path (lazily built; dropped on pickle)
     _boot_program = None
     _boot_versions = None  # clone _fused_version tuple the program was built against
     _boot_ok = True
     _record_boot_signature_after = None
+    # poisson weighted-row path: certified per instance on its first fused
+    # step (fused result compared against the eager chunked path once)
+    _poisson_certified = False
 
     def __getstate__(self) -> Dict[str, Any]:
         state = super().__getstate__()
@@ -121,7 +132,10 @@ class BootStrapper(Metric):
         else:
             raise ValueError("None of the input contained tensors, so could not determine the sampling size")
         object.__setattr__(self, "_record_boot_signature_after", None)
-        handled, predrawn = self._try_fused_multinomial(size, args, kwargs)
+        if self.sampling_strategy == "multinomial":
+            handled, predrawn = self._try_fused_multinomial(size, args, kwargs)
+        else:
+            handled, predrawn = self._try_fused_poisson(size, args, kwargs)
         if handled:
             return
         for idx in range(self.num_bootstraps):
@@ -131,45 +145,142 @@ class BootStrapper(Metric):
                 predrawn[idx] if predrawn is not None
                 else _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             )
-            if sample_idx.size == 0:
-                # an empty poisson draw still counts as this clone's update —
-                # without this, compute() would emit a spurious
-                # compute-before-update warning for the skipped clone
-                self.metrics[idx]._update_count += 1
-                continue
-            update_count_before = self.metrics[idx]._update_count
-            offset, remaining = 0, int(sample_idx.size)
-            try:
-                while remaining:
-                    # multinomial draws always have the input's (static)
-                    # length — one whole-batch program; only poisson needs
-                    # the chunking
-                    chunk_len = remaining if self.sampling_strategy == "multinomial" else 1 << (remaining.bit_length() - 1)
-                    # host-side slice, then ONE transfer of a power-of-two-
-                    # sized index array: the take+update programs are keyed
-                    # only by chunk length, never by the draw's total length
-                    # or offset
-                    chunk = jnp.asarray(sample_idx[offset : offset + chunk_len])
-                    new_args = apply_to_collection(args, jax.Array, jnp.take, chunk, axis=0)
-                    new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, chunk, axis=0)
-                    self.metrics[idx].update(*new_args, **new_kwargs)
-                    offset += chunk_len
-                    remaining -= chunk_len
-            except Exception:
-                # match the base Metric's failure contract: a raising update
-                # does not count (chunked state ingestion is non-atomic — rows
-                # from completed chunks remain, as they would for any metric
-                # whose update mutated state before raising)
-                self.metrics[idx]._update_count = update_count_before
-                raise
-            else:
-                # one draw = one update, however many chunks carried it
-                self.metrics[idx]._update_count = update_count_before + 1
+            self._eager_resampled_update(self.metrics[idx], sample_idx, args, kwargs)
         sig = self._record_boot_signature_after
         if sig is not None:
             # the eager pass validated this signature: license the fused path
             object.__setattr__(self, "_record_boot_signature_after", None)
             self._record_fused_signature(sig)
+
+    def _eager_resampled_update(self, metric: Metric, sample_idx: np.ndarray, args: tuple, kwargs: dict) -> None:
+        """Feed one clone its resampled batch on the eager path."""
+        if sample_idx.size == 0:
+            # an empty poisson draw still counts as this clone's update —
+            # without this, compute() would emit a spurious
+            # compute-before-update warning for the skipped clone
+            metric._update_count += 1
+            return
+        update_count_before = metric._update_count
+        offset, remaining = 0, int(sample_idx.size)
+        try:
+            while remaining:
+                # multinomial draws always have the input's (static)
+                # length — one whole-batch program; only poisson needs
+                # the chunking: poisson draw lengths differ almost every
+                # time, and XLA compiles one program per novel shape, so
+                # each draw is split into power-of-two consecutive slices,
+                # bounding the compile cache to ~log2(N) shapes
+                chunk_len = remaining if self.sampling_strategy == "multinomial" else 1 << (remaining.bit_length() - 1)
+                # host-side slice, then ONE transfer of a power-of-two-
+                # sized index array: the take+update programs are keyed
+                # only by chunk length, never by the draw's total length
+                # or offset
+                chunk = jnp.asarray(sample_idx[offset : offset + chunk_len])
+                new_args = apply_to_collection(args, jax.Array, jnp.take, chunk, axis=0)
+                new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, chunk, axis=0)
+                metric.update(*new_args, **new_kwargs)
+                offset += chunk_len
+                remaining -= chunk_len
+        except Exception:
+            # match the base Metric's failure contract: a raising update
+            # does not count (chunked state ingestion is non-atomic — rows
+            # from completed chunks remain, as they would for any metric
+            # whose update mutated state before raising)
+            metric._update_count = update_count_before
+            raise
+        else:
+            # one draw = one update, however many chunks carried it
+            metric._update_count = update_count_before + 1
+
+    def _try_fused_poisson(self, size: int, args: tuple, kwargs: dict):
+        """Poisson bootstrap as ONE program: counts become ROW WEIGHTS.
+
+        Reference semantics (`wrappers/bootstrapping.py:26-47`): each sample
+        appears ``Poisson(1)`` times in each clone's resampled batch. For a
+        base metric whose states all merge by ``"sum"`` the resampled update
+        equals the count-weighted sum of per-row state deltas, so the whole
+        clone fleet runs as one static-shape program: per-row deltas
+        ``upd(init, row) - init`` are vmapped ONCE (shared by every clone),
+        then contracted against the ``(num_bootstraps, N)`` poisson count
+        matrix — no variable-length index gathers, no per-shape recompiles.
+
+        Row-additivity is a stronger property than the sum-merge contract
+        guarantees, so the FIRST fused step per instance is certified: the
+        eager chunked path runs alongside on state copies (same draws) and
+        the results are compared once on host. A mismatch keeps the eager
+        result and permanently falls back; agreement licenses the one-program
+        path for the rest of the instance's life.
+
+        Returns ``(handled, predrawn_indices)`` like the multinomial path —
+        on a fused failure the consumed poisson counts are converted to the
+        exact index draws the eager fallback would have drawn, keeping the
+        seeded RNG stream identical to a never-fused run.
+        """
+        if not fanout_gate(self, self.metrics, args, kwargs, "_boot_ok") or not sum_linear_base(
+            self.metrics[0]
+        ):
+            return False, None
+        # every array leaf must carry the batch axis for the row vmap
+        leaves = jax.tree.flatten((args, kwargs))[0]
+        if not all(getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == size for leaf in leaves):
+            return False, None
+        if self._fused_seen_signatures is None:
+            self._fused_seen_signatures = {}
+        signature = ("__boot__", size, self._forward_signature(args, kwargs))
+        if signature not in self._fused_seen_signatures:
+            # eager (validating) first pass runs below; record only on success
+            self._record_boot_signature_after = signature
+            return False, None
+        # draw BEFORE the fallible block, in the same per-clone order as the
+        # eager path, so the stream is consumed exactly once per step
+        counts = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
+        certify = not self._poisson_certified
+        oracle = deepcopy(self.metrics) if certify else None
+        clone0 = self.metrics[0]
+
+        def build(upd):
+            init_fn = clone0.as_functions()[0]  # only needed at (re)build
+
+            def program(states, w, *a, **k):
+                deltas = row_deltas(upd, init_fn(), a, k)
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+                new = weighted_state_apply(stacked, deltas, w)
+                return [jax.tree.map(lambda x: x[i], new) for i in range(len(states))]
+
+            return program
+
+        ok = run_fanout(
+            self,
+            self.metrics,
+            build,
+            (jnp.asarray(counts),) + args,
+            kwargs,
+            label="BootStrapper",
+            program_attr="_boot_program",
+            versions_attr="_boot_versions",
+            ok_attr="_boot_ok",
+        )
+        if not ok:
+            return False, [np.repeat(np.arange(size), counts[c]) for c in range(self.num_bootstraps)]
+        if certify:
+            for om, c in zip(oracle, counts):
+                self._eager_resampled_update(om, np.repeat(np.arange(size), c), args, kwargs)
+            if states_allclose(
+                [m.metric_state for m in self.metrics], [m.metric_state for m in oracle]
+            ):
+                object.__setattr__(self, "_poisson_certified", True)
+            else:
+                rank_zero_warn(
+                    f"Weighted-row poisson bootstrap disagreed with the eager path for "
+                    f"`{type(self.metrics[0]).__name__}` (update is not row-additive); "
+                    "keeping the eager result and falling back permanently for this instance."
+                )
+                for m, om in zip(self.metrics, oracle):
+                    for name in m._defaults:
+                        setattr(m, name, getattr(om, name))
+                object.__setattr__(self, "_boot_ok", False)
+                object.__setattr__(self, "_boot_program", None)
+        return True, None
 
     def _try_fused_multinomial(self, size: int, args: tuple, kwargs: dict):
         """Run all clones' resample+update as ONE jitted program.

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class MinMaxMetric(Metric):
@@ -40,12 +41,134 @@ class MinMaxMetric(Metric):
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
 
+    # fused forward: one program per input signature runs child update +
+    # batch value + extrema tracking with no per-step value read
+    _mm_program = None
+    _mm_versions = None
+    _mm_ok = True
+    _record_mm_signature_after = None
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = super().__getstate__()
+        state.pop("_mm_program", None)  # jit closure: rebuilt lazily
+        return state
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        object.__setattr__(self, "_record_mm_signature_after", None)
+        if self._try_fused_forward(args, kwargs):
+            return self._forward_cache
+        out = super().forward(*args, **kwargs)
+        sig = self._record_mm_signature_after
+        if sig is not None:
+            # the eager pass validated this signature: license the fused path
+            object.__setattr__(self, "_record_mm_signature_after", None)
+            self._record_fused_signature(sig)
+        return out
+
+    def _try_fused_forward(self, args: tuple, kwargs: dict) -> bool:
+        """One jitted program for the whole forward step.
+
+        The eager two-update forward dance (update accumulated state; update
+        a fresh state for the batch value; compute — which ADVANCES the
+        running extrema with the batch value, reference
+        `wrappers/minmax.py:58-80` semantics) costs dozens of eager
+        dispatches per step through a remote backend. After a first eager,
+        fully validated call per input signature the step runs fused:
+        ``(child_state, min, max, batch) -> (new_child_state, new_min,
+        new_max, {raw, max, min})`` — no device value ever read on the host.
+        Gating mirrors the fused-update contract: fusable child states,
+        validation mode not "full", concrete device-array inputs, permanent
+        per-instance fallback on trace failure.
+        """
+        from metrics_tpu.parallel.sync import distributed_available
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        child = self._base_metric
+        if not (
+            self._mm_ok
+            and not self._is_synced
+            # under distributed execution the eager dance syncs the child's
+            # batch state across ranks before the value read; the fused
+            # program is rank-local, so it must not engage there
+            and not self.dist_sync_on_step
+            and not distributed_available()
+            and _get_validation_mode() != "full"
+            and child._fusable_states()
+            and all(
+                isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer)
+                for leaf in jax.tree.flatten((args, kwargs))[0]
+            )
+        ):
+            return False
+        if self._fused_seen_signatures is None:
+            self._fused_seen_signatures = {}
+        signature = ("__minmax__", self._forward_signature(args, kwargs))
+        if signature not in self._fused_seen_signatures:
+            object.__setattr__(self, "_record_mm_signature_after", signature)
+            return False
+        versions = (self._fused_version, child._fused_version)
+        try:
+            if self._mm_program is None or self._mm_versions != versions:
+                init_c, upd_c, cmp_c = child.as_functions()
+
+                def step(mn, mx, *a, **k):
+                    # the wrapper registers no states of its own, so the
+                    # two-update forward dance's reset wipes the child and its
+                    # restore restores nothing: the child ends each forward
+                    # holding ONLY this batch's state (reference behavior —
+                    # its forward cache covers `self._defaults`, empty here,
+                    # while reset() recurses into the child). The program
+                    # reproduces that exactly: one fresh-state update.
+                    batch_state = upd_c(init_c(), *a, **k)
+                    batch_val = cmp_c(batch_state)
+                    val32 = jnp.asarray(batch_val, jnp.float32).reshape(())
+                    new_mx = jnp.where(mx > val32, mx, val32)
+                    new_mn = jnp.where(mn < val32, mn, val32)
+                    return batch_state, new_mn, new_mx, {
+                        "raw": jnp.asarray(batch_val),
+                        "max": new_mx,
+                        "min": new_mn,
+                    }
+
+                object.__setattr__(self, "_mm_program", jax.jit(step))
+                object.__setattr__(self, "_mm_versions", versions)
+            new_state, new_mn, new_mx, out = self._mm_program(
+                self.min_val, self.max_val, *args, **kwargs
+            )
+        except Exception as exc:  # noqa: BLE001 — any trace/compile failure
+            rank_zero_warn(
+                f"Fused MinMaxMetric forward raised {type(exc).__name__}: {exc}. "
+                "Falling back to the eager path permanently for this instance."
+            )
+            object.__setattr__(self, "_mm_ok", False)
+            object.__setattr__(self, "_mm_program", None)
+            return False
+        for name, value in new_state.items():
+            setattr(child, name, value)
+        child._update_count = 1  # the eager dance's reset+update leaves exactly one
+        child._computed = None
+        # min/max are VALUE state, not hyperparameters: bypass the public
+        # __setattr__ whose config-drift version bump would force a program
+        # rebuild (full retrace + XLA compile) on every step
+        object.__setattr__(self, "min_val", new_mn)
+        object.__setattr__(self, "max_val", new_mx)
+        self._update_count += 1
+        self._computed = None
+        self._forward_cache = out
+        return True
+
     def compute(self) -> Dict[str, jax.Array]:
         val = self._base_metric.compute()
         if not self._is_suitable_val(val):
             raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
-        self.max_val = jnp.where(self.max_val > val, self.max_val, jnp.asarray(val, dtype=jnp.float32))
-        self.min_val = jnp.where(self.min_val < val, self.min_val, jnp.asarray(val, dtype=jnp.float32))
+        # value state, not hyperparameters: skip the config-drift version bump
+        # (a public setattr here would invalidate the fused forward program)
+        object.__setattr__(
+            self, "max_val", jnp.where(self.max_val > val, self.max_val, jnp.asarray(val, dtype=jnp.float32))
+        )
+        object.__setattr__(
+            self, "min_val", jnp.where(self.min_val < val, self.min_val, jnp.asarray(val, dtype=jnp.float32))
+        )
         return {"raw": jnp.asarray(val), "max": self.max_val, "min": self.min_val}
 
     def reset(self) -> None:

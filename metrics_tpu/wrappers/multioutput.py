@@ -14,7 +14,14 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
-from metrics_tpu.wrappers._fanout import fanout_gate, run_fanout
+from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.wrappers._fanout import (
+    fanout_gate,
+    row_deltas,
+    run_fanout,
+    states_allclose,
+    sum_linear_base,
+)
 
 
 def _get_nan_indices(*tensors: jax.Array) -> jax.Array:
@@ -99,11 +106,14 @@ class MultioutputWrapper(Metric):
             args_kwargs_by_output.append((selected_args, selected_kwargs))
         return args_kwargs_by_output
 
-    # one-program column fan-out (remove_nans=False; lazily built, dropped on pickle)
+    # one-program column fan-out (lazily built, dropped on pickle)
     _mo_program = None
     _mo_versions = None
     _mo_ok = True
     _record_mo_signature_after = None
+    # remove_nans weighted-row path: certified per instance on its first
+    # fused step (compared against the eager masked-gather path once)
+    _mo_certified = False
 
     def __getstate__(self) -> dict:
         state = super().__getstate__()
@@ -113,18 +123,28 @@ class MultioutputWrapper(Metric):
     def _try_fused_columns(self, args: tuple, kwargs: dict) -> bool:
         """Run every column clone's slice+update as ONE jitted program.
 
-        Same gating contract as the fused bootstrap: only for configurations
-        with static per-clone shapes (``remove_nans=False``,
-        ``squeeze_outputs=True``), a fusable base metric, validation mode not
+        Same gating contract as the fused bootstrap: static per-clone shapes,
+        ``squeeze_outputs=True``, a fusable base metric, validation mode not
         "full", concrete device-array inputs, first call per signature eager,
         identically-configured clones, permanent fallback on trace failure —
         shared machinery in `wrappers/_fanout.py`. The program bakes
         ``output_dim``; mutating it bumps this wrapper's ``_fused_version``,
         which `run_fanout` watches for the rebuild.
+
+        ``remove_nans=True`` (the reference default,
+        `wrappers/multioutput.py:12,24-60`) filters rows whose column slice
+        contains NaN — a data-dependent shape. For bases whose states all
+        merge by ``"sum"`` the filter is equivalent to ZERO-WEIGHTING the NaN
+        rows, which IS static-shape: per-row state deltas (computed on
+        NaN-scrubbed rows) are contracted against the ``~nan_row`` mask
+        inside the program, so no mask ever crosses to the host. The first
+        fused step per instance is certified against the eager masked-gather
+        path on state copies; a mismatch keeps the eager result and falls
+        back permanently.
         """
-        if self.remove_nans or not self.squeeze_outputs or not fanout_gate(
-            self, self.metrics, args, kwargs, "_mo_ok"
-        ):
+        if not self.squeeze_outputs or not fanout_gate(self, self.metrics, args, kwargs, "_mo_ok"):
+            return False
+        if self.remove_nans and not sum_linear_base(self.metrics[0]):
             return False
         if self._fused_seen_signatures is None:
             self._fused_seen_signatures = {}
@@ -133,8 +153,12 @@ class MultioutputWrapper(Metric):
             self._record_mo_signature_after = signature
             return False
         axis = self.output_dim
+        remove_nans = self.remove_nans
+        clone0 = self.metrics[0]
 
         def build(upd):
+            init_fn = clone0.as_functions()[0] if remove_nans else None  # only at (re)build
+
             def program(states, *a, **k):
                 # move the output axis to the front once, then vmap the child
                 # update over (columns, clone states) — the vmapped axis
@@ -142,16 +166,48 @@ class MultioutputWrapper(Metric):
                 cols = jax.tree.map(lambda x: jnp.moveaxis(x, axis, 0), (a, k))
                 stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
-                def one(state, col):
-                    ca, ck = col
-                    return upd(state, *ca, **ck)
+                if remove_nans:
+                    init_state = init_fn()
+
+                    def one(state, col):
+                        ca, ck = col
+                        leaves = [t for t in jax.tree.leaves((ca, ck))]
+                        n = leaves[0].shape[0]
+                        mask = jnp.zeros(n, dtype=bool)
+                        for t in leaves:
+                            if jnp.issubdtype(t.dtype, jnp.floating):
+                                mask = mask | jnp.any(jnp.isnan(t.reshape(n, -1)), axis=1)
+                        # scrub NaNs so masked-out rows still trace finitely
+                        ca, ck = jax.tree.map(
+                            lambda t: jnp.where(jnp.isnan(t), jnp.ones((), t.dtype), t)
+                            if jnp.issubdtype(t.dtype, jnp.floating)
+                            else t,
+                            (ca, ck),
+                        )
+                        deltas = row_deltas(upd, init_state, ca, ck)
+                        w = (~mask).astype(jnp.float32)
+                        return jax.tree.map(
+                            lambda old, d: (
+                                old + jnp.tensordot(w, d.astype(jnp.float32), axes=(0, 0))
+                            ).astype(old.dtype),
+                            state,
+                            deltas,
+                        )
+
+                else:
+
+                    def one(state, col):
+                        ca, ck = col
+                        return upd(state, *ca, **ck)
 
                 out = jax.vmap(one)(stacked, cols)
                 return [jax.tree.map(lambda x: x[i], out) for i in range(len(states))]
 
             return program
 
-        return run_fanout(
+        certify = remove_nans and not self._mo_certified
+        oracle = deepcopy(self.metrics) if certify else None
+        ok = run_fanout(
             self,
             self.metrics,
             build,
@@ -162,6 +218,28 @@ class MultioutputWrapper(Metric):
             versions_attr="_mo_versions",
             ok_attr="_mo_ok",
         )
+        if ok and certify:
+            for om, (sel_args, sel_kwargs) in zip(
+                oracle, self._get_args_kwargs_by_output(*args, **kwargs)
+            ):
+                om.update(*sel_args, **sel_kwargs)
+            if states_allclose(
+                [m.metric_state for m in self.metrics], [m.metric_state for m in oracle]
+            ):
+                object.__setattr__(self, "_mo_certified", True)
+            else:
+                rank_zero_warn(
+                    f"Weighted-row NaN masking disagreed with the eager path for "
+                    f"`MultioutputWrapper({type(self.metrics[0]).__name__})` (update is "
+                    "not row-additive); keeping the eager result and falling back "
+                    "permanently for this instance."
+                )
+                for m, om in zip(self.metrics, oracle):
+                    for name in m._defaults:
+                        setattr(m, name, getattr(om, name))
+                object.__setattr__(self, "_mo_ok", False)
+                object.__setattr__(self, "_mo_program", None)
+        return ok
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         object.__setattr__(self, "_record_mo_signature_after", None)

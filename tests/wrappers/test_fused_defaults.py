@@ -1,0 +1,238 @@
+"""The reference-DEFAULT wrapper configs run fused (round-5 contract).
+
+Round 4 fused only the non-default configs (multinomial bootstrap,
+``remove_nans=False``); the reference defaults — ``BootStrapper(poisson)``,
+``MultioutputWrapper(remove_nans=True)``, ``MinMaxMetric`` — stayed on the
+eager per-clone path (the 0.01×–0.19× sweep rows). These tests pin the
+round-5 fast paths:
+
+- poisson bootstrap as ONE program (counts as row weights over per-row state
+  deltas), certified against the eager chunked path on its first fused step;
+- ``remove_nans=True`` as in-program zero-weighting of NaN rows (no
+  data-dependent host gather), certified the same way;
+- MinMaxMetric forward as one program (child batch state + extrema), exactly
+  reproducing the eager two-update dance's semantics.
+
+Each case asserts BOTH engagement (the program exists) and value equality
+with a force-eager twin on identical data/seeds.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utils import checks
+
+
+@pytest.fixture(autouse=True)
+def _first_mode():
+    prev = checks._get_validation_mode()
+    checks.set_validation_mode("first")
+    yield
+    checks.set_validation_mode(prev)
+
+
+def _pair(factory, force_eager_attr):
+    fused = factory()
+    eager = factory()
+    object.__setattr__(eager, force_eager_attr, False)
+    return fused, eager
+
+
+class TestPoissonBootstrap:
+    def _run(self, base_factory, batches, seed=3):
+        fused, eager = _pair(
+            lambda: mt.BootStrapper(base_factory(), num_bootstraps=4, sampling_strategy="poisson"),
+            "_boot_ok",
+        )
+        fused._rng = np.random.RandomState(seed)
+        eager._rng = np.random.RandomState(seed)
+        for b in batches:
+            fused.update(*b)
+            eager.update(*b)
+        return fused, eager
+
+    def test_fused_equals_eager_same_seed(self):
+        rng = np.random.RandomState(0)
+        batches = [
+            (jnp.asarray(rng.rand(48).astype(np.float32)), jnp.asarray(rng.rand(48).astype(np.float32)))
+            for _ in range(4)
+        ]
+        fused, eager = self._run(mt.MeanSquaredError, batches)
+        assert fused._boot_program is not None, "poisson fused path never engaged"
+        assert fused._poisson_certified
+        for key in ("mean", "std"):
+            np.testing.assert_allclose(
+                float(fused.compute()[key]), float(eager.compute()[key]), rtol=1e-4, atol=1e-6
+            )
+        # per-clone states match the eager chunked resample exactly
+        for mf, me in zip(fused.metrics, eager.metrics):
+            for name in mf._defaults:
+                np.testing.assert_allclose(
+                    np.asarray(getattr(mf, name)), np.asarray(getattr(me, name)), rtol=1e-4, atol=1e-6
+                )
+            assert mf._update_count == me._update_count
+
+    def test_accuracy_base_fuses(self):
+        rng = np.random.RandomState(1)
+        batches = [
+            (jnp.asarray(rng.rand(32).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 32)))
+            for _ in range(3)
+        ]
+        fused, eager = self._run(mt.Accuracy, batches)
+        assert fused._boot_program is not None
+        np.testing.assert_allclose(
+            float(fused.compute()["mean"]), float(eager.compute()["mean"]), rtol=1e-4
+        )
+
+    def test_non_sum_linear_base_stays_eager(self):
+        # MaxMetric's state reduces by "max": weights cannot express resampling
+        rng = np.random.RandomState(2)
+        batches = [(jnp.asarray(rng.rand(16).astype(np.float32)),) for _ in range(3)]
+        fused, eager = self._run(mt.MaxMetric, batches)
+        assert fused._boot_program is None  # gate rejected, no fused attempt
+        np.testing.assert_allclose(
+            float(fused.compute()["mean"]), float(eager.compute()["mean"]), rtol=1e-5
+        )
+
+    def test_full_mode_stays_eager(self):
+        checks.set_validation_mode("full")
+        rng = np.random.RandomState(4)
+        batches = [
+            (jnp.asarray(rng.rand(16).astype(np.float32)), jnp.asarray(rng.rand(16).astype(np.float32)))
+            for _ in range(3)
+        ]
+        fused, _ = self._run(mt.MeanSquaredError, batches)
+        assert fused._boot_program is None
+
+
+class TestMultioutputRemoveNans:
+    def _data(self, with_nans=True):
+        rng = np.random.RandomState(5)
+        p = rng.rand(24, 3).astype(np.float32)
+        t = rng.rand(24, 3).astype(np.float32)
+        if with_nans:
+            p[rng.rand(24) < 0.25, 0] = np.nan
+            t[rng.rand(24) < 0.25, 2] = np.nan
+        return jnp.asarray(p), jnp.asarray(t)
+
+    def test_fused_equals_eager_with_nan_rows(self):
+        p, t = self._data()
+        fused, eager = _pair(
+            lambda: mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=3), "_mo_ok"
+        )
+        assert fused.remove_nans  # the reference default config is what fuses
+        for _ in range(3):
+            fused.update(p, t)
+            eager.update(p, t)
+        assert fused._mo_program is not None, "remove_nans fused path never engaged"
+        assert fused._mo_certified
+        np.testing.assert_allclose(
+            [float(v) for v in fused.compute()],
+            [float(v) for v in eager.compute()],
+            rtol=1e-5,
+        )
+
+    def test_all_nan_column_matches_eager(self):
+        rng = np.random.RandomState(6)
+        p = rng.rand(8, 2).astype(np.float32)
+        t = rng.rand(8, 2).astype(np.float32)
+        p[:, 1] = np.nan  # every row of column 1 filtered
+        p, t = jnp.asarray(p), jnp.asarray(t)
+        fused, eager = _pair(
+            lambda: mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=2), "_mo_ok"
+        )
+        for _ in range(3):
+            fused.update(p, t)
+            eager.update(p, t)
+        assert fused._mo_program is not None
+        a, b = fused.compute(), eager.compute()
+        np.testing.assert_allclose(float(a[0]), float(b[0]), rtol=1e-5)
+        # column 1 never saw a sample in either path: both divide 0/0
+        assert np.isnan(float(a[1])) == np.isnan(float(b[1]))
+
+    def test_cat_state_base_stays_eager(self):
+        p, t = self._data(with_nans=False)
+        fused, _ = _pair(
+            lambda: mt.MultioutputWrapper(mt.SpearmanCorrCoef(), num_outputs=3), "_mo_ok"
+        )
+        for _ in range(3):
+            fused.update(p[:, :1].repeat(3, 1), t[:, :1].repeat(3, 1))
+        assert fused._mo_program is None  # cat states: not fusable
+
+    def test_pickle_drops_program(self):
+        p, t = self._data()
+        w = mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=3)
+        for _ in range(3):
+            w.update(p, t)
+        assert w._mo_program is not None
+        w2 = pickle.loads(pickle.dumps(w))
+        assert w2._mo_program is None
+        np.testing.assert_allclose(
+            [float(v) for v in w.compute()], [float(v) for v in w2.compute()], rtol=1e-6
+        )
+
+
+class TestMinMaxFusedForward:
+    def test_fused_equals_eager(self):
+        rng = np.random.RandomState(7)
+        batches = [
+            (jnp.asarray(rng.rand(16).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 16)))
+            for _ in range(4)
+        ]
+        fused, eager = _pair(lambda: mt.MinMaxMetric(mt.Accuracy()), "_mm_ok")
+        for p, t in batches:
+            rf = fused(p, t)
+            re_ = eager(p, t)
+            np.testing.assert_allclose(float(rf["raw"]), float(re_["raw"]), rtol=1e-6)
+        assert fused._mm_program is not None, "minmax fused forward never engaged"
+        cf, ce = fused.compute(), eager.compute()
+        for key in ("raw", "max", "min"):
+            np.testing.assert_allclose(float(cf[key]), float(ce[key]), rtol=1e-6)
+        # the eager dance leaves the child holding only the last batch —
+        # the fused program must reproduce that exactly (reference behavior)
+        for name in fused._base_metric._defaults:
+            np.testing.assert_allclose(
+                np.asarray(getattr(fused._base_metric, name)),
+                np.asarray(getattr(eager._base_metric, name)),
+            )
+        assert fused._update_count == eager._update_count
+        assert fused._base_metric._update_count == eager._base_metric._update_count
+
+    def test_extrema_persist_across_forwards(self):
+        fused = mt.MinMaxMetric(mt.MeanMetric())
+        vals = [2.0, 5.0, 1.0, 3.0]
+        for v in vals:
+            fused(jnp.asarray([v]))
+        out = fused.compute()
+        assert float(out["max"]) == 5.0 and float(out["min"]) == 1.0
+        assert fused._mm_program is not None
+
+    def test_pickle_drops_program(self):
+        m = mt.MinMaxMetric(mt.MeanMetric())
+        for v in (1.0, 2.0, 3.0):
+            m(jnp.asarray([v]))
+        assert m._mm_program is not None
+        m2 = pickle.loads(pickle.dumps(m))
+        assert m2._mm_program is None
+        assert float(m2.compute()["max"]) == float(m.compute()["max"])
+
+    def test_program_is_stable_across_steps(self):
+        """The extrema write-back must not bump the config-drift version —
+        a rebuild per step would retrace + recompile every forward (review
+        regression)."""
+        m = mt.MinMaxMetric(mt.MeanMetric())
+        for v in (1.0, 2.0):
+            m(jnp.asarray([v]))
+        prog = m._mm_program
+        assert prog is not None
+        for v in (3.0, 4.0, 5.0):
+            m(jnp.asarray([v]))
+            assert m._mm_program is prog
+        m.compute()  # compute's extrema advance must not invalidate it either
+        m(jnp.asarray([6.0]))
+        assert m._mm_program is prog

@@ -285,8 +285,10 @@ class TestMultioutputFused:
         finally:
             checks.set_validation_mode(prev_mode)
 
-    def test_remove_nans_default_stays_eager(self):
-        """remove_nans=True has data-dependent shapes — must never fuse."""
+    def test_remove_nans_default_fuses_with_masking(self):
+        """remove_nans=True fuses for sum-linear bases by zero-weighting NaN
+        rows INSIDE the program (round-5 contract; value parity pinned in
+        tests/wrappers/test_fused_defaults.py)."""
         from metrics_tpu.utils import checks
 
         rng = np.random.RandomState(7)
@@ -299,7 +301,8 @@ class TestMultioutputFused:
             m = MultioutputWrapper(MeanSquaredError(), num_outputs=4)
             for _ in range(3):
                 m.update(jnp.asarray(p), jnp.asarray(t))
-            assert m._mo_program is None
+            assert m._mo_program is not None
+            assert m._mo_certified
             assert np.isfinite(float(m.compute()[0]))  # nan row removed
         finally:
             checks.set_validation_mode(prev_mode)
