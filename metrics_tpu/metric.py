@@ -309,7 +309,7 @@ class Metric(ABC):
                         object.__setattr__(self, "_fused_update_template", None)
                     else:
                         for name, value in new_state.items():
-                            setattr(self, name, value)
+                            object.__setattr__(self, name, value)  # state leaves: no version logic
                         _propagate_static_attrs(self._fused_update_template, self)
                         return
             # TraceAnnotation shows up in jax.profiler / xprof timelines —
@@ -477,7 +477,10 @@ class Metric(ABC):
 
         def leaf(a: Any):
             if hasattr(a, "shape") and hasattr(a, "dtype"):
-                return (tuple(a.shape), str(a.dtype))
+                # the dtype OBJECT is hashable and cheap; stringifying it costs
+                # ~10 us per leaf through numpy's name machinery — measurable
+                # on the per-step hot path
+                return (tuple(a.shape), a.dtype)
             r = repr(a)
             # long non-array reprs are hashed, not retained (the signature
             # set would otherwise pin arbitrarily large strings)
@@ -812,7 +815,9 @@ class Metric(ABC):
                 self._fused_template = None
                 return result
             for name, value in merged.items():
-                setattr(self, name, value)
+                # state names never reach the version logic in __setattr__;
+                # skip its dispatch entirely on the per-step hot path
+                object.__setattr__(self, name, value)
             # writes via object.__setattr__, so it cannot re-trigger the
             # fused-program invalidation in our __setattr__
             _propagate_static_attrs(self._fused_template, self)
@@ -1463,12 +1468,21 @@ def _propagate_static_attrs(src: "Metric", dst: "Metric") -> None:
     them. Only plain static python values are copied (they derive from shapes,
     so this is a trace-time effect — consistent across retraces of the same
     shapes); states, arrays, and private bookkeeping are never touched.
+
+    This runs once per fused step, so the NAME filter (public, non-state) is
+    cached on the source keyed by its public-key tuple; values — including
+    whether each is currently static — are still re-read fresh every call.
     """
-    state_names = set(src._reduction_specs)
-    for name, value in src.__dict__.items():
-        if name.startswith("_") or name in state_names:
-            continue
-        if not _is_static_value(value):
+    public_keys = tuple(k for k in src.__dict__ if not k.startswith("_"))
+    cache = src.__dict__.get("_static_attr_names")
+    if cache is None or cache[0] != public_keys:
+        state_names = set(src._reduction_specs)
+        names = tuple(k for k in public_keys if k not in state_names)
+        cache = (public_keys, names)
+        object.__setattr__(src, "_static_attr_names", cache)
+    for name in cache[1]:
+        value = src.__dict__.get(name, _UNSET)
+        if value is _UNSET or not _is_static_value(value):
             continue
         if dst.__dict__.get(name, object()) != value:
             object.__setattr__(dst, name, value)
