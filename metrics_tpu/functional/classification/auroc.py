@@ -33,6 +33,12 @@ def _auroc_format(preds: jax.Array, target: jax.Array, mode: DataType) -> Tuple[
         n_classes = preds.shape[1]
         preds = preds.swapaxes(0, 1).reshape(n_classes, -1).T
         target = target.swapaxes(0, 1).reshape(n_classes, -1).T
+    if mode == DataType.BINARY:
+        # canonicalize mixed-rank binary rows — e.g. (N,) then (M, 1) — to
+        # 1-D so buffered rows share rank for concat and the pad-to-max sync
+        # gather (`_canonicalize_list_states` contract); idempotent
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
     return preds, target
 
 

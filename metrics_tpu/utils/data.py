@@ -27,7 +27,10 @@ TensorOrList = Union[Array, List[Array]]
 
 def dim_zero_cat(x: TensorOrList) -> Array:
     """Concatenate a (possibly list-kind) state along dim 0."""
-    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+    # np.ndarray included: a post-reduction/restored state may be a bare HOST
+    # array, which must not fall through to the list branch (whose emptiness
+    # test would raise "truth value of an array is ambiguous")
+    if isinstance(x, (jnp.ndarray, jax.Array, np.ndarray)) and not isinstance(x, (list, tuple)):
         return x
     x = [jnp.atleast_1d(v) for v in x]
     if not x:
@@ -44,7 +47,7 @@ def dim_zero_cat_ravel(x: TensorOrList) -> Array:
     numpy rows alongside device arrays. A post-sync reduced state (bare
     array) is flattened and returned as-is.
     """
-    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+    if isinstance(x, (jnp.ndarray, jax.Array, np.ndarray)) and not isinstance(x, (list, tuple)):
         return jnp.ravel(x)
     if not x:
         raise ValueError("No samples to concatenate")

@@ -81,11 +81,18 @@ def run_fanout(
         object.__setattr__(wrapper, ok_attr, False)
         object.__setattr__(wrapper, program_attr, None)
         return False
+    from metrics_tpu.metric import _propagate_static_attrs
+
     for m, st in zip(clones, new_states):
         for name, value in st.items():
-            setattr(m, name, value)
+            object.__setattr__(m, name, value)  # state leaves: no version logic
         m._update_count += 1
         m._computed = None
+    for m in clones[1:]:
+        # update-inferred static attrs (shape-derived, so identical across
+        # clones) flow from clone 0 — whose eager first-signature pass set
+        # them — to the rest, mirroring _wrap_update's template propagation
+        _propagate_static_attrs(clones[0], m)
     return True
 
 

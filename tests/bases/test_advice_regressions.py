@@ -1,0 +1,71 @@
+"""Round-4 advisor findings, pinned (see ADVICE.md round 4).
+
+Each test reproduces the reported edge exactly and asserts the fixed
+behavior: host-numpy bare-array states in the cat helpers, mixed-rank binary
+AUROC rows under raw-row buffering, and static-attr propagation through the
+fused fan-out write-back.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utils import checks
+from metrics_tpu.utils.data import dim_zero_cat, dim_zero_cat_ravel
+
+
+class TestBareHostArrayStates:
+    def test_cat_ravel_accepts_bare_numpy(self):
+        # post-reduction/restored states can be bare HOST arrays; the
+        # multi-element truthiness crash was the advisor finding
+        out = dim_zero_cat_ravel(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        np.testing.assert_array_equal(np.asarray(out), [1.0, 2.0, 3.0, 4.0])
+
+    def test_cat_accepts_bare_numpy(self):
+        x = np.asarray([1.0, 2.0, 3.0], np.float32)
+        assert dim_zero_cat(x) is x  # type-preserving passthrough
+
+
+class TestAurocMixedRankBinaryRows:
+    def test_flat_then_column_rows_concat_and_compute(self):
+        """(N,) then (M, 1) binary rows must canonicalize to a shared rank
+        for concat — and for the pad-to-max sync gather."""
+        rng = np.random.RandomState(0)
+        m = mt.AUROC(pos_label=1)
+        p1, t1 = rng.rand(12).astype(np.float32), rng.randint(0, 2, 12)
+        p2, t2 = rng.rand(8, 1).astype(np.float32), rng.randint(0, 2, (8, 1))
+        m.update(jnp.asarray(p1), jnp.asarray(t1))
+        m.update(jnp.asarray(p2), jnp.asarray(t2))
+        m._canonicalize_list_states()
+        assert all(v.ndim == 1 for v in m.preds)
+        got = float(m.compute())
+        flat = mt.AUROC(pos_label=1)
+        flat.update(
+            jnp.asarray(np.concatenate([p1, p2.ravel()])),
+            jnp.asarray(np.concatenate([t1, t2.ravel()])),
+        )
+        assert got == pytest.approx(float(flat.compute()), abs=1e-6)
+
+
+class TestFanoutStaticAttrPropagation:
+    def test_clones_see_inferred_attrs_after_fused_steps(self):
+        """Accuracy infers `mode` in update; after fused fan-out steps every
+        clone must carry it (the eager first pass sets clone attrs, and the
+        fused write-back must keep propagating — advisor finding)."""
+        prev = checks._get_validation_mode()
+        checks.set_validation_mode("first")
+        try:
+            rng = np.random.RandomState(1)
+            boot = mt.BootStrapper(mt.Accuracy(), num_bootstraps=3, sampling_strategy="multinomial")
+            p = jnp.asarray(rng.rand(32).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 2, 32))
+            for _ in range(3):
+                boot.update(p, t)
+            assert boot._boot_program is not None
+            modes = [m.__dict__.get("mode") for m in boot.metrics]
+            assert all(v is not None for v in modes), modes
+            assert len({str(v) for v in modes}) == 1
+        finally:
+            checks.set_validation_mode(prev)
