@@ -1,0 +1,21 @@
+"""Composition layer under the 8-device mesh rank-sync engine.
+
+Runs the same certification `dryrun_multichip` performs (one emulated rank
+per device): MetricCollection with MERGED compute groups, BootStrapper's
+recursive clone-fleet sync, and a raw-row cat state canonicalized MID-BUFFER
+by sync — each against a single-device all-data oracle. The assertions live
+in `__graft_entry__.composition_sync_certification`; this test pins them in
+the CI tier so the dryrun can never silently rot.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def test_composition_layer_sync_certification():
+    from __graft_entry__ import composition_sync_certification
+
+    out = composition_sync_certification(jax.devices())
+    assert set(out) == {"collection", "bootstrap", "raw_cat"}
+    assert set(out["collection"]) == {"prec", "rec", "acc"}
+    assert set(out["bootstrap"]) >= {"mean", "std"}
