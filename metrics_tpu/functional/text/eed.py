@@ -15,6 +15,9 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import native
 
 
 def _eed_function(
@@ -129,6 +132,27 @@ def _eed_update(
         sentence_eed = []
     if 0 in (len(preds), len(target[0])):
         return sentence_eed
+    # native fast path: every (hypothesis, reference) pair of the batch runs
+    # through ONE C++ call (CSR-packed codepoints), then a per-sentence
+    # best-of-references reduction on host — sentence scores are buffered as
+    # HOST scalars (no per-sentence device transfer; one conversion at
+    # compute, the raw-row buffering pattern)
+    if native.available():
+        pair_sent: List[int] = []
+        hyp_ids: List[np.ndarray] = []
+        ref_ids: List[np.ndarray] = []
+        for si, (hypothesis, target_sentences) in enumerate(zip(preds, target)):
+            h = native.codepoints(hypothesis)
+            for reference in target_sentences:
+                pair_sent.append(si)
+                hyp_ids.append(h)
+                ref_ids.append(native.codepoints(reference))
+        scores = native.eed_batch(hyp_ids, ref_ids, alpha, rho, deletion, insertion)
+        if scores is not None:
+            best = np.full(len(preds), np.inf)
+            np.minimum.at(best, np.asarray(pair_sent), scores)
+            sentence_eed.extend(np.asarray(b, dtype=np.float32) for b in best)
+            return sentence_eed
     for hypothesis, target_sentences in zip(preds, target):
         sentence_eed.append(
             _compute_sentence_statistics(hypothesis, target_sentences, alpha, rho, deletion, insertion)
@@ -168,7 +192,8 @@ def extended_edit_distance(
     sentence_level_scores = _eed_update(preds, target, language, alpha, rho, deletion, insertion)
     average = _eed_compute(sentence_level_scores)
     if return_sentence_level_score:
-        return average, sentence_level_scores
+        # host-buffered scores (native path) convert at the API boundary only
+        return average, [jnp.asarray(s, dtype=jnp.float32) for s in sentence_level_scores]
     return average
 
 

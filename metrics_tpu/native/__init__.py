@@ -96,6 +96,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.mt_lcs.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32]
         lib.mt_lcs_batch.restype = None
         lib.mt_lcs_batch.argtypes = [i32p, i64p, i32p, i64p, ctypes.c_int64, i32p]
+        f64 = ctypes.c_double
+        lib.mt_eed_score.restype = f64
+        lib.mt_eed_score.argtypes = [i32p, ctypes.c_int32, i32p, ctypes.c_int32, ctypes.c_int32, f64, f64, f64, f64]
+        lib.mt_eed_batch.restype = None
+        lib.mt_eed_batch.argtypes = [
+            i32p, i64p, i32p, i64p, ctypes.c_int64, ctypes.c_int32, f64, f64, f64, f64,
+            ctypes.POINTER(f64),
+        ]
     except (OSError, AttributeError):
         return None
     _lib = lib
@@ -196,12 +204,55 @@ def lcs_batch(a_seqs: Sequence[np.ndarray], b_seqs: Sequence[np.ndarray]) -> Opt
     return _batch("mt_lcs_batch", a_seqs, b_seqs)
 
 
+def codepoints(s: str) -> np.ndarray:
+    """Unicode codepoints of a string as int32 (id interning for char DPs)."""
+    return np.frombuffer(s.encode("utf-32-le"), dtype=np.int32)
+
+
+def eed_batch(
+    hyp_seqs: Sequence[np.ndarray],
+    ref_seqs: Sequence[np.ndarray],
+    alpha: float,
+    rho: float,
+    deletion: float,
+    insertion: float,
+    space_id: int = 32,
+) -> Optional[np.ndarray]:
+    """EED sentence scores for k packed codepoint pairs in one native call;
+    None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    assert len(hyp_seqs) == len(ref_seqs)
+    h_flat, h_off = _pack(hyp_seqs)
+    r_flat, r_off = _pack(ref_seqs)
+    out = np.empty(len(hyp_seqs), dtype=np.float64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.mt_eed_batch(
+        h_flat.ctypes.data_as(i32p),
+        h_off.ctypes.data_as(i64p),
+        r_flat.ctypes.data_as(i32p),
+        r_off.ctypes.data_as(i64p),
+        len(hyp_seqs),
+        space_id,
+        alpha,
+        rho,
+        deletion,
+        insertion,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out
+
+
 __all__ = [
     "available",
     "intern_ids",
+    "codepoints",
     "levenshtein",
     "levenshtein_batch",
     "levenshtein_matrix",
     "lcs_length",
     "lcs_batch",
+    "eed_batch",
 ]

@@ -11,6 +11,7 @@
 // environments without a compiler.
 #include <algorithm>
 #include <cstdint>
+#include <limits>
 #include <vector>
 
 extern "C" {
@@ -78,6 +79,55 @@ void mt_lcs_batch(const int32_t* a_flat, const int64_t* a_off, const int32_t* b_
     for (int64_t i = 0; i < k; ++i) {
         out[i] = mt_lcs(a_flat + a_off[i], (int32_t)(a_off[i + 1] - a_off[i]),
                         b_flat + b_off[i], (int32_t)(b_off[i + 1] - b_off[i]));
+    }
+}
+
+// Extended Edit Distance (Stanchev et al. 2019) sentence score over character
+// codepoints: the CDER alignment grid with a long-jump at blank positions
+// (penalty `alpha`) and the `rho` coverage penalty. Double precision matches
+// the python fallback's float semantics exactly (tie-breaks included: the
+// first minimum's index takes the visit). `space_id` marks the jump anchor
+// (codepoint 32 for the published en/ja preprocessing).
+double mt_eed_score(const int32_t* hyp, int32_t m, const int32_t* ref, int32_t n,
+                    int32_t space_id, double alpha, double rho, double deletion,
+                    double insertion) {
+    const double INF = std::numeric_limits<double>::infinity();
+    std::vector<int32_t> visits(m + 1, -1);
+    std::vector<double> row(m + 1, 1.0), next(m + 1);
+    row[0] = 0.0;
+    for (int32_t w = 1; w <= n; ++w) {
+        std::fill(next.begin(), next.end(), INF);
+        next[0] = row[0] + 1.0;
+        const int32_t ref_char = ref[w - 1];
+        for (int32_t i = 1; i <= m; ++i) {
+            const double sub = row[i - 1] + (hyp[i - 1] == ref_char ? 0.0 : 1.0);
+            next[i] = std::min({next[i - 1] + deletion, sub, row[i] + insertion});
+        }
+        int32_t min_index = 0;
+        for (int32_t i = 1; i <= m; ++i)
+            if (next[i] < next[min_index]) min_index = i;
+        visits[min_index] += 1;
+        if (ref_char == space_id) {
+            const double jump = alpha + next[min_index];
+            for (int32_t i = 0; i <= m; ++i) next[i] = std::min(next[i], jump);
+        }
+        std::swap(row, next);
+    }
+    double coverage = 0.0;
+    for (int32_t i = 0; i <= m; ++i) coverage += visits[i] >= 0 ? visits[i] : 1;
+    coverage *= rho;
+    const double score = (row[m] + coverage) / ((double)n + coverage);
+    return score < 1.0 ? score : 1.0;
+}
+
+// Batched EED over k CSR-packed (hypothesis, reference) codepoint pairs.
+void mt_eed_batch(const int32_t* h_flat, const int64_t* h_off, const int32_t* r_flat,
+                  const int64_t* r_off, int64_t k, int32_t space_id, double alpha,
+                  double rho, double deletion, double insertion, double* out) {
+    for (int64_t i = 0; i < k; ++i) {
+        out[i] = mt_eed_score(h_flat + h_off[i], (int32_t)(h_off[i + 1] - h_off[i]),
+                              r_flat + r_off[i], (int32_t)(r_off[i + 1] - r_off[i]),
+                              space_id, alpha, rho, deletion, insertion);
     }
 }
 

@@ -114,3 +114,55 @@ class TestMetricsUseNative:
         monkeypatch.setattr(native, "lcs_length", lambda *a: None)
         slow = rouge._lcs_length(pred, tgt)
         assert fast == slow
+
+
+class TestEEDKernel:
+    """Native EED CDER grid must match the python DP bit for bit (double
+    precision both sides, first-min tie-break included)."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_sentences_match_python(self, seed):
+        if not native.available():
+            pytest.skip("no native toolchain")
+        from metrics_tpu.functional.text.eed import _eed_function
+
+        rng = np.random.RandomState(seed)
+        vocab = "the cat sat on a mat dog ran fast tall grass bird hills".split()
+        for _ in range(6):
+            h = " " + " ".join(vocab[i] for i in rng.randint(0, len(vocab), rng.randint(3, 12))) + " "
+            r = " " + " ".join(vocab[i] for i in rng.randint(0, len(vocab), rng.randint(3, 12))) + " "
+            py = _eed_function(h, r)
+            nat = float(
+                native.eed_batch(
+                    [native.codepoints(h)], [native.codepoints(r)], 2.0, 0.3, 0.2, 1.0
+                )[0]
+            )
+            assert py == nat, (h, r, py, nat)
+
+    def test_update_matches_fallback(self, monkeypatch):
+        """The metric value must be identical with the native path disabled
+        (and the batched path must actually engage when available)."""
+        from metrics_tpu.functional.text import eed as eed_mod
+
+        preds = ["this is the prediction", "here is an other sample"]
+        target = [["this is the reference", "an other reference too"], ["here is another one"]]
+        fast = eed_mod._eed_update(preds, target)
+        monkeypatch.setattr(eed_mod.native, "eed_batch", lambda *a, **k: None)
+        slow = eed_mod._eed_update(preds, target)
+        np.testing.assert_allclose(
+            [float(v) for v in fast], [float(v) for v in slow], rtol=1e-6
+        )
+
+    def test_edge_shapes(self):
+        if not native.available():
+            pytest.skip("no native toolchain")
+        # empty hypothesis, single-char pairs, all-space reference
+        out = native.eed_batch(
+            [native.codepoints(""), native.codepoints("a"), native.codepoints("ab")],
+            [native.codepoints("abc"), native.codepoints("a"), native.codepoints("   ")],
+            2.0, 0.3, 0.2, 1.0,
+        )
+        from metrics_tpu.functional.text.eed import _eed_function
+
+        want = [_eed_function("", "abc"), _eed_function("a", "a"), _eed_function("ab", "   ")]
+        np.testing.assert_allclose(np.asarray(out), want, rtol=0, atol=0)
