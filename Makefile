@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep ci clean
+.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep ci clean convert-weights test-real-weights
 
 # All targets run offline against the already-installed environment
 # (jax/flax/optax/pytest are assumed present — no network access needed).
@@ -38,6 +38,15 @@ dryrun:
 # Headline benchmark (one JSON line; runs on whatever jax backend is live).
 bench:
 	$(PY) bench.py
+
+# Convert every real checkpoint in WEIGHTS=<dir> to the .npz formats the
+# model-backed metrics load (see docs/weights.md). Then run the gated
+# real-weight numeric-parity tests against them.
+convert-weights:
+	$(PY) tools/convert_real_weights.py $(WEIGHTS)
+
+test-real-weights:
+	METRICS_TPU_REAL_WEIGHTS=$(WEIGHTS) $(PY) -m pytest tests/models/test_real_weights.py -q -rs
 
 # Quick structural check of the bench harness without the full timed runs.
 bench-smoke:

@@ -1,0 +1,64 @@
+"""One-command conversion of every recognized real checkpoint in a directory.
+
+Usage:
+    python tools/convert_real_weights.py /path/to/weights_dir
+    # or: make convert-weights WEIGHTS=/path/to/weights_dir
+
+Scans the directory for the artifacts the reference implementation downloads
+(reference authority chain: torch-fidelity InceptionV3 `image/fid.py:41-58`,
+`lpips` package nets `image/lpip.py:24-77`, HF transformer dirs
+`text/bert.py:171-205`) and converts each to this framework's flat ``.npz``
+next to the source:
+
+    *inception*.pth        -> inception.npz   (tools/convert_inception_weights.py)
+    lpips_<net>*.pth       -> lpips_<net>.npz (tools/convert_lpips_weights.py)
+    <dir with config.json> -> used directly by BERTScore/InfoLM (no conversion)
+
+Already-converted ``.npz`` files are left untouched. The converted outputs
+are exactly what ``METRICS_TPU_REAL_WEIGHTS=<dir> pytest
+tests/models/test_real_weights.py`` consumes.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+
+def convert_dir(weights_dir: str) -> list:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import numpy as np
+    import torch
+
+    from convert_inception_weights import convert_state_dict as convert_inception
+    from convert_lpips_weights import convert_state_dict as convert_lpips
+
+    root = Path(weights_dir)
+    done = []
+    for pth in sorted(root.glob("*.pth")):
+        name = pth.name.lower()
+        if "inception" in name:
+            out, convert = root / "inception.npz", convert_inception
+        elif name.startswith("lpips_"):
+            net = name.split("_", 1)[1].split(".")[0].split("-")[0]
+            out, convert = root / f"lpips_{net}.npz", lambda s, n=net: convert_lpips(n, s)
+        else:
+            continue  # unrecognized artifact: leave it alone
+        if out.exists():
+            continue  # converted already — don't re-load a multi-hundred-MB file
+        loaded = torch.load(pth, map_location="cpu")
+        if not hasattr(loaded, "items"):
+            continue  # not a flat state dict (e.g. a pickled full module)
+        state = {
+            k: v.numpy() if hasattr(v, "numpy") else np.asarray(v) for k, v in loaded.items()
+        }
+        np.savez(out, **convert(state))
+        done.append(str(out))
+    return done
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        raise SystemExit(__doc__)
+    converted = convert_dir(sys.argv[1])
+    print("converted:" if converted else "nothing new to convert", *converted, sep="\n  ")
