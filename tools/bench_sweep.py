@@ -393,6 +393,43 @@ def main() -> None:
     modes_by_name = {e[0]: m for e, m in zip(SWEEP, modes)}
     ordered = [e for e, m in zip(SWEEP, modes) if m] + [e for e, m in zip(SWEEP, modes) if not m]
     np_data_by_name = {}  # host copies kept for the post-pass reference arm
+
+    def _shaped_floor_ms(metric, steps: int) -> float:
+        """Per-PROGRAM cost of a chained jitted step with this metric's exact
+        state-buffer profile (bench.py's shaped-probe methodology, per row).
+
+        Runs immediately after the row's own timing, so it sees the SAME
+        backend regime (pipelined for jit rows, post-D2H for eager rows), and
+        its trailing blocking sync amortizes over the row's OWN step count —
+        `floor_bound_factor` is then an apples-to-apples bound. Returns 0.0
+        for list-state metrics (their per-update cost is a host append, not a
+        program; a program floor is the wrong model there).
+        """
+
+        def collect(m, prefix, into):
+            for k, v in m.metric_state.items():
+                into[prefix + k] = v
+            for cname, child in m._named_child_metrics():
+                collect(child, f"{prefix}{cname}.", into)
+
+        try:
+            state: dict = {}
+            collect(metric, "", state)
+            if not state or any(isinstance(v, list) for v in state.values()):
+                return 0.0
+            g = jax.jit(lambda st: {k: a + 1 for k, a in st.items()})
+            box = g(state)
+            jax.block_until_ready(box)
+            best = float("inf")
+            for _ in range(2):
+                start = time.perf_counter()
+                for _ in range(steps):
+                    box = g(box)
+                jax.block_until_ready(box)
+                best = min(best, (time.perf_counter() - start) / steps)
+            return best
+        except Exception:
+            return 0.0
     for name, ctor, kind, samples in ordered:
         try:
             data = _data(kind, rng)
@@ -459,6 +496,10 @@ def main() -> None:
                     best = min(best, time.perf_counter() - start)
             rate = steps * samples / best
             row = {"metric": name, "mode": mode, "updates_per_s": round(steps / best, 1), "samples_per_s": round(rate, 1)}
+            floor_s = _shaped_floor_ms(metric, steps)
+            if floor_s > 0:
+                row["floor_ms_per_program"] = round(floor_s * 1000.0, 3)
+                row["floor_bound_factor"] = round((best / steps) / floor_s, 2)
             results.append(row)
             print(json.dumps(results[-1]))
         except Exception as err:
@@ -510,8 +551,19 @@ def main() -> None:
         if ref_updates > 0:
             row["ref_updates_per_s"] = round(ref_updates, 1)
             row["vs_baseline"] = round(row["updates_per_s"] / ref_updates, 2)
-            if (row["vs_baseline"] > 10 or row["vs_baseline"] < 0.5) and name in OUTLIER_NOTES:
+            # EVERY sub-1x row must carry an explanation: a curated note, or
+            # the row's own measured floor evidence (within 1.6x of a chained
+            # program with its exact state profile, same backend regime)
+            if (row["vs_baseline"] > 10 or row["vs_baseline"] < 1.0) and name in OUTLIER_NOTES:
                 row["note"] = OUTLIER_NOTES[name]
+            elif row["vs_baseline"] < 1.0 and 0 < row.get("floor_bound_factor", 0) <= 1.6:
+                row["note"] = (
+                    f"floor-bound: a chained jitted program with this metric's exact "
+                    f"state profile costs {row['floor_ms_per_program']} ms through this "
+                    f"backend (measured in the row's own regime); the row runs within "
+                    f"{row['floor_bound_factor']}x of that — the gap to the torch-CPU "
+                    "baseline is the backend's per-program cost, not metric code"
+                )
             print(json.dumps({"metric": name, "ref_updates_per_s": row["ref_updates_per_s"], "vs_baseline": row["vs_baseline"]}))
     summary = None
     if results:
@@ -521,12 +573,13 @@ def main() -> None:
             "n": len(results),
             "median_updates_per_s": round(float(np.median([r["updates_per_s"] for r in results])), 1),
             "median_vs_baseline": round(float(np.median(with_ratio)), 2) if with_ratio else None,
-            # a slow row (<0.1x) without a note is a regression to chase; a
-            # fast row (>10x) without a note is covered by the blanket cause
+            # ANY sub-1x row without a note (curated or measured-floor) is a
+            # regression to chase; a fast row (>10x) without a note is
+            # covered by the blanket cause
             "unexplained_slow_outliers": [
                 r["metric"]
                 for r in results
-                if "vs_baseline" in r and r["vs_baseline"] < 0.1 and "note" not in r
+                if "vs_baseline" in r and r["vs_baseline"] < 1.0 and "note" not in r
             ],
             "fast_outliers_blanket_note": FAST_BLANKET_NOTE,
             "baseline_hardware": "torch-cpu (mounted reference), update-only protocol both sides",
