@@ -51,6 +51,47 @@ def jit_distributed_available() -> bool:
 
 
 _UNSET = object()  # sentinel: distinguishes "attribute absent" from "set to None"
+_JITTABLE_SCALARS = (int, float, bool, complex)
+
+
+def _probe_traceable(program: Callable, *args: Any, **kwargs: Any) -> bool:
+    """Abstract-trace probe (no compile, no dispatch): False when the program
+    cannot trace with these arguments — e.g. an update whose num_classes
+    inference is eager-only. Used by every fused path to decline fusion
+    SILENTLY: an untraceable configuration is supported, not an anomaly worth
+    a per-instance warning; only post-probe runtime failures warn."""
+    try:
+        jax.eval_shape(program, *args, **kwargs)
+        return True
+    except Exception:  # noqa: BLE001 — any trace failure means "decline"
+        return False
+
+
+def _leaves_jittable(tree: Any) -> bool:
+    """True when every leaf can be an argument of a jitted program: arrays or
+    python scalars, and nothing already traced. String batches (text metrics)
+    and other host objects fail here, which keeps them off the fused-path
+    bookkeeping entirely — no signature reprs, no doomed trace attempts."""
+    for leaf in jax.tree.flatten(tree)[0]:
+        if isinstance(leaf, jax.core.Tracer):
+            return False
+        if not isinstance(leaf, (jax.Array, np.ndarray, np.generic, *_JITTABLE_SCALARS)):
+            return False
+    return True
+
+
+_checks_cached = None
+
+
+def _checks_module():
+    """metrics_tpu.utils.checks, resolved once (import cycle forbids a
+    top-level import; an inline ``from ... import`` costs ~2 us per call)."""
+    global _checks_cached
+    if _checks_cached is None:
+        from metrics_tpu.utils import checks as _checks_cached_
+
+        _checks_cached = _checks_cached_
+    return _checks_cached
 
 
 class Metric(ABC):
@@ -270,7 +311,10 @@ class Metric(ABC):
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped(*args: Any, **kwargs: Any) -> None:
-            from metrics_tpu.utils.checks import _get_validation_mode
+            # lazily-resolved module handle: a `from ... import` here costs
+            # ~2 us of import machinery on EVERY update
+            _checks = _checks_module()
+            _get_validation_mode = _checks._get_validation_mode
 
             self._computed = None
             self._update_count += 1
@@ -285,20 +329,27 @@ class Metric(ABC):
                 and not self._suppress_update_fusion
                 and _get_validation_mode() != "full"
                 and self._fusable_states()
-                and not any(
-                    isinstance(leaf, jax.core.Tracer) for leaf in jax.tree.flatten((args, kwargs))[0]
-                )
+                and _leaves_jittable((args, kwargs))
             ):
                 if self._fused_seen_signatures is None:
                     self._fused_seen_signatures = {}
                 signature = ("__update__", self._forward_signature(args, kwargs))
+                run_fused = False
                 if signature in self._fused_seen_signatures:
+                    state = {name: getattr(self, name) for name in self._defaults}
+                    if self._fused_update_program is None:
+                        program = self._build_fused_update()
+                        if _probe_traceable(program, state, *args, **kwargs):
+                            object.__setattr__(self, "_fused_update_program", program)
+                        else:
+                            object.__setattr__(self, "_fused_update_ok", False)
+                            object.__setattr__(self, "_fused_update_template", None)
+                            signature = None  # probe declined: plain eager from here on
+                    run_fused = self._fused_update_program is not None
+                if run_fused:
                     try:
-                        if self._fused_update_program is None:
-                            self._fused_update_program = self._build_fused_update()
-                        state = {name: getattr(self, name) for name in self._defaults}
                         new_state = self._fused_update_program(state, *args, **kwargs)
-                    except Exception as exc:  # noqa: BLE001 — any trace/compile failure
+                    except Exception as exc:  # noqa: BLE001 — post-probe runtime failure
                         rank_zero_warn(
                             f"Fused update for `{type(self).__name__}` raised "
                             f"{type(exc).__name__}: {exc}. Falling back to the eager "
@@ -315,8 +366,6 @@ class Metric(ABC):
             # TraceAnnotation shows up in jax.profiler / xprof timelines —
             # the analogue of the reference's TorchScript profiling markers
             # (SURVEY §5 "Tracing / profiling")
-            from metrics_tpu.utils import checks as _checks
-
             prev_owner = _checks._check_owner
             _checks._check_owner = self  # scope "first"-mode memory per instance
             try:
@@ -616,7 +665,7 @@ class Metric(ABC):
         return self._run_many(True, args, kwargs)
 
     def _run_many(self, with_values: bool, args: tuple, kwargs: dict) -> Any:
-        from metrics_tpu.utils.checks import _get_validation_mode
+        _get_validation_mode = _checks_module()._get_validation_mode
 
         if self._is_synced:
             # same guard as forward (reference `metric.py:240-244`): merging
@@ -777,22 +826,40 @@ class Metric(ABC):
         the validation mode is "full", which asks for per-update value checks
         that a traced program cannot perform.
         """
-        from metrics_tpu.utils.checks import _get_validation_mode
+        _get_validation_mode = _checks_module()._get_validation_mode
 
-        fusable = self._fused_forward_ok and _get_validation_mode() != "full" and self._fusable_states()
+        fusable = (
+            self._fused_forward_ok
+            and _get_validation_mode() != "full"
+            and self._fusable_states()
+            and _leaves_jittable((args, kwargs))
+        )
         if not fusable:
-            # permanently-unfusable metrics (and mode "full") skip the
-            # signature bookkeeping entirely — no repr of text batches, no
-            # retained signature strings, just the eager path
+            # permanently-unfusable metrics (and mode "full", and host-object
+            # inputs like string batches) skip the signature bookkeeping
+            # entirely — no repr of text batches, no retained signature
+            # strings, just the eager path
             return self._forward_reduce_state_update_eager(*args, **kwargs)
         if self._fused_seen_signatures is None:
             self._fused_seen_signatures = {}  # insertion-ordered → FIFO eviction
         signature = self._forward_signature(args, kwargs)
         seen = signature in self._fused_seen_signatures
+        if seen and self._fused_forward is None:
+            program = self._build_fused_forward()
+            state = {name: getattr(self, name) for name in self._defaults}
+            probe_args = (
+                (state, self._update_count + 1, *args) if self._fused_needs_count else (state, *args)
+            )
+            if _probe_traceable(program, *probe_args, **kwargs):
+                self._fused_forward = program
+            else:
+                # probe declined: permanently eager, and the signature is
+                # already recorded — return the eager result directly
+                self._fused_forward_ok = False
+                self._fused_template = None
+                return self._forward_reduce_state_update_eager(*args, **kwargs)
         if seen:
             try:
-                if self._fused_forward is None:
-                    self._fused_forward = self._build_fused_forward()
                 state = {name: getattr(self, name) for name in self._defaults}
                 if self._fused_needs_count:
                     merged, batch_val = self._fused_forward(state, self._update_count + 1, *args, **kwargs)

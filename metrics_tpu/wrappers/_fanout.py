@@ -66,11 +66,18 @@ def run_fanout(
             object.__setattr__(wrapper, program_attr, None)
             return False
     try:
-        if getattr(wrapper, program_attr) is None or getattr(wrapper, versions_attr) != versions:
-            _, upd, _ = clones[0].as_functions()
-            object.__setattr__(wrapper, program_attr, jax.jit(build_program(upd)))
-            object.__setattr__(wrapper, versions_attr, versions)
         states = [m.metric_state for m in clones]
+        if getattr(wrapper, program_attr) is None or getattr(wrapper, versions_attr) != versions:
+            from metrics_tpu.metric import _probe_traceable
+
+            _, upd, _ = clones[0].as_functions()
+            program = jax.jit(build_program(upd))
+            if not _probe_traceable(program, states, *call_args, **call_kwargs):
+                object.__setattr__(wrapper, ok_attr, False)
+                object.__setattr__(wrapper, program_attr, None)
+                return False
+            object.__setattr__(wrapper, program_attr, program)
+            object.__setattr__(wrapper, versions_attr, versions)
         new_states = getattr(wrapper, program_attr)(states, *call_args, **call_kwargs)
     except Exception as exc:  # noqa: BLE001 — any trace/compile failure
         rank_zero_warn(

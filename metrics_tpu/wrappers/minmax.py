@@ -130,7 +130,15 @@ class MinMaxMetric(Metric):
                         "min": new_mn,
                     }
 
-                object.__setattr__(self, "_mm_program", jax.jit(step))
+                from metrics_tpu.metric import _probe_traceable
+
+                program = jax.jit(step)
+                if not _probe_traceable(program, self.min_val, self.max_val, *args, **kwargs):
+                    object.__setattr__(self, "_mm_ok", False)
+                    object.__setattr__(self, "_mm_program", None)
+                    object.__setattr__(self, "_mm_versions", None)
+                    return False
+                object.__setattr__(self, "_mm_program", program)
                 object.__setattr__(self, "_mm_versions", versions)
             new_state, new_mn, new_mx, out = self._mm_program(
                 self.min_val, self.max_val, *args, **kwargs

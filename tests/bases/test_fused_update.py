@@ -82,14 +82,34 @@ def test_first_signature_call_stays_eager():
     assert m._update_count == 4
 
 
-def test_host_string_metric_falls_back_permanently():
+def test_host_string_metric_never_enters_fusion_bookkeeping():
+    """String batches are gated out BEFORE any signature/trace work: no
+    doomed fused attempt, no warning, no retained signature reprs (round-5
+    contract — the old path warned + permanently disabled per instance)."""
     w = mt.WordErrorRate()
     with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
+        warnings.simplefilter("error")  # any fused-fallback warning fails here
         for _ in range(3):
             w.update(["hello world"], ["hello there"])
-    assert w._fused_update_ok is False
+    assert w._fused_update_ok is True  # never attempted, never disabled
+    assert w._fused_seen_signatures is None  # zero bookkeeping for host inputs
     assert round(float(w.compute()), 4) == 0.5
+
+
+def test_untraceable_config_declines_fusion_silently():
+    """Accuracy with label inputs and no num_classes cannot infer classes
+    under tracing — the eval_shape probe declines fusion with NO warning and
+    values keep flowing through the eager path (round-5 contract)."""
+    m = mt.Accuracy()
+    t = jnp.asarray(RNG.randint(0, 5, 64))
+    p = jnp.asarray(RNG.randint(0, 5, 64))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        for _ in range(3):
+            m.update(p, t)
+    assert m._fused_update_ok is False  # probe declined quietly
+    assert m._fused_update_program is None
+    assert 0.0 <= float(m.compute()) <= 1.0
 
 
 def test_hyperparameter_mutation_invalidates_program():

@@ -26,16 +26,17 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 BATCH, C = 4096, 16
 STEPS, TRIALS = 20, 3
 
-# per-row timed-step overrides, two directions: sync/recompile-floor rows
-# (single-digit updates/s) get FEWER steps so the whole sweep stays under
-# ~10 minutes, while the fused multinomial fan-out gets MORE steps so its
-# one blocking clone-state sync per trial amortizes instead of dominating
-# the short trial (at the default 20 steps the ~110 ms sync reads as
-# ~5x fewer updates/s than steady state)
+# per-row timed-step overrides: the fused wrapper rows (both BootStrapper
+# strategies and both MultioutputWrapper configs run as ONE program per step
+# since round 5) get MORE steps so their one blocking clone-state sync per
+# trial amortizes instead of dominating the short trial (at the default 20
+# steps the ~110 ms sync reads as ~5x fewer updates/s than steady state)
 EAGER_STEPS_OVERRIDE = {
-    "BootStrapper(MeanSquaredError)": 10,
+    "BootStrapper(MeanSquaredError)": 100,
     "BootStrapper(MeanSquaredError,multinomial)": 100,
-    "MultioutputWrapper(MeanSquaredError)": 3,
+    "MultioutputWrapper(MeanSquaredError)": 100,
+    "MultioutputWrapper(MeanSquaredError,no_nan_filter)": 100,
+    "MinMaxMetric(Accuracy)": 100,
 }
 
 
@@ -273,11 +274,11 @@ OUTLIER_NOTES = {
     "AUC": "append-only update both sides; ours buffers RAW rows (zero-dispatch list append, deferred canonicalization — docs/performance.md); residual ratio is python bookkeeping vs torch's in-process append",
     "RetrievalPrecisionRecallCurve": "append-only update both sides; ours buffers RAW rows (zero-dispatch list append, deferred canonicalization — docs/performance.md); residual ratio is python bookkeeping vs torch's in-process append",
     "RetrievalRecallAtFixedPrecision": "append-only update both sides; ours buffers RAW rows (zero-dispatch list append, deferred canonicalization — docs/performance.md); residual ratio is python bookkeeping vs torch's in-process append",
-    "MinMaxMetric(Accuracy)": "wrapper state lives in the child metric; the child update runs as the fused single-program update (docs/performance.md), so the row sits at the tunnel's per-program floor — below torch-CPU's in-process step, see eager_per_step in bench.py",
+    "MinMaxMetric(Accuracy)": "wrapper state lives in the child metric; the child update runs as the fused single-program update (and forward as the fused minmax program, round 5 — docs/performance.md), so the row sits at the tunnel's per-program floor — below torch-CPU's in-process step, see the row's own floor_bound_factor",
     "ClasswiseWrapper(Accuracy)": "the wrapper's own as_functions composes the child kernels (labeling happens at compute), so the update is the child's fused jit program; the reference fans out eagerly",
-    "BootStrapper(MeanSquaredError)": "poisson draws are split into power-of-two chunks (bounded compile cache — 8-19 ms/update steady-state in a fresh session, vs 10 s/update when every draw recompiled) but still run ~10 chunk programs x 4 clones per step against torch-CPU's zero dispatch cost, so the row sits at the tunnel session's per-program floor; the multinomial row is the single-program static-shape configuration (docs/performance.md)",
-    "BootStrapper(MeanSquaredError,multinomial)": "all clones run as ONE vmapped program per update (wrappers/_fanout.py fused fan-out); the timed loop still pays one blocking clone-state sync per trial, so short-step rows read sync-floor-bound — uncontended steady-state measures ~900 updates/s (docs/performance.md)",
-    "MultioutputWrapper(MeanSquaredError)": "remove_nans=True makes output shapes data-dependent: one blocking mask read per update (the remote backend's ~100ms sync floor) vs torch-CPU's free in-process read; all per-column gathers are async behind that single read",
+    "BootStrapper(MeanSquaredError)": "poisson bootstrap runs as ONE weighted-row program per step since round 5 (counts as row weights over vmapped per-row state deltas, certified vs the eager path — wrappers/bootstrapping.py); a remaining gap vs torch-CPU is the tunnel's per-program cost, see the row's floor_bound_factor",
+    "BootStrapper(MeanSquaredError,multinomial)": "all clones run as ONE vmapped program per update (wrappers/_fanout.py fused fan-out); residual gap vs torch-CPU is the tunnel's per-program cost, see the row's floor_bound_factor",
+    "MultioutputWrapper(MeanSquaredError)": "remove_nans=True zero-weights NaN rows INSIDE the one-program column fan-out since round 5 (no host mask read — wrappers/multioutput.py); residual gap vs torch-CPU is the tunnel's per-program cost, see the row's floor_bound_factor",
     "MultioutputWrapper(MeanSquaredError,no_nan_filter)": "remove_nans=False has static shapes: all column clones run as ONE vmapped program per update (wrappers/multioutput.py fused fan-out)",
     # host-side text rows: both sides are host string processing; large
     # ratios come from the native C++ DP kernels (metrics_tpu/native/)
