@@ -65,6 +65,7 @@ def run_fanout(
             object.__setattr__(wrapper, ok_attr, False)
             object.__setattr__(wrapper, program_attr, None)
             return False
+    rebuilt = False
     try:
         states = [m.metric_state for m in clones]
         if getattr(wrapper, program_attr) is None or getattr(wrapper, versions_attr) != versions:
@@ -78,6 +79,7 @@ def run_fanout(
                 return False
             object.__setattr__(wrapper, program_attr, program)
             object.__setattr__(wrapper, versions_attr, versions)
+            rebuilt = True
         new_states = getattr(wrapper, program_attr)(states, *call_args, **call_kwargs)
     except Exception as exc:  # noqa: BLE001 — any trace/compile failure
         rank_zero_warn(
@@ -88,18 +90,21 @@ def run_fanout(
         object.__setattr__(wrapper, ok_attr, False)
         object.__setattr__(wrapper, program_attr, None)
         return False
-    from metrics_tpu.metric import _propagate_static_attrs
-
     for m, st in zip(clones, new_states):
         for name, value in st.items():
             object.__setattr__(m, name, value)  # state leaves: no version logic
         m._update_count += 1
         m._computed = None
-    for m in clones[1:]:
+    if rebuilt:
+        from metrics_tpu.metric import _propagate_static_attrs
+
         # update-inferred static attrs (shape-derived, so identical across
         # clones) flow from clone 0 — whose eager first-signature pass set
-        # them — to the rest, mirroring _wrap_update's template propagation
-        _propagate_static_attrs(clones[0], m)
+        # them — to the rest, mirroring _wrap_update's template propagation.
+        # They can only change at (re)trace, so steady-state steps skip the
+        # N-clone scan (~0.4 ms/step at 10 clones).
+        for m in clones[1:]:
+            _propagate_static_attrs(clones[0], m)
     return True
 
 

@@ -96,10 +96,17 @@ class BootStrapper(Metric):
     # poisson weighted-row path: certified per instance on its first fused
     # step (fused result compared against the eager chunked path once)
     _poisson_certified = False
+    # next step's poisson counts, drawn + uploaded one step AHEAD so the
+    # host->device transfer overlaps the current program's round trip
+    # (measured ~1 ms/step through a tunneled backend): (size, counts_np, dev)
+    _boot_prefetch = None
 
     def __getstate__(self) -> Dict[str, Any]:
         state = super().__getstate__()
         state.pop("_boot_program", None)  # jit closure: rebuilt lazily
+        pf = state.pop("_boot_prefetch", None)
+        if pf is not None:
+            state["_boot_prefetch"] = (pf[0], pf[1], None)  # device leaf re-uploads lazily
         return state
 
     def update(self, *args: Any, **kwargs: Any) -> None:
@@ -138,6 +145,12 @@ class BootStrapper(Metric):
             handled, predrawn = self._try_fused_poisson(size, args, kwargs)
         if handled:
             return
+        if predrawn is None and self._boot_prefetch is not None and self._boot_prefetch[0] == size:
+            # a prefetched poisson draw exists (fused path ran earlier, then
+            # fell back or was gated off): consume it so the already-drawn
+            # stream position is used, not skipped
+            predrawn = [np.repeat(np.arange(size), c) for c in self._boot_prefetch[1]]
+            object.__setattr__(self, "_boot_prefetch", None)
         for idx in range(self.num_bootstraps):
             # a failed fused attempt already consumed this step's draws: reuse
             # them so the seeded RNG stream stays identical to a never-fused run
@@ -232,8 +245,18 @@ class BootStrapper(Metric):
             self._record_boot_signature_after = signature
             return False, None
         # draw BEFORE the fallible block, in the same per-clone order as the
-        # eager path, so the stream is consumed exactly once per step
-        counts = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
+        # eager path, so the stream is consumed exactly once per step. A
+        # prefetched draw (uploaded during the PREVIOUS step's program) is
+        # used when its batch size still matches; otherwise draw fresh here.
+        pf = self._boot_prefetch
+        if pf is not None and pf[0] == size:
+            object.__setattr__(self, "_boot_prefetch", None)
+            counts = pf[1]
+            counts_dev = pf[2] if pf[2] is not None else jnp.asarray(counts)
+        else:
+            object.__setattr__(self, "_boot_prefetch", None)  # stale size: drop
+            counts = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
+            counts_dev = jnp.asarray(counts)
         certify = not self._poisson_certified
         oracle = deepcopy(self.metrics) if certify else None
         clone0 = self.metrics[0]
@@ -253,7 +276,7 @@ class BootStrapper(Metric):
             self,
             self.metrics,
             build,
-            (jnp.asarray(counts),) + args,
+            (counts_dev,) + args,
             kwargs,
             label="BootStrapper",
             program_attr="_boot_program",
@@ -262,6 +285,10 @@ class BootStrapper(Metric):
         )
         if not ok:
             return False, [np.repeat(np.arange(size), counts[c]) for c in range(self.num_bootstraps)]
+        # prefetch NEXT step's draw: the upload submits now and completes
+        # while this step's (already dispatched) program is in flight
+        nxt = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
+        object.__setattr__(self, "_boot_prefetch", (size, nxt, jnp.asarray(nxt)))
         if certify:
             for om, c in zip(oracle, counts):
                 self._eager_resampled_update(om, np.repeat(np.arange(size), c), args, kwargs)
