@@ -98,7 +98,8 @@ class BootStrapper(Metric):
     _poisson_certified = False
     # next step's poisson counts, drawn + uploaded one step AHEAD so the
     # host->device transfer overlaps the current program's round trip
-    # (measured ~1 ms/step through a tunneled backend): (size, counts_np, dev)
+    # (measured ~1 ms/step through a tunneled backend):
+    # (size, counts_np, dev, rng_state_before_draw)
     _boot_prefetch = None
 
     def __getstate__(self) -> Dict[str, Any]:
@@ -106,8 +107,31 @@ class BootStrapper(Metric):
         state.pop("_boot_program", None)  # jit closure: rebuilt lazily
         pf = state.pop("_boot_prefetch", None)
         if pf is not None:
-            state["_boot_prefetch"] = (pf[0], pf[1], None)  # device leaf re-uploads lazily
+            state["_boot_prefetch"] = (pf[0], pf[1], None, pf[3])  # device leaf re-uploads lazily
         return state
+
+    def _take_prefetch(self, size: int):
+        """Consume the pending lookahead draw, or None.
+
+        A size-mismatched prefetch REWINDS the RNG to its pre-draw state
+        (numpy ``set_state``) before being dropped, so the seeded stream is
+        exactly what a never-prefetching run would have produced — the
+        lookahead is unobservable except as overlap. Single owner of the
+        drop/keep policy for both the fused and eager consume sites.
+        """
+        pf = self._boot_prefetch
+        if pf is None:
+            return None
+        object.__setattr__(self, "_boot_prefetch", None)
+        if pf[0] != size:
+            self._rng.set_state(pf[3])  # un-consume: stream parity preserved
+            return None
+        return pf
+
+    def _counts_to_indices(self, counts: np.ndarray) -> list:
+        """Per-clone resample indices realizing a poisson count matrix."""
+        size = counts.shape[1]
+        return [np.repeat(np.arange(size), c) for c in counts]
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each.
@@ -145,12 +169,13 @@ class BootStrapper(Metric):
             handled, predrawn = self._try_fused_poisson(size, args, kwargs)
         if handled:
             return
-        if predrawn is None and self._boot_prefetch is not None and self._boot_prefetch[0] == size:
+        if predrawn is None and self._boot_prefetch is not None:
             # a prefetched poisson draw exists (fused path ran earlier, then
             # fell back or was gated off): consume it so the already-drawn
-            # stream position is used, not skipped
-            predrawn = [np.repeat(np.arange(size), c) for c in self._boot_prefetch[1]]
-            object.__setattr__(self, "_boot_prefetch", None)
+            # stream position is used, not skipped (mismatch rewinds the RNG)
+            pf = self._take_prefetch(size)
+            if pf is not None:
+                predrawn = self._counts_to_indices(pf[1])
         for idx in range(self.num_bootstraps):
             # a failed fused attempt already consumed this step's draws: reuse
             # them so the seeded RNG stream stays identical to a never-fused run
@@ -247,14 +272,13 @@ class BootStrapper(Metric):
         # draw BEFORE the fallible block, in the same per-clone order as the
         # eager path, so the stream is consumed exactly once per step. A
         # prefetched draw (uploaded during the PREVIOUS step's program) is
-        # used when its batch size still matches; otherwise draw fresh here.
-        pf = self._boot_prefetch
-        if pf is not None and pf[0] == size:
-            object.__setattr__(self, "_boot_prefetch", None)
+        # used when its batch size still matches; a mismatch rewinds the RNG
+        # and draws fresh — stream position identical to a never-fused run.
+        pf = self._take_prefetch(size)
+        if pf is not None:
             counts = pf[1]
             counts_dev = pf[2] if pf[2] is not None else jnp.asarray(counts)
         else:
-            object.__setattr__(self, "_boot_prefetch", None)  # stale size: drop
             counts = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
             counts_dev = jnp.asarray(counts)
         certify = not self._poisson_certified
@@ -284,14 +308,16 @@ class BootStrapper(Metric):
             ok_attr="_boot_ok",
         )
         if not ok:
-            return False, [np.repeat(np.arange(size), counts[c]) for c in range(self.num_bootstraps)]
+            return False, self._counts_to_indices(counts)
         # prefetch NEXT step's draw: the upload submits now and completes
-        # while this step's (already dispatched) program is in flight
+        # while this step's (already dispatched) program is in flight. The
+        # pre-draw RNG snapshot lets _take_prefetch rewind on a size change.
+        rng_state = self._rng.get_state()
         nxt = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
-        object.__setattr__(self, "_boot_prefetch", (size, nxt, jnp.asarray(nxt)))
+        object.__setattr__(self, "_boot_prefetch", (size, nxt, jnp.asarray(nxt), rng_state))
         if certify:
-            for om, c in zip(oracle, counts):
-                self._eager_resampled_update(om, np.repeat(np.arange(size), c), args, kwargs)
+            for om, idx in zip(oracle, self._counts_to_indices(counts)):
+                self._eager_resampled_update(om, idx, args, kwargs)
             if states_allclose(
                 [m.metric_state for m in self.metrics], [m.metric_state for m in oracle]
             ):

@@ -89,6 +89,33 @@ class TestPoissonBootstrap:
             float(fused.compute()["mean"]), float(eager.compute()["mean"]), rtol=1e-4
         )
 
+    def test_shape_churn_keeps_seeded_stream_parity(self):
+        """The lookahead prefetch must be RNG-unobservable: on a batch-size
+        change the pending draw rewinds the stream (pre-draw snapshot), so a
+        fused run's states equal a force-eager run's on the same seed even
+        with varying shapes."""
+        rng = np.random.RandomState(0)
+        sizes = [32, 32, 48, 48, 32, 48, 32]
+        batches = [
+            (jnp.asarray(rng.rand(s).astype(np.float32)), jnp.asarray(rng.rand(s).astype(np.float32)))
+            for s in sizes
+        ]
+        fused, eager = _pair(
+            lambda: mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4, sampling_strategy="poisson"),
+            "_boot_ok",
+        )
+        fused._rng = np.random.RandomState(9)
+        eager._rng = np.random.RandomState(9)
+        for b in batches:
+            fused.update(*b)
+            eager.update(*b)
+        assert fused._boot_program is not None
+        for mf, me in zip(fused.metrics, eager.metrics):
+            for name in mf._defaults:
+                np.testing.assert_allclose(
+                    np.asarray(getattr(mf, name)), np.asarray(getattr(me, name)), rtol=1e-4
+                )
+
     def test_non_sum_linear_base_stays_eager(self):
         # MaxMetric's state reduces by "max": weights cannot express resampling
         rng = np.random.RandomState(2)
