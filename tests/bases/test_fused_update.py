@@ -161,3 +161,24 @@ def test_weighted_kwargs_fuse():
         m.update(jnp.asarray([float(v)]), weight=jnp.asarray([2.0]))
     assert m._fused_update_program is not None
     assert float(m.compute()) == 1.5
+
+
+def test_post_probe_runtime_failure_warns_and_falls_back():
+    """The eval_shape probe only vets TRACEABILITY; a program that passes it
+    but fails at execution (compile/runtime) must still warn once and fall
+    back permanently — the warning contract for genuine anomalies."""
+    m = mt.Accuracy()
+    p, t = BATCHES[0]
+    m.update(p, t)
+    m.update(p, t)  # licensed + probed + run: program exists
+    assert m._fused_update_program is not None
+
+    def boom(state, *a, **k):
+        raise RuntimeError("simulated post-probe failure")
+
+    object.__setattr__(m, "_fused_update_program", boom)
+    with pytest.warns(UserWarning, match="Fused update for `Accuracy`"):
+        m.update(p, t)
+    assert m._fused_update_ok is False
+    m.update(p, t)  # eager path keeps accumulating
+    assert m._update_count == 4

@@ -73,9 +73,11 @@ class TestBatchedFallbackWarning:
         assert stoi._update_count > 0
         assert jnp.isfinite(stoi.compute())
 
-    def test_stoi_fused_update_warns_and_falls_back(self):
-        """The fused bare-update path hits the same host-DSP trace wall: it
-        must warn once and permanently drop to the eager per-op update."""
+    def test_stoi_fused_update_declines_silently(self):
+        """The fused bare-update path hits the host-DSP trace wall: since
+        round 5 the eval_shape probe declines fusion with NO warning (an
+        untraceable update is a supported configuration) and the eager path
+        keeps accumulating permanently."""
         from metrics_tpu.utils import checks
 
         fs = 10000
@@ -87,8 +89,9 @@ class TestBatchedFallbackWarning:
         checks.set_validation_mode("first")
         try:
             stoi.update(preds, target)  # first signature call: eager
-            with _catch("Fused update for `ShortTimeObjectiveIntelligibility`"):
-                stoi.update(preds, target)  # fusion attempt -> fallback
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")  # a fused-fallback warning fails here
+                stoi.update(preds, target)  # probe declines quietly
         finally:
             checks.set_validation_mode(prev_mode)
         assert stoi._fused_update_ok is False
