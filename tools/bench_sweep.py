@@ -276,7 +276,7 @@ OUTLIER_NOTES = {
     "RetrievalRecallAtFixedPrecision": "append-only update both sides; ours buffers RAW rows (zero-dispatch list append, deferred canonicalization — docs/performance.md); residual ratio is python bookkeeping vs torch's in-process append",
     "MinMaxMetric(Accuracy)": "wrapper state lives in the child metric; the child update runs as the fused single-program update (and forward as the fused minmax program, round 5 — docs/performance.md), so the row sits at the tunnel's per-program floor — below torch-CPU's in-process step, see the row's own floor_bound_factor",
     "ClasswiseWrapper(Accuracy)": "the wrapper's own as_functions composes the child kernels (labeling happens at compute), so the update is the child's fused jit program; the reference fans out eagerly",
-    "BootStrapper(MeanSquaredError)": "poisson bootstrap runs as ONE weighted-row program per step since round 5 (counts as row weights over vmapped per-row state deltas, certified vs the eager path — wrappers/bootstrapping.py); a remaining gap vs torch-CPU is the tunnel's per-program cost, see the row's floor_bound_factor",
+    "BootStrapper(MeanSquaredError)": "poisson bootstrap runs as ONE weighted-row program per step since round 5 (counts as row weights over vmapped per-row state deltas, certified vs the eager path; the next draw's upload overlaps the in-flight program — wrappers/bootstrapping.py). The row sits a few x above the minimal chained-program floor: the per-row delta program is substantially larger than the probe's add-one, and the host poisson draw rides along each step — all of which is tunnel-transport cost that vanishes on a locally attached chip (torch-CPU pays zero dispatch)",
     "BootStrapper(MeanSquaredError,multinomial)": "all clones run as ONE vmapped program per update (wrappers/_fanout.py fused fan-out); residual gap vs torch-CPU is the tunnel's per-program cost, see the row's floor_bound_factor",
     "MultioutputWrapper(MeanSquaredError)": "remove_nans=True zero-weights NaN rows INSIDE the one-program column fan-out since round 5 (no host mask read — wrappers/multioutput.py); residual gap vs torch-CPU is the tunnel's per-program cost, see the row's floor_bound_factor",
     "MultioutputWrapper(MeanSquaredError,no_nan_filter)": "remove_nans=False has static shapes: all column clones run as ONE vmapped program per update (wrappers/multioutput.py fused fan-out)",
