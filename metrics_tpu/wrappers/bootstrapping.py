@@ -133,6 +133,23 @@ class BootStrapper(Metric):
         size = counts.shape[1]
         return [np.repeat(np.arange(size), c) for c in counts]
 
+    def _consume_or_draw(self, size: int, draw_matrix):
+        """This step's draw matrix and its device copy: the pending prefetch
+        when its size matches, else a fresh draw via ``draw_matrix()``."""
+        pf = self._take_prefetch(size)
+        if pf is not None:
+            return pf[1], (pf[2] if pf[2] is not None else jnp.asarray(pf[1]))
+        mat = draw_matrix()
+        return mat, jnp.asarray(mat)
+
+    def _store_prefetch(self, size: int, draw_matrix) -> None:
+        """Draw + upload the NEXT step's matrix so the transfer overlaps the
+        current (already dispatched) program; snapshot the RNG first so a
+        size change can rewind the stream (see _take_prefetch)."""
+        rng_state = self._rng.get_state()
+        nxt = draw_matrix()
+        object.__setattr__(self, "_boot_prefetch", (size, nxt, jnp.asarray(nxt), rng_state))
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each.
 
@@ -170,12 +187,17 @@ class BootStrapper(Metric):
         if handled:
             return
         if predrawn is None and self._boot_prefetch is not None:
-            # a prefetched poisson draw exists (fused path ran earlier, then
-            # fell back or was gated off): consume it so the already-drawn
-            # stream position is used, not skipped (mismatch rewinds the RNG)
+            # a prefetched draw exists (fused path ran earlier, then fell
+            # back or was gated off): consume it so the already-drawn stream
+            # position is used, not skipped (mismatch rewinds the RNG). The
+            # matrix holds poisson COUNTS or multinomial INDICES by strategy.
             pf = self._take_prefetch(size)
             if pf is not None:
-                predrawn = self._counts_to_indices(pf[1])
+                predrawn = (
+                    self._counts_to_indices(pf[1])
+                    if self.sampling_strategy == "poisson"
+                    else list(pf[1])
+                )
         for idx in range(self.num_bootstraps):
             # a failed fused attempt already consumed this step's draws: reuse
             # them so the seeded RNG stream stays identical to a never-fused run
@@ -274,13 +296,10 @@ class BootStrapper(Metric):
         # prefetched draw (uploaded during the PREVIOUS step's program) is
         # used when its batch size still matches; a mismatch rewinds the RNG
         # and draws fresh — stream position identical to a never-fused run.
-        pf = self._take_prefetch(size)
-        if pf is not None:
-            counts = pf[1]
-            counts_dev = pf[2] if pf[2] is not None else jnp.asarray(counts)
-        else:
-            counts = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
-            counts_dev = jnp.asarray(counts)
+        draw_counts = lambda: np.stack(  # noqa: E731
+            [self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)]
+        )
+        counts, counts_dev = self._consume_or_draw(size, draw_counts)
         certify = not self._poisson_certified
         oracle = deepcopy(self.metrics) if certify else None
         clone0 = self.metrics[0]
@@ -309,12 +328,7 @@ class BootStrapper(Metric):
         )
         if not ok:
             return False, self._counts_to_indices(counts)
-        # prefetch NEXT step's draw: the upload submits now and completes
-        # while this step's (already dispatched) program is in flight. The
-        # pre-draw RNG snapshot lets _take_prefetch rewind on a size change.
-        rng_state = self._rng.get_state()
-        nxt = np.stack([self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)])
-        object.__setattr__(self, "_boot_prefetch", (size, nxt, jnp.asarray(nxt), rng_state))
+        self._store_prefetch(size, draw_counts)
         if certify:
             for om, idx in zip(oracle, self._counts_to_indices(counts)):
                 self._eager_resampled_update(om, idx, args, kwargs)
@@ -363,10 +377,13 @@ class BootStrapper(Metric):
             self._record_boot_signature_after = signature
             return False, None
         # draw BEFORE the fallible block: on failure the eager fallback
-        # reuses these, so the stream is consumed exactly once per step
-        draws = np.stack(
+        # reuses these, so the stream is consumed exactly once per step. A
+        # prefetched draw (uploaded during the previous step's program) is
+        # used when its batch size still matches (mismatch rewinds the RNG).
+        draw_indices = lambda: np.stack(  # noqa: E731
             [_bootstrap_sampler(size, "multinomial", self._rng) for _ in range(self.num_bootstraps)]
         )
+        draws, draws_dev = self._consume_or_draw(size, draw_indices)
 
         def build(upd):
             def program(states, idx, *a, **k):
@@ -385,14 +402,17 @@ class BootStrapper(Metric):
             self,
             self.metrics,
             build,
-            (jnp.asarray(draws),) + args,
+            (draws_dev,) + args,
             kwargs,
             label="BootStrapper",
             program_attr="_boot_program",
             versions_attr="_boot_versions",
             ok_attr="_boot_ok",
         )
-        return ok, (None if ok else draws)
+        if not ok:
+            return False, draws
+        self._store_prefetch(size, draw_indices)
+        return True, None
 
     def compute(self) -> Dict[str, jax.Array]:
         """mean/std/quantile/raw over the bootstrap distribution."""

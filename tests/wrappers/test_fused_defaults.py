@@ -89,11 +89,13 @@ class TestPoissonBootstrap:
             float(fused.compute()["mean"]), float(eager.compute()["mean"]), rtol=1e-4
         )
 
-    def test_shape_churn_keeps_seeded_stream_parity(self):
+    @pytest.mark.parametrize("strategy", ["poisson", "multinomial"])
+    def test_shape_churn_keeps_seeded_stream_parity(self, strategy):
         """The lookahead prefetch must be RNG-unobservable: on a batch-size
         change the pending draw rewinds the stream (pre-draw snapshot), so a
         fused run's states equal a force-eager run's on the same seed even
-        with varying shapes."""
+        with varying shapes — for both sampling strategies (both prefetch
+        their next draw matrix)."""
         rng = np.random.RandomState(0)
         sizes = [32, 32, 48, 48, 32, 48, 32]
         batches = [
@@ -101,7 +103,7 @@ class TestPoissonBootstrap:
             for s in sizes
         ]
         fused, eager = _pair(
-            lambda: mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4, sampling_strategy="poisson"),
+            lambda: mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4, sampling_strategy=strategy),
             "_boot_ok",
         )
         fused._rng = np.random.RandomState(9)
