@@ -388,9 +388,10 @@ def bench_dispatch_floor() -> dict:
 
     # SHAPE-MATCHED floor: a chained program with EXACTLY the benched
     # `eager_per_step` metric's buffer profile — its state pytree plus the
-    # (BATCH,) input and scalar batch value. Each extra output buffer adds
-    # tunnel traffic, so this (not the scalar add-one) is the honest
-    # comparator for the fused forward step.
+    # (BATCH,) input and scalar batch value, compiled with the SAME
+    # donated-state aliasing the dispatch-engine forward uses. Each extra
+    # output buffer adds tunnel traffic, so this (not the scalar add-one) is
+    # the honest comparator for the fused forward step.
     from metrics_tpu import Accuracy
     from metrics_tpu.utils.checks import set_validation_mode
 
@@ -399,9 +400,11 @@ def bench_dispatch_floor() -> dict:
     rng = np.random.RandomState(0)
     v = jnp.asarray(rng.rand(BATCH).astype(np.float32))
     m(v, jnp.asarray(rng.randint(0, 2, BATCH)))
-    state0 = dict(m.metric_state)
+    state0 = {k: jnp.copy(a) for k, a in m.metric_state.items()}  # donation-safe copies
 
-    g = jax.jit(lambda st, x: ({k: a + 1 for k, a in st.items()}, x.mean()))
+    g = jax.jit(
+        lambda st, x: ({k: a + 1 for k, a in st.items()}, x.mean()), donate_argnums=(0,)
+    )
     sbox = {"st": state0}
 
     def _shaped_step():
@@ -415,6 +418,117 @@ def bench_dispatch_floor() -> dict:
         "sync_roundtrip_ms": sync_ms,
         "program_roundtrip_ms": program_ms,
         "shaped_program_roundtrip_ms": shaped_ms,
+    }
+
+
+def bench_bootstrap_shaped_floor() -> dict:
+    """Genuinely-shaped floor probes for the BootStrapper one-program paths
+    (VERDICT round-5 Next #1: the old add-one probe was "substantially
+    smaller" than the real program, so its floor_bound_factor compared
+    apples to oranges).
+
+    Both probes carry the REAL programs' full buffer profile — the stacked
+    per-clone state leaves, the (num_bootstraps, BATCH) draw matrix, the
+    (BATCH,) data operands, and (poisson) the per-row delta intermediates of
+    the vmapped-update + weight-contraction pipeline — with a trivial
+    one-op update in place of the metric kernel, donated state, chained
+    steps, final sync amortized: the honest lower bound on what ANY
+    weighted-row/vmapped-clone program costs per step on this backend.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.wrappers._fanout import weighted_state_apply
+
+    num_bootstraps = 10  # the reference default the sweep's slow row uses
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(BATCH).astype(np.float32))
+    t = jnp.asarray(rng.randn(BATCH).astype(np.float32))
+    # MeanSquaredError's state profile: one float sum + one int64/32 count.
+    # Fresh buffers per clone per probe: the chained programs donate their
+    # state, so no buffer may appear twice (or be reused across probes).
+    def fresh_states():
+        return [
+            {
+                "sum_squared_error": jnp.zeros((), jnp.float32),
+                "total": jnp.zeros((), jnp.int32),
+            }
+            for _ in range(num_bootstraps)
+        ]
+
+    def _min_ms(step, n=200):
+        best = float("inf")
+        for _ in range(TRIALS):
+            start = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = step()
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - start) / n * 1000.0)
+        return best
+
+    # ---- poisson weighted-row shape: per-row deltas + count contraction
+    def upd_like(state, pr, tr):
+        bump = (pr - tr).sum()
+        return {
+            "sum_squared_error": state["sum_squared_error"] + bump,
+            "total": state["total"] + jnp.asarray(pr.shape[0], jnp.int32),
+        }
+
+    def poisson_program(states, w, pr, tr):
+        def one_row(row):
+            ra = jax.tree.map(lambda x: x[None], row)
+            new = upd_like({k: jnp.zeros_like(v) for k, v in states[0].items()}, *ra)
+            return new
+
+        deltas = jax.vmap(one_row)((pr, tr))
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        new = weighted_state_apply(stacked, deltas, w)
+        return [jax.tree.map(lambda x: x[i], new) for i in range(len(states))]
+
+    poisson = jax.jit(poisson_program, donate_argnums=(0,))
+    counts = jnp.asarray(rng.poisson(1, size=(num_bootstraps, BATCH)).astype(np.int32))
+    pbox = {"st": fresh_states()}
+
+    def _poisson_step():
+        pbox["st"] = poisson(pbox["st"], counts, p, t)
+        return pbox["st"]
+
+    _poisson_step()
+    poisson_ms = _min_ms(_poisson_step)
+
+    # ---- multinomial shape: vmapped per-clone take + trivial update
+    def multinomial_program(states, idx, pr, tr):
+        def one(state, rows):
+            ra = jnp.take(pr, rows, axis=0)
+            rb = jnp.take(tr, rows, axis=0)
+            return upd_like(state, ra, rb)
+
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        out = jax.vmap(one)(stacked, idx)
+        return [jax.tree.map(lambda x: x[i], out) for i in range(len(states))]
+
+    multinomial = jax.jit(multinomial_program, donate_argnums=(0,))
+    draws = jnp.asarray(rng.randint(0, BATCH, size=(num_bootstraps, BATCH)))
+    mbox = {"st": fresh_states()}
+
+    def _multinomial_step():
+        mbox["st"] = multinomial(mbox["st"], draws, p, t)
+        return mbox["st"]
+
+    _multinomial_step()
+    multinomial_ms = _min_ms(_multinomial_step)
+    return {
+        "poisson_weighted_row_floor_ms": poisson_ms,
+        "multinomial_vmap_floor_ms": multinomial_ms,
+        "num_bootstraps": num_bootstraps,
+        "note": (
+            "chained donated-state programs with the real one-program "
+            "bootstrap paths' exact buffer profile (stacked clone states, "
+            "draw matrix, per-row delta intermediates) and a one-op update "
+            "kernel — the apples-to-apples comparator for the sweep's "
+            "BootStrapper rows' floor_bound_factor"
+        ),
     }
 
 
@@ -495,8 +609,13 @@ def main() -> None:
     # dispatch latency measurably grows afterwards), which would deflate the
     # per-step rows with state that has nothing to do with per-step cost
     ours_overhead = bench_overhead_ours()
-    ours_overhead_batched = bench_overhead_batched_ours()
+    # the floor probe runs IMMEDIATELY after the row it bounds — same
+    # backend regime, same per-trial call count — so the committed artifact
+    # stands behind its own floor_bound_factor with no out-of-band
+    # correction (VERDICT round-5 Next #3)
     floor = bench_dispatch_floor()
+    boot_floor = bench_bootstrap_shaped_floor()
+    ours_overhead_batched = bench_overhead_batched_ours()
     ref_overhead = _safe(bench_overhead_reference)
 
     real, fake = _fid_data()
@@ -540,6 +659,15 @@ def main() -> None:
             "baseline": round(ref_map, 3),
             "baseline_hardware": "torch-cpu",
             "vs_baseline": ratio(ours_map, ref_map, lower_is_better=True),
+        },
+        "bootstrap_shaped_floor": {
+            # genuinely-shaped comparators for the sweep's BootStrapper rows
+            # (VERDICT r5 Next #1); ms per chained donated-state program
+            "poisson_weighted_row_floor_ms": round(boot_floor["poisson_weighted_row_floor_ms"], 3),
+            "multinomial_vmap_floor_ms": round(boot_floor["multinomial_vmap_floor_ms"], 3),
+            "num_bootstraps": boot_floor["num_bootstraps"],
+            "unit": "ms/program (chained, donated state, trailing sync amortized)",
+            "note": boot_floor["note"],
         },
         "per_step_overhead": {
             "value": round(ours_overhead_batched, 1),

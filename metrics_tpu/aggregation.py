@@ -244,6 +244,38 @@ class CatMetric(BaseAggregator):
         if value.size:
             self.value.append(value)
 
+    def _build_update_lane(self, args, kwargs):
+        """Dispatch-engine host fast lane: the nan_strategy gate and
+        signature check resolve to this bound closure at the first validated
+        update per signature, leaving a steady-state append as one branch +
+        ``list.append`` (the "first"-mode value check for this signature
+        already ran on the eager pass; deferred compute-time NaN removal
+        keeps "ignore"/"warn" values reference-exact either way)."""
+        if kwargs or len(args) != 1 or not isinstance(self.nan_strategy, str):
+            return None  # float imputation rewrites values per call
+        v0 = args[0]
+        if isinstance(v0, jax.core.Tracer) or not isinstance(v0, (jax.Array, np.ndarray)):
+            return None
+        cls0, shp0, dt0 = type(v0), v0.shape, v0.dtype
+        if v0.size == 0:
+            return None  # empty rows skip the append; keep the full path
+        guard = self._lane_guard()
+
+        def lane(largs, lkwargs):
+            if lkwargs or len(largs) != 1:
+                return False
+            v = largs[0]
+            if type(v) is not cls0 or v.shape != shp0 or v.dtype != dt0:
+                return False
+            if not guard():
+                return False
+            self._update_count += 1
+            self._computed = None
+            self.value.append(v)
+            return True
+
+        return lane
+
     def _canonicalize_list_states(self) -> None:
         if not isinstance(self.value, list):
             return  # post-sync "cat" reduction left one bare canonical array

@@ -22,9 +22,10 @@ canonicalization first.
 """
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
+import numpy as np
 
 from metrics_tpu.utils.data import dim_zero_cat
 
@@ -38,6 +39,45 @@ class _RawPairStateMixin:
 
     def _format_row(self, preds, target) -> Tuple[jax.Array, jax.Array]:
         raise NotImplementedError
+
+    def _build_update_lane(self, args: tuple, kwargs: dict) -> Optional[callable]:
+        """Dispatch-engine host fast lane: after one eager-validated update
+        per signature, a same-signature update is two raw list appends plus
+        one guard branch — the mode/shape validation is a pure function of
+        the signature, already licensed by the eager pass, and inferred
+        attrs (``mode``/``num_classes``/``pos_label``) were set by it."""
+        if kwargs or len(args) != 2:
+            return None
+        specs = []
+        for v in args:
+            if isinstance(v, jax.core.Tracer) or not isinstance(v, (jax.Array, np.ndarray)):
+                return None
+            specs.append((type(v), v.shape, v.dtype))
+        (cp, sp, dp), (ct, st, dt) = specs
+        guard = self._lane_guard()
+
+        def lane(largs: tuple, lkwargs: dict) -> bool:
+            if lkwargs or len(largs) != 2:
+                return False
+            p, t = largs
+            if (
+                type(p) is not cp
+                or p.shape != sp
+                or p.dtype != dp
+                or type(t) is not ct
+                or t.shape != st
+                or t.dtype != dt
+            ):
+                return False
+            if not guard():
+                return False
+            self._update_count += 1
+            self._computed = None
+            self.preds.append(p)
+            self.target.append(t)
+            return True
+
+        return lane
 
     def _canonicalize_list_states(self) -> None:
         if not isinstance(self.preds, list):

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric, _propagate_static_attrs
+from metrics_tpu.ops import engine as _engine
 from metrics_tpu.utils.data import _flatten_dict, allclose
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -131,28 +132,60 @@ class MetricCollection:
             while len(self._fused_seen) > Metric._FUSED_SIG_CAP:
                 self._fused_seen.pop(next(iter(self._fused_seen)))
             return None
+        states = None
         try:
             if self._fused_program is None:
-                steps = {}
-                templates = {}
-                for name, m in members:
-                    templates[name], steps[name] = m._build_fused_step()
-                member_filters = {name: m._filter_kwargs for name, m in members}
 
-                def program(states: Dict[str, Any], update_count, *a: Any, **k: Any):
-                    out_states, values = {}, {}
-                    for name, step in steps.items():
-                        filtered = member_filters[name](**k)
-                        out_states[name], values[name] = step(states[name], update_count, *a, **filtered)
-                    return out_states, values
+                def build():
+                    steps = {}
+                    templates = {}
+                    for name, m in members:
+                        templates[name], steps[name] = m._build_fused_step()
+                    # kwargs filters rebound from the TEMPLATES (class-derived
+                    # update signatures), so the cached program carries no
+                    # reference to this particular collection's instances
+                    member_filters = {name: templates[name]._filter_kwargs for name in templates}
 
-                self._fused_program = jax.jit(program)
-                self._fused_templates = templates
+                    def program(states: Dict[str, Any], update_count, *a: Any, **k: Any):
+                        out_states, values = {}, {}
+                        for name, step in steps.items():
+                            filtered = member_filters[name](**k)
+                            out_states[name], values[name] = step(states[name], update_count, *a, **filtered)
+                        return out_states, values
+
+                    return program, templates, {}
+
+                # engine-cached across collections: two suites with the same
+                # member classes+configs share ONE whole-suite program
+                self._fused_program = _engine.acquire_keyed(
+                    ("collection-forward",)
+                    + tuple((name, _engine.config_fingerprint(m)) for name, m in members),
+                    build,
+                )
+                self._fused_templates = self._fused_program.template
                 self._fused_versions = {name: m._fused_version for name, m in members}
             states = {name: {s: getattr(m, s) for s in m._defaults} for name, m in members}
             count = members[0][1]._update_count + 1
-            merged, values = self._fused_program(states, count, *args, **consumed)
+            runner = getattr(self._fused_program, "run", None)
+            if runner is not None:
+                # donate the member states (in-place suite step); members
+                # sharing compute-group buffers fail the duplicate check
+                # inside run() and take the plain twin automatically
+                merged, values = runner(
+                    states,
+                    (count,) + args,
+                    consumed,
+                    avoid_ids=frozenset().union(*(m._default_leaf_ids() for _, m in members)),
+                )
+            else:
+                merged, values = self._fused_program(states, count, *args, **consumed)
         except Exception as exc:
+            if states is not None and not _engine.state_intact(states):
+                raise RuntimeError(
+                    f"Whole-suite fused forward failed after donating member state "
+                    f"buffers ({type(exc).__name__}: {exc}); the accumulated states are "
+                    "unrecoverable — construct a fresh collection."
+                ) from exc
             # member-wise fallback (full member-level semantics, incl. their
             # own fused paths); if that succeeds, this collection's combined
             # program is genuinely untraceable — stop re-trying every step.
@@ -245,6 +278,7 @@ class MetricCollection:
             while len(self._fused_seen) > Metric._FUSED_SIG_CAP:
                 self._fused_seen.pop(next(iter(self._fused_seen)))
             return result
+        states = None
         try:
             python_leaves, treedef, scanned_idx, aconst_idx, scanned, array_consts = (
                 Metric._split_many_leaves(args, consumed)
@@ -255,41 +289,65 @@ class MetricCollection:
             if with_values in self._many_programs and self._many_layouts.get(with_values) != layout:
                 del self._many_programs[with_values]
             if with_values not in self._many_programs:
-                steps, templates = {}, {}
-                for name, m in members:
-                    templates[name], steps[name] = m._build_fused_step()
-                member_filters = {name: m._filter_kwargs for name, m in members}
 
-                def program(states, update_count, xs, const_vals):
-                    def body(carry, xs_leaves):
-                        st, cnt = carry
-                        cnt = cnt + 1
-                        step_leaves = list(python_leaves)
-                        for i, leaf in zip(scanned_idx, xs_leaves):
-                            step_leaves[i] = leaf
-                        for i, leaf in zip(aconst_idx, const_vals):
-                            step_leaves[i] = leaf
-                        a, k = jax.tree.unflatten(treedef, step_leaves)
-                        new_states, vals = {}, {}
-                        for name, step in steps.items():
-                            filtered = member_filters[name](**k)
-                            new_states[name], vals[name] = step(st[name], cnt, *a, **filtered)
-                        return (new_states, cnt), (vals if with_values else 0)
+                def build():
+                    steps, templates = {}, {}
+                    for name, m in members:
+                        templates[name], steps[name] = m._build_fused_step()
+                    member_filters = {name: templates[name]._filter_kwargs for name in templates}
 
-                    (final, _), vals = jax.lax.scan(
-                        body, (states, jnp.asarray(update_count, jnp.int32)), xs
-                    )
-                    return final, vals
+                    def program(states, update_count, xs, const_vals):
+                        def body(carry, xs_leaves):
+                            st, cnt = carry
+                            cnt = cnt + 1
+                            step_leaves = list(python_leaves)
+                            for i, leaf in zip(scanned_idx, xs_leaves):
+                                step_leaves[i] = leaf
+                            for i, leaf in zip(aconst_idx, const_vals):
+                                step_leaves[i] = leaf
+                            a, k = jax.tree.unflatten(treedef, step_leaves)
+                            new_states, vals = {}, {}
+                            for name, step in steps.items():
+                                filtered = member_filters[name](**k)
+                                new_states[name], vals[name] = step(st[name], cnt, *a, **filtered)
+                            return (new_states, cnt), (vals if with_values else 0)
 
-                self._many_programs[with_values] = jax.jit(program)
-                self._many_templates[with_values] = templates
+                        (final, _), vals = jax.lax.scan(
+                            body, (states, jnp.asarray(update_count, jnp.int32)), xs
+                        )
+                        return final, vals
+
+                    return program, templates, {}
+
+                exe = _engine.acquire_keyed(
+                    ("collection-many", with_values, layout)
+                    + tuple((name, _engine.config_fingerprint(m)) for name, m in members),
+                    build,
+                )
+                self._many_programs[with_values] = exe
+                self._many_templates[with_values] = exe.template
                 self._many_layouts[with_values] = layout
                 self._many_versions = {name: m._fused_version for name, m in members}
             states = {name: {s: getattr(m, s) for s in m._defaults} for name, m in members}
             n_steps = int(scanned[0].shape[0])
             count = members[0][1]._update_count
-            merged, values = self._many_programs[with_values](states, count, scanned, array_consts)
+            program = self._many_programs[with_values]
+            runner = getattr(program, "run", None)
+            if runner is not None:
+                merged, values = runner(
+                    states,
+                    (count, scanned, array_consts),
+                    avoid_ids=frozenset().union(*(m._default_leaf_ids() for _, m in members)),
+                )
+            else:
+                merged, values = program(states, count, scanned, array_consts)
         except Exception as exc:
+            if states is not None and not _engine.state_intact(states):
+                raise RuntimeError(
+                    f"Batched-step suite program failed after donating member state "
+                    f"buffers ({type(exc).__name__}: {exc}); the accumulated states are "
+                    "unrecoverable — construct a fresh collection."
+                ) from exc
             # eager fallback; only the BATCHED suite path is disabled — the
             # single-step fused forward keeps its own _fused_disabled flag
             result = self._run_many_eager(with_values, args, kwargs)

@@ -61,7 +61,13 @@ def _f64_compute():
     small-matrix computations, so emulated f64 on TPU is an acceptable cost.
     Hot-path ``update`` stays in the input dtype.
     """
-    return jax.enable_x64(True)
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(True)
+    # newer jax removed the top-level alias; the context manager lives in
+    # jax.experimental (same semantics)
+    from jax.experimental import enable_x64
+
+    return enable_x64(True)
 
 
 def _native_f64_backend() -> bool:

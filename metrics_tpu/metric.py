@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.ops import engine as _engine
 from metrics_tpu.parallel.collectives import sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
 from metrics_tpu.parallel.sync import distributed_available as _dist_available
@@ -206,6 +207,14 @@ class Metric(ABC):
         # wrap user update/compute with bookkeeping (reference `metric.py:121-122`)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute: Callable = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+        # resolved once: whether this class opted into the host fast lane
+        # (checking the override per update call would cost two attribute
+        # walks on every eager step)
+        object.__setattr__(
+            self,
+            "_has_update_lane_hook",
+            type(self)._build_update_lane is not Metric._build_update_lane,
+        )
 
         # A subclass that leaves `full_state_update` unset silently takes the
         # two-update slow path in forward AND never engages the fused
@@ -258,6 +267,7 @@ class Metric(ABC):
         self._reduction_specs[name] = spec
         self._persistent[name] = persistent
         self._fusable_cached = None  # state set changed; re-derive on next forward
+        self.__dict__.pop("_default_ids_cache", None)  # donation guard re-derives
         setattr(self, name, list(default) if is_list else default)
 
     @property
@@ -311,6 +321,18 @@ class Metric(ABC):
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped(*args: Any, **kwargs: Any) -> None:
+            # host fast lane (dispatch-engine tier for append-only metrics):
+            # a closure bound at the first eager-validated call per signature
+            # handles the steady-state update as a list append plus one cheap
+            # branch — no checks-module resolution, no fusion gating, no
+            # trace annotation. The lane returns False for anything it did
+            # not pre-resolve (new signature, mode change), falling through
+            # to the full path below. compute_on_cpu bypasses the lane at
+            # call time: its per-update host offload must keep running even
+            # if the flag was toggled after a lane was installed.
+            lane = self._update_lane
+            if lane is not None and not self.compute_on_cpu and lane(args, kwargs):
+                return
             # lazily-resolved module handle: a `from ... import` here costs
             # ~2 us of import machinery on EVERY update
             _checks = _checks_module()
@@ -337,19 +359,47 @@ class Metric(ABC):
                 run_fused = False
                 if signature in self._fused_seen_signatures:
                     state = {name: getattr(self, name) for name in self._defaults}
-                    if self._fused_update_program is None:
+                    program = self._fused_update_program
+                    if program is None:
                         program = self._build_fused_update()
                         if _probe_traceable(program, state, *args, **kwargs):
+                            self._license_fused_signature(signature)
                             object.__setattr__(self, "_fused_update_program", program)
                         else:
                             object.__setattr__(self, "_fused_update_ok", False)
                             object.__setattr__(self, "_fused_update_template", None)
                             signature = None  # probe declined: plain eager from here on
-                    run_fused = self._fused_update_program is not None
+                        run_fused = self._fused_update_program is not None
+                    elif isinstance(program, _engine.Executable):
+                        # each FIRST-SEEN signature is probed before it runs
+                        # fused: an untraceable second signature declines
+                        # silently (eager for that signature only) instead of
+                        # surfacing as a runtime-failure warning
+                        run_fused = self._signature_licensed(
+                            signature, program, state, *args, **kwargs
+                        )
+                    else:
+                        run_fused = True  # foreign program (tests): run as-is
                 if run_fused:
                     try:
-                        new_state = self._fused_update_program(state, *args, **kwargs)
+                        runner = getattr(self._fused_update_program, "run", None)
+                        if runner is not None:
+                            new_state = runner(
+                                state, args, kwargs, avoid_ids=self._default_leaf_ids()
+                            )
+                        else:
+                            new_state = self._fused_update_program(state, *args, **kwargs)
                     except Exception as exc:  # noqa: BLE001 — post-probe runtime failure
+                        if not _engine.state_intact(state):
+                            # the failing call donated the state buffers away;
+                            # an eager retry would read deleted arrays — the
+                            # instance cannot recover, surface that plainly
+                            raise RuntimeError(
+                                f"Fused update for `{type(self).__name__}` failed after "
+                                f"donating its state buffers ({type(exc).__name__}: {exc}); "
+                                "the accumulated state is unrecoverable — construct a "
+                                "fresh instance."
+                            ) from exc
                         rank_zero_warn(
                             f"Fused update for `{type(self).__name__}` raised "
                             f"{type(exc).__name__}: {exc}. Falling back to the eager "
@@ -378,23 +428,36 @@ class Metric(ABC):
                 self._record_fused_signature(signature)
             if self.compute_on_cpu:
                 self._move_list_states_to_host()
+            elif self._has_update_lane_hook and _get_validation_mode() != "full":
+                # the eager pass validated this call: let the metric bind its
+                # steady-state append closure for this signature
+                self._install_update_lane(args, kwargs)
 
         return wrapped
 
-    def _build_fused_update(self) -> Callable:
-        """One jitted program for a bare ``update`` call: restore state into a
-        template clone, run the real update, return the new state pytree."""
-        template = self._bare_clone()
-        object.__setattr__(self, "_fused_update_template", template)
+    def _build_fused_update(self) -> "_engine.Executable":
+        """One compiled program for a bare ``update`` call: restore state into
+        a template clone, run the real update, return the new state pytree.
 
-        def ustep(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-            m = template._bare_clone()
-            m._restore_state(state)
-            m._inner_update(*args, **kwargs)
-            _propagate_static_attrs(m, template)
-            return m._state_snapshot()
+        Served by the dispatch engine: identically-configured instances share
+        one program (and its jit aval cache), and each step donates the
+        incoming state buffers so XLA updates the accumulators in place."""
 
-        return jax.jit(ustep)
+        def build():
+            template = self._bare_clone()
+
+            def ustep(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+                m = template._bare_clone()
+                m._restore_state(state)
+                m._inner_update(*args, **kwargs)
+                _propagate_static_attrs(m, template)
+                return m._state_snapshot()
+
+            return ustep, template, {}
+
+        exe = _engine.acquire(self, "update", build)
+        object.__setattr__(self, "_fused_update_template", exe.template)
+        return exe
 
     def _move_list_states_to_host(self) -> None:
         """Offload list states to host RAM to free HBM (``compute_on_cpu`` analogue)."""
@@ -477,6 +540,14 @@ class Metric(ABC):
     _fused_seen_signatures: Optional[dict] = None
     _fused_version: int = 0  # bumped on invalidation; lets collections detect staleness
     _FUSED_SIG_CAP = 4096
+    # per-signature eval_shape verdicts for engine programs: a signature that
+    # fails to trace declines fusion silently for ITSELF without poisoning
+    # signatures already licensed (round-5 silent-decline contract)
+    _fused_probe_results: Optional[dict] = None
+    # host fast lane (see _wrap_update): closure bound per signature by
+    # metrics that override _build_update_lane
+    _update_lane: Optional[Callable] = None
+    _has_update_lane_hook: bool = False
 
     _fusable_cached: Optional[bool] = None
 
@@ -488,6 +559,82 @@ class Metric(ABC):
             # FIFO: evict the OLDEST signature (set.pop would be arbitrary
             # and could flap the hot signature out of the cache)
             self._fused_seen_signatures.pop(next(iter(self._fused_seen_signatures)))
+
+    def _license_fused_signature(self, signature: tuple) -> None:
+        """Mark a signature as probe-licensed for the fused program."""
+        results = self._fused_probe_results
+        if results is None:
+            results = {}
+            object.__setattr__(self, "_fused_probe_results", results)
+        results[signature] = True
+
+    def _signature_licensed(self, signature: tuple, program: Callable, *probe_args: Any, **probe_kwargs: Any) -> bool:
+        """Probe verdict for a signature against an engine program (cached).
+
+        Every FIRST-SEEN signature gets its own ``jax.eval_shape`` probe
+        before running fused; an untraceable one is recorded as declined —
+        the call (and every later call with that signature) takes the eager
+        path quietly, while licensed signatures keep their fused program.
+        """
+        results = self._fused_probe_results
+        if results is None:
+            results = {}
+            object.__setattr__(self, "_fused_probe_results", results)
+        ok = results.get(signature)
+        if ok is None:
+            ok = _probe_traceable(program, *probe_args, **probe_kwargs)
+            results[signature] = ok
+            while len(results) > self._FUSED_SIG_CAP:
+                results.pop(next(iter(results)))
+        return ok
+
+    def _default_leaf_ids(self) -> frozenset:
+        """ids of the registered default-state arrays — buffers that must
+        NEVER be donated: ``reset()`` hands the default object back as live
+        state, and donating it would delete the template every future reset
+        restores. Cached; ``add_state`` invalidates via ``_fusable_cached``'s
+        companion slot."""
+        ids = self.__dict__.get("_default_ids_cache")
+        if ids is None:
+            ids = frozenset(id(leaf) for leaf in jax.tree.flatten(self._defaults)[0])
+            object.__setattr__(self, "_default_ids_cache", ids)
+        return ids
+
+    # ----------------------------------------------------- host fast lane
+    def _build_update_lane(self, args: tuple, kwargs: dict) -> Optional[Callable]:
+        """Hook: return a bound closure handling steady-state updates for the
+        just-validated ``(args, kwargs)`` signature, or None.
+
+        The closure receives ``(args, kwargs)`` and returns True when it
+        fully handled the update (including ``_update_count``/``_computed``
+        bookkeeping), False to fall through to the full path. Append-only
+        metrics (CatMetric, retrieval, raw-state curves, SQuAD) override
+        this; the base class opts out.
+        """
+        return None
+
+    def _install_update_lane(self, args: tuple, kwargs: dict) -> None:
+        try:
+            lane = self._build_update_lane(args, kwargs)
+        except Exception:  # noqa: BLE001 — a lane is an optimization, never a failure
+            lane = None
+        if lane is not None:
+            object.__setattr__(self, "_update_lane", lane)
+
+    def _lane_guard(self) -> Callable[[], bool]:
+        """Shared lane-invalidation check: a validation-mode change (any
+        ``set_validation_mode`` call bumps the generation) must kill every
+        installed lane so "full" mode regains per-call checks."""
+        checks = _checks_module()
+        generation = checks._cache_generation
+
+        def still_valid() -> bool:
+            if checks._cache_generation != generation:
+                object.__setattr__(self, "_update_lane", None)
+                return False
+            return True
+
+        return still_valid
 
     def _fusable_states(self) -> bool:
         """True when every state merges by sum/mean/max/min (no list states).
@@ -544,6 +691,11 @@ class Metric(ABC):
             raise TypeError("only sum/mean/max/min array states fuse")
         template = self._bare_clone()
         specs = {name: self._reduction_specs[name] for name in self._defaults}
+        # resolve the merge table OUTSIDE the closure: engine-cached programs
+        # outlive their first acquiring instance, and a `self` cell in the
+        # step would pin that instance (and its accumulated state buffers)
+        # in the global cache for the program's whole lifetime
+        merge_leaf = self._merge_leaf
 
         def step(state: Dict[str, Any], update_count: jax.Array, *args: Any, **kwargs: Any):
             m = template._bare_clone()
@@ -552,7 +704,7 @@ class Metric(ABC):
             batch_state = m._state_snapshot()
             batch_value = m._inner_compute()
             merged = {
-                name: self._merge_leaf(spec, state[name], batch_state[name], update_count)
+                name: merge_leaf(spec, state[name], batch_state[name], update_count)
                 for name, spec in specs.items()
             }
             return merged, batch_value
@@ -569,20 +721,31 @@ class Metric(ABC):
         is ONE dispatch. Only simple reductions fuse (sum/mean/max/min over
         array states); list/cat states grow (retrace per step) and custom
         reductions may not be traceable, so those metrics keep the eager path.
+
+        Served by the dispatch engine: the program is shared across every
+        identically-configured instance and donates the incoming global-state
+        buffers per step (the merged state is written in place).
         """
-        template, step = self._build_fused_step()
-        self._fused_template = template
-        # NOTE: the program caches per instance (step closes over this
-        # instance's template). Identically-configured instances each compile
-        # once per input signature; XLA's persistent compilation cache dedupes
-        # the identical HLO across them when enabled.
-        self._fused_needs_count = any(spec == "mean" for spec in self._reduction_specs.values())
-        if self._fused_needs_count:
-            return jax.jit(step)
-        # only "mean" merges read update_count; eliding the argument saves a
-        # per-step host->device scalar canonicalization+transfer on the
-        # dispatch hot path (measured ~0.2 ms/step on the tunneled backend)
-        return jax.jit(lambda state, *args, **kwargs: step(state, 0, *args, **kwargs))
+
+        def build():
+            template, step = self._build_fused_step()
+            needs_count = any(spec == "mean" for spec in self._reduction_specs.values())
+            if needs_count:
+                fn = step
+            else:
+                # only "mean" merges read update_count; eliding the argument
+                # saves a per-step host->device scalar canonicalization+
+                # transfer on the dispatch hot path (measured ~0.2 ms/step on
+                # the tunneled backend)
+                def fn(state, *args, **kwargs):
+                    return step(state, 0, *args, **kwargs)
+
+            return fn, template, {"needs_count": needs_count}
+
+        exe = _engine.acquire(self, "forward", build)
+        self._fused_template = exe.template
+        self._fused_needs_count = exe.aux["needs_count"]
+        return exe
 
     # ------------------------------------------------- batched-step (scan) API
     # Even the fused forward pays one dispatch round trip per step, and on
@@ -701,6 +864,7 @@ class Metric(ABC):
             result = self._run_many_eager(with_values, args, kwargs, force_reduce_eager=True)
             self._record_fused_signature(signature)
             return result
+        state = None
         try:
             program = self._many_program_vals if with_values else self._many_program_novals
             python_leaves, treedef, scanned_idx, aconst_idx, scanned, array_consts = (
@@ -716,39 +880,63 @@ class Metric(ABC):
             if program is not None and getattr(self, layout_attr, None) != layout:
                 program = None
             if program is None:
-                template, step = self._build_fused_step()
 
-                def program(state, update_count, xs, const_vals):
-                    def body(carry, xs_leaves):
-                        st, cnt = carry
-                        cnt = cnt + 1
-                        step_leaves = list(python_leaves)
-                        for i, leaf in zip(scanned_idx, xs_leaves):
-                            step_leaves[i] = leaf
-                        for i, leaf in zip(aconst_idx, const_vals):
-                            step_leaves[i] = leaf
-                        a, k = jax.tree.unflatten(treedef, step_leaves)
-                        new_st, val = step(st, cnt, *a, **k)
-                        return (new_st, cnt), (val if with_values else 0)
+                def build():
+                    template, step = self._build_fused_step()
 
-                    (final, _), vals = jax.lax.scan(
-                        body, (state, jnp.asarray(update_count, jnp.int32)), xs
-                    )
-                    return final, vals
+                    def scan_program(state, update_count, xs, const_vals):
+                        def body(carry, xs_leaves):
+                            st, cnt = carry
+                            cnt = cnt + 1
+                            step_leaves = list(python_leaves)
+                            for i, leaf in zip(scanned_idx, xs_leaves):
+                                step_leaves[i] = leaf
+                            for i, leaf in zip(aconst_idx, const_vals):
+                                step_leaves[i] = leaf
+                            a, k = jax.tree.unflatten(treedef, step_leaves)
+                            new_st, val = step(st, cnt, *a, **k)
+                            return (new_st, cnt), (val if with_values else 0)
 
-                program = jax.jit(program)
+                        (final, _), vals = jax.lax.scan(
+                            body, (state, jnp.asarray(update_count, jnp.int32)), xs
+                        )
+                        return final, vals
+
+                    return scan_program, template, {}
+
+                # engine-cached per (config, flavor, call layout): a second
+                # same-config instance reuses the compiled scan — the most
+                # expensive program in the library — and each chunk donates
+                # the incoming state buffers
+                program = _engine.acquire(
+                    self, "many", build, extra_key=(with_values, layout)
+                )
                 if with_values:
                     self._many_program_vals = program
-                    self._many_template_vals = template
+                    self._many_template_vals = program.template
                 else:
                     self._many_program_novals = program
-                    self._many_template_novals = template
+                    self._many_template_novals = program.template
                 object.__setattr__(self, layout_attr, layout)
             template = self._many_template_vals if with_values else self._many_template_novals
             state = {name: getattr(self, name) for name in self._defaults}
             n_steps = int(scanned[0].shape[0])
-            merged, values = program(state, self._update_count, scanned, array_consts)
+            runner = getattr(program, "run", None)
+            if runner is not None:
+                merged, values = runner(
+                    state,
+                    (self._update_count, scanned, array_consts),
+                    avoid_ids=self._default_leaf_ids(),
+                )
+            else:
+                merged, values = program(state, self._update_count, scanned, array_consts)
         except Exception as exc:
+            if state is not None and not _engine.state_intact(state):
+                raise RuntimeError(
+                    f"Batched-step program for `{type(self).__name__}` failed after "
+                    f"donating its state buffers ({type(exc).__name__}: {exc}); the "
+                    "accumulated state is unrecoverable — construct a fresh instance."
+                ) from exc
             # eager fallback; if it succeeds, only the BATCHED path is deemed
             # untraceable — the single-step fused forward keeps its own flag
             # (one bad chunk must not cost every later forward() its fast
@@ -851,6 +1039,7 @@ class Metric(ABC):
                 (state, self._update_count + 1, *args) if self._fused_needs_count else (state, *args)
             )
             if _probe_traceable(program, *probe_args, **kwargs):
+                self._license_fused_signature(signature)
                 self._fused_forward = program
             else:
                 # probe declined: permanently eager, and the signature is
@@ -858,18 +1047,38 @@ class Metric(ABC):
                 self._fused_forward_ok = False
                 self._fused_template = None
                 return self._forward_reduce_state_update_eager(*args, **kwargs)
+        if seen and isinstance(self._fused_forward, _engine.Executable):
+            # every first-seen signature is probed before running fused; an
+            # untraceable one declines quietly (eager for that signature)
+            # without disturbing the licensed ones
+            state = {name: getattr(self, name) for name in self._defaults}
+            probe_args = (
+                (state, self._update_count + 1, *args) if self._fused_needs_count else (state, *args)
+            )
+            if not self._signature_licensed(signature, self._fused_forward, *probe_args, **kwargs):
+                return self._forward_reduce_state_update_eager(*args, **kwargs)
         if seen:
             try:
                 state = {name: getattr(self, name) for name in self._defaults}
-                if self._fused_needs_count:
-                    merged, batch_val = self._fused_forward(state, self._update_count + 1, *args, **kwargs)
+                call_args = (self._update_count + 1, *args) if self._fused_needs_count else args
+                runner = getattr(self._fused_forward, "run", None)
+                if runner is not None:
+                    merged, batch_val = runner(
+                        state, call_args, kwargs, avoid_ids=self._default_leaf_ids()
+                    )
                 else:
-                    merged, batch_val = self._fused_forward(state, *args, **kwargs)
+                    merged, batch_val = self._fused_forward(state, *call_args, **kwargs)
             except Exception as exc:
                 # fall back; if the eager path then succeeds, the metric is
                 # genuinely unfusable — stop re-tracing every step. If eager
                 # raises too, the input itself was bad: surface that error and
                 # keep the fused path enabled.
+                if not _engine.state_intact(state):
+                    raise RuntimeError(
+                        f"Fused forward for `{type(self).__name__}` failed after donating "
+                        f"its state buffers ({type(exc).__name__}: {exc}); the accumulated "
+                        "state is unrecoverable — construct a fresh instance."
+                    ) from exc
                 result = self._forward_reduce_state_update_eager(*args, **kwargs)
                 rank_zero_warn(
                     f"Fused forward for `{type(self).__name__}` raised "
@@ -1102,10 +1311,34 @@ class Metric(ABC):
             ):
                 with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
                     value = compute(*args, **kwargs)
-                self._computed = _squeeze_scalar(value)
+                self._computed = self._decouple_from_state(_squeeze_scalar(value))
             return self._computed
 
         return wrapped
+
+    def _decouple_from_state(self, value: Any) -> Any:
+        """Donation safety for compute results that ARE live state buffers.
+
+        ``SumMetric.compute`` (and kin) return the state leaf itself; the
+        next donated fused step would delete that buffer out from under the
+        caller's held result. Copy any result leaf whose buffer is a current
+        state leaf — one tiny async op, only at compute time, only for
+        metrics whose states can be donated at all.
+        """
+        if not self._fusable_states() or not _engine.donation_supported():
+            return value
+        state_ids = {
+            id(v) for v in self.metric_state.values() if isinstance(v, jax.Array)
+        }
+        if not state_ids:
+            return value
+
+        def leaf(x: Any) -> Any:
+            if isinstance(x, jax.Array) and not isinstance(x, jax.core.Tracer) and id(x) in state_ids:
+                return jnp.copy(x)
+            return x
+
+        return jax.tree.map(leaf, value)
 
     def reset(self) -> None:
         """Reset state to defaults (reference `metric.py:547-562`)."""
@@ -1285,6 +1518,9 @@ class Metric(ABC):
             "_many_template_novals",
             "_many_layout_vals",
             "_many_layout_novals",
+            "_update_lane",
+            "_fused_probe_results",
+            "_default_ids_cache",
         )
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 
@@ -1337,6 +1573,13 @@ class Metric(ABC):
                 if self.__dict__.get("_fused_update_program") is not None:
                     object.__setattr__(self, "_fused_update_program", None)
                     object.__setattr__(self, "_fused_update_template", None)
+                if self.__dict__.get("_update_lane") is not None:
+                    # the lane baked this hyperparameter's behavior (e.g. a
+                    # nan_strategy gate) into its closure — rebind lazily
+                    object.__setattr__(self, "_update_lane", None)
+                if self.__dict__.get("_fused_probe_results") is not None:
+                    # probe verdicts were against the OLD program's constants
+                    object.__setattr__(self, "_fused_probe_results", None)
                 if (
                     self.__dict__.get("_many_program_vals") is not None
                     or self.__dict__.get("_many_program_novals") is not None

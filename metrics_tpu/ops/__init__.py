@@ -1,6 +1,16 @@
-"""Low-level device kernels: Pallas MXU histogram, binned-curve counts, segment reductions."""
+"""Low-level device kernels and the dispatch engine: Pallas MXU histogram,
+binned-curve counts, segment reductions, donated-state program cache."""
 from metrics_tpu.ops._dispatch import pallas_enabled
 from metrics_tpu.ops.binned import binned_curve_counts
+from metrics_tpu.ops.engine import (
+    Executable,
+    acquire,
+    acquire_keyed,
+    config_fingerprint,
+    donation_supported,
+    engine_stats,
+    reset_engine,
+)
 from metrics_tpu.ops.histogram import fused_bincount
 from metrics_tpu.ops.segments import (
     segment_count,
@@ -21,4 +31,11 @@ __all__ = [
     "segment_ranks",
     "segment_starts",
     "segment_sum",
+    "Executable",
+    "acquire",
+    "acquire_keyed",
+    "config_fingerprint",
+    "donation_supported",
+    "engine_stats",
+    "reset_engine",
 ]

@@ -1,0 +1,308 @@
+"""Donated-state dispatch engine — one owner for every fused metric program.
+
+Four call sites used to roll their own program construction and caching:
+``Metric`` (fused bare-update / fused forward / batched-scan programs),
+``MetricCollection`` (whole-suite forward and scan), the fan-out wrappers
+(`wrappers/_fanout.py` weighted-row and vmapped clone programs) and
+``BootStrapper``'s clone programs on top of them. Each cached per *instance*,
+compiled without donation, and re-compiled per identically-configured
+instance. This module centralizes all of that behind two primitives:
+
+- :func:`acquire` — a **cross-instance program cache** keyed by
+  ``(program kind, config fingerprint, structural extras)``. The fingerprint
+  digests the metric class, its public hyperparameters and its state
+  registry, recursing into child metrics, so the N bootstrap clones of one
+  base config, the members of a MetricCollection, and repeated constructions
+  of the same metric class share ONE compiled program (XLA's jit cache then
+  dedupes avals within it). A second same-config instance compiles zero new
+  programs — observable via :func:`engine_stats` and the shared jitted
+  callable's ``_cache_size``.
+
+- :class:`Executable` — every cached program carries a **donated** twin
+  (``jax.jit(..., donate_argnums=(0,))`` over the state tree) next to the
+  plain one. Fused steps donate the incoming state buffers so XLA writes the
+  new state in place instead of allocating a fresh tree per step — the
+  update/forward hot path stops paying an alloc+copy per leaf per step.
+  Donation is applied only when provably safe for that call
+  (:func:`state_donatable`): every leaf a concrete, strongly-typed, live
+  ``jax.Array`` and no buffer appearing twice in the tree (compute groups
+  share leaves across collection members; donating a shared buffer twice is
+  an XLA runtime error). Unsafe calls silently use the plain twin — same
+  trace, same numbers.
+
+Donation makes the PREVIOUS state buffers invalid. The metric instance
+replaces its state attributes immediately after every fused step, and
+``Metric._wrap_compute`` decouples any compute result that aliases a live
+state leaf, so user-held compute values survive later donated steps. Raw
+state references captured via direct attribute access before a fused step
+are not protected — hold ``compute()`` results, not state leaves.
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "Executable",
+    "acquire",
+    "acquire_keyed",
+    "config_fingerprint",
+    "donation_supported",
+    "engine_stats",
+    "reset_engine",
+    "state_donatable",
+    "state_intact",
+]
+
+
+# --------------------------------------------------------------- donation probe
+_donation_supported: Optional[bool] = None
+
+
+def donation_supported() -> bool:
+    """Whether this backend actually consumes donated buffers (probed once).
+
+    Backends without donation support leave the input alive and warn per
+    call; probing once lets the engine route every call through the plain
+    twin there, keeping the fast path warning-free.
+    """
+    global _donation_supported
+    if _donation_supported is None:
+        try:
+            import warnings
+
+            import jax.numpy as jnp
+
+            probe = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+            x = jnp.zeros((), jnp.float32)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                probe(x)
+            _donation_supported = bool(x.is_deleted())
+        except Exception:  # noqa: BLE001 — any probe failure → plain programs
+            _donation_supported = False
+    return _donation_supported
+
+
+def state_donatable(state: Any, avoid_ids: Optional[frozenset] = None) -> bool:
+    """True when donating ``state``'s buffers is provably safe for this call.
+
+    Requires every leaf to be a concrete, live, strongly-typed ``jax.Array``
+    and every buffer to appear exactly once: compute groups alias one leaf
+    across several collection members, and XLA rejects donating the same
+    buffer twice at runtime; weak-typed leaves are refused donation by jax
+    with a per-call warning. ``avoid_ids`` lists buffers that must never be
+    donated — callers pass their registered default-state arrays, which
+    ``reset()`` re-issues as live state and must therefore outlive any step.
+    """
+    seen_ids = set()
+    for leaf in jax.tree.flatten(state)[0]:
+        if not isinstance(leaf, jax.Array) or isinstance(leaf, jax.core.Tracer):
+            return False
+        if getattr(leaf, "weak_type", False) or leaf.is_deleted():
+            return False
+        i = id(leaf)
+        if i in seen_ids or (avoid_ids is not None and i in avoid_ids):
+            return False
+        seen_ids.add(i)
+    return True
+
+
+def state_intact(state: Any) -> bool:
+    """True when no state leaf has been deleted (post-failure fallback guard:
+    an eager retry over donated-away buffers would raise a confusing
+    deleted-buffer error instead of the original one)."""
+    for leaf in jax.tree.flatten(state)[0]:
+        if isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer) and leaf.is_deleted():
+            return False
+    return True
+
+
+# ----------------------------------------------------------------- fingerprints
+def _value_digest(value: Any, depth: int = 0) -> Any:
+    """Collision-safe digest of one hyperparameter value.
+
+    ``repr`` alone is NOT enough for arrays: numpy truncates reprs past
+    1000 elements, so two metrics differing only in the middle of a long
+    ``thresholds`` array would fingerprint equal and silently share a
+    program baking the wrong constants. Arrays digest by full content hash;
+    containers recurse (bounded); everything else falls back to repr.
+    """
+    if isinstance(value, (jax.Array, np.ndarray, np.generic)) and not isinstance(
+        value, jax.core.Tracer
+    ):
+        host = np.asarray(value)
+        return ("array", host.shape, str(host.dtype), hashlib.sha1(host.tobytes()).hexdigest())
+    if depth < 3 and isinstance(value, (list, tuple)):
+        return (type(value).__name__, tuple(_value_digest(v, depth + 1) for v in value))
+    if depth < 3 and isinstance(value, dict):
+        return (
+            "dict",
+            tuple(sorted((repr(k), _value_digest(v, depth + 1)) for k, v in value.items())),
+        )
+    return repr(value)
+
+
+def config_fingerprint(metric: Any) -> tuple:
+    """Hashable digest of everything a fused program bakes in.
+
+    Covers the concrete class, every public non-state attribute (scalar
+    hyperparameters by ``repr``; array-valued ones like ``thresholds`` by
+    full content hash — see :func:`_value_digest`; the same surface whose
+    mutation bumps ``_fused_version``), the state registry (names, reduction
+    specs, default avals), and — recursively — every child metric. Two
+    instances with equal fingerprints trace to the same program; an
+    attribute whose repr embeds an object address simply keys a private
+    cache slot (correct, just unshared). Distributed-transport knobs are
+    excluded: they gate *whether* a fused path runs, never what the program
+    computes.
+    """
+    cls = type(metric)
+    skip = ("update", "compute", "compute_on_cpu", "process_group", "dist_sync_fn")
+    defaults = getattr(metric, "_defaults", {})
+    attrs = tuple(
+        (k, _value_digest(v))
+        for k, v in sorted(metric.__dict__.items())
+        if not k.startswith("_") and k not in defaults and k not in skip
+    )
+    states = tuple(
+        (
+            name,
+            metric._reduction_specs.get(name),
+            "list"
+            if isinstance(default, list)
+            else (tuple(default.shape), str(default.dtype)),
+        )
+        for name, default in sorted(defaults.items())
+    )
+    children = tuple(
+        (name, config_fingerprint(child)) for name, child in metric._named_child_metrics()
+    )
+    return (cls.__module__, cls.__qualname__, attrs, states, children)
+
+
+# --------------------------------------------------------------- program cache
+class Executable:
+    """A cached fused program: donated fast path plus its plain twin.
+
+    Calling executes the donated twin when :func:`state_donatable` passes for
+    this call's state tree (and the backend supports donation), else the
+    plain twin — one trace, two compiled aliasing policies. ``template``
+    carries the bare metric clone(s) the step closure runs on (callers
+    propagate update-inferred static attrs from it); ``aux`` holds
+    build-time facts like ``needs_count``.
+    """
+
+    __slots__ = ("donated", "plain", "template", "aux", "__weakref__")
+
+    def __init__(self, donated: Optional[Callable], plain: Callable, template: Any, aux: Dict[str, Any]):
+        self.donated = donated
+        self.plain = plain
+        self.template = template
+        self.aux = aux
+
+    def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        # plain twin: trace/probe-friendly (``jax.eval_shape`` over an
+        # Executable exercises exactly the math the donated twin compiles)
+        return self.plain(state, *args, **kwargs)
+
+    def run(
+        self,
+        state: Any,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        *,
+        donate: bool = True,
+        avoid_ids: Optional[frozenset] = None,
+    ) -> Any:
+        """Execute with in-place state: the donated twin when safe for THIS
+        call's buffers, else the plain twin — same trace either way."""
+        kwargs = kwargs or {}
+        if (
+            donate
+            and self.donated is not None
+            and donation_supported()
+            and state_donatable(state, avoid_ids)
+        ):
+            return self.donated(state, *args, **kwargs)
+        return self.plain(state, *args, **kwargs)
+
+    def compiled_signatures(self) -> int:
+        """Number of aval signatures compiled across both twins — lets tests
+        assert a second same-config instance added zero compiles."""
+        count = 0
+        for fn in (self.donated, self.plain):
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                count += size()
+        return count
+
+
+_PROGRAM_CACHE: "OrderedDict[tuple, Executable]" = OrderedDict()
+_CACHE_CAP = 256
+_stats = {"builds": 0, "hits": 0}
+
+
+def acquire(
+    owner: Any,
+    kind: str,
+    build: Callable[[], Tuple[Callable, Any, Dict[str, Any]]],
+    *,
+    extra_key: tuple = (),
+    donate: bool = True,
+) -> Executable:
+    """Fetch (or build once) the fused program for ``owner``'s configuration.
+
+    ``build()`` returns ``(step_fn, template, aux)`` where ``step_fn`` takes
+    the state tree as its first argument. The compiled pair is cached under
+    ``(kind, fingerprint(owner), *extra_key)`` with LRU eviction, so every
+    identically-configured instance — bootstrap clones, collection members,
+    re-constructions — reuses one program object and its jit aval cache.
+    """
+    return acquire_keyed((kind, config_fingerprint(owner)) + tuple(extra_key), build, donate=donate)
+
+
+def acquire_keyed(
+    key: tuple,
+    build: Callable[[], Tuple[Callable, Any, Dict[str, Any]]],
+    *,
+    donate: bool = True,
+) -> Executable:
+    """:func:`acquire` for callers that assemble their own cache key —
+    MetricCollection keys by its members' fingerprints, the fan-out wrappers
+    by wrapper + clone fingerprints."""
+    exe = _PROGRAM_CACHE.get(key)
+    if exe is not None:
+        _stats["hits"] += 1
+        _PROGRAM_CACHE.move_to_end(key)
+        return exe
+    _stats["builds"] += 1
+    step, template, aux = build()
+    exe = Executable(
+        jax.jit(step, donate_argnums=(0,)) if donate else None,
+        jax.jit(step),
+        template,
+        aux,
+    )
+    _PROGRAM_CACHE[key] = exe
+    while len(_PROGRAM_CACHE) > _CACHE_CAP:
+        _PROGRAM_CACHE.popitem(last=False)
+    return exe
+
+
+def engine_stats() -> Dict[str, int]:
+    """Cache effectiveness counters: ``builds`` (distinct programs traced),
+    ``hits`` (program acquisitions served from cache), ``cached`` (live)."""
+    return {"builds": _stats["builds"], "hits": _stats["hits"], "cached": len(_PROGRAM_CACHE)}
+
+
+def reset_engine() -> None:
+    """Drop every cached program and zero the counters (tests; and the escape
+    hatch after a backend restart invalidates compiled executables)."""
+    _PROGRAM_CACHE.clear()
+    _stats["builds"] = 0
+    _stats["hits"] = 0

@@ -193,6 +193,49 @@ class RetrievalMetric(Metric):
         self.preds.append(preds)
         self.target.append(target)
 
+    def _build_update_lane(self, args: tuple, kwargs: dict):
+        """Dispatch-engine host fast lane: the metadata checks are pure
+        functions of the (shape, dtype) signature and the value checks honor
+        the validation mode, so after one eager-validated update per
+        signature a same-signature update is three raw list appends plus one
+        guard branch."""
+        if kwargs or len(args) != 3:
+            return None
+        specs = []
+        for v in args:
+            if isinstance(v, jax.core.Tracer) or not isinstance(v, (jax.Array, np.ndarray)):
+                return None
+            specs.append((type(v), v.shape, v.dtype))
+        (cp, sp, dp), (ct, st, dt), (ci, si, di) = specs
+        guard = self._lane_guard()
+
+        def lane(largs: tuple, lkwargs: dict) -> bool:
+            if lkwargs or len(largs) != 3:
+                return False
+            p, t, i = largs
+            if (
+                type(p) is not cp
+                or p.shape != sp
+                or p.dtype != dp
+                or type(t) is not ct
+                or t.shape != st
+                or t.dtype != dt
+                or type(i) is not ci
+                or i.shape != si
+                or i.dtype != di
+            ):
+                return False
+            if not guard():
+                return False
+            self._update_count += 1
+            self._computed = None
+            self.indexes.append(i)
+            self.preds.append(p)
+            self.target.append(t)
+            return True
+
+        return lane
+
     def _canonicalize_list_states(self) -> None:
         """Flatten/cast/filter buffered raw rows in place (idempotent).
 

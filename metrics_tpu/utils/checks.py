@@ -120,6 +120,9 @@ def _should_value_check(preds, target, key_extra=()) -> bool:
             cache = {}
             owner.__dict__["_value_check_seen"] = cache
             owner.__dict__["_value_check_gen"] = _cache_generation
+            # a mode switch starts a fresh diagnostic epoch for this owner
+            owner.__dict__["_value_check_evictions"] = 0
+            owner.__dict__["_value_check_evict_warned"] = False
     else:
         cache = _seen_check_keys
     if key in cache:
@@ -127,21 +130,46 @@ def _should_value_check(preds, target, key_extra=()) -> bool:
     cache[key] = None
     while len(cache) > _SEEN_KEYS_CAP:
         cache.pop(next(iter(cache)))
-        _eviction_count += 1
-        if _eviction_count > _SEEN_KEYS_CAP and not _eviction_warned:
-            _eviction_warned = True
-            from metrics_tpu.utils.prints import rank_zero_warn
+        if owner is not None:
+            # PER-OWNER diagnostics: the warning names the churning metric
+            # instance and fires once per owner, so a service with several
+            # metrics (one of them fed a pathological input stream) can
+            # attribute the churn instead of learning about it once globally
+            count = owner.__dict__.get("_value_check_evictions", 0) + 1
+            owner.__dict__["_value_check_evictions"] = count
+            if count > _SEEN_KEYS_CAP and not owner.__dict__.get("_value_check_evict_warned"):
+                owner.__dict__["_value_check_evict_warned"] = True
+                from metrics_tpu.utils.prints import rank_zero_warn
 
-            rank_zero_warn(
-                "Validation mode 'first' has evicted more than"
-                f" {_SEEN_KEYS_CAP} input signatures from its seen-signature"
-                " cache: this pipeline churns through more distinct input"
-                " shapes/dtypes than the cache holds, so evicted signatures"
-                " are re-validated (re-paying the device sync 'first' mode is"
-                " meant to elide). Pad/bucket inputs to stable shapes, or set"
-                " METRICS_TPU_VALIDATION=off if inputs are already trusted.",
-                UserWarning,
-            )
+                rank_zero_warn(
+                    f"Validation mode 'first' has evicted more than"
+                    f" {_SEEN_KEYS_CAP} input signatures for metric"
+                    f" `{type(owner).__name__}` (id 0x{id(owner):x}): this"
+                    " instance churns through more distinct input"
+                    " shapes/dtypes than the cache holds, so evicted"
+                    " signatures are re-validated (re-paying the device sync"
+                    " 'first' mode is meant to elide). Pad/bucket this"
+                    " metric's inputs to stable shapes, or set"
+                    " METRICS_TPU_VALIDATION=off if inputs are already"
+                    " trusted.",
+                    UserWarning,
+                )
+        else:
+            _eviction_count += 1
+            if _eviction_count > _SEEN_KEYS_CAP and not _eviction_warned:
+                _eviction_warned = True
+                from metrics_tpu.utils.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    "Validation mode 'first' has evicted more than"
+                    f" {_SEEN_KEYS_CAP} input signatures from its seen-signature"
+                    " cache: this pipeline churns through more distinct input"
+                    " shapes/dtypes than the cache holds, so evicted signatures"
+                    " are re-validated (re-paying the device sync 'first' mode is"
+                    " meant to elide). Pad/bucket inputs to stable shapes, or set"
+                    " METRICS_TPU_VALIDATION=off if inputs are already trusted.",
+                    UserWarning,
+                )
     return True
 
 

@@ -1,0 +1,30 @@
+"""jax version-drift shims, applied once at package import.
+
+The codebase targets the jax that ships top-level ``jax.shard_map(f, mesh=…,
+in_specs=…, out_specs=…, check_vma=…)``. Older/newer toolchain images in the
+deployment fleet carry only ``jax.experimental.shard_map.shard_map`` (same
+semantics; the replication check is spelled ``check_rep``). Installing the
+alias here keeps every SPMD call site — ``__graft_entry__`` and the mesh
+tests — source-identical across images.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["install"]
+
+
+def install() -> None:
+    """Idempotently install missing jax aliases for this process."""
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map as _shard_map
+        except Exception:  # pragma: no cover — no shard_map anywhere: leave jax as-is
+            return
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None, **kw):
+            if check_vma is not None and "check_rep" not in kw:
+                kw["check_rep"] = check_vma
+            return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+        jax.shard_map = shard_map

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops import engine as _engine
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -52,6 +53,12 @@ def run_fanout(
     failure (config drift across clones, trace/compile error) warns once,
     permanently disables the fast path for this instance, and returns False
     so the caller falls back to the per-clone eager path.
+
+    Programs are served by the dispatch engine: keyed on the wrapper's
+    config fingerprint (which recurses into the clones), so two
+    identically-configured wrappers share ONE compiled clone program — and
+    each step donates the stacked clone states, mutating the whole fleet's
+    accumulators in place.
     """
     versions = (wrapper._fused_version,) + tuple(m._fused_version for m in clones)
     if versions != getattr(wrapper, versions_attr):
@@ -66,13 +73,19 @@ def run_fanout(
             object.__setattr__(wrapper, program_attr, None)
             return False
     rebuilt = False
+    states = None
     try:
         states = [m.metric_state for m in clones]
         if getattr(wrapper, program_attr) is None or getattr(wrapper, versions_attr) != versions:
             from metrics_tpu.metric import _probe_traceable
 
-            _, upd, _ = clones[0].as_functions()
-            program = jax.jit(build_program(upd))
+            def build():
+                _, upd, _ = clones[0].as_functions()
+                return build_program(upd), None, {}
+
+            program = _engine.acquire(
+                wrapper, f"fanout:{program_attr}", build
+            )
             if not _probe_traceable(program, states, *call_args, **call_kwargs):
                 object.__setattr__(wrapper, ok_attr, False)
                 object.__setattr__(wrapper, program_attr, None)
@@ -80,8 +93,20 @@ def run_fanout(
             object.__setattr__(wrapper, program_attr, program)
             object.__setattr__(wrapper, versions_attr, versions)
             rebuilt = True
-        new_states = getattr(wrapper, program_attr)(states, *call_args, **call_kwargs)
+        program = getattr(wrapper, program_attr)
+        runner = getattr(program, "run", None)
+        if runner is not None:
+            avoid = frozenset().union(*(m._default_leaf_ids() for m in clones))
+            new_states = runner(states, call_args, call_kwargs, avoid_ids=avoid)
+        else:
+            new_states = program(states, *call_args, **call_kwargs)
     except Exception as exc:  # noqa: BLE001 — any trace/compile failure
+        if states is not None and not _engine.state_intact(states):
+            raise RuntimeError(
+                f"Fused fan-out program for `{type(clones[0]).__name__}` failed after "
+                f"donating the clone state buffers ({type(exc).__name__}: {exc}); the "
+                "accumulated states are unrecoverable — construct a fresh wrapper."
+            ) from exc
         rank_zero_warn(
             f"Fused fan-out program for `{type(clones[0]).__name__}` raised "
             f"{type(exc).__name__}: {exc}. Falling back to the per-clone eager "
@@ -145,14 +170,44 @@ def row_deltas(upd: Callable, init_state: Dict[str, Any], a: tuple, k: dict):
     return jax.vmap(one_row)((a, k))
 
 
+def weighted_delta_add(old, contrib_fn, *, weights, delta):
+    """``old + <weights · delta>`` with a dtype-exact accumulate.
+
+    Integer/count sum-states must accumulate in their own integer dtype: the
+    old behavior promoted ``old`` through float32, which silently truncates
+    once the accumulated count exceeds 2^24 (round-5 ADVICE). Integer
+    weights × integer deltas contract exactly in int32; float-weighted
+    integer deltas (the NaN-mask path: weights are exactly 0/1) are rounded
+    back before the integer add. Float states contract in float64 when x64
+    is enabled, else the state's own float dtype.
+    """
+    integral = jnp.issubdtype(old.dtype, jnp.integer) or old.dtype == jnp.bool_
+    if integral:
+        if jnp.issubdtype(weights.dtype, jnp.integer) and (
+            jnp.issubdtype(delta.dtype, jnp.integer) or delta.dtype == jnp.bool_
+        ):
+            contrib = contrib_fn(weights.astype(jnp.int32), delta.astype(jnp.int32))
+        else:
+            contrib = jnp.round(contrib_fn(weights.astype(jnp.float32), delta.astype(jnp.float32)))
+        return old + contrib.astype(old.dtype)
+    wide = jnp.float64 if jax.config.jax_enable_x64 else (
+        old.dtype if jnp.issubdtype(old.dtype, jnp.floating) else jnp.float32
+    )
+    contrib = contrib_fn(weights.astype(wide), delta.astype(wide))
+    return (old + contrib.astype(old.dtype)).astype(old.dtype)
+
+
 def weighted_state_apply(stacked_states, deltas, weights):
     """``new_c = old_c + sum_i weights[c, i] * delta_i`` for every clone c —
     the resample/filter itself, as one contraction per state leaf."""
 
     def apply(old, d):
-        w = weights.astype(d.dtype if jnp.issubdtype(d.dtype, jnp.floating) else jnp.float32)
-        contrib = jnp.tensordot(w, d.astype(w.dtype), axes=(1, 0))
-        return (old + contrib).astype(old.dtype)
+        return weighted_delta_add(
+            old,
+            lambda w, dd: jnp.tensordot(w, dd, axes=(1, 0)),
+            weights=weights,
+            delta=d,
+        )
 
     return jax.tree.map(apply, stacked_states, deltas)
 
@@ -176,6 +231,7 @@ __all__ = [
     "fanout_gate",
     "sum_linear_base",
     "row_deltas",
+    "weighted_delta_add",
     "weighted_state_apply",
     "states_allclose",
 ]

@@ -99,7 +99,7 @@ class BootStrapper(Metric):
     # next step's poisson counts, drawn + uploaded one step AHEAD so the
     # host->device transfer overlaps the current program's round trip
     # (measured ~1 ms/step through a tunneled backend):
-    # (size, counts_np, dev, rng_state_before_draw)
+    # (size, sampling_strategy, matrix_np, dev, rng_state_before_draw)
     _boot_prefetch = None
 
     def __getstate__(self) -> Dict[str, Any]:
@@ -107,24 +107,27 @@ class BootStrapper(Metric):
         state.pop("_boot_program", None)  # jit closure: rebuilt lazily
         pf = state.pop("_boot_prefetch", None)
         if pf is not None:
-            state["_boot_prefetch"] = (pf[0], pf[1], None, pf[3])  # device leaf re-uploads lazily
+            state["_boot_prefetch"] = (pf[0], pf[1], pf[2], None, pf[4])  # device leaf re-uploads lazily
         return state
 
     def _take_prefetch(self, size: int):
         """Consume the pending lookahead draw, or None.
 
-        A size-mismatched prefetch REWINDS the RNG to its pre-draw state
-        (numpy ``set_state``) before being dropped, so the seeded stream is
-        exactly what a never-prefetching run would have produced — the
-        lookahead is unobservable except as overlap. Single owner of the
-        drop/keep policy for both the fused and eager consume sites.
+        A size- OR strategy-mismatched prefetch REWINDS the RNG to its
+        pre-draw state (numpy ``set_state``) before being dropped, so the
+        seeded stream is exactly what a never-prefetching run would have
+        produced — the lookahead is unobservable except as overlap. The
+        strategy guard matters: a ``sampling_strategy`` flip mid-stream must
+        not consume a prefetched poisson COUNT matrix as multinomial INDEX
+        draws (round-5 ADVICE). Single owner of the drop/keep policy for
+        both the fused and eager consume sites.
         """
         pf = self._boot_prefetch
         if pf is None:
             return None
         object.__setattr__(self, "_boot_prefetch", None)
-        if pf[0] != size:
-            self._rng.set_state(pf[3])  # un-consume: stream parity preserved
+        if pf[0] != size or pf[1] != self.sampling_strategy:
+            self._rng.set_state(pf[4])  # un-consume: stream parity preserved
             return None
         return pf
 
@@ -135,20 +138,25 @@ class BootStrapper(Metric):
 
     def _consume_or_draw(self, size: int, draw_matrix):
         """This step's draw matrix and its device copy: the pending prefetch
-        when its size matches, else a fresh draw via ``draw_matrix()``."""
+        when its size AND strategy match, else a fresh draw via
+        ``draw_matrix()``."""
         pf = self._take_prefetch(size)
         if pf is not None:
-            return pf[1], (pf[2] if pf[2] is not None else jnp.asarray(pf[1]))
+            return pf[2], (pf[3] if pf[3] is not None else jnp.asarray(pf[2]))
         mat = draw_matrix()
         return mat, jnp.asarray(mat)
 
     def _store_prefetch(self, size: int, draw_matrix) -> None:
         """Draw + upload the NEXT step's matrix so the transfer overlaps the
         current (already dispatched) program; snapshot the RNG first so a
-        size change can rewind the stream (see _take_prefetch)."""
+        size or strategy change can rewind the stream (see _take_prefetch)."""
         rng_state = self._rng.get_state()
         nxt = draw_matrix()
-        object.__setattr__(self, "_boot_prefetch", (size, nxt, jnp.asarray(nxt), rng_state))
+        object.__setattr__(
+            self,
+            "_boot_prefetch",
+            (size, self.sampling_strategy, nxt, jnp.asarray(nxt), rng_state),
+        )
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each.
@@ -194,9 +202,9 @@ class BootStrapper(Metric):
             pf = self._take_prefetch(size)
             if pf is not None:
                 predrawn = (
-                    self._counts_to_indices(pf[1])
+                    self._counts_to_indices(pf[2])
                     if self.sampling_strategy == "poisson"
-                    else list(pf[1])
+                    else list(pf[2])
                 )
         for idx in range(self.num_bootstraps):
             # a failed fused attempt already consumed this step's draws: reuse

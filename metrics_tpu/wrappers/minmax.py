@@ -16,13 +16,24 @@ from metrics_tpu.utils.prints import rank_zero_warn
 class MinMaxMetric(Metric):
     """Returns ``{raw, min, max}`` of the base metric over time.
 
-    Example:
+    Example (batched steps first — ``forward_many`` takes a chunk of steps
+    with a leading steps axis in ONE call, the configuration that clears the
+    per-step dispatch floor on remote/tunneled backends; see
+    docs/performance.md):
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Accuracy, MinMaxMetric
         >>> metric = MinMaxMetric(Accuracy())
-        >>> _ = metric(jnp.asarray([1, 1, 0, 0]), jnp.asarray([1, 0, 0, 0]))
-        >>> _ = metric(jnp.asarray([1, 0, 0, 0]), jnp.asarray([1, 0, 0, 0]))
+        >>> preds = jnp.asarray([[1, 1, 0, 0], [1, 0, 0, 0]])    # (steps, batch)
+        >>> target = jnp.asarray([[1, 0, 0, 0], [1, 0, 0, 0]])
+        >>> per_step = metric.forward_many(preds, target)
         >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'raw': 1.0, 'max': 1.0, 'min': 0.75}
+
+    Single-step ``forward`` keeps the reference call shape:
+        >>> metric2 = MinMaxMetric(Accuracy())
+        >>> _ = metric2(jnp.asarray([1, 1, 0, 0]), jnp.asarray([1, 0, 0, 0]))
+        >>> _ = metric2(jnp.asarray([1, 0, 0, 0]), jnp.asarray([1, 0, 0, 0]))
+        >>> {k: round(float(v), 4) for k, v in metric2.compute().items()}
         {'raw': 1.0, 'max': 1.0, 'min': 0.75}
     """
 

@@ -21,6 +21,7 @@ from metrics_tpu.wrappers._fanout import (
     run_fanout,
     states_allclose,
     sum_linear_base,
+    weighted_delta_add,
 )
 
 
@@ -38,13 +39,22 @@ def _get_nan_indices(*tensors: jax.Array) -> jax.Array:
 class MultioutputWrapper(Metric):
     """Evaluate one metric per output dimension and return the list of values.
 
-    Example:
+    Example (batched steps first — ``forward_many`` takes a chunk of steps
+    with a leading steps axis in ONE call, the configuration that clears the
+    per-step dispatch floor on remote/tunneled backends; see
+    docs/performance.md):
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import MultioutputWrapper, R2Score
-        >>> preds = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]])
-        >>> target = jnp.asarray([[1.0, 12.0], [2.0, 21.0], [3.5, 29.0]])
+        >>> preds = jnp.asarray([[[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]]])   # (steps, batch, outputs)
+        >>> target = jnp.asarray([[[1.0, 12.0], [2.0, 21.0], [3.5, 29.0]]])
         >>> r2 = MultioutputWrapper(R2Score(), num_outputs=2)
-        >>> [round(float(v), 4) for v in r2(preds, target)]
+        >>> per_step = r2.forward_many(preds, target)
+        >>> [round(float(v[-1]), 4) for v in per_step]
+        [0.9211, 0.9585]
+
+    Single-step ``forward`` keeps the reference call shape:
+        >>> r2b = MultioutputWrapper(R2Score(), num_outputs=2)
+        >>> [round(float(v), 4) for v in r2b(preds[0], target[0])]
         [0.9211, 0.9585]
     """
 
@@ -185,11 +195,17 @@ class MultioutputWrapper(Metric):
                             (ca, ck),
                         )
                         deltas = row_deltas(upd, init_state, ca, ck)
-                        w = (~mask).astype(jnp.float32)
+                        # 0/1 keep-mask as integer weights: count states
+                        # contract exactly in their own dtype instead of
+                        # truncating through float32 (see weighted_delta_add)
+                        w = (~mask).astype(jnp.int32)
                         return jax.tree.map(
-                            lambda old, d: (
-                                old + jnp.tensordot(w, d.astype(jnp.float32), axes=(0, 0))
-                            ).astype(old.dtype),
+                            lambda old, d: weighted_delta_add(
+                                old,
+                                lambda ww, dd: jnp.tensordot(ww, dd, axes=(0, 0)),
+                                weights=w,
+                                delta=d,
+                            ),
                             state,
                             deltas,
                         )

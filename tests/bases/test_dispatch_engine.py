@@ -1,0 +1,340 @@
+"""Dispatch-engine contract (`metrics_tpu/ops/engine.py`).
+
+Pins the three tentpole properties:
+
+1. **Donated-state parity** — results on the donated fused paths are
+   bit-identical to the pre-donation eager path across shape churn, and a
+   fused step actually consumes (deletes) the previous state buffers when
+   the backend supports donation.
+2. **Cross-instance program cache** — a second instance of the same metric
+   class + config acquires the SAME compiled program and triggers ZERO new
+   program builds and ZERO new XLA compiles (counted via the shared jitted
+   callable's compiled-signature counter).
+3. **Donation safety rails** — registered default buffers are never donated
+   (reset() must stay restorable), compute() results that alias state
+   survive later donated steps, and aliased buffers (compute-group style)
+   fall back to the plain twin instead of tripping XLA's duplicate-donation
+   error.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine
+from metrics_tpu.utils import checks
+
+RNG = np.random.RandomState(7)
+
+
+@pytest.fixture(autouse=True)
+def _first_mode():
+    checks.set_validation_mode("first")
+    yield
+    checks.set_validation_mode("first")
+
+
+def _batches(n=6):
+    out = []
+    for i in range(n):
+        # shape churn: alternate between two batch sizes
+        size = 64 if i % 2 == 0 else 48
+        out.append(
+            (
+                jnp.asarray(RNG.rand(size).astype(np.float32)),
+                jnp.asarray(RNG.randint(0, 2, size)),
+            )
+        )
+    return out
+
+
+@pytest.mark.parametrize(
+    "factory,unary",
+    [
+        (lambda: mt.Accuracy(), False),
+        (lambda: mt.MeanMetric(), True),
+        (lambda: mt.SumMetric(), True),
+        (lambda: mt.MeanSquaredError(), False),
+    ],
+    ids=["Accuracy", "MeanMetric", "SumMetric", "MSE"],
+)
+def test_donated_path_bitwise_equals_pre_donation_path(factory, unary, monkeypatch):
+    """Donation is an aliasing policy, not a math change: the donated run
+    must be BIT-identical to the same sequence through the plain (pre-
+    donation) twin, across shape churn."""
+    batches = _batches()
+
+    def run(m):
+        for p, t in batches:
+            for _ in range(2):  # second same-signature call runs fused
+                m.update(p) if unary else m.update(p, t)
+        return np.asarray(m.compute())
+
+    donated = run(factory())
+
+    engine.reset_engine()
+    monkeypatch.setattr(engine, "_donation_supported", False)  # plain twins only
+    plain = run(factory())
+    np.testing.assert_array_equal(donated, plain)
+
+    # and the values agree with the fully-eager reference arm
+    checks.set_validation_mode("full")
+    eager = factory()
+    for p, t in batches:
+        for _ in range(2):
+            eager.update(p) if unary else eager.update(p, t)
+    assert eager._fused_update_program is None
+    np.testing.assert_allclose(donated, np.asarray(eager.compute()), rtol=1e-6)
+
+
+@pytest.mark.parametrize("api", ["update", "forward"])
+def test_fused_step_donates_state_buffers(api):
+    if not engine.donation_supported():
+        pytest.skip("backend does not consume donated buffers")
+    m = mt.SumMetric()
+    x = jnp.asarray(RNG.rand(32).astype(np.float32))
+    step = m.update if api == "update" else m
+    step(x)
+    step(x)  # signature licensed; next step runs fused
+    held = m.value
+    step(x)  # donates `held`
+    assert held.is_deleted(), "fused step did not donate the previous state buffer"
+    expected = 4 * float(np.asarray(x).sum()) if api == "update" else 4 * float(np.asarray(x).sum())
+    step(x)
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+
+def test_compute_result_survives_later_donated_steps():
+    m = mt.SumMetric()
+    x = jnp.asarray(RNG.rand(16).astype(np.float32))
+    m.update(x)
+    m.update(x)
+    v = m.compute()  # would alias the raw state buffer without decoupling
+    m.update(x)  # donated step deletes the old state buffer
+    m.update(x)
+    np.testing.assert_allclose(float(v), 2 * float(np.asarray(x).sum()), rtol=1e-5)
+
+
+def test_default_buffers_never_donated_reset_survives():
+    m = mt.SumMetric()
+    x = jnp.asarray(RNG.rand(16).astype(np.float32))
+    m.update(x)
+    m.update(x)
+    for _ in range(3):
+        m.reset()
+        # first post-reset update holds the DEFAULT buffer as live state and
+        # the signature is already licensed → the fused program runs at once;
+        # donating the default would delete it for every later reset
+        m.update(x)
+        m.update(x)
+    np.testing.assert_allclose(float(m.compute()), 2 * float(np.asarray(x).sum()), rtol=1e-5)
+
+
+class TestCrossInstanceCache:
+    def test_second_instance_compiles_zero_new_programs(self):
+        engine.reset_engine()
+        p = jnp.asarray(RNG.rand(64).astype(np.float32))
+        t = jnp.asarray(RNG.randint(0, 2, 64))
+
+        a = mt.Accuracy()
+        a.update(p, t)
+        a.update(p, t)
+        exe = a._fused_update_program
+        assert isinstance(exe, engine.Executable)
+        builds_after_first = engine.engine_stats()["builds"]
+        compiled_after_first = exe.compiled_signatures()
+        assert compiled_after_first >= 1
+
+        b = mt.Accuracy()  # same class + config
+        b.update(p, t)
+        b.update(p, t)
+        assert b._fused_update_program is exe, "second instance did not share the program"
+        assert engine.engine_stats()["builds"] == builds_after_first, "second instance built a new program"
+        assert exe.compiled_signatures() == compiled_after_first, "second instance triggered a new XLA compile"
+        # both instances accumulated independently through the shared program
+        assert float(a.compute()) == float(b.compute())
+
+    def test_different_config_gets_different_program(self):
+        engine.reset_engine()
+        p = jnp.asarray(RNG.rand(64, 4).astype(np.float32))
+        t = jnp.asarray(RNG.randint(0, 4, 64))
+        a = mt.Accuracy(num_classes=4, average="macro")
+        b = mt.Accuracy(num_classes=4, average="micro")
+        for _ in range(2):
+            a.update(p, t)
+            b.update(p, t)
+        assert a._fused_update_program is not b._fused_update_program
+
+    def test_collection_members_share_member_programs_across_suites(self):
+        engine.reset_engine()
+        p = jnp.asarray(RNG.rand(64).astype(np.float32))
+        t = jnp.asarray(RNG.randn(64).astype(np.float32))
+
+        def build():
+            return mt.MetricCollection({"mse": mt.MeanSquaredError(), "mae": mt.MeanAbsoluteError()})
+
+        c1 = build()
+        for _ in range(3):
+            c1(p, t)
+        builds = engine.engine_stats()["builds"]
+        c2 = build()
+        for _ in range(3):
+            c2(p, t)
+        assert engine.engine_stats()["builds"] == builds, "identical suite rebuilt its whole-suite program"
+        assert c2._fused_program is c1._fused_program
+        for k in c1.compute():
+            assert float(c1.compute()[k]) == float(c2.compute()[k])
+
+    def test_bootstrap_clone_fleet_shares_one_program(self):
+        engine.reset_engine()
+        p = jnp.asarray(RNG.randn(128).astype(np.float32))
+        t = jnp.asarray(RNG.randn(128).astype(np.float32))
+        b1 = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4, sampling_strategy="multinomial")
+        for _ in range(3):
+            b1.update(p, t)
+        builds = engine.engine_stats()["builds"]
+        assert b1._boot_program is not None
+        b2 = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4, sampling_strategy="multinomial")
+        for _ in range(3):
+            b2.update(p, t)
+        assert b2._boot_program is b1._boot_program
+        assert engine.engine_stats()["builds"] == builds
+
+    def test_hyperparameter_change_changes_fingerprint(self):
+        m1 = mt.Accuracy(threshold=0.5)
+        m2 = mt.Accuracy(threshold=0.7)
+        assert engine.config_fingerprint(m1) != engine.config_fingerprint(m2)
+        m2.threshold = 0.5
+        assert engine.config_fingerprint(m1) == engine.config_fingerprint(m2)
+
+    def test_long_array_hyperparameters_fingerprint_by_content(self):
+        """repr() truncates numpy arrays past 1000 elements — two metrics
+        differing only mid-array must NOT share a program (review finding:
+        the shared program would bake the first instance's thresholds)."""
+        t1 = np.linspace(0, 1, 2000).astype(np.float32)
+        t2 = t1.copy()
+        t2[1000] = 0.123456
+        m1 = mt.BinnedPrecisionRecallCurve(num_classes=1, thresholds=jnp.asarray(t1))
+        m2 = mt.BinnedPrecisionRecallCurve(num_classes=1, thresholds=jnp.asarray(t2))
+        assert engine.config_fingerprint(m1) != engine.config_fingerprint(m2)
+        m3 = mt.BinnedPrecisionRecallCurve(num_classes=1, thresholds=jnp.asarray(t1.copy()))
+        assert engine.config_fingerprint(m3) == engine.config_fingerprint(m1)
+
+    def test_cached_program_does_not_pin_acquiring_instance(self):
+        """Engine-cached step closures must not capture `self`: the global
+        cache would otherwise keep discarded instances (and their state
+        buffers) alive for the program's whole lifetime."""
+        import gc
+        import weakref
+
+        engine.reset_engine()
+        a = mt.Accuracy()
+        p = jnp.asarray(RNG.rand(64).astype(np.float32))
+        t = jnp.asarray(RNG.randint(0, 2, 64))
+        for _ in range(3):
+            a(p, t)  # fused forward built + cached through the engine
+        assert isinstance(a._fused_forward, engine.Executable)
+        ref = weakref.ref(a)
+        del a
+        gc.collect()
+        assert ref() is None, "cached program kept the dropped instance alive"
+        assert engine.engine_stats()["cached"] > 0  # the program itself survives
+
+
+class TestDonationSafetyRails:
+    def test_duplicate_buffers_take_plain_twin(self):
+        # compute-group style aliasing: the same buffer at two tree positions
+        # must NOT be donated (XLA raises on duplicate donation) — run() must
+        # silently fall back to the plain twin and produce correct values
+        leaf = jnp.asarray(3.0)
+        state = {"a": leaf, "b": leaf}
+        exe = engine.acquire_keyed(
+            ("test-dup", object()),  # unique key: never shared
+            lambda: (lambda st: {k: v + 1 for k, v in st.items()}, None, {}),
+        )
+        out = exe.run(state)
+        assert float(out["a"]) == 4.0 and float(out["b"]) == 4.0
+        assert not leaf.is_deleted()
+
+    def test_avoid_ids_blocks_donation(self):
+        if not engine.donation_supported():
+            pytest.skip("backend does not consume donated buffers")
+        leaf = jnp.asarray(1.0)
+        state = {"a": leaf}
+        exe = engine.acquire_keyed(
+            ("test-avoid", object()),
+            lambda: (lambda st: {k: v + 1 for k, v in st.items()}, None, {}),
+        )
+        exe.run(state, avoid_ids=frozenset([id(leaf)]))
+        assert not leaf.is_deleted()
+        exe.run({"a": jnp.asarray(2.0)})  # fresh strong-typed buffer: donatable
+
+    def test_state_intact_detects_deleted(self):
+        if not engine.donation_supported():
+            pytest.skip("backend does not consume donated buffers")
+        x = jnp.zeros((), jnp.float32)
+        f = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+        f(x)
+        assert not engine.state_intact({"a": x})
+        assert engine.state_intact({"a": jnp.zeros((), jnp.float32)})
+
+
+def test_second_untraceable_signature_declines_silently():
+    """Silent-decline contract (round-5 ADVICE): once a fused program is
+    licensed for one signature, a SECOND signature that cannot trace must
+    decline quietly — no runtime-failure warning, fused path kept for the
+    licensed signature."""
+    import warnings
+
+    class _Picky(mt.Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            if x.ndim == 2:
+                # value read: fine eagerly, untraceable under eval_shape
+                self.total = self.total + float(np.asarray(x).sum())
+            else:
+                self.total = self.total + x.sum()
+
+        def compute(self):
+            return self.total
+
+    m = _Picky()
+    vec = jnp.asarray(RNG.rand(16).astype(np.float32))
+    mat = jnp.asarray(RNG.rand(4, 4).astype(np.float32))
+    m.update(vec)
+    m.update(vec)  # 1-D signature licensed + fused
+    assert m._fused_update_program is not None
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # ANY warning fails the test
+        m.update(mat)  # first sight: eager (validates)
+        m.update(mat)  # would fuse; probe declines silently for THIS signature
+    # the licensed signature keeps its fused program and health flag
+    assert m._fused_update_ok is True
+    assert m._fused_update_program is not None
+    m.update(vec)  # still fused, still correct
+    assert m._update_count == 5
+    np.testing.assert_allclose(
+        float(m.compute()),
+        3 * float(np.asarray(vec).sum()) + 2 * float(np.asarray(mat).sum()),
+        rtol=1e-5,
+    )
+
+
+def test_lane_metrics_skip_program_cache_entirely():
+    """Append-only metrics ride the host fast lane, not the program cache."""
+    engine.reset_engine()
+    cm = mt.CatMetric()
+    x = jnp.asarray(RNG.rand(8).astype(np.float32))
+    for _ in range(5):
+        cm.update(x)
+    assert cm._update_lane is not None
+    assert engine.engine_stats()["builds"] == 0

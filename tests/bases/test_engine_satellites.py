@@ -1,0 +1,245 @@
+"""Round-5 advisor findings fixed alongside the dispatch engine, pinned.
+
+- BootStrapper prefetch: a ``sampling_strategy`` flip mid-stream must drop
+  the lookahead draw and rewind the RNG (a prefetched poisson COUNT matrix
+  must never be consumed as multinomial INDEX draws).
+- ``weighted_state_apply``: integer/count sum-states contract exactly in
+  their own dtype (the float32 path truncated past 2^24).
+- Per-owner eviction diagnostics: the "first"-mode cache-churn warning
+  names the churning instance and fires once per owner.
+- Host fast lane semantics: a new signature falls off the lane and gets the
+  full validated path; "full" mode disables lanes.
+- SQuAD host accumulation: pending totals fold into device states at every
+  observation surface (compute, state_dict, snapshot, forward).
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utils import checks
+from metrics_tpu.wrappers._fanout import weighted_state_apply
+
+
+@pytest.fixture(autouse=True)
+def _first_mode():
+    checks.set_validation_mode("first")
+    yield
+    checks.set_validation_mode("first")
+
+
+RNG = np.random.RandomState(11)
+
+
+class TestPrefetchStrategyFlip:
+    P = jnp.asarray(np.random.RandomState(21).rand(64).astype(np.float32))
+    T = jnp.asarray(np.random.RandomState(22).rand(64).astype(np.float32))
+
+    def _run(self, flip_after: int, fused: bool) -> list:
+        b = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4)
+        b._rng = np.random.RandomState(1234)
+        if not fused:
+            object.__setattr__(b, "_boot_ok", False)  # never prefetches
+        p, t = self.P, self.T
+        for i in range(flip_after):
+            b.update(p, t)
+        b.sampling_strategy = "multinomial"
+        for _ in range(2):
+            b.update(p, t)
+        return [np.asarray(m.metric_state["total"]) for m in b.metrics] + [
+            np.asarray(m.metric_state["sum_squared_error"]) for m in b.metrics
+        ]
+
+    def test_strategy_flip_drops_prefetch_and_rewinds_rng(self):
+        # enough poisson steps that the fused path ran and stored a lookahead
+        fused_states = self._run(flip_after=4, fused=True)
+        eager_states = self._run(flip_after=4, fused=False)
+        for f, e in zip(fused_states, eager_states):
+            np.testing.assert_allclose(f, e, rtol=1e-4, atol=1e-5)
+
+    def test_prefetch_tuple_carries_strategy(self):
+        b = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=2)
+        p = jnp.asarray(RNG.rand(32).astype(np.float32))
+        t = jnp.asarray(RNG.rand(32).astype(np.float32))
+        for _ in range(4):
+            b.update(p, t)
+        pf = b._boot_prefetch
+        assert pf is not None and pf[1] == "poisson"
+        b.sampling_strategy = "multinomial"
+        assert b._take_prefetch(32) is None  # strategy mismatch → dropped
+
+
+class TestWeightedIntegerExactness:
+    def test_count_state_exact_past_2_24(self):
+        big = 2**24 + 3  # not representable in float32
+        stacked = {"total": jnp.asarray([big], jnp.int32)}
+        deltas = {"total": jnp.asarray([1, 1], jnp.int32)}
+        weights = jnp.ones((1, 2), jnp.int32)
+        out = weighted_state_apply(stacked, deltas, weights)
+        assert int(out["total"][0]) == big + 2  # float32 would land on an even neighbor
+
+    def test_float_weights_round_into_integer_state(self):
+        big = 2**24 + 1
+        stacked = {"n": jnp.asarray([big], jnp.int32)}
+        deltas = {"n": jnp.asarray([1, 1, 1], jnp.int32)}
+        weights = jnp.asarray([[1.0, 0.0, 1.0]], jnp.float32)  # NaN-mask style
+        out = weighted_state_apply(stacked, deltas, weights)
+        assert int(out["n"][0]) == big + 2
+
+    def test_float_states_unchanged_semantics(self):
+        stacked = {"s": jnp.asarray([1.5], jnp.float32)}
+        deltas = {"s": jnp.asarray([0.25, 0.25], jnp.float32)}
+        weights = jnp.asarray([[2, 2]], jnp.int32)
+        out = weighted_state_apply(stacked, deltas, weights)
+        np.testing.assert_allclose(float(out["s"][0]), 2.5, rtol=1e-6)
+
+
+class TestPerOwnerEvictionDiagnostics:
+    def test_two_churning_instances_get_two_attributed_warnings(self, monkeypatch):
+        monkeypatch.setattr(checks, "_SEEN_KEYS_CAP", 4)
+        checks.set_validation_mode("first")
+        m1, m2 = mt.Accuracy(), mt.Accuracy()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for size in range(8, 28):  # 20 distinct signatures per instance
+                p = jnp.asarray(RNG.rand(size).astype(np.float32))
+                t = jnp.asarray(RNG.randint(0, 2, size))
+                m1.update(p, t)
+                m2.update(p, t)
+        texts = [str(w.message) for w in caught if "evicted more than" in str(w.message)]
+        assert len(texts) == 2, texts
+        assert all("`Accuracy`" in t for t in texts)
+        assert f"0x{id(m1):x}" in "".join(texts) and f"0x{id(m2):x}" in "".join(texts)
+        assert texts[0] != texts[1]  # distinct owners, distinct attributions
+
+    def test_quiet_instance_never_warns(self, monkeypatch):
+        monkeypatch.setattr(checks, "_SEEN_KEYS_CAP", 4)
+        checks.set_validation_mode("first")
+        churner, quiet = mt.Accuracy(), mt.Accuracy()
+        pq = jnp.asarray(RNG.rand(16).astype(np.float32))
+        tq = jnp.asarray(RNG.randint(0, 2, 16))
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for size in range(30, 50):
+                p = jnp.asarray(RNG.rand(size).astype(np.float32))
+                t = jnp.asarray(RNG.randint(0, 2, size))
+                churner.update(p, t)
+                quiet.update(pq, tq)
+        texts = [str(w.message) for w in caught if "evicted more than" in str(w.message)]
+        assert len(texts) == 1
+        assert f"0x{id(churner):x}" in texts[0]
+
+
+class TestHostLaneSemantics:
+    def test_new_signature_falls_off_lane_and_validates(self):
+        cm = mt.CatMetric(nan_strategy="error")
+        x = jnp.asarray(RNG.rand(8).astype(np.float32))
+        cm.update(x)
+        cm.update(x)
+        assert cm._update_lane is not None
+        bad = jnp.asarray(np.asarray([1.0, np.nan, 3.0], np.float32))
+        with pytest.raises(RuntimeError, match="nan"):
+            cm.update(bad)  # new signature → full path → "first"-mode check fires
+
+    def test_full_mode_disables_lane(self):
+        cm = mt.CatMetric()
+        x = jnp.asarray(RNG.rand(8).astype(np.float32))
+        cm.update(x)
+        cm.update(x)
+        assert cm._update_lane is not None
+        checks.set_validation_mode("full")
+        cm.update(x)  # generation bump kills the lane
+        assert cm._update_lane is None
+
+    def test_lane_values_match_full_path(self):
+        lane_m = mt.CatMetric()
+        x1 = jnp.asarray(RNG.rand(8).astype(np.float32))
+        x2 = jnp.asarray(RNG.rand(8).astype(np.float32))
+        for x in (x1, x2, x1, x2):
+            lane_m.update(x)
+        checks.set_validation_mode("full")
+        full_m = mt.CatMetric()
+        for x in (x1, x2, x1, x2):
+            full_m.update(x)
+        assert full_m._update_lane is None
+        np.testing.assert_array_equal(
+            np.asarray(lane_m.compute()), np.asarray(full_m.compute())
+        )
+
+    def test_retrieval_lane_matches_full_path(self):
+        p = jnp.asarray(RNG.rand(32).astype(np.float32))
+        t = jnp.asarray((RNG.rand(32) > 0.6).astype(np.int32))
+        i = jnp.asarray(np.repeat(np.arange(8), 4).astype(np.int64))
+        lane_m = mt.RetrievalMRR()
+        for _ in range(4):
+            lane_m.update(p, t, i)
+        checks.set_validation_mode("full")
+        full_m = mt.RetrievalMRR()
+        for _ in range(4):
+            full_m.update(p, t, i)
+        assert full_m._update_lane is None
+        np.testing.assert_allclose(float(lane_m.compute()), float(full_m.compute()), rtol=1e-6)
+
+    def test_hyperparameter_change_kills_lane(self):
+        cm = mt.CatMetric()
+        x = jnp.asarray(RNG.rand(8).astype(np.float32))
+        cm.update(x)
+        cm.update(x)
+        assert cm._update_lane is not None
+        cm.nan_strategy = "ignore"
+        assert cm._update_lane is None  # closure baked the old gate
+
+    def test_compute_on_cpu_bypasses_lane(self):
+        """Toggling compute_on_cpu after a lane installed must keep the
+        per-update host offload running (review finding: the lane skipped
+        _move_list_states_to_host)."""
+        cm = mt.CatMetric()
+        x = jnp.asarray(RNG.rand(8).astype(np.float32))
+        cm.update(x)
+        cm.update(x)
+        assert cm._update_lane is not None
+        cm.compute_on_cpu = True
+        cm.update(x)
+        assert all(isinstance(v, np.ndarray) for v in cm.value)
+
+
+class TestSquadHostAccumulation:
+    PREDS = [{"prediction_text": "london", "id": "q0"}]
+    TARGET = [{"answers": {"answer_start": [0], "text": ["london"]}, "id": "q0"}]
+
+    def test_states_fold_at_observation(self):
+        sq = mt.SQuAD()
+        for _ in range(3):
+            sq.update(self.PREDS, self.TARGET)
+        assert sq._pending is not None  # still buffered on host
+        out = {k: float(v) for k, v in sq.compute().items()}
+        assert out == {"exact_match": 100.0, "f1": 100.0}
+        assert sq._pending is None
+        assert int(sq.total) == 3
+
+    def test_state_dict_sees_pending(self):
+        sq = mt.SQuAD()
+        sq.persistent(True)
+        sq.update(self.PREDS, self.TARGET)
+        sd = sq.state_dict()
+        assert int(sd["total"]) == 1
+
+    def test_forward_matches_reference_contract(self):
+        sq = mt.SQuAD()
+        batch_val = sq(self.PREDS, self.TARGET)
+        assert round(float(batch_val["f1"]), 1) == 100.0
+        sq.update(self.PREDS, self.TARGET)
+        assert int(sq.compute()["exact_match"]) == 100
+        assert sq._update_count == 2
+
+    def test_reset_clears_pending(self):
+        sq = mt.SQuAD()
+        sq.update(self.PREDS, self.TARGET)
+        sq.reset()
+        assert sq._pending is None
+        sq.update(self.PREDS, self.TARGET)
+        assert int(sq.metric_state["total"]) == 1
