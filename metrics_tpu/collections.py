@@ -17,7 +17,13 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.metric import Metric, _propagate_static_attrs
+from metrics_tpu.metric import (
+    Metric,
+    _DeferProbeDecline,
+    _leaves_jittable,
+    _probe_traceable,
+    _propagate_static_attrs,
+)
 from metrics_tpu.ops import engine as _engine
 from metrics_tpu.utils.data import _flatten_dict, allclose
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -75,8 +81,14 @@ class MetricCollection:
         forwards), the whole collection runs as ONE jitted program per step:
         each member's batch update + batch value + state merge, with XLA
         CSE sharing the canonicalization work across members — the module-API
-        analogue of the ``as_functions`` whole-suite export.
+        analogue of the ``as_functions`` whole-suite export. With deferred
+        dispatch enabled (the default under validation mode "first"), steps
+        enqueue instead and the suite flushes as one stacked scan covering
+        the whole queue — the returned dict holds lazy per-member handles.
         """
+        deferred = self._defer_forward(args, kwargs)
+        if deferred is not None:
+            return deferred
         fused = self._forward_fused(*args, **kwargs)
         if fused is not None:
             return fused
@@ -217,6 +229,402 @@ class MetricCollection:
         res = _flatten_dict(values)
         return {self._set_name(k): v for k, v in res.items()}
 
+    # ------------------------------------------- deferred micro-batched dispatch
+    # Collection-level queue: whole-suite steps enqueue and flush as ONE
+    # stacked scan across every member (update: across compute-group
+    # leaders), sharing the engine-cached collection scan programs. Member
+    # state attrs are popped into the queue's backing while pending, so any
+    # member observation (compute, sync, pickling, direct state access)
+    # flushes the WHOLE suite queue in enqueue order.
+    _defer_pending = None
+    _defer_ok: bool = True
+    _defer_suspended: bool = False
+    _defer_fwd_flat: Optional[dict] = None  # signature -> member values are arrays
+    _defer_probed: Optional[set] = None  # (kind, layout) pairs that passed eval_shape
+
+    def _defer_probe(self, kind: str, layout, program, *probe_args) -> None:
+        """eval_shape the suite flush program once per (kind, layout); an
+        untraceable one raises :class:`_DeferProbeDecline` → silent eager
+        replay (same silent-decline contract as the per-call paths)."""
+        if self._defer_probed is None:
+            self._defer_probed = set()
+        key = (kind, layout)
+        if key in self._defer_probed:
+            return
+        if not _probe_traceable(program, *probe_args):
+            raise _DeferProbeDecline()
+        self._defer_probed.add(key)
+
+    def _defer_barrier(self) -> None:
+        q = self.__dict__.get("_defer_pending")
+        if q is not None:
+            q.flush()
+
+    def _defer_forward(self, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:
+        from metrics_tpu.ops.engine import LazyValue, defer_enabled, note_deferred_steps
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        if not (
+            defer_enabled()
+            and self._defer_ok
+            and not self._defer_suspended
+            and not self._fused_disabled
+        ):
+            return None
+        q = self.__dict__.get("_defer_pending")
+        fast = q is not None and q.kind == "collection-forward"
+        if fast:
+            members, consumed_names, raw_names = q.meta
+            # the kwarg-name set must match the queue's opening call: a
+            # NEW (or dropped) kwarg — even one some member only optionally
+            # consumes — and a validation-mode switch both re-run the full
+            # slow-path eligibility, so no argument is silently dropped and
+            # "full" regains per-call validation immediately
+            if frozenset(kwargs) != raw_names or _get_validation_mode() == "full":
+                q.flush()
+                fast = False
+            else:
+                consumed = {k: v for k, v in kwargs.items() if k in consumed_names}
+                signature = Metric._forward_signature(args, consumed)
+                if not q.matches("collection-forward", signature):
+                    q.flush()
+                    fast = False
+        if not fast:
+            # slow path: full eligibility check (mirrors _forward_fused), run
+            # only when a fresh queue must be opened
+            if _get_validation_mode() == "full":
+                return None
+            members = list(self.items(keep_base=True, copy_state=False))
+            if (
+                not members
+                or any(
+                    not (m._fused_forward_ok and m._defer_ok and m._fusable_states())
+                    for _, m in members
+                )
+                or any(
+                    m.full_state_update or m.full_state_update is None or m.dist_sync_on_step
+                    for _, m in members
+                )
+                or any(m._is_synced for _, m in members)
+                or len({m._update_count for _, m in members}) != 1
+                or len({id(m) for _, m in members}) != len(members)
+            ):
+                return None
+            consumed = {}
+            for _, m in members:
+                consumed.update(m._filter_kwargs(**kwargs))
+            if not _leaves_jittable((args, consumed)) or not Metric._defer_stackable(args, consumed):
+                return None
+            signature = Metric._forward_signature(args, consumed)
+            if self._fused_seen is None or signature not in self._fused_seen:
+                return None  # first sight stays member-wise eager (validated)
+            if self._defer_fwd_flat is None:
+                self._defer_fwd_flat = {}
+            flat = self._defer_fwd_flat.get(signature)
+            if flat is None:
+                # forcing is a one-time-per-signature cost: member batch
+                # values must be plain arrays for the lazy per-member handles
+                # to carry the same keys as the eager flattened result
+                def _is_array(v):
+                    if isinstance(v, LazyValue):
+                        v = v._force()
+                    return isinstance(v, jax.Array)
+
+                flat = all(_is_array(m._forward_cache) for _, m in members)
+                self._defer_fwd_flat[signature] = flat
+            if not flat:
+                return None
+            # member-level pending work must materialize before this queue
+            # takes ownership of the member states
+            for _, m in members:
+                m._defer_barrier()
+            from metrics_tpu.ops.engine import PendingQueue
+
+            q = PendingQueue("collection-forward", signature, self._flush_forward_deferred)
+            q.meta = (members, frozenset(consumed), frozenset(kwargs))
+            q.adopt(self, ())
+            for _, m in members:
+                q.adopt(m, m._defaults)
+        handles = {}
+        for name, m in members:
+            h = LazyValue(q)
+            handles[name] = h
+            m._update_count += 1
+            m._is_synced = False
+            m._should_unsync = True
+            m._to_sync = m.sync_on_compute
+            m._computed = None
+            object.__setattr__(m, "_forward_cache", h)
+        q.entries.append((args, consumed))
+        q.handles.append(handles)
+        note_deferred_steps(1)
+        if q.should_flush():
+            q.flush()
+        return {self._set_name(name): handles[name] for name, _ in members}
+
+    def _defer_update(self, args: tuple, kwargs: dict) -> bool:
+        """Enqueue one whole-suite ``update`` across compute-group leaders;
+        False when ineligible (caller runs the member-wise path)."""
+        from metrics_tpu.ops.engine import PendingQueue, defer_enabled, note_deferred_steps
+        from metrics_tpu.utils.checks import _get_validation_mode
+
+        if not (
+            defer_enabled()
+            and self._defer_ok
+            and not self._defer_suspended
+            and self._groups_checked
+            and not self._state_is_copy
+        ):
+            return False
+        q = self.__dict__.get("_defer_pending")
+        leaders = [(cg[0], self._modules[cg[0]]) for cg in self._groups.values()]
+        fast = q is not None and q.kind == "collection-update"
+        if fast:
+            consumed_names, raw_names = q.meta[1], q.meta[2]
+            # see _defer_forward: a changed raw-kwarg set or a switch to
+            # "full" must leave the fast path (no silent kwarg drops, no
+            # stale validation regime)
+            if frozenset(kwargs) != raw_names or _get_validation_mode() == "full":
+                q.flush()
+                fast = False
+            else:
+                consumed = {k: v for k, v in kwargs.items() if k in consumed_names}
+                signature = Metric._forward_signature(args, consumed)
+                if not q.matches("collection-update", signature):
+                    q.flush()
+                    fast = False
+        if not fast:
+            if _get_validation_mode() == "full" or not leaders:
+                return False
+            consumed = {}
+            for _, m in leaders:
+                consumed.update(m._filter_kwargs(**kwargs))
+            if not _leaves_jittable((args, consumed)) or not Metric._defer_stackable(args, consumed):
+                return False
+            for _, m in leaders:
+                if not (m._fused_update_ok and m._defer_ok and m._fusable_states()):
+                    return False
+                sig = ("__update__", Metric._forward_signature(args, m._filter_kwargs(**kwargs)))
+                if m._fused_seen_signatures is None or sig not in m._fused_seen_signatures:
+                    return False  # first sight per leader stays eager-validated
+            signature = Metric._forward_signature(args, consumed)
+            for _, m in leaders:
+                m._defer_barrier()
+            q = PendingQueue("collection-update", signature, self._flush_update_deferred)
+            q.meta = (leaders, frozenset(consumed), frozenset(kwargs))
+            q.adopt(self, ())
+            for _, m in leaders:
+                q.adopt(m, m._defaults)
+        q.entries.append((args, consumed))
+        q.handles.append(None)
+        note_deferred_steps(1)
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            m0._update_count += 1
+            m0._computed = None
+            for name in cg[1:]:
+                mi = self._modules[name]
+                mi._update_count = m0._update_count
+                mi._computed = None
+        if q.should_flush():
+            q.flush()
+        return True
+
+    def _repoint_groups(self) -> None:
+        """Re-point group members at their (just-flushed) leader states —
+        the flush-time analogue of ``_compute_groups_create_state_ref``,
+        which must not run while leader states sit in a queue backing."""
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            for name in cg[1:]:
+                mi = self._modules[name]
+                for state in m0._defaults:
+                    object.__setattr__(mi, state, m0.__dict__.get(state))
+
+    def _flush_update_deferred(self, q) -> None:
+        from metrics_tpu.ops import engine as _eng
+
+        leaders = q.meta[0]
+        entries = q.entries
+        states = {
+            name: {s: q.backing[id(m)][s] for s in m._defaults} for name, m in leaders
+        }
+        applied = 0  # advanced only after a chunk's program ran: a failure
+        # while preparing the next chunk must not double-apply the previous
+        templates = None
+        object.__setattr__(self, "_defer_suspended", True)
+        try:
+            try:
+                for (offset, chunk_len, layout, python_leaves, treedef, scanned_idx,
+                     aconst_idx, scanned, aconsts) in leaders[0][1]._deferred_chunks(entries):
+
+                    def build(pl=python_leaves, td=treedef, si=scanned_idx, ai=aconst_idx):
+                        def _build():
+                            tmpl = {name: m._bare_clone() for name, m in leaders}
+                            filters = {name: tmpl[name]._filter_kwargs for name in tmpl}
+
+                            def scan_program(states, xs, const_vals):
+                                def body(st, xs_leaves):
+                                    step_leaves = list(pl)
+                                    for i, leaf in zip(si, xs_leaves):
+                                        step_leaves[i] = leaf
+                                    for i, leaf in zip(ai, const_vals):
+                                        step_leaves[i] = leaf
+                                    a, k = jax.tree.unflatten(td, step_leaves)
+                                    new = {}
+                                    for name, template in tmpl.items():
+                                        mm = template._bare_clone()
+                                        mm._restore_state(st[name])
+                                        mm._inner_update(*a, **filters[name](**k))
+                                        _propagate_static_attrs(mm, template)
+                                        new[name] = mm._state_snapshot()
+                                    return new, 0
+
+                                final, _ = jax.lax.scan(body, states, xs)
+                                return final
+
+                            return scan_program, tmpl, {}
+
+                        return _build
+
+                    exe = _eng.acquire_keyed(
+                        ("collection-deferred-update", layout)
+                        + tuple((name, _eng.config_fingerprint(m)) for name, m in leaders),
+                        build(),
+                    )
+                    self._defer_probe("collection-update", layout, exe, states, scanned, aconsts)
+                    templates = exe.template
+                    states = exe.run(
+                        states,
+                        (scanned, aconsts),
+                        avoid_ids=frozenset().union(*(m._default_leaf_ids() for _, m in leaders)),
+                    )
+                    applied = offset + chunk_len
+            except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
+                if not _eng.state_intact(states):
+                    raise RuntimeError(
+                        f"Deferred suite update flush failed after donating member state "
+                        f"buffers ({type(exc).__name__}: {exc}); the accumulated states "
+                        "are unrecoverable — construct a fresh collection."
+                    ) from exc
+                q.release()
+                for name, m in leaders:
+                    for s, v in states[name].items():
+                        object.__setattr__(m, s, v)
+                    m._update_count -= len(entries) - applied
+                self._repoint_groups()
+                object.__setattr__(self, "_defer_ok", False)
+                if not isinstance(exc, _DeferProbeDecline):
+                    rank_zero_warn(
+                        f"Deferred suite update flush raised {type(exc).__name__}: {exc}. "
+                        "Replaying the queue eagerly and disabling deferred dispatch for "
+                        "this collection."
+                    )
+                _eng.note_deferred_flush(fallback=True)
+                # suspend the leaders so the replay fully materializes
+                # instead of re-enqueueing into member-level queues
+                for _, m in leaders:
+                    object.__setattr__(m, "_defer_suspended", True)
+                try:
+                    for a, k in entries[applied:]:
+                        for cg in self._groups.values():
+                            m0 = self._modules[cg[0]]
+                            m0.update(*a, **m0._filter_kwargs(**k))
+                            for name in cg[1:]:
+                                mi = self._modules[name]
+                                mi._update_count = m0._update_count
+                                mi._computed = None
+                finally:
+                    for _, m in leaders:
+                        object.__setattr__(m, "_defer_suspended", False)
+                return
+            q.release()
+            for name, m in leaders:
+                for s, v in states[name].items():
+                    object.__setattr__(m, s, v)
+                if templates is not None:
+                    _propagate_static_attrs(templates[name], m)
+            self._repoint_groups()
+            _eng.note_deferred_flush()
+        finally:
+            object.__setattr__(self, "_defer_suspended", False)
+
+    def _flush_forward_deferred(self, q) -> None:
+        from metrics_tpu.ops import engine as _eng
+
+        members = q.meta[0]
+        entries = q.entries
+        handles = q.handles
+        count0 = members[0][1]._update_count - len(entries)
+        states = {
+            name: {s: q.backing[id(m)][s] for s in m._defaults} for name, m in members
+        }
+        applied = 0  # see _flush_update_deferred: never double-apply a chunk
+        templates = None
+        object.__setattr__(self, "_defer_suspended", True)
+        try:
+            try:
+                for (offset, chunk_len, layout, python_leaves, treedef, scanned_idx,
+                     aconst_idx, scanned, aconsts) in members[0][1]._deferred_chunks(entries):
+                    exe = self._acquire_collection_many_program(
+                        True, layout, members, python_leaves, treedef, scanned_idx, aconst_idx
+                    )
+                    self._defer_probe(
+                        "collection-forward", layout, exe, states, count0 + offset, scanned, aconsts
+                    )
+                    templates = exe.template
+                    states, values = exe.run(
+                        states,
+                        (count0 + offset, scanned, aconsts),
+                        avoid_ids=frozenset().union(*(m._default_leaf_ids() for _, m in members)),
+                    )
+                    for j in range(chunk_len):
+                        for name, _ in members:
+                            handles[offset + j][name]._set_chunk(values[name], j)
+                    applied = offset + chunk_len
+            except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
+                if not _eng.state_intact(states):
+                    raise RuntimeError(
+                        f"Deferred suite forward flush failed after donating member state "
+                        f"buffers ({type(exc).__name__}: {exc}); the accumulated states "
+                        "are unrecoverable — construct a fresh collection."
+                    ) from exc
+                q.release()
+                for name, m in members:
+                    for s, v in states[name].items():
+                        object.__setattr__(m, s, v)
+                    m._update_count = count0 + applied
+                object.__setattr__(self, "_defer_ok", False)
+                if not isinstance(exc, _DeferProbeDecline):
+                    rank_zero_warn(
+                        f"Deferred suite forward flush raised {type(exc).__name__}: {exc}. "
+                        "Replaying the queue eagerly and disabling deferred dispatch for "
+                        "this collection."
+                    )
+                _eng.note_deferred_flush(fallback=True)
+                for _, m in members:
+                    object.__setattr__(m, "_defer_suspended", True)
+                try:
+                    for j in range(applied, len(entries)):
+                        a, k = entries[j]
+                        for name, m in members:
+                            val = m._forward_reduce_state_update_eager(*a, **m._filter_kwargs(**k))
+                            object.__setattr__(m, "_forward_cache", val)
+                            handles[j][name]._set_value(val)
+                finally:
+                    for _, m in members:
+                        object.__setattr__(m, "_defer_suspended", False)
+                return
+            q.release()
+            for name, m in members:
+                for s, v in states[name].items():
+                    object.__setattr__(m, s, v)
+                if templates is not None:
+                    _propagate_static_attrs(templates[name], m)
+            _eng.note_deferred_flush()
+        finally:
+            object.__setattr__(self, "_defer_suspended", False)
+
     # ------------------------------------------------- batched-step (scan) API
     # program/template/layout per with_values flavor (True/False): alternating
     # update_many and forward_many must not recompile the most expensive
@@ -226,6 +634,48 @@ class MetricCollection:
     _many_layouts: Optional[Dict[bool, tuple]] = None
     _many_versions: Optional[Dict[str, int]] = None
     _many_ok: bool = True  # batched-path health; independent of _fused_disabled
+
+    def _acquire_collection_many_program(
+        self, with_values: bool, layout, members, python_leaves, treedef, scanned_idx, aconst_idx
+    ):
+        """Fetch (or build once) the whole-suite scan program for one call
+        layout — shared by the batched-step API AND the deferred suite-queue
+        flush (same engine cache key, one compiled program)."""
+
+        def build():
+            steps, templates = {}, {}
+            for name, m in members:
+                templates[name], steps[name] = m._build_fused_step()
+            member_filters = {name: templates[name]._filter_kwargs for name in templates}
+
+            def program(states, update_count, xs, const_vals):
+                def body(carry, xs_leaves):
+                    st, cnt = carry
+                    cnt = cnt + 1
+                    step_leaves = list(python_leaves)
+                    for i, leaf in zip(scanned_idx, xs_leaves):
+                        step_leaves[i] = leaf
+                    for i, leaf in zip(aconst_idx, const_vals):
+                        step_leaves[i] = leaf
+                    a, k = jax.tree.unflatten(treedef, step_leaves)
+                    new_states, vals = {}, {}
+                    for name, step in steps.items():
+                        filtered = member_filters[name](**k)
+                        new_states[name], vals[name] = step(st[name], cnt, *a, **filtered)
+                    return (new_states, cnt), (vals if with_values else 0)
+
+                (final, _), vals = jax.lax.scan(
+                    body, (states, jnp.asarray(update_count, jnp.int32)), xs
+                )
+                return final, vals
+
+            return program, templates, {}
+
+        return _engine.acquire_keyed(
+            ("collection-many", with_values, layout)
+            + tuple((name, _engine.config_fingerprint(m)) for name, m in members),
+            build,
+        )
 
     def update_many(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate a CHUNK of steps into every member in ONE dispatch
@@ -240,6 +690,8 @@ class MetricCollection:
     def _run_many(self, with_values: bool, args: tuple, kwargs: dict) -> Any:
         from metrics_tpu.utils.checks import _get_validation_mode
 
+        # a chunk call applies AFTER any deferred per-step suite calls
+        self._defer_barrier()
         members = list(self.items(keep_base=True, copy_state=False))
         eligible = (
             self._many_ok
@@ -289,40 +741,8 @@ class MetricCollection:
             if with_values in self._many_programs and self._many_layouts.get(with_values) != layout:
                 del self._many_programs[with_values]
             if with_values not in self._many_programs:
-
-                def build():
-                    steps, templates = {}, {}
-                    for name, m in members:
-                        templates[name], steps[name] = m._build_fused_step()
-                    member_filters = {name: templates[name]._filter_kwargs for name in templates}
-
-                    def program(states, update_count, xs, const_vals):
-                        def body(carry, xs_leaves):
-                            st, cnt = carry
-                            cnt = cnt + 1
-                            step_leaves = list(python_leaves)
-                            for i, leaf in zip(scanned_idx, xs_leaves):
-                                step_leaves[i] = leaf
-                            for i, leaf in zip(aconst_idx, const_vals):
-                                step_leaves[i] = leaf
-                            a, k = jax.tree.unflatten(treedef, step_leaves)
-                            new_states, vals = {}, {}
-                            for name, step in steps.items():
-                                filtered = member_filters[name](**k)
-                                new_states[name], vals[name] = step(st[name], cnt, *a, **filtered)
-                            return (new_states, cnt), (vals if with_values else 0)
-
-                        (final, _), vals = jax.lax.scan(
-                            body, (states, jnp.asarray(update_count, jnp.int32)), xs
-                        )
-                        return final, vals
-
-                    return program, templates, {}
-
-                exe = _engine.acquire_keyed(
-                    ("collection-many", with_values, layout)
-                    + tuple((name, _engine.config_fingerprint(m)) for name, m in members),
-                    build,
+                exe = self._acquire_collection_many_program(
+                    with_values, layout, members, python_leaves, treedef, scanned_idx, aconst_idx
                 )
                 self._many_programs[with_values] = exe
                 self._many_templates[with_values] = exe.template
@@ -412,7 +832,13 @@ class MetricCollection:
         return jax.tree.map(lambda *xs: jnp.stack(xs), *values)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update every metric (or just each compute-group leader)."""
+        """Update every metric (or just each compute-group leader).
+
+        With deferred dispatch on, steady-state calls enqueue into ONE
+        suite-level queue that flushes as a single stacked scan program
+        across the compute-group leaders."""
+        if self._defer_update(args, kwargs):
+            return
         if self._groups_checked:
             for cg in self._groups.values():
                 m0 = self._modules[cg[0]]
@@ -610,6 +1036,9 @@ class MetricCollection:
         else:
             raise ValueError("Unknown input to MetricCollection.")
 
+        # membership changed: pending suite work was enqueued against the old
+        # member set and must materialize before the groups re-derive
+        self._defer_barrier()
         self._groups_checked = False
         if self._enable_compute_groups:
             self._init_compute_groups()
@@ -618,8 +1047,18 @@ class MetricCollection:
 
     def __getstate__(self) -> Dict[str, Any]:
         # the fused whole-suite program is a jit closure: unpicklable and not
-        # deepcopy-able — dropped here, rebuilt lazily on the next forward
-        drop = ("_fused_program", "_fused_templates", "_many_programs", "_many_templates", "_many_layouts")
+        # deepcopy-able — dropped here, rebuilt lazily on the next forward.
+        # Serialization observes: any pending suite queue flushes first.
+        self._defer_barrier()
+        drop = (
+            "_fused_program",
+            "_fused_templates",
+            "_many_programs",
+            "_many_templates",
+            "_many_layouts",
+            "_defer_pending",
+            "_defer_probed",
+        )
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:

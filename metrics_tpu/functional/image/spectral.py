@@ -121,12 +121,13 @@ def spectral_angle_mapper(
     """Per-pixel spectral angle between band vectors.
 
     Example:
-        >>> import jax
+        >>> import jax.numpy as jnp
         >>> from metrics_tpu.functional import spectral_angle_mapper
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 16, 16))
-        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (8, 3, 16, 16))
-        >>> spectral_angle_mapper(preds, target).round(2)
-        Array(0.58, dtype=float32)
+        >>> grid = jnp.arange(8 * 3 * 16 * 16, dtype=jnp.float32)
+        >>> preds = (jnp.sin(grid) * 0.5 + 0.5).reshape(8, 3, 16, 16)
+        >>> target = (jnp.cos(grid) * 0.5 + 0.5).reshape(8, 3, 16, 16)
+        >>> round(float(spectral_angle_mapper(preds, target)), 4)
+        0.8221
     """
     preds, target = _image_update(preds, target)
     if preds.shape[1] <= 1:
@@ -147,12 +148,13 @@ def spectral_distortion_index(
     """D-lambda: distance between band-pair UQI matrices of preds vs target.
 
     Example:
-        >>> import jax
+        >>> import jax.numpy as jnp
         >>> from metrics_tpu.functional import spectral_distortion_index
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 16, 16))
-        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (2, 3, 16, 16))
+        >>> grid = jnp.arange(2 * 3 * 16 * 16, dtype=jnp.float32)
+        >>> preds = ((grid * 17) % 23 / 23.0).reshape(2, 3, 16, 16)
+        >>> target = ((grid * 7) % 19 / 19.0).reshape(2, 3, 16, 16)
         >>> round(float(spectral_distortion_index(preds, target)), 4)
-        0.0595
+        0.211
     """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")

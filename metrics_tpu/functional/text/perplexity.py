@@ -51,12 +51,13 @@ def perplexity(preds: jax.Array, target: jax.Array, ignore_index: Optional[int] 
     """exp(mean NLL) over non-ignored tokens.
 
     Example:
-        >>> import jax, jax.numpy as jnp
+        >>> import jax.numpy as jnp
         >>> from metrics_tpu.functional import perplexity
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
-        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
-        >>> perplexity(preds, target, ignore_index=None).round(4)
-        Array(4.9989, dtype=float32)
+        >>> grid = jnp.arange(2 * 8 * 5, dtype=jnp.float32)
+        >>> preds = (jnp.sin(grid) * 0.5 + 0.5).reshape(2, 8, 5)
+        >>> target = (jnp.arange(2 * 8, dtype=jnp.int32).reshape(2, 8) * 3) % 5
+        >>> round(float(perplexity(preds, target, ignore_index=None)), 4)
+        5.3981
     """
     total, count = _perplexity_update(preds, target, ignore_index)
     return _perplexity_compute(total, count)

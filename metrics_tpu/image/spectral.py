@@ -93,13 +93,14 @@ class SpectralAngleMapper(_CatImageMetric):
     """Mean spectral angle between band vectors.
 
     Example:
-        >>> import jax
+        >>> import jax.numpy as jnp
         >>> from metrics_tpu import SpectralAngleMapper
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (8, 3, 16, 16))
-        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (8, 3, 16, 16))
+        >>> grid = jnp.arange(8 * 3 * 16 * 16, dtype=jnp.float32)
+        >>> preds = (jnp.sin(grid) * 0.5 + 0.5).reshape(8, 3, 16, 16)
+        >>> target = (jnp.cos(grid) * 0.5 + 0.5).reshape(8, 3, 16, 16)
         >>> sam = SpectralAngleMapper()
-        >>> sam(preds, target).round(2)
-        Array(0.58, dtype=float32)
+        >>> round(float(sam(preds, target)), 4)
+        0.8221
     """
 
     higher_is_better = False

@@ -81,6 +81,15 @@ def _leaves_jittable(tree: Any) -> bool:
     return True
 
 
+class _DeferProbeDecline(Exception):
+    """Internal: a deferred-flush scan program failed its eval_shape probe.
+
+    Routed to the eager-replay fallback WITHOUT a warning — an untraceable
+    configuration is supported, not an anomaly (the same silent-decline
+    contract as the per-call fused paths); only post-probe runtime failures
+    warn."""
+
+
 _checks_cached = None
 
 
@@ -215,6 +224,11 @@ class Metric(ABC):
             "_has_update_lane_hook",
             type(self)._build_update_lane is not Metric._build_update_lane,
         )
+        object.__setattr__(
+            self,
+            "_has_host_pending_hook",
+            type(self)._host_pending_flush is not Metric._host_pending_flush,
+        )
 
         # A subclass that leaves `full_state_update` unset silently takes the
         # two-update slow path in forward AND never engages the fused
@@ -281,7 +295,13 @@ class Metric(ABC):
 
     @property
     def metric_state(self) -> Dict[str, Any]:
-        """Current state pytree (name -> array or list of arrays)."""
+        """Current state pytree (name -> array or list of arrays).
+
+        An observation point: any pending deferred micro-batch flushes first
+        (see :meth:`_defer_barrier`), so the returned values always reflect
+        every ``update``/``forward`` call issued so far.
+        """
+        self._defer_barrier()
         return {name: getattr(self, name) for name in self._defaults}
 
     def _state_snapshot(self) -> Dict[str, Any]:
@@ -358,6 +378,19 @@ class Metric(ABC):
                 signature = ("__update__", self._forward_signature(args, kwargs))
                 run_fused = False
                 if signature in self._fused_seen_signatures:
+                    # deferred micro-batched dispatch: an eager-validated
+                    # signature enqueues instead of dispatching — the queue
+                    # flushes as ONE stacked lax.scan program at the size/age
+                    # threshold or at the next state observation.
+                    # METRICS_TPU_DEFER=0 restores the per-call dispatch.
+                    if (
+                        self._defer_ok
+                        and not self._defer_suspended
+                        and _engine.defer_enabled()
+                        and self._defer_stackable(args, kwargs)
+                    ):
+                        self._defer_enqueue_update(signature, args, kwargs)
+                        return
                     state = {name: getattr(self, name) for name in self._defaults}
                     program = self._fused_update_program
                     if program is None:
@@ -549,7 +582,60 @@ class Metric(ABC):
     _update_lane: Optional[Callable] = None
     _has_update_lane_hook: bool = False
 
+    # deferred micro-batched dispatch (engine.PendingQueue): while a queue is
+    # pending the state attributes live in the queue's backing store, not in
+    # __dict__, so ANY state access lands in __getattr__ and flushes — the
+    # observation barrier is total by construction. _defer_ok is the
+    # per-instance health flag (a failed flush replays eagerly and disables
+    # deferral permanently, degrading to the PR-1 per-call fused dispatch);
+    # _defer_suspended blocks re-enqueueing while a flush is replaying.
+    _defer_pending: Optional["_engine.PendingQueue"] = None
+    _defer_ok: bool = True
+    _defer_suspended: bool = False
+
     _fusable_cached: Optional[bool] = None
+
+    # ------------------------------------------- deferred dispatch barriers
+    def _defer_barrier(self) -> None:
+        """Flush any pending deferred micro-batch, then fold any host-side
+        pending buffer (:meth:`_host_pending_flush`) — the ONE observation
+        hook every state-materializing surface routes through."""
+        q = self.__dict__.get("_defer_pending")
+        if q is not None:
+            q.flush()
+        if self._has_host_pending_hook:
+            self._host_pending_flush()
+
+    def _host_pending_flush(self) -> None:
+        """Hook: fold host-staged pending accumulation into device state.
+
+        Append-only metrics that buffer host scalars between observations
+        (``SQuAD``'s EM/F1 counters) override this; the base class is a
+        no-op. Runs at every observation barrier — must be idempotent.
+        """
+
+    # resolved per class like _has_update_lane_hook: avoids a no-op method
+    # call on every barrier for the ~all metrics that don't buffer host state
+    _has_host_pending_hook: bool = False
+
+    def __getattr__(self, name: str) -> Any:
+        # Only reached for MISSING attributes — zero cost on every normal
+        # lookup. While a deferred queue is pending, the state attributes are
+        # popped out of __dict__ into the queue's backing store, so ANY state
+        # read (compute, sync, a user's `metric.total`, state_dict, pickle)
+        # lands here and flushes in enqueue order.
+        d = self.__dict__
+        # bounded loop: a flush's eager replay may legitimately re-enqueue
+        # (a collection flush replaying through member updates), installing a
+        # fresh queue that pops the state again — flush until settled
+        for _ in range(8):
+            q = d.get("_defer_pending")
+            if q is None or not q.has_state(self, name):
+                break
+            q.flush()
+            if name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
     def _record_fused_signature(self, signature: tuple) -> None:
         """Record an eager-validated input signature in the FIFO-capped cache
@@ -599,6 +685,253 @@ class Metric(ABC):
             ids = frozenset(id(leaf) for leaf in jax.tree.flatten(self._defaults)[0])
             object.__setattr__(self, "_default_ids_cache", ids)
         return ids
+
+    # ------------------------------------------- deferred micro-batched dispatch
+    @staticmethod
+    def _defer_stackable(args: tuple, kwargs: dict) -> bool:
+        """At least one array leaf to stack along the steps axis — calls made
+        of python scalars only have nothing to scan over and keep the
+        per-call dispatch path."""
+        return any(hasattr(leaf, "shape") for leaf in jax.tree.flatten((args, kwargs))[0])
+
+    def _defer_probe(self, kind: str, layout, program, *probe_args) -> None:
+        """eval_shape the flush program once per (kind, layout); an
+        untraceable one raises :class:`_DeferProbeDecline` so the flush
+        replays eagerly with no warning."""
+        probed = self.__dict__.get("_defer_probed")
+        if probed is None:
+            probed = set()
+            object.__setattr__(self, "_defer_probed", probed)
+        key = (kind, layout)
+        if key in probed:
+            return
+        if not _probe_traceable(program, *probe_args):
+            raise _DeferProbeDecline()
+        probed.add(key)
+
+    def _defer_enqueue_update(self, signature: tuple, args: tuple, kwargs: dict) -> None:
+        """Enqueue one bare ``update`` call (count/cache bookkeeping already
+        done by the wrapper). A kind- or signature-mismatched pending queue
+        flushes first, so mixed call streams stay in enqueue order."""
+        q = self.__dict__.get("_defer_pending")
+        if q is not None and not q.matches("update", signature):
+            q.flush()
+            q = None
+        if q is None:
+            q = _engine.PendingQueue("update", signature, self._flush_update_queue)
+            q.adopt(self, self._defaults)
+        q.entries.append((args, kwargs))
+        q.handles.append(None)
+        _engine.note_deferred_steps(1)
+        if q.should_flush():
+            q.flush()
+
+    def _defer_enqueue_forward(self, signature: tuple, args: tuple, kwargs: dict) -> Any:
+        """Enqueue one reduce-path ``forward`` call and return its
+        :class:`engine.LazyValue` handle — the flush runs only when the
+        handle (or any state) is actually read."""
+        q = self.__dict__.get("_defer_pending")
+        if q is not None and not q.matches("forward", signature):
+            q.flush()
+            q = None
+        if q is None:
+            q = _engine.PendingQueue("forward", signature, self._flush_forward_queue)
+            q.adopt(self, self._defaults)
+        handle = _engine.LazyValue(q)
+        q.entries.append((args, kwargs))
+        q.handles.append(handle)
+        _engine.note_deferred_steps(1)
+        self._update_count += 1
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        object.__setattr__(self, "_forward_cache", handle)
+        if q.should_flush():
+            q.flush()
+        return handle
+
+    def _deferred_chunks(self, entries: list):
+        """Yield ``(offset, chunk_len, scan pieces)`` for the queued calls in
+        power-of-two buckets — the scan programs compile at most
+        ~log2(max_pending) step-axis shapes per signature, however raggedly
+        an observation lands mid-queue."""
+        offset = 0
+        for chunk_len in _engine.pow2_chunks(len(entries)):
+            a_s, k_s = _engine.stack_entries(entries, offset, chunk_len)
+            python_leaves, treedef, scanned_idx, aconst_idx, scanned, aconsts = (
+                self._split_many_leaves(a_s, k_s)
+            )
+            layout = (treedef, tuple(scanned_idx), tuple(aconst_idx), repr(python_leaves))
+            yield offset, chunk_len, layout, python_leaves, treedef, scanned_idx, aconst_idx, scanned, aconsts
+            offset += chunk_len
+
+    def _flush_update_queue(self, q: "_engine.PendingQueue") -> None:
+        """Run a pending bare-update queue as stacked scan program(s).
+
+        Bit-exact by construction: the scan body is exactly the fused
+        bare-update step (restore state → ``_inner_update`` → snapshot), so a
+        flushed queue equals the same calls dispatched one-by-one. On any
+        trace/compile failure the remaining entries replay eagerly and
+        deferral is disabled for this instance (degrades to PR-1 per-call
+        dispatch)."""
+        entries = q.entries
+        backing = q.backing.get(id(self), {})
+        state = {name: backing[name] for name in self._defaults}
+        # `applied` advances only AFTER a chunk's program ran: a failure while
+        # PREPARING the next chunk (stacking, probing) must not make the
+        # fallback replay an already-applied chunk
+        applied = 0
+        template = None
+        object.__setattr__(self, "_defer_suspended", True)
+        try:
+            try:
+                for (offset, chunk_len, layout, python_leaves, treedef, scanned_idx,
+                     aconst_idx, scanned, aconsts) in self._deferred_chunks(entries):
+                    program = _engine.acquire(
+                        self,
+                        "deferred-update",
+                        self._build_deferred_update(python_leaves, treedef, scanned_idx, aconst_idx),
+                        extra_key=(layout,),
+                    )
+                    self._defer_probe("update", layout, program, state, scanned, aconsts)
+                    template = program.template
+                    state = program.run(
+                        state, (scanned, aconsts), avoid_ids=self._default_leaf_ids()
+                    )
+                    applied = offset + chunk_len
+            except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
+                if not _engine.state_intact(state):
+                    raise RuntimeError(
+                        f"Deferred update flush for `{type(self).__name__}` failed after "
+                        f"donating its state buffers ({type(exc).__name__}: {exc}); the "
+                        "accumulated state is unrecoverable — construct a fresh instance."
+                    ) from exc
+                q.release()
+                for name, value in state.items():
+                    object.__setattr__(self, name, value)
+                object.__setattr__(self, "_defer_ok", False)
+                if not isinstance(exc, _DeferProbeDecline):
+                    rank_zero_warn(
+                        f"Deferred update flush for `{type(self).__name__}` raised "
+                        f"{type(exc).__name__}: {exc}. Replaying the queue eagerly and "
+                        "disabling deferred dispatch for this instance."
+                    )
+                _engine.note_deferred_flush(fallback=True)
+                done = applied
+                try:
+                    for a, k in entries[applied:]:
+                        self._inner_update(*a, **k)
+                        done += 1
+                except Exception:
+                    # entries past the failing one never applied: their
+                    # enqueue-time count increments are rolled back so the
+                    # count matches eager semantics at the raise point
+                    self._update_count -= len(entries) - done - 1
+                    raise
+                return
+            q.release()
+            for name, value in state.items():
+                object.__setattr__(self, name, value)
+            if template is not None:
+                _propagate_static_attrs(template, self)
+            _engine.note_deferred_flush()
+        finally:
+            object.__setattr__(self, "_defer_suspended", False)
+
+    def _build_deferred_update(self, python_leaves, treedef, scanned_idx, aconst_idx):
+        """Engine build closure: a ``lax.scan`` over the fused bare-update
+        step — the deferred-queue analogue of ``_build_fused_update``."""
+
+        def build():
+            template = self._bare_clone()
+
+            def scan_program(state: Dict[str, Any], xs, const_vals):
+                def body(st, xs_leaves):
+                    step_leaves = list(python_leaves)
+                    for i, leaf in zip(scanned_idx, xs_leaves):
+                        step_leaves[i] = leaf
+                    for i, leaf in zip(aconst_idx, const_vals):
+                        step_leaves[i] = leaf
+                    a, k = jax.tree.unflatten(treedef, step_leaves)
+                    m = template._bare_clone()
+                    m._restore_state(st)
+                    m._inner_update(*a, **k)
+                    _propagate_static_attrs(m, template)
+                    return m._state_snapshot(), 0
+
+                final, _ = jax.lax.scan(body, state, xs)
+                return final
+
+            return scan_program, template, {}
+
+        return build
+
+    def _flush_forward_queue(self, q: "_engine.PendingQueue") -> None:
+        """Run a pending forward queue through the SAME donated-state scan
+        programs ``forward_many`` compiles (shared engine cache keys), fill
+        each entry's :class:`engine.LazyValue` with its per-step batch value,
+        and write the merged state back."""
+        entries = q.entries
+        handles = q.handles
+        count0 = self._update_count - len(entries)
+        backing = q.backing.get(id(self), {})
+        state = {name: backing[name] for name in self._defaults}
+        applied = 0  # advanced only after a chunk's program ran (see update flush)
+        template = None
+        object.__setattr__(self, "_defer_suspended", True)
+        try:
+            try:
+                for (offset, chunk_len, layout, python_leaves, treedef, scanned_idx,
+                     aconst_idx, scanned, aconsts) in self._deferred_chunks(entries):
+                    program = self._acquire_many_program(
+                        True, layout, python_leaves, treedef, scanned_idx, aconst_idx
+                    )
+                    self._defer_probe(
+                        "forward", layout, program, state, count0 + offset, scanned, aconsts
+                    )
+                    template = program.template
+                    state, values = program.run(
+                        state,
+                        (count0 + offset, scanned, aconsts),
+                        avoid_ids=self._default_leaf_ids(),
+                    )
+                    for j in range(chunk_len):
+                        handles[offset + j]._set_chunk(values, j)
+                    applied = offset + chunk_len
+            except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
+                if not _engine.state_intact(state):
+                    raise RuntimeError(
+                        f"Deferred forward flush for `{type(self).__name__}` failed after "
+                        f"donating its state buffers ({type(exc).__name__}: {exc}); the "
+                        "accumulated state is unrecoverable — construct a fresh instance."
+                    ) from exc
+                q.release()
+                for name, value in state.items():
+                    object.__setattr__(self, name, value)
+                object.__setattr__(self, "_defer_ok", False)
+                # replay re-runs the eager forward per entry, which
+                # re-increments the count from the replay point
+                self._update_count = count0 + applied
+                if not isinstance(exc, _DeferProbeDecline):
+                    rank_zero_warn(
+                        f"Deferred forward flush for `{type(self).__name__}` raised "
+                        f"{type(exc).__name__}: {exc}. Replaying the queue eagerly and "
+                        "disabling deferred dispatch for this instance."
+                    )
+                _engine.note_deferred_flush(fallback=True)
+                for j in range(applied, len(entries)):
+                    a, k = entries[j]
+                    handles[j]._set_value(self._forward_reduce_state_update_eager(*a, **k))
+                return
+            q.release()
+            for name, value in state.items():
+                object.__setattr__(self, name, value)
+            if template is not None:
+                _propagate_static_attrs(template, self)
+            _engine.note_deferred_flush()
+        finally:
+            object.__setattr__(self, "_defer_suspended", False)
 
     # ----------------------------------------------------- host fast lane
     def _build_update_lane(self, args: tuple, kwargs: dict) -> Optional[Callable]:
@@ -809,6 +1142,38 @@ class Metric(ABC):
             )
         return python_leaves, treedef, scanned_idx, aconst_idx, scanned, array_consts
 
+    def _acquire_many_program(
+        self, with_values: bool, layout, python_leaves, treedef, scanned_idx, aconst_idx
+    ) -> "_engine.Executable":
+        """Fetch (or build once) the batched-step scan program for one call
+        layout — shared by ``update_many``/``forward_many`` AND the deferred
+        micro-batch flush (same engine cache key, one compiled program)."""
+
+        def build():
+            template, step = self._build_fused_step()
+
+            def scan_program(state, update_count, xs, const_vals):
+                def body(carry, xs_leaves):
+                    st, cnt = carry
+                    cnt = cnt + 1
+                    step_leaves = list(python_leaves)
+                    for i, leaf in zip(scanned_idx, xs_leaves):
+                        step_leaves[i] = leaf
+                    for i, leaf in zip(aconst_idx, const_vals):
+                        step_leaves[i] = leaf
+                    a, k = jax.tree.unflatten(treedef, step_leaves)
+                    new_st, val = step(st, cnt, *a, **k)
+                    return (new_st, cnt), (val if with_values else 0)
+
+                (final, _), vals = jax.lax.scan(
+                    body, (state, jnp.asarray(update_count, jnp.int32)), xs
+                )
+                return final, vals
+
+            return scan_program, template, {}
+
+        return _engine.acquire(self, "many", build, extra_key=(with_values, layout))
+
     def update_many(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate a CHUNK of update calls in one dispatch.
 
@@ -830,6 +1195,9 @@ class Metric(ABC):
     def _run_many(self, with_values: bool, args: tuple, kwargs: dict) -> Any:
         _get_validation_mode = _checks_module()._get_validation_mode
 
+        # observation barrier: a chunk call must apply AFTER any deferred
+        # per-step calls already enqueued (order is the semantics)
+        self._defer_barrier()
         if self._is_synced:
             # same guard as forward (reference `metric.py:240-244`): merging
             # batch state into globally-reduced state double-counts at resync
@@ -880,36 +1248,14 @@ class Metric(ABC):
             if program is not None and getattr(self, layout_attr, None) != layout:
                 program = None
             if program is None:
-
-                def build():
-                    template, step = self._build_fused_step()
-
-                    def scan_program(state, update_count, xs, const_vals):
-                        def body(carry, xs_leaves):
-                            st, cnt = carry
-                            cnt = cnt + 1
-                            step_leaves = list(python_leaves)
-                            for i, leaf in zip(scanned_idx, xs_leaves):
-                                step_leaves[i] = leaf
-                            for i, leaf in zip(aconst_idx, const_vals):
-                                step_leaves[i] = leaf
-                            a, k = jax.tree.unflatten(treedef, step_leaves)
-                            new_st, val = step(st, cnt, *a, **k)
-                            return (new_st, cnt), (val if with_values else 0)
-
-                        (final, _), vals = jax.lax.scan(
-                            body, (state, jnp.asarray(update_count, jnp.int32)), xs
-                        )
-                        return final, vals
-
-                    return scan_program, template, {}
-
                 # engine-cached per (config, flavor, call layout): a second
                 # same-config instance reuses the compiled scan — the most
                 # expensive program in the library — and each chunk donates
-                # the incoming state buffers
-                program = _engine.acquire(
-                    self, "many", build, extra_key=(with_values, layout)
+                # the incoming state buffers. The deferred flush acquires
+                # through the same key, so a forward_many user and a deferred
+                # eager loop share ONE compiled program per layout.
+                program = self._acquire_many_program(
+                    with_values, layout, python_leaves, treedef, scanned_idx, aconst_idx
                 )
                 if with_values:
                     self._many_program_vals = program
@@ -1032,6 +1378,17 @@ class Metric(ABC):
             self._fused_seen_signatures = {}  # insertion-ordered → FIFO eviction
         signature = self._forward_signature(args, kwargs)
         seen = signature in self._fused_seen_signatures
+        if (
+            seen
+            and self._defer_ok
+            and not self._defer_suspended
+            and _engine.defer_enabled()
+            and self._defer_stackable(args, kwargs)
+        ):
+            # deferred micro-batched dispatch: enqueue and hand back a lazy
+            # handle — the stacked scan flush runs at the size/age threshold
+            # or when the handle/state is actually read
+            return self._defer_enqueue_forward(signature, args, kwargs)
         if seen and self._fused_forward is None:
             program = self._build_fused_forward()
             state = {name: getattr(self, name) for name in self._defaults}
@@ -1221,6 +1578,7 @@ class Metric(ABC):
         if dist_sync_fn is None:
             dist_sync_fn = self.dist_sync_fn or gather_all_tensors
 
+        self._defer_barrier()
         self._canonicalize_list_states()
         self._cache = self._state_snapshot()
         self._sync_dist(dist_sync_fn, process_group=process_group)
@@ -1304,6 +1662,7 @@ class Metric(ABC):
             if self._computed is not None:
                 return self._computed
 
+            self._defer_barrier()
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
@@ -1341,7 +1700,12 @@ class Metric(ABC):
         return jax.tree.map(leaf, value)
 
     def reset(self) -> None:
-        """Reset state to defaults (reference `metric.py:547-562`)."""
+        """Reset state to defaults (reference `metric.py:547-562`).
+
+        An observation point: pending deferred calls flush first, so lazy
+        ``forward`` handles issued before the reset keep their values (eager
+        semantics — their batches ran before the reset)."""
+        self._defer_barrier()
         self._update_count = 0
         self._forward_cache = None
         self._computed = None
@@ -1465,6 +1829,7 @@ class Metric(ABC):
         reference's ``nn.Module`` hierarchy.
         """
         destination: Dict[str, Any] = {}
+        self._defer_barrier()
         self._canonicalize_list_states()
         for name in self._defaults:
             if not self._persistent[name]:
@@ -1503,11 +1868,16 @@ class Metric(ABC):
     def __getstate__(self) -> Dict[str, Any]:
         # drop the wrapped bound methods (re-wrapped on unpickle, reference
         # `metric.py:568-577`) and the fused-forward machinery (jit closures
-        # don't pickle/deepcopy; rebuilt lazily on first fused call)
+        # don't pickle/deepcopy; rebuilt lazily on first fused call).
+        # Serialization is an observation: pending deferred calls flush first
+        # (no-op when called from inside a flush building its template).
+        self._defer_barrier()
         self._canonicalize_list_states()
         drop = (
             "update",
             "compute",
+            "_defer_pending",
+            "_defer_probed",
             "_fused_forward",
             "_fused_template",
             "_fused_update_program",
@@ -1532,6 +1902,14 @@ class Metric(ABC):
     def __setattr__(self, name: str, value: Any) -> None:
         if name in ("higher_is_better", "is_differentiable", "full_state_update"):
             raise RuntimeError(f"Can't change const `{name}`.")
+        # deferred-queue barrier: overwriting a state value (load_state_dict,
+        # user assignment) or mutating a public hyperparameter must apply
+        # AFTER the queued calls — they were enqueued under the old values.
+        # Private bookkeeping writes (and the flush's own state write-back,
+        # which uses object.__setattr__) skip this.
+        q = self.__dict__.get("_defer_pending")
+        if q is not None and (not name.startswith("_") or q.has_state(self, name)):
+            q.flush()
         # mutating a non-state attribute (a hyperparameter like `threshold`)
         # invalidates the fused forward program: its trace baked in the old
         # value, and the next fused call would both ignore the change and

@@ -36,24 +36,56 @@ replaces its state attributes immediately after every fused step, and
 state leaf, so user-held compute values survive later donated steps. Raw
 state references captured via direct attribute access before a fused step
 are not protected — hold ``compute()`` results, not state leaves.
+
+Deferred micro-batched dispatch (the third tier, on top of the two above):
+even a fused single-step program pays one backend round trip per call, which
+bounds any eager loop at ``1000/program_roundtrip_ms`` steps/s. The deferral
+layer removes the per-call dispatch entirely: eligible ``update``/``forward``
+calls enqueue their (host-staged) arguments into a per-owner
+:class:`PendingQueue` instead of dispatching, and the queue flushes as ONE
+stacked ``lax.scan`` program — the same donated-state scan programs the
+batched ``update_many``/``forward_many`` API compiles — when a size/age
+threshold trips or when state is observed. Observation is total by
+construction: while a queue is pending, the owner's state attributes are
+POPPED out of its ``__dict__`` into the queue's backing store, so *any*
+state read (``compute``, ``sync``, ``reset``, pickling, ``state_dict``,
+direct attribute access) lands in ``Metric.__getattr__`` and flushes in
+enqueue order — results stay bit-exact with the step-by-step eager path.
+``forward`` returns a :class:`LazyValue` handle that forces the flush only
+when its value is actually read, so update-only loops pay ~zero dispatches
+until observation. Chunk lengths are bucketed to powers of two
+(order-preserving consecutive slices), bounding the scan compile cache to
+~log2(max_pending) shapes per signature, so ragged flush points (a
+mid-queue observation) never trigger unbounded recompiles.
+``METRICS_TPU_DEFER=0`` (or :func:`set_deferred_dispatch`) restores the
+per-call fused dispatch behavior exactly.
 """
 from __future__ import annotations
 
 import hashlib
+import os
+import time
 from collections import OrderedDict
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 __all__ = [
     "Executable",
+    "LazyValue",
+    "PendingQueue",
     "acquire",
     "acquire_keyed",
     "config_fingerprint",
+    "defer_enabled",
+    "defer_max_age_s",
+    "defer_max_pending",
     "donation_supported",
     "engine_stats",
+    "pow2_chunks",
     "reset_engine",
+    "set_deferred_dispatch",
     "state_donatable",
     "state_intact",
 ]
@@ -296,8 +328,18 @@ def acquire_keyed(
 
 def engine_stats() -> Dict[str, int]:
     """Cache effectiveness counters: ``builds`` (distinct programs traced),
-    ``hits`` (program acquisitions served from cache), ``cached`` (live)."""
-    return {"builds": _stats["builds"], "hits": _stats["hits"], "cached": len(_PROGRAM_CACHE)}
+    ``hits`` (program acquisitions served from cache), ``cached`` (live),
+    plus deferral counters: ``deferred_steps`` (calls that enqueued instead
+    of dispatching), ``deferred_flushes`` (stacked flush dispatches),
+    ``deferred_fallbacks`` (flushes that replayed eagerly)."""
+    return {
+        "builds": _stats["builds"],
+        "hits": _stats["hits"],
+        "cached": len(_PROGRAM_CACHE),
+        "deferred_steps": _stats["deferred_steps"],
+        "deferred_flushes": _stats["deferred_flushes"],
+        "deferred_fallbacks": _stats["deferred_fallbacks"],
+    }
 
 
 def reset_engine() -> None:
@@ -306,3 +348,386 @@ def reset_engine() -> None:
     _PROGRAM_CACHE.clear()
     _stats["builds"] = 0
     _stats["hits"] = 0
+    _stats["deferred_steps"] = 0
+    _stats["deferred_flushes"] = 0
+    _stats["deferred_fallbacks"] = 0
+
+
+# ----------------------------------------------- deferred micro-batched dispatch
+_stats.update({"deferred_steps": 0, "deferred_flushes": 0, "deferred_fallbacks": 0})
+
+_defer_enabled: Optional[bool] = None  # resolved lazily from METRICS_TPU_DEFER
+_defer_max_pending: Optional[int] = None
+_defer_max_age_s: Optional[float] = None
+
+
+def defer_enabled() -> bool:
+    """Whether eligible eager calls enqueue into a pending queue instead of
+    dispatching one program per call. On by default; ``METRICS_TPU_DEFER=0``
+    (or :func:`set_deferred_dispatch`) restores per-call dispatch."""
+    global _defer_enabled
+    if _defer_enabled is None:
+        _defer_enabled = os.environ.get("METRICS_TPU_DEFER", "1") not in ("0", "false", "off")
+    return _defer_enabled
+
+
+def defer_max_pending() -> int:
+    """Queue size that triggers an automatic flush (``METRICS_TPU_DEFER_MAX``,
+    default 128 — at the measured ~0.5 ms/program backend round trip this
+    amortizes the dispatch to ~4 µs/step, two orders below the eager floor)."""
+    global _defer_max_pending
+    if _defer_max_pending is None:
+        try:
+            _defer_max_pending = max(1, int(os.environ.get("METRICS_TPU_DEFER_MAX", "128")))
+        except ValueError:
+            _defer_max_pending = 128
+    return _defer_max_pending
+
+
+def defer_max_age_s() -> float:
+    """Queue age that triggers a flush on the NEXT enqueue
+    (``METRICS_TPU_DEFER_AGE_MS``, default 250 ms). Bounds staleness in slow
+    loops; there is no background thread — age is only checked at call time,
+    and observation flushes regardless."""
+    global _defer_max_age_s
+    if _defer_max_age_s is None:
+        try:
+            _defer_max_age_s = max(0.0, float(os.environ.get("METRICS_TPU_DEFER_AGE_MS", "250"))) / 1000.0
+        except ValueError:
+            _defer_max_age_s = 0.25
+    return _defer_max_age_s
+
+
+def set_deferred_dispatch(
+    enabled: Optional[bool] = None,
+    *,
+    max_pending: Optional[int] = None,
+    max_age_ms: Optional[float] = None,
+) -> None:
+    """Override the deferral policy at runtime (None leaves a knob unchanged;
+    takes precedence over the environment variables). Live queues are not
+    flushed here — disabling only stops NEW enqueues; pending work still
+    flushes at its owners' next observation."""
+    global _defer_enabled, _defer_max_pending, _defer_max_age_s
+    if enabled is not None:
+        _defer_enabled = bool(enabled)
+    if max_pending is not None:
+        _defer_max_pending = max(1, int(max_pending))
+    if max_age_ms is not None:
+        _defer_max_age_s = max(0.0, float(max_age_ms)) / 1000.0
+
+
+def pow2_chunks(n: int) -> List[int]:
+    """Order-preserving power-of-two bucket lengths covering ``n`` steps
+    (23 → [16, 8 is too big → 4, 2, 1]): every flush chunk has a bucketed
+    length, so the scan programs compile at most ~log2(max_pending) shapes
+    per signature however raggedly observations land mid-queue."""
+    out = []
+    while n:
+        c = 1 << (n.bit_length() - 1)
+        out.append(c)
+        n -= c
+    return out
+
+
+class PendingQueue:
+    """A per-owner queue of deferred same-signature calls.
+
+    ``entries`` holds the raw ``(args, kwargs)`` of each enqueued call in
+    order; ``handles`` the :class:`LazyValue` issued for each forward entry
+    (None for bare updates). ``backing`` maps ``id(owner) -> {state_name:
+    value}`` — the state attributes popped out of each owner's ``__dict__``
+    while the queue is pending, which is what makes ANY state access land in
+    ``__getattr__`` and flush. ``flush_fn(queue)`` is installed by the owner
+    (metric or collection) and must restore/replace the backing state and
+    clear every owner's pending marker before returning.
+    """
+
+    __slots__ = (
+        "kind",
+        "signature",
+        "entries",
+        "handles",
+        "backing",
+        "owners",
+        "flush_fn",
+        "created",
+        "meta",
+        "_flushing",
+    )
+
+    def __init__(self, kind: str, signature: Any, flush_fn: Callable[["PendingQueue"], None]):
+        self.kind = kind
+        self.signature = signature
+        self.entries: list = []
+        self.handles: list = []
+        self.backing: Dict[int, Dict[str, Any]] = {}
+        self.owners: list = []
+        self.flush_fn = flush_fn
+        self.created = time.monotonic()
+        self.meta: Any = None  # creator-owned context (e.g. a collection's member list)
+        self._flushing = False
+
+    def adopt(self, owner: Any, state_names: Any) -> None:
+        """Pop ``owner``'s state attributes into the backing store and mark
+        the owner pending (its ``__getattr__`` barrier now routes here)."""
+        d = owner.__dict__
+        taken = {}
+        for name in state_names:
+            if name in d:
+                taken[name] = d.pop(name)
+        self.backing[id(owner)] = taken
+        self.owners.append(owner)
+        object.__setattr__(owner, "_defer_pending", self)
+
+    def has_state(self, owner: Any, name: str) -> bool:
+        b = self.backing.get(id(owner))
+        return b is not None and name in b
+
+    def matches(self, kind: str, signature: Any) -> bool:
+        return self.kind == kind and self.signature == signature
+
+    def should_flush(self) -> bool:
+        return len(self.entries) >= defer_max_pending() or (
+            time.monotonic() - self.created
+        ) > defer_max_age_s()
+
+    def release(self) -> None:
+        """Restore backing state attrs and clear pending markers WITHOUT
+        running the queued work (flush implementations call this first, then
+        write the post-flush state over the restored attrs)."""
+        for owner in self.owners:
+            taken = self.backing.pop(id(owner), None)
+            if taken:
+                for name, value in taken.items():
+                    object.__setattr__(owner, name, value)
+            if owner.__dict__.get("_defer_pending") is self:
+                object.__setattr__(owner, "_defer_pending", None)
+        self.owners = []
+
+    def flush(self) -> None:
+        """Run the queued calls as stacked scan program(s). Reentrancy-safe:
+        a flush triggered from inside a flush (template construction
+        deep-copies the owner, whose ``__getstate__`` barrier fires) is a
+        no-op, as is flushing an already-drained queue."""
+        if self._flushing:
+            return
+        fn = self.flush_fn
+        if fn is None:
+            return
+        self._flushing = True
+        self.flush_fn = None
+        try:
+            fn(self)
+        finally:
+            self._flushing = False
+            self.release()  # no-op if the flush implementation already did
+
+
+class LazyValue:
+    """Deferred ``forward`` batch value: a transparent proxy that forces its
+    owner queue's flush on first read.
+
+    Reading means any materialization — ``float()``, ``np.asarray``,
+    ``jnp.asarray`` (via ``__jax_array__``), arithmetic, comparison,
+    indexing, attribute access (``.shape``, ``.dtype``, ``.mean()``, …) all
+    delegate to the forced value. Until then the handle is inert and the
+    enqueued step costs no dispatch. Like the arrays it stands in for, a
+    handle is unhashable (``==`` is elementwise).
+    """
+
+    __slots__ = ("_queue", "_chunk", "_chunk_index", "_value", "_ready")
+
+    def __init__(self, queue: Optional[PendingQueue]):
+        self._queue = queue
+        self._chunk = None
+        self._chunk_index = 0
+        self._value = None
+        self._ready = False
+
+    # -- resolution (called by the flush implementations) ------------------
+    def _set_value(self, value: Any) -> None:
+        self._value = value
+        self._chunk = None
+        self._ready = True
+        self._queue = None
+
+    def _set_chunk(self, chunk_values: Any, index: int) -> None:
+        # lazy per-step slice: only handles that are actually read pay the
+        # (async) gather for their step out of the stacked chunk values
+        self._chunk = chunk_values
+        self._chunk_index = index
+        self._ready = True
+        self._queue = None
+
+    def _force(self) -> Any:
+        if not self._ready:
+            q = self._queue
+            if q is not None:
+                q.flush()
+            if not self._ready:
+                raise RuntimeError(
+                    "deferred forward value was never resolved (its metric's queue "
+                    "was dropped without a flush — e.g. the instance was reset "
+                    "through a path that bypassed the observation barrier)"
+                )
+        if self._chunk is not None:
+            i = self._chunk_index
+            self._value = jax.tree.map(lambda v: v[i], self._chunk)
+            self._chunk = None
+        return self._value
+
+    # -- transparent delegation -------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        return getattr(self._force(), name)
+
+    def __jax_array__(self) -> jax.Array:
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._force())
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self._force())
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self._force())
+
+    def __int__(self):
+        return int(self._force())
+
+    def __bool__(self):
+        return bool(self._force())
+
+    def __index__(self):
+        return self._force().__index__()
+
+    def __len__(self):
+        return len(self._force())
+
+    def __iter__(self):
+        return iter(self._force())
+
+    def __getitem__(self, item):
+        return self._force()[item]
+
+    def __repr__(self):
+        return repr(self._force())
+
+    def __format__(self, spec):
+        return format(self._force(), spec)
+
+    __hash__ = None  # elementwise __eq__, like the arrays this stands in for
+
+    def __eq__(self, other):
+        return self._force() == other
+
+    def __ne__(self, other):
+        return self._force() != other
+
+    def __lt__(self, other):
+        return self._force() < other
+
+    def __le__(self, other):
+        return self._force() <= other
+
+    def __gt__(self, other):
+        return self._force() > other
+
+    def __ge__(self, other):
+        return self._force() >= other
+
+    def __add__(self, other):
+        return self._force() + other
+
+    def __radd__(self, other):
+        return other + self._force()
+
+    def __sub__(self, other):
+        return self._force() - other
+
+    def __rsub__(self, other):
+        return other - self._force()
+
+    def __mul__(self, other):
+        return self._force() * other
+
+    def __rmul__(self, other):
+        return other * self._force()
+
+    def __truediv__(self, other):
+        return self._force() / other
+
+    def __rtruediv__(self, other):
+        return other / self._force()
+
+    def __floordiv__(self, other):
+        return self._force() // other
+
+    def __rfloordiv__(self, other):
+        return other // self._force()
+
+    def __mod__(self, other):
+        return self._force() % other
+
+    def __rmod__(self, other):
+        return other % self._force()
+
+    def __pow__(self, other):
+        return self._force() ** other
+
+    def __rpow__(self, other):
+        return other ** self._force()
+
+    def __matmul__(self, other):
+        return self._force() @ other
+
+    def __rmatmul__(self, other):
+        return other @ self._force()
+
+    def __neg__(self):
+        return -self._force()
+
+    def __pos__(self):
+        return +self._force()
+
+    def __abs__(self):
+        return abs(self._force())
+
+
+def note_deferred_steps(n: int) -> None:
+    _stats["deferred_steps"] += n
+
+
+def note_deferred_flush(fallback: bool = False) -> None:
+    _stats["deferred_flushes"] += 1
+    if fallback:
+        _stats["deferred_fallbacks"] += 1
+
+
+def stack_entries(entries: List[tuple], start: int, length: int) -> Tuple[tuple, dict]:
+    """Stack ``length`` consecutive queued ``(args, kwargs)`` calls into one
+    chunk with a leading steps axis on every array leaf.
+
+    Same-signature entries share a tree structure; array leaves (device or
+    host-staged numpy — including 0-d scalars, which become ``(k,)`` traced
+    operands) stack along a new axis 0, python leaves pass through from the
+    first entry (signature equality keys python leaves by repr, so they are
+    per-chunk constants). Host leaves transfer once per chunk here instead
+    of once per call.
+    """
+    import jax.numpy as jnp
+
+    chunk = entries[start : start + length]
+    leaves0, treedef = jax.tree.flatten(chunk[0])
+    if length == 1:
+        cols = [(leaf,) for leaf in leaves0]
+    else:
+        cols = list(zip(*(jax.tree.flatten(e)[0] for e in chunk)))
+    # python scalar leaves stay STATIC constants (signature equality keys
+    # them by repr, so they are identical across the chunk) — stacking them
+    # would turn trace-time branches on their values into tracer errors
+    stacked = [jnp.stack(col) if hasattr(col[0], "shape") else col[0] for col in cols]
+    return jax.tree.unflatten(treedef, stacked)

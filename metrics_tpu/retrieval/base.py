@@ -198,7 +198,14 @@ class RetrievalMetric(Metric):
         functions of the (shape, dtype) signature and the value checks honor
         the validation mode, so after one eager-validated update per
         signature a same-signature update is three raw list appends plus one
-        guard branch."""
+        guard branch.
+
+        This IS the host-side face of the engine's deferral protocol: the
+        buffered raw rows are the pending queue, and they materialize at the
+        same observation surfaces the deferred micro-batch queue flushes
+        through (``Metric._defer_barrier`` → sync/state_dict/pickling via
+        :meth:`_canonicalize_list_states`, and ``compute`` via
+        :meth:`_grouped_state`'s one concatenated canonicalization)."""
         if kwargs or len(args) != 3:
             return None
         specs = []

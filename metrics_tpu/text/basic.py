@@ -277,8 +277,11 @@ class SQuAD(Metric):
 
     # host accumulation buffer (f1, exact_match, total): updates accumulate
     # python floats with ZERO device dispatches; the buffer folds into the
-    # device states only at observation time (compute/sync/checkpoint) —
-    # the same deferral discipline as the raw-row cat states
+    # device states only at observation time — expressed as the base class's
+    # ``_host_pending_flush`` hook, so SQuAD rides the SAME flush protocol
+    # (``Metric._defer_barrier``) as the deferred micro-batch queue: every
+    # observation surface (metric_state/_state_snapshot, compute, sync,
+    # state_dict, pickling) folds the buffer with no per-class overrides
     _pending = None
 
     def __init__(self, **kwargs: Any) -> None:
@@ -287,27 +290,21 @@ class SQuAD(Metric):
         self.add_state("exact_match", jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
 
-    def _flush_pending(self) -> None:
+    def _host_pending_flush(self) -> None:
         p = self._pending
         if p is not None:
             object.__setattr__(self, "_pending", None)
-            # three device adds, paid once per observation instead of per step
-            self.f1_score = self.f1_score + jnp.asarray(p[0], dtype=jnp.float32)
-            self.exact_match = self.exact_match + jnp.asarray(p[1], dtype=jnp.float32)
-            self.total = self.total + jnp.asarray(p[2], dtype=jnp.int32)
-
-    def _state_snapshot(self) -> Dict[str, Any]:
-        self._flush_pending()
-        return super()._state_snapshot()
+            # three device adds, paid once per observation instead of per
+            # step (object.__setattr__: folding is not a config change and
+            # must not re-enter the observation barrier)
+            object.__setattr__(self, "f1_score", self.f1_score + jnp.asarray(p[0], dtype=jnp.float32))
+            object.__setattr__(self, "exact_match", self.exact_match + jnp.asarray(p[1], dtype=jnp.float32))
+            object.__setattr__(self, "total", self.total + jnp.asarray(p[2], dtype=jnp.int32))
 
     def _canonicalize_list_states(self) -> None:
-        # observation hook (sync/state_dict/pickle): fold the host buffer in
-        self._flush_pending()
-
-    @property
-    def metric_state(self) -> Dict[str, Any]:
-        self._flush_pending()
-        return {name: getattr(self, name) for name in self._defaults}
+        # direct per-row observation (cross-metric code paths that bypass
+        # the barrier helper) still folds the buffer
+        self._host_pending_flush()
 
     def reset(self) -> None:
         object.__setattr__(self, "_pending", None)
@@ -344,7 +341,7 @@ class SQuAD(Metric):
         return lane
 
     def compute(self) -> Dict[str, jax.Array]:
-        self._flush_pending()
+        self._host_pending_flush()
         return _squad_compute(self.f1_score, self.exact_match, self.total)
 
 

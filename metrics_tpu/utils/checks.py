@@ -67,9 +67,9 @@ _cache_generation = 0  # bumped by set_validation_mode to invalidate owner cache
 
 
 def set_validation_mode(mode: str) -> None:
-    """Control value-dependent input validation: ``"first"`` (default — first
-    update per input signature fully validated, skipped after), ``"full"``
-    (every update, strict reference parity), or ``"off"``.
+    """Control value-dependent input validation: ``"full"`` (the default —
+    every update checked, strict reference parity), ``"first"`` (first update
+    per input signature fully validated, skipped after), or ``"off"``.
 
     Shape/dtype validation always runs; this only gates checks that must read
     data values (label ranges, probability bounds). Each such read costs one
@@ -77,8 +77,11 @@ def set_validation_mode(mode: str) -> None:
     round-trip per ``update()`` on remote/tunneled TPU backends. ``"first"``
     keeps reference-grade misuse errors on the first occurrence of every input
     signature at zero steady-state cost, and is what enables the fused
-    one-program update/forward paths. Also settable via
-    ``METRICS_TPU_VALIDATION``.
+    one-program update/forward paths and the deferred micro-batched dispatch
+    queue — opt in with ``METRICS_TPU_VALIDATION=first`` (or this function)
+    on throughput-critical loops. The default stays ``"full"`` so a later
+    invalid batch (e.g. a NaN reaching ``CatMetric(nan_strategy='error')``)
+    raises on the offending call out of the box.
     """
     if mode not in ("full", "first", "off"):
         raise ValueError(f"validation mode must be 'full', 'first' or 'off', got {mode!r}")
@@ -91,13 +94,16 @@ def set_validation_mode(mode: str) -> None:
 
 
 def _get_validation_mode() -> str:
+    # "full" by default (advisor round-5: later invalid batches must surface
+    # out of the box); "first" — the fused/deferred fast-path mode — is an
+    # explicit opt-in via METRICS_TPU_VALIDATION=first or set_validation_mode
     global _validation_mode
     if _validation_mode is None:
         import os
 
-        _validation_mode = os.environ.get("METRICS_TPU_VALIDATION", "first")
+        _validation_mode = os.environ.get("METRICS_TPU_VALIDATION", "full")
         if _validation_mode not in ("full", "first", "off"):
-            _validation_mode = "first"
+            _validation_mode = "full"
     return _validation_mode
 
 

@@ -93,9 +93,16 @@ class BootStrapper(Metric):
     _boot_versions = None  # clone _fused_version tuple the program was built against
     _boot_ok = True
     _record_boot_signature_after = None
-    # poisson weighted-row path: certified per instance on its first fused
-    # step (fused result compared against the eager chunked path once)
-    _poisson_certified = False
+    # poisson weighted-row path certification: row-additivity is a stronger
+    # property than the sum-merge contract guarantees, and one coincidentally
+    # row-additive batch must not license the path permanently — so the
+    # FIRST K fused steps are each compared against the eager chunked path
+    # on state copies, and every NEW input signature re-certifies at least
+    # once (a signature change can change the shape-derived code path the
+    # base update takes)
+    _POISSON_CERT_STEPS = 3
+    _poisson_cert_done = 0  # fused steps certified so far (across signatures)
+    _poisson_cert_sigs = None  # signatures certified at least once
     # next step's poisson counts, drawn + uploaded one step AHEAD so the
     # host->device transfer overlaps the current program's round trip
     # (measured ~1 ms/step through a tunneled backend):
@@ -308,7 +315,9 @@ class BootStrapper(Metric):
             [self._rng.poisson(1, size=size) for _ in range(self.num_bootstraps)]
         )
         counts, counts_dev = self._consume_or_draw(size, draw_counts)
-        certify = not self._poisson_certified
+        certify = self._poisson_cert_done < self._POISSON_CERT_STEPS or signature not in (
+            self._poisson_cert_sigs or ()
+        )
         oracle = deepcopy(self.metrics) if certify else None
         clone0 = self.metrics[0]
 
@@ -343,7 +352,12 @@ class BootStrapper(Metric):
             if states_allclose(
                 [m.metric_state for m in self.metrics], [m.metric_state for m in oracle]
             ):
-                object.__setattr__(self, "_poisson_certified", True)
+                object.__setattr__(self, "_poisson_cert_done", self._poisson_cert_done + 1)
+                sigs = self._poisson_cert_sigs
+                if sigs is None:
+                    sigs = set()
+                    object.__setattr__(self, "_poisson_cert_sigs", sigs)
+                sigs.add(signature)
             else:
                 rank_zero_warn(
                     f"Weighted-row poisson bootstrap disagreed with the eager path for "

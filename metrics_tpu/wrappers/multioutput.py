@@ -121,9 +121,14 @@ class MultioutputWrapper(Metric):
     _mo_versions = None
     _mo_ok = True
     _record_mo_signature_after = None
-    # remove_nans weighted-row path: certified per instance on its first
-    # fused step (compared against the eager masked-gather path once)
-    _mo_certified = False
+    # remove_nans weighted-row path certification: like the poisson
+    # bootstrap, one coincidentally row-additive batch must not license the
+    # path permanently — the first K fused steps each compare against the
+    # eager masked-gather path, and every new input signature re-certifies
+    # at least once
+    _MO_CERT_STEPS = 3
+    _mo_cert_done = 0
+    _mo_cert_sigs = None
 
     def __getstate__(self) -> dict:
         state = super().__getstate__()
@@ -221,7 +226,10 @@ class MultioutputWrapper(Metric):
 
             return program
 
-        certify = remove_nans and not self._mo_certified
+        certify = remove_nans and (
+            self._mo_cert_done < self._MO_CERT_STEPS
+            or signature not in (self._mo_cert_sigs or ())
+        )
         oracle = deepcopy(self.metrics) if certify else None
         ok = run_fanout(
             self,
@@ -242,7 +250,12 @@ class MultioutputWrapper(Metric):
             if states_allclose(
                 [m.metric_state for m in self.metrics], [m.metric_state for m in oracle]
             ):
-                object.__setattr__(self, "_mo_certified", True)
+                object.__setattr__(self, "_mo_cert_done", self._mo_cert_done + 1)
+                sigs = self._mo_cert_sigs
+                if sigs is None:
+                    sigs = set()
+                    object.__setattr__(self, "_mo_cert_sigs", sigs)
+                sigs.add(signature)
             else:
                 rank_zero_warn(
                     f"Weighted-row NaN masking disagreed with the eager path for "

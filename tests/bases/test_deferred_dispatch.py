@@ -1,0 +1,437 @@
+"""Deferred micro-batched dispatch contract (ISSUE 3 tentpole).
+
+Eligible eager ``update``/``forward`` calls enqueue into a pending queue and
+flush as stacked donated-state ``lax.scan`` programs at the size/age
+threshold or at the next state observation. Pins:
+
+- queue-flushed results are BIT-EXACT against the unbatched eager oracle
+  (``np.testing.assert_array_equal`` — no tolerance widening), including
+  mid-queue observations (compute/reset/clone/pickle/state access/sync
+  surfaces), order-sensitive states (MinMax extrema, max/min reductions),
+  RNG-consuming wrappers (BootStrapper seeded replay), and compute-group
+  collections;
+- ``forward`` returns a lazy handle that forces the flush only when read;
+- flush dispatch count amortizes (one stacked program per bucket, not one
+  per call), observable via ``engine.engine_stats()``;
+- ``METRICS_TPU_DEFER=0`` / ``set_deferred_dispatch(False)`` restores the
+  PR-1 per-call fused dispatch exactly (single-step program builds again).
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine
+from metrics_tpu.utils import checks
+
+RNG = np.random.RandomState(11)
+P = jnp.asarray(RNG.rand(64).astype(np.float32))
+T = jnp.asarray(RNG.randint(0, 2, 64))
+A = jnp.asarray(RNG.rand(48).astype(np.float32))
+B = jnp.asarray(RNG.rand(48).astype(np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _first_mode_deferred():
+    checks.set_validation_mode("first")
+    engine.set_deferred_dispatch(True)
+    yield
+    engine.set_deferred_dispatch(True)
+    checks.set_validation_mode("first")
+
+
+def _with_deferral(enabled, fn):
+    engine.set_deferred_dispatch(enabled)
+    try:
+        return fn()
+    finally:
+        engine.set_deferred_dispatch(True)
+
+
+def _assert_tree_equal(a, b):
+    la, ta = jax.tree.flatten(a)
+    lb, tb = jax.tree.flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestUpdateQueue:
+    def test_updates_enqueue_and_flush_amortized(self):
+        m = mt.Accuracy()
+        m.update(P, T)  # first call per signature: eager, validated
+        s0 = engine.engine_stats()
+        for _ in range(16):
+            m.update(P, T)
+        assert m._defer_pending is not None
+        assert len(m._defer_pending.entries) == 16
+        assert engine.engine_stats()["deferred_steps"] - s0["deferred_steps"] == 16
+        value = m.compute()  # observation: one stacked flush
+        assert m._defer_pending is None
+        s1 = engine.engine_stats()
+        assert s1["deferred_flushes"] - s0["deferred_flushes"] == 1
+
+        def oracle():
+            e = mt.Accuracy()
+            for _ in range(17):
+                e.update(P, T)
+            return e.compute()
+
+        np.testing.assert_array_equal(np.asarray(value), np.asarray(_with_deferral(False, oracle)))
+
+    def test_direct_state_access_is_an_observation(self):
+        m = mt.MeanSquaredError()
+        m.update(A, B)
+        for _ in range(5):
+            m.update(A, B)
+        assert "sum_squared_error" not in m.__dict__  # popped while pending
+        total = m.sum_squared_error  # __getattr__ barrier flushes
+
+        def oracle():
+            e = mt.MeanSquaredError()
+            for _ in range(6):
+                e.update(A, B)
+            return e.sum_squared_error
+
+        np.testing.assert_array_equal(np.asarray(total), np.asarray(_with_deferral(False, oracle)))
+
+    def test_size_threshold_triggers_flush(self):
+        engine.set_deferred_dispatch(True, max_pending=4)
+        try:
+            m = mt.MeanMetric()
+            x = jnp.asarray(RNG.rand(8).astype(np.float32))
+            m.update(x)
+            for _ in range(4):
+                m.update(x)
+            # the 4th enqueue hit the threshold and flushed
+            assert m._defer_pending is None
+        finally:
+            engine.set_deferred_dispatch(True, max_pending=128)
+
+    def test_signature_change_flushes_in_enqueue_order(self):
+        short = jnp.asarray(RNG.rand(16).astype(np.float32))
+
+        def run():
+            m = mt.MeanMetric()
+            for _ in range(2):  # license both signatures
+                m.update(A)
+                m.update(short)
+            for _ in range(3):
+                m.update(A)
+                m.update(short)  # each switch flushes the previous queue
+            return m.compute()
+
+        deferred = run()
+        eager = _with_deferral(False, run)
+        np.testing.assert_array_equal(np.asarray(deferred), np.asarray(eager))
+
+
+class TestLazyForward:
+    def test_forward_returns_lazy_handle_bitexact(self):
+        m = mt.Accuracy()
+        first = m(P, T)
+        assert isinstance(first, jax.Array)  # first per signature: eager
+        handles = [m(P, T) for _ in range(5)]
+        assert all(isinstance(h, engine.LazyValue) for h in handles)
+        assert m._defer_pending is not None  # unread handles: no flush yet
+        vals = [float(h) for h in handles]
+
+        def oracle():
+            e = mt.Accuracy()
+            return [float(e(P, T)) for _ in range(6)]
+
+        assert [float(first)] + vals == _with_deferral(False, oracle)
+
+    def test_lazy_handle_interfaces(self):
+        m = mt.Accuracy()
+        m(P, T)
+        h = m(P, T)
+        assert isinstance(h, engine.LazyValue)
+        as_np = np.asarray(h)
+        as_jnp = jnp.asarray(h)
+        np.testing.assert_array_equal(as_np, np.asarray(as_jnp))
+        assert float(h + 1.0) == float(as_np) + 1.0
+        assert h.shape == as_jnp.shape
+        assert bool(h <= 1.0)
+        assert f"{float(h):.3f}" == f"{float(as_np):.3f}"
+
+    def test_unread_handles_resolve_at_state_observation(self):
+        m = mt.Accuracy()
+        m(P, T)
+        handles = [m(P, T) for _ in range(3)]
+        _ = m.compute()  # observation flushes the queue
+        assert all(h._ready for h in handles)
+
+
+MIX_CASES = [
+    ("Accuracy", lambda: mt.Accuracy(), (P, T)),
+    ("MSE", lambda: mt.MeanSquaredError(), (A, B)),
+    ("MeanMetric", lambda: mt.MeanMetric(), (A,)),
+    ("MaxMetric", lambda: mt.MaxMetric(), (A,)),  # order-sensitive reduction spec
+    ("MinMetric", lambda: mt.MinMetric(), (A,)),
+]
+
+
+class TestMidQueueObservationOrdering:
+    """Interleave update/compute/reset/clone/pickle/sync with a NON-EMPTY
+    queue and pin bit-exact equality with the unbatched eager oracle."""
+
+    @pytest.mark.parametrize("name,factory,batch", MIX_CASES, ids=[c[0] for c in MIX_CASES])
+    def test_interleaved_script_bitexact(self, name, factory, batch):
+        def script(m):
+            out = []
+            m.update(*batch)
+            m.update(*batch)
+            out.append(m.compute())          # mid-queue compute
+            m.update(*batch)
+            out.append(m(*batch))            # forward mixed into update stream
+            m.update(*batch)
+            c = m.clone()                    # mid-queue clone (deepcopy)
+            out.append(c.compute())
+            m.update(*batch)
+            m2 = pickle.loads(pickle.dumps(m))  # mid-queue pickle
+            out.append(m2.compute())
+            m.sync(should_sync=False)        # explicit sync surface (no-op dist)
+            out.append(m.metric_state)       # state observation
+            m.reset()                        # mid-script reset
+            m.update(*batch)
+            out.append(m.compute())
+            return out
+
+        deferred = script(factory())
+        eager = _with_deferral(False, lambda: script(factory()))
+        for d, e in zip(deferred, eager):
+            _assert_tree_equal(
+                jax.tree.map(lambda v: np.asarray(v), d if not isinstance(d, engine.LazyValue) else d._force()),
+                jax.tree.map(lambda v: np.asarray(v), e),
+            )
+
+    def test_minmax_wrapper_interleaved(self):
+        p2 = jnp.asarray(RNG.rand(64).astype(np.float32))
+
+        def script(m):
+            out = []
+            out.append(m(P, T))
+            out.append(m(p2, T))
+            out.append(m.compute())
+            out.append(m(P, T))
+            out.append(m.compute())
+            return jax.tree.map(lambda v: np.asarray(v), out)
+
+        deferred = script(mt.MinMaxMetric(mt.Accuracy()))
+        eager = _with_deferral(False, lambda: script(mt.MinMaxMetric(mt.Accuracy())))
+        _assert_tree_equal(deferred, eager)
+
+    def test_bootstrapper_rng_replay(self):
+        def script(seed):
+            b = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=4)
+            b._rng = np.random.RandomState(seed)
+            out = []
+            b.update(A, B)
+            b.update(A, B)
+            out.append(b.compute())          # mid-stream observation
+            b.update(A, B)
+            b.update(A, B)
+            out.append(b.compute())
+            out.append([m.metric_state for m in b.metrics])
+            return jax.tree.map(lambda v: np.asarray(v), out)
+
+        deferred = script(3)
+        eager = _with_deferral(False, lambda: script(3))
+        _assert_tree_equal(deferred, eager)
+
+
+class TestCollections:
+    C = 4
+
+    def _data(self):
+        rng = np.random.RandomState(5)
+        probs = rng.rand(32, self.C).astype(np.float32)
+        probs /= probs.sum(1, keepdims=True)
+        return jnp.asarray(probs), jnp.asarray(rng.randint(0, self.C, 32))
+
+    def _suite(self):
+        # Precision/Recall share identical stat states → one compute group
+        return mt.MetricCollection(
+            {
+                "prec": mt.Precision(num_classes=self.C, average="macro"),
+                "rec": mt.Recall(num_classes=self.C, average="macro"),
+                "acc": mt.Accuracy(num_classes=self.C, average="macro"),
+            }
+        )
+
+    def test_update_uses_one_suite_queue(self):
+        p, t = self._data()
+        col = self._suite()
+        col.update(p, t)  # first call: member-wise, groups derived
+        assert col._groups_checked
+        for _ in range(6):
+            col.update(p, t)
+        q = col._defer_pending
+        assert q is not None and q.kind == "collection-update"
+        assert len(q.entries) == 6
+
+        def oracle():
+            c = self._suite()
+            for _ in range(7):
+                c.update(p, t)
+            return c.compute()
+
+        res = col.compute()
+        eager = _with_deferral(False, oracle)
+        assert set(res) == set(eager)
+        for k in res:
+            np.testing.assert_array_equal(np.asarray(res[k]), np.asarray(eager[k]))
+
+    def test_forward_interleaved_with_compute(self):
+        p, t = self._data()
+
+        def script(c):
+            out = []
+            out.append(c(p, t))
+            out.append(c(p, t))
+            out.append(c.compute())   # mid-queue observation
+            out.append(c(p, t))
+            c.reset()
+            out.append(c(p, t))
+            out.append(c.compute())
+            return out
+
+        deferred = script(self._suite())
+        eager = _with_deferral(False, lambda: script(self._suite()))
+        for d, e in zip(deferred, eager):
+            assert set(d) == set(e)
+            for k in e:
+                dv = d[k]._force() if isinstance(d[k], engine.LazyValue) else d[k]
+                np.testing.assert_array_equal(np.asarray(dv), np.asarray(e[k]))
+
+    def test_new_kwarg_mid_queue_is_not_dropped(self):
+        """A kwarg appearing after the suite queue opened (e.g. a weight a
+        member optionally consumes) must leave the fast path — not be
+        silently filtered to the queue-opening call's kwarg set."""
+        x = jnp.asarray(RNG.rand(16).astype(np.float32))
+        w = jnp.asarray((RNG.rand(16) * 2).astype(np.float32))
+
+        def script(c):
+            c.update(x)
+            c.update(x, weight=w)  # license both signatures
+            for _ in range(3):
+                c.update(x)        # opens the no-kwarg queue
+            c.update(x, weight=w)  # NEW kwarg: must flush + take its own path
+            c.update(x, weight=w)
+            return c.compute()
+
+        make = lambda: mt.MetricCollection({"mean": mt.MeanMetric()})
+        deferred = script(make())
+        eager = _with_deferral(False, lambda: script(make()))
+        for k in eager:
+            np.testing.assert_array_equal(np.asarray(deferred[k]), np.asarray(eager[k]))
+
+    def test_mode_switch_mid_queue_regains_full_validation(self):
+        """Switching to validation mode 'full' while a suite queue is open
+        must stop enqueueing immediately (per-call checks resume)."""
+        p, t = self._data()
+        col = self._suite()
+        col.update(p, t)
+        for _ in range(3):
+            col.update(p, t)
+        assert col._defer_pending is not None
+        checks.set_validation_mode("full")
+        try:
+            col.update(p, t)  # flushes the queue, runs fully validated
+            assert col._defer_pending is None
+        finally:
+            checks.set_validation_mode("first")
+
+    def test_member_state_access_flushes_suite_queue(self):
+        p, t = self._data()
+        col = self._suite()
+        col.update(p, t)
+        for _ in range(3):
+            col.update(p, t)
+        assert col._defer_pending is not None
+        # direct member state access is an observation of the WHOLE suite
+        _ = col["acc"].compute()
+        assert col._defer_pending is None
+
+
+class TestEscapeHatch:
+    def test_defer_off_restores_per_call_fused_dispatch(self):
+        engine.set_deferred_dispatch(False)
+        try:
+            m = mt.Accuracy()
+            for _ in range(4):
+                m.update(P, T)
+            # the PR-1 contract: single-step fused program built and no queue
+            assert m._fused_update_program is not None
+            assert m._defer_pending is None
+        finally:
+            engine.set_deferred_dispatch(True)
+
+    def test_env_var_controls_default(self, monkeypatch):
+        import metrics_tpu.ops.engine as eng
+
+        monkeypatch.setattr(eng, "_defer_enabled", None)
+        monkeypatch.setenv("METRICS_TPU_DEFER", "0")
+        assert not eng.defer_enabled()
+        monkeypatch.setattr(eng, "_defer_enabled", None)
+        monkeypatch.delenv("METRICS_TPU_DEFER", raising=False)
+        assert eng.defer_enabled()
+        monkeypatch.setattr(eng, "_defer_enabled", None)
+
+    def test_full_validation_mode_disables_deferral(self):
+        checks.set_validation_mode("full")
+        m = mt.Accuracy()
+        for _ in range(3):
+            m.update(P, T)
+        assert m._defer_pending is None
+
+    def test_flush_failure_replays_eagerly_and_disables(self, monkeypatch):
+        m = mt.MeanMetric()
+        m.update(A)
+        for _ in range(3):
+            m.update(A)
+        assert m._defer_pending is not None
+        # force the stacked flush to die: the queue must replay eagerly,
+        # keep the values exact, and disable deferral for the instance
+        monkeypatch.setattr(
+            type(m), "_build_deferred_update", lambda self, *a: (_ for _ in ()).throw(RuntimeError("boom"))
+        )
+        with pytest.warns(UserWarning, match="Replaying the queue eagerly"):
+            value = m.compute()
+        assert not m._defer_ok
+
+        def oracle():
+            e = mt.MeanMetric()
+            for _ in range(4):
+                e.update(A)
+            return e.compute()
+
+        np.testing.assert_array_equal(np.asarray(value), np.asarray(_with_deferral(False, oracle)))
+        # later updates keep working on the per-call path
+        m.update(A)
+        assert m._defer_pending is None
+
+
+class TestProgramSharing:
+    def test_flush_shares_forward_many_scan_program(self):
+        """The deferred flush acquires through the same engine key as
+        forward_many — one compiled scan program serves both."""
+        engine.reset_engine()
+        m = mt.Accuracy()
+        m(P, T)
+        for _ in range(4):
+            m(P, T)
+        _ = m.compute()  # flush: builds the "many" program for this layout
+        builds_after_flush = engine.engine_stats()["builds"]
+        m2 = mt.Accuracy()
+        stacked_p = jnp.stack([P] * 4)
+        stacked_t = jnp.stack([T] * 4)
+        m2.forward_many(stacked_p, stacked_t)  # first chunk: eager replay
+        m2.forward_many(stacked_p, stacked_t)  # scan path: cache hit expected
+        assert engine.engine_stats()["builds"] == builds_after_flush

@@ -33,8 +33,13 @@ RNG = np.random.RandomState(7)
 
 @pytest.fixture(autouse=True)
 def _first_mode():
+    # this file pins the PER-CALL fused dispatch contract — exactly the
+    # behavior METRICS_TPU_DEFER=0 preserves; the deferred-queue analogues
+    # live in tests/bases/test_deferred_dispatch.py
     checks.set_validation_mode("first")
+    engine.set_deferred_dispatch(False)
     yield
+    engine.set_deferred_dispatch(True)
     checks.set_validation_mode("first")
 
 

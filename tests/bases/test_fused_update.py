@@ -19,6 +19,7 @@ import numpy as np
 import pytest
 
 import metrics_tpu as mt
+from metrics_tpu.ops import engine
 from metrics_tpu.utils import checks
 
 RNG = np.random.RandomState(3)
@@ -30,8 +31,13 @@ BATCHES = [
 
 @pytest.fixture(autouse=True)
 def _first_mode():
+    # this file pins the PER-CALL fused dispatch contract — exactly the
+    # behavior METRICS_TPU_DEFER=0 preserves; the deferred-queue analogues
+    # live in tests/bases/test_deferred_dispatch.py
     checks.set_validation_mode("first")
+    engine.set_deferred_dispatch(False)
     yield
+    engine.set_deferred_dispatch(True)
     checks.set_validation_mode("first")
 
 

@@ -138,16 +138,37 @@ class TestAggregatorNanGate:
 
 
 class TestDefaultModeAndEvictions:
-    def test_default_mode_is_first(self, mode, monkeypatch):
-        """Out-of-box behavior IS the benched behavior: with no env var set,
-        the mode resolves to "first" and the fused fast paths engage."""
+    def test_default_mode_is_full(self, mode, monkeypatch):
+        """Out of the box, EVERY update is value-checked: with no env var
+        set the mode resolves to "full", so a later invalid batch (e.g. a
+        NaN reaching CatMetric(nan_strategy='error')) raises on the
+        offending call. "first" — the benched fast-path mode — is an
+        explicit opt-in via METRICS_TPU_VALIDATION=first."""
         monkeypatch.delenv("METRICS_TPU_VALIDATION", raising=False)
         checks._validation_mode = None  # force re-resolution from env
         try:
+            assert checks._get_validation_mode() == "full"
+            monkeypatch.setenv("METRICS_TPU_VALIDATION", "first")
+            checks._validation_mode = None
             assert checks._get_validation_mode() == "first"
         finally:
             checks._validation_mode = None
             mode("first")  # fixture restore path needs a concrete mode
+
+    def test_default_mode_catches_later_invalid_batch(self, mode, monkeypatch):
+        """The advisor round-5 regression scenario: under the out-of-the-box
+        default, a NaN arriving on the SECOND batch (same signature as a
+        clean first batch) still raises on the offending call."""
+        monkeypatch.delenv("METRICS_TPU_VALIDATION", raising=False)
+        checks._validation_mode = None
+        try:
+            m = mt.CatMetric(nan_strategy="error")
+            m.update(jnp.asarray([1.0, 2.0]))
+            with pytest.raises(RuntimeError, match="Encounted `nan`"):
+                m.update(jnp.asarray([1.0, float("nan")]))
+        finally:
+            checks._validation_mode = None
+            mode("first")
 
     def test_eviction_counter_warns_once_on_churn(self, mode, monkeypatch):
         mode("first")
@@ -165,6 +186,16 @@ class TestDefaultModeAndEvictions:
 
 
 class TestFusedCountElision:
+    @pytest.fixture(autouse=True)
+    def _per_call_dispatch(self):
+        # count elision is a property of the PER-CALL fused program
+        # (the METRICS_TPU_DEFER=0 path); deferred loops never build it
+        from metrics_tpu.ops import engine
+
+        engine.set_deferred_dispatch(False)
+        yield
+        engine.set_deferred_dispatch(True)
+
     def test_mean_reduced_state_metric_keeps_count_path(self, mode):
         """PSNR's data_range state reduces by 'mean' — the fused program must
         keep the update_count argument and stay value-equal to eager."""

@@ -78,6 +78,7 @@ class TestBatchedFallbackWarning:
         round 5 the eval_shape probe declines fusion with NO warning (an
         untraceable update is a supported configuration) and the eager path
         keeps accumulating permanently."""
+        from metrics_tpu.ops import engine
         from metrics_tpu.utils import checks
 
         fs = 10000
@@ -88,14 +89,29 @@ class TestBatchedFallbackWarning:
         prev_mode = checks._get_validation_mode()
         checks.set_validation_mode("first")
         try:
+            engine.set_deferred_dispatch(False)  # pin the per-call probe path
             stoi.update(preds, target)  # first signature call: eager
             with warnings.catch_warnings():
                 warnings.simplefilter("error")  # a fused-fallback warning fails here
                 stoi.update(preds, target)  # probe declines quietly
+            assert stoi._fused_update_ok is False
+            stoi.update(preds, target)
+
+            # the DEFERRED flush declines just as silently: enqueued calls
+            # hit the eval_shape probe at flush and replay eagerly, no warning
+            engine.set_deferred_dispatch(True)
+            stoi2 = mt.ShortTimeObjectiveIntelligibility(fs)
+            stoi2.update(preds, target)
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                stoi2.update(preds, target)
+                stoi2.update(preds, target)
+                _ = stoi2.metric_state  # observation: probe + silent replay
+            assert stoi2._defer_ok is False
+            assert stoi2._update_count == 3
         finally:
+            engine.set_deferred_dispatch(True)
             checks.set_validation_mode(prev_mode)
-        assert stoi._fused_update_ok is False
-        stoi.update(preds, target)
         assert stoi._update_count == 3
         assert jnp.isfinite(stoi.compute())
 

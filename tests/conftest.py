@@ -12,6 +12,14 @@ _FLAG = "--xla_force_host_platform_device_count=8"
 if _FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
 
+# The suite runs in validation mode "first" — the documented throughput
+# configuration, which is what engages the fused one-program paths AND the
+# deferred micro-batched dispatch queue, so the whole tier-1 surface
+# exercises queue-flushed execution against its eager oracles. (The LIBRARY
+# default is "full"; tests that pin the out-of-the-box default clear this
+# env var and reset the cached mode themselves.)
+os.environ.setdefault("METRICS_TPU_VALIDATION", "first")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

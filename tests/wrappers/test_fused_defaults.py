@@ -64,7 +64,7 @@ class TestPoissonBootstrap:
         ]
         fused, eager = self._run(mt.MeanSquaredError, batches)
         assert fused._boot_program is not None, "poisson fused path never engaged"
-        assert fused._poisson_certified
+        assert fused._poisson_cert_done > 0
         for key in ("mean", "std"):
             np.testing.assert_allclose(
                 float(fused.compute()[key]), float(eager.compute()[key]), rtol=1e-4, atol=1e-6
@@ -159,7 +159,7 @@ class TestMultioutputRemoveNans:
             fused.update(p, t)
             eager.update(p, t)
         assert fused._mo_program is not None, "remove_nans fused path never engaged"
-        assert fused._mo_certified
+        assert fused._mo_cert_done > 0
         np.testing.assert_allclose(
             [float(v) for v in fused.compute()],
             [float(v) for v in eager.compute()],
