@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep ci clean convert-weights test-real-weights
+.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep faults ci clean convert-weights test-real-weights
 
 # All targets run offline against the already-installed environment
 # (jax/flax/optax/pytest are assumed present — no network access needed).
@@ -59,8 +59,15 @@ bench-smoke:
 sweep:
 	$(PY) tools/bench_sweep.py
 
+# Fault-injection sweep: every named site (probe/compile/flush-chunk-k/
+# donation/sync-gather/host-offload) across a representative metric set,
+# asserting bit-exactness vs the eager oracle and ladder recovery
+# (docs/robustness.md).
+faults:
+	$(PY) tools/fault_sweep.py
+
 # What CI runs, in order (see .github/workflows/ci.yml).
-ci: docs doctest test-fast dryrun bench-smoke test-full
+ci: docs doctest test-fast dryrun faults bench-smoke test-full
 
 clean:
 	rm -rf .pytest_cache tests/.pytest_cache .mypy_cache

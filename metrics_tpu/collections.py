@@ -25,8 +25,16 @@ from metrics_tpu.metric import (
     _propagate_static_attrs,
 )
 from metrics_tpu.ops import engine as _engine
+from metrics_tpu.ops import faults as _faults
 from metrics_tpu.utils.data import _flatten_dict, allclose
 from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _member_state_snapshot(m: Metric) -> Dict[str, Any]:
+    """Reference snapshot of a member's array states (the suite fast paths
+    exclude list states, and jax arrays are immutable — holding references
+    IS a valid snapshot)."""
+    return {s: getattr(m, s) for s in m._defaults}
 
 
 class MetricCollection:
@@ -92,7 +100,12 @@ class MetricCollection:
         fused = self._forward_fused(*args, **kwargs)
         if fused is not None:
             return fused
-        return self._forward_member_wise(list(self.items(keep_base=True, copy_state=False)), *args, **kwargs)
+        result = self._forward_member_wise(
+            list(self.items(keep_base=True, copy_state=False)), *args, **kwargs
+        )
+        # clean member-wise step: demoted suite lanes count toward recovery
+        self._fault_note_clean()
+        return result
 
     def _forward_member_wise(self, members: List[Tuple[str, Metric]], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in members}
@@ -193,6 +206,7 @@ class MetricCollection:
                 merged, values = self._fused_program(states, count, *args, **consumed)
         except Exception as exc:
             if states is not None and not _engine.state_intact(states):
+                _faults.note_fault("donation", site="suite-forward", owner=self, error=exc)
                 raise RuntimeError(
                     f"Whole-suite fused forward failed after donating member state "
                     f"buffers ({type(exc).__name__}: {exc}); the accumulated states are "
@@ -204,11 +218,18 @@ class MetricCollection:
             # If the fallback raises too, the input was bad: surface it and
             # keep the fused path enabled.
             result = self._forward_member_wise(members, *args, **kwargs)
-            rank_zero_warn(
-                f"Whole-suite fused forward for this MetricCollection raised "
-                f"{type(exc).__name__}: {exc}. Falling back to member-wise "
-                "forwards permanently for this collection — expect higher "
-                "per-step overhead. Construct a fresh collection to retry fusion."
+            _faults.demote(
+                self,
+                "forward",
+                exc,
+                site="suite-forward",
+                warn=(
+                    f"Whole-suite fused forward for this MetricCollection raised "
+                    f"{type(exc).__name__}: {exc}. Falling back to member-wise "
+                    "forwards for this collection — expect higher per-step "
+                    "overhead; the degradation ladder re-probes the fused path "
+                    "after clean steps."
+                ),
             )
             self._fused_disabled = True
             self._fused_program = None
@@ -226,6 +247,7 @@ class MetricCollection:
             m._to_sync = m.sync_on_compute
             m._computed = None
             m._forward_cache = values[name]
+        self._fault_note_clean()
         res = _flatten_dict(values)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -259,6 +281,39 @@ class MetricCollection:
         q = self.__dict__.get("_defer_pending")
         if q is not None:
             q.flush()
+
+    # --------------------------------------------------- failure-domain ladder
+    # Suite-level lanes mirror Metric's: "forward" (_fused_disabled), "defer"
+    # (_defer_ok), "many" (_many_ok). Demotions are classified and deduped by
+    # ops.faults; recoverable domains re-arm after clean suite steps.
+    def _fault_silent_decline(self, lane: str) -> None:
+        _faults.ladder(self, lane).demote("trace")
+
+    def _fault_note_clean(self, n: int = 1) -> None:
+        ladders = self.__dict__.get("_fault_ladders")
+        if not ladders:
+            return
+        for lane, lad in list(ladders.items()):
+            if lad.demoted and lad.note_clean(n):
+                self._fault_repromote(lane, lad)
+
+    def _fault_repromote(self, lane: str, lad: "_faults.Ladder") -> None:
+        """Recovery edge: re-arm the demoted suite path; the next eligible
+        call re-probes it (engine-cached programs make re-entry cheap)."""
+        lad.promote()
+        if lane == "forward":
+            object.__setattr__(self, "_fused_disabled", False)
+            object.__setattr__(self, "_fused_program", None)
+            object.__setattr__(self, "_fused_templates", None)
+        elif lane == "defer":
+            object.__setattr__(self, "_defer_ok", True)
+        elif lane == "many":
+            object.__setattr__(self, "_many_ok", True)
+            object.__setattr__(self, "_many_programs", None)
+            object.__setattr__(self, "_many_templates", None)
+        probed = self.__dict__.get("_defer_probed")
+        if probed is not None:
+            probed.clear()
 
     def _defer_forward(self, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:
         from metrics_tpu.ops.engine import LazyValue, defer_enabled, note_deferred_steps
@@ -502,6 +557,7 @@ class MetricCollection:
                     applied = offset + chunk_len
             except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
                 if not _eng.state_intact(states):
+                    _faults.note_fault("donation", site="suite-flush", owner=self, error=exc)
                     raise RuntimeError(
                         f"Deferred suite update flush failed after donating member state "
                         f"buffers ({type(exc).__name__}: {exc}); the accumulated states "
@@ -514,11 +570,21 @@ class MetricCollection:
                     m._update_count -= len(entries) - applied
                 self._repoint_groups()
                 object.__setattr__(self, "_defer_ok", False)
-                if not isinstance(exc, _DeferProbeDecline):
-                    rank_zero_warn(
-                        f"Deferred suite update flush raised {type(exc).__name__}: {exc}. "
-                        "Replaying the queue eagerly and disabling deferred dispatch for "
-                        "this collection."
+                if isinstance(exc, _DeferProbeDecline):
+                    self._fault_silent_decline("defer")
+                else:
+                    _faults.demote(
+                        self,
+                        "defer",
+                        exc,
+                        tier="chunked",
+                        site="suite-flush",
+                        warn=(
+                            f"Deferred suite update flush raised {type(exc).__name__}: {exc}. "
+                            "Replaying the queue eagerly and disabling deferred dispatch for "
+                            "this collection; the degradation ladder re-probes deferral "
+                            "after clean steps."
+                        ),
                     )
                 _eng.note_deferred_flush(fallback=True)
                 # suspend the leaders so the replay fully materializes
@@ -527,13 +593,40 @@ class MetricCollection:
                     object.__setattr__(m, "_defer_suspended", True)
                 try:
                     for a, k in entries[applied:]:
-                        for cg in self._groups.values():
-                            m0 = self._modules[cg[0]]
-                            m0.update(*a, **m0._filter_kwargs(**k))
-                            for name in cg[1:]:
-                                mi = self._modules[name]
-                                mi._update_count = m0._update_count
-                                mi._computed = None
+                        # per-entry snapshot across EVERY leader: a failure
+                        # mid-entry must never leave one member updated and
+                        # another pending (suite atomicity — the collection
+                        # analogue of forward's entry-snapshot restore)
+                        snap = {
+                            name: (_member_state_snapshot(m), m._update_count)
+                            for name, m in leaders
+                        }
+                        try:
+                            for cg in self._groups.values():
+                                m0 = self._modules[cg[0]]
+                                m0.update(*a, **m0._filter_kwargs(**k))
+                                for name in cg[1:]:
+                                    mi = self._modules[name]
+                                    mi._update_count = m0._update_count
+                                    mi._computed = None
+                        except Exception:
+                            for name, m in leaders:
+                                st, cnt = snap[name]
+                                for s, v in st.items():
+                                    object.__setattr__(m, s, v)
+                                object.__setattr__(m, "_update_count", cnt)
+                            # followers' counts were already synced to their
+                            # leader's bumped count inside the try — re-sync
+                            # them to the RESTORED leader counts so no member
+                            # is left ahead of its group
+                            for cg in self._groups.values():
+                                m0 = self._modules[cg[0]]
+                                for gname in cg[1:]:
+                                    mi = self._modules[gname]
+                                    object.__setattr__(mi, "_update_count", m0._update_count)
+                                    object.__setattr__(mi, "_computed", None)
+                            self._repoint_groups()
+                            raise
                 finally:
                     for _, m in leaders:
                         object.__setattr__(m, "_defer_suspended", False)
@@ -546,6 +639,7 @@ class MetricCollection:
                     _propagate_static_attrs(templates[name], m)
             self._repoint_groups()
             _eng.note_deferred_flush()
+            self._fault_note_clean(len(entries))
         finally:
             object.__setattr__(self, "_defer_suspended", False)
 
@@ -584,6 +678,7 @@ class MetricCollection:
                     applied = offset + chunk_len
             except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
                 if not _eng.state_intact(states):
+                    _faults.note_fault("donation", site="suite-flush", owner=self, error=exc)
                     raise RuntimeError(
                         f"Deferred suite forward flush failed after donating member state "
                         f"buffers ({type(exc).__name__}: {exc}); the accumulated states "
@@ -595,11 +690,21 @@ class MetricCollection:
                         object.__setattr__(m, s, v)
                     m._update_count = count0 + applied
                 object.__setattr__(self, "_defer_ok", False)
-                if not isinstance(exc, _DeferProbeDecline):
-                    rank_zero_warn(
-                        f"Deferred suite forward flush raised {type(exc).__name__}: {exc}. "
-                        "Replaying the queue eagerly and disabling deferred dispatch for "
-                        "this collection."
+                if isinstance(exc, _DeferProbeDecline):
+                    self._fault_silent_decline("defer")
+                else:
+                    _faults.demote(
+                        self,
+                        "defer",
+                        exc,
+                        tier="chunked",
+                        site="suite-flush",
+                        warn=(
+                            f"Deferred suite forward flush raised {type(exc).__name__}: {exc}. "
+                            "Replaying the queue eagerly and disabling deferred dispatch for "
+                            "this collection; the degradation ladder re-probes deferral "
+                            "after clean steps."
+                        ),
                     )
                 _eng.note_deferred_flush(fallback=True)
                 for _, m in members:
@@ -607,10 +712,29 @@ class MetricCollection:
                 try:
                     for j in range(applied, len(entries)):
                         a, k = entries[j]
+                        # per-entry snapshot across EVERY member: a failure
+                        # mid-entry must never leave one member stepped and
+                        # another pending
+                        snap = {
+                            name: (_member_state_snapshot(m), m._update_count)
+                            for name, m in members
+                        }
+                        vals = {}
+                        try:
+                            for name, m in members:
+                                vals[name] = m._forward_reduce_state_update_eager(
+                                    *a, **m._filter_kwargs(**k)
+                                )
+                        except Exception:
+                            for name, m in members:
+                                st, cnt = snap[name]
+                                for s, v in st.items():
+                                    object.__setattr__(m, s, v)
+                                object.__setattr__(m, "_update_count", cnt)
+                            raise
                         for name, m in members:
-                            val = m._forward_reduce_state_update_eager(*a, **m._filter_kwargs(**k))
-                            object.__setattr__(m, "_forward_cache", val)
-                            handles[j][name]._set_value(val)
+                            object.__setattr__(m, "_forward_cache", vals[name])
+                            handles[j][name]._set_value(vals[name])
                 finally:
                     for _, m in members:
                         object.__setattr__(m, "_defer_suspended", False)
@@ -622,6 +746,7 @@ class MetricCollection:
                 if templates is not None:
                     _propagate_static_attrs(templates[name], m)
             _eng.note_deferred_flush()
+            self._fault_note_clean(len(entries))
         finally:
             object.__setattr__(self, "_defer_suspended", False)
 
@@ -763,6 +888,7 @@ class MetricCollection:
                 merged, values = program(states, count, scanned, array_consts)
         except Exception as exc:
             if states is not None and not _engine.state_intact(states):
+                _faults.note_fault("donation", site="suite-many", owner=self, error=exc)
                 raise RuntimeError(
                     f"Batched-step suite program failed after donating member state "
                     f"buffers ({type(exc).__name__}: {exc}); the accumulated states are "
@@ -771,10 +897,18 @@ class MetricCollection:
             # eager fallback; only the BATCHED suite path is disabled — the
             # single-step fused forward keeps its own _fused_disabled flag
             result = self._run_many_eager(with_values, args, kwargs)
-            rank_zero_warn(
-                f"Batched-step suite program for this MetricCollection raised "
-                f"{type(exc).__name__}: {exc}. Falling back to per-step eager "
-                "forwards permanently for this collection's batched API."
+            _faults.demote(
+                self,
+                "many",
+                exc,
+                tier="chunked",
+                site="suite-many",
+                warn=(
+                    f"Batched-step suite program for this MetricCollection raised "
+                    f"{type(exc).__name__}: {exc}. Falling back to per-step eager "
+                    "forwards for this collection's batched API; recoverable "
+                    "failures re-probe after clean steps."
+                ),
             )
             self._many_ok = False
             self._many_programs = None
@@ -792,6 +926,7 @@ class MetricCollection:
             m._computed = None
             if with_values:
                 m._forward_cache = jax.tree.map(lambda v: v[-1], values[name])
+        self._fault_note_clean(n_steps)
         if with_values:
             res = _flatten_dict({name: values[name] for name, _ in members})
             return {self._set_name(k): v for k, v in res.items()}
@@ -857,6 +992,9 @@ class MetricCollection:
                 self._merge_compute_groups()
                 self._compute_groups_create_state_ref()
                 self._groups_checked = True
+        # clean suite step at whatever tier ran: demoted suite lanes count
+        # toward their recovery edge
+        self._fault_note_clean()
 
     def compute(self) -> Dict[str, Any]:
         res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
@@ -1058,6 +1196,9 @@ class MetricCollection:
             "_many_layouts",
             "_defer_pending",
             "_defer_probed",
+            # per-process health bookkeeping, not suite state
+            "_fault_ladders",
+            "_fault_warned",
         )
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.ops import engine as _engine
+from metrics_tpu.ops import faults as _faults
 from metrics_tpu.parallel.collectives import sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
 from metrics_tpu.parallel.sync import distributed_available as _dist_available
@@ -62,9 +63,13 @@ def _probe_traceable(program: Callable, *args: Any, **kwargs: Any) -> bool:
     SILENTLY: an untraceable configuration is supported, not an anomaly worth
     a per-instance warning; only post-probe runtime failures warn."""
     try:
+        if _faults.armed:
+            _faults.maybe_fail("probe")
         jax.eval_shape(program, *args, **kwargs)
         return True
-    except Exception:  # noqa: BLE001 — any trace failure means "decline"
+    except Exception as exc:  # noqa: BLE001 — any trace failure means "decline"
+        # classified for telemetry (trace domain), still silent for the user
+        _faults.note_fault("trace", site="probe", error=exc)
         return False
 
 
@@ -360,6 +365,9 @@ class Metric(ABC):
 
             self._computed = None
             self._update_count += 1
+            # set when THIS call records a demotion: the call that failed
+            # must not also count itself as a clean step toward recovery
+            demoted_this_call = False
             # fused bare-update: for sum/mean/max/min array-state metrics the
             # whole update runs as ONE cached jitted program per input
             # signature (same gating contract as the fused forward: first
@@ -392,27 +400,52 @@ class Metric(ABC):
                         self._defer_enqueue_update(signature, args, kwargs)
                         return
                     state = {name: getattr(self, name) for name in self._defaults}
-                    program = self._fused_update_program
-                    if program is None:
-                        program = self._build_fused_update()
-                        if _probe_traceable(program, state, *args, **kwargs):
-                            self._license_fused_signature(signature)
-                            object.__setattr__(self, "_fused_update_program", program)
+                    try:
+                        program = self._fused_update_program
+                        if program is None:
+                            program = self._build_fused_update()
+                            if _probe_traceable(program, state, *args, **kwargs):
+                                self._license_fused_signature(signature)
+                                object.__setattr__(self, "_fused_update_program", program)
+                            else:
+                                # probe declined: plain eager from here on —
+                                # silent (trace domain is structural), but the
+                                # ladder records the demotion for telemetry
+                                self._fault_silent_decline("update")
+                                object.__setattr__(self, "_fused_update_ok", False)
+                                object.__setattr__(self, "_fused_update_template", None)
+                                signature = None
+                            run_fused = self._fused_update_program is not None
+                        elif isinstance(program, _engine.Executable):
+                            # each FIRST-SEEN signature is probed before it runs
+                            # fused: an untraceable second signature declines
+                            # silently (eager for that signature only) instead of
+                            # surfacing as a runtime-failure warning
+                            run_fused = self._signature_licensed(
+                                signature, program, state, *args, **kwargs
+                            )
                         else:
-                            object.__setattr__(self, "_fused_update_ok", False)
-                            object.__setattr__(self, "_fused_update_template", None)
-                            signature = None  # probe declined: plain eager from here on
-                        run_fused = self._fused_update_program is not None
-                    elif isinstance(program, _engine.Executable):
-                        # each FIRST-SEEN signature is probed before it runs
-                        # fused: an untraceable second signature declines
-                        # silently (eager for that signature only) instead of
-                        # surfacing as a runtime-failure warning
-                        run_fused = self._signature_licensed(
-                            signature, program, state, *args, **kwargs
+                            run_fused = True  # foreign program (tests): run as-is
+                    except Exception as exc:  # noqa: BLE001 — acquire/build (compile-domain) failure
+                        _faults.demote(
+                            self,
+                            "update",
+                            exc,
+                            default_domain="compile",
+                            site="compile",
+                            warn=(
+                                f"Building the fused update program for `{type(self).__name__}` "
+                                f"failed ({type(exc).__name__}: {exc}). Falling back to the "
+                                "eager per-op update for this instance; the degradation "
+                                "ladder re-probes the fused path after clean steps."
+                            ),
                         )
-                    else:
-                        run_fused = True  # foreign program (tests): run as-is
+                        object.__setattr__(self, "_fused_update_ok", False)
+                        object.__setattr__(self, "_fused_update_program", None)
+                        object.__setattr__(self, "_fused_update_template", None)
+                        run_fused = False
+                        demoted_this_call = True
+                        signature = None  # already recorded when first licensed
                 if run_fused:
                     try:
                         runner = getattr(self._fused_update_program, "run", None)
@@ -427,24 +460,34 @@ class Metric(ABC):
                             # the failing call donated the state buffers away;
                             # an eager retry would read deleted arrays — the
                             # instance cannot recover, surface that plainly
+                            _faults.note_fault("donation", site="fused-update", owner=self, error=exc)
                             raise RuntimeError(
                                 f"Fused update for `{type(self).__name__}` failed after "
                                 f"donating its state buffers ({type(exc).__name__}: {exc}); "
                                 "the accumulated state is unrecoverable — construct a "
                                 "fresh instance."
                             ) from exc
-                        rank_zero_warn(
-                            f"Fused update for `{type(self).__name__}` raised "
-                            f"{type(exc).__name__}: {exc}. Falling back to the eager "
-                            "per-op update permanently for this instance."
+                        _faults.demote(
+                            self,
+                            "update",
+                            exc,
+                            site="fused-update",
+                            warn=(
+                                f"Fused update for `{type(self).__name__}` raised "
+                                f"{type(exc).__name__}: {exc}. Falling back to the eager "
+                                "per-op update for this instance; the degradation ladder "
+                                "re-probes the fused path after clean steps."
+                            ),
                         )
                         object.__setattr__(self, "_fused_update_ok", False)
                         object.__setattr__(self, "_fused_update_program", None)
                         object.__setattr__(self, "_fused_update_template", None)
+                        demoted_this_call = True
                     else:
                         for name, value in new_state.items():
                             object.__setattr__(self, name, value)  # state leaves: no version logic
                         _propagate_static_attrs(self._fused_update_template, self)
+                        self._fault_note_clean()
                         return
             # TraceAnnotation shows up in jax.profiler / xprof timelines —
             # the analogue of the reference's TorchScript profiling markers
@@ -460,11 +503,17 @@ class Metric(ABC):
                 # recorded only AFTER the eager call validated this signature
                 self._record_fused_signature(signature)
             if self.compute_on_cpu:
-                self._move_list_states_to_host()
+                if self._move_list_states_to_host():
+                    demoted_this_call = True
             elif self._has_update_lane_hook and _get_validation_mode() != "full":
                 # the eager pass validated this call: let the metric bind its
                 # steady-state append closure for this signature
                 self._install_update_lane(args, kwargs)
+            # one clean step at whatever tier this call ran: demoted lanes
+            # (fused update/forward, deferral, host offload) count toward
+            # their recovery edge here — unless this very call demoted one
+            if not demoted_this_call:
+                self._fault_note_clean()
 
         return wrapped
 
@@ -492,12 +541,45 @@ class Metric(ABC):
         object.__setattr__(self, "_fused_update_template", exe.template)
         return exe
 
-    def _move_list_states_to_host(self) -> None:
-        """Offload list states to host RAM to free HBM (``compute_on_cpu`` analogue)."""
-        for name in self._defaults:
-            value = getattr(self, name)
-            if isinstance(value, list):
-                setattr(self, name, [np.asarray(jax.device_get(v)) for v in value])
+    def _move_list_states_to_host(self) -> bool:
+        """Offload list states to host RAM to free HBM (``compute_on_cpu`` analogue).
+
+        Host-offload is its own failure domain: a failed device→host move
+        demotes this owner's ``host`` lane — the rows simply STAY on device
+        (numerically identical, just holding HBM) and the ladder re-probes
+        the offload after clean steps. The offload is staged (convert every
+        row, then assign) so a mid-move failure never leaves a state
+        half-offloaded. Returns True when THIS call demoted the lane (the
+        caller must not count the failing call as a clean step)."""
+        if not self._host_offload_ok:
+            return False  # demoted: keep rows on device until the ladder recovers
+        try:
+            if _faults.armed:
+                _faults.maybe_fail("host-offload")
+            moved = {}
+            for name in self._defaults:
+                value = getattr(self, name)
+                if isinstance(value, list):
+                    moved[name] = [np.asarray(jax.device_get(v)) for v in value]
+        except Exception as exc:  # noqa: BLE001 — classified; state untouched
+            _faults.demote(
+                self,
+                "host",
+                exc,
+                default_domain="host",
+                site="host-offload",
+                warn=(
+                    f"Host offload (compute_on_cpu) for `{type(self).__name__}` raised "
+                    f"{type(exc).__name__}: {exc}. Keeping list states on device for "
+                    "this instance; the degradation ladder re-probes the offload "
+                    "after clean steps."
+                ),
+            )
+            object.__setattr__(self, "_host_offload_ok", False)
+            return True
+        for name, rows in moved.items():
+            setattr(self, name, rows)
+        return False
 
     # ---------------------------------------------------------------- forward
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
@@ -593,7 +675,70 @@ class Metric(ABC):
     _defer_ok: bool = True
     _defer_suspended: bool = False
 
+    # host-offload health (compute_on_cpu device→host moves): its own ladder
+    # lane — a failed offload keeps rows on device instead of failing updates
+    _host_offload_ok: bool = True
+
     _fusable_cached: Optional[bool] = None
+
+    # --------------------------------------------------- failure-domain ladder
+    # Per-lane degradation state (ops.faults.Ladder) replaces the old
+    # "fail once → warn forever" flags semantics: the boolean flags above
+    # still gate the hot paths (zero new cost per step), but every demotion
+    # is recorded with its classified domain, warnings dedupe per
+    # owner+domain, and recoverable domains (compile/runtime/donation — the
+    # transient ones) earn a recovery edge: after N clean steps the lane's
+    # flag is re-armed and the path re-probes (exponential backoff on
+    # repeated failures). Trace-domain declines stay silent and permanent
+    # (the round-5 silent-decline contract).
+    def _fault_silent_decline(self, lane: str) -> None:
+        """Record a probe decline: trace domain, no warning, no recovery."""
+        _faults.ladder(self, lane).demote("trace")
+
+    def _fault_note_clean(self, n: int = 1) -> None:
+        """Count ``n`` clean steps for every demoted lane; re-arm the lanes
+        whose recovery edge fires. Costs one dict lookup when no lane was
+        ever demoted."""
+        ladders = self.__dict__.get("_fault_ladders")
+        if not ladders:
+            return
+        for lane, lad in list(ladders.items()):
+            if lad.demoted and lad.note_clean(n):
+                self._fault_repromote(lane, lad)
+
+    def _fault_repromote(self, lane: str, lad: "_faults.Ladder") -> None:
+        """The recovery edge: re-arm the demoted path so the next eligible
+        call re-probes it (cached programs may still exist in the engine —
+        re-entry costs a cache hit plus one ``eval_shape``)."""
+        lad.promote()
+        if lane == "update":
+            object.__setattr__(self, "_fused_update_ok", True)
+            object.__setattr__(self, "_fused_update_program", None)
+            object.__setattr__(self, "_fused_update_template", None)
+        elif lane == "forward":
+            object.__setattr__(self, "_fused_forward_ok", True)
+            object.__setattr__(self, "_fused_forward", None)
+            object.__setattr__(self, "_fused_template", None)
+        elif lane == "defer":
+            object.__setattr__(self, "_defer_ok", True)
+        elif lane == "many":
+            object.__setattr__(self, "_many_ok", True)
+            object.__setattr__(self, "_many_program_vals", None)
+            object.__setattr__(self, "_many_program_novals", None)
+            object.__setattr__(self, "_many_template_vals", None)
+            object.__setattr__(self, "_many_template_novals", None)
+        elif lane == "host":
+            object.__setattr__(self, "_host_offload_ok", True)
+        elif lane.startswith("fanout:"):
+            _, ok_attr, program_attr = lane.split(":", 2)
+            object.__setattr__(self, ok_attr, True)
+            object.__setattr__(self, program_attr, None)
+        # probe verdicts were issued for the pre-failure regime; the re-armed
+        # path must re-probe before it is trusted again
+        object.__setattr__(self, "_fused_probe_results", None)
+        probed = self.__dict__.get("_defer_probed")
+        if probed is not None:
+            probed.clear()
 
     # ------------------------------------------- deferred dispatch barriers
     def _defer_barrier(self) -> None:
@@ -757,7 +902,13 @@ class Metric(ABC):
         ~log2(max_pending) step-axis shapes per signature, however raggedly
         an observation lands mid-queue."""
         offset = 0
-        for chunk_len in _engine.pow2_chunks(len(entries)):
+        for chunk_index, chunk_len in enumerate(_engine.pow2_chunks(len(entries))):
+            # "flush-chunk" fault site (indexed: flush-chunk-<k>): fires while
+            # PREPARING chunk k, i.e. BETWEEN applied chunks — the exact spot
+            # the applied-chunks counters exist to protect (a fallback must
+            # never replay an already-applied chunk)
+            if _faults.armed:
+                _faults.maybe_fail("flush-chunk", index=chunk_index)
             a_s, k_s = _engine.stack_entries(entries, offset, chunk_len)
             python_leaves, treedef, scanned_idx, aconst_idx, scanned, aconsts = (
                 self._split_many_leaves(a_s, k_s)
@@ -802,6 +953,7 @@ class Metric(ABC):
                     applied = offset + chunk_len
             except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
                 if not _engine.state_intact(state):
+                    _faults.note_fault("donation", site="deferred-flush", owner=self, error=exc)
                     raise RuntimeError(
                         f"Deferred update flush for `{type(self).__name__}` failed after "
                         f"donating its state buffers ({type(exc).__name__}: {exc}); the "
@@ -811,11 +963,21 @@ class Metric(ABC):
                 for name, value in state.items():
                     object.__setattr__(self, name, value)
                 object.__setattr__(self, "_defer_ok", False)
-                if not isinstance(exc, _DeferProbeDecline):
-                    rank_zero_warn(
-                        f"Deferred update flush for `{type(self).__name__}` raised "
-                        f"{type(exc).__name__}: {exc}. Replaying the queue eagerly and "
-                        "disabling deferred dispatch for this instance."
+                if isinstance(exc, _DeferProbeDecline):
+                    self._fault_silent_decline("defer")
+                else:
+                    _faults.demote(
+                        self,
+                        "defer",
+                        exc,
+                        tier="chunked",
+                        site="deferred-flush",
+                        warn=(
+                            f"Deferred update flush for `{type(self).__name__}` raised "
+                            f"{type(exc).__name__}: {exc}. Replaying the queue eagerly and "
+                            "disabling deferred dispatch for this instance; the degradation "
+                            "ladder re-probes deferral after clean steps."
+                        ),
                     )
                 _engine.note_deferred_flush(fallback=True)
                 done = applied
@@ -836,6 +998,9 @@ class Metric(ABC):
             if template is not None:
                 _propagate_static_attrs(template, self)
             _engine.note_deferred_flush()
+            # a fully-applied flush = len(entries) clean steps toward any
+            # demoted lane's recovery edge
+            self._fault_note_clean(len(entries))
         finally:
             object.__setattr__(self, "_defer_suspended", False)
 
@@ -901,6 +1066,7 @@ class Metric(ABC):
                     applied = offset + chunk_len
             except Exception as exc:  # noqa: BLE001 — scan decline → eager replay
                 if not _engine.state_intact(state):
+                    _faults.note_fault("donation", site="deferred-flush", owner=self, error=exc)
                     raise RuntimeError(
                         f"Deferred forward flush for `{type(self).__name__}` failed after "
                         f"donating its state buffers ({type(exc).__name__}: {exc}); the "
@@ -913,11 +1079,21 @@ class Metric(ABC):
                 # replay re-runs the eager forward per entry, which
                 # re-increments the count from the replay point
                 self._update_count = count0 + applied
-                if not isinstance(exc, _DeferProbeDecline):
-                    rank_zero_warn(
-                        f"Deferred forward flush for `{type(self).__name__}` raised "
-                        f"{type(exc).__name__}: {exc}. Replaying the queue eagerly and "
-                        "disabling deferred dispatch for this instance."
+                if isinstance(exc, _DeferProbeDecline):
+                    self._fault_silent_decline("defer")
+                else:
+                    _faults.demote(
+                        self,
+                        "defer",
+                        exc,
+                        tier="chunked",
+                        site="deferred-flush",
+                        warn=(
+                            f"Deferred forward flush for `{type(self).__name__}` raised "
+                            f"{type(exc).__name__}: {exc}. Replaying the queue eagerly and "
+                            "disabling deferred dispatch for this instance; the degradation "
+                            "ladder re-probes deferral after clean steps."
+                        ),
                     )
                 _engine.note_deferred_flush(fallback=True)
                 for j in range(applied, len(entries)):
@@ -930,6 +1106,9 @@ class Metric(ABC):
             if template is not None:
                 _propagate_static_attrs(template, self)
             _engine.note_deferred_flush()
+            # a fully-applied flush = len(entries) clean steps toward any
+            # demoted lane's recovery edge
+            self._fault_note_clean(len(entries))
         finally:
             object.__setattr__(self, "_defer_suspended", False)
 
@@ -1278,6 +1457,7 @@ class Metric(ABC):
                 merged, values = program(state, self._update_count, scanned, array_consts)
         except Exception as exc:
             if state is not None and not _engine.state_intact(state):
+                _faults.note_fault("donation", site="batched-many", owner=self, error=exc)
                 raise RuntimeError(
                     f"Batched-step program for `{type(self).__name__}` failed after "
                     f"donating its state buffers ({type(exc).__name__}: {exc}); the "
@@ -1289,10 +1469,18 @@ class Metric(ABC):
             # path). If the fallback raises too, the input was bad: surface
             # it and keep the batched path enabled.
             result = self._run_many_eager(with_values, args, kwargs)
-            rank_zero_warn(
-                f"Batched-step program for `{type(self).__name__}` raised "
-                f"{type(exc).__name__}: {exc}. Falling back to per-step eager "
-                "forwards permanently for this instance's batched API."
+            _faults.demote(
+                self,
+                "many",
+                exc,
+                tier="chunked",
+                site="batched-many",
+                warn=(
+                    f"Batched-step program for `{type(self).__name__}` raised "
+                    f"{type(exc).__name__}: {exc}. Falling back to per-step eager "
+                    "forwards for this instance's batched API; recoverable "
+                    "failures re-probe after clean steps."
+                ),
             )
             self._many_ok = False
             self._many_program_vals = None
@@ -1308,6 +1496,7 @@ class Metric(ABC):
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
         self._computed = None
+        self._fault_note_clean(n_steps)
         if with_values:
             # keep the forward contract: _forward_cache is the LAST step's
             # batch value, exactly as n sequential forward calls would leave it
@@ -1390,7 +1579,25 @@ class Metric(ABC):
             # or when the handle/state is actually read
             return self._defer_enqueue_forward(signature, args, kwargs)
         if seen and self._fused_forward is None:
-            program = self._build_fused_forward()
+            try:
+                program = self._build_fused_forward()
+            except Exception as exc:  # noqa: BLE001 — acquire/build (compile-domain) failure
+                _faults.demote(
+                    self,
+                    "forward",
+                    exc,
+                    default_domain="compile",
+                    site="compile",
+                    warn=(
+                        f"Building the fused forward program for `{type(self).__name__}` "
+                        f"failed ({type(exc).__name__}: {exc}). Falling back to the eager "
+                        "per-op path for this instance; the degradation ladder re-probes "
+                        "the fused path after clean steps."
+                    ),
+                )
+                self._fused_forward_ok = False
+                self._fused_template = None
+                return self._forward_reduce_state_update_eager(*args, **kwargs)
             state = {name: getattr(self, name) for name in self._defaults}
             probe_args = (
                 (state, self._update_count + 1, *args) if self._fused_needs_count else (state, *args)
@@ -1401,6 +1608,7 @@ class Metric(ABC):
             else:
                 # probe declined: permanently eager, and the signature is
                 # already recorded — return the eager result directly
+                self._fault_silent_decline("forward")
                 self._fused_forward_ok = False
                 self._fused_template = None
                 return self._forward_reduce_state_update_eager(*args, **kwargs)
@@ -1431,17 +1639,25 @@ class Metric(ABC):
                 # raises too, the input itself was bad: surface that error and
                 # keep the fused path enabled.
                 if not _engine.state_intact(state):
+                    _faults.note_fault("donation", site="fused-forward", owner=self, error=exc)
                     raise RuntimeError(
                         f"Fused forward for `{type(self).__name__}` failed after donating "
                         f"its state buffers ({type(exc).__name__}: {exc}); the accumulated "
                         "state is unrecoverable — construct a fresh instance."
                     ) from exc
                 result = self._forward_reduce_state_update_eager(*args, **kwargs)
-                rank_zero_warn(
-                    f"Fused forward for `{type(self).__name__}` raised "
-                    f"{type(exc).__name__}: {exc}. Falling back to the eager "
-                    "per-op path permanently for this instance — expect higher "
-                    "per-step overhead. Construct a fresh instance to retry fusion."
+                _faults.demote(
+                    self,
+                    "forward",
+                    exc,
+                    site="fused-forward",
+                    warn=(
+                        f"Fused forward for `{type(self).__name__}` raised "
+                        f"{type(exc).__name__}: {exc}. Falling back to the eager "
+                        "per-op path for this instance — expect higher per-step "
+                        "overhead; the degradation ladder re-probes the fused "
+                        "path after clean steps."
+                    ),
                 )
                 self._fused_forward_ok = False
                 self._fused_forward = None
@@ -1459,6 +1675,9 @@ class Metric(ABC):
             self._should_unsync = True
             self._to_sync = self.sync_on_compute
             self._computed = None
+            # clean fused step: demoted sibling lanes (defer, many, host)
+            # count toward their recovery edge
+            self._fault_note_clean()
             return batch_val
         result = self._forward_reduce_state_update_eager(*args, **kwargs)
         self._record_fused_signature(signature)
@@ -1578,22 +1797,50 @@ class Metric(ABC):
         if dist_sync_fn is None:
             dist_sync_fn = self.dist_sync_fn or gather_all_tensors
 
+        group = process_group or self.process_group
+        if isinstance(group, (list, tuple)) and group and not all(isinstance(g, str) for g in group):
+            # the range check deferred at construction (metrics may be built
+            # before jax.distributed initializes — see __init__) runs HERE
+            # against the LIVE world size, raising the classified SyncConfigFault
+            from metrics_tpu.parallel.sync import validate_group_live
+
+            validate_group_live(group)
+
         self._defer_barrier()
         self._canonicalize_list_states()
         self._cache = self._state_snapshot()
-        self._sync_dist(dist_sync_fn, process_group=process_group)
-        self._is_synced = True
-        # wrappers/compositions hold their accumulators in child metrics, not
-        # in their own state registry — sync recurses so the wrapper's
-        # distributed value equals the reference's module-tree sync
-        # (reference wrappers' child states are registered submodule states)
-        for child in self._sync_children():
-            child.sync(
-                dist_sync_fn=dist_sync_fn,
-                process_group=process_group,
-                should_sync=should_sync,
-                distributed_available=distributed_available,
-            )
+        try:
+            self._sync_dist(dist_sync_fn, process_group=process_group)
+            self._is_synced = True
+            # wrappers/compositions hold their accumulators in child metrics, not
+            # in their own state registry — sync recurses so the wrapper's
+            # distributed value equals the reference's module-tree sync
+            # (reference wrappers' child states are registered submodule states)
+            for child in self._sync_children():
+                child.sync(
+                    dist_sync_fn=dist_sync_fn,
+                    process_group=process_group,
+                    should_sync=should_sync,
+                    distributed_available=distributed_available,
+                )
+        except Exception as exc:
+            # a failed sync must leave local state INTACT and retryable: a
+            # mid-gather failure may have overwritten some states with merged
+            # values and not others — restore the entry snapshot, roll back
+            # any children that synced before the failure, and surface the
+            # classified error (compute() then raises instead of returning a
+            # half-synced value)
+            self._restore_state(self._cache)
+            self._cache = None
+            self._is_synced = False
+            for child in self._sync_children():
+                if child._is_synced:
+                    try:
+                        child.unsync()
+                    except Exception:  # noqa: BLE001 — best-effort rollback
+                        pass
+            _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
+            raise
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local state (reference `metric.py:452-472`)."""
@@ -1891,6 +2138,10 @@ class Metric(ABC):
             "_update_lane",
             "_fused_probe_results",
             "_default_ids_cache",
+            # fault-ladder state is per-process health bookkeeping, not
+            # metric state: a restored/cloned instance starts healthy
+            "_fault_ladders",
+            "_fault_warned",
         )
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 

@@ -1,5 +1,7 @@
 """Low-level device kernels and the dispatch engine: Pallas MXU histogram,
-binned-curve counts, segment reductions, donated-state program cache."""
+binned-curve counts, segment reductions, donated-state program cache, and
+the failure-domain engine (classified faults, degradation ladders,
+deterministic fault injection)."""
 from metrics_tpu.ops._dispatch import pallas_enabled
 from metrics_tpu.ops.binned import binned_curve_counts
 from metrics_tpu.ops.engine import (
@@ -10,6 +12,12 @@ from metrics_tpu.ops.engine import (
     donation_supported,
     engine_stats,
     reset_engine,
+)
+from metrics_tpu.ops.faults import (
+    FAULT_SITES,
+    fault_stats,
+    inject_faults,
+    set_recovery_policy,
 )
 from metrics_tpu.ops.histogram import fused_bincount
 from metrics_tpu.ops.segments import (
@@ -38,4 +46,8 @@ __all__ = [
     "donation_supported",
     "engine_stats",
     "reset_engine",
+    "FAULT_SITES",
+    "fault_stats",
+    "inject_faults",
+    "set_recovery_policy",
 ]

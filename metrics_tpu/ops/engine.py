@@ -71,6 +71,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from metrics_tpu.ops import faults as _faults
+
 __all__ = [
     "Executable",
     "LazyValue",
@@ -254,13 +256,16 @@ class Executable:
         """Execute with in-place state: the donated twin when safe for THIS
         call's buffers, else the plain twin — same trace either way."""
         kwargs = kwargs or {}
-        if (
-            donate
-            and self.donated is not None
-            and donation_supported()
-            and state_donatable(state, avoid_ids)
-        ):
-            return self.donated(state, *args, **kwargs)
+        if donate and self.donated is not None:
+            # "donation" fault site: fires where a donated execution is
+            # attempted, BEFORE any buffer is consumed — an injected
+            # DonationFault leaves the state intact so callers exercise
+            # their eager fallback exactly as a real donation violation
+            # caught pre-dispatch would
+            if _faults.armed:
+                _faults.maybe_fail("donation")
+            if donation_supported() and state_donatable(state, avoid_ids):
+                return self.donated(state, *args, **kwargs)
         return self.plain(state, *args, **kwargs)
 
     def compiled_signatures(self) -> int:
@@ -312,6 +317,11 @@ def acquire_keyed(
         _stats["hits"] += 1
         _PROGRAM_CACHE.move_to_end(key)
         return exe
+    # "compile" fault site: fires only on cache misses (a cache hit means no
+    # compile happens), so an injected CompileFault models trace/lowering
+    # failure while building a new program — callers classify and ladder down
+    if _faults.armed:
+        _faults.maybe_fail("compile")
     _stats["builds"] += 1
     step, template, aux = build()
     exe = Executable(
@@ -326,13 +336,17 @@ def acquire_keyed(
     return exe
 
 
-def engine_stats() -> Dict[str, int]:
+def engine_stats() -> Dict[str, Any]:
     """Cache effectiveness counters: ``builds`` (distinct programs traced),
     ``hits`` (program acquisitions served from cache), ``cached`` (live),
     plus deferral counters: ``deferred_steps`` (calls that enqueued instead
     of dispatching), ``deferred_flushes`` (stacked flush dispatches),
-    ``deferred_fallbacks`` (flushes that replayed eagerly)."""
-    return {
+    ``deferred_fallbacks`` (flushes that replayed eagerly) — and the
+    failure-domain telemetry from :mod:`metrics_tpu.ops.faults`: per-domain
+    ``fault_<domain>`` counters, ``fault_demotions`` / ``fault_promotions``
+    (degradation-ladder transitions), ``fault_injected``, and the bounded
+    ``failure_log`` ring buffer (newest last)."""
+    out: Dict[str, Any] = {
         "builds": _stats["builds"],
         "hits": _stats["hits"],
         "cached": len(_PROGRAM_CACHE),
@@ -340,6 +354,8 @@ def engine_stats() -> Dict[str, int]:
         "deferred_flushes": _stats["deferred_flushes"],
         "deferred_fallbacks": _stats["deferred_fallbacks"],
     }
+    out.update(_faults.fault_stats())
+    return out
 
 
 def reset_engine() -> None:
@@ -351,6 +367,7 @@ def reset_engine() -> None:
     _stats["deferred_steps"] = 0
     _stats["deferred_flushes"] = 0
     _stats["deferred_fallbacks"] = 0
+    _faults.clear_fault_state()
 
 
 # ----------------------------------------------- deferred micro-batched dispatch
@@ -577,6 +594,24 @@ class LazyValue:
             self._chunk = None
         return self._value
 
+    # -- copy / pickle ------------------------------------------------------
+    def __reduce__(self):
+        # Copying or pickling a handle is an OBSERVATION: force the flush and
+        # serialize the resolved value, so the copy never carries a queue
+        # binding (a deep-copied queue would point at cloned owners whose
+        # ids are absent from the id-keyed backing — reading such a copy
+        # raised an opaque KeyError). One exception: a copy taken MID-FLUSH
+        # (template construction deep-copies the owner, whose _forward_cache
+        # still holds this unresolved handle) cannot force — the reentrancy
+        # guard makes the nested flush a no-op — so it serializes a
+        # detached stub; templates reset immediately, so that copy's value
+        # is never observed, and reading it anyway raises the clear
+        # "never resolved" error instead of a KeyError.
+        q = self._queue
+        if not self._ready and q is not None and q._flushing:
+            return (LazyValue, (None,))
+        return (_resolved_lazy_value, (self._force(),))
+
     # -- transparent delegation -------------------------------------------
     def __getattr__(self, name: str) -> Any:
         if name.startswith("__") and name.endswith("__"):
@@ -695,6 +730,14 @@ class LazyValue:
 
     def __abs__(self):
         return abs(self._force())
+
+
+def _resolved_lazy_value(value: Any) -> "LazyValue":
+    """Reconstructor for copied/pickled handles: a detached, already-resolved
+    LazyValue (module-level so pickle can find it by qualified name)."""
+    lv = LazyValue(None)
+    lv._set_value(value)
+    return lv
 
 
 def note_deferred_steps(n: int) -> None:

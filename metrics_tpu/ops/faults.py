@@ -1,0 +1,513 @@
+"""Failure-domain engine: classification, degradation ladders, fault injection.
+
+Every fallback in the dispatch stack routes through this module instead of
+rolling its own "fail once → warn forever" logic:
+
+- **Classification** (:func:`classify`): maps a raised exception onto one of
+  the failure domains declared in :mod:`metrics_tpu.utils.exceptions`
+  (``trace`` / ``compile`` / ``runtime`` / ``donation`` / ``host`` /
+  ``sync``), so ``engine.py``, ``Metric``'s fused paths,
+  ``MetricCollection``'s flush fallbacks and ``parallel/sync.py`` stop
+  treating every ``Exception`` identically. The domain decides telemetry,
+  warning dedupe, and whether the ladder may recover.
+
+- **Degradation ladder** (:class:`Ladder`, :func:`demote`,
+  :func:`ladder`): a per-owner-per-lane state machine over the tiers
+  ``fused → chunked → eager → host``. A demotion records its domain; when the
+  domain is recoverable (compile/runtime/donation — transient by nature, e.g.
+  HBM pressure during compile), the owner earns a **recovery edge**: after N
+  clean steps at the degraded tier (``METRICS_TPU_FAULT_RECOVERY_STEPS``,
+  default 8, doubling per repeated failure up to a cap — exponential backoff)
+  the demoted path is re-armed and re-probed. Trace-domain demotions (an
+  untraceable configuration) never recover: the same config would fail the
+  same way, and the silent-decline contract stays intact.
+
+- **Deterministic fault injection** (:func:`inject_faults`,
+  ``METRICS_TPU_FAULTS``): named sites instrumented throughout the stack
+  (``probe``, ``compile``, ``flush-chunk-<k>``, ``donation``,
+  ``sync-gather``, ``host-offload``) fire classified exceptions on demand, so
+  every ladder transition is testable without a flaky backend. When no plan
+  is armed the per-site check is a single module-attribute read
+  (:data:`armed`), keeping the hot paths at their measured cost
+  (``bench.py`` ``fault_overhead`` row).
+
+- **Telemetry**: per-domain fault counters and a bounded ``failure_log``
+  ring buffer, surfaced through ``engine.engine_stats()``; plus
+  :func:`warn_fault`, which dedupes fallback warnings per ``owner+domain``
+  (a pathological loop used to emit one warning per step).
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+from metrics_tpu.utils.exceptions import (
+    FAULT_DOMAINS,
+    CompileFault,
+    DonationFault,
+    FaultError,
+    HostOffloadFault,
+    RuntimeFault,
+    SyncFault,
+    TraceFault,
+)
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = [
+    "FAULT_SITES",
+    "Ladder",
+    "TIERS",
+    "armed",
+    "classify",
+    "clear_fault_state",
+    "demote",
+    "fault_stats",
+    "inject_faults",
+    "ladder",
+    "maybe_fail",
+    "note_fault",
+    "recovery_steps",
+    "set_recovery_policy",
+    "warn_fault",
+]
+
+# ------------------------------------------------------------------ the tiers
+#: Degradation-ladder tiers, best first. ``fused`` is the single-dispatch (or
+#: deferred micro-batched) program path; ``chunked`` the stacked-scan flush /
+#: batched API; ``eager`` the per-op validated path; ``host`` the pure-host
+#: fallback (list appends, host counters) that cannot fail on the device.
+TIERS = ("fused", "chunked", "eager", "host")
+
+#: Named injection sites instrumented across the stack. ``flush-chunk-<k>``
+#: is the indexed family (``flush-chunk`` matches every chunk).
+FAULT_SITES = ("probe", "compile", "flush-chunk", "donation", "sync-gather", "host-offload")
+
+_SITE_DEFAULT_EXC = {
+    "probe": TraceFault,
+    "compile": CompileFault,
+    "flush-chunk": RuntimeFault,
+    "donation": DonationFault,
+    "sync-gather": SyncFault,
+    "host-offload": HostOffloadFault,
+}
+
+_DOMAIN_EXC = {
+    "trace": TraceFault,
+    "compile": CompileFault,
+    "runtime": RuntimeFault,
+    "donation": DonationFault,
+    "host": HostOffloadFault,
+    "sync": SyncFault,
+}
+
+
+# ------------------------------------------------------------- classification
+def classify(exc: BaseException, default: str = "runtime") -> str:
+    """Map a raised exception to a failure domain.
+
+    Classified :class:`FaultError`\\ s carry their own domain. For foreign
+    exceptions the verdict is structural where possible — jax trace errors
+    (concretization, tracer leaks) are ``trace``; XLA messages naming
+    compilation or resource exhaustion are ``compile``; deleted/donated
+    buffer complaints are ``donation`` — and falls back to ``default``
+    (the catching site knows which stage it was executing).
+    """
+    if isinstance(exc, FaultError):
+        return exc.domain
+    try:
+        import jax
+
+        trace_types = tuple(
+            t
+            for t in (
+                getattr(jax.errors, "TracerArrayConversionError", None),
+                getattr(jax.errors, "TracerBoolConversionError", None),
+                getattr(jax.errors, "TracerIntegerConversionError", None),
+                getattr(jax.errors, "ConcretizationTypeError", None),
+                getattr(jax.errors, "UnexpectedTracerError", None),
+            )
+            if t is not None
+        )
+        if trace_types and isinstance(exc, trace_types):
+            return "trace"
+    except Exception:  # pragma: no cover - jax always importable in-tree
+        pass
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if "donat" in text or "deleted" in text or "buffer has been deleted" in text:
+        return "donation"
+    if "compil" in text or "resource_exhausted" in text or "out of memory" in text:
+        return "compile"
+    if "tracer" in text or "abstract" in text:
+        return "trace"
+    return default if default in FAULT_DOMAINS else "runtime"
+
+
+def domain_recoverable(domain: str) -> bool:
+    """Whether the ladder may re-probe after a failure in ``domain``.
+
+    Trace failures are structural (same config → same failure) and stay
+    declined; everything else can be transient and earns a recovery edge.
+    """
+    return domain != "trace"
+
+
+# ------------------------------------------------------------------ telemetry
+_FAILURE_LOG_CAP = 64
+
+_counters: Dict[str, int] = {f"fault_{d}": 0 for d in FAULT_DOMAINS}
+_counters.update({"fault_demotions": 0, "fault_promotions": 0, "fault_injected": 0})
+_failure_log: "deque[Dict[str, Any]]" = deque(maxlen=_FAILURE_LOG_CAP)
+
+
+def note_fault(
+    domain: str,
+    *,
+    site: Optional[str] = None,
+    owner: Any = None,
+    error: Optional[BaseException] = None,
+) -> None:
+    """Count one fault in its domain and append it to the ring buffer."""
+    key = f"fault_{domain}"
+    if key not in _counters:
+        key = "fault_runtime"
+    _counters[key] += 1
+    _failure_log.append(
+        {
+            "domain": domain,
+            "site": site,
+            "owner": type(owner).__name__ if owner is not None else None,
+            "error": f"{type(error).__name__}: {error}" if error is not None else None,
+        }
+    )
+
+
+def fault_stats() -> Dict[str, Any]:
+    """Per-domain fault counters plus demotion/promotion totals and the
+    bounded ``failure_log`` ring buffer (newest last). Merged into
+    ``engine.engine_stats()``."""
+    out: Dict[str, Any] = dict(_counters)
+    out["failure_log"] = list(_failure_log)
+    return out
+
+
+def clear_fault_state() -> None:
+    """Zero the module-global counters and drop the failure log (tests;
+    called by ``engine.reset_engine``). Per-owner state — ladders and
+    warn-dedupe markers — lives on the owner instances themselves and is
+    untouched: an already-demoted metric keeps its ladder (and its backoff)
+    until it recovers or is rebuilt."""
+    for key in _counters:
+        _counters[key] = 0
+    _failure_log.clear()
+
+
+# ------------------------------------------------------- warning hygiene
+def warn_fault(owner: Any, domain: str, message: str) -> bool:
+    """Emit ``message`` once per ``owner+domain``; later faults in the same
+    domain on the same owner only count in telemetry.
+
+    The dedupe marker lives on the owner itself (not a global id-keyed map,
+    which would leak across id reuse), so it dies with the instance. Returns
+    True when the warning was actually emitted.
+    """
+    warned = owner.__dict__.get("_fault_warned") if owner is not None else None
+    if warned is None:
+        warned = set()
+        if owner is not None:
+            object.__setattr__(owner, "_fault_warned", warned)
+    if domain in warned:
+        return False
+    warned.add(domain)
+    rank_zero_warn(
+        message
+        + f" [fault domain: {domain}; further {domain}-domain warnings for this owner are "
+        "suppressed — see engine_stats()['failure_log']]"
+    )
+    return True
+
+
+# ----------------------------------------------------------- recovery policy
+_recovery_steps: Optional[int] = None
+_recovery_max_exponent: int = 6
+
+
+def recovery_steps() -> int:
+    """Clean steps required at a degraded tier before the first re-probe
+    (``METRICS_TPU_FAULT_RECOVERY_STEPS``, default 8). Doubles per repeated
+    failure of the same lane — exponential backoff — up to
+    ``base * 2**max_exponent``. ``0`` disables recovery entirely (the
+    pre-ladder permanent-demotion behavior)."""
+    global _recovery_steps
+    if _recovery_steps is None:
+        try:
+            _recovery_steps = max(0, int(os.environ.get("METRICS_TPU_FAULT_RECOVERY_STEPS", "8")))
+        except ValueError:
+            _recovery_steps = 8
+    return _recovery_steps
+
+
+def set_recovery_policy(steps: Optional[int] = None, *, max_exponent: Optional[int] = None) -> None:
+    """Override the recovery policy at runtime (None leaves a knob unchanged;
+    takes precedence over the environment variable)."""
+    global _recovery_steps, _recovery_max_exponent
+    if steps is not None:
+        _recovery_steps = max(0, int(steps))
+    if max_exponent is not None:
+        _recovery_max_exponent = max(0, int(max_exponent))
+
+
+# ----------------------------------------------------------------- the ladder
+class Ladder:
+    """Degradation state for one owner lane (``update`` / ``forward`` /
+    ``defer`` / ``many`` / ``suite`` / ``host`` …).
+
+    Explicit state machine over :data:`TIERS`:
+
+    - ``demote(domain, to=...)`` — a classified failure moves the lane down
+      and records the domain. Repeated failures double the re-probe
+      threshold (exponential backoff).
+    - ``note_clean()`` — one successful step at the degraded tier. Returns
+      True when the recovery edge fires: the owner should re-arm the demoted
+      path (and re-probe it before trusting it).
+    - ``promote()`` — the owner re-armed the path; the lane returns to its
+      best tier. A later failure demotes again with a doubled threshold.
+    """
+
+    __slots__ = ("lane", "tier", "domain", "failures", "clean", "threshold", "history")
+
+    def __init__(self, lane: str):
+        self.lane = lane
+        self.tier = TIERS[0]
+        self.domain: Optional[str] = None
+        self.failures = 0
+        self.clean = 0
+        self.threshold = 0
+        self.history: List[str] = []
+
+    @property
+    def demoted(self) -> bool:
+        return self.tier != TIERS[0]
+
+    @property
+    def recoverable(self) -> bool:
+        return (
+            self.demoted
+            and self.domain is not None
+            and domain_recoverable(self.domain)
+            and recovery_steps() > 0
+        )
+
+    def demote(self, domain: str, to: str = "eager") -> None:
+        self.domain = domain
+        self.tier = to if to in TIERS else "eager"
+        self.failures += 1
+        self.clean = 0
+        base = recovery_steps()
+        exponent = min(self.failures - 1, _recovery_max_exponent)
+        self.threshold = base * (2**exponent) if base else 0
+        self.history.append(f"demote:{domain}:{self.tier}")
+        if len(self.history) > 32:
+            del self.history[:-32]
+        _counters["fault_demotions"] += 1
+
+    def note_clean(self, n: int = 1) -> bool:
+        if not self.recoverable:
+            return False
+        self.clean += n
+        return self.clean >= self.threshold
+
+    def promote(self) -> None:
+        self.tier = TIERS[0]
+        self.clean = 0
+        self.history.append("promote")
+        if len(self.history) > 32:
+            del self.history[:-32]
+        _counters["fault_promotions"] += 1
+
+
+def ladder(owner: Any, lane: str) -> Ladder:
+    """The per-owner ladder for ``lane``, created on first use. Stored in the
+    owner's ``__dict__`` (bypassing any ``__setattr__`` barrier) so it dies
+    with the instance and survives pickling drops."""
+    ladders = owner.__dict__.get("_fault_ladders")
+    if ladders is None:
+        ladders = {}
+        object.__setattr__(owner, "_fault_ladders", ladders)
+    lad = ladders.get(lane)
+    if lad is None:
+        lad = Ladder(lane)
+        ladders[lane] = lad
+    return lad
+
+
+def demote(
+    owner: Any,
+    lane: str,
+    exc: BaseException,
+    *,
+    default_domain: str = "runtime",
+    tier: str = "eager",
+    site: Optional[str] = None,
+    warn: Optional[str] = None,
+) -> str:
+    """One-call failure handling: classify ``exc``, count it, demote the
+    owner's ``lane`` ladder, and (optionally) emit the owner+domain-deduped
+    warning. Returns the classified domain so callers can branch."""
+    domain = classify(exc, default_domain)
+    note_fault(domain, site=site, owner=owner, error=exc)
+    ladder(owner, lane).demote(domain, to=tier)
+    if warn:
+        warn_fault(owner, domain, warn)
+    return domain
+
+
+# ----------------------------------------------------------- fault injection
+class _Plan:
+    """One armed injection: fire ``count`` classified exceptions at ``site``."""
+
+    __slots__ = ("site", "remaining", "exc_type", "message", "fired")
+
+    def __init__(self, site: str, count: int, exc_type: type, message: Optional[str]):
+        self.site = site
+        self.remaining = count
+        self.exc_type = exc_type
+        self.message = message
+        self.fired = 0
+
+
+_plans: Dict[str, List[_Plan]] = {}
+
+#: Hot-path guard: call sites check ``faults.armed`` (one attribute read)
+#: before calling :func:`maybe_fail`, so the instrumentation costs nothing
+#: when no plan (and no ``METRICS_TPU_FAULTS``) is active.
+armed: bool = False
+
+
+def _rearm() -> None:
+    global armed
+    armed = bool(_plans)
+
+
+def _site_exc(site: str, domain: Optional[str]) -> type:
+    if domain is not None:
+        return _DOMAIN_EXC.get(domain, RuntimeFault)
+    family = site.rsplit("-", 1)[0] if site.startswith("flush-chunk") else site
+    return _SITE_DEFAULT_EXC.get(family, _SITE_DEFAULT_EXC.get(site, RuntimeFault))
+
+
+@contextmanager
+def inject_faults(
+    site: str,
+    count: int = 1,
+    *,
+    domain: Optional[str] = None,
+    message: Optional[str] = None,
+) -> Iterator[_Plan]:
+    """Deterministically fire ``count`` classified failures at ``site``.
+
+    ``site`` is one of :data:`FAULT_SITES` (``flush-chunk`` fires at every
+    chunk; ``flush-chunk-2`` only at chunk index 2). ``domain`` overrides the
+    site's default exception class. The yielded plan exposes ``fired`` for
+    assertions. Plans nest and stack (multiple contexts on the same site fire
+    in installation order)::
+
+        with inject_faults("flush-chunk-1") as plan:
+            metric.compute()            # flush: chunk 1 dies, ladder engages
+        assert plan.fired == 1
+    """
+    plan = _Plan(site, count, _site_exc(site, domain), message)
+    _plans.setdefault(site, []).append(plan)
+    _rearm()
+    try:
+        yield plan
+    finally:
+        stack = _plans.get(site)
+        if stack is not None:
+            try:
+                stack.remove(plan)
+            except ValueError:
+                pass
+            if not stack:
+                _plans.pop(site, None)
+        _rearm()
+
+
+def _env_plans() -> None:
+    """``METRICS_TPU_FAULTS="site[:count[:domain]],..."`` arms plans at import
+    (e.g. ``probe:1,sync-gather:2:sync``) — the no-code-change hook for
+    soak/chaos runs."""
+    spec = os.environ.get("METRICS_TPU_FAULTS", "")
+    if not spec:
+        return
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        site = fields[0]
+        try:
+            count = int(fields[1]) if len(fields) > 1 and fields[1] else 1
+        except ValueError:
+            count = 1
+        domain = fields[2] if len(fields) > 2 and fields[2] else None
+        _plans.setdefault(site, []).append(_Plan(site, count, _site_exc(site, domain), None))
+    _rearm()
+
+
+_env_plans()
+
+
+def maybe_fail(site: str, index: Optional[int] = None) -> None:
+    """Fire the next armed plan matching ``site`` (or ``site-<index>``), if
+    any. Call sites guard with ``if faults.armed:`` so this function only
+    runs while an injection context (or the env hook) is active."""
+    if not _plans:
+        return
+    names = (site,) if index is None else (f"{site}-{index}", site)
+    for name in names:
+        stack = _plans.get(name)
+        if not stack:
+            continue
+        for plan in stack:
+            if plan.remaining > 0:
+                plan.remaining -= 1
+                plan.fired += 1
+                _counters["fault_injected"] += 1
+                exc = plan.exc_type(
+                    plan.message or f"injected {plan.exc_type.__name__} at site {name!r}",
+                    site=name,
+                )
+                raise exc
+    return
+
+
+# ------------------------------------------------------------- retry helpers
+def retry_with_backoff(fn, *, attempts: int, base_delay_s: float, owner: Any = None, site: str = "sync-gather"):
+    """Run ``fn()`` with up to ``attempts`` retries and exponential backoff,
+    counting every failure in the sync domain. Raises the LAST failure,
+    classified, when the budget is exhausted. Used by
+    ``parallel.sync.gather_all_tensors`` — a transient DCN hiccup retries
+    instead of poisoning the sync; local state is untouched on failure
+    because the caller snapshots before gathering."""
+    delay = base_delay_s
+    last: Optional[BaseException] = None
+    for attempt in range(attempts + 1):
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — classified + rethrown below
+            last = exc
+            note_fault(classify(exc, "sync"), site=site, owner=owner, error=exc)
+            if attempt == attempts:
+                break
+            time.sleep(delay)
+            delay *= 2
+    if isinstance(last, FaultError):
+        raise last
+    raise SyncFault(
+        f"distributed gather failed after {attempts + 1} attempt(s): "
+        f"{type(last).__name__}: {last}",
+        site=site,
+    ) from last

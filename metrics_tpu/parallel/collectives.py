@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, Optional
 import jax
 from jax import lax
 
+from metrics_tpu.utils.exceptions import SyncConfigFault
+
 
 def sync_array(
     x: jax.Array,
@@ -49,9 +51,12 @@ def sync_array(
         return lax.all_gather(x, axis_name, axis=0)
     if spec == "custom":
         if custom_fn is None:
-            raise ValueError("custom reduction requires `custom_fn`")
+            # classified sync-domain config error (still a ValueError for
+            # pre-taxonomy callers); raised at trace time, so it surfaces on
+            # the first jit of the sync program, never mid-collective
+            raise SyncConfigFault("custom reduction requires `custom_fn`", site="sync-spec")
         return custom_fn(lax.all_gather(x, axis_name, axis=0))
-    raise ValueError(f"Unknown reduction spec {spec!r}")
+    raise SyncConfigFault(f"Unknown reduction spec {spec!r}", site="sync-spec")
 
 
 def sync_pytree(

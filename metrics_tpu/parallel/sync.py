@@ -13,11 +13,14 @@ zero-overhead early-out, mirroring ``distributed_available()``
 """
 from __future__ import annotations
 
+import os
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from metrics_tpu.utils.exceptions import SyncConfigFault
 
 
 def distributed_available() -> bool:
@@ -67,23 +70,61 @@ def _resolve_group(group: Optional[Any], n_processes: Optional[int]) -> Optional
     return members
 
 
-def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
-    """All-gather an array from every process; handles uneven dim sizes.
+def validate_group_live(group: Optional[Any]) -> Optional[List[int]]:
+    """Run the (construction-deferred) ``process_group`` validation against
+    the LIVE world size, raising the classified :class:`SyncConfigFault`.
 
-    Returns a list with one entry per process (every process receives all
-    entries — all-gather, not gather-to-root), like the reference
-    `utilities/distributed.py:102-151`.
-
-    ``group`` scopes the gather to a subset of process indices (the host-path
-    analogue of the reference's ``torch.distributed`` group objects). One
-    deliberate divergence, forced by JAX's host collectives being global:
-    EVERY process participates in the exchange (all processes must call
-    ``sync``/``compute`` — there is no members-only collective), and every
-    caller receives the group members' entries in ascending process order.
-    The reference instead lets only members call and errors on outsiders.
+    Metrics may be constructed before ``jax.distributed`` initializes, so
+    ``Metric.__init__`` skips the range check (see ``metric.py``'s
+    ``process_group`` handling); sync time is when the real world size is
+    known. ``SyncConfigFault`` is also a ``ValueError``, so pre-taxonomy
+    callers keep working, and it is structural — never retried.
     """
-    n_processes = world_size()
-    members = _resolve_group(group, n_processes)
+    try:
+        return _resolve_group(group, world_size())
+    except SyncConfigFault:
+        raise
+    except ValueError as err:
+        from metrics_tpu.ops import faults as _faults
+
+        _faults.note_fault("sync", site="sync-config", error=err)
+        raise SyncConfigFault(
+            f"process_group is invalid for the live world size "
+            f"({world_size()} process(es)): {err}",
+            site="sync-config",
+        ) from err
+
+
+def sync_retries() -> int:
+    """Extra gather attempts after a failure (``METRICS_TPU_SYNC_RETRIES``).
+
+    Default: 2 in single-process mode (custom/simulated gathers, the dryrun
+    surface), 0 when a real multi-process world is live — a collective can
+    only be retried safely if EVERY participant retries in lockstep, and a
+    unilateral re-issued ``process_allgather`` would pair with the other
+    ranks' next collective (mismatched payloads or a deadlock). Operators
+    whose failure mode is symmetric (e.g. a coordinator timeout surfacing on
+    all ranks at once) opt in by setting the env var explicitly. Read per
+    call — gathers run at sync time, never on the per-step hot path."""
+    raw = os.environ.get("METRICS_TPU_SYNC_RETRIES")
+    if raw is None:
+        return 0 if distributed_available() else 2
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 2
+
+
+def sync_backoff_s() -> float:
+    """Base retry backoff (``METRICS_TPU_SYNC_BACKOFF_MS``, default 50 ms),
+    doubled per attempt."""
+    try:
+        return max(0.0, float(os.environ.get("METRICS_TPU_SYNC_BACKOFF_MS", "50"))) / 1000.0
+    except ValueError:
+        return 0.05
+
+
+def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Array]:
     if not distributed_available():
         return [jnp.asarray(result)]
 
@@ -103,6 +144,45 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
         slices = tuple(slice(0, int(d)) for d in all_shapes[idx])
         out.append(jnp.asarray(gathered[idx])[slices])
     return out
+
+
+def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+    """All-gather an array from every process; handles uneven dim sizes.
+
+    Returns a list with one entry per process (every process receives all
+    entries — all-gather, not gather-to-root), like the reference
+    `utilities/distributed.py:102-151`.
+
+    ``group`` scopes the gather to a subset of process indices (the host-path
+    analogue of the reference's ``torch.distributed`` group objects). One
+    deliberate divergence, forced by JAX's host collectives being global:
+    EVERY process participates in the exchange (all processes must call
+    ``sync``/``compute`` — there is no members-only collective), and every
+    caller receives the group members' entries in ascending process order.
+    The reference instead lets only members call and errors on outsiders.
+
+    Failure domain: the group is validated against the live world size first
+    (classified :class:`SyncConfigFault`, no retry — config errors are
+    structural), then the exchange itself runs under retry-with-backoff
+    (``METRICS_TPU_SYNC_RETRIES`` × ``METRICS_TPU_SYNC_BACKOFF_MS``); a
+    budget-exhausted transient failure surfaces as a classified ``SyncFault``
+    with the caller's local state untouched (``Metric.sync`` snapshots before
+    gathering and restores on failure, so a failed sync is retryable).
+    """
+    from metrics_tpu.ops import faults as _faults
+
+    members = validate_group_live(group)
+
+    def _attempt() -> List[jax.Array]:
+        # "sync-gather" fault site: before the exchange, so an injected
+        # SyncFault exercises the retry ladder and the callers' restore paths
+        if _faults.armed:
+            _faults.maybe_fail("sync-gather")
+        return _gather_once(result, members)
+
+    return _faults.retry_with_backoff(
+        _attempt, attempts=sync_retries(), base_delay_s=sync_backoff_s(), site="sync-gather"
+    )
 
 
 def reduce(x: jax.Array, reduction: str) -> jax.Array:
@@ -139,4 +219,13 @@ def class_reduce(
     raise ValueError(f"Reduction parameter {class_reduction!r} unknown. Choose between one of these: {valid_reduction}")
 
 
-__all__ = ["distributed_available", "world_size", "gather_all_tensors", "reduce", "class_reduce"]
+__all__ = [
+    "distributed_available",
+    "world_size",
+    "gather_all_tensors",
+    "validate_group_live",
+    "sync_retries",
+    "sync_backoff_s",
+    "reduce",
+    "class_reduce",
+]

@@ -1,11 +1,112 @@
-"""User-facing error types.
+"""User-facing error types and the classified failure-domain hierarchy.
 
-Parity: reference `src/torchmetrics/utilities/exceptions.py:15-17`.
+Parity: reference ``src/torchmetrics/utilities/exceptions.py:15-17`` provides
+only ``MetricsUserError``. The failure-domain classes below are the TPU-side
+extension: every fallback ladder in the dispatch stack (``ops/engine.py``,
+``Metric``'s fused paths, ``MetricCollection``'s suite flushes,
+``parallel/sync.py``) classifies what failed instead of catching bare
+``Exception``, so telemetry, warning dedupe, and the recovery policy can key
+on the *domain* of a failure rather than its string. The machinery that
+consumes these classes (injection sites, degradation ladders, counters) lives
+in :mod:`metrics_tpu.ops.faults`; this module stays dependency-free so the
+exception types are importable from anywhere without cycles.
 """
+from __future__ import annotations
 
 
 class MetricsUserError(Exception):
     """Raised on incorrect use of the metrics API (e.g. double ``sync()``)."""
 
 
-__all__ = ["MetricsUserError"]
+# --------------------------------------------------------------- fault domains
+#: Canonical failure-domain names, in ladder-relevant order. Every
+#: :class:`FaultError` subclass carries one of these as ``domain``.
+FAULT_DOMAINS = ("trace", "compile", "runtime", "donation", "host", "sync")
+
+
+class FaultError(Exception):
+    """Base of the classified failure-domain hierarchy.
+
+    ``domain`` names which stage of the dispatch stack failed (one of
+    :data:`FAULT_DOMAINS`); ``site`` optionally names the injection/observation
+    site that raised (``"probe"``, ``"flush-chunk-2"``, ``"sync-gather"``, …).
+    ``recoverable`` states whether the degradation ladder may re-probe the
+    demoted path after clean steps: trace failures are structural (the same
+    configuration will fail the same way), while compile/runtime/donation
+    failures can be transient (HBM pressure, a backend hiccup) and earn a
+    recovery edge.
+    """
+
+    domain: str = "runtime"
+    recoverable: bool = True
+
+    def __init__(self, message: str = "", *, site: str | None = None):
+        super().__init__(message or f"{type(self).__name__} at site {site!r}")
+        self.site = site
+
+
+class TraceFault(FaultError):
+    """Trace-time failure: the program cannot even ``eval_shape`` with these
+    inputs. Structural — silent decline, never retried for the same config."""
+
+    domain = "trace"
+    recoverable = False
+
+
+class CompileFault(FaultError):
+    """Compile-time failure: the trace was fine but XLA lowering/compilation
+    failed (e.g. resource exhaustion while building the executable)."""
+
+    domain = "compile"
+
+
+class RuntimeFault(FaultError):
+    """Execution failure of an already-compiled program."""
+
+    domain = "runtime"
+
+
+class DonationFault(FaultError):
+    """Buffer-donation violation: a donated input was reused, double-donated,
+    or the donated twin failed where the plain twin would not."""
+
+    domain = "donation"
+
+
+class HostOffloadFault(FaultError):
+    """Host-memory offload failure (``compute_on_cpu`` device→host moves,
+    host-staged pending buffers)."""
+
+    domain = "host"
+
+
+class SyncFault(FaultError):
+    """Distributed synchronisation failure: a cross-process gather/collective
+    died or the sync configuration is invalid for the live world size."""
+
+    domain = "sync"
+
+
+class SyncConfigFault(SyncFault, ValueError):
+    """Invalid sync *configuration* for the live world (e.g. a
+    ``process_group`` index outside ``[0, world_size)`` at sync time).
+
+    Also a ``ValueError`` so config-validation callers that predate the
+    taxonomy keep catching it; structural, so never retried.
+    """
+
+    recoverable = False
+
+
+__all__ = [
+    "FAULT_DOMAINS",
+    "CompileFault",
+    "DonationFault",
+    "FaultError",
+    "HostOffloadFault",
+    "MetricsUserError",
+    "RuntimeFault",
+    "SyncConfigFault",
+    "SyncFault",
+    "TraceFault",
+]

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops import engine as _engine
+from metrics_tpu.ops import faults as _faults
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -60,6 +61,7 @@ def run_fanout(
     each step donates the stacked clone states, mutating the whole fleet's
     accumulators in place.
     """
+    lane = f"fanout:{ok_attr}:{program_attr}"
     versions = (wrapper._fused_version,) + tuple(m._fused_version for m in clones)
     if versions != getattr(wrapper, versions_attr):
         cfg0 = clone_config(clones[0])
@@ -69,6 +71,9 @@ def run_fanout(
                 "one-program fan-out is disabled for this instance and updates "
                 "run the per-clone eager path."
             )
+            # structural (user-driven config divergence): trace-domain
+            # demotion, never re-probed
+            _faults.ladder(wrapper, lane).demote("trace")
             object.__setattr__(wrapper, ok_attr, False)
             object.__setattr__(wrapper, program_attr, None)
             return False
@@ -87,6 +92,9 @@ def run_fanout(
                 wrapper, f"fanout:{program_attr}", build
             )
             if not _probe_traceable(program, states, *call_args, **call_kwargs):
+                # silent decline (trace domain): the per-clone eager path is
+                # the supported configuration, not an anomaly
+                _faults.ladder(wrapper, lane).demote("trace")
                 object.__setattr__(wrapper, ok_attr, False)
                 object.__setattr__(wrapper, program_attr, None)
                 return False
@@ -102,15 +110,23 @@ def run_fanout(
             new_states = program(states, *call_args, **call_kwargs)
     except Exception as exc:  # noqa: BLE001 — any trace/compile failure
         if states is not None and not _engine.state_intact(states):
+            _faults.note_fault("donation", site="fanout", owner=wrapper, error=exc)
             raise RuntimeError(
                 f"Fused fan-out program for `{type(clones[0]).__name__}` failed after "
                 f"donating the clone state buffers ({type(exc).__name__}: {exc}); the "
                 "accumulated states are unrecoverable — construct a fresh wrapper."
             ) from exc
-        rank_zero_warn(
-            f"Fused fan-out program for `{type(clones[0]).__name__}` raised "
-            f"{type(exc).__name__}: {exc}. Falling back to the per-clone eager "
-            "path permanently for this instance."
+        _faults.demote(
+            wrapper,
+            lane,
+            exc,
+            site="fanout",
+            warn=(
+                f"Fused fan-out program for `{type(clones[0]).__name__}` raised "
+                f"{type(exc).__name__}: {exc}. Falling back to the per-clone eager "
+                "path for this instance; recoverable failures re-probe after "
+                "clean steps."
+            ),
         )
         object.__setattr__(wrapper, ok_attr, False)
         object.__setattr__(wrapper, program_attr, None)

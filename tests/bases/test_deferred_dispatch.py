@@ -418,6 +418,25 @@ class TestEscapeHatch:
         assert m._defer_pending is None
 
 
+class TestLazyHandleCopy:
+    def test_copy_and_pickle_of_unresolved_handle_resolve(self):
+        """Copying/pickling a LazyValue is an observation: the copy is a
+        detached RESOLVED handle, never a deep-copied queue binding (whose
+        id-keyed backing lookup would raise an opaque KeyError on read)."""
+        import copy as _copy
+
+        m = mt.Accuracy()
+        m(P, T)
+        h = m(P, T)
+        hc = _copy.deepcopy(h)  # forces the flush
+        np.testing.assert_array_equal(np.asarray(hc), np.asarray(h))
+        h2 = m(P, T)
+        hp = pickle.loads(pickle.dumps(h2))
+        np.testing.assert_array_equal(np.asarray(hp), np.asarray(h2))
+        # the copies are detached: further reads cost no queue machinery
+        assert hc._queue is None and hp._queue is None
+
+
 class TestProgramSharing:
     def test_flush_shares_forward_many_scan_program(self):
         """The deferred flush acquires through the same engine key as

@@ -1,0 +1,452 @@
+"""Failure-domain engine contract (ISSUE 4 tentpole).
+
+Under deterministic fault injection at every named site (``probe``,
+``compile``, ``flush-chunk-<k>``, ``donation``, ``host-offload``; the
+``sync-gather`` site is pinned in ``tests/parallel/test_sync_faults.py``),
+every degradation-ladder transition preserves state BIT-EXACTLY against the
+step-by-step eager oracle (``np.testing.assert_array_equal`` — no tolerance
+widening), and the recovery edge is pinned: a transiently-failed owner
+returns to the fused path within N clean steps with ``engine_stats`` showing
+the demotion AND the re-promotion. Trace-domain declines stay silent and
+permanent (the round-5 silent-decline contract).
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, faults
+from metrics_tpu.utils import checks
+from metrics_tpu.utils.exceptions import (
+    CompileFault,
+    DonationFault,
+    RuntimeFault,
+    SyncFault,
+    TraceFault,
+)
+
+RNG = np.random.RandomState(7)
+P = jnp.asarray(RNG.rand(32).astype(np.float32))
+T = jnp.asarray(RNG.randint(0, 2, 32))
+A = jnp.asarray(RNG.rand(24).astype(np.float32))
+B = jnp.asarray(RNG.rand(24).astype(np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _fault_mode():
+    """Validation "first" (fused paths engage), short recovery threshold so
+    the recovery edge is testable in a handful of steps, clean counters."""
+    checks.set_validation_mode("first")
+    engine.set_deferred_dispatch(True)
+    faults.set_recovery_policy(steps=3, max_exponent=6)
+    yield
+    engine.set_deferred_dispatch(True)
+    faults.set_recovery_policy(steps=8, max_exponent=6)
+    checks.set_validation_mode("first")
+
+
+def _mean_oracle(n_updates, x=A):
+    """Step-by-step eager oracle: deferral off, fresh instance."""
+    engine.set_deferred_dispatch(False)
+    try:
+        e = mt.MeanMetric()
+        for _ in range(n_updates):
+            e.update(x)
+        return np.asarray(e.compute())
+    finally:
+        engine.set_deferred_dispatch(True)
+
+
+def _acc_forward_oracle(n_steps):
+    engine.set_deferred_dispatch(False)
+    try:
+        e = mt.Accuracy()
+        vals = [np.asarray(e(P, T)) for _ in range(n_steps)]
+        return vals, np.asarray(e.compute())
+    finally:
+        engine.set_deferred_dispatch(True)
+
+
+# --------------------------------------------------------------- the machine
+class TestLadderStateMachine:
+    def test_tiers_and_transitions(self):
+        lad = faults.Ladder("update")
+        assert lad.tier == "fused" and not lad.demoted
+        lad.demote("runtime")
+        assert lad.demoted and lad.domain == "runtime" and lad.recoverable
+        assert lad.threshold == 3  # fixture policy
+        assert not lad.note_clean()  # 1 < 3
+        assert not lad.note_clean()
+        assert lad.note_clean()  # threshold reached: recovery edge fires
+        lad.promote()
+        assert not lad.demoted and lad.clean == 0
+        # exponential backoff: second failure doubles the threshold
+        lad.demote("runtime")
+        assert lad.threshold == 6
+        assert "promote" in lad.history and lad.history.count("demote:runtime:eager") == 2
+
+    def test_trace_domain_never_recovers(self):
+        lad = faults.Ladder("update")
+        lad.demote("trace")
+        assert lad.demoted and not lad.recoverable
+        for _ in range(100):
+            assert not lad.note_clean()
+
+    def test_recovery_steps_zero_disables_recovery(self):
+        faults.set_recovery_policy(steps=0)
+        lad = faults.Ladder("update")
+        lad.demote("runtime")
+        assert not lad.recoverable
+        assert not lad.note_clean()
+
+    def test_classify(self):
+        assert faults.classify(RuntimeFault("x")) == "runtime"
+        assert faults.classify(TraceFault("x")) == "trace"
+        assert faults.classify(DonationFault("x")) == "donation"
+        assert faults.classify(SyncFault("x")) == "sync"
+        assert faults.classify(ValueError("boom"), default="runtime") == "runtime"
+        assert faults.classify(RuntimeError("XLA compilation failure")) == "compile"
+        assert faults.classify(RuntimeError("RESOURCE_EXHAUSTED: out of memory")) == "compile"
+        assert faults.classify(RuntimeError("buffer has been deleted or donated")) == "donation"
+        import jax
+
+        try:
+            jax.jit(lambda x: bool(x > 0))(jnp.asarray(1.0))
+        except Exception as exc:
+            assert faults.classify(exc) == "trace"
+
+    def test_env_hook_parses_plans(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_FAULTS", "probe:2,sync-gather:1:sync, ,bad")
+        before = {site: list(stack) for site, stack in faults._plans.items()}
+        try:
+            faults._env_plans()
+            assert faults.armed
+            assert any(p.remaining == 2 for p in faults._plans["probe"])
+            assert any(p.exc_type is SyncFault for p in faults._plans["sync-gather"])
+        finally:
+            faults._plans.clear()
+            faults._plans.update(before)
+            faults._rearm()
+
+
+# ------------------------------------------------------------------- probe site
+class TestProbeSite:
+    def test_probe_fault_declines_silently_bit_exact(self):
+        engine.set_deferred_dispatch(False)
+        m = mt.MeanMetric()
+        m.update(A)  # first signature: eager, validated
+        s0 = engine.engine_stats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any warning fails the test
+            with faults.inject_faults("probe") as plan:
+                m.update(A)  # probe declines -> silent eager
+        assert plan.fired == 1
+        assert m._fused_update_ok is False  # trace decline: permanent
+        m.update(A)
+        # trace declines never re-promote, however many clean steps pass
+        for _ in range(10):
+            m.update(A)
+        assert m._fused_update_ok is False
+        np.testing.assert_array_equal(np.asarray(m.compute()), _mean_oracle(13))
+        s1 = engine.engine_stats()
+        assert s1["fault_trace"] > s0["fault_trace"]
+
+
+# ----------------------------------------------------------------- compile site
+class TestCompileSite:
+    def test_compile_fault_demotes_then_recovers(self):
+        engine.set_deferred_dispatch(False)
+        engine.reset_engine()  # cache miss => the compile site fires
+        m = mt.MeanMetric()
+        m.update(A)
+        with pytest.warns(UserWarning, match="Building the fused update program"):
+            with faults.inject_faults("compile") as plan:
+                m.update(A)
+        assert plan.fired == 1
+        assert m._fused_update_ok is False
+        # recovery edge: 3 clean eager steps re-arm the fused path
+        m.update(A)
+        m.update(A)
+        m.update(A)
+        assert m._fused_update_ok is True
+        m.update(A)  # re-probes and runs fused again
+        assert isinstance(m._fused_update_program, engine.Executable)
+        np.testing.assert_array_equal(np.asarray(m.compute()), _mean_oracle(6))
+        stats = engine.engine_stats()
+        assert stats["fault_compile"] >= 1
+        assert stats["fault_demotions"] >= 1
+        assert stats["fault_promotions"] >= 1
+        lad = faults.ladder(m, "update")
+        assert lad.history[-2:] == ["demote:compile:eager", "promote"]
+
+    def test_forward_compile_fault_bit_exact(self):
+        engine.set_deferred_dispatch(False)
+        engine.reset_engine()
+        m = mt.Accuracy()
+        m(P, T)
+        with pytest.warns(UserWarning, match="Building the fused forward program"):
+            with faults.inject_faults("compile"):
+                v1 = m(P, T)
+        vals, final = _acc_forward_oracle(4)
+        np.testing.assert_array_equal(np.asarray(v1), vals[1])
+        m(P, T)
+        m(P, T)
+        np.testing.assert_array_equal(np.asarray(m.compute()), final)
+
+
+# ------------------------------------------------------------- flush-chunk site
+class TestFlushChunkSite:
+    @pytest.mark.parametrize("chunk_index", [0, 1])
+    def test_failure_between_applied_chunks_bit_exact(self, chunk_index):
+        """A failure while PREPARING chunk k must replay ONLY entries from
+        chunk k on (the applied-chunks counter from PR 2, now pinned under
+        real injection): 7 queued entries flush as [4, 2, 1] chunks."""
+        m = mt.MeanMetric()
+        m.update(A)  # eager-validated
+        for _ in range(7):
+            m.update(A)
+        assert m._defer_pending is not None and len(m._defer_pending.entries) == 7
+        with pytest.warns(UserWarning, match="Replaying the queue eagerly"):
+            with faults.inject_faults(f"flush-chunk-{chunk_index}") as plan:
+                value = np.asarray(m.compute())
+        assert plan.fired == 1
+        assert m._defer_ok is False
+        np.testing.assert_array_equal(value, _mean_oracle(8))
+        assert m._update_count == 8
+
+    def test_defer_lane_recovers_after_clean_steps(self):
+        m = mt.MeanMetric()
+        m.update(A)
+        for _ in range(3):
+            m.update(A)
+        with pytest.warns(UserWarning, match="Replaying the queue eagerly"):
+            with faults.inject_faults("flush-chunk"):
+                _ = m.metric_state
+        assert m._defer_ok is False
+        m.update(A)
+        m.update(A)
+        m.update(A)  # three clean per-call steps: recovery edge fires
+        assert m._defer_ok is True
+        m.update(A)
+        m.update(A)
+        assert m._defer_pending is not None  # deferral re-engaged
+        np.testing.assert_array_equal(np.asarray(m.compute()), _mean_oracle(9))
+        stats = engine.engine_stats()
+        assert stats["fault_demotions"] >= 1 and stats["fault_promotions"] >= 1
+
+    def test_forward_flush_chunk_fault_resolves_handles(self):
+        """Lazy forward handles issued before a failed flush must still
+        resolve to the exact eager per-step values."""
+        m = mt.Accuracy()
+        m(P, T)
+        handles = [m(P, T) for _ in range(5)]
+        with pytest.warns(UserWarning, match="Replaying the queue eagerly"):
+            with faults.inject_faults("flush-chunk-1"):
+                got = [np.asarray(h) for h in handles]
+        vals, final = _acc_forward_oracle(6)
+        for g, v in zip(got, vals[1:]):
+            np.testing.assert_array_equal(g, v)
+        np.testing.assert_array_equal(np.asarray(m.compute()), final)
+
+    def test_suite_flush_chunk_fault_bit_exact(self):
+        """MetricCollection's suite queue: an injected chunk failure replays
+        member-wise with every member ending bit-exact vs its oracle."""
+        col = mt.MetricCollection([mt.SumMetric(), mt.MeanMetric()])
+        col.update(A)
+        for _ in range(4):
+            col.update(A)
+        with pytest.warns(UserWarning, match="Replaying the queue eagerly"):
+            with faults.inject_faults("flush-chunk"):
+                res = col.compute()
+        engine.set_deferred_dispatch(False)
+        try:
+            oracle = mt.MetricCollection([mt.SumMetric(), mt.MeanMetric()])
+            for _ in range(5):
+                oracle.update(A)
+            expected = oracle.compute()
+        finally:
+            engine.set_deferred_dispatch(True)
+        assert res.keys() == expected.keys()
+        for key in res:
+            np.testing.assert_array_equal(np.asarray(res[key]), np.asarray(expected[key]))
+
+
+# ---------------------------------------------------------------- donation site
+class TestDonationSite:
+    def test_donation_fault_demotes_then_recovers(self):
+        engine.set_deferred_dispatch(False)
+        m = mt.Accuracy()
+        m(P, T)
+        m(P, T)  # licensed + fused (program built)
+        with pytest.warns(UserWarning, match="Fused forward for `Accuracy`"):
+            with faults.inject_faults("donation") as plan:
+                v = m(P, T)
+        assert plan.fired == 1
+        assert m._fused_forward_ok is False
+        vals, _ = _acc_forward_oracle(3)
+        np.testing.assert_array_equal(np.asarray(v), vals[2])
+        # clean eager steps -> recovery edge -> fused path again
+        m(P, T)
+        m(P, T)
+        m(P, T)
+        assert m._fused_forward_ok is True
+        m(P, T)
+        vals7, final7 = _acc_forward_oracle(7)
+        np.testing.assert_array_equal(np.asarray(m.compute()), final7)
+        stats = engine.engine_stats()
+        assert stats["fault_donation"] >= 1
+        lad = faults.ladder(m, "forward")
+        assert lad.history[-2:] == ["demote:donation:eager", "promote"]
+
+    def test_donation_fault_order_sensitive_state(self):
+        """MinMax extrema are order-sensitive: the eager fallback must apply
+        the failing step exactly once, in order."""
+        engine.set_deferred_dispatch(False)
+        xs = [jnp.asarray(RNG.rand(8).astype(np.float32)) for _ in range(6)]
+        m = mt.MinMetric()
+        m.update(xs[0])
+        m.update(xs[1])
+        with pytest.warns(UserWarning, match="Fused update for `MinMetric`"):
+            with faults.inject_faults("donation"):
+                m.update(xs[2])
+        for x in xs[3:]:
+            m.update(x)
+        e = mt.MinMetric()
+        for x in xs:
+            e.update(x)
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(e.compute()))
+
+
+# ------------------------------------------------------------ host-offload site
+class TestHostOffloadSite:
+    def test_offload_fault_keeps_rows_on_device_then_recovers(self):
+        rows = jnp.asarray([1.5, 2.5])
+        c = mt.CatMetric(compute_on_cpu=True)
+        c.update(rows)
+        assert isinstance(c.value[0], np.ndarray)  # offloaded to host
+        with pytest.warns(UserWarning, match="Host offload .* for `CatMetric`"):
+            with faults.inject_faults("host-offload") as plan:
+                c.update(rows)
+        assert plan.fired == 1
+        assert c._host_offload_ok is False
+        c.update(rows)  # degraded tier: rows stay on device, update succeeds
+        assert not isinstance(c.value[-1], np.ndarray)
+        c.update(rows)
+        c.update(rows)  # third CLEAN step (the failing call does not count)
+        assert c._host_offload_ok is True
+        c.update(rows)
+        assert isinstance(c.value[-1], np.ndarray)  # offload resumed
+        e = mt.CatMetric()
+        for _ in range(6):
+            e.update(rows)
+        np.testing.assert_array_equal(np.asarray(c.compute()), np.asarray(e.compute()))
+        assert engine.engine_stats()["fault_host"] >= 1
+
+
+# ----------------------------------------------------- suite-flush atomicity
+class TestSuiteFlushAtomicity:
+    def test_failure_mid_suite_replay_never_splits_members(self):
+        """Satellite regression: a failure mid-suite-flush must never leave
+        one member flushed and another pending — the replay snapshots every
+        leader per entry and restores all of them on a member failure."""
+        col = mt.MetricCollection([mt.SumMetric(), mt.MeanMetric()])
+        col.update(A)  # member-wise eager: validates + derives groups
+        col.update(A)  # enqueues into the suite queue
+        col.update(A)
+        q = col._defer_pending
+        assert q is not None and len(q.entries) == 2
+        mean = col._modules["MeanMetric"]
+        sum_m = col._modules["SumMetric"]
+        # read the pre-flush state out of the queue backing: a plain
+        # `sum_m.value` read IS an observation and would flush the queue here
+        value_before = np.asarray(q.backing[id(sum_m)]["value"])
+
+        calls = {"n": 0}
+        orig_update = mean.update
+
+        def poisoned(*a, **k):
+            calls["n"] += 1
+            raise RuntimeError("poison mid-suite replay")
+
+        # object.__setattr__: a plain setattr would hit the observation
+        # barrier and flush the queue before the poison is installed
+        object.__setattr__(mean, "update", poisoned)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with faults.inject_faults("flush-chunk"):
+                    with pytest.raises(RuntimeError, match="poison mid-suite replay"):
+                        sum_m.compute()  # observation -> flush -> eager replay
+        finally:
+            object.__setattr__(mean, "update", orig_update)
+        assert calls["n"] == 1
+        # BOTH members rolled back to the pre-entry point: neither half-flushed
+        assert sum_m._update_count == mean._update_count == 1
+        np.testing.assert_array_equal(np.asarray(sum_m.value), value_before)
+        np.testing.assert_array_equal(np.asarray(mean.compute()), _mean_oracle(1))
+        np.testing.assert_array_equal(np.asarray(sum_m.compute()), value_before)
+
+    def test_forward_replay_failure_never_splits_members(self):
+        col = mt.MetricCollection([mt.SumMetric(), mt.MeanMetric()])
+        col(A)
+        col(A)  # enqueued suite forward
+        assert col._defer_pending is not None
+        mean = col._modules["MeanMetric"]
+        sum_m = col._modules["SumMetric"]
+
+        def poisoned(*a, **k):
+            raise RuntimeError("poison forward replay")
+
+        object.__setattr__(mean, "_forward_reduce_state_update_eager", poisoned)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with faults.inject_faults("flush-chunk"):
+                    with pytest.raises(RuntimeError, match="poison forward replay"):
+                        sum_m.compute()
+        finally:
+            del mean.__dict__["_forward_reduce_state_update_eager"]
+        assert sum_m._update_count == mean._update_count == 1
+        np.testing.assert_array_equal(np.asarray(sum_m.compute()), np.asarray(A.sum()))
+
+
+# ----------------------------------------------- telemetry / engine_stats shape
+class TestTelemetry:
+    def test_engine_stats_exposes_fault_surface(self):
+        stats = engine.engine_stats()
+        for domain in ("trace", "compile", "runtime", "donation", "host", "sync"):
+            assert isinstance(stats[f"fault_{domain}"], int)
+        assert isinstance(stats["fault_demotions"], int)
+        assert isinstance(stats["fault_promotions"], int)
+        assert isinstance(stats["failure_log"], list)
+
+    def test_failure_log_is_bounded(self):
+        engine.reset_engine()
+        for i in range(200):
+            faults.note_fault("runtime", site=f"s{i}")
+        log = engine.engine_stats()["failure_log"]
+        assert len(log) == 64
+        assert log[-1]["site"] == "s199"  # newest last, oldest evicted
+
+    def test_injected_exception_carries_site_and_domain(self):
+        with faults.inject_faults("flush-chunk-2") as plan:
+            with pytest.raises(RuntimeFault) as ei:
+                faults.maybe_fail("flush-chunk", index=2)
+        assert plan.fired == 1
+        assert ei.value.site == "flush-chunk-2"
+        assert ei.value.domain == "runtime"
+        # index mismatch does not fire
+        with faults.inject_faults("flush-chunk-3"):
+            faults.maybe_fail("flush-chunk", index=1)
+
+    def test_exhausted_plan_stops_firing(self):
+        with faults.inject_faults("probe", count=1) as plan:
+            with pytest.raises(TraceFault):
+                faults.maybe_fail("probe")
+            faults.maybe_fail("probe")  # budget spent: no-op
+        assert plan.fired == 1
+        assert not faults.armed

@@ -135,6 +135,83 @@ class TestComputeBeforeUpdateWarning:
             m.compute()
 
 
+class TestFaultWarningDedupe:
+    """ISSUE 4 satellite: fallback warnings dedupe per owner+domain — with
+    the recovery edge a pathological demote/recover loop could otherwise
+    emit one warning per flush; only the FIRST failure in a domain warns,
+    later ones count in engine_stats()['failure_log'] only."""
+
+    def test_deferred_flush_warning_dedupes_per_owner_domain(self):
+        from metrics_tpu.ops import engine, faults
+        from metrics_tpu.utils import checks
+
+        checks.set_validation_mode("first")
+        engine.set_deferred_dispatch(True)
+        faults.set_recovery_policy(steps=1)  # recover after ONE clean step
+        try:
+            a = jnp.asarray(np.random.RandomState(3).rand(8).astype(np.float32))
+            m = mt.MeanMetric()
+            m.update(a)  # eager-validated
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                with faults.inject_faults("flush-chunk", count=100):
+                    for _ in range(3):  # fail -> recover -> fail again ...
+                        m.update(a)
+                        m.update(a)
+                        _ = m.metric_state  # flush fails, replays eagerly
+                        m.update(a)  # clean step: defer lane re-promotes
+            msgs = [str(w.message) for w in caught if "Replaying the queue eagerly" in str(w.message)]
+            assert len(msgs) == 1, msgs
+            assert "suppressed" in msgs[0]
+            # the loop really did refail (dedupe, not a single failure)
+            from metrics_tpu.ops.engine import engine_stats
+
+            assert sum(
+                1 for e in engine_stats()["failure_log"] if e["site"] == "deferred-flush"
+            ) >= 2
+            # a DIFFERENT owner still gets its own first warning
+            m2 = mt.MeanMetric()
+            m2.update(a)
+            m2.update(a)
+            m2.update(a)
+            with _catch("Replaying the queue eagerly"):
+                with faults.inject_faults("flush-chunk", count=10):
+                    _ = m2.metric_state
+        finally:
+            faults.set_recovery_policy(steps=8)
+            engine.set_deferred_dispatch(True)
+
+    def test_donation_decline_warning_dedupes_per_owner_domain(self):
+        from metrics_tpu.ops import engine, faults
+        from metrics_tpu.utils import checks
+
+        checks.set_validation_mode("first")
+        engine.set_deferred_dispatch(False)  # pin the per-call fused path
+        faults.set_recovery_policy(steps=1)
+        try:
+            rng = np.random.RandomState(4)
+            p = jnp.asarray(rng.rand(16).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 2, 16))
+            m = mt.Accuracy()
+            m(p, t)
+            m(p, t)  # licensed + fused
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                with faults.inject_faults("donation", count=10) as plan:
+                    m(p, t)  # donation faults: demote + FIRST warning
+                    m(p, t)  # clean eager step: demoted lanes re-promote
+                    m(p, t)  # fused again -> more donation faults: deduped
+            assert plan.fired >= 2  # the loop genuinely refailed
+            # one warning TOTAL for this owner's donation domain — the fused
+            # forward and fused update fallbacks share the dedupe key
+            msgs = [str(w.message) for w in caught if "DonationFault" in str(w.message)]
+            assert len(msgs) == 1, msgs
+            assert "suppressed" in msgs[0]
+        finally:
+            faults.set_recovery_policy(steps=8)
+            engine.set_deferred_dispatch(True)
+
+
 class TestFullStateUpdateWarning:
     def test_unset_full_state_update_warns_once_per_class(self):
         class Unset(Metric):

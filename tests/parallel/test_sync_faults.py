@@ -1,0 +1,178 @@
+"""Sync failure-domain contract under the SPMD dryrun environment.
+
+Runs on the suite's 8-virtual-device CPU mesh (tests/conftest.py — the same
+environment `make dryrun` validates). Pins, under ``inject_faults`` at the
+``sync-gather`` site:
+
+- a failed distributed gather leaves LOCAL state intact and the metric
+  retryable (``Metric.sync`` snapshots before gathering and restores on
+  failure);
+- the retry-with-backoff wrapper absorbs transient failures within its
+  budget (``METRICS_TPU_SYNC_RETRIES``) and surfaces a classified
+  ``SyncFault`` when the budget is exhausted;
+- ``compute()`` after a failed sync raises the classified error instead of
+  returning a half-synced value;
+- the ``process_ids`` range check documented at ``metric.py`` construction
+  runs against the LIVE world size at sync time (classified
+  ``SyncConfigFault``, which is also a ``ValueError`` — no retry);
+- the in-program SPMD sync path (``sync_pytree`` under ``shard_map``) is a
+  different lane entirely and is untouched by armed host-gather plans.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, faults
+from metrics_tpu.parallel.sync import gather_all_tensors, sync_backoff_s, sync_retries, validate_group_live
+from metrics_tpu.parallel.collectives import sync_pytree
+from metrics_tpu.utils.exceptions import SyncConfigFault, SyncFault
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+    yield
+
+
+def _force_distributed(monkeypatch):
+    """Route compute()'s auto-sync through the host gather on one process:
+    `jit_distributed_available` reads `metrics_tpu.metric._dist_available`."""
+    import metrics_tpu.metric as metric_mod
+
+    monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+
+
+class TestRetryWithBackoff:
+    def test_transient_failure_retries_and_succeeds(self):
+        x = jnp.arange(4.0)
+        with faults.inject_faults("sync-gather", count=1) as plan:
+            out = gather_all_tensors(x)
+        assert plan.fired == 1  # first attempt failed, retry succeeded
+        assert len(out) == 1
+        np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x))
+
+    def test_budget_exhaustion_raises_classified_sync_fault(self):
+        n_attempts = sync_retries() + 1
+        with faults.inject_faults("sync-gather", count=n_attempts + 5) as plan:
+            with pytest.raises(SyncFault):
+                gather_all_tensors(jnp.arange(3.0))
+        assert plan.fired == n_attempts  # one failure per attempt, then raise
+        assert engine.engine_stats()["fault_sync"] >= n_attempts
+
+    def test_retry_knobs_read_from_env(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "0")
+        monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "125")
+        assert sync_retries() == 0
+        assert sync_backoff_s() == 0.125
+        with faults.inject_faults("sync-gather", count=1) as plan:
+            with pytest.raises(SyncFault):
+                gather_all_tensors(jnp.arange(2.0))
+        assert plan.fired == 1  # zero retries: first failure is final
+
+
+class TestSyncLeavesStateIntact:
+    def test_failed_sync_is_retryable(self):
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([2.0, 4.0]))
+        with faults.inject_faults("sync-gather", count=100):
+            with pytest.raises(SyncFault):
+                m.sync(distributed_available=lambda: True)
+        # local state intact, flags consistent, metric retryable
+        assert m._is_synced is False
+        assert m._cache is None
+        np.testing.assert_array_equal(np.asarray(m.value), np.asarray(6.0))
+        m.sync(distributed_available=lambda: True)  # retry succeeds
+        assert m._is_synced is True
+        m.unsync()
+        assert float(m.compute()) == 3.0
+
+    def test_failed_sync_mid_state_restores_every_state(self):
+        """MeanMetric gathers two states; a failure on the SECOND gather must
+        restore the first (no half-synced value survives)."""
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([1.0, 3.0]))
+        before = {k: np.asarray(v) for k, v in m.metric_state.items()}
+
+        calls = {"n": 0}
+
+        def flaky_gather(x, group=None):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise SyncFault("second state gather died", site="sync-gather")
+            return [jnp.asarray(x) * 2]  # visibly-wrong merged value
+
+        with pytest.raises(SyncFault):
+            m.sync(dist_sync_fn=flaky_gather, distributed_available=lambda: True)
+        after = {k: np.asarray(v) for k, v in m.metric_state.items()}
+        for k in before:
+            np.testing.assert_array_equal(after[k], before[k])
+        assert m._is_synced is False
+
+    def test_compute_after_failed_sync_raises_classified(self, monkeypatch):
+        _force_distributed(monkeypatch)
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([2.0, 4.0]))
+        with faults.inject_faults("sync-gather", count=100):
+            with pytest.raises(SyncFault):
+                m.compute()  # auto-sync inside compute: classified, not half-synced
+        assert m._computed is None  # no poisoned compute cache
+        assert m._is_synced is False
+        # with the fault gone, the same compute succeeds on intact local state
+        assert float(m.compute()) == 3.0
+
+
+class TestLiveWorldSizeCheck:
+    def test_deferred_range_check_enforced_at_sync(self):
+        """Construction defers the process-index range check (metrics may be
+        built before jax.distributed initializes); sync() must enforce it
+        against the live world size with the classified error."""
+        m = mt.SumMetric(process_group=[3])  # accepted at construction
+        m.update(jnp.asarray([1.0]))
+        with pytest.raises(SyncConfigFault, match="out of range"):
+            m.sync(distributed_available=lambda: True)
+        # classified AND backward compatible
+        assert issubclass(SyncConfigFault, ValueError)
+        # state untouched, flags consistent
+        assert m._is_synced is False
+        assert float(m.compute()) == 1.0
+
+    def test_validate_group_live_passthrough_and_classify(self):
+        assert validate_group_live(None) is None
+        assert validate_group_live([0]) == [0]
+        with pytest.raises(SyncConfigFault):
+            validate_group_live([0, 1])  # world size 1 in this suite
+        with pytest.raises(SyncConfigFault, match="iterable of process indices"):
+            validate_group_live(123)
+
+    def test_config_faults_are_not_retried(self):
+        s0 = engine.engine_stats()["fault_sync"]
+        with pytest.raises(SyncConfigFault):
+            gather_all_tensors(jnp.zeros(2), group=[5])
+        # exactly one classified config fault — no retry loop ran
+        assert engine.engine_stats()["fault_sync"] == s0 + 1
+
+
+class TestSpmdPathUnaffected:
+    def test_inprogram_sync_ignores_host_gather_plans(self):
+        """The SPMD dryrun lane (shard_map + sync_pytree over the 8-device
+        mesh) performs no host gather — armed sync-gather plans must neither
+        fire nor perturb its collectives."""
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+
+        def f(x):
+            state = {"s": x, "mx": x}
+            return sync_pytree(state, {"s": "sum", "mx": "max"}, "dp")
+
+        x = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        with faults.inject_faults("sync-gather", count=100) as plan:
+            out = jax.jit(
+                jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+            )(x)
+        assert plan.fired == 0
+        assert float(out["s"][0]) == 10.0
+        assert float(out["mx"][0]) == 4.0

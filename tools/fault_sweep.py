@@ -1,0 +1,187 @@
+"""Fault-injection sweep: every named site x a representative metric set.
+
+The ``make faults`` entry point. For each injection site (``probe``,
+``compile``, ``flush-chunk-0``, ``flush-chunk-1``, ``donation``,
+``sync-gather``, ``host-offload``) it drives a representative workload under
+``metrics_tpu.ops.faults.inject_faults`` and asserts:
+
+- the final metric values are BIT-EXACT against a step-by-step eager oracle
+  (fresh instance, deferral off, no tolerance widening);
+- the plan actually fired (the site is really on the exercised path);
+- for recoverable domains, the degradation ladder re-promoted the owner
+  (``engine_stats`` shows the demotion AND the promotion).
+
+Prints one JSON line per site plus a summary; exits non-zero on any
+mismatch. Runs on CPU by default so results are deterministic anywhere
+(override with JAX_PLATFORMS).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("METRICS_TPU_VALIDATION", "first")
+os.environ.setdefault("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_DIR not in sys.path:
+    sys.path.insert(0, _REPO_DIR)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import metrics_tpu as mt  # noqa: E402
+from metrics_tpu.ops import engine, faults  # noqa: E402
+from metrics_tpu.utils.exceptions import SyncFault  # noqa: E402
+
+RNG = np.random.RandomState(0)
+A = jnp.asarray(RNG.rand(32).astype(np.float32))
+P = jnp.asarray(RNG.rand(64).astype(np.float32))
+T = jnp.asarray(RNG.randint(0, 2, 64))
+N_STEPS = 8
+
+
+def _tree_equal(a, b) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b)
+
+
+def _oracle_mean(n: int):
+    engine.set_deferred_dispatch(False)
+    try:
+        e = mt.MeanMetric()
+        for _ in range(n):
+            e.update(A)
+        return np.asarray(e.compute())
+    finally:
+        engine.set_deferred_dispatch(True)
+
+
+def _oracle_accuracy(n: int):
+    engine.set_deferred_dispatch(False)
+    try:
+        e = mt.Accuracy()
+        vals = [np.asarray(e(P, T)) for _ in range(n)]
+        return vals, np.asarray(e.compute())
+    finally:
+        engine.set_deferred_dispatch(True)
+
+
+def _scenario_update_queue(site: str):
+    """N deferred updates with the fault armed mid-stream; the flush (or its
+    eager replay) must land bit-exactly on the oracle."""
+    engine.set_deferred_dispatch(True)
+    m = mt.MeanMetric()
+    m.update(A)
+    with faults.inject_faults(site) as plan:
+        for _ in range(N_STEPS - 1):
+            m.update(A)
+        value = np.asarray(m.compute())
+    return _tree_equal(value, _oracle_mean(N_STEPS)), plan.fired
+
+
+def _scenario_per_call(site: str):
+    """Per-call fused forwards (deferral off) with the fault at step 3; the
+    per-step values AND the final value must match the oracle."""
+    engine.set_deferred_dispatch(False)
+    try:
+        if site == "compile":
+            engine.reset_engine()  # the compile site fires on cache misses
+        m = mt.Accuracy()
+        got = [np.asarray(m(P, T))]  # first signature call: eager, validated
+        # arm across steps 2-3: the compile site fires at program BUILD
+        # (step 2, a cache miss), the donation site at donated execution
+        with faults.inject_faults(site) as plan:
+            got.append(np.asarray(m(P, T)))
+            got.append(np.asarray(m(P, T)))
+        for _ in range(N_STEPS - 3):
+            got.append(np.asarray(m(P, T)))
+        final = np.asarray(m.compute())
+    finally:
+        engine.set_deferred_dispatch(True)
+    vals, oracle_final = _oracle_accuracy(N_STEPS)
+    ok = _tree_equal(final, oracle_final) and all(
+        _tree_equal(g, v) for g, v in zip(got, vals)
+    )
+    # recoverable domains must show the recovery edge in the ladder
+    stats = engine.engine_stats()
+    if site in ("compile", "donation"):
+        ok = ok and stats["fault_promotions"] >= 1 and stats["fault_demotions"] >= 1
+    return ok, plan.fired
+
+
+def _scenario_sync(site: str):
+    m = mt.MeanMetric()
+    m.update(jnp.asarray([2.0, 4.0]))
+    raised = False
+    with faults.inject_faults(site, count=100) as plan:
+        try:
+            m.sync(distributed_available=lambda: True)
+        except SyncFault:
+            raised = True
+    # failed sync: local state intact and retryable
+    m.sync(distributed_available=lambda: True)
+    m.unsync()
+    return raised and _tree_equal(m.compute(), np.asarray(3.0)), plan.fired
+
+
+def _scenario_host_offload(site: str):
+    rows = jnp.asarray([1.0, 2.0])
+    c = mt.CatMetric(compute_on_cpu=True)
+    c.update(rows)
+    with faults.inject_faults(site) as plan:
+        c.update(rows)
+    for _ in range(N_STEPS - 2):
+        c.update(rows)
+    e = mt.CatMetric()
+    for _ in range(N_STEPS):
+        e.update(rows)
+    return _tree_equal(c.compute(), np.asarray(e.compute())), plan.fired
+
+
+SWEEP = {
+    "probe": _scenario_update_queue,
+    "compile": _scenario_per_call,
+    "flush-chunk-0": _scenario_update_queue,
+    "flush-chunk-1": _scenario_update_queue,
+    "donation": _scenario_per_call,
+    "sync-gather": _scenario_sync,
+    "host-offload": _scenario_host_offload,
+}
+
+
+def main() -> int:
+    faults.set_recovery_policy(steps=2)
+    failures = 0
+    results = {}
+    for site, scenario in SWEEP.items():
+        engine.reset_engine()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # fallback warnings are expected here
+            ok, fired = scenario(site)
+        if fired == 0:
+            ok = False  # the site was never reached: the sweep is lying
+        results[site] = {"bit_exact": bool(ok), "fired": int(fired)}
+        failures += 0 if ok else 1
+        print(json.dumps({"site": site, **results[site]}))
+    stats = engine.engine_stats()
+    print(
+        json.dumps(
+            {
+                "summary": "fault_sweep",
+                "sites": len(SWEEP),
+                "failures": failures,
+                "fault_counters": {
+                    k: v for k, v in stats.items() if k.startswith("fault_") and v
+                },
+            }
+        )
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
