@@ -655,6 +655,75 @@ def bench_fault_overhead() -> dict:
     return {"disarmed_steps_per_s": disarmed, "armed_steps_per_s": armed}
 
 
+def bench_sync_per_call() -> dict:
+    """Whole-suite sync round-trip cost: coalesced vs per-state protocol.
+
+    A 4-metric multi-state ``MetricCollection`` (8 array states total) runs
+    ``sync``/``unsync`` cycles with the simulated-distributed hook (the same
+    single-process protocol surface the dryrun certifies). Coalesced: ONE
+    packed payload collective slot + one donated unpack program per sync.
+    Per-state (``METRICS_TPU_SYNC_COALESCE=0``): one shape + one payload slot
+    and one gather per state — 2·M·S protocol round trips. On the tunneled
+    backend each blocking collective costs ~sync_roundtrip_ms (BENCH_r05), so
+    collectives_per_sync IS the cost model; both syncs/s loops are reported
+    for the local-dispatch floor comparison."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MeanAbsoluteError, MeanMetric, MeanSquaredError, MetricCollection
+    from metrics_tpu.ops import engine
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(BATCH).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, BATCH))
+    dist_on = lambda: True  # noqa: E731
+    n_syncs = max(3, STEPS // 5)
+
+    def loop(coalesce: bool) -> dict:
+        os.environ["METRICS_TPU_SYNC_COALESCE"] = "1" if coalesce else "0"
+        try:
+            coll = MetricCollection(
+                {
+                    "mean": MeanMetric(),
+                    "mse": MeanSquaredError(),
+                    "mae": MeanAbsoluteError(),
+                    "acc": Accuracy(),
+                }
+            )
+            coll.update(p, t)
+            # warmup compiles the pack/unpack (or per-state apply) programs
+            coll.sync(distributed_available=dist_on)
+            coll.unsync()
+            s0 = engine.engine_stats()
+            best = float("inf")
+            for _ in range(TRIALS):
+                start = time.perf_counter()
+                for _ in range(n_syncs):
+                    coll.sync(distributed_available=dist_on)
+                    coll.unsync()
+                jax.block_until_ready(coll["mean"].value)
+                best = min(best, time.perf_counter() - start)
+            s1 = engine.engine_stats()
+            per_sync = (
+                s1["sync_shape_collectives"]
+                + s1["sync_payload_collectives"]
+                - s0["sync_shape_collectives"]
+                - s0["sync_payload_collectives"]
+            ) / (n_syncs * TRIALS)
+            return {"syncs_per_s": n_syncs / best, "collectives_per_sync": per_sync}
+        finally:
+            os.environ.pop("METRICS_TPU_SYNC_COALESCE", None)
+
+    coalesced = loop(True)
+    per_state = loop(False)
+    return {
+        "coalesced_syncs_per_s": coalesced["syncs_per_s"],
+        "coalesced_collectives_per_sync": coalesced["collectives_per_sync"],
+        "per_state_syncs_per_s": per_state["syncs_per_s"],
+        "per_state_collectives_per_sync": per_state["collectives_per_sync"],
+    }
+
+
 def bench_overhead_reference() -> float:
     tm = _reference()
     if tm is None:
@@ -710,6 +779,7 @@ def main() -> None:
     # fault instrumentation probe rides the same regime as the deferred row
     # it bounds (same loop shape, same backend state)
     fault_probe = bench_fault_overhead()
+    sync_probe = bench_sync_per_call()
     boot_floor = bench_bootstrap_shaped_floor()
     ours_overhead_batched = bench_overhead_batched_ours()
     ref_overhead = _safe(bench_overhead_reference)
@@ -829,6 +899,32 @@ def main() -> None:
                 "(probe/compile/flush-chunk/donation/sync-gather/host-offload) "
                 "cost nothing measurable per step; loop-to-loop jitter on the "
                 "backend dominates any difference"
+            ),
+        },
+        "sync_per_call": {
+            # ISSUE 5: coalesced bucketed sync — one payload collective per
+            # suite sync (static fast lane) vs the per-state protocol's
+            # 2-per-state-per-metric walk, bit-exact. collectives_per_sync is
+            # the cost model: on a tunneled backend each blocking collective
+            # costs ~sync_roundtrip_ms (the per_step_overhead row's floor),
+            # so the ratio of the two collective counts bounds the sync-time
+            # speedup in any real multi-process world.
+            "coalesced_syncs_per_s": round(sync_probe["coalesced_syncs_per_s"], 1),
+            "coalesced_collectives_per_sync": round(
+                sync_probe["coalesced_collectives_per_sync"], 2
+            ),
+            "per_state_syncs_per_s": round(sync_probe["per_state_syncs_per_s"], 1),
+            "per_state_collectives_per_sync": round(
+                sync_probe["per_state_collectives_per_sync"], 2
+            ),
+            "unit": "suite sync+unsync cycles/s (4-metric multi-state suite, simulated world)",
+            "note": (
+                "coalesced: ONE packed payload collective slot + one donated "
+                "engine-cached unpack program per sync; per-state "
+                "(METRICS_TPU_SYNC_COALESCE=0): one shape + one payload slot "
+                "per state per metric — the collective-slot ratio is the "
+                "multi-process round-trip saving (each slot is a blocking "
+                "~sync_roundtrip_ms exchange on the tunneled backend)"
             ),
         },
         "eager_per_step": {

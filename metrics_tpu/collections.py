@@ -23,11 +23,17 @@ from metrics_tpu.metric import (
     _leaves_jittable,
     _probe_traceable,
     _propagate_static_attrs,
+    jit_distributed_available,
 )
 from metrics_tpu.ops import engine as _engine
 from metrics_tpu.ops import faults as _faults
+from metrics_tpu.parallel import bucketing as _bucketing
 from metrics_tpu.utils.data import _flatten_dict, allclose
+from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
+
+
+_UNSET_GROUP = object()  # sentinel: "no coalesced member seen yet" (None is a real group)
 
 
 def _member_state_snapshot(m: Metric) -> Dict[str, Any]:
@@ -997,9 +1003,215 @@ class MetricCollection:
         self._fault_note_clean()
 
     def compute(self) -> Dict[str, Any]:
-        res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        # suite-coalesced auto-sync: in a live multi-process world the whole
+        # suite syncs as ONE packed collective up front, so every member's
+        # compute sees itself presynced instead of issuing its own 2-per-state
+        # gather walk (single-process mode: ctx is None, nothing changes)
+        ctx = self._auto_sync_context()
+        if ctx is not None:
+            with ctx:
+                res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        else:
+            res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
+
+    # ------------------------------------------------------------------- sync
+    def sync(
+        self,
+        dist_sync_fn: Optional[Any] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Any] = jit_distributed_available,
+    ) -> None:
+        """Sync every member across processes — the whole suite as ONE
+        coalesced payload collective where possible.
+
+        Every eligible member's state tree (including wrapper children) packs
+        into a single flat buffer; one shape/metadata exchange (skipped
+        entirely on the static fast lane) plus one payload ``process_allgather``
+        replaces the per-member, per-state 2-collective walk, and one
+        engine-cached jitted program unpacks and reduces everything (see
+        :mod:`metrics_tpu.parallel.bucketing`). Members are packed member-wise
+        (not leader-wise): the packed layout then depends only on the
+        constructed suite, never on the data-dependent compute-group merge,
+        so every process builds the identical layout. Ineligible members — a
+        custom ``dist_sync_fn``, un-coalescible states, a demoted
+        ``sync-pack`` lane, a divergent ``process_group`` — sync individually
+        through their own :meth:`Metric.sync`. A pack failure demotes the
+        suite's ``sync-pack`` ladder lane and replays member-wise (bit-exact);
+        any transport failure rolls back every already-synced member and
+        re-raises classified, so a failed suite sync leaves ALL local state
+        intact and retryable.
+        """
+        if not should_sync:
+            return
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not is_distributed:
+            return
+        self._defer_barrier()
+        members = list(self.items(keep_base=True, copy_state=False))
+        if any(m._is_synced for _, m in members):
+            raise MetricsUserError("The Metric has already been synced.")
+
+        suite_lad = self.__dict__.get("_fault_ladders", {}).get("sync-pack")
+        suite_ok = (
+            dist_sync_fn is None
+            and _bucketing.coalesce_enabled()
+            and not (suite_lad is not None and suite_lad.demoted)
+        )
+        coalesced: List[Tuple[Metric, List[Metric]]] = []
+        individual: List[Metric] = []
+        anchor_group: Any = _UNSET_GROUP
+        for _, m in members:
+            eligible = suite_ok and m.dist_sync_fn is None
+            lad = m.__dict__.get("_fault_ladders", {}).get("sync-pack")
+            if lad is not None and lad.demoted:
+                eligible = False
+            nodes: List[Metric] = []
+            if eligible:
+                m._defer_barrier()
+                nodes = _bucketing.tree_nodes(m)
+                for n in nodes:
+                    n._defer_barrier()
+                    n._canonicalize_list_states()
+                eff = process_group if process_group is not None else m.process_group
+                eligible = (
+                    not any(n._is_synced for n in nodes)
+                    and (
+                        process_group is not None
+                        or not any(n.process_group != m.process_group for n in nodes[1:])
+                    )
+                    and _bucketing.coalescible(nodes)
+                )
+                if eligible:
+                    if anchor_group is _UNSET_GROUP:
+                        anchor_group = eff
+                    elif eff != anchor_group:
+                        eligible = False  # one collective, one member subset
+            if eligible:
+                coalesced.append((m, nodes))
+            else:
+                individual.append(m)
+
+        try:
+            if coalesced:
+                all_nodes = [n for _, nodes in coalesced for n in nodes]
+                snaps = [(n, n._state_snapshot()) for n in all_nodes]
+                try:
+                    _bucketing.coalesced_sync_nodes(
+                        all_nodes, group=None if anchor_group is _UNSET_GROUP else anchor_group
+                    )
+                except _bucketing.CoalesceError as err:
+                    if not _bucketing.should_fallback(err):
+                        # live world, rank-LOCAL failure: surface classified —
+                        # a unilateral member-wise replay cannot pair with the
+                        # other ranks' single coalesced collective
+                        for n, snap in snaps:
+                            n._restore_state(snap)
+                        raise err.original from err
+                    _bucketing.handle_coalesce_failure(
+                        self,
+                        snaps,
+                        err,
+                        warn=(
+                            "Coalesced suite sync failed; falling back to member-wise "
+                            "syncs (bit-exact; each member may still coalesce its own "
+                            "tree — per-state only if its own pack also fails)."
+                        ),
+                    )
+                    individual = [m for m, _ in coalesced] + individual
+                else:
+                    for n, snap in snaps:
+                        n._cache = snap
+                        n._is_synced = True
+            for m in individual:
+                m.sync(
+                    dist_sync_fn=dist_sync_fn,
+                    process_group=process_group,
+                    should_sync=True,
+                    distributed_available=distributed_available,
+                )
+            if not coalesced:
+                # a whole member-wise suite sync is one clean step toward the
+                # suite lane's recovery edge (re-probe the coalescer after N)
+                lad = self.__dict__.get("_fault_ladders", {}).get("sync-pack")
+                if lad is not None and lad.demoted and lad.note_clean():
+                    lad.promote()
+        except Exception as exc:
+            # suite-level rollback: a failure mid-suite must not leave one
+            # member synced and another local (mirrors the flush replay
+            # semantics) — every member stays intact and retryable
+            for _, m in members:
+                if m._is_synced:
+                    try:
+                        m.unsync()
+                    except Exception:  # noqa: BLE001 — best-effort rollback
+                        pass
+            _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
+            raise
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore every member's pre-sync local state."""
+        if not should_unsync:
+            return
+        for _, m in self.items(keep_base=True, copy_state=False):
+            if m._is_synced:
+                m.unsync()
+
+    class _SyncContext:
+        def __init__(self, collection: "MetricCollection", should_unsync: bool = True, **kwargs: Any):
+            self.collection = collection
+            self.kwargs = kwargs
+            self.should_unsync = should_unsync
+
+        def __enter__(self) -> "MetricCollection":
+            self.collection.sync(**self.kwargs)
+            return self.collection
+
+        def __exit__(self, *exc: Any) -> None:
+            self.collection.unsync(should_unsync=self.should_unsync)
+
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Any] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Any] = jit_distributed_available,
+    ) -> "MetricCollection._SyncContext":
+        """Context manager: suite-coalesced sync on enter, restore on exit."""
+        return MetricCollection._SyncContext(
+            self,
+            should_unsync=should_unsync,
+            dist_sync_fn=dist_sync_fn,
+            process_group=process_group,
+            should_sync=should_sync,
+            distributed_available=distributed_available,
+        )
+
+    def _auto_sync_context(self) -> Optional["MetricCollection._SyncContext"]:
+        """The compute()-time suite sync, engaged only in the unambiguous
+        case: a live distributed world, coalescing on, and every member on
+        the default gather flags (``sync_on_compute`` pending, default
+        unsync, no custom ``dist_sync_fn``, not already synced). Anything
+        else keeps each member's own ``sync_context`` semantics untouched."""
+        try:
+            if not _bucketing.coalesce_enabled() or not jit_distributed_available():
+                return None
+            members = [m for _, m in self.items(keep_base=True, copy_state=False)]
+            if not members:
+                return None
+            if all(m._computed is not None for m in members):
+                return None  # every member returns its cache: zero syncs either way
+            if any(
+                m._is_synced or not m._to_sync or not m._should_unsync or m.dist_sync_fn is not None
+                for m in members
+            ):
+                return None
+        except Exception:  # noqa: BLE001 — auto path must never break compute
+            return None
+        return self.sync_context()
 
     def reset(self) -> None:
         for _, m in self.items(keep_base=True, copy_state=False):

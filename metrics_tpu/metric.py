@@ -39,6 +39,7 @@ import numpy as np
 
 from metrics_tpu.ops import engine as _engine
 from metrics_tpu.ops import faults as _faults
+from metrics_tpu.parallel import bucketing as _bucketing
 from metrics_tpu.parallel.collectives import sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
 from metrics_tpu.parallel.sync import distributed_available as _dist_available
@@ -1764,20 +1765,81 @@ class Metric(ABC):
             input_dict, (jax.Array, np.ndarray), dist_sync_fn, group=process_group or self.process_group
         )
 
-        for name, reduction_fn in self._reductions.items():
-            gathered = output_dict[name]
-            if isinstance(gathered, list) and len(gathered) == 0:
-                # never-updated list state: nothing was gathered on any rank
-                setattr(self, name, [])
-                continue
-            if isinstance(gathered[0], (jax.Array, np.ndarray)):
-                gathered = jnp.stack([jnp.asarray(g) for g in gathered])
-            elif isinstance(gathered[0], list):
-                gathered = _flatten(gathered)
-            if not (callable(reduction_fn) or reduction_fn is None):
-                raise TypeError("reduction_fn must be callable or None")
-            reduced = reduction_fn(gathered) if reduction_fn is not None else gathered
-            setattr(self, name, reduced)
+        # the per-state stack+reduce tail runs as ONE engine-cached program
+        # (list-of-list gathers and empties keep their host branches; any
+        # program failure replays the state-by-state loop bit-exactly)
+        _bucketing.apply_gathered_states(self, output_dict)
+
+    def _sync_coalesced(self, dist_sync_fn: Callable, process_group: Optional[Any]) -> bool:
+        """Try the coalesced bucketed protocol for this metric's whole tree.
+
+        One packed payload collective (plus at most one shape exchange — see
+        :mod:`metrics_tpu.parallel.bucketing`) replaces the 2-per-state walk,
+        and children are marked synced with their own snapshots so
+        ``unsync`` behaves exactly like the recursive path. Returns False to
+        fall back to the per-state protocol (custom ``dist_sync_fn``,
+        ``METRICS_TPU_SYNC_COALESCE=0``, a demoted ``sync-pack`` lane,
+        un-coalescible states, or a classified pack failure — which demotes
+        the lane, bit-exact fallback); transport faults raise to the caller's
+        snapshot/restore like the per-state gather would.
+        """
+        if dist_sync_fn is not gather_all_tensors:
+            return False  # custom gather: the injected protocol owns the walk
+        if not _bucketing.coalesce_enabled():
+            return False
+        lad = self.__dict__.get("_fault_ladders", {}).get("sync-pack")
+        if lad is not None and lad.demoted:
+            return False  # clean per-state syncs advance the recovery edge
+        nodes = _bucketing.tree_nodes(self)
+        if any(n._is_synced for n in nodes[1:]):
+            return False  # the recursive path raises its documented error
+        if process_group is None and any(
+            n.process_group != self.process_group for n in nodes[1:]
+        ):
+            return False  # per-node groups: each child must gather its own
+        if not _bucketing.coalescible(nodes):
+            return False
+        snaps = []
+        for n in nodes[1:]:
+            n._defer_barrier()
+            n._canonicalize_list_states()
+            snaps.append((n, n._state_snapshot()))
+        try:
+            _bucketing.coalesced_sync_nodes(nodes, group=process_group or self.process_group)
+        except _bucketing.CoalesceError as err:
+            if not _bucketing.should_fallback(err):
+                # live multi-process world, rank-LOCAL failure: a unilateral
+                # protocol switch cannot pair with the other ranks'
+                # collectives — surface classified instead (the caller's
+                # handler restores; sync stays retryable)
+                for n, snap in snaps:
+                    n._restore_state(snap)
+                raise err.original from err
+            _bucketing.handle_coalesce_failure(
+                self,
+                snaps,
+                err,
+                warn=(
+                    f"Coalesced sync failed for {type(self).__name__}; falling back to the"
+                    " per-state gather protocol (bit-exact, one collective pair per state)."
+                ),
+            )
+            return False
+        except Exception:
+            for n, snap in snaps:
+                n._restore_state(snap)
+            raise  # the caller's handler restores self and classifies
+        for n, snap in snaps:
+            n._cache = snap
+            n._is_synced = True
+        return True
+
+    def _sync_note_clean(self) -> None:
+        """One clean sync at the per-state tier: advance the ``sync-pack``
+        recovery edge (the coalesced path re-probes once it fires)."""
+        lad = self.__dict__.get("_fault_ladders", {}).get("sync-pack")
+        if lad is not None and lad.demoted and lad.note_clean():
+            lad.promote()
 
     def sync(
         self,
@@ -1810,19 +1872,25 @@ class Metric(ABC):
         self._canonicalize_list_states()
         self._cache = self._state_snapshot()
         try:
-            self._sync_dist(dist_sync_fn, process_group=process_group)
-            self._is_synced = True
-            # wrappers/compositions hold their accumulators in child metrics, not
-            # in their own state registry — sync recurses so the wrapper's
-            # distributed value equals the reference's module-tree sync
-            # (reference wrappers' child states are registered submodule states)
-            for child in self._sync_children():
-                child.sync(
-                    dist_sync_fn=dist_sync_fn,
-                    process_group=process_group,
-                    should_sync=should_sync,
-                    distributed_available=distributed_available,
-                )
+            if self._sync_coalesced(dist_sync_fn, process_group):
+                self._is_synced = True
+            else:
+                self._sync_dist(dist_sync_fn, process_group=process_group)
+                self._is_synced = True
+                # wrappers/compositions hold their accumulators in child metrics, not
+                # in their own state registry — sync recurses so the wrapper's
+                # distributed value equals the reference's module-tree sync
+                # (reference wrappers' child states are registered submodule states)
+                for child in self._sync_children():
+                    child.sync(
+                        dist_sync_fn=dist_sync_fn,
+                        process_group=process_group,
+                        should_sync=should_sync,
+                        distributed_available=distributed_available,
+                    )
+                # a clean per-state sync counts toward the sync-pack recovery
+                # edge: a demoted coalescer re-probes after N clean syncs
+                self._sync_note_clean()
         except Exception as exc:
             # a failed sync must leave local state INTACT and retryable: a
             # mid-gather failure may have overwritten some states with merged

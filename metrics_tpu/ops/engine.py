@@ -345,7 +345,13 @@ def engine_stats() -> Dict[str, Any]:
     failure-domain telemetry from :mod:`metrics_tpu.ops.faults`: per-domain
     ``fault_<domain>`` counters, ``fault_demotions`` / ``fault_promotions``
     (degradation-ladder transitions), ``fault_injected``, and the bounded
-    ``failure_log`` ring buffer (newest last)."""
+    ``failure_log`` ring buffer (newest last) — plus the sync-protocol
+    telemetry from :mod:`metrics_tpu.parallel.sync`:
+    ``sync_collectives_issued`` / ``sync_shape_collectives`` /
+    ``sync_payload_collectives`` (protocol collective slots),
+    ``sync_bytes_gathered``, ``sync_coalesce_ratio`` (states packed per
+    coalesced payload), fast-lane hit/miss counts and
+    ``sync_pack_fallbacks``."""
     out: Dict[str, Any] = {
         "builds": _stats["builds"],
         "hits": _stats["hits"],
@@ -355,6 +361,9 @@ def engine_stats() -> Dict[str, Any]:
         "deferred_fallbacks": _stats["deferred_fallbacks"],
     }
     out.update(_faults.fault_stats())
+    from metrics_tpu.parallel import sync as _psync
+
+    out.update(_psync.collective_stats())
     return out
 
 
@@ -368,6 +377,11 @@ def reset_engine() -> None:
     _stats["deferred_flushes"] = 0
     _stats["deferred_fallbacks"] = 0
     _faults.clear_fault_state()
+    from metrics_tpu.parallel import bucketing as _bucketing
+    from metrics_tpu.parallel import sync as _psync
+
+    _psync.reset_collective_stats()
+    _bucketing._MANIFEST_CACHE.clear()
 
 
 # ----------------------------------------------- deferred micro-batched dispatch

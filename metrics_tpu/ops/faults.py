@@ -82,8 +82,19 @@ __all__ = [
 TIERS = ("fused", "chunked", "eager", "host")
 
 #: Named injection sites instrumented across the stack. ``flush-chunk-<k>``
-#: is the indexed family (``flush-chunk`` matches every chunk).
-FAULT_SITES = ("probe", "compile", "flush-chunk", "donation", "sync-gather", "host-offload")
+#: is the indexed family (``flush-chunk`` matches every chunk). ``sync-pack``
+#: fires at the entry of the coalesced bucketed-sync pack phase
+#: (``parallel/bucketing.py``) — before any collective, so an injected fault
+#: exercises the demote-to-per-state ladder with local state intact.
+FAULT_SITES = (
+    "probe",
+    "compile",
+    "flush-chunk",
+    "donation",
+    "sync-gather",
+    "sync-pack",
+    "host-offload",
+)
 
 _SITE_DEFAULT_EXC = {
     "probe": TraceFault,
@@ -91,6 +102,9 @@ _SITE_DEFAULT_EXC = {
     "flush-chunk": RuntimeFault,
     "donation": DonationFault,
     "sync-gather": SyncFault,
+    # runtime domain: recoverable, so the sync-pack ladder earns the
+    # demote -> clean-syncs -> re-promote edge
+    "sync-pack": RuntimeFault,
     "host-offload": HostOffloadFault,
 }
 

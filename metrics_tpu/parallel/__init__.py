@@ -1,9 +1,11 @@
 """Distributed state synchronisation: SPMD collectives + multi-host backend."""
+from metrics_tpu.parallel.bucketing import coalesce_enabled
 from metrics_tpu.parallel.collectives import sync_array, sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
 from metrics_tpu.parallel.sharding import shard_states, state_shardings
 from metrics_tpu.parallel.sync import (
     class_reduce,
+    collective_stats,
     distributed_available,
     gather_all_tensors,
     reduce,
@@ -21,4 +23,6 @@ __all__ = [
     "world_size",
     "reduce",
     "class_reduce",
+    "coalesce_enabled",
+    "collective_stats",
 ]

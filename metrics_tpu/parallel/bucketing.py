@@ -1,0 +1,652 @@
+"""Coalesced bucketed sync: one collective per sync, one program to unpack.
+
+The reference's ``gather_all_tensors`` protocol (`utilities/distributed.py:102-151`)
+is per-tensor: a metric with S states pays 2·S blocking collectives per sync
+(shape exchange + payload for each state), and a ``MetricCollection`` of M
+metrics pays 2·M·S — at ~tens of ms per blocking round trip on a tunneled
+backend, sync time is pure launch latency (BENCH_r05; EQuARX, arXiv:2506.17615,
+measures the same regime inside XLA: small-payload collectives are
+latency-bound, so fewer+larger wins). This module is the gradient-bucketing
+answer for metric state:
+
+- **Pack**: every reduce-path state of a metric tree (the metric plus its
+  ``_sync_children`` recursion — wrappers, compositions, bootstrap clones) —
+  or, lifted to ``MetricCollection.sync``, of the whole suite — is flattened
+  to raw bytes (``lax.bitcast_convert_type`` → ``uint8``; bit-exact for every
+  fixed-width dtype) and concatenated into ONE flat buffer by a single
+  engine-cached jitted pack program. A host-side layout manifest records each
+  state's byte range, shape, dtype and reduction spec.
+- **Exchange**: fixed-shape states ("static" entries — everything except
+  ``cat``-reduction list states) need no shape exchange at all: their byte
+  ranges are known from the layout, which is cached per layout key (the
+  **static fast lane** — steady-state sync is exactly ONE collective).
+  ``cat`` states keep the reference's uneven-shape protocol, but coalesced:
+  ONE metadata all-gather carries every dynamic state's dims plus the total
+  packed length, then everything still rides the single payload collective
+  (pad to the max total, gather, slice per rank).
+- **Unpack + reduce**: one engine-cached jitted program (``ops/engine.py``
+  program cache; the gathered buffer is donated) slices every state out of
+  the gathered ``(world, bytes)`` buffer, bitcasts it back, and applies the
+  same reduction callables the per-state path uses (``dim_zero_sum`` /
+  ``mean`` / ``max`` / ``min`` / ``dim_zero_cat`` / stack) — bit-exact by
+  construction, compiled once per layout. Custom-callable reductions are
+  applied host-side on the unpacked stack, exactly like the per-state path.
+
+Failure domain: packing/unpacking failures raise :class:`CoalesceError`; the
+callers (``Metric.sync`` / ``MetricCollection.sync``) classify them through
+the ``sync-pack`` fault site, demote the owner's ``sync-pack`` ladder lane
+and replay the per-state protocol (bit-exact fallback; a mid-pack failure
+never mutates state — all ``setattr`` happen after the whole unpack
+succeeds). Transport failures keep the per-state semantics: the collective
+phase runs under the same retry-with-backoff budget and the classified
+``SyncFault`` surfaces to the caller's snapshot/restore.
+
+``METRICS_TPU_SYNC_COALESCE=0`` restores the per-state protocol exactly.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel import sync as _sync
+from metrics_tpu.parallel.reductions import _SPEC_TO_FN
+from metrics_tpu.utils.data import _flatten, dim_zero_cat
+
+__all__ = [
+    "CoalesceError",
+    "apply_gathered_states",
+    "coalesce_enabled",
+    "coalesced_sync_nodes",
+    "coalescible",
+    "tree_nodes",
+]
+
+
+class CoalesceError(Exception):
+    """A pack/unpack/program failure inside the coalesced engine.
+
+    Never a transport fault. ``original`` carries the underlying exception
+    for classification. ``rank_symmetric`` marks failures every process is
+    guaranteed to hit identically (e.g. the layout cross-check mismatch,
+    derived from an exchange all ranks ran): only those may demote-and-
+    fall-back in a LIVE multi-process world — sync is a collective protocol,
+    and a rank-LOCAL failure falling back unilaterally would issue per-state
+    collectives that cannot pair with the other ranks' coalesced one (see
+    :func:`should_fallback`).
+    """
+
+    def __init__(self, original: BaseException, rank_symmetric: bool = False):
+        super().__init__(f"{type(original).__name__}: {original}")
+        self.original = original
+        self.rank_symmetric = rank_symmetric
+
+
+def should_fallback(err: "CoalesceError") -> bool:
+    """Whether a caller may demote and replay the per-state protocol for
+    ``err``. Always in a single-process (or simulated) world — fallback is
+    rank-trivially symmetric there, and it is the tested surface. In a live
+    multi-process world only rank-symmetric failures may switch protocols;
+    a rank-local failure must surface classified instead (snapshot/restore
+    keeps local state intact and the sync retryable — the same exposure the
+    per-state protocol has for a mid-walk failure)."""
+    return err.rank_symmetric or not _sync.distributed_available()
+
+
+def coalesce_enabled() -> bool:
+    """``METRICS_TPU_SYNC_COALESCE`` gate (default on). Read per call —
+    sync runs off the per-step hot path."""
+    return os.environ.get("METRICS_TPU_SYNC_COALESCE", "1").lower() not in ("0", "false")
+
+
+# ------------------------------------------------------------------ tree walk
+def tree_nodes(metric: Any) -> List[Any]:
+    """The metric plus every ``_sync_children`` descendant, pre-order — the
+    exact node order the legacy recursive ``sync`` visits, so the packed
+    layout is deterministic and identical on every process."""
+    nodes = [metric]
+    for child in metric._sync_children():
+        nodes.extend(tree_nodes(child))
+    return nodes
+
+
+_UNPACKABLE_DTYPES = ("int4", "uint4")
+
+
+def _packable_dtype(dtype: Any) -> bool:
+    dt = jnp.dtype(dtype)
+    if dt == jnp.bool_:
+        return True
+    return dt.itemsize >= 1 and dt.name not in _UNPACKABLE_DTYPES
+
+
+def coalescible(nodes: Sequence[Any]) -> bool:
+    """Whether every node's every state can ride the packed protocol.
+
+    Declines (→ per-state fallback, no warning): a node overriding
+    ``_sync_dist`` while holding its own states (custom gather semantics),
+    non-``cat`` list states (the reference's element-wise gather walk),
+    non-array leaves, and sub-byte dtypes the bitcast packing cannot carry.
+    """
+    from metrics_tpu.metric import Metric  # local: metric.py imports us
+
+    for node in nodes:
+        if type(node)._sync_dist is not Metric._sync_dist and node._defaults:
+            return False
+        for name, fn in node._reductions.items():
+            if not (callable(fn) or fn is None):
+                return False  # legacy raises TypeError — keep that path's error
+            spec = node._reduction_specs[name]
+            value = getattr(node, name)
+            if isinstance(value, list):
+                if spec != "cat":
+                    return False
+                for row in value:
+                    if not isinstance(row, (jax.Array, np.ndarray)) or isinstance(
+                        row, jax.core.Tracer
+                    ):
+                        return False
+                    if not _packable_dtype(row.dtype):
+                        return False
+            else:
+                if not isinstance(value, (jax.Array, np.ndarray)) or isinstance(
+                    value, jax.core.Tracer
+                ):
+                    return False
+                if not _packable_dtype(value.dtype):
+                    return False
+    return True
+
+
+# ------------------------------------------------------------ layout manifest
+class _Entry:
+    """One packed state: where it lives in the flat buffer and how it reduces.
+
+    ``kind``: "static" (fixed shape, byte range known from the layout),
+    "dyn" (``cat`` list state — shape exchanged), "empty" (never-updated
+    list state — zero bytes, applies ``[]`` like the per-state path).
+    """
+
+    __slots__ = ("node_idx", "name", "kind", "spec", "dtype", "shape", "ndim")
+
+    def __init__(self, node_idx, name, kind, spec, dtype=None, shape=None, ndim=None):
+        self.node_idx = node_idx
+        self.name = name
+        self.kind = kind
+        self.spec = spec
+        self.dtype = dtype
+        self.shape = shape
+        self.ndim = ndim
+
+    def sig(self) -> tuple:
+        return (
+            self.node_idx,
+            self.name,
+            self.kind,
+            self.spec,
+            None if self.dtype is None else jnp.dtype(self.dtype).name,
+            self.shape,
+            self.ndim,
+        )
+
+
+def _byte_len(shape: tuple, dtype: Any) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * max(1, jnp.dtype(dtype).itemsize)
+
+
+def _collect(nodes: Sequence[Any]) -> Tuple[List[_Entry], List[Any]]:
+    """Walk the tree and build the layout manifest plus the pack values.
+
+    Values are ordered static-first then dynamic (the packed buffer layout),
+    mirroring the per-state protocol's treatment of each state: ``cat`` lists
+    pre-concatenate to one row (``len>1``) or pass the raw row (``len==1``);
+    bare-array holders are static entries regardless of spec.
+    """
+    statics: List[_Entry] = []
+    dyns: List[_Entry] = []
+    empties: List[_Entry] = []
+    static_vals: List[Any] = []
+    dyn_vals: List[Any] = []
+    for idx, node in enumerate(nodes):
+        for name in node._reductions:
+            spec = node._reduction_specs[name]
+            value = getattr(node, name)
+            if isinstance(value, list):
+                if len(value) == 0:
+                    empties.append(_Entry(idx, name, "empty", spec))
+                    continue
+                row = dim_zero_cat(value) if len(value) > 1 else jnp.asarray(value[0])
+                dyns.append(_Entry(idx, name, "dyn", spec, dtype=row.dtype, ndim=row.ndim))
+                dyn_vals.append(row)
+            else:
+                value = jnp.asarray(value)
+                statics.append(
+                    _Entry(idx, name, "static", spec, dtype=value.dtype, shape=tuple(value.shape))
+                )
+                static_vals.append(value)
+    # static entries pack first: their byte ranges never move between syncs
+    return statics + dyns + empties, static_vals + dyn_vals
+
+
+def _layout_key(entries: Sequence[_Entry]) -> tuple:
+    return tuple(e.sig() for e in entries)
+
+
+# ----------------------------------------------------------- byte conversion
+def _to_bytes(x: jax.Array) -> jax.Array:
+    """Flatten one array to its raw bytes (bit-exact, trace-safe)."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    if x.dtype != jnp.uint8:
+        x = jax.lax.bitcast_convert_type(x, jnp.uint8)
+    return x.reshape(-1)
+
+
+def _from_bytes(seg: jax.Array, shape: tuple, dtype: Any) -> jax.Array:
+    """Reverse of :func:`_to_bytes` for one state's byte segment."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.bool_:
+        return seg.reshape(shape).astype(jnp.bool_)
+    itemsize = dt.itemsize
+    if itemsize == 1:
+        seg = seg.reshape(shape)
+        return seg if dt == jnp.dtype(jnp.uint8) else jax.lax.bitcast_convert_type(seg, dt)
+    return jax.lax.bitcast_convert_type(seg.reshape(tuple(shape) + (itemsize,)), dt)
+
+
+# ------------------------------------------------------------------ transport
+# Module-level hooks so tests can simulate an N-process world without a real
+# multi-host runtime (monkeypatch these two; see tests/parallel/
+# test_coalesced_sync.py). Row 0 of the returned stack is the caller's own.
+def _host_allgather(vec: np.ndarray) -> np.ndarray:
+    """Metadata exchange: all-gather one small host int vector."""
+    if not _sync.distributed_available():
+        return np.asarray(vec)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(vec)))
+
+
+def _payload_allgather(packed: jax.Array) -> jax.Array:
+    """Payload collective: all-gather the flat byte buffer → (world, bytes)."""
+    if not _sync.distributed_available():
+        return packed[None]
+    from jax.experimental import multihost_utils
+
+    return jnp.asarray(multihost_utils.process_allgather(packed))
+
+
+# ------------------------------------------------------------- pack / unpack
+def _pack(entries: Sequence[_Entry], values: Sequence[Any]) -> Tuple[jax.Array, np.ndarray]:
+    """One jitted program: every state → one flat uint8 buffer.
+
+    Returns the packed buffer plus the dynamic-dims metadata vector
+    (``[*dims per dyn entry, total_bytes]``; int64 — byte totals overflow
+    int32 past 2 GiB) the uneven-shape lane exchanges. Cached per
+    (arity, dtypes) — shapes retrace inside the jit.
+    """
+    from metrics_tpu.ops import engine as _engine
+
+    values = [jnp.asarray(v) for v in values]
+    if not values:
+        return jnp.zeros((0,), jnp.uint8), np.asarray([0], np.int64)
+
+    key = ("sync-pack-prog", tuple(jnp.dtype(v.dtype).name for v in values))
+
+    def build():
+        def program(xs):
+            return jnp.concatenate([_to_bytes(x) for x in xs]) if xs else jnp.zeros((0,), jnp.uint8)
+
+        return program, None, {}
+
+    exe = _engine.acquire_keyed(key, build, donate=False)
+    packed = exe(values)  # plain twin: inputs are live state buffers, never donated
+    dyn_dims: List[int] = []
+    vi = iter(values)
+    for e in entries:
+        if e.kind == "empty":
+            continue
+        v = next(vi)
+        if e.kind == "dyn":
+            dyn_dims.extend(int(d) for d in v.shape)
+    dyn_dims.append(int(packed.shape[0]))
+    return packed, np.asarray(dyn_dims, np.int64)
+
+
+# fast-lane manifest cache: layout key -> True once the layout's byte ranges
+# have been established (and, in a live multi-process world, cross-checked)
+_MANIFEST_CACHE: Dict[tuple, bool] = {}
+_MANIFEST_CACHE_CAP = 512
+
+#: Sentinel carried OUT of the retried collective closure when the static-lane
+#: cross-check finds disagreeing layouts — structural, never retried.
+_LAYOUT_MISMATCH = object()
+
+
+def _parse_rank_meta(
+    entries: Sequence[_Entry], vec: np.ndarray
+) -> Tuple[List[tuple], int]:
+    """Split one rank's metadata vector back into per-dyn-entry shapes."""
+    shapes: List[tuple] = []
+    pos = 0
+    for e in entries:
+        if e.kind != "dyn":
+            continue
+        shapes.append(tuple(int(d) for d in vec[pos : pos + e.ndim]))
+        pos += e.ndim
+    return shapes, int(vec[pos])
+
+
+def _rank_offsets(
+    entries: Sequence[_Entry], dyn_shapes: Sequence[tuple]
+) -> List[Tuple[int, int, tuple]]:
+    """Byte ranges ``(offset, nbytes, shape)`` for one rank, in entry order
+    (skipping empties). Static entries occupy the fixed prefix."""
+    out = []
+    off = 0
+    di = iter(dyn_shapes)
+    for e in entries:
+        if e.kind == "empty":
+            continue
+        shape = e.shape if e.kind == "static" else next(di)
+        n = _byte_len(shape, e.dtype)
+        out.append((off, n, shape))
+        off += n
+    return out
+
+
+def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> None:
+    """Sync every node's states with ONE payload collective and one program.
+
+    The caller must have flushed/canonicalized/snapshotted every node. All
+    ``setattr`` happen only after the whole unpack succeeds, so any failure
+    leaves every node's local state intact. Raises:
+
+    - ``SyncConfigFault`` — invalid group (structural, never retried);
+    - ``SyncFault`` — the collective phase failed past its retry budget
+      (caller's snapshot/restore surfaces it, exactly like the per-state
+      path);
+    - :class:`CoalesceError` — pack/unpack/program failure (caller demotes
+      its ``sync-pack`` lane and replays the per-state protocol).
+    """
+    from metrics_tpu.ops import engine as _engine
+    from metrics_tpu.ops import faults as _faults
+    from metrics_tpu.utils.exceptions import SyncFault
+
+    members = _sync.validate_group_live(group)
+
+    # ---- pack (the "sync-pack" deterministic injection site) ----
+    try:
+        if _faults.armed:
+            _faults.maybe_fail("sync-pack")
+        entries, values = _collect(nodes)
+        packed_entries = [e for e in entries if e.kind != "empty"]
+        if not packed_entries:
+            for e in entries:
+                setattr(nodes[e.node_idx], e.name, [])
+            return
+        packed, meta_vec = _pack(entries, values)
+        key = _layout_key(entries)
+        has_dyn = any(e.kind == "dyn" for e in entries)
+    except SyncFault:
+        raise
+    except Exception as exc:  # noqa: BLE001 — classified by the caller's ladder
+        raise CoalesceError(exc) from exc
+
+    # ---- collective phase (same retry budget + injection site as the
+    # per-state gather; a post-budget transient surfaces as SyncFault).
+    # Layout disagreement is NOT raised inside the retried closure: a raise
+    # there would be retried (a unilateral re-issued exchange cannot pair
+    # with the other ranks' collectives) and then re-wrapped as a misleading
+    # SyncFault — the mismatch rides out as a sentinel and classifies as a
+    # CoalesceError below, where the caller's demote-to-per-state fallback
+    # can actually catch it.
+    def _attempt():
+        if _faults.armed:
+            _faults.maybe_fail("sync-gather")
+        local_total = int(packed.shape[0])
+        if has_dyn:
+            # uneven-shape lane: ONE metadata exchange for every dyn state
+            all_vecs = _host_allgather(meta_vec)
+            _sync.note_collective("shape")
+            _sync._bump("sync_fastlane_misses")
+            rank_meta = [_parse_rank_meta(entries, all_vecs[r]) for r in range(all_vecs.shape[0])]
+            max_total = max(total for _, total in rank_meta)
+        else:
+            # static fast lane: byte ranges are knowable from the layout.
+            # First sync of a layout in a LIVE multi-process world cross-checks
+            # the total against the other ranks once; after that (and always in
+            # single-process/simulated mode) the cached manifest skips the
+            # exchange entirely — steady-state sync is exactly one collective.
+            # The per-process cache stays rank-symmetric because a jax
+            # multi-host world runs the same program on every process (a rank
+            # cannot restart and rejoin mid-job), so every rank caches a
+            # layout at the same completed sync.
+            if key not in _MANIFEST_CACHE and _sync.distributed_available():
+                totals = _host_allgather(np.asarray([local_total], np.int64))
+                _sync.note_collective("shape")
+                if int(totals.max()) != int(totals.min()):
+                    return _LAYOUT_MISMATCH, sorted(set(int(t) for t in totals[:, 0]))
+            if key in _MANIFEST_CACHE:
+                _sync._bump("sync_fastlane_hits")
+            else:
+                _sync._bump("sync_fastlane_misses")
+            rank_meta = None
+            max_total = local_total
+        padded = (
+            packed
+            if local_total == max_total
+            else jnp.pad(packed, (0, max_total - local_total))
+        )
+        gathered = _payload_allgather(padded)
+        _sync.note_collective("payload", nbytes=int(np.prod(gathered.shape)))
+        return gathered, rank_meta
+
+    gathered, rank_meta = _faults.retry_with_backoff(
+        _attempt,
+        attempts=_sync.sync_retries(),
+        base_delay_s=_sync.sync_backoff_s(),
+        site="sync-gather",
+    )
+    if gathered is _LAYOUT_MISMATCH:
+        # every rank ran the same cross-check exchange and saw the same
+        # totals: this failure (and the resulting demotion) is rank-symmetric
+        raise CoalesceError(
+            ValueError(f"static-shape layouts disagree across processes (packed totals {rank_meta})"),
+            rank_symmetric=True,
+        )
+
+    # ---- unpack + reduce ----
+    # Static entries (the fixed prefix of every rank's buffer) unpack through
+    # ONE donated, engine-cached program whose key depends only on the static
+    # layout — a growing cat state never retraces it. Dynamic (cat) entries
+    # unpack with per-op eager dispatches (slice/bitcast/dim_zero_cat), the
+    # same op-level cost profile the per-state path paid for them — baking
+    # their per-sync shapes into the big program would recompile it on every
+    # sync and churn the engine's program cache.
+    try:
+        world = int(gathered.shape[0])
+        ranks = list(range(world)) if members is None else [r for r in members if r < world]
+        static_entries = [e for e in packed_entries if e.kind == "static"]
+        dyn_entries = [e for e in packed_entries if e.kind == "dyn"]
+        static_total = sum(_byte_len(e.shape, e.dtype) for e in static_entries)
+
+        results: Dict[Tuple[int, str], Any] = {}
+        if static_entries:
+            static_offsets = _rank_offsets(static_entries, ())
+            unpack_key = (
+                "sync-unpack",
+                tuple(e.sig() for e in static_entries),
+                world,
+                tuple(ranks),
+                static_total,
+            )
+
+            def build():
+                ents = list(static_entries)
+                offsets = list(static_offsets)
+
+                def program(buf):
+                    outs = []
+                    for (off, n, shape), e in zip(offsets, ents):
+                        stacked = jnp.stack(
+                            [_from_bytes(buf[r, off : off + n], shape, e.dtype) for r in ranks]
+                        )
+                        fn = _SPEC_TO_FN.get(e.spec)
+                        # None/custom specs return the stack; custom callables
+                        # run host-side on it, exactly like the per-state path
+                        outs.append(fn(stacked) if fn is not None else stacked)
+                    return tuple(outs)
+
+                return program, None, {}
+
+            exe = _engine.acquire_keyed(unpack_key, build, donate=True)
+            static_buf = gathered if not dyn_entries else gathered[:, :static_total]
+            # the byte buffer is donated opportunistically; when the bitcast
+            # outputs can't alias it XLA falls back to plain behavior with a
+            # compile-time inapplicability warning — not actionable here
+            with warnings.catch_warnings():
+                warnings.filterwarnings("ignore", message=".*donated buffers were not usable.*")
+                outs = exe.run(static_buf, donate=True)
+            for e, out in zip(static_entries, outs):
+                if e.spec == "custom":
+                    out = nodes[e.node_idx]._reductions[e.name](out)
+                results[(e.node_idx, e.name)] = out
+
+        if dyn_entries:
+            per_rank = [_rank_offsets(packed_entries, shapes) for shapes, _ in rank_meta]
+            for i, e in enumerate(dyn_entries):
+                pos = len(static_entries) + i
+                parts = []
+                for r in ranks:
+                    off, n, shape = per_rank[r][pos]
+                    parts.append(_from_bytes(gathered[r, off : off + n], shape, e.dtype))
+                # the per-state path's _flatten → dim_zero_cat walk
+                results[(e.node_idx, e.name)] = dim_zero_cat(parts)
+
+        new_values: List[Tuple[Any, str, Any]] = []
+        for e in entries:
+            value = [] if e.kind == "empty" else results[(e.node_idx, e.name)]
+            new_values.append((nodes[e.node_idx], e.name, value))
+    except Exception as exc:  # noqa: BLE001 — classified by the caller's ladder
+        raise CoalesceError(exc) from exc
+
+    # apply only after EVERY state unpacked — a mid-unpack failure above
+    # leaves every member's local state intact
+    for node, name, value in new_values:
+        setattr(node, name, value)
+
+    _MANIFEST_CACHE[key] = True
+    while len(_MANIFEST_CACHE) > _MANIFEST_CACHE_CAP:
+        _MANIFEST_CACHE.pop(next(iter(_MANIFEST_CACHE)))
+    _sync._bump("sync_states_coalesced", len(packed_entries))
+    _sync._bump("sync_coalesced_payloads")
+
+
+def handle_coalesce_failure(owner: Any, snaps: Sequence[Tuple[Any, Any]], err: "CoalesceError", warn: str) -> None:
+    """The one demotion sequence both callers share: restore every node's
+    snapshot (defensive — packing never mutates state), count the fallback,
+    classify the original failure and demote ``owner``'s ``sync-pack`` lane
+    with the owner+domain-deduped warning."""
+    from metrics_tpu.ops import faults as _faults
+
+    for node, snap in snaps:
+        node._restore_state(snap)
+    _sync._bump("sync_pack_fallbacks")
+    _faults.demote(
+        owner,
+        "sync-pack",
+        err.original,
+        default_domain="runtime",
+        tier="eager",
+        site="sync-pack",
+        warn=warn,
+    )
+
+
+# -------------------------------------------- fused per-state gather apply
+def apply_gathered_states(metric: Any, output_dict: Dict[str, Any]) -> None:
+    """Apply the per-state gather results as ONE jitted program.
+
+    The legacy ``_sync_dist`` tail dispatched ``jnp.stack`` + one reduction
+    per state; this folds every array-state stack+reduce into a single
+    engine-cached program (one dispatch per sync even on the per-state
+    fallback path). List-of-list gathers and empties keep their host
+    branches; custom callables run host-side on the fused stack. Any program
+    failure replays the state-by-state loop (bit-exact).
+    """
+    from metrics_tpu.ops import engine as _engine
+    from metrics_tpu.ops import faults as _faults
+
+    results: Dict[str, Any] = {}
+    fused: List[Tuple[str, Optional[str], List[Any]]] = []
+    for name, reduction_fn in metric._reductions.items():
+        gathered = output_dict[name]
+        if isinstance(gathered, list) and len(gathered) == 0:
+            # never-updated list state: nothing was gathered on any rank
+            results[name] = []
+            continue
+        if not (callable(reduction_fn) or reduction_fn is None):
+            raise TypeError("reduction_fn must be callable or None")
+        if isinstance(gathered[0], (jax.Array, np.ndarray)):
+            fused.append((name, metric._reduction_specs[name], [jnp.asarray(g) for g in gathered]))
+        elif isinstance(gathered[0], list):
+            flat = _flatten(gathered)
+            results[name] = reduction_fn(flat) if reduction_fn is not None else flat
+        else:
+            results[name] = reduction_fn(gathered) if reduction_fn is not None else gathered
+
+    if fused:
+        prog_key = (
+            "sync-apply",
+            tuple(
+                (spec, len(arrs), tuple(tuple(a.shape) for a in arrs), jnp.dtype(arrs[0].dtype).name)
+                for _, spec, arrs in fused
+            ),
+        )
+        specs = [spec for _, spec, _ in fused]
+
+        def build():
+            def program(groups):
+                outs = []
+                for spec, arrs in zip(specs, groups):
+                    stacked = jnp.stack(arrs)
+                    fn = _SPEC_TO_FN.get(spec)
+                    outs.append(fn(stacked) if fn is not None else stacked)
+                return tuple(outs)
+
+            return program, None, {}
+
+        outs = None
+        prog_exc: Optional[BaseException] = None
+        try:
+            exe = _engine.acquire_keyed(prog_key, build, donate=False)
+            # plain twin: in a 1-process world the gathered leaves ARE the
+            # live state buffers (and the caller's snapshot) — never donated
+            outs = exe([arrs for _, _, arrs in fused])
+        except Exception as exc:  # noqa: BLE001 — eager replay below
+            prog_exc = exc
+        if outs is None:
+            outs = []
+            for _, spec, arrs in fused:
+                stacked = jnp.stack(arrs)
+                fn = _SPEC_TO_FN.get(spec)
+                outs.append(fn(stacked) if fn is not None else stacked)
+            # only a program-layer fault: the eager replay above succeeded
+            _faults.note_fault(
+                _faults.classify(prog_exc, "runtime"), site="sync-apply", owner=metric, error=prog_exc
+            )
+        for (name, spec, _), out in zip(fused, outs):
+            if spec == "custom":
+                out = metric._reductions[name](out)
+            results[name] = out
+
+    for name, value in results.items():
+        setattr(metric, name, value)

@@ -95,6 +95,14 @@ def validate_group_live(group: Optional[Any]) -> Optional[List[int]]:
         ) from err
 
 
+class _EnvWarnOwner:
+    """Warn-dedupe anchor for env-knob parse warnings (``faults.warn_fault``
+    stores its once-per-domain marker on the owner instance)."""
+
+
+_RETRIES_WARN_OWNER = _EnvWarnOwner()
+
+
 def sync_retries() -> int:
     """Extra gather attempts after a failure (``METRICS_TPU_SYNC_RETRIES``).
 
@@ -104,15 +112,28 @@ def sync_retries() -> int:
     unilateral re-issued ``process_allgather`` would pair with the other
     ranks' next collective (mismatched payloads or a deadlock). Operators
     whose failure mode is symmetric (e.g. a coordinator timeout surfacing on
-    all ranks at once) opt in by setting the env var explicitly. Read per
-    call — gathers run at sync time, never on the per-step hot path."""
+    all ranks at once) opt in by setting the env var explicitly. An
+    unparseable value falls back to the SAME distributed-aware default as the
+    unset case (never a unilateral retry in a live world) and warns once.
+    Read per call — gathers run at sync time, never on the per-step hot
+    path."""
     raw = os.environ.get("METRICS_TPU_SYNC_RETRIES")
     if raw is None:
         return 0 if distributed_available() else 2
     try:
         return max(0, int(raw))
     except ValueError:
-        return 2
+        default = 0 if distributed_available() else 2
+        from metrics_tpu.ops import faults as _faults
+
+        _faults.warn_fault(
+            _RETRIES_WARN_OWNER,
+            "sync",
+            f"METRICS_TPU_SYNC_RETRIES={raw!r} is not an integer; falling back to the"
+            f" distributed-aware default ({default} — unilateral collective retries stay"
+            " opt-in in a live multi-process world).",
+        )
+        return default
 
 
 def sync_backoff_s() -> float:
@@ -124,20 +145,78 @@ def sync_backoff_s() -> float:
         return 0.05
 
 
+# ----------------------------------------------------------- collective audit
+# Protocol-slot counters: every point where the sync protocol WOULD issue a
+# collective in a live multi-process world counts, including in
+# single-process/simulated mode (the dryrun surface is where "one payload
+# collective per suite sync" is asserted — see docs/performance.md "Sync cost
+# model"). Surfaced through ``engine.engine_stats()``.
+_counters: dict = {
+    "sync_shape_collectives": 0,
+    "sync_payload_collectives": 0,
+    "sync_bytes_gathered": 0,
+    "sync_states_coalesced": 0,
+    "sync_coalesced_payloads": 0,
+    "sync_fastlane_hits": 0,
+    "sync_fastlane_misses": 0,
+    "sync_pack_fallbacks": 0,
+}
+
+
+def note_collective(kind: str, nbytes: int = 0) -> None:
+    """Count one protocol collective slot (``kind``: "shape" | "payload")."""
+    _counters[f"sync_{kind}_collectives"] += 1
+    if nbytes:
+        _counters["sync_bytes_gathered"] += int(nbytes)
+
+
+def _bump(name: str, n: int = 1) -> None:
+    _counters[name] += n
+
+
+def collective_stats() -> dict:
+    """Sync-protocol telemetry: collective-slot counters plus the coalescing
+    effectiveness ratio (states packed per coalesced payload collective —
+    the per-state protocol's 1.0 is the floor). Merged into
+    ``engine.engine_stats()``."""
+    out = dict(_counters)
+    out["sync_collectives_issued"] = (
+        out["sync_shape_collectives"] + out["sync_payload_collectives"]
+    )
+    payloads = out["sync_coalesced_payloads"]
+    out["sync_coalesce_ratio"] = (
+        round(out["sync_states_coalesced"] / payloads, 3) if payloads else 0.0
+    )
+    return out
+
+
+def reset_collective_stats() -> None:
+    for key in _counters:
+        _counters[key] = 0
+
+
 def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Array]:
+    result = jnp.asarray(result)
     if not distributed_available():
-        return [jnp.asarray(result)]
+        # single-process early-out still counts its protocol slots: the
+        # per-state protocol costs one shape exchange + one payload gather
+        # per state in any live world, and the dryrun/simulated surface is
+        # where the coalescing win is asserted
+        note_collective("shape")
+        note_collective("payload", nbytes=int(result.nbytes))
+        return [result]
 
     from jax.experimental import multihost_utils
 
-    result = jnp.asarray(result)
     local_shape = np.asarray(result.shape, dtype=np.int32)
     # 1) exchange shapes (rank count must match across processes)
+    note_collective("shape")
     all_shapes = np.asarray(multihost_utils.process_allgather(local_shape))
     max_shape = all_shapes.max(axis=0)
     # 2) pad to the max shape, 3) gather, 4) trim each entry back
     pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
     padded = jnp.pad(result, pad_width) if any(p[1] for p in pad_width) else result
+    note_collective("payload", nbytes=int(padded.nbytes) * int(all_shapes.shape[0]))
     gathered = multihost_utils.process_allgather(padded)
     out = []
     for idx in range(all_shapes.shape[0]) if members is None else members:
@@ -226,6 +305,9 @@ __all__ = [
     "validate_group_live",
     "sync_retries",
     "sync_backoff_s",
+    "note_collective",
+    "collective_stats",
+    "reset_collective_stats",
     "reduce",
     "class_reduce",
 ]

@@ -1,0 +1,487 @@
+"""Coalesced bucketed sync vs the per-state oracle.
+
+The coalesced engine (``metrics_tpu/parallel/bucketing.py``) must be
+observationally invisible: every value BIT-EXACT against the per-state
+gather protocol (the ``_FakeGather`` rank-walk oracle — no tolerance
+widening), with the collective count collapsing from 2-per-state-per-metric
+to one payload (plus at most one metadata exchange for uneven ``cat``
+states). The multi-process world is simulated by monkeypatching the two
+transport hooks (``_host_allgather`` / ``_payload_allgather``) with a fake
+that packs every other rank's metric tree through the same layout/pack code
+the syncing rank uses.
+"""
+from __future__ import annotations
+
+import copy
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops import engine, faults
+from metrics_tpu.parallel import bucketing
+from metrics_tpu.parallel import sync as psync
+from metrics_tpu.utils.exceptions import SyncFault
+from tests.helpers.testers import _FakeGather
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+    yield
+
+
+DIST_ON = lambda: True  # noqa: E731
+
+
+def _install_world(monkeypatch, rank_node_lists):
+    """Simulate an N-process world: rank 0 is the live syncing instance; the
+    other ranks' trees are packed lazily through the SAME layout/pack code at
+    collective time (after rank 0's own canonicalization, mirroring the
+    symmetric protocol)."""
+    cache = {}
+
+    def _rank_packs():
+        if "packs" not in cache:
+            packs, vecs = [], []
+            for nodes in rank_node_lists[1:]:
+                for n in nodes:
+                    n._canonicalize_list_states()
+                entries, values = bucketing._collect(nodes)
+                p, v = bucketing._pack(entries, values)
+                packs.append(p)
+                vecs.append(v)
+            cache["packs"], cache["vecs"] = packs, vecs
+        return cache["packs"], cache["vecs"]
+
+    def host(vec):
+        _, vecs = _rank_packs()
+        return np.stack([np.asarray(vec)] + [np.asarray(v) for v in vecs])
+
+    def payload(x):
+        packs, _ = _rank_packs()
+        pad_to = int(x.shape[0])
+        return jnp.stack([x] + [jnp.pad(p, (0, pad_to - int(p.shape[0]))) for p in packs])
+
+    monkeypatch.setattr(bucketing, "_host_allgather", host)
+    monkeypatch.setattr(bucketing, "_payload_allgather", payload)
+
+
+def _states_equal(a, b) -> None:
+    assert a.keys() == b.keys()
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, list) or isinstance(vb, list):
+            assert isinstance(va, list) and isinstance(vb, list) and len(va) == len(vb)
+            for ra, rb in zip(va, vb):
+                np.testing.assert_array_equal(np.asarray(ra), np.asarray(rb))
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def _collect_tree_state(m: Metric) -> dict:
+    out = {}
+    for i, node in enumerate(bucketing.tree_nodes(m)):
+        for name in node._defaults:
+            out[(i, name)] = getattr(node, name)
+    return out
+
+
+def _oracle_sync(rank_metrics):
+    """The per-state protocol on deep copies: the reference rank-walk."""
+    copies = [copy.deepcopy(m) for m in rank_metrics]
+    copies[0].sync(dist_sync_fn=_FakeGather(copies), distributed_available=DIST_ON)
+    return copies[0]
+
+
+class TestBitExactVsPerStateOracle:
+    def test_multi_state_metric(self, monkeypatch):
+        ranks = []
+        for r in range(3):
+            m = mt.MeanMetric()
+            m.update(jnp.asarray([1.0 + r, 4.0 * (r + 1)]))
+            ranks.append(m)
+        oracle = _oracle_sync(ranks)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        s0 = engine.engine_stats()
+        ranks[0].sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+        assert s1["sync_shape_collectives"] - s0["sync_shape_collectives"] == 0  # static lane
+        _states_equal(
+            {k: v for k, v in ranks[0].metric_state.items()},
+            {k: v for k, v in oracle.metric_state.items()},
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ranks[0].compute()), np.asarray(oracle.compute())
+        )
+        ranks[0].unsync()
+
+    def test_uneven_cat_states(self, monkeypatch):
+        rng = np.random.RandomState(3)
+        ranks = []
+        for r in range(3):
+            a = mt.AUROC(pos_label=1)
+            n = 12 - 3 * r  # UNEVEN per-rank row counts
+            a.update(jnp.asarray(rng.rand(n).astype(np.float32)), jnp.asarray(rng.randint(0, 2, n)))
+            ranks.append(a)
+        oracle = _oracle_sync(ranks)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        s0 = engine.engine_stats()
+        ranks[0].sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        # uneven-shape lane: ONE metadata exchange + ONE payload, not 2/state
+        assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+        assert s1["sync_shape_collectives"] - s0["sync_shape_collectives"] == 1
+        _states_equal(dict(ranks[0].metric_state), dict(oracle.metric_state))
+        np.testing.assert_array_equal(
+            np.asarray(ranks[0].compute()), np.asarray(oracle.compute())
+        )
+
+    def test_never_updated_list_state(self, monkeypatch):
+        class _Mixed(Metric):
+            full_state_update = True
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+                self.add_state("rows", [], dist_reduce_fx="cat")
+
+            def update(self, x):
+                self.total = self.total + jnp.sum(x)
+
+            def compute(self):
+                return self.total
+
+        ranks = []
+        for r in range(2):
+            m = _Mixed()
+            m.update(jnp.asarray([1.0 + r]))
+            ranks.append(m)
+        oracle = _oracle_sync(ranks)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        ranks[0].sync(distributed_available=DIST_ON)
+        assert ranks[0].rows == [] and oracle.rows == []
+        np.testing.assert_array_equal(np.asarray(ranks[0].total), np.asarray(oracle.total))
+        ranks[0].unsync()
+        assert ranks[0].rows == []
+
+    def test_wrapper_child_recursion(self, monkeypatch):
+        rng = np.random.RandomState(11)
+        ranks = []
+        for r in range(2):
+            b = mt.BootStrapper(mt.MeanSquaredError(), num_bootstraps=3, sampling_strategy="multinomial")
+            b._rng = np.random.RandomState(50 + r)
+            b.update(
+                jnp.asarray(rng.rand(8).astype(np.float32)),
+                jnp.asarray(rng.rand(8).astype(np.float32)),
+            )
+            ranks.append(b)
+        oracle = _oracle_sync(ranks)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        s0 = engine.engine_stats()
+        ranks[0].sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        # the whole clone fleet rides ONE payload collective
+        assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+        _states_equal(_collect_tree_state(ranks[0]), _collect_tree_state(oracle))
+        got = {k: np.asarray(v) for k, v in ranks[0].compute().items()}
+        want = {k: np.asarray(v) for k, v in oracle.compute().items()}
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+        # children were marked synced; unsync restores the whole tree
+        assert all(c._is_synced for c in ranks[0]._sync_children())
+        pre = _collect_tree_state(oracle)  # oracle still synced; compare post-restore below
+        ranks[0].unsync()
+        assert not any(c._is_synced for c in ranks[0]._sync_children())
+        oracle.unsync()
+        _states_equal(_collect_tree_state(ranks[0]), _collect_tree_state(oracle))
+        assert pre  # silence unused warning
+
+
+class TestProtocolGates:
+    def test_custom_dist_sync_fn_bypasses_coalescing(self):
+        ranks = [mt.MeanMetric() for _ in range(2)]
+        for r, m in enumerate(ranks):
+            m.update(jnp.asarray([float(r + 1)]))
+        s0 = engine.engine_stats()
+        ranks[0].sync(dist_sync_fn=_FakeGather(ranks), distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        # the injected protocol owns the walk: nothing was coalesced
+        assert s1["sync_coalesced_payloads"] == s0["sync_coalesced_payloads"]
+        np.testing.assert_allclose(float(ranks[0].compute()), 1.5)
+
+    def test_coalesce_env_off_restores_per_state_protocol(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_COALESCE", "0")
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([2.0, 4.0]))
+        s0 = engine.engine_stats()
+        m.sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        # 2 states -> one shape + one payload slot EACH, zero coalesced
+        assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 2
+        assert s1["sync_shape_collectives"] - s0["sync_shape_collectives"] == 2
+        assert s1["sync_coalesced_payloads"] == s0["sync_coalesced_payloads"]
+        m.unsync()
+        np.testing.assert_allclose(float(m.compute()), 3.0)
+
+    def test_sync_retries_env_garbage_uses_distributed_aware_default(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "not-a-number")
+        monkeypatch.setattr(psync, "_RETRIES_WARN_OWNER", psync._EnvWarnOwner())
+        with pytest.warns(UserWarning, match="METRICS_TPU_SYNC_RETRIES"):
+            assert psync.sync_retries() == 2  # single-process default
+        # warned ONCE per owner+domain
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            assert psync.sync_retries() == 2
+        # a live multi-process world must NOT inherit the unilateral-retry 2
+        monkeypatch.setattr(psync, "_RETRIES_WARN_OWNER", psync._EnvWarnOwner())
+        monkeypatch.setattr(psync, "distributed_available", lambda: True)
+        with pytest.warns(UserWarning, match="distributed-aware default"):
+            assert psync.sync_retries() == 0
+
+
+class TestFaultIntegration:
+    def test_sync_pack_demote_fallback_and_repromote(self):
+        # threshold 2: the in-call fallback sync counts clean step 1, so the
+        # demotion is still observable after the failing sync returns
+        faults.set_recovery_policy(steps=2)
+        try:
+            m = mt.MeanMetric()
+            m.update(jnp.asarray([2.0, 4.0]))
+            before = {k: np.asarray(v) for k, v in m.metric_state.items()}
+            s0 = engine.engine_stats()
+            with faults.inject_faults("sync-pack") as plan:
+                with pytest.warns(UserWarning, match="Coalesced sync failed"):
+                    m.sync(distributed_available=DIST_ON)
+            assert plan.fired == 1
+            # the fallback ran the per-state protocol, bit-exact (1-process
+            # sync is the identity) and the ladder recorded the demotion
+            after = {k: np.asarray(v) for k, v in m.metric_state.items()}
+            for k in before:
+                np.testing.assert_array_equal(after[k], before[k])
+            lad = m.__dict__["_fault_ladders"]["sync-pack"]
+            assert lad.demoted
+            s1 = engine.engine_stats()
+            assert s1["sync_pack_fallbacks"] - s0["sync_pack_fallbacks"] == 1
+            assert s1["sync_coalesced_payloads"] == s0["sync_coalesced_payloads"]
+            m.unsync()
+            # demoted: the next sync stays per-state AND counts clean step 2
+            m.sync(distributed_available=DIST_ON)
+            m.unsync()
+            assert not lad.demoted  # recovery edge fired (threshold 2)
+            # re-promoted: the next sync coalesces again
+            s2 = engine.engine_stats()
+            m.sync(distributed_available=DIST_ON)
+            s3 = engine.engine_stats()
+            assert s3["sync_coalesced_payloads"] - s2["sync_coalesced_payloads"] == 1
+            m.unsync()
+            np.testing.assert_allclose(float(m.compute()), 3.0)
+        finally:
+            faults.set_recovery_policy(steps=8)
+
+    def test_rank_local_pack_failure_in_live_world_raises_classified(self, monkeypatch):
+        """Sync is a collective protocol: in a LIVE multi-process world a
+        rank-local pack failure must surface classified (state intact,
+        retryable) instead of unilaterally switching to per-state collectives
+        the other ranks cannot pair with. Only rank-symmetric failures (the
+        layout cross-check mismatch) may demote-and-fall-back there."""
+        from metrics_tpu.utils.exceptions import RuntimeFault
+
+        m = mt.SumMetric()
+        m.update(jnp.asarray([5.0]))
+        monkeypatch.setattr(psync, "distributed_available", lambda: True)
+        monkeypatch.setattr(psync, "_gather_once", lambda result, members: [jnp.asarray(result)])
+        with faults.inject_faults("sync-pack") as plan:
+            with pytest.raises(RuntimeFault):
+                m.sync(distributed_available=DIST_ON)
+        assert plan.fired == 1
+        lad = m.__dict__.get("_fault_ladders", {}).get("sync-pack")
+        assert lad is None or not lad.demoted  # no unilateral protocol switch
+        assert not m._is_synced
+        np.testing.assert_array_equal(np.asarray(m.value), np.asarray(5.0))
+        # the symmetric layout mismatch DOES fall back, on every rank alike
+        monkeypatch.setattr(
+            bucketing, "_host_allgather", lambda v: np.stack([np.asarray(v), np.asarray(v) + 4])
+        )
+        with pytest.warns(UserWarning, match="Coalesced sync failed"):
+            m.sync(distributed_available=DIST_ON)
+        assert m.__dict__["_fault_ladders"]["sync-pack"].demoted
+        m.unsync()
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(5.0))
+
+    def test_sync_gather_fault_mid_suite_restores_every_member(self):
+        coll = mt.MetricCollection(
+            {"mean": mt.MeanMetric(), "mse": mt.MeanSquaredError(), "mae": mt.MeanAbsoluteError()}
+        )
+        p = jnp.asarray([0.2, 0.8])
+        t = jnp.asarray([0.0, 1.0])
+        coll.update(p, t)
+        before = {
+            k: {s: np.asarray(v) for s, v in m.metric_state.items()}
+            for k, m in coll.items(keep_base=True, copy_state=False)
+        }
+        with faults.inject_faults("sync-gather", count=100) as plan:
+            with pytest.raises(SyncFault):
+                coll.sync(distributed_available=DIST_ON)
+        assert plan.fired >= 1
+        # EVERY member's local state intact and retryable
+        for k, m in coll.items(keep_base=True, copy_state=False):
+            assert not m._is_synced
+            for s, v in m.metric_state.items():
+                np.testing.assert_array_equal(np.asarray(v), before[k][s])
+        coll.sync(distributed_available=DIST_ON)  # retry succeeds
+        coll.unsync()
+
+    def test_suite_pack_fault_falls_back_member_wise_bit_exact(self):
+        faults.set_recovery_policy(steps=1)
+        try:
+            coll = mt.MetricCollection({"mean": mt.MeanMetric(), "mse": mt.MeanSquaredError()})
+            coll.update(jnp.asarray([0.4]), jnp.asarray([0.5]))
+            oracle = copy.deepcopy(coll)
+            with faults.inject_faults("sync-pack") as plan:
+                with pytest.warns(UserWarning, match="Coalesced suite sync failed"):
+                    coll.sync(distributed_available=DIST_ON)
+            assert plan.fired == 1
+            coll.unsync()
+            got = {k: np.asarray(v) for k, v in coll.compute().items()}
+            want = {k: np.asarray(v) for k, v in oracle.compute().items()}
+            for k in want:
+                np.testing.assert_array_equal(got[k], want[k])
+            lad = coll.__dict__["_fault_ladders"]["sync-pack"]
+            assert lad.demoted
+            # member-wise suite syncs count clean steps; the edge re-arms
+            coll.sync(distributed_available=DIST_ON)
+            coll.unsync()
+            assert not lad.demoted
+            s0 = engine.engine_stats()
+            coll.sync(distributed_available=DIST_ON)
+            s1 = engine.engine_stats()
+            assert s1["sync_coalesced_payloads"] - s0["sync_coalesced_payloads"] == 1
+            coll.unsync()
+        finally:
+            faults.set_recovery_policy(steps=8)
+
+
+class TestSuiteCoalescing:
+    def _make(self):
+        return mt.MetricCollection(
+            {
+                "mean": mt.MeanMetric(),
+                "mse": mt.MeanSquaredError(),
+                "mae": mt.MeanAbsoluteError(),
+                "acc": mt.Accuracy(),
+            }
+        )
+
+    def test_one_payload_collective_per_suite_sync(self, monkeypatch):
+        rng = np.random.RandomState(0)
+        rank_colls = []
+        for r in range(2):
+            c = self._make()
+            c.update(
+                jnp.asarray(rng.rand(16).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 16))
+            )
+            rank_colls.append(c)
+
+        # per-member per-state oracle on deep copies
+        oracle = copy.deepcopy(rank_colls)
+        for name, m0 in oracle[0].items(keep_base=True, copy_state=False):
+            gather = _FakeGather([oc[name] for oc in oracle])
+            m0.sync(dist_sync_fn=gather, distributed_available=DIST_ON)
+        oracle_vals = {k: np.asarray(v) for k, v in oracle[0].compute().items()}
+
+        def suite_nodes(coll):
+            return [
+                n
+                for _, m in coll.items(keep_base=True, copy_state=False)
+                for n in bucketing.tree_nodes(m)
+            ]
+
+        _install_world(monkeypatch, [suite_nodes(c) for c in rank_colls])
+        s0 = engine.engine_stats()
+        rank_colls[0].sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        # >=4 multi-state metrics, ONE payload collective, zero shape exchanges
+        assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+        assert s1["sync_shape_collectives"] - s0["sync_shape_collectives"] == 0
+        assert s1["sync_states_coalesced"] - s0["sync_states_coalesced"] >= 8
+        got = {k: np.asarray(v) for k, v in rank_colls[0].compute().items()}
+        for k in oracle_vals:
+            np.testing.assert_array_equal(got[k], oracle_vals[k])
+        rank_colls[0].unsync()
+        for _, m in rank_colls[0].items(keep_base=True, copy_state=False):
+            assert not m._is_synced
+        # steady state: the cached manifest keeps repeat syncs at 1 collective
+        s2 = engine.engine_stats()
+        rank_colls[0].sync(distributed_available=DIST_ON)
+        s3 = engine.engine_stats()
+        assert s3["sync_payload_collectives"] - s2["sync_payload_collectives"] == 1
+        assert s3["sync_shape_collectives"] - s2["sync_shape_collectives"] == 0
+        assert s3["sync_fastlane_hits"] == s2["sync_fastlane_hits"] + 1
+        rank_colls[0].unsync()
+
+    def test_compute_auto_suite_sync_in_distributed_world(self, monkeypatch):
+        """In a live distributed world collection.compute() pre-syncs the
+        whole suite as ONE packed collective; every member computes presynced
+        and unsyncs on exit — values identical to the per-member protocol."""
+        import metrics_tpu.metric as metric_mod
+
+        p = jnp.asarray([0.2, 0.7, 0.9])
+        t = jnp.asarray([0, 1, 1])
+
+        monkeypatch.setenv("METRICS_TPU_SYNC_COALESCE", "0")
+        oracle = self._make()
+        oracle.update(p, t)
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        oracle_vals = {k: np.asarray(v) for k, v in oracle.compute().items()}
+        monkeypatch.delenv("METRICS_TPU_SYNC_COALESCE")
+
+        coll = self._make()
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: False)
+        coll.update(p, t)
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        s0 = engine.engine_stats()
+        got = {k: np.asarray(v) for k, v in coll.compute().items()}
+        s1 = engine.engine_stats()
+        assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+        for k in oracle_vals:
+            np.testing.assert_array_equal(got[k], oracle_vals[k])
+        # the context unsynced on exit: local state back, metrics retryable
+        for _, m in coll.items(keep_base=True, copy_state=False):
+            assert not m._is_synced
+
+    def test_member_with_custom_gather_syncs_individually(self):
+        coll = mt.MetricCollection({"mean": mt.MeanMetric(), "sum": mt.SumMetric()})
+        coll.update(jnp.asarray([2.0]))
+        calls = {"n": 0}
+
+        def custom(x, group=None):
+            calls["n"] += 1
+            return [jnp.asarray(x)]
+
+        coll["sum"].dist_sync_fn = custom
+        s0 = engine.engine_stats()
+        coll.sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        assert calls["n"] >= 1  # the custom protocol ran for its member
+        # the other member still coalesced
+        assert s1["sync_coalesced_payloads"] - s0["sync_coalesced_payloads"] == 1
+        coll.unsync()
+
+    def test_second_suite_sync_reuses_programs(self, monkeypatch):
+        c1 = self._make()
+        c1.update(jnp.asarray([0.3, 0.9]), jnp.asarray([0, 1]))
+        c1.sync(distributed_available=DIST_ON)
+        c1.unsync()
+        # an identically-configured suite adds ZERO new program builds
+        c2 = self._make()
+        c2.update(jnp.asarray([0.6, 0.1]), jnp.asarray([1, 0]))
+        builds0 = engine.engine_stats()["builds"]
+        c2.sync(distributed_available=DIST_ON)
+        assert engine.engine_stats()["builds"] == builds0
+        c2.unsync()

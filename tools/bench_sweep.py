@@ -16,6 +16,7 @@ its cost IS measured (model-backed metrics, detection mAP:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -693,6 +694,58 @@ def main() -> None:
             print(json.dumps(row))
         except Exception as err:
             print(json.dumps({"metric": name, "error": str(err)[:160]}))
+
+    # sync_per_call rows (ISSUE 5): whole-suite sync round trips, coalesced
+    # (one packed payload collective slot + one donated unpack program) vs
+    # the per-state protocol (2 collective slots per state per metric).
+    # collectives_per_sync is the multi-process cost model — each slot is a
+    # blocking ~sync_roundtrip_ms exchange on the tunneled backend; no
+    # reference arm (the torch reference needs a live process group).
+    for label, coalesce in (("suite_sync(coalesced)", True), ("suite_sync(per_state)", False)):
+        try:
+            os.environ["METRICS_TPU_SYNC_COALESCE"] = "1" if coalesce else "0"
+            from metrics_tpu.ops import engine as _sync_engine
+
+            dist_on = lambda: True  # noqa: E731
+            coll = mt.MetricCollection(
+                {
+                    "mean": mt.MeanMetric(),
+                    "mse": mt.MeanSquaredError(),
+                    "mae": mt.MeanAbsoluteError(),
+                    "acc": mt.Accuracy(),
+                }
+            )
+            reg = _data("binary", np.random.RandomState(0))
+            coll.update(jax.numpy.asarray(reg[0]), jax.numpy.asarray(reg[1]))
+            coll.sync(distributed_available=dist_on)  # warmup: programs compile
+            coll.unsync()
+            n_syncs = max(3, STEPS // 5)
+            s0 = _sync_engine.engine_stats()
+            best = float("inf")
+            for _ in range(TRIALS):
+                start = time.perf_counter()
+                for _ in range(n_syncs):
+                    coll.sync(distributed_available=dist_on)
+                    coll.unsync()
+                jax.block_until_ready(coll["mean"].value)
+                best = min(best, time.perf_counter() - start)
+            s1 = _sync_engine.engine_stats()
+            per_sync = (
+                s1["sync_shape_collectives"] + s1["sync_payload_collectives"]
+                - s0["sync_shape_collectives"] - s0["sync_payload_collectives"]
+            ) / (n_syncs * TRIALS)
+            row = {
+                "metric": label,
+                "mode": "sync",
+                "updates_per_s": round(n_syncs / best, 1),
+                "collectives_per_sync": round(per_sync, 2),
+            }
+            results.append(row)
+            print(json.dumps(row))
+        except Exception as err:
+            print(json.dumps({"metric": label, "error": str(err)[:160]}))
+        finally:
+            os.environ.pop("METRICS_TPU_SYNC_COALESCE", None)
 
     # reference pass LAST: converting/reading any device value flips the
     # tunneled backend into its post-read regime (~ms per dependent dispatch),

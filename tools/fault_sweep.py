@@ -2,8 +2,8 @@
 
 The ``make faults`` entry point. For each injection site (``probe``,
 ``compile``, ``flush-chunk-0``, ``flush-chunk-1``, ``donation``,
-``sync-gather``, ``host-offload``) it drives a representative workload under
-``metrics_tpu.ops.faults.inject_faults`` and asserts:
+``sync-gather``, ``sync-pack``, ``host-offload``) it drives a representative
+workload under ``metrics_tpu.ops.faults.inject_faults`` and asserts:
 
 - the final metric values are BIT-EXACT against a step-by-step eager oracle
   (fresh instance, deferral off, no tolerance widening);
@@ -128,6 +128,36 @@ def _scenario_sync(site: str):
     return raised and _tree_equal(m.compute(), np.asarray(3.0)), plan.fired
 
 
+def _scenario_sync_pack(site: str):
+    """Injected pack failure on a suite sync: the coalesced engine must
+    demote to the member-wise per-state protocol BIT-EXACTLY (no error
+    surfaces, local state intact), and the ladder must re-promote after the
+    clean-sync recovery edge (demote -> per-state -> coalesced again)."""
+    coll = mt.MetricCollection({"mean": mt.MeanMetric(), "mse": mt.MeanSquaredError()})
+    coll.update(A, A)
+    oracle = {k: np.asarray(v) for k, v in coll.compute().items()}
+    with faults.inject_faults(site) as plan:
+        coll.sync(distributed_available=lambda: True)  # falls back, no raise
+    coll.unsync()
+    ok = all(_tree_equal(np.asarray(v), oracle[k]) for k, v in coll.compute().items())
+    lad = coll.__dict__["_fault_ladders"]["sync-pack"]
+    ok = ok and lad.demoted
+    # clean member-wise syncs advance the recovery edge (policy steps=2)
+    for _ in range(2):
+        coll.sync(distributed_available=lambda: True)
+        coll.unsync()
+    ok = ok and not lad.demoted
+    # re-promoted: the suite coalesces again (one payload collective)
+    s0 = engine.engine_stats()["sync_coalesced_payloads"]
+    coll.sync(distributed_available=lambda: True)
+    coll.unsync()
+    ok = ok and engine.engine_stats()["sync_coalesced_payloads"] == s0 + 1
+    ok = ok and all(_tree_equal(np.asarray(v), oracle[k]) for k, v in coll.compute().items())
+    stats = engine.engine_stats()
+    ok = ok and stats["fault_demotions"] >= 1 and stats["fault_promotions"] >= 1
+    return ok, plan.fired
+
+
 def _scenario_host_offload(site: str):
     rows = jnp.asarray([1.0, 2.0])
     c = mt.CatMetric(compute_on_cpu=True)
@@ -149,6 +179,7 @@ SWEEP = {
     "flush-chunk-1": _scenario_update_queue,
     "donation": _scenario_per_call,
     "sync-gather": _scenario_sync,
+    "sync-pack": _scenario_sync_pack,
     "host-offload": _scenario_host_offload,
 }
 
