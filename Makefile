@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep faults ci clean convert-weights test-real-weights
+.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep faults chaos ci clean convert-weights test-real-weights
 
 # All targets run offline against the already-installed environment
 # (jax/flax/optax/pytest are assumed present — no network access needed).
@@ -60,11 +60,19 @@ sweep:
 	$(PY) tools/bench_sweep.py
 
 # Fault-injection sweep: every named site (probe/compile/flush-chunk-k/
-# donation/sync-gather/host-offload) across a representative metric set,
-# asserting bit-exactness vs the eager oracle and ladder recovery
-# (docs/robustness.md).
+# donation/sync-gather/sync-pack/host-offload/journal-write/journal-load)
+# across a representative metric set, asserting bit-exactness vs the eager
+# oracle and ladder recovery (docs/robustness.md) — then the fast subset of
+# the multi-fault chaos scenarios (timeout->compile-on-reprobe, crash with a
+# torn journal, pack->gather double fault), asserting the invariant
+# "bit-exact result or classified raise, never silent corruption".
 faults:
 	$(PY) tools/fault_sweep.py
+	$(PY) tools/chaos_sweep.py --fast
+
+# The full chaos sweep (adds the deferral-interaction scenarios).
+chaos:
+	$(PY) tools/chaos_sweep.py
 
 # What CI runs, in order (see .github/workflows/ci.yml).
 ci: docs doctest test-fast dryrun faults bench-smoke test-full

@@ -20,7 +20,10 @@ import jax.numpy as jnp
 from metrics_tpu.metric import (
     Metric,
     _DeferProbeDecline,
+    _degradable_sync_failure,
+    _enter_degraded,
     _leaves_jittable,
+    _note_degraded_serve,
     _probe_traceable,
     _propagate_static_attrs,
     jit_distributed_available,
@@ -28,6 +31,7 @@ from metrics_tpu.metric import (
 from metrics_tpu.ops import engine as _engine
 from metrics_tpu.ops import faults as _faults
 from metrics_tpu.parallel import bucketing as _bucketing
+from metrics_tpu.parallel import sync as _psync
 from metrics_tpu.utils.data import _flatten_dict, allclose
 from metrics_tpu.utils.exceptions import MetricsUserError
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -102,15 +106,18 @@ class MetricCollection:
         """
         deferred = self._defer_forward(args, kwargs)
         if deferred is not None:
+            self._journal_tick()
             return deferred
         fused = self._forward_fused(*args, **kwargs)
         if fused is not None:
+            self._journal_tick()
             return fused
         result = self._forward_member_wise(
             list(self.items(keep_base=True, copy_state=False)), *args, **kwargs
         )
         # clean member-wise step: demoted suite lanes count toward recovery
         self._fault_note_clean()
+        self._journal_tick()
         return result
 
     def _forward_member_wise(self, members: List[Tuple[str, Metric]], *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -933,6 +940,7 @@ class MetricCollection:
             if with_values:
                 m._forward_cache = jax.tree.map(lambda v: v[-1], values[name])
         self._fault_note_clean(n_steps)
+        self._journal_tick(n_steps)
         if with_values:
             res = _flatten_dict({name: values[name] for name, _ in members})
             return {self._set_name(k): v for k, v in res.items()}
@@ -961,6 +969,7 @@ class MetricCollection:
                 for name, m in members:
                     step_vals[name] = m._forward_reduce_state_update_eager(*a, **m._filter_kwargs(**k))
                     m._forward_cache = step_vals[name]
+                self._journal_tick()
                 if with_values:
                     res = _flatten_dict(step_vals)
                     values.append({self._set_name(kk): v for kk, v in res.items()})
@@ -979,6 +988,7 @@ class MetricCollection:
         suite-level queue that flushes as a single stacked scan program
         across the compute-group leaders."""
         if self._defer_update(args, kwargs):
+            self._journal_tick()
             return
         if self._groups_checked:
             for cg in self._groups.values():
@@ -1001,6 +1011,7 @@ class MetricCollection:
         # clean suite step at whatever tier ran: demoted suite lanes count
         # toward their recovery edge
         self._fault_note_clean()
+        self._journal_tick()
 
     def compute(self) -> Dict[str, Any]:
         # suite-coalesced auto-sync: in a live multi-process world the whole
@@ -1008,13 +1019,54 @@ class MetricCollection:
         # compute sees itself presynced instead of issuing its own 2-per-state
         # gather walk (single-process mode: ctx is None, nothing changes)
         ctx = self._auto_sync_context()
-        if ctx is not None:
-            with ctx:
-                res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        # quorum-degraded tier (METRICS_TPU_SYNC_DEGRADED=local, default off):
+        # while the suite's sync-degrade lane is down, serve LOCAL-ONLY member
+        # values; each serve is one clean step toward the recovery edge, whose
+        # firing re-probes the full suite sync on this very call
+        degraded_tier = _psync.sync_degraded_tier() if ctx is not None else None
+        serve_local = False
+        if degraded_tier is not None:
+            lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
+            if lad is not None and lad.demoted:
+                if lad.note_clean():
+                    lad.promote()
+                else:
+                    serve_local = True
+        if serve_local:
+            _note_degraded_serve(self)
+            res = self._compute_local()
+        elif ctx is not None:
+            try:
+                with ctx:
+                    res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+            except Exception as exc:  # noqa: BLE001 — only degradable sync faults caught
+                if degraded_tier is None or not _degradable_sync_failure(exc):
+                    raise
+                # the suite sync failed classified past its retries with every
+                # member's local state restored (collections.sync rollback):
+                # drop to the degraded tier and serve local-only values
+                # instead of raising (sync_health() carries the staleness tag)
+                _enter_degraded(self, exc)
+                res = self._compute_local()
         else:
             res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
+
+    def _compute_local(self) -> Dict[str, Any]:
+        """Every member's compute with its own sync suppressed — the degraded
+        tier's local-only serve. Each member's ``sync_on_compute`` intent is
+        preserved by save/restoring its ``_to_sync`` flag, so a later healed
+        compute syncs exactly as configured."""
+        members = list(self.items(keep_base=True, copy_state=False))
+        saved = [(m, m._to_sync) for _, m in members]
+        try:
+            for m, _ in saved:
+                m._to_sync = False
+            return {k: m.compute() for k, m in members}
+        finally:
+            for m, flag in saved:
+                m._to_sync = flag
 
     # ------------------------------------------------------------------- sync
     def sync(
@@ -1150,6 +1202,17 @@ class MetricCollection:
                         pass
             _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
             raise
+        # a completed suite sync is the "last good" marker for the suite and
+        # every member tree (sync_health() reports the monotonic step index)
+        step = _faults.tick()
+        object.__setattr__(self, "_last_good_sync_step", step)
+        if self.__dict__.get("_degraded_since_step") is not None:
+            object.__setattr__(self, "_degraded_since_step", None)
+        for _, m in members:
+            for n in _bucketing.tree_nodes(m):
+                object.__setattr__(n, "_last_good_sync_step", step)
+                if n.__dict__.get("_degraded_since_step") is not None:
+                    object.__setattr__(n, "_degraded_since_step", None)
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore every member's pre-sync local state."""
@@ -1218,6 +1281,109 @@ class MetricCollection:
             m.reset()
         if self._enable_compute_groups and self._groups_checked:
             self._compute_groups_create_state_ref()
+
+    # ------------------------------------------------------------- durability
+    def sync_health(self) -> Dict[str, Any]:
+        """Suite-level staleness metadata (see :meth:`Metric.sync_health`):
+        the suite's own ``sync-degrade`` lane plus a per-member breakdown —
+        ``degraded`` is True when the suite OR any member serves local-only
+        values."""
+        lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
+        members = {k: m.sync_health() for k, m in self.items(keep_base=True, copy_state=False)}
+        return {
+            "degraded": bool(lad is not None and lad.demoted)
+            or any(h["degraded"] for h in members.values()),
+            "degraded_tier": _psync.sync_degraded_tier(),
+            "last_good_sync_step": self.__dict__.get("_last_good_sync_step"),
+            "degraded_since_step": self.__dict__.get("_degraded_since_step"),
+            "degraded_serves": self.__dict__.get("_degraded_serves", 0),
+            "members": members,
+        }
+
+    def _journal_nodes(self) -> List[Metric]:
+        """Every member tree's nodes, member-wise in suite order — the same
+        deterministic walk the coalesced sync packs, so the journal layout
+        depends only on the constructed suite."""
+        return [
+            n
+            for _, m in self.items(keep_base=True, copy_state=False)
+            for n in _bucketing.tree_nodes(m)
+        ]
+
+    def save_state(self, path: str) -> int:
+        """Snapshot the whole suite into the crash-consistent journal at
+        ``path`` — ONE flat byte record for every member tree (see
+        :mod:`metrics_tpu.ops.journal`); returns the record size in bytes."""
+        from metrics_tpu.ops import journal as _journal
+
+        self._defer_barrier()
+        return _journal.save_nodes(self, self._journal_nodes(), path)
+
+    def load_state(self, path: str) -> int:
+        """Restore the whole suite from the newest good journal generation at
+        ``path``; returns the generation index restored (0 = newest). A
+        corrupt generation records a classified ``journal`` fault and demotes
+        to the previous good one; restore is all-or-nothing."""
+        from metrics_tpu.ops import journal as _journal
+
+        self._defer_barrier()
+        gen = _journal.load_nodes(self, self._journal_nodes(), path)
+        # compute-group members share state by reference; re-establish the
+        # sharing over the freshly-restored arrays (same as reset())
+        if self._enable_compute_groups and self._groups_checked:
+            self._compute_groups_create_state_ref()
+        return gen
+
+    def journal(self, path: Optional[str], every_n: int = 1) -> None:
+        """Arm suite-level auto-journaling: every ``every_n``-th ``update``
+        call snapshots the suite via :meth:`save_state` (``path=None``
+        disarms). Write failures never take down the update loop: they demote
+        the suite's ``journal`` ladder lane (warn once, snapshots skipped)
+        and clean updates advance the standard recovery edge, so a healed
+        disk resumes journaling automatically."""
+        if path is None:
+            self.__dict__.pop("_journal_cfg", None)
+            return
+        if int(every_n) < 1:
+            raise ValueError(f"journal every_n must be >= 1, got {every_n}")
+        object.__setattr__(
+            self, "_journal_cfg", {"path": str(path), "every_n": int(every_n), "count": 0}
+        )
+
+    def _journal_tick(self, n: int = 1) -> None:
+        """Per-step journal hook — one dict lookup when disarmed. Every
+        state-mutating suite call ticks (``update``, ``forward``/``__call__``,
+        and the ``*_many`` chunk APIs, which credit their whole chunk), so an
+        armed journal snapshots regardless of which step API drives the
+        loop. A chunk that crosses the ``every_n`` cadence saves once."""
+        cfg = self.__dict__.get("_journal_cfg")
+        if cfg is None:
+            return
+        before = cfg["count"]
+        cfg["count"] = before + n
+        if cfg["count"] // cfg["every_n"] == before // cfg["every_n"]:
+            return
+        lad = self.__dict__.get("_fault_ladders", {}).get("journal")
+        if lad is not None and lad.demoted:
+            return  # journaling degraded; clean updates advance the edge
+        try:
+            self.save_state(cfg["path"])
+        except Exception as exc:  # noqa: BLE001 — auto-journaling must not break updates
+            _faults.demote(
+                self,
+                "journal",
+                exc,
+                default_domain="journal",
+                tier="host",
+                site="journal-write",
+                # save_nodes already counted the failure at the write site
+                count=False,
+                warn=(
+                    "Suite auto-journaling failed; journaling is DEGRADED (snapshots "
+                    "skipped) until the journal lane's recovery edge re-probes the disk. "
+                    "The on-disk generation ring is intact."
+                ),
+            )
 
     # ---------------------------------------------------- functional export
     def as_functions(self) -> tuple:

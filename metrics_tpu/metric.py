@@ -42,10 +42,11 @@ from metrics_tpu.ops import faults as _faults
 from metrics_tpu.parallel import bucketing as _bucketing
 from metrics_tpu.parallel.collectives import sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
+from metrics_tpu.parallel import sync as _psync
 from metrics_tpu.parallel.sync import distributed_available as _dist_available
 from metrics_tpu.parallel.sync import gather_all_tensors
 from metrics_tpu.utils.data import _flatten, apply_to_collection, dim_zero_cat
-from metrics_tpu.utils.exceptions import MetricsUserError
+from metrics_tpu.utils.exceptions import MetricsUserError, SyncConfigFault, SyncFault
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -94,6 +95,50 @@ class _DeferProbeDecline(Exception):
     configuration is supported, not an anomaly (the same silent-decline
     contract as the per-call fused paths); only post-probe runtime failures
     warn."""
+
+
+def _degradable_sync_failure(exc: BaseException) -> bool:
+    """Whether a failed sync may drop to the opt-in quorum-degraded tier
+    (``METRICS_TPU_SYNC_DEGRADED=local``): transient transport faults —
+    gather/collective failures and watchdog timeouts — qualify; structural
+    config errors (``SyncConfigFault``) never do, degrading would mask a bug
+    the operator must fix."""
+    return isinstance(exc, SyncFault) and not isinstance(exc, SyncConfigFault)
+
+
+def _note_degraded_serve(owner: Any) -> None:
+    """Count one local-only compute served while the owner's ``sync-degrade``
+    lane is down (per-owner tally + the global ``sync_degraded_serves``
+    counter in ``engine_stats()``)."""
+    object.__setattr__(owner, "_degraded_serves", owner.__dict__.get("_degraded_serves", 0) + 1)
+    _psync._bump("sync_degraded_serves")
+
+
+def _enter_degraded(owner: Any, exc: BaseException) -> None:
+    """Drop ``owner`` to the quorum-degraded compute tier: demote its
+    ``sync-degrade`` ladder lane (standard recovery edge — a healed transport
+    promotes back to full sync automatically), stamp the degradation onset
+    for ``sync_health()``, and warn once per owner+domain."""
+    _faults.demote(
+        owner,
+        "sync-degrade",
+        exc,
+        default_domain="sync",
+        tier="eager",
+        site="sync-degrade",
+        # the failure was already counted at its raise site (Metric.sync /
+        # MetricCollection.sync note it before re-raising) — the demotion
+        # must not double it in the counters or the failure log
+        count=False,
+        warn=(
+            f"Distributed sync failed for `{type(owner).__name__}` and "
+            "METRICS_TPU_SYNC_DEGRADED=local is set: compute() now serves the LOCAL-ONLY "
+            "value (staleness metadata in sync_health()) until the sync-degrade lane's "
+            "recovery edge re-probes the transport."
+        ),
+    )
+    object.__setattr__(owner, "_degraded_since_step", _faults.current_step())
+    _note_degraded_serve(owner)
 
 
 _checks_cached = None
@@ -1909,6 +1954,14 @@ class Metric(ABC):
                         pass
             _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
             raise
+        # a completed sync is the tree's "last good" health marker: stamp the
+        # monotonic fault/sync step index on every node (sync_health() reports
+        # it as last_good_sync_step) and clear any degradation onset
+        step = _faults.tick()
+        for n in _bucketing.tree_nodes(self):
+            object.__setattr__(n, "_last_good_sync_step", step)
+            if n.__dict__.get("_degraded_since_step") is not None:
+                object.__setattr__(n, "_degraded_since_step", None)
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local state (reference `metric.py:452-472`)."""
@@ -1964,6 +2017,65 @@ class Metric(ABC):
             distributed_available=distributed_available,
         )
 
+    # ------------------------------------------------------------- durability
+    def sync_health(self) -> Dict[str, Any]:
+        """Staleness metadata for this metric's distributed value.
+
+        The explicit tag on every quorum-degraded compute
+        (``METRICS_TPU_SYNC_DEGRADED=local``): whether the value currently
+        served is local-only, the monotonic step index of the last completed
+        sync (stamped by :meth:`sync`; ``None`` if this tree never synced),
+        when the degradation began, how many local-only values were served,
+        and the per-domain fault counts folded out of ``engine_stats()``'s
+        ``failure_log`` ring (each ring entry carries the same monotonic
+        ``step`` index, so the log orders against ``last_good_sync_step``).
+        """
+        lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
+        domain_counts: Dict[str, int] = {}
+        for entry in _faults.fault_stats()["failure_log"]:
+            domain_counts[entry["domain"]] = domain_counts.get(entry["domain"], 0) + 1
+        return {
+            "degraded": bool(lad is not None and lad.demoted),
+            "degraded_tier": _psync.sync_degraded_tier(),
+            "last_good_sync_step": self.__dict__.get("_last_good_sync_step"),
+            "degraded_since_step": self.__dict__.get("_degraded_since_step"),
+            "degraded_serves": self.__dict__.get("_degraded_serves", 0),
+            "fault_domain_counts": domain_counts,
+        }
+
+    def save_state(self, path: str) -> int:
+        """Snapshot this metric tree's reduce-path states into the
+        crash-consistent journal at ``path`` (CRC-checksummed single byte
+        record, atomic write, bounded generation ring — see
+        :mod:`metrics_tpu.ops.journal`). Returns the record size in bytes.
+        Flushes any pending deferred micro-batch first (an observation
+        point), reusing the coalesced-sync pack machinery so the record is
+        bit-exact vs the live state by construction."""
+        from metrics_tpu.ops import journal as _journal
+
+        return _journal.save_nodes(self, _bucketing.tree_nodes(self), path)
+
+    def load_state(self, path: str) -> int:
+        """Restore this metric tree from the newest good journal generation
+        at ``path``; returns the generation index restored (0 = newest). A
+        torn or checksum-failed generation records a classified ``journal``
+        fault and demotes to the previous good one; restore is all-or-nothing
+        (a bad record leaves live state untouched)."""
+        from metrics_tpu.ops import journal as _journal
+
+        return _journal.load_nodes(self, _bucketing.tree_nodes(self), path)
+
+    def _journal_extra(self) -> Optional[Dict[str, Any]]:
+        """Hook: JSON-serializable HOST-side state (beyond the packed
+        reduce-path states and public scalar hyperparameters) that a
+        crash-consistent restore needs to reproduce future behavior exactly —
+        e.g. ``BootStrapper``'s numpy RNG stream, whose post-restore draws
+        must match the uninterrupted run's. Default: nothing."""
+        return None
+
+    def _journal_restore_extra(self, extra: Dict[str, Any]) -> None:
+        """Apply what :meth:`_journal_extra` recorded. Default: no-op."""
+
     # ---------------------------------------------------------------- compute
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
@@ -1978,15 +2090,50 @@ class Metric(ABC):
                 return self._computed
 
             self._defer_barrier()
-            with self.sync_context(
-                dist_sync_fn=self.dist_sync_fn,
-                should_sync=self._to_sync,
-                should_unsync=self._should_unsync,
-            ):
-                with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
-                    value = compute(*args, **kwargs)
-                self._computed = self._decouple_from_state(_squeeze_scalar(value))
-            return self._computed
+            should_sync = self._to_sync
+            # quorum-degraded tier (METRICS_TPU_SYNC_DEGRADED=local, default
+            # off — one env read only when a sync is actually pending): while
+            # the sync-degrade lane is down, compute() serves the LOCAL-ONLY
+            # value (tagged via sync_health()); each serve is one clean step
+            # toward the recovery edge, whose firing re-probes the full sync
+            # on this very call — a healed transport promotes automatically
+            degraded_tier = _psync.sync_degraded_tier() if should_sync else None
+            if degraded_tier is not None:
+                lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
+                if lad is not None and lad.demoted:
+                    if lad.note_clean():
+                        lad.promote()
+                    else:
+                        should_sync = False
+                        _note_degraded_serve(self)
+
+            def _compute_under_sync(do_sync: bool) -> Any:
+                with self.sync_context(
+                    dist_sync_fn=self.dist_sync_fn,
+                    should_sync=do_sync,
+                    should_unsync=self._should_unsync,
+                ):
+                    with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+                        value = compute(*args, **kwargs)
+                    self._computed = self._decouple_from_state(_squeeze_scalar(value))
+                return self._computed
+
+            try:
+                return _compute_under_sync(should_sync)
+            except Exception as exc:  # noqa: BLE001 — only degradable sync faults caught
+                if not (
+                    degraded_tier is not None
+                    and should_sync
+                    and _degradable_sync_failure(exc)
+                    and not self._is_synced
+                ):
+                    raise
+                # the sync failed classified past its retries and restored
+                # local state (Metric.sync's snapshot/restore): drop to the
+                # degraded tier and serve the local-only value instead of
+                # raising
+                _enter_degraded(self, exc)
+                return _compute_under_sync(False)
 
         return wrapped
 

@@ -1,7 +1,7 @@
 """Low-level device kernels and the dispatch engine: Pallas MXU histogram,
-binned-curve counts, segment reductions, donated-state program cache, and
-the failure-domain engine (classified faults, degradation ladders,
-deterministic fault injection)."""
+binned-curve counts, segment reductions, donated-state program cache, the
+failure-domain engine (classified faults, degradation ladders,
+deterministic fault injection), and the crash-consistent state journal."""
 from metrics_tpu.ops._dispatch import pallas_enabled
 from metrics_tpu.ops.binned import binned_curve_counts
 from metrics_tpu.ops.engine import (
@@ -12,6 +12,7 @@ from metrics_tpu.ops.engine import (
     donation_supported,
     engine_stats,
     reset_engine,
+    reset_stats,
 )
 from metrics_tpu.ops.faults import (
     FAULT_SITES,
@@ -19,6 +20,7 @@ from metrics_tpu.ops.faults import (
     inject_faults,
     set_recovery_policy,
 )
+from metrics_tpu.ops.journal import journal_generations, journalable
 from metrics_tpu.ops.histogram import fused_bincount
 from metrics_tpu.ops.segments import (
     segment_count,
@@ -46,8 +48,11 @@ __all__ = [
     "donation_supported",
     "engine_stats",
     "reset_engine",
+    "reset_stats",
     "FAULT_SITES",
     "fault_stats",
     "inject_faults",
     "set_recovery_policy",
+    "journal_generations",
+    "journalable",
 ]

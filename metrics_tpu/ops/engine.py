@@ -87,6 +87,7 @@ __all__ = [
     "engine_stats",
     "pow2_chunks",
     "reset_engine",
+    "reset_stats",
     "set_deferred_dispatch",
     "state_donatable",
     "state_intact",
@@ -367,20 +368,35 @@ def engine_stats() -> Dict[str, Any]:
     return out
 
 
-def reset_engine() -> None:
-    """Drop every cached program and zero the counters (tests; and the escape
-    hatch after a backend restart invalidates compiled executables)."""
-    _PROGRAM_CACHE.clear()
+def reset_stats() -> None:
+    """Zero every counter :func:`engine_stats` reports — cache, deferral,
+    fault and sync-protocol telemetry plus the failure log — WITHOUT dropping
+    any cached program, manifest, or per-owner ladder state.
+
+    The companion tests (and operators diffing counter windows) need:
+    ``reset_engine`` throws away compiled executables to get clean counters,
+    which both recompiles everything and perturbs the behavior under test.
+    ``reset_stats`` isolates a counter delta in-place. The monotonic
+    failure-log ``step`` index is deliberately NOT reset (monotonicity is
+    what lets ``sync_health()`` order events across windows)."""
     _stats["builds"] = 0
     _stats["hits"] = 0
     _stats["deferred_steps"] = 0
     _stats["deferred_flushes"] = 0
     _stats["deferred_fallbacks"] = 0
     _faults.clear_fault_state()
-    from metrics_tpu.parallel import bucketing as _bucketing
     from metrics_tpu.parallel import sync as _psync
 
     _psync.reset_collective_stats()
+
+
+def reset_engine() -> None:
+    """Drop every cached program and zero the counters (tests; and the escape
+    hatch after a backend restart invalidates compiled executables)."""
+    _PROGRAM_CACHE.clear()
+    reset_stats()
+    from metrics_tpu.parallel import bucketing as _bucketing
+
     _bucketing._MANIFEST_CACHE.clear()
 
 

@@ -50,6 +50,7 @@ from metrics_tpu.utils.exceptions import (
     DonationFault,
     FaultError,
     HostOffloadFault,
+    JournalFault,
     RuntimeFault,
     SyncFault,
     TraceFault,
@@ -63,6 +64,7 @@ __all__ = [
     "armed",
     "classify",
     "clear_fault_state",
+    "current_step",
     "demote",
     "fault_stats",
     "inject_faults",
@@ -71,6 +73,7 @@ __all__ = [
     "note_fault",
     "recovery_steps",
     "set_recovery_policy",
+    "tick",
     "warn_fault",
 ]
 
@@ -86,6 +89,9 @@ TIERS = ("fused", "chunked", "eager", "host")
 #: fires at the entry of the coalesced bucketed-sync pack phase
 #: (``parallel/bucketing.py``) — before any collective, so an injected fault
 #: exercises the demote-to-per-state ladder with local state intact.
+#: ``journal-write`` fires before a journal record's temp file is written
+#: (previous generations stay intact by construction); ``journal-load`` fires
+#: before a stored record is read, modelling an unreadable newest generation.
 FAULT_SITES = (
     "probe",
     "compile",
@@ -94,6 +100,8 @@ FAULT_SITES = (
     "sync-gather",
     "sync-pack",
     "host-offload",
+    "journal-write",
+    "journal-load",
 )
 
 _SITE_DEFAULT_EXC = {
@@ -106,6 +114,8 @@ _SITE_DEFAULT_EXC = {
     # demote -> clean-syncs -> re-promote edge
     "sync-pack": RuntimeFault,
     "host-offload": HostOffloadFault,
+    "journal-write": JournalFault,
+    "journal-load": JournalFault,
 }
 
 _DOMAIN_EXC = {
@@ -115,6 +125,7 @@ _DOMAIN_EXC = {
     "donation": DonationFault,
     "host": HostOffloadFault,
     "sync": SyncFault,
+    "journal": JournalFault,
 }
 
 
@@ -149,6 +160,14 @@ def classify(exc: BaseException, default: str = "runtime") -> str:
             return "trace"
     except Exception:  # pragma: no cover - jax always importable in-tree
         pass
+    # structural stdlib mappings: a TimeoutError is deadline/hang shaped (the
+    # watchdog's SyncTimeoutFault is already classified above via FaultError);
+    # any other OSError/IOError is host-or-disk I/O — journal when the
+    # catching site is storage, otherwise the site's default I/O-ish domain.
+    if isinstance(exc, TimeoutError):
+        return "sync"
+    if isinstance(exc, OSError):
+        return default if default in ("journal", "host", "sync") else "journal"
     text = f"{type(exc).__name__}: {exc}".lower()
     if "donat" in text or "deleted" in text or "buffer has been deleted" in text:
         return "donation"
@@ -175,6 +194,25 @@ _counters: Dict[str, int] = {f"fault_{d}": 0 for d in FAULT_DOMAINS}
 _counters.update({"fault_demotions": 0, "fault_promotions": 0, "fault_injected": 0})
 _failure_log: "deque[Dict[str, Any]]" = deque(maxlen=_FAILURE_LOG_CAP)
 
+# Monotonic event index shared by the failure log and the sync-health
+# surface: every recorded fault AND every recorded good sync advances it, so
+# ``Metric.sync_health()`` can report "last-good sync step" relative to the
+# ring entries without a separate per-owner counter. Never reset (not even by
+# ``clear_fault_state``) — monotonicity is the whole point.
+_monotonic_step: int = 0
+
+
+def tick() -> int:
+    """Advance and return the monotonic fault/sync event index."""
+    global _monotonic_step
+    _monotonic_step += 1
+    return _monotonic_step
+
+
+def current_step() -> int:
+    """The current monotonic event index (last value :func:`tick` returned)."""
+    return _monotonic_step
+
 
 def note_fault(
     domain: str,
@@ -183,13 +221,15 @@ def note_fault(
     owner: Any = None,
     error: Optional[BaseException] = None,
 ) -> None:
-    """Count one fault in its domain and append it to the ring buffer."""
+    """Count one fault in its domain and append it to the ring buffer (each
+    entry stamped with the monotonic ``step`` index)."""
     key = f"fault_{domain}"
     if key not in _counters:
         key = "fault_runtime"
     _counters[key] += 1
     _failure_log.append(
         {
+            "step": tick(),
             "domain": domain,
             "site": site,
             "owner": type(owner).__name__ if owner is not None else None,
@@ -366,12 +406,20 @@ def demote(
     tier: str = "eager",
     site: Optional[str] = None,
     warn: Optional[str] = None,
+    count: bool = True,
 ) -> str:
     """One-call failure handling: classify ``exc``, count it, demote the
     owner's ``lane`` ladder, and (optionally) emit the owner+domain-deduped
-    warning. Returns the classified domain so callers can branch."""
+    warning. Returns the classified domain so callers can branch.
+
+    ``count=False`` skips the per-domain counter + ring entry — for callers
+    reacting to a failure that was ALREADY recorded at its raise site (the
+    degraded-compute and auto-journal handlers), so one incident never shows
+    up twice in ``engine_stats()``. The demotion itself still counts in
+    ``fault_demotions``."""
     domain = classify(exc, default_domain)
-    note_fault(domain, site=site, owner=owner, error=exc)
+    if count:
+        note_fault(domain, site=site, owner=owner, error=exc)
     ladder(owner, lane).demote(domain, to=tier)
     if warn:
         warn_fault(owner, domain, warn)

@@ -1,0 +1,401 @@
+"""Crash-consistent state journal: one flat byte record per snapshot.
+
+A process crash must not silently discard every accumulated metric state.
+This module serializes a ``Metric``/``MetricCollection``'s reduce-path states
+into ONE flat byte record by reusing the coalesced-sync pack/manifest
+machinery (:mod:`metrics_tpu.parallel.bucketing`): the same
+``tree_nodes`` → ``_collect`` → bitcast-to-uint8 pack walk that feeds the
+payload all-gather feeds the journal payload, so **restore is bit-exact vs
+the live state by construction** — the bytes on disk are exactly the bytes a
+sync would have exchanged.
+
+Record format (little-endian)::
+
+    MAGIC(4) | version(u32) | manifest_len(u32) | payload_len(u64)
+    | crc32(manifest)(u32) | crc32(payload)(u32) | manifest(JSON) | payload
+
+Durability contract (the compiler-first caching pattern of
+arXiv:2603.09555, generalized: any durable artifact must verify on load and
+demote to a known-good tier, never crash or silently corrupt):
+
+- **Atomic writes**: the record is written to ``<path>.tmp``, fsynced, and
+  ``os.replace``d into place — a crash mid-write leaves the previous
+  generation untouched, never a torn newest record.
+- **Bounded generation ring**: each save rotates ``<path>`` → ``<path>.g1``
+  → ``<path>.g2`` … up to ``METRICS_TPU_JOURNAL_GENERATIONS`` (default 3;
+  the oldest generation falls off the end).
+- **Verified loads**: magic/version/length/CRC32 all check before a single
+  state is touched, and every ``setattr`` happens only after the whole
+  record parses — a bad record never half-restores. A torn or
+  checksum-failed generation classifies as a ``journal``-domain fault
+  (``engine_stats()`` counters + failure log) and **demotes to the previous
+  good generation**; only when every generation is bad does the classified
+  :class:`~metrics_tpu.utils.exceptions.JournalFault` surface.
+
+Fault sites: ``journal-write`` (before the temp file is written — an
+injected fault models a full disk with previous generations intact) and
+``journal-load`` (before a record is read — models an unreadable newest
+generation). The suite-level auto-journal hook
+(``MetricCollection.journal(path, every_n)``) routes write failures through
+the owner's ``journal`` ladder lane instead of raising, so a broken disk
+degrades journaling (warn once, re-probe after the recovery edge) without
+taking down the update loop.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel import bucketing as _bucketing
+from metrics_tpu.utils.exceptions import JournalFault
+
+__all__ = [
+    "journal_generations",
+    "journalable",
+    "load_nodes",
+    "pack_record",
+    "read_record",
+    "save_nodes",
+    "write_record",
+]
+
+_MAGIC = b"MTJL"
+_VERSION = 1
+_HEADER = struct.Struct("<4sIIQII")  # magic, version, manifest_len, payload_len, crc_m, crc_p
+
+
+def journal_generations() -> int:
+    """Size of the on-disk generation ring (``METRICS_TPU_JOURNAL_GENERATIONS``,
+    default 3, floor 1)."""
+    try:
+        return max(1, int(os.environ.get("METRICS_TPU_JOURNAL_GENERATIONS", "3")))
+    except ValueError:
+        return 3
+
+
+def _gen_path(path: str, gen: int) -> str:
+    return path if gen == 0 else f"{path}.g{gen}"
+
+
+def journalable(nodes: Sequence[Any]) -> Optional[str]:
+    """None when every node's every state can ride the byte record, else the
+    reason it cannot (non-``cat`` list states lose their row structure through
+    the concatenating pack; non-array leaves and sub-byte dtypes cannot
+    bitcast). Unlike ``bucketing.coalescible`` this does NOT gate on sync
+    semantics (``_sync_dist`` overrides journal fine — the journal never
+    gathers)."""
+    import jax
+
+    for node in nodes:
+        for name in node._reductions:
+            spec = node._reduction_specs[name]
+            value = getattr(node, name)
+            rows = value if isinstance(value, list) else [value]
+            if isinstance(value, list) and spec != "cat" and value:
+                return (
+                    f"state {type(node).__name__}.{name} is a non-'cat' list state; its row "
+                    "structure would not survive the concatenating byte pack"
+                )
+            for row in rows:
+                if not isinstance(row, (jax.Array, np.ndarray)) or isinstance(row, jax.core.Tracer):
+                    return f"state {type(node).__name__}.{name} holds a non-array leaf"
+                if not _bucketing._packable_dtype(row.dtype):
+                    return (
+                        f"state {type(node).__name__}.{name} has dtype {row.dtype} which the "
+                        "bitcast packing cannot carry"
+                    )
+    return None
+
+
+_SCALAR_TYPES = (bool, int, float, str, type(None))
+
+
+def _static_attrs(node: Any) -> Dict[str, Any]:
+    """One node's public scalar attributes — the update-inferred static
+    hyperparameter surface ``_propagate_static_attrs`` manages for the fused
+    paths, restricted to exactly JSON-round-trippable scalars (tuples and
+    other containers are skipped: JSON would hand them back as lists and
+    silently change their type)."""
+    state_names = set(node._reduction_specs)
+    out: Dict[str, Any] = {}
+    for key, value in node.__dict__.items():
+        if key.startswith("_") or key in state_names:
+            continue
+        if isinstance(value, _SCALAR_TYPES):
+            out[key] = value
+    return out
+
+
+# ------------------------------------------------------------------- encoding
+def pack_record(nodes: Sequence[Any]) -> bytes:
+    """Serialize every reduce-path state of ``nodes`` into one byte record.
+
+    The caller must have flushed/canonicalized every node (``save_nodes``
+    does). Reuses the coalesced-sync pack: ``bucketing._collect`` builds the
+    layout manifest, ``bucketing._pack`` bitcasts and concatenates every
+    state into one flat uint8 buffer (bit-exact for every fixed-width dtype;
+    the engine-cached pack program is shared with the sync path)."""
+    reason = journalable(nodes)
+    if reason is not None:
+        raise JournalFault(f"cannot journal this state tree: {reason}", site="journal-write")
+    entries, values = _bucketing._collect(nodes)
+    packed, _ = _bucketing._pack(entries, values)
+    payload = np.asarray(packed).tobytes()
+
+    manifest_entries: List[Dict[str, Any]] = []
+    vi = 0
+    for e in entries:
+        row: Dict[str, Any] = {"node": e.node_idx, "name": e.name, "kind": e.kind, "spec": e.spec}
+        if e.kind != "empty":
+            value = values[vi]
+            vi += 1
+            row["dtype"] = jnp.dtype(value.dtype).name
+            row["shape"] = [int(d) for d in value.shape]
+        manifest_entries.append(row)
+    manifest = {
+        "version": _VERSION,
+        "nodes": [type(n).__name__ for n in nodes],
+        "update_counts": [int(n._update_count) for n in nodes],
+        "entries": manifest_entries,
+        # update-inferred static hyperparameters (Accuracy's `mode`, the
+        # curve family's inferred `num_classes`/`pos_label`, …) live as plain
+        # public scalars on the instance, not registered states — compute()
+        # after a crash-restore needs them back (str-enums round-trip through
+        # JSON as their string values; equality still holds)
+        "static_attrs": [_static_attrs(n) for n in nodes],
+        # host-side extra state a subclass declares crash-critical (e.g.
+        # BootStrapper's numpy RNG stream — see Metric._journal_extra)
+        "extras": [n._journal_extra() for n in nodes],
+    }
+    mbytes = json.dumps(manifest, separators=(",", ":")).encode("utf-8")
+    header = _HEADER.pack(
+        _MAGIC, _VERSION, len(mbytes), len(payload), zlib.crc32(mbytes), zlib.crc32(payload)
+    )
+    return header + mbytes + payload
+
+
+def decode_record(data: bytes, origin: str = "<bytes>") -> Tuple[Dict[str, Any], bytes]:
+    """Verify and split one record into ``(manifest, payload)``; raises the
+    classified :class:`JournalFault` on ANY corruption — truncation, foreign
+    magic, version skew, or a CRC mismatch on either part."""
+
+    def _bad(why: str) -> JournalFault:
+        return JournalFault(f"journal record {origin} is corrupt: {why}", site="journal-load")
+
+    if len(data) < _HEADER.size:
+        raise _bad(f"truncated header ({len(data)} bytes)")
+    magic, version, mlen, plen, crc_m, crc_p = _HEADER.unpack_from(data)
+    if magic != _MAGIC:
+        raise _bad(f"bad magic {magic!r}")
+    if version != _VERSION:
+        raise _bad(f"unsupported version {version}")
+    if len(data) != _HEADER.size + mlen + plen:
+        raise _bad(f"torn record ({len(data)} bytes, header promises {_HEADER.size + mlen + plen})")
+    mbytes = data[_HEADER.size : _HEADER.size + mlen]
+    payload = data[_HEADER.size + mlen :]
+    if zlib.crc32(mbytes) != crc_m:
+        raise _bad("manifest checksum mismatch")
+    if zlib.crc32(payload) != crc_p:
+        raise _bad("payload checksum mismatch")
+    try:
+        manifest = json.loads(mbytes.decode("utf-8"))
+    except ValueError as err:  # pragma: no cover - crc makes this near-impossible
+        raise _bad(f"manifest does not parse: {err}") from err
+    return manifest, payload
+
+
+def restore_nodes(nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes) -> None:
+    """Apply a decoded record to ``nodes`` — all-or-nothing.
+
+    Every segment is sliced, bitcast back through the same
+    ``bucketing._from_bytes`` the sync unpack uses, and staged; ``setattr``
+    runs only after the WHOLE record parses, so a layout-incompatible record
+    (classified :class:`JournalFault`) leaves every node untouched."""
+
+    def _bad(why: str) -> JournalFault:
+        return JournalFault(f"journal record does not match this state tree: {why}", site="journal-load")
+
+    # the whole tree must match, node for node — a record from a smaller or
+    # differently-composed suite would otherwise "restore" while leaving the
+    # extra live nodes silently untouched (a partial restore IS corruption)
+    live_types = [type(n).__name__ for n in nodes]
+    rec_types = manifest.get("nodes")
+    if rec_types is not None and list(rec_types) != live_types:
+        raise _bad(
+            f"record holds {len(rec_types)} node(s) {rec_types}, live tree is "
+            f"{len(live_types)} node(s) {live_types} (construction mismatch)"
+        )
+
+    buf = jnp.asarray(np.frombuffer(payload, np.uint8))
+    staged: List[Tuple[Any, str, Any]] = []
+    off = 0
+    for e in manifest["entries"]:
+        idx, name, kind = e["node"], e["name"], e["kind"]
+        if not (0 <= idx < len(nodes)):
+            raise _bad(f"entry {name!r} addresses node {idx} of {len(nodes)}")
+        node = nodes[idx]
+        if name not in node._defaults:
+            raise _bad(f"{type(node).__name__} has no state {name!r}")
+        if kind == "empty":
+            staged.append((node, name, []))
+            continue
+        shape, dtype = tuple(e["shape"]), e["dtype"]
+        n = _bucketing._byte_len(shape, dtype)
+        if off + n > len(payload):
+            raise _bad(f"entry {name!r} overruns the payload")
+        value = _bucketing._from_bytes(buf[off : off + n], shape, dtype)
+        off += n
+        if kind == "dyn":
+            # cat list state: restored as the single pre-concatenated row the
+            # pack wrote — dim_zero_cat of [concat] == concat, so compute()
+            # is bit-exact vs the multi-row live buffer
+            staged.append((node, name, [value]))
+        else:
+            current = getattr(node, name)
+            if not isinstance(current, list) and jnp.dtype(jnp.asarray(current).dtype).name != dtype:
+                raise _bad(
+                    f"{type(node).__name__}.{name} is {jnp.asarray(current).dtype} live but "
+                    f"{dtype} in the record (construction mismatch)"
+                )
+            staged.append((node, name, value))
+    if off != len(payload):
+        raise _bad(f"record carries {len(payload) - off} unclaimed payload bytes")
+
+    counts = manifest.get("update_counts", [])
+    statics = manifest.get("static_attrs", [])
+    extras = manifest.get("extras", [])
+    for node, name, value in staged:
+        setattr(node, name, value)
+    for i, node in enumerate(nodes):
+        if i < len(statics) and statics[i]:
+            for key, value in statics[i].items():
+                setattr(node, key, value)
+        if i < len(extras) and extras[i]:
+            node._journal_restore_extra(extras[i])
+        if i < len(counts):
+            node._update_count = int(counts[i])
+        node._computed = None
+        node._is_synced = False
+        node._cache = None
+
+
+# ------------------------------------------------------------------- disk I/O
+def write_record(path: str, data: bytes, generations: Optional[int] = None) -> None:
+    """Atomically persist one record and rotate the generation ring.
+
+    Write-to-temp + fsync + ``os.replace`` — a crash at any point leaves a
+    consistent ring (the previous newest generation survives until the final
+    rename). The ``journal-write`` fault site fires before any byte is
+    written, so an injected fault models a failed write with the ring
+    intact."""
+    from metrics_tpu.ops import faults as _faults
+
+    if _faults.armed:
+        _faults.maybe_fail("journal-write")
+    cap = generations if generations is not None else journal_generations()
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    for gen in range(cap - 1, 0, -1):
+        src = _gen_path(path, gen - 1)
+        if os.path.exists(src):
+            os.replace(src, _gen_path(path, gen))
+    os.replace(tmp, path)
+
+
+def read_record(path: str) -> Tuple[Dict[str, Any], bytes]:
+    """Read and verify ONE generation file (no ring demotion — that is
+    :func:`load_nodes`). I/O errors and corruption both raise the classified
+    :class:`JournalFault`."""
+    from metrics_tpu.ops import faults as _faults
+
+    if _faults.armed:
+        _faults.maybe_fail("journal-load")
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError as err:
+        raise JournalFault(
+            f"journal record {path!r} is unreadable: {type(err).__name__}: {err}",
+            site="journal-load",
+        ) from err
+    return decode_record(data, origin=repr(path))
+
+
+# ---------------------------------------------------------------- owner-level
+def save_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
+    """Snapshot ``nodes`` to ``path`` (rotating the ring); returns the record
+    size in bytes. Any failure raises classified with the ring intact."""
+    from metrics_tpu.ops import faults as _faults
+
+    try:
+        for n in nodes:
+            n._defer_barrier()
+            n._canonicalize_list_states()
+        data = pack_record(nodes)
+        write_record(path, data)
+    except Exception as exc:  # noqa: BLE001 — classified + rethrown
+        domain = _faults.classify(exc, "journal")
+        _faults.note_fault(domain, site="journal-write", owner=owner, error=exc)
+        if isinstance(exc, JournalFault):
+            raise
+        raise JournalFault(
+            f"journal save to {path!r} failed: {type(exc).__name__}: {exc}",
+            site="journal-write",
+        ) from exc
+    return len(data)
+
+
+def load_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
+    """Restore ``nodes`` from the newest good generation at ``path``.
+
+    Walks the ring newest-first: a torn/checksum-failed/unreadable generation
+    records a classified ``journal`` fault (+ one owner-deduped warning) and
+    **demotes to the previous generation**. Returns the generation index that
+    restored (0 = newest). Raises :class:`JournalFault` only when no
+    generation verifies."""
+    from metrics_tpu.ops import faults as _faults
+
+    last: Optional[BaseException] = None
+    # scan a few generations past the configured cap: the ring size may have
+    # been lowered between runs, and stale-but-good older files are still a
+    # better tier than a crash
+    for gen in range(journal_generations() + 8):
+        gpath = _gen_path(path, gen)
+        if not os.path.exists(gpath):
+            continue
+        try:
+            manifest, payload = read_record(gpath)
+            restore_nodes(nodes, manifest, payload)
+        except Exception as exc:  # noqa: BLE001 — demote to the previous generation
+            last = exc
+            _faults.note_fault(
+                _faults.classify(exc, "journal"), site="journal-load", owner=owner, error=exc
+            )
+            _faults.warn_fault(
+                owner,
+                "journal",
+                f"Journal generation {gpath!r} failed verification "
+                f"({type(exc).__name__}: {exc}); demoting to the previous good generation.",
+            )
+            continue
+        return gen
+    if last is not None:
+        if isinstance(last, JournalFault):
+            raise last
+        raise JournalFault(
+            f"every journal generation at {path!r} failed verification; last error: "
+            f"{type(last).__name__}: {last}",
+            site="journal-load",
+        ) from last
+    raise JournalFault(f"no journal record found at {path!r}", site="journal-load")

@@ -408,13 +408,20 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
     # SyncFault — the mismatch rides out as a sentinel and classifies as a
     # CoalesceError below, where the caller's demote-to-per-state fallback
     # can actually catch it.
+    # Every blocking transport call below runs under the watchdog deadline
+    # (METRICS_TPU_SYNC_DEADLINE_MS, default off — a direct call): a hung
+    # peer raises a classified SyncTimeoutFault instead of blocking forever,
+    # inside the retried closure so it rides the same retry/snapshot-restore
+    # lane as any other transport fault.
     def _attempt():
         if _faults.armed:
             _faults.maybe_fail("sync-gather")
         local_total = int(packed.shape[0])
         if has_dyn:
             # uneven-shape lane: ONE metadata exchange for every dyn state
-            all_vecs = _host_allgather(meta_vec)
+            all_vecs = _sync.run_with_deadline(
+                lambda: _host_allgather(meta_vec), site="sync-gather"
+            )
             _sync.note_collective("shape")
             _sync._bump("sync_fastlane_misses")
             rank_meta = [_parse_rank_meta(entries, all_vecs[r]) for r in range(all_vecs.shape[0])]
@@ -430,7 +437,10 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             # cannot restart and rejoin mid-job), so every rank caches a
             # layout at the same completed sync.
             if key not in _MANIFEST_CACHE and _sync.distributed_available():
-                totals = _host_allgather(np.asarray([local_total], np.int64))
+                totals = _sync.run_with_deadline(
+                    lambda: _host_allgather(np.asarray([local_total], np.int64)),
+                    site="sync-gather",
+                )
                 _sync.note_collective("shape")
                 if int(totals.max()) != int(totals.min()):
                     return _LAYOUT_MISMATCH, sorted(set(int(t) for t in totals[:, 0]))
@@ -445,7 +455,9 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             if local_total == max_total
             else jnp.pad(packed, (0, max_total - local_total))
         )
-        gathered = _payload_allgather(padded)
+        gathered = _sync.run_with_deadline(
+            lambda: _payload_allgather(padded), site="sync-gather"
+        )
         _sync.note_collective("payload", nbytes=int(np.prod(gathered.shape)))
         return gathered, rank_meta
 

@@ -14,13 +14,14 @@ zero-overhead early-out, mirroring ``distributed_available()``
 from __future__ import annotations
 
 import os
-from typing import Any, List, Optional
+import threading
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.utils.exceptions import SyncConfigFault
+from metrics_tpu.utils.exceptions import SyncConfigFault, SyncTimeoutFault
 
 
 def distributed_available() -> bool:
@@ -145,6 +146,158 @@ def sync_backoff_s() -> float:
         return 0.05
 
 
+# ------------------------------------------------------------- sync deadlines
+_DEADLINE_WARN_OWNER = _EnvWarnOwner()
+
+
+def sync_deadline_s() -> Optional[float]:
+    """Watchdog deadline for one blocking collective
+    (``METRICS_TPU_SYNC_DEADLINE_MS``; default **off** — unset preserves the
+    pre-deadline semantics exactly: a hung peer blocks forever, and the hot
+    path pays zero watchdog cost). An unparseable or non-positive value warns
+    once and stays off. Read per call — collectives run at sync time, never
+    on the per-step hot path."""
+    raw = os.environ.get("METRICS_TPU_SYNC_DEADLINE_MS")
+    if raw is None or not raw.strip():
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        from metrics_tpu.ops import faults as _faults
+
+        _faults.warn_fault(
+            _DEADLINE_WARN_OWNER,
+            "sync",
+            f"METRICS_TPU_SYNC_DEADLINE_MS={raw!r} is not a number; the sync watchdog"
+            " stays OFF (collectives block without a deadline).",
+        )
+        return None
+    return ms / 1000.0 if ms > 0 else None
+
+
+# One long-lived watchdog worker (lazily created): syncs are serialized, so a
+# single DAEMON thread with a handoff queue amortizes thread startup to one
+# queue put/get per collective (an executor would do the same, but its
+# threads are non-daemon since py3.9 — a hung collective would then block
+# interpreter exit, the exact failure the watchdog exists to escape). A
+# timed-out worker is stuck inside the hung collective — it is abandoned
+# (poisoned so it exits if the call ever returns) and replaced on next use.
+class _Watchdog:
+    def __init__(self) -> None:
+        import queue
+
+        self.queue: "queue.Queue" = queue.Queue()
+        self.thread = threading.Thread(
+            target=self._run, name="metrics-tpu-sync-watchdog", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                box["value"] = fn()
+            except BaseException as exc:  # noqa: BLE001 — re-raised on the caller thread
+                box["error"] = exc
+            done.set()
+
+    def submit(self, fn: Callable[[], Any]):
+        box: dict = {}
+        done = threading.Event()
+        self.queue.put((fn, box, done))
+        return box, done
+
+
+_watchdog: Optional[_Watchdog] = None
+_watchdog_lock = threading.Lock()
+
+
+def _watchdog_submit(fn: Callable[[], Any]):
+    global _watchdog
+    with _watchdog_lock:
+        if _watchdog is None or not _watchdog.thread.is_alive():
+            _watchdog = _Watchdog()
+        return _watchdog.submit(fn)
+
+
+def _watchdog_abandon() -> None:
+    global _watchdog
+    with _watchdog_lock:
+        stuck, _watchdog = _watchdog, None
+    if stuck is not None:
+        stuck.queue.put(None)  # poison: exit when (if ever) the hung call returns
+
+
+def run_with_deadline(fn: Callable[[], Any], *, site: str = "sync-gather", owner: Any = None) -> Any:
+    """Run one blocking collective under the watchdog deadline.
+
+    With no deadline configured this is a direct call — zero threads, zero
+    overhead: the unset default preserves pre-deadline behavior and cost
+    exactly. With a deadline, ``fn`` runs on the long-lived watchdog worker
+    (one queue handoff per collective — the ``sync_deadline_overhead`` bench
+    row pins armed≈disarmed on the healthy path); if it has not returned
+    within the deadline a classified :class:`SyncTimeoutFault` raises
+    *instead of hanging forever*. The abandoned call keeps blocking on its
+    (daemon) worker, which is retired — a stuck collective cannot be
+    cancelled from the host side; standard watchdog semantics — and the
+    caller's snapshot/restore keeps local state intact and retryable.
+
+    Raised inside the retry closure, a timeout rides the existing
+    ``sync-gather`` retry/snapshot-restore lane: retries follow the
+    distributed-aware budget (0 in a live world — a unilateral re-issued
+    collective cannot pair), and the surfaced fault is what the opt-in
+    degraded-compute tier (``METRICS_TPU_SYNC_DEGRADED=local``) catches.
+    """
+    deadline = sync_deadline_s()
+    if deadline is None:
+        return fn()
+    box, done = _watchdog_submit(fn)
+    if not done.wait(deadline):
+        _watchdog_abandon()
+        _bump("sync_deadline_timeouts")
+        raise SyncTimeoutFault(
+            f"blocking collective at site {site!r} exceeded the "
+            f"{deadline * 1000.0:.0f} ms watchdog deadline (METRICS_TPU_SYNC_DEADLINE_MS) — "
+            "a peer rank is hung or dead; local state is intact and the sync is retryable",
+            site=site,
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# ------------------------------------------------------- degraded-compute tier
+def sync_degraded_tier() -> Optional[str]:
+    """The opt-in quorum-degraded compute tier (``METRICS_TPU_SYNC_DEGRADED``).
+
+    ``"local"`` — after a classified sync failure exhausts its retries,
+    ``compute()`` serves the **local-only** value tagged with staleness
+    metadata (``Metric.sync_health()``) instead of raising, and the owner's
+    ``sync-degrade`` ladder lane re-probes the full sync after the standard
+    recovery edge. Unset/empty (the default) preserves raise-on-failure
+    exactly. Any other value warns once and stays off."""
+    raw = os.environ.get("METRICS_TPU_SYNC_DEGRADED")
+    if not raw:
+        return None
+    value = raw.strip().lower()
+    if value in ("0", "false", "off"):
+        return None
+    if value == "local":
+        return "local"
+    from metrics_tpu.ops import faults as _faults
+
+    _faults.warn_fault(
+        _DEADLINE_WARN_OWNER,
+        "sync",
+        f"METRICS_TPU_SYNC_DEGRADED={raw!r} is not a known tier (only 'local');"
+        " degraded compute stays OFF (sync failures raise classified).",
+    )
+    return None
+
+
 # ----------------------------------------------------------- collective audit
 # Protocol-slot counters: every point where the sync protocol WOULD issue a
 # collective in a live multi-process world counts, including in
@@ -160,6 +313,8 @@ _counters: dict = {
     "sync_fastlane_hits": 0,
     "sync_fastlane_misses": 0,
     "sync_pack_fallbacks": 0,
+    "sync_deadline_timeouts": 0,
+    "sync_degraded_serves": 0,
 }
 
 
@@ -257,7 +412,11 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
         # SyncFault exercises the retry ladder and the callers' restore paths
         if _faults.armed:
             _faults.maybe_fail("sync-gather")
-        return _gather_once(result, members)
+        # watchdog deadline (METRICS_TPU_SYNC_DEADLINE_MS, default off): a
+        # hung peer raises a classified SyncTimeoutFault instead of blocking
+        # forever — inside the retry closure, so the timeout rides the same
+        # retry/snapshot-restore lane as any other transport fault
+        return run_with_deadline(lambda: _gather_once(result, members), site="sync-gather")
 
     return _faults.retry_with_backoff(
         _attempt, attempts=sync_retries(), base_delay_s=sync_backoff_s(), site="sync-gather"
@@ -305,6 +464,9 @@ __all__ = [
     "validate_group_live",
     "sync_retries",
     "sync_backoff_s",
+    "sync_deadline_s",
+    "sync_degraded_tier",
+    "run_with_deadline",
     "note_collective",
     "collective_stats",
     "reset_collective_stats",

@@ -21,7 +21,7 @@ class MetricsUserError(Exception):
 # --------------------------------------------------------------- fault domains
 #: Canonical failure-domain names, in ladder-relevant order. Every
 #: :class:`FaultError` subclass carries one of these as ``domain``.
-FAULT_DOMAINS = ("trace", "compile", "runtime", "donation", "host", "sync")
+FAULT_DOMAINS = ("trace", "compile", "runtime", "donation", "host", "sync", "journal")
 
 
 class FaultError(Exception):
@@ -98,15 +98,34 @@ class SyncConfigFault(SyncFault, ValueError):
     recoverable = False
 
 
+class SyncTimeoutFault(SyncFault):
+    """A blocking collective exceeded its watchdog deadline
+    (``METRICS_TPU_SYNC_DEADLINE_MS``): a peer rank hung or died
+    mid-collective. Raised by the watchdog *instead of hanging forever*;
+    transient by nature (the peer may restart, the transport may heal), so
+    the degraded-compute ladder may recover."""
+
+
+class JournalFault(FaultError):
+    """State-journal failure: a record could not be written, or a stored
+    record is torn / checksum-failed / layout-incompatible on load. Load
+    corruption demotes to the previous good generation; only when every
+    generation is bad does the classified fault surface to the caller."""
+
+    domain = "journal"
+
+
 __all__ = [
     "FAULT_DOMAINS",
     "CompileFault",
     "DonationFault",
     "FaultError",
     "HostOffloadFault",
+    "JournalFault",
     "MetricsUserError",
     "RuntimeFault",
     "SyncConfigFault",
     "SyncFault",
+    "SyncTimeoutFault",
     "TraceFault",
 ]

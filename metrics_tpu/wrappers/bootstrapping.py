@@ -165,6 +165,23 @@ class BootStrapper(Metric):
             (size, self.sampling_strategy, nxt, jnp.asarray(nxt), rng_state),
         )
 
+    def _journal_extra(self):
+        """Crash-consistent journal hook: the numpy RNG stream, so post-restore
+        resampling draws match the uninterrupted run's exactly. A pending
+        prefetch has already consumed NEXT step's draw — record its pre-draw
+        snapshot instead (the same rewind `_take_prefetch` performs), since the
+        restored instance holds no prefetch and will re-draw that step."""
+        pf = self._boot_prefetch
+        name, keys, pos, has_gauss, cached = pf[4] if pf is not None else self._rng.get_state()
+        return {"rng": [str(name), np.asarray(keys).tolist(), int(pos), int(has_gauss), float(cached)]}
+
+    def _journal_restore_extra(self, extra) -> None:
+        rng = extra.get("rng")
+        if rng:
+            self._rng.set_state(
+                (rng[0], np.asarray(rng[1], dtype=np.uint32), int(rng[2]), int(rng[3]), float(rng[4]))
+            )
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch per bootstrap clone and update each.
 

@@ -1,0 +1,297 @@
+"""Sync deadlines and the quorum-degraded compute tier.
+
+The watchdog (``METRICS_TPU_SYNC_DEADLINE_MS``) must convert a hung
+collective into a classified ``SyncTimeoutFault`` with local state bit-exact
+and retryable — and, with ``METRICS_TPU_SYNC_DEGRADED=local``, ``compute()``
+must serve the local-only value tagged via ``sync_health()`` and promote back
+to the full coalesced sync after the ``sync-degrade`` recovery edge. The
+multi-process world is the same transport-hook fake world the coalesced-sync
+suite certifies against (``_install_world``), so degraded (local) and healed
+(merged) values are distinguishable.
+"""
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+import metrics_tpu.metric as metric_mod
+from metrics_tpu.ops import engine, faults
+from metrics_tpu.parallel import bucketing
+from metrics_tpu.parallel import sync as psync
+from metrics_tpu.utils.exceptions import SyncTimeoutFault
+from tests.parallel.test_coalesced_sync import DIST_ON, _install_world
+
+DEADLINE_MS = "150"
+
+
+@pytest.fixture(autouse=True)
+def _fast_sync(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+    monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "0")
+    yield
+
+
+def _hang_payload(monkeypatch, seconds: float = 1.0):
+    # after the sleep the abandoned call raises pure-python instead of
+    # re-entering XLA: its result is discarded anyway, and a daemon thread
+    # inside a jax dispatch at interpreter exit can abort process teardown
+    def hung(x):
+        time.sleep(seconds)
+        raise RuntimeError("abandoned hung collective (watchdog timed out long ago)")
+
+    monkeypatch.setattr(bucketing, "_payload_allgather", hung)
+
+
+class TestDeadline:
+    def test_default_off_is_direct_call(self, monkeypatch):
+        monkeypatch.delenv("METRICS_TPU_SYNC_DEADLINE_MS", raising=False)
+        assert psync.sync_deadline_s() is None
+        # direct call: the caller's exception propagates untouched and the
+        # timeout counter never moves
+        s0 = engine.engine_stats()["sync_deadline_timeouts"]
+        with pytest.raises(KeyError):
+            psync.run_with_deadline(lambda: {}["missing"])
+        assert psync.run_with_deadline(lambda: 41 + 1) == 42
+        assert engine.engine_stats()["sync_deadline_timeouts"] == s0
+
+    def test_env_garbage_warns_once_and_stays_off(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", "soon")
+        monkeypatch.setattr(psync, "_DEADLINE_WARN_OWNER", psync._EnvWarnOwner())
+        with pytest.warns(UserWarning, match="METRICS_TPU_SYNC_DEADLINE_MS"):
+            assert psync.sync_deadline_s() is None
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            assert psync.sync_deadline_s() is None
+
+    def test_timeout_raises_classified_state_intact_retryable(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        ranks = []
+        for r in range(2):
+            m = mt.MeanMetric()
+            m.update(jnp.asarray([1.0 + r, 3.0 + r]))
+            ranks.append(m)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        _hang_payload(monkeypatch)
+        before = {k: np.asarray(v) for k, v in ranks[0].metric_state.items()}
+        s0 = engine.engine_stats()["sync_deadline_timeouts"]
+        with pytest.raises(SyncTimeoutFault):
+            ranks[0].sync(distributed_available=DIST_ON)
+        assert engine.engine_stats()["sync_deadline_timeouts"] == s0 + 1
+        # local state bit-exact and retryable
+        after = {k: np.asarray(v) for k, v in ranks[0].metric_state.items()}
+        for k in before:
+            np.testing.assert_array_equal(after[k], before[k])
+        assert not ranks[0]._is_synced
+        # transport heals: the SAME metric syncs (still coalesced — a
+        # transport fault never demotes the sync-pack lane) and lands on the
+        # fake-world merged value
+        monkeypatch.undo()  # drop the hang; reinstall the healthy world
+        monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        s1 = engine.engine_stats()
+        ranks[0].sync(distributed_available=DIST_ON)
+        s2 = engine.engine_stats()
+        assert s2["sync_coalesced_payloads"] - s1["sync_coalesced_payloads"] == 1
+        np.testing.assert_allclose(float(ranks[0].compute()), 2.5)  # mean of 1,3,2,4
+        ranks[0].unsync()
+
+    def test_timeout_on_per_state_gather_path(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        monkeypatch.setenv("METRICS_TPU_SYNC_COALESCE", "0")
+
+        def hung_gather(result, members):
+            time.sleep(1.0)
+            raise RuntimeError("abandoned hung gather (watchdog timed out long ago)")
+
+        monkeypatch.setattr(psync, "_gather_once", hung_gather)
+        m = mt.SumMetric()
+        m.update(jnp.asarray([5.0]))
+        with pytest.raises(SyncTimeoutFault):
+            m.sync(distributed_available=DIST_ON)
+        assert not m._is_synced
+        np.testing.assert_array_equal(np.asarray(m.value), np.asarray(5.0))
+
+    def test_healthy_path_identical_armed_vs_disarmed(self, monkeypatch):
+        """Armed deadline on a healthy transport: same values, same
+        collective counts, zero timeouts — the acceptance 'armed≈disarmed'
+        contract, behavior side."""
+        vals = {}
+        for armed in (False, True):
+            if armed:
+                monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", "60000")
+            else:
+                monkeypatch.delenv("METRICS_TPU_SYNC_DEADLINE_MS", raising=False)
+            m = mt.MeanMetric()
+            m.update(jnp.asarray([2.0, 4.0]))
+            s0 = engine.engine_stats()
+            m.sync(distributed_available=DIST_ON)
+            s1 = engine.engine_stats()
+            assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+            assert s1["sync_deadline_timeouts"] == s0["sync_deadline_timeouts"]
+            m.unsync()
+            vals[armed] = float(m.compute())
+        assert vals[True] == vals[False]
+
+
+class TestDegradedCompute:
+    def _two_rank_world(self, monkeypatch):
+        ranks = []
+        for r in range(2):
+            m = mt.MeanMetric()
+            m.update(jnp.asarray([1.0 + 2 * r, 3.0 + 2 * r]))  # rank0: 1,3  rank1: 3,5
+            ranks.append(m)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        return ranks
+
+    def test_metric_serves_local_then_promotes_to_full_sync(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "local")
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        faults.set_recovery_policy(steps=2)
+        try:
+            ranks = self._two_rank_world(monkeypatch)
+            m = ranks[0]
+            _hang_payload(monkeypatch)
+            s0 = engine.engine_stats()["sync_degraded_serves"]
+            with pytest.warns(UserWarning, match="LOCAL-ONLY"):
+                v = m.compute()
+            # local-only value (rank0's own mean), explicitly tagged
+            np.testing.assert_allclose(float(v), 2.0)
+            health = m.sync_health()
+            assert health["degraded"] and health["degraded_tier"] == "local"
+            assert health["degraded_serves"] == 1
+            assert health["degraded_since_step"] is not None
+            assert health["last_good_sync_step"] is None
+            assert engine.engine_stats()["sync_degraded_serves"] == s0 + 1
+            # state stays retryable: the local accumulators are untouched
+            np.testing.assert_allclose(float(np.asarray(m.value)), 4.0)
+            np.testing.assert_allclose(float(np.asarray(m.weight)), 2.0)
+
+            # transport heals; clean serves advance the sync-degrade edge
+            monkeypatch.undo()
+            monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+            monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "local")
+            monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+            ranks = self._two_rank_world(monkeypatch)  # fresh healthy world
+            m._computed = None
+            v = m.compute()  # clean step 1: still local
+            np.testing.assert_allclose(float(v), 2.0)
+            assert m.sync_health()["degraded"]
+            m._computed = None
+            v = m.compute()  # edge fires -> promote -> full sync re-probe
+            np.testing.assert_allclose(float(v), 3.0)  # mean of 1,3,3,5
+            health = m.sync_health()
+            assert not health["degraded"]
+            assert health["last_good_sync_step"] is not None
+            assert health["degraded_since_step"] is None
+        finally:
+            faults.set_recovery_policy(steps=8)
+
+    def test_degraded_off_by_default_failure_raises(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        monkeypatch.delenv("METRICS_TPU_SYNC_DEGRADED", raising=False)
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([2.0, 4.0]))
+        _hang_payload(monkeypatch)
+        with pytest.raises(SyncTimeoutFault):
+            m.compute()
+        lad = m.__dict__.get("_fault_ladders", {}).get("sync-degrade")
+        assert lad is None or not lad.demoted
+
+    def test_config_fault_never_degrades(self, monkeypatch):
+        from metrics_tpu.utils.exceptions import SyncConfigFault
+
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "local")
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        m = mt.MeanMetric(process_group=[0, 99])  # range-checked at sync time
+        m.update(jnp.asarray([2.0]))
+        with pytest.raises(SyncConfigFault):
+            m.compute()
+        assert not m.sync_health()["degraded"]
+
+    def test_collection_degrades_and_promotes_suite_wide(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "local")
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        faults.set_recovery_policy(steps=1)
+        try:
+            coll = mt.MetricCollection({"mean": mt.MeanMetric(), "sum": mt.SumMetric()})
+            coll.update(jnp.asarray([2.0, 4.0]))
+            local_vals = {"mean": 3.0, "sum": 6.0}
+            _hang_payload(monkeypatch)
+            with pytest.warns(UserWarning, match="LOCAL-ONLY"):
+                got = {k: float(v) for k, v in coll.compute().items()}
+            assert got == local_vals
+            health = coll.sync_health()
+            assert health["degraded"] and health["degraded_serves"] == 1
+            # heal: edge (steps=1) fires on the next compute -> full sync
+            monkeypatch.undo()
+            monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+            monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "local")
+            monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+            for _, m in coll.items(keep_base=True, copy_state=False):
+                m._computed = None
+            s0 = engine.engine_stats()
+            got = {k: float(v) for k, v in coll.compute().items()}
+            s1 = engine.engine_stats()
+            # the re-probe ran the real coalesced suite sync (1-process
+            # gather = identity, so values match; the collective proves it)
+            assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+            assert got == local_vals
+            assert not coll.sync_health()["degraded"]
+            assert coll.sync_health()["last_good_sync_step"] is not None
+        finally:
+            faults.set_recovery_policy(steps=8)
+
+
+class TestTaxonomySatellites:
+    def test_classify_maps_stdlib_timeout_and_oserror(self):
+        assert faults.classify(TimeoutError("peer hung")) == "sync"
+        assert faults.classify(OSError(28, "No space left on device")) == "journal"
+        assert faults.classify(IOError("disk detached")) == "journal"
+        # the catching site's default wins for I/O-ish domains
+        assert faults.classify(OSError("host path"), default="host") == "host"
+        assert faults.classify(SyncTimeoutFault("deadline", site="sync-gather")) == "sync"
+        # journal domain is recoverable (ladder re-probes)
+        assert faults.domain_recoverable("journal")
+
+    def test_failure_log_entries_carry_monotonic_step(self):
+        faults.note_fault("sync", site="sync-gather")
+        faults.note_fault("journal", site="journal-load")
+        log = engine.engine_stats()["failure_log"]
+        steps = [e["step"] for e in log[-2:]]
+        assert steps[1] > steps[0] > 0
+        assert faults.current_step() == steps[1]
+
+    def test_reset_stats_zeroes_counters_keeps_programs(self):
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([1.0, 2.0]))
+        m.sync(distributed_available=DIST_ON)
+        m.unsync()
+        faults.note_fault("runtime", site="probe")
+        stats = engine.engine_stats()
+        assert stats["cached"] > 0 and stats["sync_payload_collectives"] >= 1
+        assert stats["fault_runtime"] >= 1 and stats["failure_log"]
+        step_before = faults.current_step()
+        engine.reset_stats()
+        stats = engine.engine_stats()
+        # counters + log zeroed...
+        assert stats["builds"] == 0 and stats["hits"] == 0
+        assert stats["sync_payload_collectives"] == 0
+        assert stats["fault_runtime"] == 0 and stats["failure_log"] == []
+        # ...but programs survive (zero new builds on the next same-config
+        # sync) and the monotonic step index keeps counting
+        assert stats["cached"] > 0
+        assert faults.current_step() >= step_before
+        m2 = mt.MeanMetric()
+        m2.update(jnp.asarray([3.0, 4.0]))
+        m2.sync(distributed_available=DIST_ON)
+        m2.unsync()
+        assert engine.engine_stats()["builds"] == 0  # cache hits only

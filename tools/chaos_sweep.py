@@ -1,0 +1,274 @@
+"""Chaos scenario sweep: scripted MULTI-fault failure sequences.
+
+``tools/fault_sweep.py`` certifies one injected fault per site; production
+incidents arrive in sequences — a peer hangs mid-collective, and the compile
+that the recovery re-probe triggers dies under the same pressure; a process
+crashes AND its newest journal generation is torn. Each scenario here drives
+one such sequence end to end and asserts the elastic-durability invariant:
+
+    **bit-exact result or classified raise — never silent corruption.**
+
+Every observed value is either identical to the step-by-step oracle, or the
+call raised a classified :class:`FaultError`; local state stays intact and
+retryable across every failure, and the ladders re-promote once the faults
+clear.
+
+Scenarios:
+
+- ``timeout-then-compile-on-reprobe`` — a deadline-armed suite sync times
+  out (hung transport, ``METRICS_TPU_SYNC_DEADLINE_MS``); with
+  ``METRICS_TPU_SYNC_DEGRADED=local`` compute serves the bit-exact local
+  value; then the healed transport's recovery re-probe hits an injected
+  COMPILE fault while rebuilding the pack program — the sync-pack ladder
+  absorbs it (per-state fallback), still bit-exact, no raise.
+- ``crash-with-torn-journal`` — an auto-journaled suite "crashes"; the
+  newest generation is additionally corrupted (flipped byte). Restore must
+  demote to the previous good generation (classified ``journal`` fault),
+  and replaying the lost tail must land bit-exactly on the uninterrupted
+  oracle.
+- ``pack-then-gather-fault`` — a sync-pack fault demotes to the per-state
+  protocol whose gather then ALSO fails past its retry budget: the sync
+  must raise classified with local state bit-exact and retryable, and the
+  post-fault retry must succeed.
+- ``flush-fault-during-journal-save`` — a deferred-queue flush chunk dies
+  inside ``save_state``'s observation barrier: the eager replay absorbs it
+  and the written record must still load bit-exactly.
+
+``--fast`` runs the first three (the ``make faults`` / CI subset); the full
+sweep adds the deferral interaction. One JSON line per scenario; non-zero
+exit on any violation.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+import tempfile
+import time
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("METRICS_TPU_VALIDATION", "first")
+os.environ.setdefault("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_DIR not in sys.path:
+    sys.path.insert(0, _REPO_DIR)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import metrics_tpu as mt  # noqa: E402
+import metrics_tpu.metric as metric_mod  # noqa: E402
+from metrics_tpu.ops import engine, faults  # noqa: E402
+from metrics_tpu.parallel import bucketing  # noqa: E402
+from metrics_tpu.utils.exceptions import FaultError  # noqa: E402
+
+RNG = np.random.RandomState(0)
+P = jnp.asarray(RNG.rand(48).astype(np.float32))
+T = jnp.asarray(RNG.randint(0, 2, 48))
+DIST_ON = lambda: True  # noqa: E731
+
+
+def _eq(a, b) -> bool:
+    a, b = np.asarray(a), np.asarray(b)
+    return a.shape == b.shape and np.array_equal(a, b)
+
+
+def _suite():
+    return mt.MetricCollection({"mean": mt.MeanMetric(), "acc": mt.Accuracy()})
+
+
+class _env:
+    """Scoped env overrides + transport/dist patches, restored on exit."""
+
+    def __init__(self, **env):
+        self.env = env
+
+    def __enter__(self):
+        self.saved_env = {k: os.environ.get(k) for k in self.env}
+        for k, v in self.env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        self.saved_payload = bucketing._payload_allgather
+        self.saved_dist = metric_mod._dist_available
+        return self
+
+    def hang_transport(self, seconds: float = 0.5):
+        # the abandoned call must not re-enter XLA after the watchdog fires
+        # (a daemon thread inside a jax dispatch at interpreter exit can
+        # abort process teardown); its result is discarded anyway
+        def hung(x):
+            time.sleep(seconds)
+            raise RuntimeError("abandoned hung collective (watchdog timed out long ago)")
+
+        bucketing._payload_allgather = hung
+
+    def heal_transport(self):
+        bucketing._payload_allgather = self.saved_payload
+
+    def simulate_distributed(self):
+        metric_mod._dist_available = lambda: True
+
+    def __exit__(self, *exc):
+        bucketing._payload_allgather = self.saved_payload
+        metric_mod._dist_available = self.saved_dist
+        for k, v in self.saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return False
+
+
+def scenario_timeout_then_compile() -> dict:
+    """Deadline timeout mid-suite -> degraded local compute -> healed
+    transport's recovery re-probe hits a compile fault -> sync-pack ladder
+    absorbs it per-state, bit-exact throughout, zero raises."""
+    engine.reset_engine()
+    faults.set_recovery_policy(steps=1)
+    suite = _suite()
+    suite.update(P, T)
+    oracle = {k: np.asarray(v) for k, v in copy.deepcopy(suite).compute().items()}
+    with _env(METRICS_TPU_SYNC_DEADLINE_MS="80", METRICS_TPU_SYNC_DEGRADED="local") as env:
+        env.simulate_distributed()
+        env.hang_transport(0.5)
+        degraded_vals = {k: np.asarray(v) for k, v in suite.compute().items()}
+        ok = all(_eq(degraded_vals[k], oracle[k]) for k in oracle)
+        ok = ok and suite.sync_health()["degraded"]
+        ok = ok and engine.engine_stats()["sync_deadline_timeouts"] >= 1
+        # transport heals; the recovery edge (steps=1) re-probes the full
+        # sync on the next compute — and that re-probe's program build dies
+        env.heal_transport()
+        engine.reset_engine()  # force the re-probe to actually compile
+        for _, m in suite.items(keep_base=True, copy_state=False):
+            m._computed = None
+        with faults.inject_faults("compile", count=1) as plan:
+            reprobe_vals = {k: np.asarray(v) for k, v in suite.compute().items()}
+        ok = ok and plan.fired >= 1
+        # the compile fault demoted the coalescer, not the result: the
+        # per-state fallback completed the sync (1-process gather = identity)
+        ok = ok and all(_eq(reprobe_vals[k], oracle[k]) for k in oracle)
+        ok = ok and not suite.sync_health()["degraded"]
+    return {"scenario": "timeout-then-compile-on-reprobe", "ok": bool(ok)}
+
+
+def scenario_crash_with_torn_journal() -> dict:
+    """Auto-journaled suite crashes AND its newest generation is torn:
+    restore demotes to the previous good generation (classified journal
+    fault) and the replayed tail lands bit-exactly on the oracle."""
+    engine.reset_engine()
+    d = tempfile.mkdtemp(prefix="mt-chaos-")
+    path = os.path.join(d, "suite.journal")
+    batches = [
+        (jnp.asarray(RNG.rand(16).astype(np.float32)), jnp.asarray(RNG.randint(0, 2, 16)))
+        for _ in range(3)
+    ]
+    live = _suite()
+    live.journal(path, every_n=1)
+    for p, t in batches:
+        live.update(p, t)
+    oracle = {k: np.asarray(v) for k, v in live.compute().items()}
+    # crash: the process state is gone; the newest generation is ALSO torn
+    with open(path, "r+b") as fh:
+        fh.seek(30)
+        byte = fh.read(1)
+        fh.seek(30)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    j0 = engine.engine_stats()["fault_journal"]
+    restored = _suite()
+    gen = restored.load_state(path)
+    ok = gen == 1  # demoted to the previous good generation
+    ok = ok and engine.engine_stats()["fault_journal"] > j0
+    restored.update(*batches[2])  # replay the tail lost with generation 0
+    got = {k: np.asarray(v) for k, v in restored.compute().items()}
+    ok = ok and all(_eq(got[k], oracle[k]) for k in oracle)
+    return {"scenario": "crash-with-torn-journal", "ok": bool(ok), "demoted_to_generation": gen}
+
+
+def scenario_pack_then_gather() -> dict:
+    """sync-pack fault demotes to per-state, whose gather then also fails
+    past its budget: classified raise, state bit-exact and retryable."""
+    engine.reset_engine()
+    m = mt.MeanMetric()
+    m.update(jnp.asarray([2.0, 4.0]))
+    before = {k: np.asarray(v) for k, v in m.metric_state.items()}
+    raised = False
+    with faults.inject_faults("sync-pack", count=1):
+        with faults.inject_faults("sync-gather", count=100):
+            try:
+                m.sync(distributed_available=DIST_ON)
+            except FaultError:
+                raised = True  # classified, never a bare Exception
+    after = {k: np.asarray(v) for k, v in m.metric_state.items()}
+    ok = raised and all(_eq(after[k], before[k]) for k in before)
+    ok = ok and not m._is_synced
+    m.sync(distributed_available=DIST_ON)  # faults cleared: retry succeeds
+    m.unsync()
+    ok = ok and _eq(m.compute(), np.asarray(3.0))
+    return {"scenario": "pack-then-gather-fault", "ok": bool(ok)}
+
+
+def scenario_flush_fault_during_journal_save() -> dict:
+    """A deferred flush chunk dies inside save_state's observation barrier:
+    the eager replay absorbs it and the record still loads bit-exactly."""
+    engine.reset_engine()
+    engine.set_deferred_dispatch(True)
+    d = tempfile.mkdtemp(prefix="mt-chaos-")
+    path = os.path.join(d, "m.journal")
+    m = mt.MeanMetric()
+    for _ in range(6):
+        m.update(P)
+    with faults.inject_faults("flush-chunk-0", count=1) as plan:
+        m.save_state(path)
+    engine.set_deferred_dispatch(False)
+    oracle = mt.MeanMetric()
+    for _ in range(6):
+        oracle.update(P)
+    engine.set_deferred_dispatch(True)
+    fresh = mt.MeanMetric()
+    gen = fresh.load_state(path)
+    ok = plan.fired >= 1 and gen == 0
+    ok = ok and _eq(fresh.compute(), np.asarray(oracle.compute()))
+    ok = ok and _eq(m.compute(), np.asarray(oracle.compute()))
+    return {"scenario": "flush-fault-during-journal-save", "ok": bool(ok)}
+
+
+FAST = [scenario_timeout_then_compile, scenario_crash_with_torn_journal, scenario_pack_then_gather]
+FULL = FAST + [scenario_flush_fault_during_journal_save]
+
+
+def main(argv) -> int:
+    fast = "--fast" in argv
+    failures = 0
+    for scenario in FAST if fast else FULL:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # degradation warnings are the point
+            try:
+                result = scenario()
+            except Exception as exc:  # noqa: BLE001 — a scenario crash IS a violation
+                result = {
+                    "scenario": scenario.__name__,
+                    "ok": False,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+        failures += 0 if result["ok"] else 1
+        print(json.dumps(result))
+    print(
+        json.dumps(
+            {
+                "summary": "chaos_sweep",
+                "scenarios": len(FAST if fast else FULL),
+                "failures": failures,
+                "invariant": "bit-exact result or classified raise, never silent corruption",
+            }
+        )
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

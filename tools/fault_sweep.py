@@ -2,8 +2,9 @@
 
 The ``make faults`` entry point. For each injection site (``probe``,
 ``compile``, ``flush-chunk-0``, ``flush-chunk-1``, ``donation``,
-``sync-gather``, ``sync-pack``, ``host-offload``) it drives a representative
-workload under ``metrics_tpu.ops.faults.inject_faults`` and asserts:
+``sync-gather``, ``sync-pack``, ``host-offload``, ``journal-write``,
+``journal-load``) it drives a representative workload under
+``metrics_tpu.ops.faults.inject_faults`` and asserts:
 
 - the final metric values are BIT-EXACT against a step-by-step eager oracle
   (fresh instance, deferral off, no tolerance widening);
@@ -158,6 +159,69 @@ def _scenario_sync_pack(site: str):
     return ok, plan.fired
 
 
+def _scenario_journal_write(site: str):
+    """Injected write failure while auto-journaling a suite: updates must
+    keep running (journal lane demotes, warn once), the on-disk ring must
+    stay intact (the PREVIOUS record still loads), and the recovery edge
+    must re-enable journaling (a later save lands)."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="mt-fault-sweep-")
+    path = os.path.join(d, "suite.journal")
+
+    def make():
+        return mt.MetricCollection({"mean": mt.MeanMetric(), "mse": mt.MeanSquaredError()})
+
+    coll = make()
+    coll.journal(path, every_n=1)
+    coll.update(A, A)  # good generation on disk
+    oracle1 = {k: np.asarray(v) for k, v in coll.compute().items()}
+    with faults.inject_faults(site) as plan:
+        coll.update(A, A)  # write fails -> journal lane demotes, no raise
+    lad = coll.__dict__["_fault_ladders"]["journal"]
+    ok = lad.demoted
+    # the ring survived: the pre-fault record restores the 1-update state
+    fresh = make()
+    fresh.load_state(path)
+    ok = ok and all(_tree_equal(v, oracle1[k]) for k, v in fresh.compute().items())
+    # clean observed steps advance the edge (policy steps=2, deferred updates
+    # credit at flush); journaling resumes after the re-arm
+    for _ in range(2):
+        coll.update(A, A)
+        coll.compute()
+    ok = ok and not lad.demoted
+    coll.update(A, A)  # journals again: all 5 updates on disk now
+    final = {k: np.asarray(v) for k, v in coll.compute().items()}
+    fresh2 = make()
+    fresh2.load_state(path)
+    ok = ok and all(_tree_equal(v, final[k]) for k, v in fresh2.compute().items())
+    return ok, plan.fired
+
+
+def _scenario_journal_load(site: str):
+    """Injected load failure on the newest generation: restore must demote to
+    the previous good generation (classified journal fault, no raise) and be
+    bit-exact vs that generation's oracle."""
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="mt-fault-sweep-")
+    path = os.path.join(d, "m.journal")
+    m = mt.MeanMetric()
+    m.update(A)
+    m.save_state(path)  # generation to demote to
+    m.update(A)
+    m.save_state(path)  # newest generation (its read will be failed)
+    fresh = mt.MeanMetric()
+    with faults.inject_faults(site) as plan:
+        gen = fresh.load_state(path)
+    ok = gen == 1 and _tree_equal(fresh.compute(), _oracle_mean(1))
+    ok = ok and engine.engine_stats()["fault_journal"] >= 1
+    # uninjected load lands on the newest generation, bit-exact
+    fresh2 = mt.MeanMetric()
+    ok = ok and fresh2.load_state(path) == 0 and _tree_equal(fresh2.compute(), _oracle_mean(2))
+    return ok, plan.fired
+
+
 def _scenario_host_offload(site: str):
     rows = jnp.asarray([1.0, 2.0])
     c = mt.CatMetric(compute_on_cpu=True)
@@ -181,6 +245,8 @@ SWEEP = {
     "sync-gather": _scenario_sync,
     "sync-pack": _scenario_sync_pack,
     "host-offload": _scenario_host_offload,
+    "journal-write": _scenario_journal_write,
+    "journal-load": _scenario_journal_load,
 }
 
 
