@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep faults chaos ci clean convert-weights test-real-weights
+.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep faults chaos trace ci clean convert-weights test-real-weights
 
 # All targets run offline against the already-installed environment
 # (jax/flax/optax/pytest are assumed present — no network access needed).
@@ -74,8 +74,15 @@ faults:
 chaos:
 	$(PY) tools/chaos_sweep.py
 
+# Telemetry smoke: run a small suite with the flight recorder armed, export
+# the Chrome-trace/Perfetto JSON, and validate + summarize it with the
+# report tool (docs/observability.md). --smoke implies --check semantics:
+# a structurally invalid trace (bad events, non-monotonic timestamps) fails.
+trace:
+	$(PY) tools/trace_report.py --smoke
+
 # What CI runs, in order (see .github/workflows/ci.yml).
-ci: docs doctest test-fast dryrun faults bench-smoke test-full
+ci: docs doctest test-fast dryrun faults trace bench-smoke test-full
 
 clean:
 	rm -rf .pytest_cache tests/.pytest_cache .mypy_cache

@@ -30,6 +30,7 @@ from metrics_tpu.metric import (
 )
 from metrics_tpu.ops import engine as _engine
 from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.parallel import bucketing as _bucketing
 from metrics_tpu.parallel import sync as _psync
 from metrics_tpu.utils.data import _flatten_dict, allclose
@@ -1105,6 +1106,9 @@ class MetricCollection:
         members = list(self.items(keep_base=True, copy_state=False))
         if any(m._is_synced for _, m in members):
             raise MetricsUserError("The Metric has already been synced.")
+        # suite-sync telemetry span: the parent slice the pack / metadata /
+        # payload-gather / unpack spans nest under on the trace timeline
+        t_suite = _telemetry.now() if _telemetry.armed else 0.0
 
         suite_lad = self.__dict__.get("_fault_ladders", {}).get("sync-pack")
         suite_ok = (
@@ -1202,6 +1206,11 @@ class MetricCollection:
                         pass
             _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
             raise
+        if t_suite and _telemetry.armed:
+            _telemetry.emit(
+                "suite-sync", self, "sync", t_suite, _telemetry.now() - t_suite,
+                {"members": len(members), "coalesced": len(coalesced), "individual": len(individual)},
+            )
         # a completed suite sync is the "last good" marker for the suite and
         # every member tree (sync_health() reports the monotonic step index)
         step = _faults.tick()

@@ -39,6 +39,7 @@ import numpy as np
 
 from metrics_tpu.ops import engine as _engine
 from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.parallel import bucketing as _bucketing
 from metrics_tpu.parallel.collectives import sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
@@ -109,9 +110,15 @@ def _degradable_sync_failure(exc: BaseException) -> bool:
 def _note_degraded_serve(owner: Any) -> None:
     """Count one local-only compute served while the owner's ``sync-degrade``
     lane is down (per-owner tally + the global ``sync_degraded_serves``
-    counter in ``engine_stats()``)."""
+    counter in ``engine_stats()``; an instant telemetry span marks it on the
+    timeline)."""
     object.__setattr__(owner, "_degraded_serves", owner.__dict__.get("_degraded_serves", 0) + 1)
     _psync._bump("sync_degraded_serves")
+    if _telemetry.armed:
+        _telemetry.emit(
+            "sync-degrade-serve", owner, "sync",
+            attrs={"serves": owner.__dict__.get("_degraded_serves", 0)},
+        )
 
 
 def _enter_degraded(owner: Any, exc: BaseException) -> None:
@@ -403,6 +410,8 @@ class Metric(ABC):
             # if the flag was toggled after a lane was installed.
             lane = self._update_lane
             if lane is not None and not self.compute_on_cpu and lane(args, kwargs):
+                if _telemetry.armed:
+                    _telemetry.emit("host-lane", self, "host")
                 return
             # lazily-resolved module handle: a `from ... import` here costs
             # ~2 us of import machinery on EVERY update

@@ -1,7 +1,8 @@
 """Low-level device kernels and the dispatch engine: Pallas MXU histogram,
 binned-curve counts, segment reductions, donated-state program cache, the
 failure-domain engine (classified faults, degradation ladders,
-deterministic fault injection), and the crash-consistent state journal."""
+deterministic fault injection), the crash-consistent state journal, and the
+telemetry flight recorder (span ring, program ledger, trace export)."""
 from metrics_tpu.ops._dispatch import pallas_enabled
 from metrics_tpu.ops.binned import binned_curve_counts
 from metrics_tpu.ops.engine import (
@@ -11,6 +12,9 @@ from metrics_tpu.ops.engine import (
     config_fingerprint,
     donation_supported,
     engine_stats,
+    export_trace,
+    program_report,
+    program_summary,
     reset_engine,
     reset_stats,
 )
@@ -18,9 +22,16 @@ from metrics_tpu.ops.faults import (
     FAULT_SITES,
     fault_stats,
     inject_faults,
+    reset_warn_dedupe,
     set_recovery_policy,
 )
-from metrics_tpu.ops.journal import journal_generations, journalable
+from metrics_tpu.ops.journal import journal_generations, journal_stats, journalable
+from metrics_tpu.ops.telemetry import (
+    SPAN_SITES,
+    prometheus_text,
+    set_telemetry,
+    telemetry_snapshot,
+)
 from metrics_tpu.ops.histogram import fused_bincount
 from metrics_tpu.ops.segments import (
     segment_count,
@@ -47,12 +58,21 @@ __all__ = [
     "config_fingerprint",
     "donation_supported",
     "engine_stats",
+    "export_trace",
+    "program_report",
+    "program_summary",
     "reset_engine",
     "reset_stats",
     "FAULT_SITES",
     "fault_stats",
     "inject_faults",
+    "reset_warn_dedupe",
     "set_recovery_policy",
     "journal_generations",
+    "journal_stats",
     "journalable",
+    "SPAN_SITES",
+    "prometheus_text",
+    "set_telemetry",
+    "telemetry_snapshot",
 ]

@@ -72,6 +72,7 @@ import jax
 import numpy as np
 
 from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import telemetry as _telemetry
 
 __all__ = [
     "Executable",
@@ -85,7 +86,10 @@ __all__ = [
     "defer_max_pending",
     "donation_supported",
     "engine_stats",
+    "export_trace",
     "pow2_chunks",
+    "program_report",
+    "program_summary",
     "reset_engine",
     "reset_stats",
     "set_deferred_dispatch",
@@ -230,20 +234,108 @@ class Executable:
     carries the bare metric clone(s) the step closure runs on (callers
     propagate update-inferred static attrs from it); ``aux`` holds
     build-time facts like ``needs_count``.
+
+    Every executable doubles as a **program-ledger row**: each execution
+    counts toward ``hits``-style run tallies (``donated_runs`` /
+    ``plain_runs``), each call that grows a twin's jit aval cache is a
+    compile event (``compiles`` / ``compile_time_s`` — first-call wall:
+    trace + XLA compile + dispatch) whose abstract argument signature is
+    retained so :func:`program_report` can attach XLA ``cost_analysis()`` /
+    ``memory_analysis()`` on demand (an AOT re-lower of the plain twin —
+    paid only when a report is actually requested, never on the hot path).
     """
 
-    __slots__ = ("donated", "plain", "template", "aux", "__weakref__")
+    __slots__ = (
+        "donated",
+        "plain",
+        "template",
+        "aux",
+        "kind",
+        "key_digest",
+        "hits",
+        "donated_runs",
+        "plain_runs",
+        "compiles",
+        "compile_time_s",
+        "arg_structs",
+        "analysis",
+        "__weakref__",
+    )
 
     def __init__(self, donated: Optional[Callable], plain: Callable, template: Any, aux: Dict[str, Any]):
         self.donated = donated
         self.plain = plain
         self.template = template
         self.aux = aux
+        self.kind = "anonymous"
+        self.key_digest = ""
+        self.hits = 0
+        self.donated_runs = 0
+        self.plain_runs = 0
+        self.compiles = 0
+        self.compile_time_s = 0.0
+        self.arg_structs: Optional[tuple] = None
+        self.analysis: Optional[Dict[str, Any]] = None
+
+    def _capture_structs(self, state: Any, args: tuple, kwargs: dict) -> None:
+        """Retain the just-compiled call's abstract signature (arrays as
+        ``ShapeDtypeStruct``, python leaves as-is) for the on-demand
+        cost-analysis lower in :func:`program_report`."""
+        try:
+
+            def leaf(x: Any) -> Any:
+                if isinstance(x, jax.core.Tracer):
+                    raise TypeError("traced call")  # probes: nothing to retain
+                if isinstance(x, (jax.Array, np.ndarray, np.generic)):
+                    return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+                return x
+
+            self.arg_structs = jax.tree.map(leaf, (state, args, kwargs))
+            self.analysis = None  # a new signature invalidates the cached analysis
+        except Exception:  # noqa: BLE001 — the ledger never breaks a dispatch
+            pass
+
+    def _dispatch(
+        self, fn: Callable, donated: bool, state: Any, args: tuple, kwargs: dict, record_span: bool = True
+    ) -> Any:
+        if not _telemetry.armed or not jax.core.trace_state_clean():
+            # disarmed (METRICS_TPU_TELEMETRY=0): the documented contract is
+            # ONE predicate on the dispatch path — no clocks, no cache-size
+            # probes, no tallies (ledger capture is part of the recorder).
+            # Abstract tracing (eval_shape probes, nested traces) likewise
+            # never dispatches: the ledger counts real executions only.
+            return fn(state, *args, **kwargs)
+        t0 = time.perf_counter()
+        size_fn = getattr(fn, "_cache_size", None)
+        before = size_fn() if size_fn is not None else -1
+        out = fn(state, *args, **kwargs)
+        if donated:
+            self.donated_runs += 1
+        else:
+            self.plain_runs += 1
+        if size_fn is not None and size_fn() > before:
+            # this call traced+compiled a new aval signature: a ledger
+            # compile event (its wall time IS the cold-start cost the
+            # persistent-AOT-cache roadmap item needs attributed per program)
+            dur = time.perf_counter() - t0
+            self.compiles += 1
+            self.compile_time_s += dur
+            self._capture_structs(state, args, kwargs)
+            if _telemetry.armed:
+                _telemetry.emit("engine-compile", self.kind, "engine", t0, dur, {"donated": donated})
+        elif record_span and _telemetry.armed:
+            _telemetry.emit(
+                "engine-dispatch", self.kind, "engine", t0, time.perf_counter() - t0, None
+            )
+        return out
 
     def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
         # plain twin: trace/probe-friendly (``jax.eval_shape`` over an
-        # Executable exercises exactly the math the donated twin compiles)
-        return self.plain(state, *args, **kwargs)
+        # Executable exercises exactly the math the donated twin compiles).
+        # No dispatch span here — __call__ is also the pack/apply programs'
+        # concrete entry, whose callers time themselves; probes are already
+        # excluded wholesale by the trace-state guard in _dispatch.
+        return self._dispatch(self.plain, False, state, args, kwargs, record_span=False)
 
     def run(
         self,
@@ -266,8 +358,8 @@ class Executable:
             if _faults.armed:
                 _faults.maybe_fail("donation")
             if donation_supported() and state_donatable(state, avoid_ids):
-                return self.donated(state, *args, **kwargs)
-        return self.plain(state, *args, **kwargs)
+                return self._dispatch(self.donated, True, state, args, kwargs)
+        return self._dispatch(self.plain, False, state, args, kwargs)
 
     def compiled_signatures(self) -> int:
         """Number of aval signatures compiled across both twins — lets tests
@@ -316,6 +408,7 @@ def acquire_keyed(
     exe = _PROGRAM_CACHE.get(key)
     if exe is not None:
         _stats["hits"] += 1
+        exe.hits += 1
         _PROGRAM_CACHE.move_to_end(key)
         return exe
     # "compile" fault site: fires only on cache misses (a cache hit means no
@@ -324,6 +417,7 @@ def acquire_keyed(
     if _faults.armed:
         _faults.maybe_fail("compile")
     _stats["builds"] += 1
+    t0 = time.perf_counter()
     step, template, aux = build()
     exe = Executable(
         jax.jit(step, donate_argnums=(0,)) if donate else None,
@@ -331,6 +425,12 @@ def acquire_keyed(
         template,
         aux,
     )
+    exe.kind = str(key[0])
+    exe.key_digest = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+    if _telemetry.armed:
+        _telemetry.emit(
+            "engine-build", exe.kind, "engine", t0, time.perf_counter() - t0, {"key": exe.key_digest}
+        )
     _PROGRAM_CACHE[key] = exe
     while len(_PROGRAM_CACHE) > _CACHE_CAP:
         _PROGRAM_CACHE.popitem(last=False)
@@ -352,7 +452,10 @@ def engine_stats() -> Dict[str, Any]:
     ``sync_payload_collectives`` (protocol collective slots),
     ``sync_bytes_gathered``, ``sync_coalesce_ratio`` (states packed per
     coalesced payload), fast-lane hit/miss counts and
-    ``sync_pack_fallbacks``."""
+    ``sync_pack_fallbacks`` — and the journal counters from
+    :mod:`metrics_tpu.ops.journal` (saves, loads, bytes written, generation
+    demotions). ``telemetry.snapshot()`` is the superset surface that adds
+    the span-recorder counters and the program-ledger summary on top."""
     out: Dict[str, Any] = {
         "builds": _stats["builds"],
         "hits": _stats["hits"],
@@ -362,32 +465,148 @@ def engine_stats() -> Dict[str, Any]:
         "deferred_fallbacks": _stats["deferred_fallbacks"],
     }
     out.update(_faults.fault_stats())
+    from metrics_tpu.ops import journal as _journal
     from metrics_tpu.parallel import sync as _psync
 
     out.update(_psync.collective_stats())
+    out.update(_journal.journal_stats())
     return out
 
 
-def reset_stats() -> None:
+# ------------------------------------------------------------- program ledger
+def _analyze(exe: Executable) -> Optional[Dict[str, Any]]:
+    """XLA cost/memory analysis for one cached program, via an AOT re-lower
+    of the plain twin at its last-compiled abstract signature. Cached on the
+    executable; any failure (no recorded signature, a backend without
+    analysis support) reports None rather than raising."""
+    if exe.analysis is not None:
+        return exe.analysis
+    if exe.arg_structs is None:
+        return None
+    try:
+        state_s, args_s, kwargs_s = exe.arg_structs
+        compiled = exe.plain.lower(state_s, *args_s, **kwargs_s).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        mem = compiled.memory_analysis()
+        arg_b = int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+        out_b = int(getattr(mem, "output_size_in_bytes", 0) or 0)
+        tmp_b = int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+        exe.analysis = {
+            "flops": float(cost.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
+            "argument_bytes": arg_b,
+            "output_bytes": out_b,
+            "temp_bytes": tmp_b,
+            "generated_code_bytes": int(getattr(mem, "generated_code_size_in_bytes", 0) or 0),
+            # peak live footprint of one execution: arguments + outputs +
+            # scratch (donation aliases args onto outputs, so this is the
+            # un-donated upper bound)
+            "peak_bytes": arg_b + out_b + tmp_b,
+        }
+    except Exception:  # noqa: BLE001 — a report must never raise
+        return None
+    return exe.analysis
+
+
+def program_report(analyze: bool = True) -> List[Dict[str, Any]]:
+    """The program ledger: one row per cached executable — kind, cache-key
+    digest, acquisition ``hits``, ``donated_runs`` / ``plain_runs``, compile
+    events and their total wall seconds, compiled aval signatures, and (with
+    ``analyze=True``) the XLA ``cost_analysis`` / ``memory_analysis`` facts:
+    FLOPs, bytes accessed, argument/output/temp bytes and the peak live
+    footprint. Analysis is computed lazily (an AOT re-lower per program,
+    cached) — pass ``analyze=False`` for a counters-only report with zero
+    compile cost. Joined into :func:`metrics_tpu.ops.telemetry.export_trace`
+    under ``programLedger``."""
+    rows: List[Dict[str, Any]] = []
+    for exe in _PROGRAM_CACHE.values():
+        row: Dict[str, Any] = {
+            "kind": exe.kind,
+            "key": exe.key_digest,
+            "hits": exe.hits,
+            "donated_runs": exe.donated_runs,
+            "plain_runs": exe.plain_runs,
+            "compiles": exe.compiles,
+            "compile_time_s": round(exe.compile_time_s, 6),
+            "compiled_signatures": exe.compiled_signatures(),
+        }
+        row["analysis"] = _analyze(exe) if analyze else None
+        rows.append(row)
+    rows.sort(key=lambda r: r["compile_time_s"], reverse=True)
+    return rows
+
+
+def program_summary() -> Dict[str, Any]:
+    """Ledger totals (the ``programs`` block of ``telemetry.snapshot()``):
+    cached program count, compile events and wall seconds, acquisition hits
+    and donated/plain run tallies — no per-program analysis (that is
+    :func:`program_report`)."""
+    out = {
+        "count": len(_PROGRAM_CACHE),
+        "compiles": 0,
+        "compile_time_s": 0.0,
+        "hits": 0,
+        "donated_runs": 0,
+        "plain_runs": 0,
+    }
+    for exe in _PROGRAM_CACHE.values():
+        out["compiles"] += exe.compiles
+        out["compile_time_s"] += exe.compile_time_s
+        out["hits"] += exe.hits
+        out["donated_runs"] += exe.donated_runs
+        out["plain_runs"] += exe.plain_runs
+    out["compile_time_s"] = round(out["compile_time_s"], 6)
+    return out
+
+
+def export_trace(path: str) -> int:
+    """Write the recorded telemetry spans (plus the program ledger and the
+    numeric snapshot) as Chrome-trace/Perfetto JSON — see
+    :func:`metrics_tpu.ops.telemetry.export_trace`. Returns the number of
+    span events written."""
+    return _telemetry.export_trace(path)
+
+
+def _zero_engine_counters() -> None:
+    _stats["builds"] = 0
+    _stats["hits"] = 0
+    _stats["deferred_steps"] = 0
+    _stats["deferred_flushes"] = 0
+    _stats["deferred_fallbacks"] = 0
+
+
+_telemetry.register_reset("engine", _zero_engine_counters)
+
+
+def reset_stats(reset_warnings: bool = False) -> None:
     """Zero every counter :func:`engine_stats` reports — cache, deferral,
-    fault and sync-protocol telemetry plus the failure log — WITHOUT dropping
-    any cached program, manifest, or per-owner ladder state.
+    fault, sync-protocol and journal telemetry, the failure log AND the
+    telemetry span ring — WITHOUT dropping any cached program, manifest, or
+    per-owner ladder state. One registry walk
+    (:func:`metrics_tpu.ops.telemetry.reset_all`): every counter-owning
+    module registers its zeroing callback at import, so no per-module reset
+    can drift out of this set again.
 
     The companion tests (and operators diffing counter windows) need:
     ``reset_engine`` throws away compiled executables to get clean counters,
     which both recompiles everything and perturbs the behavior under test.
     ``reset_stats`` isolates a counter delta in-place. The monotonic
     failure-log ``step`` index is deliberately NOT reset (monotonicity is
-    what lets ``sync_health()`` order events across windows)."""
-    _stats["builds"] = 0
-    _stats["hits"] = 0
-    _stats["deferred_steps"] = 0
-    _stats["deferred_flushes"] = 0
-    _stats["deferred_fallbacks"] = 0
-    _faults.clear_fault_state()
-    from metrics_tpu.parallel import sync as _psync
+    what lets ``sync_health()`` order events across windows). Per-program
+    ledger tallies live with the cached programs and survive likewise.
 
-    _psync.reset_collective_stats()
+    ``reset_warnings=True`` additionally clears the ``faults.warn_fault``
+    once-per-owner dedupe markers — the explicit opt-in chaos/CI sweeps use
+    to re-observe warnings deterministically; the default preserves the
+    warn-once lifetime exactly."""
+    # import for registration side effects: every counter-owning module must
+    # be on the registry before the walk (unimported == nothing to reset)
+    from metrics_tpu.ops import journal as _journal  # noqa: F401
+    from metrics_tpu.parallel import sync as _psync  # noqa: F401
+
+    _telemetry.reset_all(reset_warnings=reset_warnings)
 
 
 def reset_engine() -> None:
@@ -564,11 +783,27 @@ class PendingQueue:
             return
         self._flushing = True
         self.flush_fn = None
+        # flush span: capture the label facts BEFORE fn runs (the flush
+        # implementation releases the owners and may drain the entries)
+        t0 = 0.0
+        if _telemetry.armed:
+            t0 = time.perf_counter()
+            owner_label = type(self.owners[0]).__name__ if self.owners else None
+            n_entries = len(self.entries)
         try:
             fn(self)
         finally:
             self._flushing = False
             self.release()  # no-op if the flush implementation already did
+            if t0 and _telemetry.armed:
+                _telemetry.emit(
+                    "engine-flush",
+                    owner_label,
+                    "defer",
+                    t0,
+                    time.perf_counter() - t0,
+                    {"kind": self.kind, "entries": n_entries},
+                )
 
 
 class LazyValue:
@@ -772,6 +1007,10 @@ def _resolved_lazy_value(value: Any) -> "LazyValue":
 
 def note_deferred_steps(n: int) -> None:
     _stats["deferred_steps"] += n
+    # hot deferred loop: one instant span per enqueue when armed (a single
+    # predicate + tuple append; the telemetry_overhead bench row pins it)
+    if _telemetry.armed:
+        _telemetry.emit("engine-enqueue", None, "defer")
 
 
 def note_deferred_flush(fallback: bool = False) -> None:

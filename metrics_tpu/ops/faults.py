@@ -40,10 +40,12 @@ from __future__ import annotations
 
 import os
 import time
+import weakref
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional
 
+from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.utils.exceptions import (
     FAULT_DOMAINS,
     CompileFault,
@@ -72,6 +74,7 @@ __all__ = [
     "maybe_fail",
     "note_fault",
     "recovery_steps",
+    "reset_warn_dedupe",
     "set_recovery_policy",
     "tick",
     "warn_fault",
@@ -214,6 +217,11 @@ def current_step() -> int:
     return _monotonic_step
 
 
+# telemetry spans are stamped with THIS index (one ordering axis for the span
+# ring and the failure log); telemetry cannot import us — we import it
+_telemetry._step_provider = current_step
+
+
 def note_fault(
     domain: str,
     *,
@@ -236,6 +244,13 @@ def note_fault(
             "error": f"{type(error).__name__}: {error}" if error is not None else None,
         }
     )
+    if _telemetry.armed:
+        _telemetry.emit(
+            "fault",
+            owner,
+            domain,
+            attrs={"site": site, "error": type(error).__name__ if error is not None else None},
+        )
 
 
 def fault_stats() -> Dict[str, Any]:
@@ -258,13 +273,41 @@ def clear_fault_state() -> None:
     _failure_log.clear()
 
 
+_telemetry.register_reset("faults", clear_fault_state)
+
+
 # ------------------------------------------------------- warning hygiene
+# Weak registry of every owner carrying a warn-dedupe marker: the markers
+# themselves live on the instances (dying with them — no id-reuse leak), but
+# chaos/CI sweeps need to clear them deterministically between scenarios
+# without holding the owners alive. `reset_warn_dedupe` (the
+# `reset_stats(reset_warnings=True)` opt-in) walks this set.
+_warned_owners: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def reset_warn_dedupe() -> None:
+    """Clear every live owner's ``warn_fault`` dedupe markers, so the next
+    fault in any domain warns again. Explicit opt-in
+    (``engine.reset_stats(reset_warnings=True)``) — the default warn-once
+    lifetime deliberately survives counter resets: an operator zeroing a
+    counter window must not re-trigger a warning storm."""
+    for owner in list(_warned_owners):
+        warned = getattr(owner, "_fault_warned", None)
+        if warned is not None:
+            warned.clear()
+
+
+_telemetry.register_warning_reset("faults", reset_warn_dedupe)
+
+
 def warn_fault(owner: Any, domain: str, message: str) -> bool:
     """Emit ``message`` once per ``owner+domain``; later faults in the same
     domain on the same owner only count in telemetry.
 
     The dedupe marker lives on the owner itself (not a global id-keyed map,
-    which would leak across id reuse), so it dies with the instance. Returns
+    which would leak across id reuse), so it dies with the instance —
+    ``reset_warn_dedupe`` (via ``engine.reset_stats(reset_warnings=True)``)
+    is the explicit opt-in that clears the markers early. Returns
     True when the warning was actually emitted.
     """
     warned = owner.__dict__.get("_fault_warned") if owner is not None else None
@@ -272,6 +315,11 @@ def warn_fault(owner: Any, domain: str, message: str) -> bool:
         warned = set()
         if owner is not None:
             object.__setattr__(owner, "_fault_warned", warned)
+    if owner is not None:
+        try:
+            _warned_owners.add(owner)
+        except TypeError:  # non-weakrefable owner: marker still dedupes
+            pass
     if domain in warned:
         return False
     warned.add(domain)
@@ -366,6 +414,13 @@ class Ladder:
         if len(self.history) > 32:
             del self.history[:-32]
         _counters["fault_demotions"] += 1
+        if _telemetry.armed:
+            _telemetry.emit(
+                "ladder-demote",
+                None,
+                self.lane,
+                attrs={"domain": domain, "tier": self.tier, "failures": self.failures},
+            )
 
     def note_clean(self, n: int = 1) -> bool:
         if not self.recoverable:
@@ -380,6 +435,8 @@ class Ladder:
         if len(self.history) > 32:
             del self.history[:-32]
         _counters["fault_promotions"] += 1
+        if _telemetry.armed:
+            _telemetry.emit("ladder-promote", None, self.lane, attrs={"failures": self.failures})
 
 
 def ladder(owner: Any, lane: str) -> Ladder:

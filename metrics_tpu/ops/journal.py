@@ -52,11 +52,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.parallel import bucketing as _bucketing
 from metrics_tpu.utils.exceptions import JournalFault
 
 __all__ = [
     "journal_generations",
+    "journal_stats",
     "journalable",
     "load_nodes",
     "pack_record",
@@ -64,6 +66,32 @@ __all__ = [
     "save_nodes",
     "write_record",
 ]
+
+# Journal-plane counters (merged into ``engine.engine_stats()`` and the
+# telemetry snapshot; zeroed through the shared reset registry). The fault
+# classifications stay in ``fault_journal`` — these count the HEALTHY traffic
+# a fault-only view is blind to.
+_counters: Dict[str, int] = {
+    "journal_saves": 0,
+    "journal_loads": 0,
+    "journal_bytes_written": 0,
+    "journal_load_demotions": 0,
+}
+
+
+def journal_stats() -> Dict[str, int]:
+    """Healthy-path journal counters: records saved/restored, bytes written,
+    and load-time generation demotions (each demotion also classifies a
+    ``journal`` fault — this counter is the cheap scrape)."""
+    return dict(_counters)
+
+
+def _reset_journal_stats() -> None:
+    for key in _counters:
+        _counters[key] = 0
+
+
+_telemetry.register_reset("journal", _reset_journal_stats)
 
 _MAGIC = b"MTJL"
 _VERSION = 1
@@ -338,6 +366,7 @@ def save_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
     size in bytes. Any failure raises classified with the ring intact."""
     from metrics_tpu.ops import faults as _faults
 
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
     try:
         for n in nodes:
             n._defer_barrier()
@@ -353,6 +382,13 @@ def save_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
             f"journal save to {path!r} failed: {type(exc).__name__}: {exc}",
             site="journal-write",
         ) from exc
+    _counters["journal_saves"] += 1
+    _counters["journal_bytes_written"] += len(data)
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "journal-save", owner, "journal", t0, _telemetry.now() - t0,
+            {"bytes": len(data), "nodes": len(nodes)},
+        )
     return len(data)
 
 
@@ -367,6 +403,7 @@ def load_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
     from metrics_tpu.ops import faults as _faults
 
     last: Optional[BaseException] = None
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
     # scan a few generations past the configured cap: the ring size may have
     # been lowered between runs, and stale-but-good older files are still a
     # better tier than a crash
@@ -379,9 +416,15 @@ def load_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
             restore_nodes(nodes, manifest, payload)
         except Exception as exc:  # noqa: BLE001 — demote to the previous generation
             last = exc
+            _counters["journal_load_demotions"] += 1
             _faults.note_fault(
                 _faults.classify(exc, "journal"), site="journal-load", owner=owner, error=exc
             )
+            if _telemetry.armed:
+                _telemetry.emit(
+                    "journal-demote", owner, "journal",
+                    attrs={"generation": gen, "error": type(exc).__name__},
+                )
             _faults.warn_fault(
                 owner,
                 "journal",
@@ -389,6 +432,12 @@ def load_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
                 f"({type(exc).__name__}: {exc}); demoting to the previous good generation.",
             )
             continue
+        _counters["journal_loads"] += 1
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "journal-load", owner, "journal", t0, _telemetry.now() - t0,
+                {"generation": gen, "bytes": len(payload), "nodes": len(nodes)},
+            )
         return gen
     if last is not None:
         if isinstance(last, JournalFault):

@@ -1,0 +1,433 @@
+"""Flight recorder: one telemetry plane for dispatch, sync, faults, journal.
+
+Five subsystems grew their own counters (engine deferral, coalesced sync,
+fault ladders, sync deadlines/degrade, the journal) but no shared *timeline*:
+``engine_stats()`` says how many collectives ran, never when, how long, or
+around which flush. This module is the missing plane, in three layers:
+
+- **Span recorder** — a bounded ring of ``(step, owner, lane, site,
+  t_start, dur, attrs)`` events. ``step`` is the SAME monotonic fault/sync
+  event index the ``failure_log`` ring stamps (:func:`metrics_tpu.ops.faults
+  .current_step`), so spans order against recorded faults without a second
+  clock. Every instrumented boundary the stack already names emits here:
+  engine enqueue/flush/build/compile/dispatch and the host fast lane, sync
+  pack/metadata/payload-gather/unpack plus deadline timeouts, degraded
+  serves and ladder demotions/promotions, journal save/load/demote. The
+  hot-path contract mirrors ``faults.armed``: call sites guard with ``if
+  telemetry.armed:`` — disarmed (``METRICS_TPU_TELEMETRY=0``) costs one
+  module-attribute read and allocates nothing; armed, one span is one tuple
+  append into a ``deque`` (the ``telemetry_overhead`` bench row pins
+  armed≈disarmed on the hot deferred loop).
+
+- **Reset registry** — every counter-owning module registers its zeroing
+  callback here at import (:func:`register_reset`), so
+  ``engine.reset_stats()`` resets the WHOLE plane through one walk instead
+  of the historical per-module drift (engine zeroed its own counters;
+  sync/fault resets were bolted on; the span ring would have been a third).
+  ``reset_all(reset_warnings=True)`` additionally clears the
+  ``faults.warn_fault`` once-per-owner dedupe markers (opt-in — chaos/CI
+  sweeps re-observe warnings deterministically; default keeps the
+  warn-once lifetime). The monotonic step index never resets.
+
+- **Faces** — :func:`snapshot` (alias ``telemetry_snapshot``): ONE merged,
+  schema-stable dict — a strict superset of ``engine_stats()`` (which
+  already folds fault + sync + journal counters) plus the span-ring
+  counters, the program-ledger summary and a global sync-health block —
+  THE monitoring surface, with :func:`prometheus_text` rendering its
+  numeric keys as a Prometheus-style exposition. :func:`export_trace`
+  writes the ring as Chrome-trace/Perfetto JSON (one track per owner,
+  nested slices; the program ledger joined under ``programLedger``) —
+  summarized offline by ``tools/trace_report.py``. See
+  docs/observability.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "SPAN_SITES",
+    "armed",
+    "clear_spans",
+    "emit",
+    "export_trace",
+    "now",
+    "prometheus_text",
+    "register_reset",
+    "register_warning_reset",
+    "reset_all",
+    "set_telemetry",
+    "snapshot",
+    "spans",
+    "telemetry_stats",
+]
+
+#: Every instrumented span site, by subsystem — the rows of the
+#: docs/observability.md site table. Instant sites (dur == 0) are marked.
+SPAN_SITES = {
+    # engine (ops/engine.py + the deferral layer)
+    "engine-enqueue": "one eager call enqueued into a pending queue (instant)",
+    "engine-flush": "a pending queue flushed as stacked scan program(s)",
+    "engine-build": "a program-cache miss traced a new program (build closure)",
+    "engine-compile": "a dispatch compiled a new aval signature (trace+compile+run wall)",
+    "engine-dispatch": "one cached-program execution (dispatch wall; completion is async)",
+    "host-lane": "one host fast-lane update (list append tier, instant)",
+    # sync (parallel/sync.py + parallel/bucketing.py)
+    "sync-pack": "coalesced pack: tree walk + bitcast-concat program",
+    "sync-metadata": "coalesced metadata exchange (dyn-shape lane)",
+    "sync-payload-gather": "coalesced payload all-gather",
+    "sync-unpack": "coalesced unpack + reduce (donated program + dyn entries)",
+    "sync-gather": "per-state gather_all_tensors exchange (shape + payload)",
+    "sync-timeout": "a blocking collective hit the watchdog deadline (instant)",
+    "sync-degrade-serve": "compute() served a local-only degraded value (instant)",
+    # fault ladders (ops/faults.py)
+    "fault": "one classified fault recorded (instant; mirrors failure_log)",
+    "ladder-demote": "a per-owner lane demoted (instant)",
+    "ladder-promote": "a per-owner lane re-promoted (instant)",
+    # journal (ops/journal.py)
+    "journal-save": "one crash-consistent record packed + atomically written",
+    "journal-load": "one record verified + restored",
+    "journal-demote": "a journal generation failed verification (instant)",
+    # suite (collections.py)
+    "suite-sync": "one whole-suite sync (coalesced + individual members)",
+}
+
+# ------------------------------------------------------------------ the gate
+#: Hot-path guard (same shape as ``faults.armed``): call sites check this one
+#: module attribute before calling :func:`emit`, so a disarmed recorder costs
+#: a single predicate and allocates nothing.
+armed: bool = os.environ.get("METRICS_TPU_TELEMETRY", "1") not in ("0", "false", "off")
+
+_DEFAULT_CAP = 4096
+
+
+def _env_cap() -> int:
+    try:
+        return max(16, int(os.environ.get("METRICS_TPU_TELEMETRY_SPANS", str(_DEFAULT_CAP))))
+    except ValueError:
+        return _DEFAULT_CAP
+
+
+_ring: "deque[tuple]" = deque(maxlen=_env_cap())
+_emitted: List[int] = [0]  # list cell: emit() stays a closure-free hot path
+
+#: Monotonic fault/sync event index provider — rebound by ``ops/faults`` at
+#: import to its ``current_step`` so spans and ``failure_log`` entries share
+#: one ordering axis (telemetry must not import faults: faults imports us).
+_step_provider: Callable[[], int] = lambda: 0  # noqa: E731
+
+
+def now() -> float:
+    """The span clock (``time.perf_counter`` — monotonic, sub-µs)."""
+    return time.perf_counter()
+
+
+def set_telemetry(enabled: Optional[bool] = None, *, span_cap: Optional[int] = None) -> None:
+    """Override the recorder at runtime (None leaves a knob unchanged; takes
+    precedence over ``METRICS_TPU_TELEMETRY`` / ``_TELEMETRY_SPANS``).
+    Shrinking ``span_cap`` re-rings the newest spans; the counters survive.
+
+    Example:
+        >>> from metrics_tpu import set_telemetry
+        >>> set_telemetry(False)   # disarm: every site is one predicate check
+        >>> set_telemetry(True, span_cap=4096)
+    """
+    global armed, _ring
+    if enabled is not None:
+        armed = bool(enabled)
+    if span_cap is not None:
+        cap = max(16, int(span_cap))
+        if cap != _ring.maxlen:
+            _ring = deque(_ring, maxlen=cap)
+
+
+def emit(
+    site: str,
+    owner: Any = None,
+    lane: Optional[str] = None,
+    t_start: float = 0.0,
+    dur: float = 0.0,
+    attrs: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Record one span. Callers guard with ``if telemetry.armed:`` — this
+    function assumes the recorder is armed and does no re-check, keeping the
+    armed path at one tuple append. ``t_start=0.0`` stamps "now" (an instant
+    event); ``owner`` may be the owning instance (stored as its type name)
+    or a pre-rendered string."""
+    _emitted[0] += 1
+    _ring.append(
+        (
+            _step_provider(),
+            owner if (owner is None or type(owner) is str) else type(owner).__name__,
+            lane,
+            site,
+            t_start if t_start else time.perf_counter(),
+            dur,
+            attrs,
+        )
+    )
+
+
+_SPAN_KEYS = ("step", "owner", "lane", "site", "t_start", "dur", "attrs")
+
+
+def spans() -> List[Dict[str, Any]]:
+    """The recorded spans, oldest first, as schema-stable dicts (keys:
+    ``step, owner, lane, site, t_start, dur, attrs``)."""
+    return [dict(zip(_SPAN_KEYS, row)) for row in _ring]
+
+
+def clear_spans() -> None:
+    _ring.clear()
+    _emitted[0] = 0
+
+
+def telemetry_stats() -> Dict[str, Any]:
+    """Recorder-plane counters (merged into :func:`snapshot`)."""
+    return {
+        "telemetry_armed": armed,
+        "spans_recorded": _emitted[0],
+        "spans_retained": len(_ring),
+        "spans_dropped": max(0, _emitted[0] - len(_ring)),
+        "span_ring_cap": _ring.maxlen,
+    }
+
+
+# -------------------------------------------------------------- reset registry
+_resets: List[Tuple[str, Callable[[], None]]] = []
+_warning_resets: List[Tuple[str, Callable[[], None]]] = []
+
+
+def _register(registry: List[Tuple[str, Callable[[], None]]], name: str, fn: Callable[[], None]) -> None:
+    for i, (n, _) in enumerate(registry):
+        if n == name:
+            registry[i] = (name, fn)
+            return
+    registry.append((name, fn))
+
+
+def register_reset(name: str, fn: Callable[[], None]) -> None:
+    """Register one module's counter-zeroing callback (idempotent per name;
+    modules call this at import). ``engine.reset_stats()`` walks the registry
+    so no per-module reset can drift out of the set again."""
+    _register(_resets, name, fn)
+
+
+def register_warning_reset(name: str, fn: Callable[[], None]) -> None:
+    """Register a warn-dedupe-clearing callback, run only under the explicit
+    ``reset_warnings=True`` opt-in (warn-once markers outliving counter
+    windows is the DEFAULT contract; chaos/CI sweeps opt out)."""
+    _register(_warning_resets, name, fn)
+
+
+def reset_all(reset_warnings: bool = False) -> None:
+    """Zero every registered counter plane (spans included) in one walk.
+    The monotonic fault/sync step index is deliberately NOT reset — each
+    registered callback preserves it. ``reset_warnings=True`` additionally
+    clears the registered warn-once dedupe markers."""
+    for _, fn in _resets:
+        fn()
+    if reset_warnings:
+        for _, fn in _warning_resets:
+            fn()
+
+
+register_reset("telemetry", clear_spans)
+
+
+# --------------------------------------------------------------------- faces
+def snapshot() -> Dict[str, Any]:
+    """ONE merged, schema-stable monitoring dict — a strict superset of
+    ``engine.engine_stats()``'s keys (cache + deferral + fault + sync +
+    journal counters and the ``failure_log`` ring) plus:
+
+    - the recorder counters (:func:`telemetry_stats`),
+    - ``programs`` — the program-ledger summary (count, compiles, compile
+      wall seconds, hits, donated/plain runs; per-program detail lives in
+      ``engine.program_report()``),
+    - ``sync_health`` — the global health block (monotonic event step,
+      degraded serves, deadline timeouts, per-domain fault counts folded
+      from the log),
+    - ``snapshot_schema`` — bumped only on breaking key changes.
+
+    This replaces the three-module counter scavenger hunt: scrape THIS (or
+    its :func:`prometheus_text` rendering) and nothing else.
+
+    Example:
+        >>> from metrics_tpu import telemetry_snapshot
+        >>> snap = telemetry_snapshot()
+        >>> snap["snapshot_schema"]
+        1
+        >>> sorted(snap["programs"])
+        ['compile_time_s', 'compiles', 'count', 'donated_runs', 'hits', 'plain_runs']
+    """
+    from metrics_tpu.ops import engine as _engine
+
+    out: Dict[str, Any] = {"snapshot_schema": 1}
+    out.update(_engine.engine_stats())
+    out.update(telemetry_stats())
+    out["monotonic_step"] = _step_provider()
+    out["programs"] = _engine.program_summary()
+    domain_counts: Dict[str, int] = {}
+    for entry in out.get("failure_log", ()):
+        domain_counts[entry["domain"]] = domain_counts.get(entry["domain"], 0) + 1
+    out["sync_health"] = {
+        "monotonic_step": _step_provider(),
+        "sync_degraded_serves": out.get("sync_degraded_serves", 0),
+        "sync_deadline_timeouts": out.get("sync_deadline_timeouts", 0),
+        "fault_domain_counts": domain_counts,
+    }
+    return out
+
+
+#: Exported name matching the issue-surface spelling; ``telemetry.snapshot()``
+#: and ``telemetry.telemetry_snapshot()`` are the same callable.
+telemetry_snapshot = snapshot
+
+
+def _flat_numeric(prefix: str, value: Any) -> Iterator[Tuple[str, float]]:
+    if isinstance(value, bool):
+        yield prefix, 1.0 if value else 0.0
+    elif isinstance(value, (int, float)) and value is not None:
+        yield prefix, float(value)
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            key = f"{prefix}_{k}" if prefix else str(k)
+            yield from _flat_numeric(key, v)
+
+
+def prometheus_text(data: Optional[Dict[str, Any]] = None) -> str:
+    """Render :func:`snapshot` (or ``data``) as a Prometheus-style text
+    exposition: every numeric key (nested dicts flattened with ``_``) becomes
+    one ``metrics_tpu_<key> <value>`` sample with a ``# TYPE`` line
+    (monotonic counters as ``counter``, the rest as ``gauge``). Non-numeric
+    values (the failure log, per-program rows) are omitted — they belong to
+    the trace, not the scrape.
+
+    Example:
+        >>> from metrics_tpu import prometheus_text
+        >>> text = prometheus_text()
+        >>> text.splitlines()[0].startswith("# TYPE metrics_tpu_")
+        True
+        >>> "metrics_tpu_sync_payload_collectives" in text
+        True
+    """
+    data = snapshot() if data is None else data
+    counter_prefixes = (
+        "builds", "hits", "deferred_", "fault_", "sync_", "journal_",
+        "spans_recorded", "spans_dropped", "monotonic_step",
+    )
+    # prefix matches that are NOT monotonically increasing (ratios recompute
+    # per scrape and can fall; counter semantics — rate()/reset detection —
+    # would read garbage off them)
+    gauge_suffixes = ("_ratio",)
+    lines: List[str] = []
+    for key, value in sorted(_flat_numeric("", {k: v for k, v in data.items() if k != "failure_log"})):
+        name = "metrics_tpu_" + "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
+        kind = (
+            "counter"
+            if key.startswith(counter_prefixes) and not key.endswith(gauge_suffixes)
+            else "gauge"
+        )
+        # integers render exactly ('%g' rounds to 6 significant digits — a
+        # multi-MiB byte counter would scrape off by thousands); floats keep
+        # repr's round-trip precision
+        rendered = str(int(value)) if float(value).is_integer() else repr(float(value))
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {rendered}")
+    return "\n".join(lines) + "\n"
+
+
+def _json_safe(value: Any) -> Any:
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): _json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    try:
+        return float(value)  # numpy scalars
+    except Exception:  # noqa: BLE001 — repr is always renderable
+        return repr(value)
+
+
+def trace_events(rows: Optional[List[Dict[str, Any]]] = None) -> List[Dict[str, Any]]:
+    """The ring as Chrome-trace events: one ``pid``, one ``tid`` (track) per
+    owner, complete (``ph: X``) slices for timed spans and instant (``ph:
+    i``) marks for zero-duration ones, timestamps in µs from the earliest
+    span — sorted, so Perfetto (and the export-round-trip test) sees
+    monotonic ``ts``."""
+    rows = spans() if rows is None else rows
+    if not rows:
+        return []
+    t0 = min(r["t_start"] for r in rows)
+    tids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for r in rows:
+        owner = r["owner"] or "global"
+        tid = tids.setdefault(owner, len(tids) + 1)
+        args: Dict[str, Any] = {"step": r["step"]}
+        if r["lane"]:
+            args["lane"] = r["lane"]
+        if r["attrs"]:
+            args.update(_json_safe(r["attrs"]))
+        ev: Dict[str, Any] = {
+            "name": r["site"],
+            "cat": r["lane"] or "span",
+            "pid": 0,
+            "tid": tid,
+            "ts": round((r["t_start"] - t0) * 1e6, 3),
+            "args": args,
+        }
+        if r["dur"] > 0:
+            ev["ph"] = "X"
+            ev["dur"] = round(r["dur"] * 1e6, 3)
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    events.sort(key=lambda e: e["ts"])
+    meta: List[Dict[str, Any]] = [
+        {"ph": "M", "name": "process_name", "pid": 0, "tid": 0, "ts": 0, "args": {"name": "metrics_tpu"}}
+    ]
+    for owner, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        meta.append(
+            {"ph": "M", "name": "thread_name", "pid": 0, "tid": tid, "ts": 0, "args": {"name": owner}}
+        )
+    return meta + events
+
+
+def export_trace(path: str) -> int:
+    """Write the recorded spans as a Chrome-trace/Perfetto JSON file — load
+    it at https://ui.perfetto.dev (or ``chrome://tracing``) to see the whole
+    run as a timeline: flush chunks, collectives and compiles as nested
+    slices per owner track, instant marks for faults/demotions/timeouts.
+    The program ledger rides along under ``programLedger`` and the numeric
+    snapshot under ``snapshot`` (``tools/trace_report.py`` summarizes both).
+    Returns the number of span events written.
+
+    Example:
+        >>> import os, tempfile
+        >>> from metrics_tpu import export_trace
+        >>> path = os.path.join(tempfile.mkdtemp(), "trace.json")
+        >>> _ = export_trace(path)
+        >>> os.path.exists(path)
+        True
+    """
+    from metrics_tpu.ops import engine as _engine
+
+    events = trace_events()
+    snap = snapshot()
+    doc = {
+        "displayTimeUnit": "ms",
+        "otherData": {"generator": "metrics_tpu.ops.telemetry", "schema": 1},
+        "programLedger": _json_safe(_engine.program_report()),
+        "snapshot": _json_safe({k: v for k, v in snap.items() if k != "failure_log"}),
+        "traceEvents": events,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, separators=(",", ":"))
+    return max(0, len(events) - sum(1 for e in events if e["ph"] == "M"))

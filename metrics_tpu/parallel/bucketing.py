@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.parallel import sync as _sync
 from metrics_tpu.parallel.reductions import _SPEC_TO_FN
 from metrics_tpu.utils.data import _flatten, dim_zero_cat
@@ -383,6 +384,7 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
     members = _sync.validate_group_live(group)
 
     # ---- pack (the "sync-pack" deterministic injection site) ----
+    t_pack = _telemetry.now() if _telemetry.armed else 0.0
     try:
         if _faults.armed:
             _faults.maybe_fail("sync-pack")
@@ -399,6 +401,11 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
         raise
     except Exception as exc:  # noqa: BLE001 — classified by the caller's ladder
         raise CoalesceError(exc) from exc
+    if t_pack and _telemetry.armed:
+        _telemetry.emit(
+            "sync-pack", nodes[0], "sync", t_pack, _telemetry.now() - t_pack,
+            {"states": len(packed_entries), "bytes": int(packed.shape[0])},
+        )
 
     # ---- collective phase (same retry budget + injection site as the
     # per-state gather; a post-budget transient surfaces as SyncFault).
@@ -419,10 +426,16 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
         local_total = int(packed.shape[0])
         if has_dyn:
             # uneven-shape lane: ONE metadata exchange for every dyn state
+            t_meta = _telemetry.now() if _telemetry.armed else 0.0
             all_vecs = _sync.run_with_deadline(
                 lambda: _host_allgather(meta_vec), site="sync-gather"
             )
             _sync.note_collective("shape")
+            if t_meta and _telemetry.armed:
+                _telemetry.emit(
+                    "sync-metadata", nodes[0], "sync", t_meta, _telemetry.now() - t_meta,
+                    {"dims": int(meta_vec.shape[0])},
+                )
             _sync._bump("sync_fastlane_misses")
             rank_meta = [_parse_rank_meta(entries, all_vecs[r]) for r in range(all_vecs.shape[0])]
             max_total = max(total for _, total in rank_meta)
@@ -437,11 +450,17 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             # cannot restart and rejoin mid-job), so every rank caches a
             # layout at the same completed sync.
             if key not in _MANIFEST_CACHE and _sync.distributed_available():
+                t_meta = _telemetry.now() if _telemetry.armed else 0.0
                 totals = _sync.run_with_deadline(
                     lambda: _host_allgather(np.asarray([local_total], np.int64)),
                     site="sync-gather",
                 )
                 _sync.note_collective("shape")
+                if t_meta and _telemetry.armed:
+                    _telemetry.emit(
+                        "sync-metadata", nodes[0], "sync", t_meta, _telemetry.now() - t_meta,
+                        {"cross_check": True},
+                    )
                 if int(totals.max()) != int(totals.min()):
                     return _LAYOUT_MISMATCH, sorted(set(int(t) for t in totals[:, 0]))
             if key in _MANIFEST_CACHE:
@@ -455,10 +474,17 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             if local_total == max_total
             else jnp.pad(packed, (0, max_total - local_total))
         )
+        t_gather = _telemetry.now() if _telemetry.armed else 0.0
         gathered = _sync.run_with_deadline(
             lambda: _payload_allgather(padded), site="sync-gather"
         )
-        _sync.note_collective("payload", nbytes=int(np.prod(gathered.shape)))
+        gathered_bytes = int(np.prod(gathered.shape))
+        _sync.note_collective("payload", nbytes=gathered_bytes)
+        if t_gather and _telemetry.armed:
+            _telemetry.emit(
+                "sync-payload-gather", nodes[0], "sync", t_gather, _telemetry.now() - t_gather,
+                {"bytes": gathered_bytes, "world": int(gathered.shape[0])},
+            )
         return gathered, rank_meta
 
     gathered, rank_meta = _faults.retry_with_backoff(
@@ -483,6 +509,7 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
     # same op-level cost profile the per-state path paid for them — baking
     # their per-sync shapes into the big program would recompile it on every
     # sync and churn the engine's program cache.
+    t_unpack = _telemetry.now() if _telemetry.armed else 0.0
     try:
         world = int(gathered.shape[0])
         ranks = list(range(world)) if members is None else [r for r in members if r < world]
@@ -555,6 +582,11 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
     for node, name, value in new_values:
         setattr(node, name, value)
 
+    if t_unpack and _telemetry.armed:
+        _telemetry.emit(
+            "sync-unpack", nodes[0], "sync", t_unpack, _telemetry.now() - t_unpack,
+            {"states": len(packed_entries)},
+        )
     _MANIFEST_CACHE[key] = True
     while len(_MANIFEST_CACHE) > _MANIFEST_CACHE_CAP:
         _MANIFEST_CACHE.pop(next(iter(_MANIFEST_CACHE)))

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.utils.exceptions import SyncConfigFault, SyncTimeoutFault
 
 
@@ -258,6 +259,10 @@ def run_with_deadline(fn: Callable[[], Any], *, site: str = "sync-gather", owner
     if not done.wait(deadline):
         _watchdog_abandon()
         _bump("sync_deadline_timeouts")
+        if _telemetry.armed:
+            _telemetry.emit(
+                "sync-timeout", owner, "sync", attrs={"site": site, "deadline_ms": deadline * 1000.0}
+            )
         raise SyncTimeoutFault(
             f"blocking collective at site {site!r} exceeded the "
             f"{deadline * 1000.0:.0f} ms watchdog deadline (METRICS_TPU_SYNC_DEADLINE_MS) — "
@@ -350,7 +355,11 @@ def reset_collective_stats() -> None:
         _counters[key] = 0
 
 
+_telemetry.register_reset("sync", reset_collective_stats)
+
+
 def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Array]:
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
     result = jnp.asarray(result)
     if not distributed_available():
         # single-process early-out still counts its protocol slots: the
@@ -359,6 +368,11 @@ def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Ar
         # where the coalescing win is asserted
         note_collective("shape")
         note_collective("payload", nbytes=int(result.nbytes))
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "sync-gather", None, "sync", t0, _telemetry.now() - t0,
+                {"bytes": int(result.nbytes), "collectives": 2},
+            )
         return [result]
 
     from jax.experimental import multihost_utils
@@ -371,12 +385,18 @@ def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Ar
     # 2) pad to the max shape, 3) gather, 4) trim each entry back
     pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
     padded = jnp.pad(result, pad_width) if any(p[1] for p in pad_width) else result
-    note_collective("payload", nbytes=int(padded.nbytes) * int(all_shapes.shape[0]))
+    gathered_bytes = int(padded.nbytes) * int(all_shapes.shape[0])
+    note_collective("payload", nbytes=gathered_bytes)
     gathered = multihost_utils.process_allgather(padded)
     out = []
     for idx in range(all_shapes.shape[0]) if members is None else members:
         slices = tuple(slice(0, int(d)) for d in all_shapes[idx])
         out.append(jnp.asarray(gathered[idx])[slices])
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "sync-gather", None, "sync", t0, _telemetry.now() - t0,
+            {"bytes": gathered_bytes, "collectives": 2},
+        )
     return out
 
 

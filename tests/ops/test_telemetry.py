@@ -1,0 +1,402 @@
+"""Flight recorder + program ledger: the ISSUE-7 observability contracts.
+
+Contracts (`metrics_tpu/ops/telemetry.py`, `engine.program_report`):
+
+- **Span emission at every instrumented boundary** — the deferred engine
+  path (enqueue/flush/build/compile), the coalesced sync faces
+  (pack/payload-gather/unpack under the suite-sync parent), the fault lane
+  (an injected demotion at ``sync-pack`` must produce a matching
+  ``ladder-demote`` span), and the journal (save/load/demote) — every site
+  drawn from the documented :data:`telemetry.SPAN_SITES` table, every span
+  stamped with the same monotonic step index as the ``failure_log``.
+- **Export round-trip** — ``engine.export_trace`` writes valid Chrome-trace
+  JSON (monotonic timestamps, well-formed events, per-owner tracks, the
+  program ledger joined) that passes ``tools/trace_report.py``'s validator.
+- **Snapshot schema stability** — ``telemetry_snapshot()`` is a strict key
+  superset of ``engine_stats()``, key-stable call-over-call, and its
+  Prometheus rendering is well-formed.
+- **Disarmed is free** — with the recorder off the ring records nothing and
+  allocates nothing.
+- **One reset registry** — ``engine.reset_stats()`` zeroes engine, sync,
+  fault, journal AND span counters in one walk (monotonic step preserved);
+  ``reset_stats(reset_warnings=True)`` is the explicit opt-in that lets
+  ``faults.warn_fault`` warn again.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, faults, telemetry
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO_DIR not in sys.path:
+    sys.path.insert(0, _REPO_DIR)
+
+from tools.trace_report import check_trace  # noqa: E402
+
+RNG = np.random.RandomState(3)
+DIST_ON = lambda: True  # noqa: E731
+
+
+def _batch(n=32):
+    return (
+        jnp.asarray(RNG.rand(n).astype(np.float32)),
+        jnp.asarray(RNG.randint(0, 2, n)),
+    )
+
+
+def _suite():
+    s = mt.MetricCollection(
+        {
+            "mean": mt.MeanMetric(),
+            "mse": mt.MeanSquaredError(),
+            "mae": mt.MeanAbsoluteError(),
+            "acc": mt.Accuracy(),
+        }
+    )
+    s.update(*_batch())
+    return s
+
+
+@pytest.fixture(autouse=True)
+def _armed_and_clean():
+    """Every test starts armed with an empty ring and leaves the recorder in
+    its default state."""
+    was = telemetry.armed
+    telemetry.set_telemetry(True)
+    telemetry.clear_spans()
+    yield
+    telemetry.set_telemetry(was)
+    telemetry.clear_spans()
+
+
+def _sites():
+    return [s["site"] for s in telemetry.spans()]
+
+
+# ------------------------------------------------------------- span emission
+def test_every_emitted_site_is_documented():
+    suite = _suite()
+    for _ in range(4):
+        suite.update(*_batch())
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    suite.compute()
+    emitted = set(_sites())
+    assert emitted, "an armed recorder saw no spans from a full suite cycle"
+    undocumented = emitted - set(telemetry.SPAN_SITES)
+    assert not undocumented, f"sites missing from the SPAN_SITES table: {undocumented}"
+
+
+def test_deferred_engine_spans():
+    m = mt.Accuracy()
+    p, t = _batch()
+    m(p, t)  # eager validation call
+    telemetry.clear_spans()
+    for _ in range(6):
+        m(p, t)  # enqueue
+    jax.block_until_ready(m.correct)  # observation: flush
+    sites = _sites()
+    assert sites.count("engine-enqueue") == 6
+    flushes = [s for s in telemetry.spans() if s["site"] == "engine-flush"]
+    assert len(flushes) == 1 and flushes[0]["attrs"]["entries"] == 6
+    assert flushes[0]["dur"] > 0
+    # the flush either compiled (first bucket) or dispatched cached programs
+    assert any(s in ("engine-compile", "engine-dispatch") for s in sites)
+
+
+def test_host_fast_lane_span():
+    m = mt.CatMetric()
+    x = jnp.asarray(RNG.rand(8).astype(np.float32))
+    m.update(x)  # first call installs the lane
+    telemetry.clear_spans()
+    m.update(x)
+    assert "host-lane" in _sites()
+
+
+def test_sync_spans_nest_and_agree_with_counters():
+    suite = _suite()
+    telemetry.clear_spans()
+    s0 = engine.engine_stats()
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    s1 = engine.engine_stats()
+    spans = telemetry.spans()
+    by_site = {s["site"]: s for s in spans}
+    for site in ("suite-sync", "sync-pack", "sync-payload-gather", "sync-unpack"):
+        assert site in by_site, f"coalesced suite sync emitted no {site} span"
+        assert by_site[site]["dur"] > 0
+    # the payload span's bytes must agree exactly with the gathered-bytes
+    # counter for the same window (the certification pins the full equality)
+    payload = [s for s in spans if s["site"] == "sync-payload-gather"]
+    assert sum(s["attrs"]["bytes"] for s in payload) == (
+        s1["sync_bytes_gathered"] - s0["sync_bytes_gathered"]
+    )
+    assert len(payload) == s1["sync_payload_collectives"] - s0["sync_payload_collectives"]
+    # child spans nest inside the suite-sync parent slice on the timeline
+    parent = by_site["suite-sync"]
+    child = by_site["sync-payload-gather"]
+    assert parent["t_start"] <= child["t_start"]
+    assert child["t_start"] + child["dur"] <= parent["t_start"] + parent["dur"] + 1e-6
+
+
+def test_injected_demotion_produces_matching_span():
+    suite = _suite()
+    telemetry.clear_spans()
+    with pytest.warns(UserWarning, match="Coalesced suite sync failed"):
+        with faults.inject_faults("sync-pack") as plan:
+            suite.sync(distributed_available=DIST_ON)
+            suite.unsync()
+    assert plan.fired == 1
+    demotes = [s for s in telemetry.spans() if s["site"] == "ladder-demote"]
+    assert [d["lane"] for d in demotes] == ["sync-pack"], demotes
+    assert demotes[0]["attrs"]["domain"] == "runtime"
+    # the classified fault itself is marked too, with the same step index
+    # stamped on the failure_log entry it mirrors
+    fault_spans = [s for s in telemetry.spans() if s["site"] == "fault"]
+    assert fault_spans and fault_spans[0]["lane"] == "runtime"
+    log_steps = {e["step"] for e in engine.engine_stats()["failure_log"]}
+    assert fault_spans[-1]["step"] in log_steps
+
+
+def test_journal_spans_and_counters(tmp_path):
+    path = str(tmp_path / "suite.journal")
+    suite = _suite()
+    s0 = engine.engine_stats()
+    telemetry.clear_spans()
+    nbytes = suite.save_state(path)
+    restored = _suite()
+    restored.load_state(path)
+    spans = {s["site"]: s for s in telemetry.spans()}
+    assert spans["journal-save"]["attrs"]["bytes"] == nbytes
+    assert spans["journal-load"]["attrs"]["generation"] == 0
+    s1 = engine.engine_stats()
+    assert s1["journal_saves"] - s0["journal_saves"] == 1
+    assert s1["journal_loads"] - s0["journal_loads"] == 1
+    assert s1["journal_bytes_written"] - s0["journal_bytes_written"] == nbytes
+    # corrupt the newest generation: the load demotes with an instant mark
+    suite.save_state(path)  # gen1 = the good record
+    with open(path, "r+b") as fh:
+        fh.seek(30)
+        byte = fh.read(1)
+        fh.seek(30)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    telemetry.clear_spans()
+    fresh = _suite()
+    with pytest.warns(UserWarning, match="failed verification"):
+        assert fresh.load_state(path) == 1
+    sites = _sites()
+    assert "journal-demote" in sites and "journal-load" in sites
+    assert engine.engine_stats()["journal_load_demotions"] >= 1
+
+
+# ------------------------------------------------------------ export + faces
+def test_export_trace_round_trip(tmp_path):
+    suite = _suite()
+    for _ in range(3):
+        suite.update(*_batch())
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    suite.compute()
+    path = str(tmp_path / "trace.json")
+    n = engine.export_trace(path)
+    assert n > 0
+    with open(path) as fh:
+        doc = json.load(fh)
+    problems = check_trace(doc)
+    assert not problems, problems
+    events = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    # monotonic timestamps (Perfetto renders any order; we pin sorted output)
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    assert all(e.get("dur", 0) >= 0 for e in events)
+    # per-owner tracks carry thread_name metadata
+    names = {
+        e["args"]["name"] for e in doc["traceEvents"] if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert "MetricCollection" in names
+    # both event flavors present: slices and instant marks
+    assert {e["ph"] for e in events} >= {"X", "i"}
+    # the program ledger rides along
+    assert isinstance(doc["programLedger"], list) and doc["programLedger"]
+    assert all("kind" in row for row in doc["programLedger"])
+    assert isinstance(doc["snapshot"], dict)
+
+
+def test_snapshot_schema_superset_and_stable():
+    suite = _suite()
+    suite.compute()
+    es = engine.engine_stats()
+    snap = mt.telemetry_snapshot()
+    missing = set(es) - set(snap)
+    assert not missing, f"snapshot dropped engine_stats keys: {missing}"
+    for key in (
+        "snapshot_schema",
+        "telemetry_armed",
+        "spans_recorded",
+        "spans_dropped",
+        "span_ring_cap",
+        "monotonic_step",
+        "programs",
+        "sync_health",
+    ):
+        assert key in snap, f"snapshot is missing its own {key!r}"
+    assert snap["snapshot_schema"] == 1
+    assert set(snap) == set(mt.telemetry_snapshot()), "snapshot keys drift call-over-call"
+    progs = snap["programs"]
+    assert set(progs) == {"count", "compiles", "compile_time_s", "hits", "donated_runs", "plain_runs"}
+    health = snap["sync_health"]
+    assert set(health) == {
+        "monotonic_step",
+        "sync_degraded_serves",
+        "sync_deadline_timeouts",
+        "fault_domain_counts",
+    }
+
+
+def test_prometheus_text_well_formed():
+    _suite().compute()
+    text = mt.prometheus_text()
+    lines = [ln for ln in text.strip().splitlines() if ln]
+    assert lines and len(lines) % 2 == 0
+    for type_line, sample in zip(lines[::2], lines[1::2]):
+        assert type_line.startswith("# TYPE metrics_tpu_")
+        kind = type_line.rsplit(" ", 1)[1]
+        assert kind in ("counter", "gauge")
+        name, value = sample.rsplit(" ", 1)
+        assert name == type_line.split(" ")[2]
+        float(value)  # parses
+    # the headline counters are scrapeable
+    assert "metrics_tpu_sync_payload_collectives" in text
+    assert "metrics_tpu_programs_count" in text
+    # a recomputed ratio must scrape as a gauge, never a counter
+    assert "# TYPE metrics_tpu_sync_coalesce_ratio gauge" in text
+    # integers render exactly — '%g'-style 6-sig-digit rounding would scrape
+    # a multi-MiB byte counter off by thousands
+    big = mt.prometheus_text({"sync_bytes_gathered": 16777217})
+    assert "metrics_tpu_sync_bytes_gathered 16777217" in big.splitlines()[-1]
+
+
+def test_program_report_ledger():
+    engine.reset_stats()
+    m1 = mt.Accuracy()
+    p, t = _batch()
+    for _ in range(4):
+        m1(p, t)
+    jax.block_until_ready(m1.correct)
+    m2 = mt.Accuracy()  # same config: cache hit, zero new compiles
+    m2(p, t)
+    report = engine.program_report()
+    assert report
+    # the deferred forward flush runs the SAME "many" scan programs
+    # forward_many compiles (shared engine keys — the PR-2 contract)
+    many = [r for r in report if r["kind"] == "many" and r["compiles"] >= 1]
+    assert many, f"ledger missing the many/flush program: {[r['kind'] for r in report]}"
+    row = many[0]
+    assert row["compiles"] >= 1 and row["compile_time_s"] > 0
+    assert row["donated_runs"] + row["plain_runs"] >= 1
+    a = row["analysis"]
+    assert a is not None and a["bytes_accessed"] > 0 and a["peak_bytes"] > 0
+    # counters-only report skips the AOT analysis entirely
+    assert all(r["analysis"] is None for r in engine.program_report(analyze=False))
+    summary = engine.program_summary()
+    assert summary["count"] == len(report) == engine.engine_stats()["cached"]
+    assert summary["compiles"] == sum(r["compiles"] for r in report)
+
+
+# ------------------------------------------------------------- disarmed path
+def test_disarmed_emits_nothing_and_allocates_nothing(tmp_path):
+    suite = _suite()
+    telemetry.set_telemetry(False)
+    before = telemetry.telemetry_stats()
+    ring_id = id(telemetry._ring)
+    for _ in range(4):
+        suite.update(*_batch())
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    suite.compute()
+    suite.save_state(str(tmp_path / "j"))
+    after = telemetry.telemetry_stats()
+    assert after["spans_recorded"] == before["spans_recorded"]
+    assert after["spans_retained"] == before["spans_retained"] == 0
+    assert id(telemetry._ring) == ring_id  # no reallocation either
+    assert after["telemetry_armed"] is False
+
+
+def test_span_ring_bounded():
+    telemetry.set_telemetry(True, span_cap=32)
+    try:
+        for i in range(100):
+            telemetry.emit("engine-enqueue", None, "defer")
+        stats = telemetry.telemetry_stats()
+        assert stats["spans_retained"] == 32
+        assert stats["spans_recorded"] == 100
+        assert stats["spans_dropped"] == 68
+    finally:
+        telemetry.set_telemetry(True, span_cap=4096)
+
+
+# ------------------------------------------------------------- reset registry
+def test_reset_stats_unifies_every_counter_plane(tmp_path):
+    suite = _suite()
+    for _ in range(3):
+        suite.update(*_batch())
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    suite.save_state(str(tmp_path / "j"))
+    with pytest.warns(UserWarning):
+        with faults.inject_faults("sync-pack"):
+            suite.sync(distributed_available=DIST_ON)
+            suite.unsync()
+    stats = engine.engine_stats()
+    assert stats["deferred_steps"] > 0
+    assert stats["sync_payload_collectives"] > 0
+    assert stats["fault_runtime"] > 0 and stats["failure_log"]
+    assert stats["journal_saves"] > 0
+    assert telemetry.telemetry_stats()["spans_recorded"] > 0
+    step_before = faults.current_step()
+    cached_before = stats["cached"]
+    ladders_before = dict(suite.__dict__["_fault_ladders"])
+
+    engine.reset_stats()
+
+    after = engine.engine_stats()
+    for key, value in after.items():
+        if key == "failure_log":
+            assert value == []
+        elif key == "cached":
+            assert value == cached_before  # programs survive
+        elif isinstance(value, bool):
+            continue
+        elif isinstance(value, (int, float)):
+            assert value == 0, f"{key} survived reset_stats: {value}"
+    assert telemetry.telemetry_stats()["spans_recorded"] == 0
+    assert telemetry.telemetry_stats()["spans_retained"] == 0
+    # the never-resetting monotonic step and per-owner ladder state persist
+    assert faults.current_step() == step_before
+    assert suite.__dict__["_fault_ladders"] == ladders_before
+
+
+def test_reset_warnings_is_an_explicit_optin():
+    class Owner:
+        pass
+
+    owner = Owner()
+    with pytest.warns(UserWarning, match="boom"):
+        assert faults.warn_fault(owner, "runtime", "boom")
+    # deduped, and a plain counter reset must NOT resurrect the warning
+    assert not faults.warn_fault(owner, "runtime", "boom")
+    engine.reset_stats()
+    assert not faults.warn_fault(owner, "runtime", "boom")
+    # the opt-in clears the dedupe markers so sweeps re-observe warnings
+    engine.reset_stats(reset_warnings=True)
+    with pytest.warns(UserWarning, match="boom"):
+        assert faults.warn_fault(owner, "runtime", "boom")

@@ -747,6 +747,61 @@ def main() -> None:
         finally:
             os.environ.pop("METRICS_TPU_SYNC_COALESCE", None)
 
+    # telemetry-armed row (ISSUE 7): the deferred Accuracy loop re-run with
+    # the flight recorder ON, exporting + validating a Chrome-trace at the
+    # end — pins that a trace-enabled sweep run stays in the deferred rows'
+    # throughput band (the bench.py telemetry_overhead row owns the precise
+    # armed-vs-disarmed ratio; this row owns "tracing a sweep artifact works")
+    try:
+        import tempfile
+
+        from metrics_tpu.ops import engine as _tel_engine
+        from metrics_tpu.ops import telemetry as _telemetry
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from trace_report import check_trace as _check_trace
+
+        was_armed = _telemetry.armed
+        _telemetry.set_telemetry(True)
+        try:
+            data = _data("binary", np.random.RandomState(0))
+            jdata = tuple(jax.device_put(jax.numpy.asarray(d)) for d in data)
+            jax.block_until_ready(jdata)
+            _defer_engine.set_deferred_dispatch(True)
+            metric = mt.Accuracy()
+            metric.update(*jdata)
+            for _ in range(DEFERRED_STEPS):
+                metric.update(*jdata)
+            jax.block_until_ready(metric.metric_state)
+            best = float("inf")
+            for _ in range(TRIALS):
+                metric.reset()
+                start = time.perf_counter()
+                for _ in range(DEFERRED_STEPS):
+                    metric.update(*jdata)
+                jax.block_until_ready(metric.metric_state)
+                best = min(best, time.perf_counter() - start)
+            trace_path = os.path.join(tempfile.mkdtemp(prefix="mt-sweep-trace-"), "sweep.json")
+            n_events = _tel_engine.export_trace(trace_path)
+            with open(trace_path) as fh:
+                problems = _check_trace(json.load(fh))
+            row = {
+                "metric": "Accuracy[trace-enabled]",
+                "mode": "deferred+telemetry",
+                "updates_per_s": round(DEFERRED_STEPS / best, 1),
+                "samples_per_s": round(DEFERRED_STEPS * BATCH / best, 1),
+                "trace_events": n_events,
+                "trace_valid": not problems,
+            }
+            if problems:
+                row["trace_problems"] = problems[:3]
+            results.append(row)
+            print(json.dumps(row))
+        finally:
+            _telemetry.set_telemetry(was_armed)
+    except Exception as err:
+        print(json.dumps({"metric": "Accuracy[trace-enabled]", "error": str(err)[:160]}))
+
     # reference pass LAST: converting/reading any device value flips the
     # tunneled backend into its post-read regime (~ms per dependent dispatch),
     # which must not poison the pipelined jit rows above — the reference arm

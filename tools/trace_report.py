@@ -1,0 +1,285 @@
+"""Summarize (and validate) a metrics_tpu telemetry trace file.
+
+The trace is the Chrome-trace/Perfetto JSON ``engine.export_trace(path)``
+writes (see docs/observability.md): span events per owner track plus the
+program ledger under ``programLedger`` and the numeric snapshot under
+``snapshot``. This tool turns one into the three summaries an operator (or a
+BENCH/SWEEP artifact review) actually reads:
+
+- **top programs** — ledger rows by compile wall time, with FLOPs / bytes
+  accessed / peak footprint from XLA cost analysis;
+- **collectives** — the sync-face spans (pack, metadata, payload gather,
+  unpack, per-state gather) by count, bytes and latency;
+- **fault-lane timeline** — every instant mark (faults, ladder demotions/
+  promotions, deadline timeouts, degraded serves, journal demotions) in
+  monotonic-step order.
+
+Modes::
+
+    python tools/trace_report.py TRACE.json           # full report
+    python tools/trace_report.py TRACE.json --check   # validate only (CI)
+    python tools/trace_report.py --smoke              # run a small suite with
+                                                      # telemetry armed, export,
+                                                      # validate, report
+
+``--check`` exits non-zero on any structural problem (not valid JSON, missing
+or non-monotonic timestamps, malformed events) — the ``make trace`` gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Span names that mark the fault lane (instant events on the timeline).
+FAULT_MARKS = (
+    "fault",
+    "ladder-demote",
+    "ladder-promote",
+    "sync-timeout",
+    "sync-degrade-serve",
+    "journal-demote",
+)
+
+#: Span names that are sync-face collectives/phases.
+COLLECTIVE_SITES = (
+    "sync-pack",
+    "sync-metadata",
+    "sync-payload-gather",
+    "sync-unpack",
+    "sync-gather",
+    "suite-sync",
+)
+
+
+def check_trace(doc: Any) -> List[str]:
+    """Structural validation of one loaded trace document; returns the list
+    of problems (empty == valid Chrome-trace JSON with monotonic span
+    timestamps)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"top level must be an object, got {type(doc).__name__}"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing 'traceEvents' list"]
+    last_ts = None
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i} is not an object")
+            continue
+        for field in ("ph", "name", "pid", "tid"):
+            if field not in ev:
+                problems.append(f"event {i} ({ev.get('name')!r}) missing {field!r}")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M", "B", "E"):
+            problems.append(f"event {i} has unknown phase {ph!r}")
+        ts = ev.get("ts")
+        if ph != "M":
+            if not isinstance(ts, (int, float)) or ts < 0:
+                problems.append(f"event {i} ({ev.get('name')!r}) has bad ts {ts!r}")
+            elif last_ts is not None and ts < last_ts:
+                problems.append(
+                    f"event {i} ({ev.get('name')!r}) ts {ts} < previous {last_ts} (non-monotonic)"
+                )
+            else:
+                last_ts = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i} ({ev.get('name')!r}) has bad dur {dur!r}")
+    ledger = doc.get("programLedger")
+    if ledger is not None:
+        if not isinstance(ledger, list):
+            problems.append("'programLedger' must be a list")
+        else:
+            for i, row in enumerate(ledger):
+                if not isinstance(row, dict) or "kind" not in row:
+                    problems.append(f"programLedger row {i} malformed")
+    snap = doc.get("snapshot")
+    if snap is not None and not isinstance(snap, dict):
+        problems.append("'snapshot' must be an object")
+    return problems
+
+
+def _span_rows(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [e for e in doc.get("traceEvents", []) if e.get("ph") in ("X", "i")]
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GiB"
+
+
+def summarize(doc: Dict[str, Any], top: int = 10) -> str:
+    """Render the three operator summaries for one trace document."""
+    rows = _span_rows(doc)
+    lines: List[str] = []
+
+    # ---- span sites by total time ----
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for ev in rows:
+        if ev["ph"] == "X":
+            agg[ev["name"]].append(float(ev.get("dur", 0.0)))
+    lines.append(f"== span sites by total time ({len(rows)} events) ==")
+    for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1]))[:top]:
+        total = sum(durs) / 1000.0
+        lines.append(
+            f"  {name:<22} n={len(durs):<6} total={total:9.3f} ms  "
+            f"mean={total / len(durs):8.4f} ms  max={max(durs) / 1000.0:8.4f} ms"
+        )
+    instants = defaultdict(int)
+    for ev in rows:
+        if ev["ph"] == "i":
+            instants[ev["name"]] += 1
+    if instants:
+        marks = ", ".join(f"{k}×{v}" for k, v in sorted(instants.items(), key=lambda kv: -kv[1]))
+        lines.append(f"  instants: {marks}")
+
+    # ---- top programs (ledger) ----
+    ledger = doc.get("programLedger") or []
+    lines.append(f"\n== top programs by compile time ({len(ledger)} cached) ==")
+    for row in ledger[:top]:
+        a = row.get("analysis") or {}
+        lines.append(
+            f"  {row.get('kind', '?'):<18} key={row.get('key', '')!s:<13} "
+            f"compiles={row.get('compiles', 0)} wall={row.get('compile_time_s', 0.0):.3f}s "
+            f"hits={row.get('hits', 0)} runs={row.get('donated_runs', 0)}d/{row.get('plain_runs', 0)}p"
+            + (
+                f"  flops={a.get('flops', 0):.0f} bytes={_fmt_bytes(a.get('bytes_accessed', 0))} "
+                f"peak={_fmt_bytes(a.get('peak_bytes', 0))}"
+                if a
+                else ""
+            )
+        )
+
+    # ---- collectives by bytes / latency ----
+    lines.append("\n== collectives / sync phases ==")
+    for site in COLLECTIVE_SITES:
+        evs = [e for e in rows if e["name"] == site and e["ph"] == "X"]
+        if not evs:
+            continue
+        total_bytes = sum(float(e.get("args", {}).get("bytes", 0)) for e in evs)
+        durs = [float(e.get("dur", 0.0)) for e in evs]
+        lines.append(
+            f"  {site:<22} n={len(evs):<6} bytes={_fmt_bytes(total_bytes):<12} "
+            f"mean={sum(durs) / len(durs) / 1000.0:8.4f} ms  max={max(durs) / 1000.0:8.4f} ms"
+        )
+
+    # ---- fault-lane timeline ----
+    marks = [e for e in rows if e["name"] in FAULT_MARKS]
+    lines.append(f"\n== fault-lane timeline ({len(marks)} marks) ==")
+    for ev in marks[: max(top, 20)]:
+        args = ev.get("args", {})
+        step = args.get("step", "?")
+        lane = args.get("lane", "")
+        detail = {k: v for k, v in args.items() if k not in ("step", "lane")}
+        lines.append(f"  step={step:<6} {ev['name']:<18} lane={lane:<14} {detail}")
+
+    snap = doc.get("snapshot") or {}
+    if snap:
+        keys = (
+            "sync_collectives_issued",
+            "sync_bytes_gathered",
+            "deferred_steps",
+            "deferred_flushes",
+            "fault_demotions",
+            "fault_promotions",
+            "journal_saves",
+            "spans_recorded",
+        )
+        lines.append("\n== snapshot ==")
+        lines.append("  " + "  ".join(f"{k}={snap.get(k)}" for k in keys if k in snap))
+    return "\n".join(lines)
+
+
+def run_smoke(out_path: str) -> str:
+    """The ``make trace`` driver: run a small 4-metric suite with telemetry
+    armed (deferred updates, one coalesced sync, a compute, one journal
+    snapshot), export the trace, and return its path."""
+    if _REPO_DIR not in sys.path:
+        sys.path.insert(0, _REPO_DIR)
+    import numpy as np
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from metrics_tpu.ops import engine, telemetry
+
+    telemetry.set_telemetry(True)
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(64).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, 64))
+    suite = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(),
+            "mean": mt.MeanMetric(),
+            "mse": mt.MeanSquaredError(),
+            "mae": mt.MeanAbsoluteError(),
+        }
+    )
+    for _ in range(12):
+        suite.update(p, t)
+    suite.sync(distributed_available=lambda: True)
+    suite.unsync()
+    suite.compute()
+    suite.save_state(out_path + ".journal")
+    engine.export_trace(out_path)
+    return out_path
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", nargs="?", help="path to an export_trace() JSON file")
+    ap.add_argument("--check", action="store_true", help="validate only; exit non-zero on problems")
+    ap.add_argument("--top", type=int, default=10, help="rows per summary table")
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="run a small telemetry-armed suite, export, validate and report (the `make trace` gate)",
+    )
+    ap.add_argument("--out", default=None, help="--smoke: where to write the trace")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        import tempfile
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault("METRICS_TPU_VALIDATION", "first")
+        out = args.out or os.path.join(tempfile.mkdtemp(prefix="mt-trace-"), "smoke-trace.json")
+        path = run_smoke(out)
+        print(f"trace written: {path}")
+    elif args.trace:
+        path = args.trace
+    else:
+        ap.error("need a TRACE file or --smoke")
+        return 2
+
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as err:
+        print(f"trace INVALID: {path}: {type(err).__name__}: {err}", file=sys.stderr)
+        return 1
+
+    problems = check_trace(doc)
+    if problems:
+        print(f"trace INVALID: {path}:", file=sys.stderr)
+        for p in problems[:20]:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    n_events = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(f"trace OK: {path} ({n_events} events, {len(doc.get('programLedger') or [])} ledger rows)")
+    if not args.check:
+        print(summarize(doc, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
