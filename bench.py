@@ -790,11 +790,15 @@ def bench_sync_deadline_overhead() -> dict:
     dist_on = lambda: True  # noqa: E731
     n_syncs = max(3, STEPS // 5)
 
-    def loop(deadline_ms) -> float:
+    def loop(deadline_ms, degraded=None) -> float:
         if deadline_ms is None:
             os.environ.pop("METRICS_TPU_SYNC_DEADLINE_MS", None)
         else:
             os.environ["METRICS_TPU_SYNC_DEADLINE_MS"] = str(deadline_ms)
+        if degraded is None:
+            os.environ.pop("METRICS_TPU_SYNC_DEGRADED", None)
+        else:
+            os.environ["METRICS_TPU_SYNC_DEGRADED"] = degraded
         try:
             coll = MetricCollection({"mean": MeanMetric(), "acc": Accuracy()})
             coll.update(p, t)
@@ -811,10 +815,19 @@ def bench_sync_deadline_overhead() -> dict:
             return n_syncs / best
         finally:
             os.environ.pop("METRICS_TPU_SYNC_DEADLINE_MS", None)
+            os.environ.pop("METRICS_TPU_SYNC_DEGRADED", None)
 
     disarmed = loop(None)
     armed = loop(60_000)
-    return {"disarmed_syncs_per_s": disarmed, "armed_syncs_per_s": armed}
+    # ISSUE 8: deadline + quorum tier + epoch fence all armed on a HEALTHY
+    # transport — every collective additionally captures/checks its epoch
+    # fence and folds success into the membership registry
+    membership_armed = loop(60_000, degraded="quorum")
+    return {
+        "disarmed_syncs_per_s": disarmed,
+        "armed_syncs_per_s": armed,
+        "membership_armed_syncs_per_s": membership_armed,
+    }
 
 
 def bench_journal_write() -> dict:
@@ -1103,13 +1116,28 @@ def main() -> None:
             )
             if deadline_probe["disarmed_syncs_per_s"] > 0
             else None,
+            # ISSUE 8: deadline + quorum tier + epoch fencing armed on a
+            # healthy transport (the fence is one int compare per collective
+            # plus a registry fold per completed sync) — armed≈disarmed is
+            # the membership acceptance pin
+            "membership_armed_syncs_per_s": round(
+                deadline_probe["membership_armed_syncs_per_s"], 1
+            ),
+            "membership_armed_vs_disarmed": round(
+                deadline_probe["membership_armed_syncs_per_s"]
+                / deadline_probe["disarmed_syncs_per_s"],
+                3,
+            )
+            if deadline_probe["disarmed_syncs_per_s"] > 0
+            else None,
             "unit": "suite sync+unsync cycles/s (2-metric suite, simulated world)",
             "note": (
                 "disarmed (default): run_with_deadline is a direct call — "
                 "behavior and cost identical to the pre-deadline protocol; "
                 "armed: each blocking collective rides a watchdog thread so a "
                 "hung peer raises a classified SyncTimeoutFault instead of "
-                "blocking forever (docs/robustness.md)"
+                "blocking forever; membership_armed additionally epoch-fences "
+                "every collective and arms the quorum tier (docs/robustness.md)"
             ),
         },
         "journal_write_per_snapshot": {

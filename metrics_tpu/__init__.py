@@ -140,6 +140,10 @@ from metrics_tpu.ops.telemetry import (  # noqa: E402
     telemetry_snapshot,
 )
 
+# world membership (docs/robustness.md "World membership"): epoch registry +
+# peer-health surface behind epoch-fenced collectives and quorum compute
+from metrics_tpu.parallel.sync import world_health  # noqa: E402
+
 __all__ = [
     "__version__",
     "functional",
@@ -147,6 +151,7 @@ __all__ = [
     "prometheus_text",
     "set_telemetry",
     "telemetry_snapshot",
+    "world_health",
     "Metric",
     "CompositionalMetric",
     "MetricCollection",

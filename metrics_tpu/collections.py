@@ -16,6 +16,7 @@ from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tupl
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import (
     Metric,
@@ -24,6 +25,7 @@ from metrics_tpu.metric import (
     _enter_degraded,
     _leaves_jittable,
     _note_degraded_serve,
+    _note_quorum_serve,
     _probe_traceable,
     _propagate_static_attrs,
     jit_distributed_available,
@@ -1020,22 +1022,23 @@ class MetricCollection:
         # compute sees itself presynced instead of issuing its own 2-per-state
         # gather walk (single-process mode: ctx is None, nothing changes)
         ctx = self._auto_sync_context()
-        # quorum-degraded tier (METRICS_TPU_SYNC_DEGRADED=local, default off):
-        # while the suite's sync-degrade lane is down, serve LOCAL-ONLY member
-        # values; each serve is one clean step toward the recovery edge, whose
-        # firing re-probes the full suite sync on this very call
+        # degraded compute tier (METRICS_TPU_SYNC_DEGRADED=local|quorum,
+        # default off): while the suite's sync-degrade lane is down, serve
+        # LOCAL-ONLY member values — or, on the quorum tier with declared-dead
+        # peers, the merge over the SURVIVING subgroup; each serve is one
+        # clean step toward the recovery edge, whose firing re-probes the
+        # full suite sync on this very call
         degraded_tier = _psync.sync_degraded_tier() if ctx is not None else None
-        serve_local = False
+        serve_degraded = False
         if degraded_tier is not None:
             lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
             if lad is not None and lad.demoted:
                 if lad.note_clean():
                     lad.promote()
                 else:
-                    serve_local = True
-        if serve_local:
-            _note_degraded_serve(self)
-            res = self._compute_local()
+                    serve_degraded = True
+        if serve_degraded:
+            res = self._compute_degraded(degraded_tier)
         elif ctx is not None:
             try:
                 with ctx:
@@ -1045,14 +1048,38 @@ class MetricCollection:
                     raise
                 # the suite sync failed classified past its retries with every
                 # member's local state restored (collections.sync rollback):
-                # drop to the degraded tier and serve local-only values
-                # instead of raising (sync_health() carries the staleness tag)
-                _enter_degraded(self, exc)
-                res = self._compute_local()
+                # drop to the degraded tier and serve degraded values instead
+                # of raising (sync_health() carries the staleness tag)
+                _enter_degraded(self, exc, degraded_tier)
+                res = self._compute_degraded(degraded_tier)
         else:
             res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
+
+    def _compute_degraded(self, tier: str) -> Dict[str, Any]:
+        """One degraded suite serve. On the ``quorum`` tier with a known
+        surviving cohort, the whole suite syncs scoped to the survivors (the
+        same coalesced protocol, group-gathered over the subgroup) and every
+        member computes pre-synced — falling back to the local-only serve
+        when no quorum is known or the subgroup sync also fails (which
+        re-demotes the lane, doubling its backoff)."""
+        if tier == "quorum":
+            survivors = _psync.surviving_members()
+            if survivors is not None:
+                try:
+                    with self.sync_context(process_group=survivors):
+                        res = {
+                            k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)
+                        }
+                    _note_quorum_serve(self, survivors)
+                    return res
+                except Exception as exc:  # noqa: BLE001 — only degradable sync faults caught
+                    if not _degradable_sync_failure(exc):
+                        raise
+                    _enter_degraded(self, exc, tier)
+        _note_degraded_serve(self)
+        return self._compute_local()
 
     def _compute_local(self) -> Dict[str, Any]:
         """Every member's compute with its own sync suppressed — the degraded
@@ -1211,17 +1238,21 @@ class MetricCollection:
                 "suite-sync", self, "sync", t_suite, _telemetry.now() - t_suite,
                 {"members": len(members), "coalesced": len(coalesced), "individual": len(individual)},
             )
-        # a completed suite sync is the "last good" marker for the suite and
-        # every member tree (sync_health() reports the monotonic step index)
-        step = _faults.tick()
-        object.__setattr__(self, "_last_good_sync_step", step)
-        if self.__dict__.get("_degraded_since_step") is not None:
-            object.__setattr__(self, "_degraded_since_step", None)
-        for _, m in members:
-            for n in _bucketing.tree_nodes(m):
-                object.__setattr__(n, "_last_good_sync_step", step)
-                if n.__dict__.get("_degraded_since_step") is not None:
-                    object.__setattr__(n, "_degraded_since_step", None)
+        # a completed FULL-WORLD suite sync is the "last good" marker for the
+        # suite and every member tree (sync_health() reports the monotonic
+        # step index); a group-scoped sync — the quorum tier's surviving-
+        # subgroup merge — stamps nothing, so health keeps reporting the
+        # degradation onset while served values exclude dead ranks
+        if _psync.is_full_world_group(process_group):
+            step = _faults.tick()
+            object.__setattr__(self, "_last_good_sync_step", step)
+            if self.__dict__.get("_degraded_since_step") is not None:
+                object.__setattr__(self, "_degraded_since_step", None)
+            for _, m in members:
+                for n in _bucketing.tree_nodes(m):
+                    object.__setattr__(n, "_last_good_sync_step", step)
+                    if n.__dict__.get("_degraded_since_step") is not None:
+                        object.__setattr__(n, "_degraded_since_step", None)
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore every member's pre-sync local state."""
@@ -1303,10 +1334,15 @@ class MetricCollection:
             "degraded": bool(lad is not None and lad.demoted)
             or any(h["degraded"] for h in members.values()),
             "degraded_tier": _psync.sync_degraded_tier(),
+            "epoch": _psync.world_epoch(),
             "last_good_sync_step": self.__dict__.get("_last_good_sync_step"),
             "degraded_since_step": self.__dict__.get("_degraded_since_step"),
             "degraded_serves": self.__dict__.get("_degraded_serves", 0),
+            "quorum_serves": self.__dict__.get("_quorum_serves", 0),
             "members": members,
+            # the fleet-level membership view (dead ranks, surviving cohort,
+            # suspicion counters, transition log) — one dict for dashboards
+            "world": _psync.world_health(),
         }
 
     def _journal_nodes(self) -> List[Metric]:
@@ -1393,6 +1429,177 @@ class MetricCollection:
                     "The on-disk generation ring is intact."
                 ),
             )
+
+    # --------------------------------------------------------- world membership
+    def checkpoint_barrier(self, path: str) -> Dict[str, Any]:
+        """Journal the fleet at ONE agreed monotonic step — the coordinated
+        variant of :meth:`save_state` a globally-consistent restore needs.
+
+        A collective: **every rank calls it**. One small metadata exchange
+        (epoch-fenced, deadline-guarded, riding the standard retry budget)
+        gathers each rank's monotonic event step; the maximum is the agreed
+        ``barrier_step``, stamped — together with the world epoch and world
+        size — into every rank's record manifest. A fleet-wide restore then
+        verifies all rank files carry the same ``(epoch, barrier_step)``
+        pair, so no rank restores a snapshot from a different membership
+        configuration. Returns ``{path, epoch, barrier_step, world_size,
+        bytes}``.
+        """
+        from metrics_tpu.ops import journal as _journal
+
+        self._defer_barrier()
+        fence = _psync.world_epoch()
+        t0 = _telemetry.now() if _telemetry.armed else 0.0
+        # the barrier is itself an event on the shared monotonic fault/sync
+        # axis: each rank contributes its NEXT step, so consecutive barriers
+        # always agree strictly increasing steps (and order against the
+        # failure log without a second clock)
+        local = np.asarray([_faults.tick()], np.int64)
+
+        def _exchange():
+            _psync.check_epoch(fence, site="checkpoint-barrier", owner=self)
+            return _psync.run_with_deadline(
+                lambda: _bucketing._host_allgather(local), site="checkpoint-barrier"
+            )
+
+        vec = np.asarray(
+            _faults.retry_with_backoff(
+                _exchange,
+                attempts=_psync.sync_retries(),
+                base_delay_s=_psync.sync_backoff_s(),
+                owner=self,
+                site="checkpoint-barrier",
+            )
+        )
+        _psync.note_collective("shape", epoch=fence)
+        agreed = int(vec.max())
+        world = int(vec.shape[0])
+        # the completed exchange is a collective success: clear the
+        # cohort-wide timeout suspicion (as a subgroup success while peers
+        # are declared dead — a barrier proves the current cohort responded,
+        # not that the full world healed)
+        _psync.note_sync_success(world=world, members=_psync.surviving_members())
+        # the epoch must still hold when the record is stamped: a membership
+        # change during the exchange would stamp a manifest no surviving
+        # cohort agrees on
+        _psync.check_epoch(fence, site="checkpoint-barrier", owner=self)
+        nbytes = _journal.save_nodes(
+            self,
+            self._journal_nodes(),
+            path,
+            manifest_extra={
+                "epoch": fence,
+                "barrier_step": agreed,
+                "world_size": world,
+                "barrier": True,
+            },
+        )
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "checkpoint-barrier", self, "sync", t0, _telemetry.now() - t0,
+                {"barrier_step": agreed, "epoch": fence, "world": world, "bytes": nbytes},
+            )
+        return {
+            "path": path,
+            "epoch": fence,
+            "barrier_step": agreed,
+            "world_size": world,
+            "bytes": nbytes,
+        }
+
+    def rejoin(
+        self,
+        path: str,
+        handoff: Optional[Any] = None,
+        rank: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Re-enter the world after a restart, without corrupting a single
+        collective.
+
+        1. **Restore** the newest good journal generation at ``path`` (torn
+           generations demote, exactly like :meth:`load_state`), recovering
+           every update this rank journaled before it died.
+        2. **Catch up**: when a ``handoff`` callable is provided (a survivor
+           serving this rank's newest barrier record off shared storage or
+           its retained copy), it is called with the restored manifest's
+           membership stamps and may return newer record *bytes* — one
+           bucketed state handoff, since a journal record **is** the
+           sync-pack byte buffer. A strictly newer record (by
+           ``barrier_step``/``monotonic_step``) replaces the local restore,
+           all-or-nothing.
+        3. **Enter the next epoch**: :func:`~metrics_tpu.parallel.sync.rejoin_rank`
+           clears this rank's dead mark and bumps the world epoch, so every
+           stale in-flight protocol fences and the surviving quorum's
+           recovery edge re-probes the full world on its next compute.
+
+        Returns ``{generation, epoch, handoff, restored_step, rank}``.
+        """
+        from metrics_tpu.ops import journal as _journal
+
+        t0 = _telemetry.now() if _telemetry.armed else 0.0
+        gen = self.load_state(path)
+        meta = _journal.restored_meta(self)
+
+        def _stamp(m: Dict[str, Any]) -> Optional[int]:
+            step = m.get("barrier_step")
+            return step if step is not None else m.get("monotonic_step")
+
+        handoff_used = False
+        if handoff is not None:
+            # a broken handoff must never abort the rejoin: the local
+            # generation already restored (all-or-nothing), so a corrupt or
+            # incompatible survivor record demotes to it — classified, warn
+            # once — exactly like a torn on-disk generation would
+            try:
+                record = handoff(dict(meta))
+                if record:
+                    manifest, payload = _journal.decode_record(record, origin="<rejoin-handoff>")
+                    theirs, mine = _stamp(manifest), _stamp(meta)
+                    if theirs is not None and (mine is None or theirs > mine):
+                        _journal.restore_nodes(self._journal_nodes(), manifest, payload)
+                        if self._enable_compute_groups and self._groups_checked:
+                            self._compute_groups_create_state_ref()
+                        meta = {
+                            k: manifest[k] for k in _journal._META_KEYS if k in manifest
+                        }
+                        object.__setattr__(self, "_journal_meta", dict(meta))
+                        handoff_used = True
+            except Exception as exc:  # noqa: BLE001 — demote to the local restore
+                _faults.note_fault(
+                    _faults.classify(exc, "journal"), site="journal-load", owner=self, error=exc
+                )
+                _faults.warn_fault(
+                    self,
+                    "journal",
+                    f"Rejoin handoff record failed verification ({type(exc).__name__}: {exc}); "
+                    "continuing with the locally-restored journal generation.",
+                )
+        live_rank = rank
+        if live_rank is None:
+            live_rank = jax.process_index() if _psync.distributed_available() else 0
+        epoch = _psync.rejoin_rank(int(live_rank))
+        # a fresh epoch: this instance serves nothing stale
+        lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
+        if lad is not None and lad.demoted:
+            lad.promote()
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "rank-rejoin", self, "sync", t0, _telemetry.now() - t0,
+                {
+                    "rank": int(live_rank),
+                    "epoch": epoch,
+                    "generation": gen,
+                    "handoff": handoff_used,
+                    "restored_step": _stamp(meta),
+                },
+            )
+        return {
+            "generation": gen,
+            "epoch": epoch,
+            "handoff": handoff_used,
+            "restored_step": _stamp(meta),
+            "rank": int(live_rank),
+        }
 
     # ---------------------------------------------------- functional export
     def as_functions(self) -> tuple:

@@ -113,7 +113,7 @@ def _note_degraded_serve(owner: Any) -> None:
     counter in ``engine_stats()``; an instant telemetry span marks it on the
     timeline)."""
     object.__setattr__(owner, "_degraded_serves", owner.__dict__.get("_degraded_serves", 0) + 1)
-    _psync._bump("sync_degraded_serves")
+    _psync.note_degraded_serve("local")
     if _telemetry.armed:
         _telemetry.emit(
             "sync-degrade-serve", owner, "sync",
@@ -121,11 +121,38 @@ def _note_degraded_serve(owner: Any) -> None:
         )
 
 
-def _enter_degraded(owner: Any, exc: BaseException) -> None:
-    """Drop ``owner`` to the quorum-degraded compute tier: demote its
+def _note_quorum_serve(owner: Any, survivors: List[int]) -> None:
+    """Count one surviving-quorum compute served while the owner's
+    ``sync-degrade`` lane is down: the value aggregated over the surviving
+    subgroup instead of the full world (per-owner tally + the global
+    ``sync_quorum_serves`` counter; an instant span stamps the epoch and
+    the cohort on the timeline)."""
+    object.__setattr__(owner, "_quorum_serves", owner.__dict__.get("_quorum_serves", 0) + 1)
+    _psync.note_degraded_serve("quorum")
+    if _telemetry.armed:
+        _telemetry.emit(
+            "sync-quorum-serve", owner, "sync",
+            attrs={
+                "serves": owner.__dict__.get("_quorum_serves", 0),
+                "epoch": _psync.world_epoch(),
+                "survivors": list(survivors),
+            },
+        )
+
+
+def _enter_degraded(owner: Any, exc: BaseException, tier: str = "local") -> None:
+    """Drop ``owner`` to the degraded compute tier: demote its
     ``sync-degrade`` ladder lane (standard recovery edge — a healed transport
     promotes back to full sync automatically), stamp the degradation onset
-    for ``sync_health()``, and warn once per owner+domain."""
+    for ``sync_health()``, and warn once per owner+domain. The serve itself
+    (local or quorum) is counted by the caller — entering the tier and
+    serving under it are separate events."""
+    serves = (
+        "the surviving-QUORUM aggregate (the subgroup of ranks still alive; "
+        "local-only if no quorum is known)"
+        if tier == "quorum"
+        else "the LOCAL-ONLY value"
+    )
     _faults.demote(
         owner,
         "sync-degrade",
@@ -139,13 +166,12 @@ def _enter_degraded(owner: Any, exc: BaseException) -> None:
         count=False,
         warn=(
             f"Distributed sync failed for `{type(owner).__name__}` and "
-            "METRICS_TPU_SYNC_DEGRADED=local is set: compute() now serves the LOCAL-ONLY "
-            "value (staleness metadata in sync_health()) until the sync-degrade lane's "
+            f"METRICS_TPU_SYNC_DEGRADED={tier} is set: compute() now serves {serves} "
+            "(staleness metadata in sync_health()) until the sync-degrade lane's "
             "recovery edge re-probes the transport."
         ),
     )
     object.__setattr__(owner, "_degraded_since_step", _faults.current_step())
-    _note_degraded_serve(owner)
 
 
 _checks_cached = None
@@ -1963,14 +1989,19 @@ class Metric(ABC):
                         pass
             _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
             raise
-        # a completed sync is the tree's "last good" health marker: stamp the
-        # monotonic fault/sync step index on every node (sync_health() reports
-        # it as last_good_sync_step) and clear any degradation onset
-        step = _faults.tick()
-        for n in _bucketing.tree_nodes(self):
-            object.__setattr__(n, "_last_good_sync_step", step)
-            if n.__dict__.get("_degraded_since_step") is not None:
-                object.__setattr__(n, "_degraded_since_step", None)
+        # a completed FULL-WORLD sync is the tree's "last good" health marker:
+        # stamp the monotonic fault/sync step index on every node
+        # (sync_health() reports it as last_good_sync_step) and clear any
+        # degradation onset. A group-scoped sync — the quorum tier's
+        # surviving-subgroup merge — deliberately stamps nothing: its served
+        # values still exclude dead ranks, and reporting fresh full-world
+        # health would contradict the membership registry.
+        if _psync.is_full_world_group(process_group or self.process_group):
+            step = _faults.tick()
+            for n in _bucketing.tree_nodes(self):
+                object.__setattr__(n, "_last_good_sync_step", step)
+                if n.__dict__.get("_degraded_since_step") is not None:
+                    object.__setattr__(n, "_degraded_since_step", None)
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local state (reference `metric.py:452-472`)."""
@@ -2046,9 +2077,11 @@ class Metric(ABC):
         return {
             "degraded": bool(lad is not None and lad.demoted),
             "degraded_tier": _psync.sync_degraded_tier(),
+            "epoch": _psync.world_epoch(),
             "last_good_sync_step": self.__dict__.get("_last_good_sync_step"),
             "degraded_since_step": self.__dict__.get("_degraded_since_step"),
             "degraded_serves": self.__dict__.get("_degraded_serves", 0),
+            "quorum_serves": self.__dict__.get("_quorum_serves", 0),
             "fault_domain_counts": domain_counts,
         }
 
@@ -2100,25 +2133,34 @@ class Metric(ABC):
 
             self._defer_barrier()
             should_sync = self._to_sync
-            # quorum-degraded tier (METRICS_TPU_SYNC_DEGRADED=local, default
-            # off — one env read only when a sync is actually pending): while
-            # the sync-degrade lane is down, compute() serves the LOCAL-ONLY
-            # value (tagged via sync_health()); each serve is one clean step
-            # toward the recovery edge, whose firing re-probes the full sync
-            # on this very call — a healed transport promotes automatically
+            # degraded compute tier (METRICS_TPU_SYNC_DEGRADED=local|quorum,
+            # default off — one env read only when a sync is actually
+            # pending): while the sync-degrade lane is down, compute() serves
+            # the LOCAL-ONLY value ("local") or the merge over the SURVIVING
+            # subgroup ("quorum", when the membership registry knows who
+            # survived — the group-scoped gather path). Each serve is one
+            # clean step toward the recovery edge, whose firing re-probes the
+            # FULL world on this very call — a healed transport (or a
+            # rejoined rank) promotes automatically.
             degraded_tier = _psync.sync_degraded_tier() if should_sync else None
+            quorum_group: Optional[List[int]] = None
             if degraded_tier is not None:
                 lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
                 if lad is not None and lad.demoted:
                     if lad.note_clean():
                         lad.promote()
                     else:
-                        should_sync = False
-                        _note_degraded_serve(self)
+                        quorum_group = (
+                            _psync.surviving_members() if degraded_tier == "quorum" else None
+                        )
+                        if quorum_group is None:
+                            should_sync = False
+                            _note_degraded_serve(self)
 
-            def _compute_under_sync(do_sync: bool) -> Any:
+            def _compute_under_sync(do_sync: bool, group: Optional[List[int]] = None) -> Any:
                 with self.sync_context(
                     dist_sync_fn=self.dist_sync_fn,
+                    process_group=group,
                     should_sync=do_sync,
                     should_unsync=self._should_unsync,
                 ):
@@ -2128,7 +2170,10 @@ class Metric(ABC):
                 return self._computed
 
             try:
-                return _compute_under_sync(should_sync)
+                value = _compute_under_sync(should_sync, quorum_group)
+                if quorum_group is not None:
+                    _note_quorum_serve(self, quorum_group)
+                return value
             except Exception as exc:  # noqa: BLE001 — only degradable sync faults caught
                 if not (
                     degraded_tier is not None
@@ -2139,9 +2184,23 @@ class Metric(ABC):
                     raise
                 # the sync failed classified past its retries and restored
                 # local state (Metric.sync's snapshot/restore): drop to the
-                # degraded tier and serve the local-only value instead of
-                # raising
-                _enter_degraded(self, exc)
+                # degraded tier and serve instead of raising
+                _enter_degraded(self, exc, degraded_tier)
+                if quorum_group is None and degraded_tier == "quorum":
+                    survivors = _psync.surviving_members()
+                    if survivors is not None:
+                        # a quorum is known (peers declared dead, epoch
+                        # bumped): aggregate over the survivors before
+                        # falling all the way back to local-only
+                        try:
+                            value = _compute_under_sync(True, survivors)
+                            _note_quorum_serve(self, survivors)
+                            return value
+                        except Exception as exc2:  # noqa: BLE001 — degradable only
+                            if not (_degradable_sync_failure(exc2) and not self._is_synced):
+                                raise
+                            _enter_degraded(self, exc2, degraded_tier)
+                _note_degraded_serve(self)
                 return _compute_under_sync(False)
 
         return wrapped

@@ -50,6 +50,7 @@ from metrics_tpu.utils.exceptions import (
     FAULT_DOMAINS,
     CompileFault,
     DonationFault,
+    EpochFault,
     FaultError,
     HostOffloadFault,
     JournalFault,
@@ -95,6 +96,9 @@ TIERS = ("fused", "chunked", "eager", "host")
 #: ``journal-write`` fires before a journal record's temp file is written
 #: (previous generations stay intact by construction); ``journal-load`` fires
 #: before a stored record is read, modelling an unreadable newest generation.
+#: ``epoch-fence`` models a membership change racing a collective: the
+#: injected ``EpochFault`` is what the real fence raises when a protocol's
+#: entry epoch goes stale mid-flight.
 FAULT_SITES = (
     "probe",
     "compile",
@@ -102,6 +106,7 @@ FAULT_SITES = (
     "donation",
     "sync-gather",
     "sync-pack",
+    "epoch-fence",
     "host-offload",
     "journal-write",
     "journal-load",
@@ -116,6 +121,9 @@ _SITE_DEFAULT_EXC = {
     # runtime domain: recoverable, so the sync-pack ladder earns the
     # demote -> clean-syncs -> re-promote edge
     "sync-pack": RuntimeFault,
+    # sync domain: a stale-epoch collective attempt (membership changed
+    # mid-protocol) — the fence raises it instead of issuing
+    "epoch-fence": EpochFault,
     "host-offload": HostOffloadFault,
     "journal-write": JournalFault,
     "journal-load": JournalFault,
@@ -616,6 +624,12 @@ def retry_with_backoff(fn, *, attempts: int, base_delay_s: float, owner: Any = N
     for attempt in range(attempts + 1):
         try:
             return fn()
+        except EpochFault:
+            # the epoch fence already classified and counted it; a re-issued
+            # collective at a stale epoch can never pair with the new cohort,
+            # so the retry budget does not apply — the caller re-enters the
+            # whole protocol at the current epoch instead
+            raise
         except Exception as exc:  # noqa: BLE001 — classified + rethrown below
             last = exc
             note_fault(classify(exc, "sync"), site=site, owner=owner, error=exc)

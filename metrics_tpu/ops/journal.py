@@ -63,7 +63,9 @@ __all__ = [
     "load_nodes",
     "pack_record",
     "read_record",
+    "restored_meta",
     "save_nodes",
+    "world_meta",
     "write_record",
 ]
 
@@ -161,14 +163,21 @@ def _static_attrs(node: Any) -> Dict[str, Any]:
 
 
 # ------------------------------------------------------------------- encoding
-def pack_record(nodes: Sequence[Any]) -> bytes:
+def pack_record(nodes: Sequence[Any], manifest_extra: Optional[Dict[str, Any]] = None) -> bytes:
     """Serialize every reduce-path state of ``nodes`` into one byte record.
 
     The caller must have flushed/canonicalized every node (``save_nodes``
     does). Reuses the coalesced-sync pack: ``bucketing._collect`` builds the
     layout manifest, ``bucketing._pack`` bitcasts and concatenates every
     state into one flat uint8 buffer (bit-exact for every fixed-width dtype;
-    the engine-cached pack program is shared with the sync path)."""
+    the engine-cached pack program is shared with the sync path).
+
+    ``manifest_extra`` adds JSON-serializable keys to the manifest — the
+    world-membership stamps (``epoch``, ``barrier_step``, …) ride here.
+    Reserved structural keys (``entries``, ``version``, …) cannot be
+    overridden, and :func:`decode_record` tolerates any extra key it does
+    not know (forward compatibility: an older reader restores a newer
+    writer's record, ignoring the stamps it cannot interpret)."""
     reason = journalable(nodes)
     if reason is not None:
         raise JournalFault(f"cannot journal this state tree: {reason}", site="journal-write")
@@ -201,6 +210,10 @@ def pack_record(nodes: Sequence[Any]) -> bytes:
         # BootStrapper's numpy RNG stream — see Metric._journal_extra)
         "extras": [n._journal_extra() for n in nodes],
     }
+    if manifest_extra:
+        for key, value in manifest_extra.items():
+            # extra stamps never shadow the structural schema
+            manifest.setdefault(key, value)
     mbytes = json.dumps(manifest, separators=(",", ":")).encode("utf-8")
     header = _HEADER.pack(
         _MAGIC, _VERSION, len(mbytes), len(payload), zlib.crc32(mbytes), zlib.crc32(payload)
@@ -211,7 +224,14 @@ def pack_record(nodes: Sequence[Any]) -> bytes:
 def decode_record(data: bytes, origin: str = "<bytes>") -> Tuple[Dict[str, Any], bytes]:
     """Verify and split one record into ``(manifest, payload)``; raises the
     classified :class:`JournalFault` on ANY corruption — truncation, foreign
-    magic, version skew, or a CRC mismatch on either part."""
+    magic, version skew, or a CRC mismatch on either part.
+
+    The manifest check is deliberately asymmetric: **unknown extra keys are
+    tolerated** (forward compatibility — a newer writer may stamp
+    world-membership metadata like ``epoch``/``barrier_step`` that an older
+    reader must ignore, not reject), but the structural ``entries`` table is
+    required — a CRC-valid record without it cannot restore anything and
+    classifies as corrupt."""
 
     def _bad(why: str) -> JournalFault:
         return JournalFault(f"journal record {origin} is corrupt: {why}", site="journal-load")
@@ -235,6 +255,8 @@ def decode_record(data: bytes, origin: str = "<bytes>") -> Tuple[Dict[str, Any],
         manifest = json.loads(mbytes.decode("utf-8"))
     except ValueError as err:  # pragma: no cover - crc makes this near-impossible
         raise _bad(f"manifest does not parse: {err}") from err
+    if not isinstance(manifest, dict) or not isinstance(manifest.get("entries"), list):
+        raise _bad("manifest has no entries table")
     return manifest, payload
 
 
@@ -361,9 +383,38 @@ def read_record(path: str) -> Tuple[Dict[str, Any], bytes]:
 
 
 # ---------------------------------------------------------------- owner-level
-def save_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
+#: Manifest stamps the membership layer reads back at rejoin time. Unknown
+#: to older readers by design (decode_record tolerates them).
+_META_KEYS = ("epoch", "last_good_sync_step", "monotonic_step", "barrier_step", "world_size", "barrier")
+
+
+def world_meta(owner: Any) -> Dict[str, Any]:
+    """The default world-membership manifest stamps for one save: the current
+    epoch, the owner's last completed sync step, and the global monotonic
+    event step — what ``rejoin`` compares against a survivor handoff to
+    decide whose record is newer."""
+    from metrics_tpu.ops import faults as _faults
+    from metrics_tpu.parallel import sync as _sync
+
+    return {
+        "epoch": _sync.world_epoch(),
+        "last_good_sync_step": owner.__dict__.get("_last_good_sync_step"),
+        "monotonic_step": _faults.current_step(),
+    }
+
+
+def restored_meta(owner: Any) -> Dict[str, Any]:
+    """The membership stamps of the record ``owner`` last restored (empty
+    before any load). ``MetricCollection.rejoin`` reads this to compare the
+    local journal against the fleet."""
+    return dict(owner.__dict__.get("_journal_meta") or {})
+
+
+def save_nodes(owner: Any, nodes: Sequence[Any], path: str, manifest_extra: Optional[Dict[str, Any]] = None) -> int:
     """Snapshot ``nodes`` to ``path`` (rotating the ring); returns the record
-    size in bytes. Any failure raises classified with the ring intact."""
+    size in bytes. Any failure raises classified with the ring intact. The
+    manifest carries the :func:`world_meta` membership stamps (plus any
+    caller ``manifest_extra``, which wins on key overlap)."""
     from metrics_tpu.ops import faults as _faults
 
     t0 = _telemetry.now() if _telemetry.armed else 0.0
@@ -371,7 +422,10 @@ def save_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
         for n in nodes:
             n._defer_barrier()
             n._canonicalize_list_states()
-        data = pack_record(nodes)
+        extra = world_meta(owner)
+        if manifest_extra:
+            extra.update(manifest_extra)
+        data = pack_record(nodes, manifest_extra=extra)
         write_record(path, data)
     except Exception as exc:  # noqa: BLE001 — classified + rethrown
         domain = _faults.classify(exc, "journal")
@@ -414,6 +468,12 @@ def load_nodes(owner: Any, nodes: Sequence[Any], path: str) -> int:
         try:
             manifest, payload = read_record(gpath)
             restore_nodes(nodes, manifest, payload)
+            # stash the restored record's membership stamps for rejoin
+            object.__setattr__(
+                owner,
+                "_journal_meta",
+                {k: manifest[k] for k in _META_KEYS if k in manifest},
+            )
         except Exception as exc:  # noqa: BLE001 — demote to the previous generation
             last = exc
             _counters["journal_load_demotions"] += 1

@@ -83,6 +83,13 @@ SPAN_SITES = {
     "sync-gather": "per-state gather_all_tensors exchange (shape + payload)",
     "sync-timeout": "a blocking collective hit the watchdog deadline (instant)",
     "sync-degrade-serve": "compute() served a local-only degraded value (instant)",
+    "sync-quorum-serve": "compute() served the surviving-quorum aggregate (instant)",
+    # world membership (parallel/sync.py + collections.py)
+    "epoch-bump": "the world epoch advanced on a membership transition (instant)",
+    "peer-dead": "a peer rank was declared dead (instant)",
+    "peer-rejoin": "a rank's dead mark cleared in the membership registry (instant)",
+    "rank-rejoin": "a restarted rank restored its journal and re-entered the world",
+    "checkpoint-barrier": "a fleet-wide journal at one agreed monotonic step",
     # fault ladders (ops/faults.py)
     "fault": "one classified fault recorded (instant; mirrors failure_log)",
     "ladder-demote": "a per-owner lane demoted (instant)",
@@ -266,6 +273,8 @@ def snapshot() -> Dict[str, Any]:
     """
     from metrics_tpu.ops import engine as _engine
 
+    from metrics_tpu.parallel import sync as _world
+
     out: Dict[str, Any] = {"snapshot_schema": 1}
     out.update(_engine.engine_stats())
     out.update(telemetry_stats())
@@ -274,9 +283,22 @@ def snapshot() -> Dict[str, Any]:
     domain_counts: Dict[str, int] = {}
     for entry in out.get("failure_log", ()):
         domain_counts[entry["domain"]] = domain_counts.get(entry["domain"], 0) + 1
+    wh = _world.world_health()
+    last_good = wh.get("last_good_sync_step")
     out["sync_health"] = {
         "monotonic_step": _step_provider(),
+        # every key below is a typed Prometheus gauge (prometheus_text
+        # flattens this block as metrics_tpu_sync_health_*): the health
+        # surface a scrape can alert on, not just raw event counters
+        "degraded": bool(wh.get("degraded")),
+        "epoch": int(wh.get("epoch", 0)),
+        "dead_ranks": len(wh.get("dead_ranks") or ()),
+        "consecutive_timeouts": int(wh.get("consecutive_timeouts", 0)),
+        # -1 = "no full-world sync completed yet" (None would drop out of
+        # the numeric exposition entirely, hiding exactly the alarming case)
+        "last_good_sync_step": -1 if last_good is None else int(last_good),
         "sync_degraded_serves": out.get("sync_degraded_serves", 0),
+        "sync_quorum_serves": out.get("sync_quorum_serves", 0),
         "sync_deadline_timeouts": out.get("sync_deadline_timeouts", 0),
         "fault_domain_counts": domain_counts,
     }
@@ -324,12 +346,18 @@ def prometheus_text(data: Optional[Dict[str, Any]] = None) -> str:
     # per scrape and can fall; counter semantics — rate()/reset detection —
     # would read garbage off them)
     gauge_suffixes = ("_ratio",)
+    # the flattened sync_health block is health STATE, not event counts: the
+    # degraded flag clears, dead ranks rejoin, suspicion resets — every key
+    # scrapes as a gauge even though the "sync_" prefix matches above
+    gauge_prefixes = ("sync_health_",)
     lines: List[str] = []
     for key, value in sorted(_flat_numeric("", {k: v for k, v in data.items() if k != "failure_log"})):
         name = "metrics_tpu_" + "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
         kind = (
             "counter"
-            if key.startswith(counter_prefixes) and not key.endswith(gauge_suffixes)
+            if key.startswith(counter_prefixes)
+            and not key.endswith(gauge_suffixes)
+            and not key.startswith(gauge_prefixes)
             else "gauge"
         )
         # integers render exactly ('%g' rounds to 6 significant digits — a

@@ -382,6 +382,12 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
     from metrics_tpu.utils.exceptions import SyncFault
 
     members = _sync.validate_group_live(group)
+    # epoch fence: this protocol instance pairs with the cohort that exists
+    # NOW; every transport attempt below re-checks the fence before issuing,
+    # so a membership change mid-sync (peer declared dead, rank rejoined)
+    # raises the classified EpochFault instead of pairing with the wrong
+    # cohort — and every collective slot is audited against the stamp
+    fence = _sync.world_epoch()
 
     # ---- pack (the "sync-pack" deterministic injection site) ----
     t_pack = _telemetry.now() if _telemetry.armed else 0.0
@@ -421,6 +427,7 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
     # inside the retried closure so it rides the same retry/snapshot-restore
     # lane as any other transport fault.
     def _attempt():
+        _sync.check_epoch(fence, site="sync-gather", owner=nodes[0])
         if _faults.armed:
             _faults.maybe_fail("sync-gather")
         local_total = int(packed.shape[0])
@@ -430,7 +437,7 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             all_vecs = _sync.run_with_deadline(
                 lambda: _host_allgather(meta_vec), site="sync-gather"
             )
-            _sync.note_collective("shape")
+            _sync.note_collective("shape", epoch=fence)
             if t_meta and _telemetry.armed:
                 _telemetry.emit(
                     "sync-metadata", nodes[0], "sync", t_meta, _telemetry.now() - t_meta,
@@ -455,7 +462,7 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
                     lambda: _host_allgather(np.asarray([local_total], np.int64)),
                     site="sync-gather",
                 )
-                _sync.note_collective("shape")
+                _sync.note_collective("shape", epoch=fence)
                 if t_meta and _telemetry.armed:
                     _telemetry.emit(
                         "sync-metadata", nodes[0], "sync", t_meta, _telemetry.now() - t_meta,
@@ -479,11 +486,11 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             lambda: _payload_allgather(padded), site="sync-gather"
         )
         gathered_bytes = int(np.prod(gathered.shape))
-        _sync.note_collective("payload", nbytes=gathered_bytes)
+        _sync.note_collective("payload", nbytes=gathered_bytes, epoch=fence)
         if t_gather and _telemetry.armed:
             _telemetry.emit(
                 "sync-payload-gather", nodes[0], "sync", t_gather, _telemetry.now() - t_gather,
-                {"bytes": gathered_bytes, "world": int(gathered.shape[0])},
+                {"bytes": gathered_bytes, "world": int(gathered.shape[0]), "epoch": fence},
             )
         return gathered, rank_meta
 
@@ -500,6 +507,10 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             ValueError(f"static-shape layouts disagree across processes (packed totals {rank_meta})"),
             rank_symmetric=True,
         )
+    # the collective phase completed: clear cohort-wide timeout suspicion and
+    # (on a full-world sync) the degraded flag; a multi-row gather also
+    # teaches the membership registry the world size
+    _sync.note_sync_success(world=int(gathered.shape[0]), members=members)
 
     # ---- unpack + reduce ----
     # Static entries (the fixed prefix of every rank's buffer) unpack through

@@ -15,14 +15,15 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Callable, List, Optional
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.ops import telemetry as _telemetry
-from metrics_tpu.utils.exceptions import SyncConfigFault, SyncTimeoutFault
+from metrics_tpu.utils.exceptions import EpochFault, SyncConfigFault, SyncTimeoutFault
 
 
 def distributed_available() -> bool:
@@ -72,6 +73,19 @@ def _resolve_group(group: Optional[Any], n_processes: Optional[int]) -> Optional
     return members
 
 
+def effective_world_size() -> int:
+    """The world the sync protocol validates groups against: the LIVE process
+    count, or the membership registry's DECLARED expected world when that is
+    larger (a simulated/fake multi-rank world — the transport hooks — and a
+    world currently degraded below its full size both keep their original
+    rank numbering, so a surviving-quorum ``process_group`` must stay valid).
+    A world size merely *observed* from past gathers never loosens
+    validation — only an explicit declaration or a membership transition
+    makes the registry authoritative."""
+    expected = _membership.expected_world
+    return max(world_size(), expected if expected else 1)
+
+
 def validate_group_live(group: Optional[Any]) -> Optional[List[int]]:
     """Run the (construction-deferred) ``process_group`` validation against
     the LIVE world size, raising the classified :class:`SyncConfigFault`.
@@ -83,7 +97,7 @@ def validate_group_live(group: Optional[Any]) -> Optional[List[int]]:
     callers keep working, and it is structural — never retried.
     """
     try:
-        return _resolve_group(group, world_size())
+        return _resolve_group(group, effective_world_size())
     except SyncConfigFault:
         raise
     except ValueError as err:
@@ -92,7 +106,7 @@ def validate_group_live(group: Optional[Any]) -> Optional[List[int]]:
         _faults.note_fault("sync", site="sync-config", error=err)
         raise SyncConfigFault(
             f"process_group is invalid for the live world size "
-            f"({world_size()} process(es)): {err}",
+            f"({effective_world_size()} process(es)): {err}",
             site="sync-config",
         ) from err
 
@@ -103,6 +117,40 @@ class _EnvWarnOwner:
 
 
 _RETRIES_WARN_OWNER = _EnvWarnOwner()
+_BACKOFF_WARN_OWNER = _EnvWarnOwner()
+_DEADLINE_WARN_OWNER = _EnvWarnOwner()
+_MEMBERSHIP_WARN_OWNER = _EnvWarnOwner()
+
+
+def _env_parse(name: str, default: Any, parse: Callable[[str], Any], kind: str, *, owner: Any, fallback_desc: Optional[str] = None) -> Any:
+    """The ONE parser every sync env knob rides: unset/blank returns
+    ``default``; an unparseable value warns once (naming the offending value,
+    so the operator can find the typo'd deployment line) and falls back to
+    ``default``. Read per call — every knob is consulted at sync time, never
+    on the per-step hot path."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return parse(raw)
+    except (TypeError, ValueError):
+        from metrics_tpu.ops import faults as _faults
+
+        _faults.warn_fault(
+            owner,
+            "sync",
+            f"{name}={raw!r} is not {kind}; falling back to "
+            f"{fallback_desc or f'the default ({default!r})'}.",
+        )
+        return default
+
+
+def _env_int(name: str, default: Any, *, owner: Any, fallback_desc: Optional[str] = None) -> Any:
+    return _env_parse(name, default, int, "an integer", owner=owner, fallback_desc=fallback_desc)
+
+
+def _env_float(name: str, default: Any, *, owner: Any, fallback_desc: Optional[str] = None) -> Any:
+    return _env_parse(name, default, float, "a number", owner=owner, fallback_desc=fallback_desc)
 
 
 def sync_retries() -> int:
@@ -116,64 +164,55 @@ def sync_retries() -> int:
     whose failure mode is symmetric (e.g. a coordinator timeout surfacing on
     all ranks at once) opt in by setting the env var explicitly. An
     unparseable value falls back to the SAME distributed-aware default as the
-    unset case (never a unilateral retry in a live world) and warns once.
-    Read per call — gathers run at sync time, never on the per-step hot
-    path."""
-    raw = os.environ.get("METRICS_TPU_SYNC_RETRIES")
-    if raw is None:
-        return 0 if distributed_available() else 2
-    try:
-        return max(0, int(raw))
-    except ValueError:
-        default = 0 if distributed_available() else 2
-        from metrics_tpu.ops import faults as _faults
-
-        _faults.warn_fault(
-            _RETRIES_WARN_OWNER,
-            "sync",
-            f"METRICS_TPU_SYNC_RETRIES={raw!r} is not an integer; falling back to the"
-            f" distributed-aware default ({default} — unilateral collective retries stay"
-            " opt-in in a live multi-process world).",
-        )
-        return default
+    unset case (never a unilateral retry in a live world) and warns once."""
+    default = 0 if distributed_available() else 2
+    return max(
+        0,
+        _env_int(
+            "METRICS_TPU_SYNC_RETRIES",
+            default,
+            owner=_RETRIES_WARN_OWNER,
+            fallback_desc=(
+                f"the distributed-aware default ({default} — unilateral collective retries"
+                " stay opt-in in a live multi-process world)"
+            ),
+        ),
+    )
 
 
 def sync_backoff_s() -> float:
     """Base retry backoff (``METRICS_TPU_SYNC_BACKOFF_MS``, default 50 ms),
-    doubled per attempt."""
-    try:
-        return max(0.0, float(os.environ.get("METRICS_TPU_SYNC_BACKOFF_MS", "50"))) / 1000.0
-    except ValueError:
-        return 0.05
+    doubled per attempt. An unparseable value warns once (naming the value)
+    and uses the default."""
+    return max(0.0, _env_float("METRICS_TPU_SYNC_BACKOFF_MS", 50.0, owner=_BACKOFF_WARN_OWNER)) / 1000.0
 
 
 # ------------------------------------------------------------- sync deadlines
-_DEADLINE_WARN_OWNER = _EnvWarnOwner()
-
-
 def sync_deadline_s() -> Optional[float]:
     """Watchdog deadline for one blocking collective
     (``METRICS_TPU_SYNC_DEADLINE_MS``; default **off** — unset preserves the
     pre-deadline semantics exactly: a hung peer blocks forever, and the hot
     path pays zero watchdog cost). An unparseable or non-positive value warns
-    once and stays off. Read per call — collectives run at sync time, never
-    on the per-step hot path."""
-    raw = os.environ.get("METRICS_TPU_SYNC_DEADLINE_MS")
-    if raw is None or not raw.strip():
-        return None
-    try:
-        ms = float(raw)
-    except ValueError:
-        from metrics_tpu.ops import faults as _faults
-
-        _faults.warn_fault(
-            _DEADLINE_WARN_OWNER,
-            "sync",
-            f"METRICS_TPU_SYNC_DEADLINE_MS={raw!r} is not a number; the sync watchdog"
-            " stays OFF (collectives block without a deadline).",
-        )
+    once and stays off."""
+    ms = _env_float(
+        "METRICS_TPU_SYNC_DEADLINE_MS",
+        None,
+        owner=_DEADLINE_WARN_OWNER,
+        fallback_desc="OFF (collectives block without a deadline)",
+    )
+    if ms is None:
         return None
     return ms / 1000.0 if ms > 0 else None
+
+
+def sync_dead_after() -> int:
+    """Consecutive watchdog timeouts at ONE world epoch before the peer
+    prober is consulted and unresponsive peers are declared dead
+    (``METRICS_TPU_SYNC_DEAD_AFTER``, default 3, floor 1). With no prober
+    installed the threshold only drives the ``world_health()`` suspicion
+    counter — membership never changes on timeouts alone, because a host
+    collective timing out does not say *which* peer hung."""
+    return max(1, _env_int("METRICS_TPU_SYNC_DEAD_AFTER", 3, owner=_MEMBERSHIP_WARN_OWNER))
 
 
 # One long-lived watchdog worker (lazily created): syncs are serialized, so a
@@ -259,6 +298,12 @@ def run_with_deadline(fn: Callable[[], Any], *, site: str = "sync-gather", owner
     if not done.wait(deadline):
         _watchdog_abandon()
         _bump("sync_deadline_timeouts")
+        # fold the timeout into the membership registry: K consecutive
+        # timeouts at one epoch consult the peer prober (if installed) and
+        # may declare dead peers + bump the world epoch — after which any
+        # retry of THIS protocol instance trips the epoch fence instead of
+        # re-issuing a collective the new cohort cannot pair with
+        note_sync_timeout(site)
         if _telemetry.armed:
             _telemetry.emit(
                 "sync-timeout", owner, "sync", attrs={"site": site, "deadline_ms": deadline * 1000.0}
@@ -282,25 +327,339 @@ def sync_degraded_tier() -> Optional[str]:
     ``compute()`` serves the **local-only** value tagged with staleness
     metadata (``Metric.sync_health()``) instead of raising, and the owner's
     ``sync-degrade`` ladder lane re-probes the full sync after the standard
-    recovery edge. Unset/empty (the default) preserves raise-on-failure
-    exactly. Any other value warns once and stays off."""
+    recovery edge. ``"quorum"`` — same trigger, but while peers are declared
+    dead (:func:`surviving_members`), ``compute()`` aggregates over the
+    **surviving subgroup** (the group-scoped gather path) instead of serving
+    a purely local value, falling back to local only when no quorum is
+    known or the subgroup sync also fails. Unset/empty (the default)
+    preserves raise-on-failure exactly. Any other value warns once and
+    stays off."""
     raw = os.environ.get("METRICS_TPU_SYNC_DEGRADED")
     if not raw:
         return None
     value = raw.strip().lower()
     if value in ("0", "false", "off"):
         return None
-    if value == "local":
-        return "local"
+    if value in ("local", "quorum"):
+        return value
     from metrics_tpu.ops import faults as _faults
 
     _faults.warn_fault(
         _DEADLINE_WARN_OWNER,
         "sync",
-        f"METRICS_TPU_SYNC_DEGRADED={raw!r} is not a known tier (only 'local');"
+        f"METRICS_TPU_SYNC_DEGRADED={raw!r} is not a known tier ('local' or 'quorum');"
         " degraded compute stays OFF (sync failures raise classified).",
     )
     return None
+
+
+# ------------------------------------------------------ world membership/epochs
+class _Membership:
+    """Process-local world-membership registry.
+
+    One monotonic **world epoch** numbers every membership configuration this
+    process has seen; every collective protocol captures the epoch at entry
+    (its *fence*) and re-checks it before each transport attempt
+    (:func:`check_epoch`), so a membership change mid-protocol raises the
+    classified :class:`EpochFault` instead of pairing a collective with the
+    wrong cohort. Transitions — peer declared dead, rank rejoined — bump the
+    epoch; per-peer outcome records fold out of sync successes and watchdog
+    timeouts (timeouts are *anonymous* on a host collective, so suspicion is
+    cohort-wide until the peer prober attributes it). The registry is
+    process-local state, like the fault ladders: counters reset around it,
+    membership does not (``reset_membership`` is the explicit test/chaos
+    reset; the epoch stays monotonic across it, like the fault step index).
+    """
+
+    __slots__ = (
+        "epoch",
+        "dead",
+        "expected_world",
+        "observed_world",
+        "consecutive_timeouts",
+        "last_good_sync_step",
+        "world_degraded",
+        "peers",
+        "transitions",
+    )
+
+    def __init__(self) -> None:
+        self.epoch: int = 1
+        self.dead: set = set()
+        # expected_world is DECLARED (set_expected_world, or promoted from
+        # observed_world at the first membership transition) and widens
+        # process-group validation; observed_world is merely LEARNED from
+        # completed multi-row gathers and never loosens validation on its own
+        self.expected_world: Optional[int] = None
+        self.observed_world: Optional[int] = None
+        self.consecutive_timeouts: int = 0
+        self.last_good_sync_step: Optional[int] = None
+        self.world_degraded: bool = False
+        self.peers: Dict[int, Dict[str, Any]] = {}
+        self.transitions: "deque[Dict[str, Any]]" = deque(maxlen=64)
+
+    @property
+    def known_world(self) -> Optional[int]:
+        return self.expected_world or self.observed_world
+
+
+_membership = _Membership()
+
+#: Optional peer-attribution hook: a callable returning the ranks believed
+#: DEAD (an operator heartbeat, a coordinator watch, or a test/chaos stub).
+#: Consulted only after ``sync_dead_after()`` consecutive timeouts at one
+#: epoch — a host collective timeout alone cannot attribute the hang.
+_peer_prober: Optional[Callable[[], Iterable[int]]] = None
+
+
+def set_peer_prober(prober: Optional[Callable[[], Iterable[int]]]) -> None:
+    """Install (or clear, with ``None``) the dead-peer attribution hook."""
+    global _peer_prober
+    _peer_prober = prober
+
+
+def set_expected_world(n: Optional[int]) -> None:
+    """Declare the full-world rank count membership reasons against (also
+    learned automatically from any completed multi-row gather)."""
+    _membership.expected_world = None if n is None else max(1, int(n))
+
+
+def world_epoch() -> int:
+    """The current monotonic world epoch (starts at 1; bumps on every
+    membership transition). Capture it at protocol entry and pass it to
+    :func:`check_epoch` before issuing each collective."""
+    return _membership.epoch
+
+
+def bump_epoch(reason: str, rank: Optional[int] = None) -> int:
+    """Advance the world epoch (a membership transition happened). Every
+    in-flight protocol's fence goes stale — its next :func:`check_epoch`
+    raises instead of issuing a collective into the new cohort."""
+    m = _membership
+    m.epoch += 1
+    m.consecutive_timeouts = 0
+    _bump("sync_epoch_bumps")
+    from metrics_tpu.ops import faults as _faults
+
+    m.transitions.append(
+        {"step": _faults.current_step(), "epoch": m.epoch, "reason": reason, "rank": rank}
+    )
+    if _telemetry.armed:
+        _telemetry.emit("epoch-bump", None, "sync", attrs={"epoch": m.epoch, "reason": reason, "rank": rank})
+    return m.epoch
+
+
+def check_epoch(stamped: int, *, site: str = "sync-gather", owner: Any = None) -> None:
+    """The epoch fence: raise the classified :class:`EpochFault` when the
+    protocol's entry-captured epoch no longer matches the live one. Called
+    inside the retried collective closure, immediately before issue — a
+    membership change between attempts (e.g. the K-th watchdog timeout
+    auto-declaring a peer dead) fences the retry instead of letting it pair
+    with the wrong cohort or hang again."""
+    from metrics_tpu.ops import faults as _faults
+
+    if _faults.armed:
+        # deterministic injection: models a membership change racing this
+        # exact collective (the injected EpochFault is what the fence raises)
+        _faults.maybe_fail("epoch-fence")
+    if stamped == _membership.epoch:
+        return
+    _bump("sync_epoch_fence_trips")
+
+    err = EpochFault(
+        f"collective at site {site!r} fenced: the protocol entered at world epoch {stamped} "
+        f"but the membership epoch is now {_membership.epoch} (a peer died or rejoined "
+        "mid-protocol). Local state is intact — re-enter the sync at the current epoch.",
+        site="epoch-fence",
+    )
+    _faults.note_fault("sync", site="epoch-fence", owner=owner, error=err)
+    raise err
+
+
+def _declare_dead(ranks: Iterable[int], reason: str) -> List[int]:
+    m = _membership
+    new = sorted(int(r) for r in ranks if int(r) not in m.dead)
+    if not new:
+        return []
+    # a membership transition makes the registry authoritative about the
+    # world: promote the observed size so the surviving cohort both resolves
+    # and validates as a process group
+    if m.expected_world is None and m.observed_world:
+        m.expected_world = m.observed_world
+    for r in new:
+        m.dead.add(r)
+        rec = m.peers.setdefault(r, {"timeouts": 0})
+        rec["state"] = "dead"
+        rec["declared_dead_epoch"] = m.epoch
+        _bump("sync_peers_declared_dead")
+        if _telemetry.armed:
+            _telemetry.emit("peer-dead", None, "sync", attrs={"rank": r, "reason": reason})
+    bump_epoch("peer-dead", rank=new[0] if len(new) == 1 else None)
+    return new
+
+
+def mark_peer_dead(rank: int, reason: str = "declared-dead") -> int:
+    """Explicitly declare one rank dead (operator/coordinator decision):
+    records the transition, bumps the epoch, and makes
+    :func:`surviving_members` report the reduced cohort. Idempotent per
+    rank. Returns the (possibly bumped) epoch."""
+    _declare_dead([rank], reason)
+    return _membership.epoch
+
+
+def rejoin_rank(rank: int) -> int:
+    """Re-admit a (restarted) rank: clears its dead mark and suspicion,
+    bumps the epoch — in-flight stale protocols fence — and returns the new
+    epoch. Every process must apply the same transition (the rejoiner via
+    ``MetricCollection.rejoin``; survivors via their coordinator watch) so
+    the fleet re-enters the same epoch."""
+    m = _membership
+    r = int(rank)
+    m.dead.discard(r)
+    rec = m.peers.setdefault(r, {"timeouts": 0})
+    rec["state"] = "live"
+    rec["timeouts"] = 0
+    _bump("sync_rank_rejoins")
+    if _telemetry.armed:
+        _telemetry.emit("peer-rejoin", None, "sync", attrs={"rank": r})
+    rec["rejoined_epoch"] = bump_epoch("rejoin", rank=r)
+    return m.epoch
+
+
+def is_full_world_group(group: Optional[Any]) -> bool:
+    """Whether a host-path process group covers the whole (known) world —
+    the line between a real full-world sync (which stamps the owner's
+    ``last_good_sync_step`` health marker and clears degradation onsets)
+    and a group-scoped one (e.g. the quorum tier's surviving-subgroup
+    merge), which must NOT report fresh full-world health while served
+    values still exclude dead ranks."""
+    if group is None:
+        return True
+    try:
+        members = sorted(int(r) for r in group)
+    except (TypeError, ValueError):
+        return False
+    return members == list(range(effective_world_size()))
+
+
+def surviving_members() -> Optional[List[int]]:
+    """The surviving cohort as a host-path process group, or ``None`` when
+    the full world is intact (or the world size is unknown — quorum needs to
+    know who it is quorate over). This is what the ``quorum`` degraded tier
+    scopes its group-gather to; the re-formed transport's rows are the
+    survivors in ascending rank order (a production redeploy renumbers
+    processes on re-init, which makes the prefix mapping true by
+    construction)."""
+    m = _membership
+    world = m.known_world
+    if not m.dead or not world:
+        return None
+    alive = [r for r in range(world) if r not in m.dead]
+    return alive or None
+
+
+def note_sync_timeout(site: str) -> None:
+    """Fold one watchdog timeout into the membership registry (called by
+    :func:`run_with_deadline` when the deadline fires). Suspicion is
+    cohort-wide — a host collective cannot attribute the hang — until the
+    K-th consecutive timeout at one epoch consults the peer prober, which
+    may declare peers dead (bumping the epoch)."""
+    m = _membership
+    m.consecutive_timeouts += 1
+    if m.known_world:
+        for r in range(m.known_world):
+            if r not in m.dead:
+                m.peers.setdefault(r, {"timeouts": 0, "state": "live"})["timeouts"] += 1
+    if m.consecutive_timeouts < sync_dead_after() or _peer_prober is None:
+        return
+    try:
+        suspects = list(_peer_prober() or ())
+    except Exception:  # noqa: BLE001 — a broken prober must not mask the timeout
+        return
+    _declare_dead(suspects, reason=f"prober after {m.consecutive_timeouts} timeouts at {site}")
+
+
+def note_sync_success(world: Optional[int] = None, members: Optional[List[int]] = None) -> None:
+    """Record one completed collective protocol. Any success clears the
+    consecutive-timeout suspicion; a FULL-world success (``members`` is
+    None) additionally clears the world-degraded flag and stamps the
+    registry's ``last_good_sync_step``; a multi-row gather teaches the
+    registry the world size."""
+    m = _membership
+    m.consecutive_timeouts = 0
+    if members is not None:
+        # a group-scoped success (e.g. a quorum sync over the survivors)
+        # clears suspicion only: the re-formed transport's row count is the
+        # SUBGROUP, not the world — learning it would shrink the world
+        return
+    if world is not None and world > 1:
+        m.observed_world = int(world)
+    m.world_degraded = False
+    from metrics_tpu.ops import faults as _faults
+
+    m.last_good_sync_step = _faults.current_step()
+    for rec in m.peers.values():
+        if rec.get("state", "live") == "live":
+            rec["timeouts"] = 0
+            rec["last_good_epoch"] = m.epoch
+
+
+def note_degraded_serve(kind: str = "local") -> None:
+    """Count one degraded compute serve (``local`` or ``quorum``) and mark
+    the world degraded until the next completed full-world sync."""
+    _bump("sync_quorum_serves" if kind == "quorum" else "sync_degraded_serves")
+    _membership.world_degraded = True
+
+
+def world_health() -> Dict[str, Any]:
+    """The world-membership health surface: epoch, declared-dead ranks, the
+    surviving cohort, cohort-wide timeout suspicion, per-peer outcome
+    records, and the bounded transition log. Folded into
+    ``telemetry_snapshot()['sync_health']`` (and thence the Prometheus
+    exposition); ``Metric.sync_health()`` carries the per-owner staleness
+    view on top of this global one.
+
+    Example:
+        >>> from metrics_tpu.parallel.sync import world_health
+        >>> h = world_health()
+        >>> isinstance(h["epoch"], int) and h["epoch"] >= 1
+        True
+        >>> sorted(h)[:3]
+        ['consecutive_timeouts', 'dead_after', 'dead_ranks']
+    """
+    m = _membership
+    return {
+        "epoch": m.epoch,
+        "expected_world": m.expected_world,
+        "observed_world": m.observed_world,
+        "live_world": world_size(),
+        "dead_ranks": sorted(m.dead),
+        "surviving_ranks": surviving_members(),
+        "consecutive_timeouts": m.consecutive_timeouts,
+        "dead_after": sync_dead_after(),
+        "degraded": bool(m.dead) or m.world_degraded,
+        "last_good_sync_step": m.last_good_sync_step,
+        "peers": {r: dict(rec) for r, rec in sorted(m.peers.items())},
+        "transitions": list(m.transitions),
+    }
+
+
+def reset_membership() -> None:
+    """Clear membership state (dead set, suspicion, peer records, expected
+    world) for tests and chaos scenarios. The epoch stays monotonic — like
+    the fault step index, a reset must never make a stale fence look
+    current."""
+    m = _membership
+    m.dead.clear()
+    m.peers.clear()
+    m.expected_world = None
+    m.observed_world = None
+    m.consecutive_timeouts = 0
+    m.last_good_sync_step = None
+    m.world_degraded = False
+    m.transitions.clear()
+    global _peer_prober
+    _peer_prober = None
 
 
 # ----------------------------------------------------------- collective audit
@@ -320,14 +679,28 @@ _counters: dict = {
     "sync_pack_fallbacks": 0,
     "sync_deadline_timeouts": 0,
     "sync_degraded_serves": 0,
+    "sync_quorum_serves": 0,
+    "sync_epoch_bumps": 0,
+    "sync_epoch_fence_trips": 0,
+    "sync_stale_collectives": 0,
+    "sync_peers_declared_dead": 0,
+    "sync_rank_rejoins": 0,
 }
 
 
-def note_collective(kind: str, nbytes: int = 0) -> None:
-    """Count one protocol collective slot (``kind``: "shape" | "payload")."""
+def note_collective(kind: str, nbytes: int = 0, epoch: Optional[int] = None) -> None:
+    """Count one protocol collective slot (``kind``: "shape" | "payload").
+
+    ``epoch`` is the issuing protocol's epoch fence stamp; a collective noted
+    at a stale epoch counts in ``sync_stale_collectives`` — the audit
+    backstop behind the fence (the fence raises *before* issue, so this
+    counter staying 0 is the certified invariant; a nonzero value means a
+    transport bypassed the fence)."""
     _counters[f"sync_{kind}_collectives"] += 1
     if nbytes:
         _counters["sync_bytes_gathered"] += int(nbytes)
+    if epoch is not None and epoch != _membership.epoch:
+        _counters["sync_stale_collectives"] += 1
 
 
 def _bump(name: str, n: int = 1) -> None:
@@ -426,8 +799,12 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
     from metrics_tpu.ops import faults as _faults
 
     members = validate_group_live(group)
+    # epoch fence: the protocol pairs with the cohort that existed NOW; a
+    # membership change before any (re)issued collective trips check_epoch
+    fence = world_epoch()
 
     def _attempt() -> List[jax.Array]:
+        check_epoch(fence, site="sync-gather")
         # "sync-gather" fault site: before the exchange, so an injected
         # SyncFault exercises the retry ladder and the callers' restore paths
         if _faults.armed:
@@ -438,9 +815,11 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
         # retry/snapshot-restore lane as any other transport fault
         return run_with_deadline(lambda: _gather_once(result, members), site="sync-gather")
 
-    return _faults.retry_with_backoff(
+    out = _faults.retry_with_backoff(
         _attempt, attempts=sync_retries(), base_delay_s=sync_backoff_s(), site="sync-gather"
     )
+    note_sync_success(world=len(out) if members is None else None, members=members)
+    return out
 
 
 def reduce(x: jax.Array, reduction: str) -> jax.Array:
@@ -480,16 +859,31 @@ def class_reduce(
 __all__ = [
     "distributed_available",
     "world_size",
+    "effective_world_size",
     "gather_all_tensors",
     "validate_group_live",
     "sync_retries",
     "sync_backoff_s",
     "sync_deadline_s",
+    "sync_dead_after",
     "sync_degraded_tier",
     "run_with_deadline",
     "note_collective",
     "collective_stats",
     "reset_collective_stats",
+    "world_epoch",
+    "bump_epoch",
+    "check_epoch",
+    "mark_peer_dead",
+    "rejoin_rank",
+    "surviving_members",
+    "set_peer_prober",
+    "set_expected_world",
+    "note_sync_timeout",
+    "note_sync_success",
+    "note_degraded_serve",
+    "world_health",
+    "reset_membership",
     "reduce",
     "class_reduce",
 ]

@@ -106,6 +106,18 @@ class SyncTimeoutFault(SyncFault):
     the degraded-compute ladder may recover."""
 
 
+class EpochFault(SyncFault):
+    """A collective was attempted under a **stale world epoch**: membership
+    changed (a peer was declared dead, or a rank rejoined) between the moment
+    the sync protocol captured its epoch fence and the collective being
+    issued. Raised by the fence *instead of pairing with the wrong cohort*
+    (or hanging against a dead peer) — local state is intact and the sync is
+    retryable at the current epoch, so the degraded-compute tier may catch it
+    like any transport fault. Never retried inside one protocol attempt: the
+    stale cohort can never pair, so the retry ladder re-raises it
+    immediately (the caller re-enters at the current epoch instead)."""
+
+
 class JournalFault(FaultError):
     """State-journal failure: a record could not be written, or a stored
     record is torn / checksum-failed / layout-incompatible on load. Load
@@ -119,6 +131,7 @@ __all__ = [
     "FAULT_DOMAINS",
     "CompileFault",
     "DonationFault",
+    "EpochFault",
     "FaultError",
     "HostOffloadFault",
     "JournalFault",

@@ -78,6 +78,66 @@ class TestRoundTrip:
         _equal_values(fresh.compute(), live.compute())
         assert fresh.update_count == live.update_count
 
+    def test_unknown_extra_manifest_keys_round_trip(self, tmp_path):
+        """Forward compatibility: a NEWER writer may stamp manifest keys this
+        reader does not know (the world-membership epoch stamps are the
+        first); decode must tolerate them — while still rejecting magic /
+        version / CRC corruption and a manifest missing its entries table."""
+        import json
+        import struct
+        import zlib
+
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([2.0, 4.0]))
+        nodes = [m]
+        extra = {"epoch": 7, "barrier_step": 41, "from_the_future": {"list": [1, 2]}}
+        record = journal_mod.pack_record(nodes, manifest_extra=extra)
+        manifest, payload = journal_mod.decode_record(record)
+        for key, value in extra.items():
+            assert manifest[key] == value
+        # reserved structural keys cannot be shadowed by extras
+        shadowing = journal_mod.pack_record(nodes, manifest_extra={"entries": [], "epoch": 1})
+        manifest2, _ = journal_mod.decode_record(shadowing)
+        assert manifest2["entries"], "manifest_extra must not override the entries table"
+        # the extra-stamped record restores bit-exactly
+        fresh = mt.MeanMetric()
+        journal_mod.restore_nodes([fresh], manifest, payload)
+        np.testing.assert_array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+        # ...and corruption is still rejected: flip one manifest byte
+        torn = bytearray(record)
+        torn[journal_mod._HEADER.size + 2] ^= 0xFF
+        with pytest.raises(JournalFault, match="checksum"):
+            journal_mod.decode_record(bytes(torn))
+        # a CRC-valid record whose manifest lacks the entries table is corrupt
+        mbytes = json.dumps({"only": "stamps"}).encode()
+        header = journal_mod._HEADER.pack(
+            journal_mod._MAGIC, journal_mod._VERSION, len(mbytes), 0, zlib.crc32(mbytes), zlib.crc32(b"")
+        )
+        with pytest.raises(JournalFault, match="entries"):
+            journal_mod.decode_record(header + mbytes)
+        # version skew still rejects (forward-compat is manifest-level only)
+        skewed = struct.pack("<I", 99)
+        with pytest.raises(JournalFault, match="version"):
+            journal_mod.decode_record(record[:4] + skewed + record[8:])
+
+    def test_save_state_stamps_world_meta(self, tmp_path):
+        """Every save stamps the membership meta (epoch, last-good sync step,
+        monotonic step) — what rejoin compares against a survivor handoff."""
+        from metrics_tpu.parallel import sync as psync
+
+        path = str(tmp_path / "meta.journal")
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([1.0]))
+        m.save_state(path)
+        manifest, _ = journal_mod.read_record(path)
+        assert manifest["epoch"] == psync.world_epoch()
+        assert manifest["monotonic_step"] == faults.current_step()
+        fresh = mt.MeanMetric()
+        fresh.load_state(path)
+        meta = journal_mod.restored_meta(fresh)
+        assert meta["epoch"] == manifest["epoch"]
+        assert meta["monotonic_step"] == manifest["monotonic_step"]
+
     @pytest.mark.parametrize("family", sorted(FAMILIES))
     def test_save_crash_load_replay_equals_uninterrupted_oracle(self, family, tmp_path):
         """The acceptance walk: save mid-stream, 'crash' (fresh instance),

@@ -256,7 +256,13 @@ def test_snapshot_schema_superset_and_stable():
     health = snap["sync_health"]
     assert set(health) == {
         "monotonic_step",
+        "degraded",
+        "epoch",
+        "dead_ranks",
+        "consecutive_timeouts",
+        "last_good_sync_step",
         "sync_degraded_serves",
+        "sync_quorum_serves",
         "sync_deadline_timeouts",
         "fault_domain_counts",
     }
@@ -283,6 +289,36 @@ def test_prometheus_text_well_formed():
     # a multi-MiB byte counter off by thousands
     big = mt.prometheus_text({"sync_bytes_gathered": 16777217})
     assert "metrics_tpu_sync_bytes_gathered 16777217" in big.splitlines()[-1]
+
+
+def test_prometheus_exports_sync_health_as_typed_gauges():
+    """The one monitoring surface must actually export HEALTH, not just raw
+    event counters: the flattened sync_health block (degraded flag, epoch,
+    last-good sync step, per-domain fault counts) scrapes as typed GAUGES —
+    state that can fall must never carry counter semantics."""
+    from metrics_tpu.ops import faults
+    from metrics_tpu.parallel import sync as psync
+
+    faults.note_fault("sync", site="sync-gather")
+    text = mt.prometheus_text()
+    for gauge in (
+        "metrics_tpu_sync_health_degraded",
+        "metrics_tpu_sync_health_epoch",
+        "metrics_tpu_sync_health_dead_ranks",
+        "metrics_tpu_sync_health_consecutive_timeouts",
+        "metrics_tpu_sync_health_last_good_sync_step",
+        "metrics_tpu_sync_health_fault_domain_counts_sync",
+    ):
+        assert f"# TYPE {gauge} gauge" in text, f"{gauge} missing or mistyped"
+    # the epoch gauge tracks the live registry
+    line = next(ln for ln in text.splitlines() if ln.startswith("metrics_tpu_sync_health_epoch "))
+    assert int(float(line.split()[1])) == psync.world_epoch()
+    # never-synced renders the -1 sentinel rather than dropping the sample
+    snap = mt.telemetry_snapshot()
+    assert isinstance(snap["sync_health"]["last_good_sync_step"], int)
+    # membership event counters (outside the health block) stay counters
+    assert "# TYPE metrics_tpu_sync_epoch_bumps counter" in text
+    assert "# TYPE metrics_tpu_sync_quorum_serves counter" in text
 
 
 def test_program_report_ledger():

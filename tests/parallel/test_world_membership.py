@@ -1,0 +1,586 @@
+"""Elastic world membership: epoch fencing, surviving-quorum compute, rejoin.
+
+The membership layer must make every world transition safe for the sync
+protocol:
+
+- **Epoch fence** — a membership change between a protocol's entry and any
+  (re)issued collective raises the classified ``EpochFault`` with local
+  state intact, instead of pairing a collective with the wrong cohort (the
+  ``sync_stale_collectives`` audit counter stays 0 — the certified
+  invariant).
+- **Surviving quorum** — with ``METRICS_TPU_SYNC_DEGRADED=quorum`` and a
+  declared-dead peer, ``compute()`` aggregates over the surviving subgroup
+  BIT-EXACTLY vs the ``_FakeGather`` rank-walk oracle over the survivors,
+  then promotes back to the full world once the dead rank rejoins.
+- **Rejoin + barrier** — a restarted rank restores its journal (or a
+  survivor's handoff record), enters the next epoch, and the post-rejoin
+  full-world sync is bit-exact vs an uninterrupted run;
+  ``checkpoint_barrier`` stamps one agreed step + the epoch into every
+  manifest.
+
+The multi-process world is the same transport-hook fake world the
+coalesced-sync suite certifies against, extended with a "kill switch": the
+full-world transport hangs while the dead rank is undeclared and the
+re-formed survivor transport works.
+"""
+from __future__ import annotations
+
+import copy
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+import metrics_tpu.metric as metric_mod
+from metrics_tpu.ops import engine, faults
+from metrics_tpu.parallel import bucketing
+from metrics_tpu.parallel import sync as psync
+from metrics_tpu.utils.exceptions import EpochFault, SyncFault, SyncTimeoutFault
+from tests.helpers.testers import _FakeGather
+from tests.parallel.test_coalesced_sync import DIST_ON, _install_world
+
+DEADLINE_MS = "150"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_membership(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+    psync.reset_membership()
+    yield
+    psync.reset_membership()
+    faults.set_recovery_policy(steps=8)
+
+
+class _ElasticWorld:
+    """Kill-switch controller for the fake transport: ``revive()`` models the
+    dead rank's process restarting (its transport becomes reachable again)."""
+
+    def __init__(self):
+        self.killed = True
+
+    def revive(self):
+        self.killed = False
+
+
+def _install_elastic_world(monkeypatch, rank_node_lists, dead_rank):
+    """A 3-rank fake world with a killed rank: while the killed peer is
+    UNDECLARED the full-world collective hangs (the dead peer never shows
+    up); once the membership registry declares it dead, the transport models
+    the re-formed surviving world — rows for the survivors only, in
+    ascending rank order; after ``revive()`` (the restarted process is
+    back), the full world answers again."""
+    world = _ElasticWorld()
+
+    def _pack(nodes):
+        for n in nodes:
+            n._canonicalize_list_states()
+        entries, values = bucketing._collect(nodes)
+        packed, vec = bucketing._pack(entries, values)
+        return packed, vec
+
+    def _rows():
+        if not world.killed:
+            return [nodes for r, nodes in enumerate(rank_node_lists) if r != 0]
+        alive = psync.surviving_members()
+        if alive is None:
+            return None  # full world requested, dead peer undeclared: hang
+        return [rank_node_lists[r] for r in alive if r != 0]
+
+    def host(vec):
+        rows = _rows()
+        if rows is None:
+            time.sleep(1.0)
+            raise RuntimeError("abandoned hung metadata exchange")
+        return np.stack([np.asarray(vec)] + [np.asarray(_pack(nodes)[1]) for nodes in rows])
+
+    def payload(x):
+        rows = _rows()
+        if rows is None:
+            time.sleep(1.0)
+            raise RuntimeError("abandoned hung collective (dead peer)")
+        pad_to = int(x.shape[0])
+        packs = [_pack(nodes)[0] for nodes in rows]
+        return jnp.stack([x] + [jnp.pad(p, (0, pad_to - int(p.shape[0]))) for p in packs])
+
+    monkeypatch.setattr(bucketing, "_host_allgather", host)
+    monkeypatch.setattr(bucketing, "_payload_allgather", payload)
+    return world
+
+
+class TestEpochRegistry:
+    def test_bump_is_monotonic_and_counted(self):
+        s0 = engine.engine_stats()["sync_epoch_bumps"]
+        e0 = psync.world_epoch()
+        e1 = psync.bump_epoch("test-transition")
+        assert e1 == e0 + 1 == psync.world_epoch()
+        assert engine.engine_stats()["sync_epoch_bumps"] == s0 + 1
+        assert psync.world_health()["transitions"][-1]["reason"] == "test-transition"
+
+    def test_stale_fence_raises_classified_epoch_fault(self):
+        fence = psync.world_epoch()
+        psync.check_epoch(fence)  # current epoch passes silently
+        psync.bump_epoch("membership-change")
+        t0 = engine.engine_stats()["sync_epoch_fence_trips"]
+        with pytest.raises(EpochFault) as err:
+            psync.check_epoch(fence, site="sync-gather")
+        assert err.value.site == "epoch-fence"
+        assert isinstance(err.value, SyncFault)  # degradable, sync domain
+        assert faults.classify(err.value) == "sync"
+        stats = engine.engine_stats()
+        assert stats["sync_epoch_fence_trips"] == t0 + 1
+        assert stats["failure_log"][-1]["site"] == "epoch-fence"
+
+    def test_epoch_fault_is_never_retried(self):
+        """retry_with_backoff must re-raise an EpochFault immediately — a
+        re-issued collective at a stale epoch can never pair."""
+        calls = {"n": 0}
+
+        def fenced():
+            calls["n"] += 1
+            raise EpochFault("stale", site="epoch-fence")
+
+        with pytest.raises(EpochFault):
+            faults.retry_with_backoff(fenced, attempts=5, base_delay_s=0.0)
+        assert calls["n"] == 1
+
+    def test_injection_site_fires_classified(self):
+        with faults.inject_faults("epoch-fence", count=1) as plan:
+            with pytest.raises(EpochFault):
+                psync.check_epoch(psync.world_epoch())
+        assert plan.fired == 1
+
+    def test_mid_sync_membership_change_fences_the_retry(self, monkeypatch):
+        """The chaos shape: the first transport attempt fails transiently AND
+        the membership epoch bumps (a peer died mid-protocol); the retry must
+        trip the fence — classified EpochFault, local state intact and
+        retryable at the new epoch, zero stale collectives issued."""
+        monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "1")
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([2.0, 4.0]))
+        before = {k: np.asarray(v) for k, v in m.metric_state.items()}
+        calls = {"n": 0}
+
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                psync.bump_epoch("peer-died-mid-sync")
+                raise RuntimeError("transport reset by membership change")
+            return x[None]
+
+        monkeypatch.setattr(bucketing, "_payload_allgather", flaky)
+        s0 = engine.engine_stats()
+        with pytest.raises(EpochFault):
+            m.sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        assert s1["sync_epoch_fence_trips"] - s0["sync_epoch_fence_trips"] == 1
+        assert s1["sync_stale_collectives"] == s0["sync_stale_collectives"] == 0
+        assert calls["n"] == 1  # the stale retry never reached the transport
+        assert not m._is_synced
+        after = {k: np.asarray(v) for k, v in m.metric_state.items()}
+        for k in before:
+            np.testing.assert_array_equal(after[k], before[k])
+        # re-entering at the current epoch succeeds
+        m.sync(distributed_available=DIST_ON)
+        m.unsync()
+        np.testing.assert_allclose(float(m.compute()), 3.0)
+
+
+class TestPeerHealth:
+    def test_timeouts_fold_into_suspicion_and_success_clears(self):
+        psync.set_expected_world(3)
+        psync.note_sync_timeout("sync-gather")
+        psync.note_sync_timeout("sync-gather")
+        h = psync.world_health()
+        assert h["consecutive_timeouts"] == 2
+        assert h["peers"][1]["timeouts"] == 2  # anonymous: cohort-wide
+        psync.note_sync_success(world=3)
+        h = psync.world_health()
+        assert h["consecutive_timeouts"] == 0
+        assert h["peers"][1]["timeouts"] == 0
+        assert h["last_good_sync_step"] is not None
+        assert h["observed_world"] == 3
+
+    def test_kth_timeout_consults_prober_and_declares_dead(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEAD_AFTER", "2")
+        psync.set_expected_world(3)
+        psync.set_peer_prober(lambda: [2])
+        s0 = engine.engine_stats()
+        e0 = psync.world_epoch()
+        psync.note_sync_timeout("sync-gather")
+        assert psync.world_health()["dead_ranks"] == []  # below the threshold
+        psync.note_sync_timeout("sync-gather")
+        h = psync.world_health()
+        assert h["dead_ranks"] == [2]
+        assert h["surviving_ranks"] == [0, 1]
+        assert h["degraded"]
+        assert psync.world_epoch() == e0 + 1
+        s1 = engine.engine_stats()
+        assert s1["sync_peers_declared_dead"] - s0["sync_peers_declared_dead"] == 1
+        assert h["peers"][2]["state"] == "dead"
+
+    def test_no_prober_means_no_membership_change(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEAD_AFTER", "1")
+        psync.set_expected_world(2)
+        e0 = psync.world_epoch()
+        psync.note_sync_timeout("sync-gather")
+        psync.note_sync_timeout("sync-gather")
+        assert psync.world_health()["dead_ranks"] == []
+        assert psync.world_epoch() == e0
+
+    def test_rejoin_clears_dead_mark_and_bumps_epoch(self):
+        psync.set_expected_world(2)
+        psync.mark_peer_dead(1, reason="operator")
+        e_dead = psync.world_epoch()
+        s0 = engine.engine_stats()["sync_rank_rejoins"]
+        e_new = psync.rejoin_rank(1)
+        assert e_new == e_dead + 1
+        h = psync.world_health()
+        assert h["dead_ranks"] == [] and h["surviving_ranks"] is None
+        assert h["peers"][1]["state"] == "live"
+        assert engine.engine_stats()["sync_rank_rejoins"] == s0 + 1
+
+
+class TestQuorumCompute:
+    def _three_rank_metrics(self):
+        ranks = []
+        for r in range(3):
+            m = mt.MeanMetric()
+            m.update(jnp.asarray([1.0 + 2 * r, 3.0 + 2 * r]))  # distinguishable per rank
+            ranks.append(m)
+        return ranks
+
+    def test_quorum_merge_bit_exact_vs_survivor_oracle(self, monkeypatch):
+        """A dead rank 2: K timeouts auto-declare it, the epoch bumps, and
+        METRICS_TPU_SYNC_DEGRADED=quorum computes the merge over ranks {0,1}
+        bit-exactly vs the _FakeGather rank-walk oracle over the survivors —
+        then the rejoin promotes back to the bit-exact full-world sync."""
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "quorum")
+        monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "1")
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEAD_AFTER", "2")
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        faults.set_recovery_policy(steps=1)
+        ranks = self._three_rank_metrics()
+        trees = [bucketing.tree_nodes(m) for m in ranks]
+
+        # oracles: the per-state rank walk over the survivors and full world
+        surv_copies = [copy.deepcopy(ranks[r]) for r in (0, 1)]
+        surv_copies[0].sync(dist_sync_fn=_FakeGather(surv_copies), distributed_available=DIST_ON)
+        quorum_oracle = np.asarray(surv_copies[0].compute())
+        full_copies = [copy.deepcopy(m) for m in ranks]
+        full_copies[0].sync(dist_sync_fn=_FakeGather(full_copies), distributed_available=DIST_ON)
+        full_oracle = np.asarray(full_copies[0].compute())
+
+        psync.set_expected_world(3)
+        psync.set_peer_prober(lambda: [2])
+        world = _install_elastic_world(monkeypatch, trees, dead_rank=2)
+        m = ranks[0]
+        s0 = engine.engine_stats()
+        with pytest.warns(UserWarning, match="QUORUM"):
+            got = np.asarray(m.compute())
+        # retries=1 and DEAD_AFTER=2: the 2nd timeout declared rank 2 dead,
+        # the epoch bumped, and the degraded handler aggregated over {0, 1}
+        np.testing.assert_array_equal(got, quorum_oracle)
+        assert not np.array_equal(got, full_oracle)  # genuinely a subgroup merge
+        s1 = engine.engine_stats()
+        assert s1["sync_quorum_serves"] - s0["sync_quorum_serves"] == 1
+        assert s1["sync_stale_collectives"] == 0
+        health = m.sync_health()
+        assert health["degraded"] and health["degraded_tier"] == "quorum"
+        assert health["quorum_serves"] == 1
+        # the subgroup merge must NOT report fresh full-world health: no
+        # last-good stamp, the degradation onset stays visible
+        assert health["last_good_sync_step"] is None
+        assert health["degraded_since_step"] is not None
+        assert psync.world_health()["dead_ranks"] == [2]
+        # local accumulators stay intact and retryable under the hood
+        np.testing.assert_allclose(float(np.asarray(m.value)), 4.0)
+
+        # rank 2 rejoins (its restarted process is reachable again); the
+        # recovery edge (steps=1) re-probes the FULL world on the next
+        # compute — bit-exact vs the uninterrupted oracle
+        world.revive()
+        psync.rejoin_rank(2)
+        m._computed = None
+        got2 = np.asarray(m.compute())
+        np.testing.assert_array_equal(got2, full_oracle)
+        health = m.sync_health()
+        assert not health["degraded"]
+        # the full-world re-probe IS the last-good marker and clears the onset
+        assert health["last_good_sync_step"] is not None
+        assert health["degraded_since_step"] is None
+        assert engine.engine_stats()["sync_stale_collectives"] == 0
+
+    def test_quorum_without_known_survivors_serves_local(self, monkeypatch):
+        """quorum tier with no declared-dead peers behaves exactly like the
+        local tier: no subgroup is known, so the degraded serve is local."""
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "quorum")
+        monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "0")
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([2.0, 4.0]))
+
+        def hung(x):
+            time.sleep(1.0)
+            raise RuntimeError("abandoned hung collective")
+
+        monkeypatch.setattr(bucketing, "_payload_allgather", hung)
+        s0 = engine.engine_stats()
+        with pytest.warns(UserWarning, match="QUORUM"):
+            v = m.compute()
+        np.testing.assert_allclose(float(v), 3.0)  # the local value
+        s1 = engine.engine_stats()
+        assert s1["sync_degraded_serves"] - s0["sync_degraded_serves"] == 1
+        assert s1["sync_quorum_serves"] == s0["sync_quorum_serves"]
+
+    def test_suite_quorum_serve_and_sync_health(self, monkeypatch):
+        """Suite-level: the whole collection aggregates over the surviving
+        subgroup as one coalesced group-scoped sync."""
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "quorum")
+        monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "1")
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEAD_AFTER", "2")
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        faults.set_recovery_policy(steps=1)
+
+        def make(r):
+            c = mt.MetricCollection({"mean": mt.MeanMetric(), "sum": mt.SumMetric()})
+            c.update(jnp.asarray([1.0 + 2 * r, 3.0 + 2 * r]))
+            return c
+
+        rank_colls = [make(r) for r in range(3)]
+        trees = [
+            [
+                n
+                for _, mm in c.items(keep_base=True, copy_state=False)
+                for n in bucketing.tree_nodes(mm)
+            ]
+            for c in rank_colls
+        ]
+        # survivor oracle, member-wise per-state rank walk over ranks {0, 1}
+        oracle = [copy.deepcopy(rank_colls[r]) for r in (0, 1)]
+        for name, m0 in oracle[0].items(keep_base=True, copy_state=False):
+            m0.sync(dist_sync_fn=_FakeGather([oc[name] for oc in oracle]), distributed_available=DIST_ON)
+        oracle_vals = {k: np.asarray(v) for k, v in oracle[0].compute().items()}
+
+        psync.set_expected_world(3)
+        psync.set_peer_prober(lambda: [2])
+        _install_elastic_world(monkeypatch, trees, dead_rank=2)
+        suite = rank_colls[0]
+        with pytest.warns(UserWarning, match="QUORUM"):
+            got = {k: np.asarray(v) for k, v in suite.compute().items()}
+        for k in oracle_vals:
+            np.testing.assert_array_equal(got[k], oracle_vals[k])
+        health = suite.sync_health()
+        assert health["degraded"] and health["quorum_serves"] == 1
+        assert health["world"]["dead_ranks"] == [2]
+        assert health["world"]["surviving_ranks"] == [0, 1]
+        # every member is unsynced after the serve: retryable
+        for _, mm in suite.items(keep_base=True, copy_state=False):
+            assert not mm._is_synced
+
+
+class TestMixedHealthSuite:
+    def test_subset_degraded_members_aggregate_and_order_vs_failure_log(self, monkeypatch):
+        """sync_health() when a STRICT SUBSET of members is degraded: the
+        suite flag folds member-wise, the healthy member stays clean, and
+        the degradation onset orders against the failure_log's monotonic
+        steps."""
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "local")
+        coll = mt.MetricCollection({"mean": mt.MeanMetric(), "sum": mt.SumMetric()})
+        coll.update(jnp.asarray([2.0, 4.0]))
+        # demote ONLY the "mean" member's sync-degrade lane, via the real
+        # entry path (fault counted at a raise site first, like sync does)
+        exc = SyncTimeoutFault("peer hung", site="sync-gather")
+        faults.note_fault("sync", site="sync-gather", owner=coll["mean"], error=exc)
+        fault_step = engine.engine_stats()["failure_log"][-1]["step"]
+        with pytest.warns(UserWarning, match="LOCAL-ONLY"):
+            metric_mod._enter_degraded(coll["mean"], exc, "local")
+
+        health = coll.sync_health()
+        assert health["degraded"] is True  # folded from the member
+        members = health["members"]
+        assert members["mean"]["degraded"] is True
+        assert members["sum"]["degraded"] is False
+        assert members["sum"]["degraded_since_step"] is None
+        # ordering: the onset stamp is at-or-after the classified fault that
+        # caused it, on the SAME monotonic axis as the failure_log ring
+        onset = members["mean"]["degraded_since_step"]
+        assert onset is not None and onset >= fault_step
+        assert faults.current_step() >= onset
+        # a completed suite sync stamps last_good AFTER the onset and clears it
+        coll.sync(distributed_available=DIST_ON)
+        coll.unsync()
+        health = coll.sync_health()
+        assert health["last_good_sync_step"] > onset
+        assert members["mean"]["degraded_since_step"] is not None  # old dict
+        assert coll.sync_health()["members"]["mean"]["degraded_since_step"] is None
+
+    def test_member_counts_fold_from_failure_log_domains(self):
+        coll = mt.MetricCollection({"mean": mt.MeanMetric()})
+        coll.update(jnp.asarray([1.0]))
+        faults.note_fault("sync", site="sync-gather")
+        faults.note_fault("journal", site="journal-load")
+        counts = coll.sync_health()["members"]["mean"]["fault_domain_counts"]
+        assert counts.get("sync", 0) >= 1 and counts.get("journal", 0) >= 1
+
+
+class TestBarrierAndRejoin:
+    def test_checkpoint_barrier_stamps_epoch_and_agreed_step(self, tmp_path):
+        from metrics_tpu.ops import journal
+
+        path = str(tmp_path / "suite.journal")
+        coll = mt.MetricCollection({"mean": mt.MeanMetric()})
+        coll.update(jnp.asarray([1.0, 3.0]))
+        info = coll.checkpoint_barrier(path)
+        assert info["epoch"] == psync.world_epoch()
+        assert info["world_size"] == 1 and info["bytes"] > 0
+        manifest, _ = journal.read_record(path)
+        assert manifest["epoch"] == info["epoch"]
+        assert manifest["barrier_step"] == info["barrier_step"]
+        assert manifest["barrier"] is True
+        # a second barrier agrees a strictly newer step (monotonic axis)
+        coll.update(jnp.asarray([5.0]))
+        info2 = coll.checkpoint_barrier(path)
+        assert info2["barrier_step"] >= info["barrier_step"]
+
+    def test_barrier_fences_on_mid_exchange_epoch_bump(self, tmp_path, monkeypatch):
+        coll = mt.MetricCollection({"mean": mt.MeanMetric()})
+        coll.update(jnp.asarray([1.0]))
+
+        def bumping_exchange(vec):
+            psync.bump_epoch("peer-died-mid-barrier")
+            return np.asarray(vec)[None]
+
+        monkeypatch.setattr(bucketing, "_host_allgather", bumping_exchange)
+        with pytest.raises(EpochFault):
+            coll.checkpoint_barrier(str(tmp_path / "j"))
+
+    def test_rejoin_restores_journal_and_enters_next_epoch(self, tmp_path):
+        path = str(tmp_path / "rank2.journal")
+        live = mt.MetricCollection({"mean": mt.MeanMetric()})
+        live.update(jnp.asarray([2.0, 4.0]))
+        live.save_state(path)
+        oracle = {k: np.asarray(v) for k, v in live.compute().items()}
+
+        psync.set_expected_world(3)
+        psync.mark_peer_dead(2, reason="crash")
+        e_dead = psync.world_epoch()
+        restored = mt.MetricCollection({"mean": mt.MeanMetric()})
+        out = restored.rejoin(path, rank=2)
+        assert out["generation"] == 0 and out["handoff"] is False
+        assert out["epoch"] == e_dead + 1 == psync.world_epoch()
+        assert psync.world_health()["dead_ranks"] == []
+        got = {k: np.asarray(v) for k, v in restored.compute().items()}
+        for k in oracle:
+            np.testing.assert_array_equal(got[k], oracle[k])
+
+    def test_rejoin_handoff_fast_forwards_to_newer_record(self, tmp_path):
+        """A survivor hands the rejoiner a NEWER record (by barrier_step):
+        one bucketed state handoff wins over the stale local generation."""
+        from metrics_tpu.ops import journal
+
+        path = str(tmp_path / "rank1.journal")
+        live = mt.MetricCollection({"mean": mt.MeanMetric()})
+        live.update(jnp.asarray([2.0, 4.0]))
+        live.checkpoint_barrier(path)  # the stale local generation
+        live.update(jnp.asarray([9.0]))
+        # the survivor's copy of the NEWER barrier record (shared storage)
+        newer = journal.pack_record(
+            live._journal_nodes(),
+            manifest_extra={
+                "epoch": psync.world_epoch(),
+                "barrier_step": faults.tick(),
+                "nodes": None,  # reserved keys cannot be overridden
+            },
+        )
+        oracle = {k: np.asarray(v) for k, v in live.compute().items()}
+
+        handoffs = []
+
+        def handoff(meta):
+            handoffs.append(meta)
+            return newer
+
+        restored = mt.MetricCollection({"mean": mt.MeanMetric()})
+        out = restored.rejoin(path, handoff=handoff, rank=1)
+        assert out["handoff"] is True
+        assert handoffs and "barrier_step" in handoffs[0]
+        got = {k: np.asarray(v) for k, v in restored.compute().items()}
+        for k in oracle:
+            np.testing.assert_array_equal(got[k], oracle[k])
+
+    def test_rejoin_handoff_corrupt_record_demotes_to_local_restore(self, tmp_path):
+        """A broken survivor handoff must never abort the rejoin: the local
+        generation already restored all-or-nothing, so a corrupt record
+        classifies a journal fault (warn once) and the rank still enters
+        the next epoch on its local state."""
+        path = str(tmp_path / "rank1.journal")
+        live = mt.MetricCollection({"mean": mt.MeanMetric()})
+        live.update(jnp.asarray([2.0, 4.0]))
+        live.checkpoint_barrier(path)
+        oracle = {k: np.asarray(v) for k, v in live.compute().items()}
+        j0 = engine.engine_stats()["fault_journal"]
+        e0 = psync.world_epoch()
+        restored = mt.MetricCollection({"mean": mt.MeanMetric()})
+        with pytest.warns(UserWarning, match="handoff record failed verification"):
+            out = restored.rejoin(path, handoff=lambda meta: b"garbage-not-a-record", rank=1)
+        assert out["handoff"] is False
+        assert out["epoch"] == e0 + 1  # the rejoin still completed
+        assert engine.engine_stats()["fault_journal"] > j0  # classified
+        got = {k: np.asarray(v) for k, v in restored.compute().items()}
+        for k in oracle:
+            np.testing.assert_array_equal(got[k], oracle[k])
+
+    def test_rejoin_handoff_older_record_is_ignored(self, tmp_path):
+        from metrics_tpu.ops import journal
+
+        path = str(tmp_path / "rank1.journal")
+        live = mt.MetricCollection({"mean": mt.MeanMetric()})
+        live.update(jnp.asarray([2.0]))
+        older = journal.pack_record(live._journal_nodes(), manifest_extra={"barrier_step": 0})
+        live.update(jnp.asarray([4.0]))
+        live.checkpoint_barrier(path)
+        oracle = {k: np.asarray(v) for k, v in live.compute().items()}
+        restored = mt.MetricCollection({"mean": mt.MeanMetric()})
+        out = restored.rejoin(path, handoff=lambda meta: older, rank=1)
+        assert out["handoff"] is False
+        got = {k: np.asarray(v) for k, v in restored.compute().items()}
+        for k in oracle:
+            np.testing.assert_array_equal(got[k], oracle[k])
+
+
+class TestEnvParserSatellites:
+    def test_backoff_garbage_warns_once_naming_the_value(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "soonish")
+        monkeypatch.setattr(psync, "_BACKOFF_WARN_OWNER", psync._EnvWarnOwner())
+        with pytest.warns(UserWarning, match=r"METRICS_TPU_SYNC_BACKOFF_MS='soonish'"):
+            assert psync.sync_backoff_s() == 0.05
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            assert psync.sync_backoff_s() == 0.05  # warned ONCE
+
+    def test_retries_warning_names_the_value(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "many")
+        monkeypatch.setattr(psync, "_RETRIES_WARN_OWNER", psync._EnvWarnOwner())
+        with pytest.warns(UserWarning, match=r"METRICS_TPU_SYNC_RETRIES='many'"):
+            assert psync.sync_retries() == 2
+
+    def test_dead_after_garbage_falls_back_to_default(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEAD_AFTER", "never")
+        monkeypatch.setattr(psync, "_MEMBERSHIP_WARN_OWNER", psync._EnvWarnOwner())
+        with pytest.warns(UserWarning, match=r"METRICS_TPU_SYNC_DEAD_AFTER='never'"):
+            assert psync.sync_dead_after() == 3
+
+    def test_degraded_tier_accepts_quorum_and_rejects_garbage(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "quorum")
+        assert psync.sync_degraded_tier() == "quorum"
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "mostly")
+        monkeypatch.setattr(psync, "_DEADLINE_WARN_OWNER", psync._EnvWarnOwner())
+        with pytest.warns(UserWarning, match="quorum"):
+            assert psync.sync_degraded_tier() is None

@@ -33,10 +33,26 @@ Scenarios:
 - ``flush-fault-during-journal-save`` — a deferred-queue flush chunk dies
   inside ``save_state``'s observation barrier: the eager replay absorbs it
   and the written record must still load bit-exactly.
+- ``kill-rank-quorum-rejoin`` — a 3-rank world loses rank 2 mid-sync: K
+  watchdog timeouts auto-declare it dead (peer prober), the epoch bumps, and
+  ``METRICS_TPU_SYNC_DEGRADED=quorum`` serves the BIT-EXACT merge over the
+  surviving subgroup {0,1}; the restarted rank rejoins (journal restore +
+  epoch bump) and the post-rejoin full-world sync is bit-exact vs an
+  uninterrupted run — with ZERO stale-epoch collectives issued
+  (counter-asserted).
+- ``stale-epoch-collective`` — a membership change races a sync's retry: the
+  epoch fence raises the classified ``EpochFault`` (the stale retry never
+  reaches the transport), local state bit-exact and retryable at the new
+  epoch.
+- ``barrier-with-torn-generation`` — a ``checkpoint_barrier`` fleet journals
+  at one agreed epoch-stamped step; the newest generation tears; ``rejoin``
+  demotes to the previous good generation and a survivor's handoff record
+  (one bucketed state record) fast-forwards the rejoiner to the barrier
+  state bit-exactly.
 
-``--fast`` runs the first three (the ``make faults`` / CI subset); the full
-sweep adds the deferral interaction. One JSON line per scenario; non-zero
-exit on any violation.
+``--fast`` runs everything except the deferral interaction (the
+``make faults`` / CI subset); the full sweep adds it. One JSON line per
+scenario; non-zero exit on any violation.
 """
 from __future__ import annotations
 
@@ -62,8 +78,10 @@ import numpy as np  # noqa: E402
 import metrics_tpu as mt  # noqa: E402
 import metrics_tpu.metric as metric_mod  # noqa: E402
 from metrics_tpu.ops import engine, faults  # noqa: E402
+from metrics_tpu.ops import journal as journal_mod  # noqa: E402
 from metrics_tpu.parallel import bucketing  # noqa: E402
-from metrics_tpu.utils.exceptions import FaultError  # noqa: E402
+from metrics_tpu.parallel import sync as psync  # noqa: E402
+from metrics_tpu.utils.exceptions import EpochFault, FaultError  # noqa: E402
 
 RNG = np.random.RandomState(0)
 P = jnp.asarray(RNG.rand(48).astype(np.float32))
@@ -94,6 +112,7 @@ class _env:
             else:
                 os.environ[k] = v
         self.saved_payload = bucketing._payload_allgather
+        self.saved_host = bucketing._host_allgather
         self.saved_dist = metric_mod._dist_available
         return self
 
@@ -115,7 +134,9 @@ class _env:
 
     def __exit__(self, *exc):
         bucketing._payload_allgather = self.saved_payload
+        bucketing._host_allgather = self.saved_host
         metric_mod._dist_available = self.saved_dist
+        psync.reset_membership()
         for k, v in self.saved_env.items():
             if v is None:
                 os.environ.pop(k, None)
@@ -237,7 +258,224 @@ def scenario_flush_fault_during_journal_save() -> dict:
     return {"scenario": "flush-fault-during-journal-save", "ok": bool(ok)}
 
 
-FAST = [scenario_timeout_then_compile, scenario_crash_with_torn_journal, scenario_pack_then_gather]
+def scenario_kill_rank_quorum_rejoin() -> dict:
+    """3-rank world loses rank 2 mid-sync: K timeouts auto-declare it dead
+    (epoch bump), METRICS_TPU_SYNC_DEGRADED=quorum serves the bit-exact
+    merge over survivors {0,1}; rank 2 restores its journal, rejoins (next
+    epoch), and the post-rejoin full-world sync is bit-exact vs an
+    uninterrupted run. Zero stale-epoch collectives issued, counter-asserted."""
+    engine.reset_engine()
+    psync.reset_membership()
+    faults.set_recovery_policy(steps=1)
+    d = tempfile.mkdtemp(prefix="mt-chaos-")
+    rank2_path = os.path.join(d, "rank2.journal")
+    try:
+        with _env(
+            METRICS_TPU_SYNC_DEADLINE_MS="80",
+            METRICS_TPU_SYNC_DEGRADED="quorum",
+            METRICS_TPU_SYNC_RETRIES="1",
+            METRICS_TPU_SYNC_DEAD_AFTER="2",
+        ) as env:
+            env.simulate_distributed()
+            suites = []
+            for r in range(3):
+                s = _suite()
+                s.update(jnp.asarray(np.float32([1.0 + 2 * r, 3.0 + 2 * r])), jnp.asarray([0, 1]))
+                suites.append(s)
+            suites[2].save_state(rank2_path)  # rank 2 journaled before it dies
+
+            # oracles: a suite fed the survivors' (and all ranks') batches —
+            # sum-reduced states make sequential updates == the rank merge
+            def oracle_over(rs):
+                o = _suite()
+                for r in rs:
+                    o.update(jnp.asarray(np.float32([1.0 + 2 * r, 3.0 + 2 * r])), jnp.asarray([0, 1]))
+                return {k: np.asarray(v) for k, v in o.compute().items()}
+
+            quorum_oracle = oracle_over([0, 1])
+            full_oracle = oracle_over([0, 1, 2])
+            local_oracle = oracle_over([0])
+
+            def trees(live=(0, 1, 2)):
+                return [
+                    [
+                        n
+                        for _, m in suites[r].items(keep_base=True, copy_state=False)
+                        for n in bucketing.tree_nodes(m)
+                    ]
+                    for r in live
+                ]
+
+            killed = {"dead": True}
+            psync.set_expected_world(3)
+            psync.set_peer_prober(lambda: [2])
+
+            def rows():
+                if not killed["dead"]:
+                    return trees()[1:]
+                alive = psync.surviving_members()
+                if alive is None:
+                    return None  # dead peer undeclared: the full world hangs
+                return [t for r, t in zip((0, 1, 2), trees()) if r in alive and r != 0]
+
+            def pack(nodes):
+                for n in nodes:
+                    n._canonicalize_list_states()
+                entries, values = bucketing._collect(nodes)
+                return bucketing._pack(entries, values)
+
+            def host(vec):
+                rr = rows()
+                if rr is None:
+                    time.sleep(0.5)
+                    raise RuntimeError("abandoned hung metadata exchange (dead peer)")
+                return np.stack([np.asarray(vec)] + [np.asarray(pack(t)[1]) for t in rr])
+
+            def payload(x):
+                rr = rows()
+                if rr is None:
+                    time.sleep(0.5)
+                    raise RuntimeError("abandoned hung collective (dead peer)")
+                packs = [pack(t)[0] for t in rr]
+                pad = int(x.shape[0])
+                return jnp.stack([x] + [jnp.pad(p, (0, pad - int(p.shape[0]))) for p in packs])
+
+            bucketing._host_allgather = host
+            bucketing._payload_allgather = payload
+
+            # kill-rank mid-sync -> K timeouts -> dead declared -> quorum serve
+            got = {k: np.asarray(v) for k, v in suites[0].compute().items()}
+            ok = all(_eq(got[k], quorum_oracle[k]) for k in quorum_oracle)
+            ok = ok and not all(_eq(got[k], full_oracle[k]) for k in full_oracle)
+            ok = ok and not all(_eq(got[k], local_oracle[k]) for k in local_oracle)
+            stats = engine.engine_stats()
+            ok = ok and stats["sync_quorum_serves"] >= 1
+            ok = ok and psync.world_health()["dead_ranks"] == [2]
+            health = suites[0].sync_health()
+            ok = ok and health["degraded"] and health["degraded_tier"] == "quorum"
+
+            # rank 2 restarts: journal restore + rejoin (next epoch); the
+            # revived transport answers for the full world again
+            restored = _suite()
+            rejoin_info = restored.rejoin(rank2_path, rank=2)
+            suites[2] = restored
+            killed["dead"] = False
+            ok = ok and rejoin_info["generation"] == 0
+            ok = ok and psync.world_health()["dead_ranks"] == []
+
+            # the survivors' recovery edge (steps=1) re-probes the FULL world
+            for _, m in suites[0].items(keep_base=True, copy_state=False):
+                m._computed = None
+            got2 = {k: np.asarray(v) for k, v in suites[0].compute().items()}
+            ok = ok and all(_eq(got2[k], full_oracle[k]) for k in full_oracle)
+            ok = ok and not suites[0].sync_health()["degraded"]
+            # the certified invariant: no collective ever went out stale
+            ok = ok and engine.engine_stats()["sync_stale_collectives"] == 0
+        return {
+            "scenario": "kill-rank-quorum-rejoin",
+            "ok": bool(ok),
+            "epoch": psync.world_epoch(),
+        }
+    finally:
+        faults.set_recovery_policy(steps=8)
+        psync.reset_membership()
+
+
+def scenario_stale_epoch_collective() -> dict:
+    """A membership change races a sync's retry: the epoch fence raises the
+    classified EpochFault — the stale retry never reaches the transport,
+    local state is bit-exact and retryable at the new epoch, and zero stale
+    collectives are issued."""
+    engine.reset_engine()
+    psync.reset_membership()
+    m = mt.MeanMetric()
+    m.update(jnp.asarray([2.0, 4.0]))
+    before = {k: np.asarray(v) for k, v in m.metric_state.items()}
+    with _env(METRICS_TPU_SYNC_RETRIES="1"):
+        calls = {"n": 0}
+
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                psync.bump_epoch("peer-died-mid-sync")  # membership change races the sync
+                raise RuntimeError("transport reset by membership change")
+            return x[None]
+
+        bucketing._payload_allgather = flaky
+        fenced = False
+        try:
+            m.sync(distributed_available=DIST_ON)
+        except EpochFault:
+            fenced = True  # classified, never a bare raise or a wrong-cohort pair
+        stats = engine.engine_stats()
+        ok = fenced and calls["n"] == 1  # the stale retry never re-issued
+        ok = ok and stats["sync_epoch_fence_trips"] >= 1
+        ok = ok and stats["sync_stale_collectives"] == 0
+        after = {k: np.asarray(v) for k, v in m.metric_state.items()}
+        ok = ok and all(_eq(after[k], before[k]) for k in before)
+        ok = ok and not m._is_synced
+        # re-entering at the current epoch succeeds
+        m.sync(distributed_available=DIST_ON)
+        m.unsync()
+        ok = ok and _eq(m.compute(), np.asarray(3.0))
+    return {"scenario": "stale-epoch-collective", "ok": bool(ok)}
+
+
+def scenario_barrier_with_torn_generation() -> dict:
+    """checkpoint_barrier journals at one agreed epoch-stamped step; the
+    newest generation tears; rejoin demotes to the previous good generation
+    (classified journal fault) and a survivor's handoff record fast-forwards
+    the rejoiner to the barrier state bit-exactly."""
+    engine.reset_engine()
+    psync.reset_membership()
+    d = tempfile.mkdtemp(prefix="mt-chaos-")
+    path = os.path.join(d, "suite.journal")
+    suite = _suite()
+    suite.update(P, T)
+    info1 = suite.checkpoint_barrier(path)
+    suite.update(jnp.asarray(np.float32([5.0, 7.0])), jnp.asarray([1, 0]))
+    info2 = suite.checkpoint_barrier(path)
+    ok = info2["barrier_step"] > info1["barrier_step"] and info2["epoch"] >= info1["epoch"]
+    manifest, _ = journal_mod.read_record(path)
+    ok = ok and manifest["barrier_step"] == info2["barrier_step"]
+    ok = ok and manifest["epoch"] == info2["epoch"]
+    oracle = {k: np.asarray(v) for k, v in suite.compute().items()}
+    # the survivor's retained copy of the newest barrier record
+    survivor_record = journal_mod.pack_record(
+        suite._journal_nodes(),
+        manifest_extra={"epoch": info2["epoch"], "barrier_step": info2["barrier_step"]},
+    )
+    # tear the newest on-disk generation
+    with open(path, "r+b") as fh:
+        fh.seek(30)
+        byte = fh.read(1)
+        fh.seek(30)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    j0 = engine.engine_stats()["fault_journal"]
+    restored = _suite()
+    out = restored.rejoin(path, handoff=lambda meta: survivor_record, rank=0)
+    ok = ok and out["generation"] == 1  # torn newest demoted, classified
+    ok = ok and engine.engine_stats()["fault_journal"] > j0
+    ok = ok and out["handoff"] is True  # the newer survivor record won
+    ok = ok and out["restored_step"] == info2["barrier_step"]
+    got = {k: np.asarray(v) for k, v in restored.compute().items()}
+    ok = ok and all(_eq(got[k], oracle[k]) for k in oracle)
+    psync.reset_membership()
+    return {
+        "scenario": "barrier-with-torn-generation",
+        "ok": bool(ok),
+        "demoted_to_generation": out["generation"],
+    }
+
+
+FAST = [
+    scenario_timeout_then_compile,
+    scenario_crash_with_torn_journal,
+    scenario_pack_then_gather,
+    scenario_kill_rank_quorum_rejoin,
+    scenario_stale_epoch_collective,
+    scenario_barrier_with_torn_generation,
+]
 FULL = FAST + [scenario_flush_fault_during_journal_save]
 
 
