@@ -78,8 +78,13 @@ chaos:
 # the Chrome-trace/Perfetto JSON, and validate + summarize it with the
 # report tool (docs/observability.md). --smoke implies --check semantics:
 # a structurally invalid trace (bad events, non-monotonic timestamps) fails.
+# The fleet smoke then runs the dryrun-multichip fleet path: a simulated
+# 3-rank world (deliberately-slow rank flagged by the straggler report),
+# one merged one-process-per-rank trace validated with --check, and a
+# --diff counter-delta report between two consecutive snapshots.
 trace:
 	$(PY) tools/trace_report.py --smoke
+	$(PY) tools/trace_report.py --fleet-smoke
 
 # What CI runs, in order (see .github/workflows/ci.yml).
 ci: docs doctest test-fast dryrun faults trace bench-smoke test-full

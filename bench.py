@@ -870,6 +870,57 @@ def bench_journal_write() -> dict:
     }
 
 
+def bench_fleet_snapshot() -> dict:
+    """``fleet_snapshot_overhead``: cost of one fleet snapshot merge in a
+    world of size 1 — the production single-replica default. The contract
+    (ISSUE 9): zero collectives issued (the local plane serves directly;
+    counter-asserted via the protocol-slot audit). armed vs disarmed
+    isolates only the per-call span emit — disarming does NOT drop the
+    already-recorded ring, so both loops pay the same span-ring
+    phase-stats reduction inside telemetry.snapshot()."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MeanMetric, MetricCollection
+    from metrics_tpu.ops import engine, fleetobs, telemetry
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(BATCH).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, BATCH))
+    coll = MetricCollection({"mean": MeanMetric(), "acc": Accuracy()})
+    coll.update(p, t)
+    # one simulated-world sync so the span ring carries sync-phase material
+    coll.sync(distributed_available=lambda: True)
+    coll.unsync()
+    n_snaps = max(5, STEPS // 5)
+    calls = {"n": 0}
+
+    def loop() -> float:
+        best = float("inf")
+        for _ in range(TRIALS):
+            start = time.perf_counter()
+            for _ in range(n_snaps):
+                fleetobs.fleet_snapshot()
+            calls["n"] += n_snaps
+            best = min(best, time.perf_counter() - start)
+        return n_snaps / best
+
+    was_armed = telemetry.armed
+    s0 = engine.engine_stats()["sync_collectives_issued"]
+    try:
+        telemetry.set_telemetry(True)
+        armed = loop()
+        telemetry.set_telemetry(False)
+        disarmed = loop()
+    finally:
+        telemetry.set_telemetry(was_armed)
+    collectives = engine.engine_stats()["sync_collectives_issued"] - s0
+    return {
+        "armed_snapshots_per_s": armed,
+        "disarmed_snapshots_per_s": disarmed,
+        "collectives_per_snapshot": collectives / max(1, calls["n"]),
+    }
+
+
 def bench_overhead_reference() -> float:
     tm = _reference()
     if tm is None:
@@ -933,6 +984,8 @@ def main() -> None:
     # extend (same loop shape, same simulated-distributed surface)
     deadline_probe = bench_sync_deadline_overhead()
     journal_probe = bench_journal_write()
+    # fleet probe rides the same simulated-world regime as the sync rows
+    fleet_probe = bench_fleet_snapshot()
     boot_floor = bench_bootstrap_shaped_floor()
     ours_overhead_batched = bench_overhead_batched_ours()
     ref_overhead = _safe(bench_overhead_reference)
@@ -1138,6 +1191,26 @@ def main() -> None:
                 "hung peer raises a classified SyncTimeoutFault instead of "
                 "blocking forever; membership_armed additionally epoch-fences "
                 "every collective and arms the quorum tier (docs/robustness.md)"
+            ),
+        },
+        "fleet_snapshot_overhead": {
+            # ISSUE 9: the fleet observability plane's cost in a world of
+            # size 1 (the production single-replica default). ZERO
+            # collectives per snapshot is the acceptance pin — the local
+            # plane serves directly; gathering engages only in a multi-rank
+            # (or registry-declared) world, as two collective slots per
+            # snapshot (length exchange + padded blob gather).
+            "armed_snapshots_per_s": round(fleet_probe["armed_snapshots_per_s"], 1),
+            "disarmed_snapshots_per_s": round(fleet_probe["disarmed_snapshots_per_s"], 1),
+            "collectives_per_snapshot": round(fleet_probe["collectives_per_snapshot"], 4),
+            "unit": "fleet_snapshot() calls/s (world size 1, 2-metric suite)",
+            "note": (
+                "collectives_per_snapshot == 0 pins the world-size-1 "
+                "zero-collective contract; armed vs disarmed differ only by "
+                "the fleet-snapshot span emit itself — the span-ring "
+                "phase-stats reduction (the straggler-attribution input) "
+                "runs in BOTH loops, since disarming stops recording but "
+                "keeps the retained ring (docs/observability.md Fleet plane)"
             ),
         },
         "journal_write_per_snapshot": {

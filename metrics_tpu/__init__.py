@@ -140,6 +140,14 @@ from metrics_tpu.ops.telemetry import (  # noqa: E402
     telemetry_snapshot,
 )
 
+# the fleet plane (docs/observability.md "Fleet plane"): cross-rank snapshot
+# aggregation, straggler attribution, and the merged one-process-per-rank trace
+from metrics_tpu.ops.fleetobs import (  # noqa: E402
+    export_fleet_trace,
+    fleet_prometheus_text,
+    fleet_snapshot,
+)
+
 # world membership (docs/robustness.md "World membership"): epoch registry +
 # peer-health surface behind epoch-fenced collectives and quorum compute
 from metrics_tpu.parallel.sync import world_health  # noqa: E402
@@ -152,6 +160,9 @@ __all__ = [
     "set_telemetry",
     "telemetry_snapshot",
     "world_health",
+    "export_fleet_trace",
+    "fleet_prometheus_text",
+    "fleet_snapshot",
     "Metric",
     "CompositionalMetric",
     "MetricCollection",

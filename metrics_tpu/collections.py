@@ -1345,6 +1345,20 @@ class MetricCollection:
             "world": _psync.world_health(),
         }
 
+    def fleet_health(self) -> Dict[str, Any]:
+        """The suite's fleet view: one :func:`metrics_tpu.fleet_snapshot`
+        (cross-rank planes, summed/min-median-max aggregates, the straggler
+        report, dead-rank placeholders — ZERO collectives in a single-process
+        world) with this suite's own :meth:`sync_health` staleness block
+        attached under ``"suite"`` — the one dict a serving dashboard polls
+        to answer "is this cohort healthy enough to serve, and who is slow".
+        """
+        from metrics_tpu.ops import fleetobs as _fleetobs
+
+        out = _fleetobs.fleet_snapshot()
+        out["suite"] = self.sync_health()
+        return out
+
     def _journal_nodes(self) -> List[Metric]:
         """Every member tree's nodes, member-wise in suite order — the same
         deterministic walk the coalesced sync packs, so the journal layout

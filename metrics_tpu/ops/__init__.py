@@ -25,6 +25,12 @@ from metrics_tpu.ops.faults import (
     reset_warn_dedupe,
     set_recovery_policy,
 )
+from metrics_tpu.ops.fleetobs import (
+    export_fleet_trace,
+    fleet_prometheus_text,
+    fleet_snapshot,
+    straggler_report,
+)
 from metrics_tpu.ops.journal import journal_generations, journal_stats, journalable
 from metrics_tpu.ops.telemetry import (
     SPAN_SITES,
@@ -75,4 +81,8 @@ __all__ = [
     "prometheus_text",
     "set_telemetry",
     "telemetry_snapshot",
+    "export_fleet_trace",
+    "fleet_prometheus_text",
+    "fleet_snapshot",
+    "straggler_report",
 ]

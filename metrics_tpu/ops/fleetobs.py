@@ -1,0 +1,730 @@
+"""Fleet observability: cross-rank snapshot aggregation, straggler
+attribution, merged multi-rank traces.
+
+The flight recorder (:mod:`metrics_tpu.ops.telemetry`) sees exactly one
+process, yet every hard fleet question — who is slow, where sync time goes
+per rank, whether a degraded cohort is healthy enough to serve — spans the
+whole world, especially once elastic membership makes the world dynamic.
+This module is the fleet plane on top of the local one, in three faces:
+
+- :func:`fleet_snapshot` — ONE epoch-fenced, deadline-guarded host gather
+  of every rank's JSON-serialized ``telemetry_snapshot()`` (the same
+  ``_host_allgather`` + ``run_with_deadline`` + ``check_epoch`` ladder every
+  other collective protocol rides), merged into a schema-stable dict with
+  per-rank planes, aggregate planes (counters summed exactly; gauges
+  min/median/max), dead-rank placeholders sourced from the membership
+  registry, the straggler report, and ``world_health()`` folded in. With a
+  world size of 1 the local plane is served directly — ZERO collectives.
+
+- **Straggler attribution** — every rank's snapshot carries its
+  ``sync_phase_stats`` block (per-phase span duration statistics:
+  pack / metadata / payload-gather / unpack, reduced from the span ring);
+  :func:`straggler_report` compares them across ranks and names the slowest
+  ranks per phase with deviation-from-median scores.
+  :func:`fleet_prometheus_text` renders the fleet view as a Prometheus
+  exposition with ``rank`` (and ``phase``) labels.
+
+- :func:`export_fleet_trace` — gather the span rings, align ranks on the
+  shared monotonic axis using paired payload-gather spans (identical
+  ``seq`` ordinals — collectives issue in lockstep) as clock-offset
+  anchors, and emit ONE Perfetto JSON with one *process per rank*, so a
+  cross-rank sync timeline is visible in a single view.
+
+Transport note: in a live world with declared-dead ranks, the gather rows
+are the SURVIVORS in ascending rank order (the same re-formed-transport
+convention the quorum tier uses); dead ranks appear as placeholder planes
+and are excluded from every aggregate. See docs/observability.md
+("Fleet plane").
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.ops import telemetry as _telemetry
+
+__all__ = [
+    "FLEET_SCHEMA",
+    "export_fleet_trace",
+    "fleet_prometheus_text",
+    "fleet_snapshot",
+    "fleet_stats",
+    "fleet_world",
+    "local_rank",
+    "merge_snapshots",
+    "reset_fleet_stats",
+    "straggler_report",
+    "straggler_threshold",
+]
+
+#: Bumped only on breaking key changes to the :func:`fleet_snapshot` schema.
+FLEET_SCHEMA = 1
+
+# ------------------------------------------------------------------ counters
+_counters: Dict[str, int] = {
+    "fleet_snapshots": 0,
+    "fleet_trace_exports": 0,
+    "fleet_gathers": 0,
+    "fleet_gather_bytes": 0,
+}
+
+
+def fleet_stats() -> Dict[str, int]:
+    """Fleet-plane counters (surfaced inside :func:`fleet_snapshot`)."""
+    return dict(_counters)
+
+
+def reset_fleet_stats() -> None:
+    for key in _counters:
+        _counters[key] = 0
+
+
+_telemetry.register_reset("fleetobs", reset_fleet_stats)
+
+
+class _FleetWarnOwner:
+    """Warn-dedupe anchor for fleet env-knob / merge warnings."""
+
+
+_THRESHOLD_WARN_OWNER = _FleetWarnOwner()
+_MERGE_WARN_OWNER = _FleetWarnOwner()
+# distinct owner: the snapshot row-count warning and the trace dropped-row
+# warning are different conditions — sharing one warn_fault slot would let
+# whichever fires first permanently suppress the other
+_TRACE_DROP_WARN_OWNER = _FleetWarnOwner()
+
+#: Keys that are monotonic counters on ONE rank but must NOT sum across the
+#: fleet: every rank carries the same kind of event axis, and "3 ranks at
+#: step 100" is step skew (a min/median/max gauge signal), not 300 events.
+FLEET_GAUGE_KEYS = frozenset({"monotonic_step"})
+
+
+def _fleet_is_counter(key: str) -> bool:
+    """The fleet-merge counter predicate: :func:`telemetry.is_counter_key`
+    (the Prometheus-typing predicate) minus :data:`FLEET_GAUGE_KEYS` — the
+    keys whose cross-rank sum is meaningless."""
+    return _telemetry.is_counter_key(key) and key not in FLEET_GAUGE_KEYS
+
+
+# ------------------------------------------------------------------ the world
+def fleet_world() -> int:
+    """The world the fleet plane gathers over: the live process count, or the
+    membership registry's known (declared or transition-promoted) world when
+    that is larger — a degraded cohort keeps its original rank numbering, and
+    simulated/fake worlds declare themselves via ``set_expected_world``. A
+    plain single process with no known world is a fleet of one: every face
+    serves the local plane with ZERO collectives."""
+    from metrics_tpu.parallel import sync as _sync
+
+    return max(_sync.world_size(), _sync._membership.known_world or 1)
+
+
+def local_rank() -> int:
+    """This process's rank in the fleet (0 in a single-process world)."""
+    from metrics_tpu.parallel import sync as _sync
+
+    if _sync.distributed_available():
+        import jax
+
+        return int(jax.process_index())
+    return 0
+
+
+def straggler_threshold() -> float:
+    """Deviation-from-median above which a rank is flagged as a straggler
+    (``METRICS_TPU_STRAGGLER_THRESHOLD``, default 0.5 — 50% slower than the
+    fleet median for some sync phase). An unparseable value warns once and
+    uses the default."""
+    from metrics_tpu.parallel import sync as _sync
+
+    return max(
+        0.0, _sync._env_float("METRICS_TPU_STRAGGLER_THRESHOLD", 0.5, owner=_THRESHOLD_WARN_OWNER)
+    )
+
+
+def _participant_ranks(world: int, dead: Any) -> List[int]:
+    """The ranks a host gather's rows map to: survivors ascending (the
+    re-formed-transport convention — see ``sync.surviving_members``)."""
+    dead = set(int(r) for r in (dead or ()))
+    return [r for r in range(world) if r not in dead]
+
+
+# ------------------------------------------------------------------ transport
+def _gather_blobs(blob: bytes, *, owner: Any = None, site: str = "fleet-gather") -> List[bytes]:
+    """All-gather one variable-length byte blob from every rank.
+
+    Two host exchanges (a length vector, then the max-length-padded payload)
+    riding the full collective-protocol ladder: the epoch fence is captured
+    at entry and re-checked inside the retried closure before each issue,
+    every blocking exchange runs under the watchdog deadline, and both
+    collective slots are audited against the fence stamp. Returns one
+    ``bytes`` entry per gather row (row order = survivors ascending)."""
+    from metrics_tpu.ops import faults as _faults
+    from metrics_tpu.parallel import bucketing as _bucketing
+    from metrics_tpu.parallel import sync as _sync
+
+    fence = _sync.world_epoch()
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
+    local_vec = np.frombuffer(blob, np.uint8)
+
+    def _attempt() -> List[bytes]:
+        _sync.check_epoch(fence, site=site, owner=owner)
+        lengths_rows = np.asarray(
+            _sync.run_with_deadline(
+                lambda: _bucketing._host_allgather(np.asarray([len(blob)], np.int64)),
+                site=site,
+            )
+        )
+        _sync.note_collective("shape", epoch=fence)
+        lengths = lengths_rows.reshape(lengths_rows.shape[0], -1)[:, 0].astype(np.int64)
+        max_len = max(1, int(lengths.max()))
+        padded = np.zeros(max_len, np.uint8)
+        padded[: len(blob)] = local_vec
+        rows = np.asarray(
+            _sync.run_with_deadline(lambda: _bucketing._host_allgather(padded), site=site)
+        )
+        _sync.note_collective("payload", nbytes=int(rows.size), epoch=fence)
+        n = min(rows.shape[0], lengths.shape[0])
+        return [rows[i, : int(lengths[i])].astype(np.uint8).tobytes() for i in range(n)]
+
+    out = _faults.retry_with_backoff(
+        _attempt,
+        attempts=_sync.sync_retries(),
+        base_delay_s=_sync.sync_backoff_s(),
+        owner=owner,
+        site=site,
+    )
+    _counters["fleet_gathers"] += 1
+    _counters["fleet_gather_bytes"] += sum(len(b) for b in out)
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "fleet-gather", owner, "sync", t0, _telemetry.now() - t0,
+            {"rows": len(out), "bytes": sum(len(b) for b in out), "epoch": fence},
+        )
+    return out
+
+
+def _local_plane_text() -> str:
+    """This rank's snapshot plane as its wire JSON: ``telemetry_snapshot()``
+    minus the ``failure_log`` ring (per-entry error strings belong to the
+    local trace, not the fleet gather — the per-domain counts already travel
+    inside ``sync_health.fault_domain_counts``). The gather blob and the
+    local plane both come from this ONE serialization, so they are
+    byte-identical by construction."""
+    snap = _telemetry.snapshot()
+    plane = {k: v for k, v in snap.items() if k != "failure_log"}
+    return json.dumps(_telemetry._json_safe(plane), separators=(",", ":"))
+
+
+def _local_plane() -> Dict[str, Any]:
+    return json.loads(_local_plane_text())
+
+
+def _is_live_plane(plane: Any) -> bool:
+    return isinstance(plane, dict) and not plane.get("dead") and not plane.get("missing") and not plane.get("corrupt")
+
+
+# ------------------------------------------------------------------ the merge
+def _median(values: List[float]) -> float:
+    vals = sorted(values)
+    n = len(vals)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return float(vals[mid]) if n % 2 else float(vals[mid - 1] + vals[mid]) / 2.0
+
+
+def merge_snapshots(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
+    """Reduce per-rank snapshot planes into the aggregate plane: every
+    flattened numeric key classified by the SAME predicate the Prometheus
+    exposition types with (:func:`metrics_tpu.ops.telemetry.is_counter_key`)
+    — **counters summed exactly** (the dryrun certification pins aggregate ==
+    sum of per-rank), gauges reduced to ``min``/``median``/``max``. The
+    shared-monotonic-axis keys (:data:`FLEET_GAUGE_KEYS`) reduce as gauges —
+    cross-rank step skew is the signal, a sum would be noise. Dead /
+    missing / corrupt placeholder planes are excluded."""
+    counters: Dict[str, float] = {}
+    gauge_values: Dict[str, List[float]] = {}
+    merged_ranks: List[int] = []
+    for rank, plane in sorted(planes.items()):
+        if not _is_live_plane(plane):
+            continue
+        merged_ranks.append(rank)
+        numeric = {k: v for k, v in plane.items() if k != "failure_log"}
+        for key, value in _telemetry._flat_numeric("", numeric):
+            if _fleet_is_counter(key):
+                counters[key] = counters.get(key, 0) + value
+            else:
+                gauge_values.setdefault(key, []).append(value)
+    # integer counters stay integers (floats are exact below 2**53; a fleet
+    # of byte counters sums well inside that)
+    counters_out: Dict[str, Any] = {
+        k: int(v) if float(v).is_integer() else v for k, v in sorted(counters.items())
+    }
+    gauges_out: Dict[str, Dict[str, float]] = {
+        k: {"min": float(min(v)), "median": _median(v), "max": float(max(v))}
+        for k, v in sorted(gauge_values.items())
+    }
+    return {"counters": counters_out, "gauges": gauges_out, "ranks_merged": merged_ranks}
+
+
+def straggler_report(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
+    """Name the slowest ranks per sync phase, with deviation scores.
+
+    Each live plane's ``sync_phase_stats`` block carries per-phase mean span
+    durations; for every phase with data the report records the per-rank
+    means, the fleet median, the slowest rank and its deviation
+    ``(mean - median) / median``. ``stragglers`` lists the ranks whose worst
+    phase deviation exceeds :func:`straggler_threshold`, worst first;
+    ``ranked`` orders every attributed rank the same way."""
+    live = {
+        r: p["sync_phase_stats"]
+        for r, p in planes.items()
+        if _is_live_plane(p) and isinstance(p.get("sync_phase_stats"), dict)
+    }
+    threshold = straggler_threshold()
+    phases: Dict[str, Dict[str, Any]] = {}
+    worst: Dict[int, Tuple[float, str]] = {}
+    for site in _telemetry.SYNC_PHASE_SITES:
+        per_rank = {}
+        for r, stats in live.items():
+            block = stats.get(site) or {}
+            if float(block.get("count", 0)) > 0:
+                per_rank[r] = float(block.get("mean_s", 0.0))
+        entry: Dict[str, Any] = {
+            "per_rank_mean_s": per_rank,
+            "median_s": 0.0,
+            "slowest_rank": None,
+            "slowest_mean_s": 0.0,
+            "deviation": 0.0,
+            "per_rank_deviation": {},
+        }
+        if per_rank:
+            med = _median(list(per_rank.values()))
+            deviations = {
+                r: (v - med) / max(med, 1e-12) for r, v in per_rank.items()
+            }
+            slowest = max(per_rank, key=lambda r: per_rank[r])
+            entry.update(
+                median_s=med,
+                slowest_rank=slowest,
+                slowest_mean_s=per_rank[slowest],
+                deviation=deviations[slowest],
+                per_rank_deviation=deviations,
+            )
+            for r, d in deviations.items():
+                if r not in worst or d > worst[r][0]:
+                    worst[r] = (d, site)
+        phases[site] = entry
+    ranked = [
+        {"rank": r, "phase": site, "deviation": d}
+        for r, (d, site) in sorted(worst.items(), key=lambda kv: -kv[1][0])
+    ]
+    return {
+        "phases": phases,
+        "ranked": ranked,
+        "threshold": threshold,
+        "stragglers": [row["rank"] for row in ranked if row["deviation"] >= threshold],
+    }
+
+
+# ------------------------------------------------------------------ the faces
+def fleet_snapshot() -> Dict[str, Any]:
+    """ONE merged fleet monitoring dict — the cross-rank face of
+    ``telemetry_snapshot()``.
+
+    In a multi-rank world, every rank's JSON-serialized snapshot rides one
+    epoch-fenced, deadline-guarded blob gather (two collective slots: a
+    length exchange + the padded payload — see :func:`_gather_blobs`) —
+    a **collective**: every live rank must call it in lockstep, like
+    ``sync()`` or ``checkpoint_barrier()``, so invoke it from the
+    coordinated serving/eval loop, never from an unsynchronized per-rank
+    poller. With a world size of 1 the local plane is served directly and
+    **zero collectives are issued**. Keys:
+
+    - ``fleet_schema`` — :data:`FLEET_SCHEMA`; bumped on breaking changes.
+    - ``world_size`` / ``rank`` / ``epoch`` / ``gathered``.
+    - ``ranks`` — per-rank planes keyed by rank: each live rank's snapshot
+      (minus the ``failure_log`` ring); declared-dead ranks get a
+      ``{"dead": True, ...}`` placeholder sourced from the membership
+      registry; ranks the gather could not produce a row for get
+      ``{"missing": True}``; an undecodable row gets ``{"corrupt": True}``.
+    - ``aggregate`` — :func:`merge_snapshots` over the live planes
+      (counters summed exactly; gauges min/median/max).
+    - ``stragglers`` — :func:`straggler_report`.
+    - ``world_health`` — the membership registry surface, folded in.
+    - ``fleet_stats`` — this plane's own counters.
+
+    Example:
+        >>> from metrics_tpu import fleet_snapshot
+        >>> snap = fleet_snapshot()     # single process: local plane only
+        >>> snap["fleet_schema"]
+        1
+        >>> snap["rank"] in snap["ranks"]
+        True
+    """
+    from metrics_tpu.parallel import sync as _sync
+
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
+    wh = _sync.world_health()
+    world = fleet_world()
+    rank = local_rank()
+    dead = set(wh.get("dead_ranks") or ())
+    plane_text = _local_plane_text()
+    gathered = False
+    planes: Dict[int, Dict[str, Any]] = {}
+    if world > 1:
+        # the local plane arrives back through its own gather row — no
+        # second parse of the multi-KB snapshot on the collective path
+        payloads = _gather_blobs(plane_text.encode("utf-8"), site="fleet-snapshot")
+        participants = _participant_ranks(world, dead)
+        if len(payloads) != len(participants):
+            # a row count the registry did not predict (e.g. a fake world
+            # narrower than the declared one): map rows positionally and
+            # mark the unaccounted-for live ranks missing
+            from metrics_tpu.ops import faults as _faults
+
+            _faults.warn_fault(
+                _MERGE_WARN_OWNER,
+                "sync",
+                f"fleet_snapshot gathered {len(payloads)} row(s) but the membership "
+                f"registry expects {len(participants)} live rank(s) of {world}; mapping "
+                "rows to the lowest live ranks and marking the rest missing.",
+            )
+        for r, raw in zip(participants, payloads):
+            try:
+                decoded = json.loads(raw.decode("utf-8"))
+                if not isinstance(decoded, dict):
+                    raise ValueError(f"rank plane must be an object, got {type(decoded).__name__}")
+                planes[r] = decoded
+            except (ValueError, UnicodeDecodeError):
+                planes[r] = {"corrupt": True, "rank": r}
+        for r in participants:
+            if r not in planes:
+                planes[r] = {"missing": True, "rank": r}
+        gathered = True
+    else:
+        planes[rank] = json.loads(plane_text)
+    # dead-rank placeholders, sourced from the membership registry: the
+    # aggregate excludes them, the schema still names them
+    for r in sorted(dead):
+        if r not in planes:
+            rec = (wh.get("peers") or {}).get(r) or {}
+            planes[r] = {
+                "dead": True,
+                "rank": r,
+                "declared_dead_epoch": rec.get("declared_dead_epoch"),
+                "timeouts": rec.get("timeouts", 0),
+            }
+    _counters["fleet_snapshots"] += 1
+    out = {
+        "fleet_schema": FLEET_SCHEMA,
+        "world_size": world,
+        "rank": rank,
+        "epoch": int(wh.get("epoch", 1)),
+        "gathered": gathered,
+        "dead_ranks": sorted(dead),
+        "ranks": planes,
+        "aggregate": merge_snapshots(planes),
+        "stragglers": straggler_report(planes),
+        "world_health": wh,
+        "fleet_stats": fleet_stats(),
+    }
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "fleet-snapshot", None, "sync", t0, _telemetry.now() - t0,
+            {"world": world, "gathered": gathered, "ranks": len(planes)},
+        )
+    return out
+
+
+def _prom_name(key: str) -> str:
+    return "metrics_tpu_fleet_" + "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
+
+
+def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Render a :func:`fleet_snapshot` as a Prometheus exposition with
+    ``rank`` (and ``phase``) labels — the scrape face of the fleet plane.
+
+    Families: fleet-level gauges (``world_size``, ``dead_ranks``, ``epoch``,
+    ``gathered``), the aggregate counters (``metrics_tpu_fleet_<key>``,
+    typed ``counter``) and aggregate gauges (``_min``/``_median``/``_max``),
+    per-rank liveness/health gauges (``rank`` label), the per-rank sync
+    phase statistics (``rank`` + ``phase`` labels) and the straggler
+    deviation scores. Samples of one family are grouped under a single
+    ``# TYPE`` line, as the text format requires.
+
+    .. warning:: With no ``snap`` argument this calls
+       :func:`fleet_snapshot`, which in a multi-rank world is a
+       **collective** — every live rank must enter it in lockstep, so do
+       NOT wire the no-arg form into an independently-scraped per-rank
+       ``/metrics`` endpoint. Gather once at a coordinated point in the
+       serving loop and render the result (``fleet_prometheus_text(snap)``)
+       from the scrape handler; the per-rank local exposition
+       (:func:`metrics_tpu.prometheus_text`) needs no coordination.
+
+    Example:
+        >>> from metrics_tpu import fleet_prometheus_text
+        >>> text = fleet_prometheus_text()
+        >>> text.splitlines()[0]
+        '# TYPE metrics_tpu_fleet_world_size gauge'
+        >>> 'metrics_tpu_fleet_rank_live{rank="' in text
+        True
+    """
+    snap = fleet_snapshot() if snap is None else snap
+    families: List[Tuple[str, str, List[str]]] = []  # (name, kind, sample lines)
+
+    def family(name: str, kind: str, samples: List[Tuple[str, float]]) -> None:
+        if not samples:
+            return
+        lines = []
+        for labels, value in samples:
+            rendered = str(int(value)) if float(value).is_integer() else repr(float(value))
+            lines.append(f"{name}{labels} {rendered}")
+        families.append((name, kind, lines))
+
+    family("metrics_tpu_fleet_world_size", "gauge", [("", snap["world_size"])])
+    family("metrics_tpu_fleet_dead_ranks", "gauge", [("", len(snap["dead_ranks"]))])
+    family("metrics_tpu_fleet_epoch", "gauge", [("", snap["epoch"])])
+    family("metrics_tpu_fleet_gathered", "gauge", [("", 1 if snap["gathered"] else 0)])
+
+    agg = snap.get("aggregate") or {}
+    for key, value in (agg.get("counters") or {}).items():
+        family(_prom_name(key), "counter", [("", float(value))])
+    for key, stats in (agg.get("gauges") or {}).items():
+        for stat in ("min", "median", "max"):
+            family(f"{_prom_name(key)}_{stat}", "gauge", [("", float(stats[stat]))])
+
+    ranks = snap.get("ranks") or {}
+    live_samples, dead_samples, degraded_samples = [], [], []
+    phase_samples: Dict[str, List[Tuple[str, float]]] = {
+        "count": [], "mean": [], "max": [], "total": []
+    }
+    for rank in sorted(ranks):
+        plane = ranks[rank]
+        label = f'{{rank="{rank}"}}'
+        alive = _is_live_plane(plane)
+        live_samples.append((label, 1 if alive else 0))
+        dead_samples.append((label, 1 if (isinstance(plane, dict) and plane.get("dead")) else 0))
+        if alive:
+            health = plane.get("sync_health") or {}
+            degraded_samples.append((label, 1 if health.get("degraded") else 0))
+            stats = plane.get("sync_phase_stats") or {}
+            for site in _telemetry.SYNC_PHASE_SITES:
+                block = stats.get(site) or {}
+                if not float(block.get("count", 0)):
+                    continue
+                plabel = f'{{rank="{rank}",phase="{site}"}}'
+                phase_samples["count"].append((plabel, float(block.get("count", 0))))
+                phase_samples["mean"].append((plabel, float(block.get("mean_s", 0.0))))
+                phase_samples["max"].append((plabel, float(block.get("max_s", 0.0))))
+                phase_samples["total"].append((plabel, float(block.get("total_s", 0.0))))
+    family("metrics_tpu_fleet_rank_live", "gauge", live_samples)
+    family("metrics_tpu_fleet_rank_dead", "gauge", dead_samples)
+    family("metrics_tpu_fleet_rank_degraded", "gauge", degraded_samples)
+    family("metrics_tpu_fleet_sync_phase_count", "gauge", phase_samples["count"])
+    family("metrics_tpu_fleet_sync_phase_mean_seconds", "gauge", phase_samples["mean"])
+    family("metrics_tpu_fleet_sync_phase_max_seconds", "gauge", phase_samples["max"])
+    family("metrics_tpu_fleet_sync_phase_total_seconds", "gauge", phase_samples["total"])
+
+    stragglers = snap.get("stragglers") or {}
+    dev_samples = []
+    for site, entry in (stragglers.get("phases") or {}).items():
+        for rank, dev in (entry.get("per_rank_deviation") or {}).items():
+            dev_samples.append((f'{{rank="{rank}",phase="{site}"}}', float(dev)))
+    family("metrics_tpu_fleet_straggler_deviation", "gauge", dev_samples)
+    flagged = [(f'{{rank="{r}"}}', 1.0) for r in stragglers.get("stragglers") or ()]
+    family("metrics_tpu_fleet_straggler_flagged", "gauge", flagged)
+
+    lines: List[str] = []
+    for name, kind, samples in families:
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------- merged trace
+def _anchor_points(rows: List[Dict[str, Any]]) -> Dict[Tuple[str, int], float]:
+    """Clock-alignment anchors: payload-collective spans carrying the
+    lockstep ``seq`` ordinal. Every rank blocks inside the same collective,
+    so same-seq spans mark (approximately) the same wall moment; the median
+    pairwise difference recovers the per-rank clock offset."""
+    anchors: Dict[Tuple[str, int], float] = {}
+    for row in rows:
+        attrs = row.get("attrs") or {}
+        if row.get("site") in ("sync-payload-gather", "sync-gather") and "seq" in attrs:
+            anchors[(row["site"], int(attrs["seq"]))] = float(row["t_start"]) + float(
+                row.get("dur") or 0.0
+            )
+    return anchors
+
+
+def export_fleet_trace(path: str) -> int:
+    """Gather every rank's span ring and write ONE merged Perfetto JSON with
+    one **process per rank** (``pid`` = rank, per-owner threads inside it),
+    so a cross-rank sync timeline — who entered the collective late, whose
+    unpack ran long — is visible in a single view.
+
+    Ranks are aligned on the shared monotonic axis: paired payload-gather
+    spans (identical lockstep ``seq`` ordinals) act as clock-offset anchors,
+    and each rank's timestamps shift by the median anchor difference against
+    the lowest-ranked participant (recorded under
+    ``otherData.clock_offsets_s``; alignment is approximate — anchors mark
+    the collective's *completion*, which skews by per-rank unblock order).
+    With a world size of 1 the local ring exports directly, zero
+    collectives. Returns the number of span events written; the output
+    passes ``tools/trace_report.py --check``.
+
+    Example:
+        >>> import os, tempfile
+        >>> from metrics_tpu import export_fleet_trace
+        >>> path = os.path.join(tempfile.mkdtemp(), "fleet-trace.json")
+        >>> _ = export_fleet_trace(path)
+        >>> os.path.exists(path)
+        True
+    """
+    from metrics_tpu.parallel import sync as _sync
+
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
+    wh = _sync.world_health()
+    world = fleet_world()
+    rank = local_rank()
+    dead = set(wh.get("dead_ranks") or ())
+    local_doc = {
+        "rank": rank,
+        "spans": _telemetry.spans(),
+        "snapshot": {k: v for k, v in _telemetry.snapshot().items() if k != "failure_log"},
+    }
+    docs: Dict[int, Dict[str, Any]] = {}
+    if world > 1:
+        blob = json.dumps(_telemetry._json_safe(local_doc), separators=(",", ":")).encode("utf-8")
+        payloads = _gather_blobs(blob, site="fleet-trace")
+        participants = _participant_ranks(world, dead)
+        dropped: List[int] = []
+        mismatched: List[int] = []
+        for r, raw in zip(participants, payloads):
+            try:
+                decoded = json.loads(raw.decode("utf-8"))
+                if isinstance(decoded, dict) and isinstance(decoded.get("spans"), list):
+                    # rows key POSITIONALLY (survivors ascending — the same
+                    # mapping fleet_snapshot uses); a row claiming another
+                    # rank's number must not overwrite that rank's ring
+                    if decoded.get("rank") not in (None, r):
+                        mismatched.append(r)
+                    docs[r] = decoded
+                else:
+                    dropped.append(r)
+            except (ValueError, UnicodeDecodeError):
+                dropped.append(r)
+        if dropped or mismatched:
+            # no-silent-caps: a rank whose ring was lost in transit must not
+            # read as "that rank emitted no spans"
+            from metrics_tpu.ops import faults as _faults
+
+            detail = []
+            if dropped:
+                detail.append(f"dropped undecodable row(s) for rank(s) {dropped}")
+            if mismatched:
+                detail.append(
+                    f"row(s) at position(s) {mismatched} claimed a different rank "
+                    "(kept under their positional rank)"
+                )
+            _faults.warn_fault(
+                _TRACE_DROP_WARN_OWNER,
+                "sync",
+                "export_fleet_trace " + "; ".join(detail) + "; the merged trace may "
+                "omit or misattribute those processes.",
+            )
+        if rank not in docs:
+            docs[rank] = local_doc
+    else:
+        docs[rank] = local_doc
+
+    # ---- clock alignment against the lowest-ranked participant ----
+    ref = min(docs)
+    ref_anchors = _anchor_points(docs[ref]["spans"])
+    offsets: Dict[int, float] = {}
+    for r, doc in sorted(docs.items()):
+        if r == ref:
+            offsets[r] = 0.0
+            continue
+        anchors = _anchor_points(doc["spans"])
+        shared = sorted(set(ref_anchors) & set(anchors))
+        offsets[r] = (
+            _median([ref_anchors[k] - anchors[k] for k in shared]) if shared else 0.0
+        )
+
+    # ---- one process per rank ----
+    aligned: List[Tuple[float, Dict[str, Any]]] = []
+    meta: List[Dict[str, Any]] = []
+    next_tid = 1
+    for r, doc in sorted(docs.items()):
+        meta.append(
+            {"ph": "M", "name": "process_name", "pid": r, "tid": 0, "ts": 0,
+             "args": {"name": f"rank {r}"}}
+        )
+        tids: Dict[str, int] = {}
+        for row in doc["spans"]:
+            owner = row.get("owner") or "global"
+            tid = tids.get(owner)
+            if tid is None:
+                tid = tids[owner] = next_tid
+                next_tid += 1
+                meta.append(
+                    {"ph": "M", "name": "thread_name", "pid": r, "tid": tid, "ts": 0,
+                     "args": {"name": owner}}
+                )
+            args: Dict[str, Any] = {"step": row.get("step"), "rank": r}
+            if row.get("lane"):
+                args["lane"] = row["lane"]
+            if row.get("attrs"):
+                args.update(_telemetry._json_safe(row["attrs"]))
+            ev: Dict[str, Any] = {
+                "name": row.get("site"),
+                "cat": row.get("lane") or "span",
+                "pid": r,
+                "tid": tid,
+                "args": args,
+            }
+            dur = float(row.get("dur") or 0.0)
+            if dur > 0:
+                ev["ph"] = "X"
+                ev["dur"] = round(dur * 1e6, 3)
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            aligned.append((float(row["t_start"]) + offsets[r], ev))
+
+    n_events = len(aligned)
+    t_min = min(t for t, _ in aligned) if aligned else 0.0
+    events: List[Dict[str, Any]] = []
+    for t, ev in sorted(aligned, key=lambda kv: kv[0]):
+        ev["ts"] = round(max(0.0, t - t_min) * 1e6, 3)
+        events.append(ev)
+
+    merged = merge_snapshots(
+        {r: {k: v for k, v in (doc.get("snapshot") or {}).items()} for r, doc in docs.items()}
+    )
+    doc_out = {
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "metrics_tpu.ops.fleetobs",
+            "schema": FLEET_SCHEMA,
+            "ranks": sorted(docs),
+            "dead_ranks": sorted(dead),
+            "clock_offsets_s": {str(r): offsets[r] for r in sorted(offsets)},
+        },
+        "snapshot": merged["counters"],
+        "traceEvents": meta + events,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc_out, fh, separators=(",", ":"))
+    _counters["fleet_trace_exports"] += 1
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "fleet-trace", None, "sync", t0, _telemetry.now() - t0,
+            {"world": world, "ranks": len(docs), "events": n_events},
+        )
+    return n_events

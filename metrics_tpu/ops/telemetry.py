@@ -50,10 +50,12 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
     "SPAN_SITES",
+    "SYNC_PHASE_SITES",
     "armed",
     "clear_spans",
     "emit",
     "export_trace",
+    "is_counter_key",
     "now",
     "prometheus_text",
     "register_reset",
@@ -62,6 +64,7 @@ __all__ = [
     "set_telemetry",
     "snapshot",
     "spans",
+    "sync_phase_stats",
     "telemetry_stats",
 ]
 
@@ -100,7 +103,23 @@ SPAN_SITES = {
     "journal-demote": "a journal generation failed verification (instant)",
     # suite (collections.py)
     "suite-sync": "one whole-suite sync (coalesced + individual members)",
+    # fleet plane (ops/fleetobs.py)
+    "fleet-gather": "one fleet metadata/blob exchange (length + padded payload)",
+    "fleet-snapshot": "one cross-rank snapshot gather + merge",
+    "fleet-trace": "one cross-rank span-ring gather + merged trace export",
 }
+
+#: The sync-protocol phases the fleet straggler report attributes
+#: (per-rank duration statistics reduced from the span ring — see
+#: :func:`sync_phase_stats` and ``ops/fleetobs.py``).
+SYNC_PHASE_SITES = (
+    "sync-pack",
+    "sync-metadata",
+    "sync-payload-gather",
+    "sync-unpack",
+    "sync-gather",
+    "suite-sync",
+)
 
 # ------------------------------------------------------------------ the gate
 #: Hot-path guard (same shape as ``faults.armed``): call sites check this one
@@ -109,6 +128,11 @@ SPAN_SITES = {
 armed: bool = os.environ.get("METRICS_TPU_TELEMETRY", "1") not in ("0", "false", "off")
 
 _DEFAULT_CAP = 4096
+
+#: Newest membership transitions carried in ``snapshot()['sync_health']`` —
+#: bounded so the fleet gather's payload stays small (the full 64-entry log
+#: stays on ``world_health()``).
+_TRANSITIONS_CAP = 32
 
 
 def _env_cap() -> int:
@@ -151,6 +175,34 @@ def set_telemetry(enabled: Optional[bool] = None, *, span_cap: Optional[int] = N
             _ring = deque(_ring, maxlen=cap)
 
 
+class _SpanRingWarnOwner:
+    """Warn-dedupe anchor for the ring-overflow warning (``faults.warn_fault``
+    keeps its once-per-domain marker on the owner instance)."""
+
+
+_OVERFLOW_WARN_OWNER = _SpanRingWarnOwner()
+_overflow_warned: List[bool] = [False]
+
+
+def _warn_overflow() -> None:
+    # no-silent-caps: truncation must be visible once. Runtime-deferred
+    # import — faults imports us at module load, so the cold overflow branch
+    # is the only place this module may reach back into it.
+    from metrics_tpu.ops import faults as _faults
+
+    _faults.warn_fault(
+        _OVERFLOW_WARN_OWNER,
+        "telemetry",
+        f"The telemetry span ring overflowed its {_ring.maxlen}-span capacity; the oldest "
+        "spans are being dropped (counted in spans_dropped). Raise METRICS_TPU_TELEMETRY_SPANS "
+        "or set_telemetry(span_cap=...) to retain a longer window.",
+    )
+
+
+def _reset_overflow_warning() -> None:
+    _overflow_warned[0] = False
+
+
 def emit(
     site: str,
     owner: Any = None,
@@ -165,6 +217,9 @@ def emit(
     event); ``owner`` may be the owning instance (stored as its type name)
     or a pre-rendered string."""
     _emitted[0] += 1
+    if len(_ring) == _ring.maxlen and not _overflow_warned[0]:
+        _overflow_warned[0] = True
+        _warn_overflow()
     _ring.append(
         (
             _step_provider(),
@@ -190,6 +245,32 @@ def spans() -> List[Dict[str, Any]]:
 def clear_spans() -> None:
     _ring.clear()
     _emitted[0] = 0
+
+
+def sync_phase_stats() -> Dict[str, Dict[str, float]]:
+    """Per-phase duration statistics for the sync-protocol span sites
+    (:data:`SYNC_PHASE_SITES`), reduced from the current span ring — the
+    per-rank plane the fleet straggler report compares across ranks
+    (``ops/fleetobs.py``). Schema-stable: every phase is always present
+    (zeros when no span of that site is retained); values are ring-windowed,
+    so they can fall as old spans drop — gauges, never counters."""
+    agg: Dict[str, Dict[str, float]] = {
+        site: {"count": 0, "total_s": 0.0, "mean_s": 0.0, "max_s": 0.0}
+        for site in SYNC_PHASE_SITES
+    }
+    for row in _ring:
+        site, dur = row[3], row[5]
+        if site not in agg or dur <= 0:
+            continue
+        d = agg[site]
+        d["count"] += 1
+        d["total_s"] += dur
+        if dur > d["max_s"]:
+            d["max_s"] = dur
+    for d in agg.values():
+        if d["count"]:
+            d["mean_s"] = d["total_s"] / d["count"]
+    return agg
 
 
 def telemetry_stats() -> Dict[str, Any]:
@@ -243,6 +324,9 @@ def reset_all(reset_warnings: bool = False) -> None:
 
 
 register_reset("telemetry", clear_spans)
+# overflow warn-once clears only under the explicit reset_warnings opt-in —
+# a plain counter reset must not resurrect the truncation warning
+register_warning_reset("telemetry", _reset_overflow_warning)
 
 
 # --------------------------------------------------------------------- faces
@@ -301,7 +385,14 @@ def snapshot() -> Dict[str, Any]:
         "sync_quorum_serves": out.get("sync_quorum_serves", 0),
         "sync_deadline_timeouts": out.get("sync_deadline_timeouts", 0),
         "fault_domain_counts": domain_counts,
+        # the bounded membership transition log (epoch bumps, peer-dead /
+        # rejoin records), each entry stamped with the shared monotonic step
+        # — the fleet merge orders membership events against spans with it
+        "transitions": [dict(t) for t in (wh.get("transitions") or ())[-_TRANSITIONS_CAP:]],
     }
+    # per-phase sync span statistics (the straggler-attribution plane) —
+    # ring-windowed gauges, one block per SYNC_PHASE_SITES entry
+    out["sync_phase_stats"] = sync_phase_stats()
     return out
 
 
@@ -321,6 +412,35 @@ def _flat_numeric(prefix: str, value: Any) -> Iterator[Tuple[str, float]]:
             yield from _flat_numeric(key, v)
 
 
+_COUNTER_PREFIXES = (
+    "builds", "hits", "deferred_", "fault_", "sync_", "journal_",
+    "spans_recorded", "spans_dropped", "monotonic_step",
+)
+# prefix matches that are NOT monotonically increasing (ratios recompute
+# per scrape and can fall; counter semantics — rate()/reset detection —
+# would read garbage off them)
+_GAUGE_SUFFIXES = ("_ratio",)
+# the flattened sync_health block is health STATE, not event counts: the
+# degraded flag clears, dead ranks rejoin, suspicion resets — every key
+# scrapes as a gauge even though the "sync_" prefix matches above. The
+# sync_phase_stats block is ring-windowed (old spans drop), so its counts
+# and totals can fall too.
+_GAUGE_PREFIXES = ("sync_health_", "sync_phase_stats_")
+
+
+def is_counter_key(key: str) -> bool:
+    """Whether a flattened snapshot key carries monotonic counter semantics
+    (vs gauge). The ONE classification the Prometheus exposition and the
+    fleet merge (counters summed, gauges min/median/max — ``ops/fleetobs``)
+    both ride, so a scrape and a fleet aggregate can never disagree about
+    what a key means."""
+    return (
+        key.startswith(_COUNTER_PREFIXES)
+        and not key.endswith(_GAUGE_SUFFIXES)
+        and not key.startswith(_GAUGE_PREFIXES)
+    )
+
+
 def prometheus_text(data: Optional[Dict[str, Any]] = None) -> str:
     """Render :func:`snapshot` (or ``data``) as a Prometheus-style text
     exposition: every numeric key (nested dicts flattened with ``_``) becomes
@@ -338,28 +458,10 @@ def prometheus_text(data: Optional[Dict[str, Any]] = None) -> str:
         True
     """
     data = snapshot() if data is None else data
-    counter_prefixes = (
-        "builds", "hits", "deferred_", "fault_", "sync_", "journal_",
-        "spans_recorded", "spans_dropped", "monotonic_step",
-    )
-    # prefix matches that are NOT monotonically increasing (ratios recompute
-    # per scrape and can fall; counter semantics — rate()/reset detection —
-    # would read garbage off them)
-    gauge_suffixes = ("_ratio",)
-    # the flattened sync_health block is health STATE, not event counts: the
-    # degraded flag clears, dead ranks rejoin, suspicion resets — every key
-    # scrapes as a gauge even though the "sync_" prefix matches above
-    gauge_prefixes = ("sync_health_",)
     lines: List[str] = []
     for key, value in sorted(_flat_numeric("", {k: v for k, v in data.items() if k != "failure_log"})):
         name = "metrics_tpu_" + "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
-        kind = (
-            "counter"
-            if key.startswith(counter_prefixes)
-            and not key.endswith(gauge_suffixes)
-            and not key.startswith(gauge_prefixes)
-            else "gauge"
-        )
+        kind = "counter" if is_counter_key(key) else "gauge"
         # integers render exactly ('%g' rounds to 6 significant digits — a
         # multi-MiB byte counter would scrape off by thousands); floats keep
         # repr's round-trip precision

@@ -488,9 +488,13 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
         gathered_bytes = int(np.prod(gathered.shape))
         _sync.note_collective("payload", nbytes=gathered_bytes, epoch=fence)
         if t_gather and _telemetry.armed:
+            # seq: the payload-collective ordinal, identical on every rank
+            # (collectives issue in lockstep) — the fleet trace merge pairs
+            # same-seq spans across ranks as clock-offset anchors
             _telemetry.emit(
                 "sync-payload-gather", nodes[0], "sync", t_gather, _telemetry.now() - t_gather,
-                {"bytes": gathered_bytes, "world": int(gathered.shape[0]), "epoch": fence},
+                {"bytes": gathered_bytes, "world": int(gathered.shape[0]), "epoch": fence,
+                 "seq": _sync._counters["sync_payload_collectives"]},
             )
         return gathered, rank_meta
 

@@ -742,9 +742,13 @@ def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Ar
         note_collective("shape")
         note_collective("payload", nbytes=int(result.nbytes))
         if t0 and _telemetry.armed:
+            # seq: the payload-collective ordinal — issued in lockstep on
+            # every rank, so the fleet trace merge pairs the k-th payload
+            # span across ranks as a clock-offset anchor (ops/fleetobs.py)
             _telemetry.emit(
                 "sync-gather", None, "sync", t0, _telemetry.now() - t0,
-                {"bytes": int(result.nbytes), "collectives": 2},
+                {"bytes": int(result.nbytes), "collectives": 2,
+                 "seq": _counters["sync_payload_collectives"]},
             )
         return [result]
 
@@ -768,7 +772,8 @@ def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Ar
     if t0 and _telemetry.armed:
         _telemetry.emit(
             "sync-gather", None, "sync", t0, _telemetry.now() - t0,
-            {"bytes": gathered_bytes, "collectives": 2},
+            {"bytes": gathered_bytes, "collectives": 2,
+             "seq": _counters["sync_payload_collectives"]},
         )
     return out
 

@@ -1,0 +1,457 @@
+"""Fleet observability plane: the ISSUE-9 contracts.
+
+Contracts (`metrics_tpu/ops/fleetobs.py`):
+
+- **Single-process is free** — with a world size of 1, ``fleet_snapshot()``
+  serves the local plane directly: ZERO collectives issued, schema identical
+  to the gathered case.
+- **Exact aggregation** — in a (fake) multi-rank world the aggregate plane's
+  counters equal the EXACT per-key sum of the per-rank planes, gauges reduce
+  to min/median/max, and the merge rides the real epoch-fenced
+  ``_host_allgather`` blob protocol.
+- **Dead ranks** — declared-dead ranks appear as placeholder planes sourced
+  from the membership registry and are excluded from every aggregate.
+- **Straggler attribution** — per-rank ``sync_phase_stats`` reduce into a
+  report naming the slowest ranks per phase with deviation scores; the fleet
+  Prometheus exposition carries ``rank``/``phase`` labels and is well-formed.
+- **Merged trace** — ``export_fleet_trace`` emits one process per rank,
+  clock-aligned on paired payload-gather anchors, and the output passes
+  ``tools/trace_report.py --check``; ``--diff`` reports counter deltas
+  between two snapshots.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, fleetobs, telemetry
+from metrics_tpu.parallel import bucketing
+from metrics_tpu.parallel import sync as psync
+from metrics_tpu.utils.exceptions import EpochFault
+
+_REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO_DIR not in sys.path:
+    sys.path.insert(0, _REPO_DIR)
+
+from tools.trace_report import check_trace, diff_report  # noqa: E402
+
+RNG = np.random.RandomState(9)
+DIST_ON = lambda: True  # noqa: E731
+
+
+def _suite():
+    s = mt.MetricCollection({"mean": mt.MeanMetric(), "acc": mt.Accuracy()})
+    s.update(
+        jnp.asarray(RNG.rand(32).astype(np.float32)),
+        jnp.asarray(RNG.randint(0, 2, 32)),
+    )
+    return s
+
+
+@pytest.fixture(autouse=True)
+def _armed_clean_world():
+    """Armed recorder, empty ring, pristine membership registry per test."""
+    was = telemetry.armed
+    telemetry.set_telemetry(True)
+    telemetry.clear_spans()
+    psync.reset_membership()
+    yield
+    psync.reset_membership()
+    telemetry.set_telemetry(was)
+    telemetry.clear_spans()
+
+
+def _sync_cycle(suite):
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+
+
+class _FakeWorld:
+    """A 3-rank world at the ``_host_allgather`` transport seam: the real
+    blob protocol (length exchange + padded payload) runs; rank 1/2 rows are
+    produced by ``make_blobs()`` at length-exchange time."""
+
+    def __init__(self, monkeypatch, make_blobs):
+        self.make_blobs = make_blobs
+        self.blobs = []
+        psync.set_expected_world(3)
+        monkeypatch.setattr(bucketing, "_host_allgather", self._host)
+
+    def _host(self, vec):
+        vec = np.asarray(vec)
+        if vec.dtype != np.uint8:  # the length exchange
+            self.blobs = self.make_blobs()
+            return np.stack([vec] + [np.asarray([len(b)], np.int64) for b in self.blobs])
+        rows = [vec]
+        for b in self.blobs:
+            row = np.zeros(vec.size, np.uint8)
+            row[: len(b)] = np.frombuffer(b, np.uint8)
+            rows.append(row)
+        return np.stack(rows)
+
+
+def _plane_blobs(tweak=None):
+    def make():
+        out = []
+        for r in (1, 2):
+            plane = fleetobs._local_plane()
+            if tweak is not None:
+                tweak(r, plane)
+            out.append(json.dumps(plane, separators=(",", ":")).encode())
+        return out
+
+    return make
+
+
+# ------------------------------------------------------------- single process
+def test_single_process_local_plane_zero_collectives():
+    suite = _suite()
+    _sync_cycle(suite)
+    s0 = engine.engine_stats()["sync_collectives_issued"]
+    snap = fleetobs.fleet_snapshot()
+    assert engine.engine_stats()["sync_collectives_issued"] == s0, (
+        "a world-size-1 fleet_snapshot issued collectives"
+    )
+    assert snap["world_size"] == 1 and snap["gathered"] is False
+    assert sorted(snap["ranks"]) == [snap["rank"]] == [0]
+    local = snap["ranks"][0]
+    assert "failure_log" not in local
+    assert local["snapshot_schema"] == 1
+    # the lone plane aggregates as itself
+    assert snap["aggregate"]["ranks_merged"] == [0]
+    assert snap["aggregate"]["counters"]["sync_payload_collectives"] == local[
+        "sync_payload_collectives"
+    ]
+
+
+def test_fleet_schema_stable_and_keys():
+    snap = fleetobs.fleet_snapshot()
+    assert snap["fleet_schema"] == fleetobs.FLEET_SCHEMA == 1
+    expected = {
+        "fleet_schema", "world_size", "rank", "epoch", "gathered", "dead_ranks",
+        "ranks", "aggregate", "stragglers", "world_health", "fleet_stats",
+    }
+    assert set(snap) == expected
+    assert set(snap) == set(fleetobs.fleet_snapshot()), "fleet keys drift call-over-call"
+    assert set(snap["aggregate"]) == {"counters", "gauges", "ranks_merged"}
+    assert set(snap["stragglers"]) == {"phases", "ranked", "threshold", "stragglers"}
+
+
+# ------------------------------------------------------------- fake multi-rank
+def test_fleet_merge_sums_counters_exactly(monkeypatch):
+    suite = _suite()
+    _sync_cycle(suite)
+
+    def tweak(r, plane):
+        plane["deferred_steps"] = int(plane.get("deferred_steps", 0)) + 100 * r
+        plane["sync_bytes_gathered"] = int(plane.get("sync_bytes_gathered", 0)) + r
+
+    _FakeWorld(monkeypatch, _plane_blobs(tweak))
+    snap = fleetobs.fleet_snapshot()
+    assert snap["world_size"] == 3 and snap["gathered"] is True
+    assert sorted(snap["ranks"]) == [0, 1, 2]
+    # independent exact-sum oracle over the per-rank planes
+    expected = {}
+    gauge_vals = {}
+    for plane in snap["ranks"].values():
+        for key, val in telemetry._flat_numeric("", plane):
+            if fleetobs._fleet_is_counter(key):
+                expected[key] = expected.get(key, 0) + val
+            else:
+                gauge_vals.setdefault(key, []).append(val)
+    got = snap["aggregate"]["counters"]
+    assert set(got) == set(expected)
+    for key, val in expected.items():
+        assert float(got[key]) == float(val), f"aggregate[{key!r}] != exact sum"
+    # the deliberately-offset counters prove three distinct planes summed
+    base = snap["ranks"][0]["deferred_steps"]
+    assert got["deferred_steps"] == 3 * base + 300
+    # gauges reduce to min/median/max over the live planes
+    gauges = snap["aggregate"]["gauges"]
+    for key, vals in gauge_vals.items():
+        assert gauges[key]["min"] == min(vals)
+        assert gauges[key]["max"] == max(vals)
+        assert gauges[key]["median"] == sorted(vals)[1]  # 3 planes
+    # the shared monotonic event axis reduces as a gauge (cross-rank step
+    # skew), never as a 3x sum
+    assert "monotonic_step" not in got
+    assert "monotonic_step" in gauges
+
+
+def test_fleet_merge_dead_rank_placeholder_excluded(monkeypatch):
+    suite = _suite()
+    _sync_cycle(suite)
+    # declare rank 2 dead: the gather's rows are the survivors {0, 1}
+    psync.set_expected_world(3)
+    psync.mark_peer_dead(2, "test-dead")
+
+    def make():
+        plane = fleetobs._local_plane()
+        plane["deferred_steps"] = int(plane.get("deferred_steps", 0)) + 7
+        return [json.dumps(plane, separators=(",", ":")).encode()]
+
+    world = _FakeWorld(monkeypatch, make)
+    assert world  # registry already declared the world via mark_peer_dead
+    snap = fleetobs.fleet_snapshot()
+    assert snap["world_size"] == 3
+    assert snap["dead_ranks"] == [2]
+    assert sorted(snap["ranks"]) == [0, 1, 2]
+    dead_plane = snap["ranks"][2]
+    assert dead_plane["dead"] is True and dead_plane["rank"] == 2
+    assert dead_plane["declared_dead_epoch"] is not None
+    # aggregates exclude the placeholder: only the two live planes merged
+    assert snap["aggregate"]["ranks_merged"] == [0, 1]
+    live_sum = (
+        snap["ranks"][0]["deferred_steps"] + snap["ranks"][1]["deferred_steps"]
+    )
+    assert snap["aggregate"]["counters"]["deferred_steps"] == live_sum
+    # the straggler report also ignores the dead plane
+    for entry in snap["stragglers"]["phases"].values():
+        assert 2 not in entry["per_rank_mean_s"]
+
+
+def test_fleet_gather_rides_the_epoch_fence(monkeypatch):
+    """A membership change racing the fleet gather fences the retry with the
+    classified EpochFault instead of re-issuing into the wrong cohort."""
+    suite = _suite()
+    _sync_cycle(suite)
+    monkeypatch.setenv("METRICS_TPU_SYNC_RETRIES", "1")
+    monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+    psync.set_expected_world(3)
+    calls = {"n": 0}
+
+    def racing_host(vec):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            psync.bump_epoch("test-race")
+            raise RuntimeError("transport reset by membership change")
+        raise AssertionError("a stale-epoch retry reached the transport")
+
+    monkeypatch.setattr(bucketing, "_host_allgather", racing_host)
+    with pytest.raises(EpochFault):
+        fleetobs.fleet_snapshot()
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------- stragglers
+def test_straggler_report_names_delayed_rank(monkeypatch):
+    suite = _suite()
+    _sync_cycle(suite)
+
+    def tweak(r, plane):
+        if r == 2:
+            for block in (plane.get("sync_phase_stats") or {}).values():
+                for key in ("total_s", "mean_s", "max_s"):
+                    block[key] = float(block.get(key, 0.0)) * 10.0
+
+    _FakeWorld(monkeypatch, _plane_blobs(tweak))
+    report = fleetobs.fleet_snapshot()["stragglers"]
+    phase = report["phases"]["sync-payload-gather"]
+    assert phase["slowest_rank"] == 2
+    assert phase["deviation"] == pytest.approx(9.0)  # (10x - 1x) / 1x
+    assert set(phase["per_rank_mean_s"]) == {0, 1, 2}
+    assert 2 in report["stragglers"]
+    assert report["ranked"][0]["rank"] == 2
+    # the healthy ranks sit at the median: not flagged
+    assert 0 not in report["stragglers"] and 1 not in report["stragglers"]
+
+
+def test_straggler_threshold_env(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_STRAGGLER_THRESHOLD", "2.5")
+    assert fleetobs.straggler_threshold() == 2.5
+    monkeypatch.setenv("METRICS_TPU_STRAGGLER_THRESHOLD", "garbage")
+    with pytest.warns(UserWarning, match="METRICS_TPU_STRAGGLER_THRESHOLD"):
+        engine.reset_stats(reset_warnings=True)
+        assert fleetobs.straggler_threshold() == 0.5
+
+
+def test_fleet_prometheus_well_formed(monkeypatch):
+    suite = _suite()
+    _sync_cycle(suite)
+
+    def tweak(r, plane):
+        if r == 2:
+            for block in (plane.get("sync_phase_stats") or {}).values():
+                for key in ("total_s", "mean_s", "max_s"):
+                    block[key] = float(block.get(key, 0.0)) * 10.0
+
+    _FakeWorld(monkeypatch, _plane_blobs(tweak))
+    text = fleetobs.fleet_prometheus_text()
+    lines = [ln for ln in text.strip().splitlines() if ln]
+    sample_re = re.compile(
+        r"^(metrics_tpu_fleet_[a-zA-Z0-9_]+)(\{[a-z]+=\"[^\"]+\"(,[a-z]+=\"[^\"]+\")*\})? (-?[0-9.e+-]+)$"
+    )
+    current_family = None
+    seen_families = set()
+    for ln in lines:
+        if ln.startswith("# TYPE "):
+            _, _, name, kind = ln.split(" ")
+            assert kind in ("counter", "gauge")
+            assert name not in seen_families, f"family {name} split across TYPE lines"
+            seen_families.add(name)
+            current_family = name
+            continue
+        m = sample_re.match(ln)
+        assert m, f"malformed sample line: {ln!r}"
+        assert m.group(1) == current_family, f"{ln!r} outside its TYPE block"
+        float(m.group(4))
+    # the headline fleet families, with rank/phase labels where promised
+    assert "# TYPE metrics_tpu_fleet_world_size gauge" in text
+    assert "# TYPE metrics_tpu_fleet_sync_collectives_issued counter" in text
+    assert 'metrics_tpu_fleet_rank_live{rank="2"} 1' in text
+    assert re.search(
+        r'metrics_tpu_fleet_sync_phase_mean_seconds\{rank="2",phase="sync-payload-gather"\}', text
+    )
+    assert re.search(
+        r'metrics_tpu_fleet_straggler_deviation\{rank="2",phase="[a-z-]+"\}', text
+    )
+    assert 'metrics_tpu_fleet_straggler_flagged{rank="2"} 1' in text
+
+
+# -------------------------------------------------------------- merged trace
+def test_export_fleet_trace_merged_and_aligned(monkeypatch, tmp_path):
+    suite = _suite()
+    _sync_cycle(suite)
+    skew = {1: 0.004, 2: -0.006}
+
+    def make():
+        out = []
+        for r in (1, 2):
+            doc = {
+                "rank": r,
+                "spans": [dict(s) for s in telemetry.spans()],
+                "snapshot": {
+                    k: v
+                    for k, v in telemetry._json_safe(telemetry.snapshot()).items()
+                    if k != "failure_log"
+                },
+            }
+            for s in doc["spans"]:
+                s["t_start"] = float(s["t_start"]) + skew[r]
+            out.append(json.dumps(telemetry._json_safe(doc), separators=(",", ":")).encode())
+        return out
+
+    _FakeWorld(monkeypatch, make)
+    path = str(tmp_path / "fleet-trace.json")
+    n = fleetobs.export_fleet_trace(path)
+    assert n > 0
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert not check_trace(doc), check_trace(doc)
+    # one process per rank, named
+    procs = {
+        e["pid"]: e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert procs == {0: "rank 0", 1: "rank 1", 2: "rank 2"}
+    # every non-meta event belongs to a rank process and carries its rank
+    for ev in doc["traceEvents"]:
+        if ev["ph"] == "M":
+            continue
+        assert ev["pid"] in (0, 1, 2)
+        assert ev["args"]["rank"] == ev["pid"]
+    # the recovered clock offsets invert the injected skew
+    offsets = doc["otherData"]["clock_offsets_s"]
+    for r in (1, 2):
+        assert abs(float(offsets[str(r)]) + skew[r]) < 1e-6
+    # timestamps globally monotonic (what --check pins) and non-negative
+    ts = [e["ts"] for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert ts == sorted(ts) and all(t >= 0 for t in ts)
+    # the embedded fleet snapshot is the summed counter plane
+    assert doc["snapshot"]["spans_recorded"] > 0
+
+
+def test_export_fleet_trace_single_process(tmp_path):
+    suite = _suite()
+    _sync_cycle(suite)
+    s0 = engine.engine_stats()["sync_collectives_issued"]
+    path = str(tmp_path / "local-fleet.json")
+    n = fleetobs.export_fleet_trace(path)
+    assert engine.engine_stats()["sync_collectives_issued"] == s0
+    assert n > 0
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert not check_trace(doc)
+    procs = [e for e in doc["traceEvents"] if e["ph"] == "M" and e["name"] == "process_name"]
+    assert len(procs) == 1 and procs[0]["args"]["name"] == "rank 0"
+
+
+# ------------------------------------------------------------------ the tools
+def test_trace_report_diff(tmp_path):
+    a = {"sync_collectives_issued": 3, "gone_key": 1.5, "same": 7}
+    b = {"sync_collectives_issued": 9, "fresh_key": 2, "same": 7}
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    with open(pa, "w") as fh:
+        json.dump(a, fh)
+    with open(pb, "w") as fh:
+        json.dump(b, fh)
+    text = diff_report(pa, pb)
+    assert "+ fresh_key = 2" in text
+    assert "- gone_key (was 1.5)" in text
+    assert "sync_collectives_issued" in text and "(+6)" in text
+    assert "same" not in [ln.split()[0] for ln in text.splitlines() if ln.strip()]
+
+
+def test_trace_report_diff_reads_embedded_trace_snapshots(tmp_path):
+    suite = _suite()
+    _sync_cycle(suite)
+    pa = str(tmp_path / "a.json")
+    pb = str(tmp_path / "b.json")
+    fleetobs.export_fleet_trace(pa)
+    _sync_cycle(suite)
+    fleetobs.export_fleet_trace(pb)
+    text = diff_report(pa, pb)
+    assert "top movers" in text
+    assert "sync_payload_collectives" in text or "spans_recorded" in text
+
+
+# -------------------------------------------------------------- suite + reset
+def test_collection_fleet_health():
+    suite = _suite()
+    out = suite.fleet_health()
+    assert out["fleet_schema"] == 1
+    assert "suite" in out and "degraded" in out["suite"]
+    assert out["suite"]["epoch"] == psync.world_epoch()
+
+
+def test_fleet_counters_reset_with_the_registry():
+    fleetobs.fleet_snapshot()
+    assert fleetobs.fleet_stats()["fleet_snapshots"] >= 1
+    engine.reset_stats()
+    assert fleetobs.fleet_stats() == {
+        "fleet_snapshots": 0,
+        "fleet_trace_exports": 0,
+        "fleet_gathers": 0,
+        "fleet_gather_bytes": 0,
+    }
+
+
+def test_fleet_sites_documented():
+    suite = _suite()
+    _sync_cycle(suite)
+    fleetobs.fleet_snapshot()
+    emitted = {s["site"] for s in telemetry.spans()}
+    assert "fleet-snapshot" in emitted
+    undocumented = emitted - set(telemetry.SPAN_SITES)
+    assert not undocumented, undocumented
+
+
+def test_merge_snapshots_unit():
+    planes = {
+        0: {"sync_payload_collectives": 2, "cached": 5, "sync_coalesce_ratio": 2.0},
+        1: {"sync_payload_collectives": 3, "cached": 7, "sync_coalesce_ratio": 4.0},
+        2: {"dead": True},
+    }
+    out = fleetobs.merge_snapshots(planes)
+    assert out["ranks_merged"] == [0, 1]
+    assert out["counters"] == {"sync_payload_collectives": 5}
+    assert out["gauges"]["cached"] == {"min": 5.0, "median": 6.0, "max": 7.0}
+    assert out["gauges"]["sync_coalesce_ratio"] == {"min": 2.0, "median": 3.0, "max": 4.0}

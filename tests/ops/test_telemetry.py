@@ -247,6 +247,7 @@ def test_snapshot_schema_superset_and_stable():
         "monotonic_step",
         "programs",
         "sync_health",
+        "sync_phase_stats",
     ):
         assert key in snap, f"snapshot is missing its own {key!r}"
     assert snap["snapshot_schema"] == 1
@@ -265,7 +266,14 @@ def test_snapshot_schema_superset_and_stable():
         "sync_quorum_serves",
         "sync_deadline_timeouts",
         "fault_domain_counts",
+        "transitions",
     }
+    # the per-phase sync span statistics (the fleet straggler input) cover
+    # every documented phase, schema-stable
+    stats = snap["sync_phase_stats"]
+    assert set(stats) == set(telemetry.SYNC_PHASE_SITES)
+    for block in stats.values():
+        assert set(block) == {"count", "total_s", "mean_s", "max_s"}
 
 
 def test_prometheus_text_well_formed():
@@ -378,6 +386,71 @@ def test_span_ring_bounded():
         assert stats["spans_dropped"] == 68
     finally:
         telemetry.set_telemetry(True, span_cap=4096)
+
+
+def test_span_ring_overflow_warns_exactly_once():
+    """No-silent-caps: the first dropped span warns (via faults.warn_fault),
+    later drops stay silent, a plain counter reset does NOT resurrect the
+    warning, and reset_stats(reset_warnings=True) is the explicit opt-in
+    that lets the next overflow warn again."""
+    import warnings as _warnings
+
+    telemetry.set_telemetry(True, span_cap=32)
+    engine.reset_stats(reset_warnings=True)  # an earlier test may have overflowed
+    try:
+        with pytest.warns(UserWarning, match="span ring overflowed"):
+            for _ in range(40):
+                telemetry.emit("engine-enqueue", None, "defer")
+        # exactly once: further drops are silent
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            for _ in range(10):
+                telemetry.emit("engine-enqueue", None, "defer")
+        # a plain counter reset clears the ring but must NOT re-warn
+        engine.reset_stats()
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            for _ in range(40):
+                telemetry.emit("engine-enqueue", None, "defer")
+        # the explicit opt-in re-arms the warning
+        engine.reset_stats(reset_warnings=True)
+        with pytest.warns(UserWarning, match="span ring overflowed"):
+            for _ in range(40):
+                telemetry.emit("engine-enqueue", None, "defer")
+    finally:
+        telemetry.set_telemetry(True, span_cap=4096)
+
+
+def test_snapshot_sync_phase_stats_reduce_the_ring():
+    suite = _suite()
+    telemetry.clear_spans()
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    stats = mt.telemetry_snapshot()["sync_phase_stats"]
+    for site in ("sync-pack", "sync-payload-gather", "sync-unpack", "suite-sync"):
+        block = stats[site]
+        assert block["count"] >= 1, f"{site} saw no spans"
+        assert block["mean_s"] > 0 and block["max_s"] >= block["mean_s"]
+        assert block["total_s"] >= block["max_s"]
+    # phases with no retained spans report zeros, not missing keys (a
+    # static-fast-lane single-process sync exchanges no metadata)
+    assert stats["sync-metadata"] == {"count": 0, "total_s": 0.0, "mean_s": 0.0, "max_s": 0.0}
+
+
+def test_sync_health_carries_bounded_transition_log():
+    from metrics_tpu.parallel import sync as psync
+
+    before = len(mt.telemetry_snapshot()["sync_health"]["transitions"])
+    epoch = psync.bump_epoch("test-transition")
+    trans = mt.telemetry_snapshot()["sync_health"]["transitions"]
+    assert len(trans) <= 32
+    assert len(trans) >= min(32, before + 1)
+    last = trans[-1]
+    assert last["epoch"] == epoch == psync.world_epoch()
+    assert last["reason"] == "test-transition"
+    # ordered on the shared monotonic step axis, so membership events sort
+    # against spans and failure_log entries without a second clock
+    assert last["step"] <= faults.current_step()
 
 
 # ------------------------------------------------------------- reset registry

@@ -21,9 +21,19 @@ Modes::
     python tools/trace_report.py --smoke              # run a small suite with
                                                       # telemetry armed, export,
                                                       # validate, report
+    python tools/trace_report.py --diff A B           # counter-delta report
+                                                      # between two snapshots
+                                                      # or exported traces
+    python tools/trace_report.py --fleet-smoke        # simulate a 3-rank fleet,
+                                                      # merge + export + validate
+                                                      # the multi-rank trace,
+                                                      # smoke the --diff path
 
 ``--check`` exits non-zero on any structural problem (not valid JSON, missing
 or non-monotonic timestamps, malformed events) — the ``make trace`` gate.
+``--diff`` accepts either an ``export_trace``/``export_fleet_trace`` JSON
+(its embedded ``snapshot`` is used) or a raw ``telemetry_snapshot()`` dump,
+and prints new/removed keys plus the top movers.
 """
 from __future__ import annotations
 
@@ -54,6 +64,9 @@ COLLECTIVE_SITES = (
     "sync-unpack",
     "sync-gather",
     "suite-sync",
+    "fleet-gather",
+    "fleet-snapshot",
+    "fleet-trace",
 )
 
 
@@ -200,6 +213,137 @@ def summarize(doc: Dict[str, Any], top: int = 10) -> str:
     return "\n".join(lines)
 
 
+def _flatten_numeric(prefix: str, value: Any) -> Dict[str, float]:
+    """Flatten nested dicts to dotted numeric keys (booleans as 0/1; lists
+    and strings dropped) — standalone so ``--diff`` works on any two files
+    without importing the library."""
+    out: Dict[str, float] = {}
+    if isinstance(value, bool):
+        out[prefix] = 1.0 if value else 0.0
+    elif isinstance(value, (int, float)):
+        out[prefix] = float(value)
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(_flatten_numeric(key, v))
+    return out
+
+
+def load_snapshot(path: str) -> Dict[str, float]:
+    """Load the numeric snapshot out of ``path``: an ``export_trace`` /
+    ``export_fleet_trace`` JSON contributes its embedded ``snapshot``; any
+    other JSON object is treated as a raw snapshot dump."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        doc = doc.get("snapshot") or {}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: no snapshot object found")
+    return _flatten_numeric("", {k: v for k, v in doc.items() if k != "failure_log"})
+
+
+def diff_report(a_path: str, b_path: str, top: int = 10) -> str:
+    """Counter-delta report between two snapshots/traces: new and removed
+    keys, then the top movers by absolute delta (B - A)."""
+    a, b = load_snapshot(a_path), load_snapshot(b_path)
+    new = sorted(set(b) - set(a))
+    removed = sorted(set(a) - set(b))
+    movers = sorted(
+        ((k, b[k] - a[k]) for k in set(a) & set(b) if b[k] != a[k]),
+        key=lambda kv: (-abs(kv[1]), kv[0]),
+    )
+    lines = [f"== snapshot diff: {os.path.basename(a_path)} -> {os.path.basename(b_path)} =="]
+    lines.append(f"  keys: {len(a)} -> {len(b)}  new={len(new)}  removed={len(removed)}  changed={len(movers)}")
+    if new:
+        lines.append("  new keys:")
+        lines.extend(f"    + {k} = {b[k]:g}" for k in new[:top])
+        if len(new) > top:
+            lines.append(f"    ... and {len(new) - top} more")
+    if removed:
+        lines.append("  removed keys:")
+        lines.extend(f"    - {k} (was {a[k]:g})" for k in removed[:top])
+        if len(removed) > top:
+            lines.append(f"    ... and {len(removed) - top} more")
+    lines.append(f"  top movers (of {len(movers)}):")
+    for k, d in movers[:top]:
+        lines.append(f"    {k:<52} {a[k]:>12g} -> {b[k]:<12g} ({'+' if d >= 0 else ''}{d:g})")
+    if not movers:
+        lines.append("    (no changed keys)")
+    return "\n".join(lines)
+
+
+def run_fleet_smoke(out_path: str) -> str:
+    """The ``make trace`` fleet gate: run the local suite cycle, simulate a
+    3-rank world at the fleet blob-gather seam (rank 2 deliberately slow in
+    the payload-gather phase, both fake ranks clock-skewed), assert the
+    straggler report names the slow rank, export the merged one-process-per-
+    rank trace, and smoke the ``--diff`` path on two consecutive snapshots.
+    The caller validates the written trace with :func:`check_trace`."""
+    import copy
+    import tempfile
+
+    if _REPO_DIR not in sys.path:
+        sys.path.insert(0, _REPO_DIR)
+    run_smoke(out_path + ".local.json")  # drives a real suite cycle: sync spans + seq anchors
+
+    from metrics_tpu.ops import fleetobs
+    from metrics_tpu.parallel import sync as psync
+
+    saved_gather = fleetobs._gather_blobs
+    try:
+
+        def fake_gather(blob: bytes, *, owner=None, site="fleet-gather"):
+            doc = json.loads(blob.decode("utf-8"))
+            rows = [blob]
+            for rank, skew_s, slowdown in ((1, 0.002, 1.1), (2, -0.003, 8.0)):
+                d = copy.deepcopy(doc)
+                if isinstance(d.get("spans"), list):  # the trace gather
+                    d["rank"] = rank
+                    for s in d["spans"]:
+                        s["t_start"] = float(s["t_start"]) + skew_s
+                        if s["site"] == "sync-payload-gather":
+                            s["dur"] = float(s.get("dur") or 0.0) * slowdown
+                else:  # the snapshot gather
+                    for block in (d.get("sync_phase_stats") or {}).values():
+                        for key in ("total_s", "mean_s", "max_s"):
+                            block[key] = float(block.get(key, 0.0)) * slowdown
+                rows.append(json.dumps(d, separators=(",", ":")).encode("utf-8"))
+            return rows
+
+        fleetobs._gather_blobs = fake_gather
+        psync.set_expected_world(3)
+
+        snap = fleetobs.fleet_snapshot()
+        assert snap["world_size"] == 3 and snap["gathered"], "fleet smoke never gathered"
+        assert sorted(snap["ranks"]) == [0, 1, 2], sorted(snap["ranks"])
+        report = snap["stragglers"]
+        assert 2 in report["stragglers"], (
+            f"the deliberately-slow rank 2 was not flagged: {report['ranked']}"
+        )
+        n = fleetobs.export_fleet_trace(out_path)
+        assert n > 0, "fleet trace exported no span events"
+
+        # ---- the --diff smoke: two consecutive snapshots must show movers ----
+        d = tempfile.mkdtemp(prefix="mt-fleet-diff-")
+        a_path, b_path = os.path.join(d, "a.json"), os.path.join(d, "b.json")
+        with open(a_path, "w", encoding="utf-8") as fh:
+            json.dump(snap["aggregate"]["counters"], fh)
+        snap2 = fleetobs.fleet_snapshot()
+        with open(b_path, "w", encoding="utf-8") as fh:
+            json.dump(snap2["aggregate"]["counters"], fh)
+        text = diff_report(a_path, b_path)
+        # consecutive snapshots must actually MOVE (the gathers themselves
+        # advance the collective-slot and span counters); a diff that finds
+        # nothing changed means the counter planes froze
+        assert "(no changed keys)" not in text, text
+        assert " changed=0" not in text.splitlines()[1], text
+        print(text)
+    finally:
+        fleetobs._gather_blobs = saved_gather
+        psync.reset_membership()
+    return out_path
+
+
 def run_smoke(out_path: str) -> str:
     """The ``make trace`` driver: run a small 4-metric suite with telemetry
     armed (deferred updates, one coalesced sync, a compute, one journal
@@ -244,21 +388,43 @@ def main(argv: List[str]) -> int:
         action="store_true",
         help="run a small telemetry-armed suite, export, validate and report (the `make trace` gate)",
     )
-    ap.add_argument("--out", default=None, help="--smoke: where to write the trace")
+    ap.add_argument(
+        "--fleet-smoke",
+        action="store_true",
+        help="simulate a 3-rank fleet (straggler flagged), export + validate the merged "
+        "multi-rank trace, and smoke the --diff path (the `make trace` fleet gate)",
+    )
+    ap.add_argument(
+        "--diff",
+        nargs=2,
+        metavar=("A", "B"),
+        default=None,
+        help="counter-delta report between two exported snapshots/traces (new/removed keys, top movers)",
+    )
+    ap.add_argument("--out", default=None, help="--smoke/--fleet-smoke: where to write the trace")
     args = ap.parse_args(argv)
 
-    if args.smoke:
+    if args.diff:
+        try:
+            print(diff_report(args.diff[0], args.diff[1], top=args.top))
+        except (OSError, ValueError) as err:
+            print(f"diff FAILED: {type(err).__name__}: {err}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.smoke or args.fleet_smoke:
         import tempfile
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         os.environ.setdefault("METRICS_TPU_VALIDATION", "first")
-        out = args.out or os.path.join(tempfile.mkdtemp(prefix="mt-trace-"), "smoke-trace.json")
-        path = run_smoke(out)
+        name = "fleet-trace.json" if args.fleet_smoke else "smoke-trace.json"
+        out = args.out or os.path.join(tempfile.mkdtemp(prefix="mt-trace-"), name)
+        path = run_fleet_smoke(out) if args.fleet_smoke else run_smoke(out)
         print(f"trace written: {path}")
     elif args.trace:
         path = args.trace
     else:
-        ap.error("need a TRACE file or --smoke")
+        ap.error("need a TRACE file, --smoke, --fleet-smoke, or --diff A B")
         return 2
 
     try:
