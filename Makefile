@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-full doctest docs dryrun bench bench-smoke sweep faults chaos trace ci clean convert-weights test-real-weights
+.PHONY: test test-fast test-full doctest docs lint dryrun bench bench-smoke sweep faults chaos trace ci clean convert-weights test-real-weights
 
 # All targets run offline against the already-installed environment
 # (jax/flax/optax/pytest are assumed present — no network access needed).
@@ -26,9 +26,19 @@ doctest:
 	$(PY) -m pytest tests/test_doctests.py -q
 
 # Documentation integrity (the reference builds sphinx here; our markdown
-# docs are validated instead: links + named in-repo files must resolve).
+# docs are validated instead: links + named in-repo files must resolve, and
+# the canonical site registries must each have a docs-table row).
 docs:
 	$(PY) tools/check_docs.py
+
+# Invariant linter: AST passes proving collective discipline, retry purity,
+# fault taxonomy, telemetry typing and warn-once discipline over the whole
+# package + tools (docs/robustness.md "Enforced invariants"). Stdlib-only,
+# milliseconds; exits nonzero on any finding not suppressed by an inline
+# `# invlint: allow(RULE) — reason` pragma or a reasoned entry in
+# tools/invlint_baseline.json.
+lint:
+	$(PY) -m tools.invlint metrics_tpu tools
 
 # Multi-chip SPMD validation: jit the full training step over an 8-device
 # mesh (dp=4 x tp=2) with real shardings, on virtual CPU devices.
@@ -87,7 +97,7 @@ trace:
 	$(PY) tools/trace_report.py --fleet-smoke
 
 # What CI runs, in order (see .github/workflows/ci.yml).
-ci: docs doctest test-fast dryrun faults trace bench-smoke test-full
+ci: docs lint doctest test-fast dryrun faults trace bench-smoke test-full
 
 clean:
 	rm -rf .pytest_cache tests/.pytest_cache .mypy_cache

@@ -4,7 +4,6 @@ Parity: reference `functional/classification/auroc.py:28-230`.
 """
 from __future__ import annotations
 
-import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -16,6 +15,7 @@ from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.utils.checks import _classification_case
 from metrics_tpu.utils.data import _bincount
 from metrics_tpu.utils.enums import AverageMethod, DataType
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def _auroc_format(preds: jax.Array, target: jax.Array, mode: DataType) -> Tuple[jax.Array, jax.Array]:
@@ -125,7 +125,7 @@ def _auroc_compute(
                 observed[np.unique(target_np)] = True
                 for c in range(num_classes):
                     if not observed[c]:
-                        warnings.warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
+                        rank_zero_warn(f"Class {c} had 0 observations, omitted from AUROC calculation", UserWarning)
                 onehot = np.zeros((len(target_np), num_classes), dtype=bool)
                 onehot[np.arange(len(target_np)), target_np] = True
                 preds = jnp.asarray(np.asarray(preds)[:, observed])

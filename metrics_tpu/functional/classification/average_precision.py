@@ -4,7 +4,6 @@ Parity: reference `functional/classification/average_precision.py:27-160`.
 """
 from __future__ import annotations
 
-import warnings
 from typing import List, Optional, Tuple, Union
 
 import jax
@@ -14,6 +13,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
 )
+from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def _average_precision_update(
@@ -95,7 +95,7 @@ def _average_precision_compute_with_precision_recall(
         res_arr = jnp.stack(res)
         nan_mask = jnp.isnan(res_arr)
         if bool(nan_mask.any()):
-            warnings.warn(
+            rank_zero_warn(
                 "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
                 UserWarning,
             )

@@ -66,7 +66,15 @@ def _build() -> Optional[Path]:
         )
         tmp_path.replace(out)
         return out
-    except Exception:
+    except Exception as exc:
+        # the fallback is silent on the metric path by design, but the
+        # failure itself is a genuine host fault (missing toolchain,
+        # read-only cache dir, compile error): classify + count it so
+        # engine_stats()['failure_log'] says WHY native is off instead of
+        # the pre-taxonomy nothing
+        from metrics_tpu.ops import faults as _faults
+
+        _faults.note_fault(_faults.classify(exc, "host"), site="native-build", error=exc)
         if tmp_path is not None:
             tmp_path.unlink(missing_ok=True)
         return None

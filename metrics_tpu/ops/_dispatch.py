@@ -24,5 +24,5 @@ def pallas_enabled() -> bool:
         return False
     try:
         return jax.default_backend() in _PALLAS_BACKENDS
-    except Exception:  # backend init failure → always safe to fall back
+    except Exception:  # invlint: allow(INV201) — backend-init probe: failure means "no Pallas"; the lax path is always correct
         return False

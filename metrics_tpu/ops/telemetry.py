@@ -413,7 +413,7 @@ def _flat_numeric(prefix: str, value: Any) -> Iterator[Tuple[str, float]]:
 
 
 _COUNTER_PREFIXES = (
-    "builds", "hits", "deferred_", "fault_", "sync_", "journal_",
+    "builds", "hits", "deferred_", "fault_", "sync_", "journal_", "fleet_",
     "spans_recorded", "spans_dropped", "monotonic_step",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute

@@ -459,6 +459,7 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             if key not in _MANIFEST_CACHE and _sync.distributed_available():
                 t_meta = _telemetry.now() if _telemetry.armed else 0.0
                 totals = _sync.run_with_deadline(
+                    # invlint: allow(INV003) — the manifest cache is rank-symmetric by construction: a jax multi-host world runs the same program on every process, so every rank caches a layout at the same completed sync (see the comment above)
                     lambda: _host_allgather(np.asarray([local_total], np.int64)),
                     site="sync-gather",
                 )

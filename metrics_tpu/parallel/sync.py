@@ -731,7 +731,13 @@ def reset_collective_stats() -> None:
 _telemetry.register_reset("sync", reset_collective_stats)
 
 
-def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Array]:
+def _gather_once(
+    result: jax.Array, members: Optional[List[int]], epoch: Optional[int] = None
+) -> List[jax.Array]:
+    # ``epoch`` is the caller's fence stamp: every collective slot below is
+    # audited against it, so a transport that somehow bypassed the fence
+    # shows up in ``sync_stale_collectives`` (the audit backstop) on the
+    # per-state path exactly as it does on the coalesced path
     t0 = _telemetry.now() if _telemetry.armed else 0.0
     result = jnp.asarray(result)
     if not distributed_available():
@@ -739,8 +745,8 @@ def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Ar
         # per-state protocol costs one shape exchange + one payload gather
         # per state in any live world, and the dryrun/simulated surface is
         # where the coalescing win is asserted
-        note_collective("shape")
-        note_collective("payload", nbytes=int(result.nbytes))
+        note_collective("shape", epoch=epoch)
+        note_collective("payload", nbytes=int(result.nbytes), epoch=epoch)
         if t0 and _telemetry.armed:
             # seq: the payload-collective ordinal — issued in lockstep on
             # every rank, so the fleet trace merge pairs the k-th payload
@@ -756,14 +762,14 @@ def _gather_once(result: jax.Array, members: Optional[List[int]]) -> List[jax.Ar
 
     local_shape = np.asarray(result.shape, dtype=np.int32)
     # 1) exchange shapes (rank count must match across processes)
-    note_collective("shape")
+    note_collective("shape", epoch=epoch)
     all_shapes = np.asarray(multihost_utils.process_allgather(local_shape))
     max_shape = all_shapes.max(axis=0)
     # 2) pad to the max shape, 3) gather, 4) trim each entry back
     pad_width = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
     padded = jnp.pad(result, pad_width) if any(p[1] for p in pad_width) else result
     gathered_bytes = int(padded.nbytes) * int(all_shapes.shape[0])
-    note_collective("payload", nbytes=gathered_bytes)
+    note_collective("payload", nbytes=gathered_bytes, epoch=epoch)
     gathered = multihost_utils.process_allgather(padded)
     out = []
     for idx in range(all_shapes.shape[0]) if members is None else members:
@@ -818,7 +824,7 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
         # hung peer raises a classified SyncTimeoutFault instead of blocking
         # forever — inside the retry closure, so the timeout rides the same
         # retry/snapshot-restore lane as any other transport fault
-        return run_with_deadline(lambda: _gather_once(result, members), site="sync-gather")
+        return run_with_deadline(lambda: _gather_once(result, members, fence), site="sync-gather")
 
     out = _faults.retry_with_backoff(
         _attempt, attempts=sync_retries(), base_delay_s=sync_backoff_s(), site="sync-gather"

@@ -34,7 +34,7 @@ def module_available(path: str) -> bool:
 def _try_import(name: str):
     try:
         return importlib.import_module(name)
-    except Exception:
+    except Exception:  # invlint: allow(INV201) — availability probe: any import failure means "not installed", never a fault
         return None
 
 
@@ -51,13 +51,13 @@ def compare_version(package: str, op, version: str) -> bool:
         import importlib.metadata as _im
 
         have = _im.version(package)
-    except Exception:
+    except Exception:  # invlint: allow(INV201) — availability probe: an unversioned package compares False by contract
         return False
     from packaging.version import Version
 
     try:
         return bool(op(Version(have), Version(version)))
-    except Exception:
+    except Exception:  # invlint: allow(INV201) — availability probe: an unparseable version string compares False by contract
         return False
 
 

@@ -1,0 +1,39 @@
+"""Known-bad fixture for the collective-discipline pass (INV001/002/003).
+
+Never imported — parsed by ``tools/invlint`` in ``tests/tools/test_invlint.py``.
+``# expect: RULE`` markers pin the exact line each rule must fire on.
+"""
+from jax.experimental import multihost_utils  # noqa: F401 — fixture, never imported
+
+_LAYOUT_CACHE = {}
+
+
+def unguarded_unaudited(vec):
+    """A raw transport call: no watchdog, no audit — both rules fire."""
+    return multihost_utils.process_allgather(vec)  # expect: INV001, INV002
+
+
+def guarded_but_unaudited(vec, run_with_deadline):
+    """Deadline-guarded, but no note_collective(epoch=...) in the protocol."""
+    return run_with_deadline(lambda: multihost_utils.process_allgather(vec))  # expect: INV002
+
+
+def rank_keyed(vec, run_with_deadline, note_collective, fence):
+    """Only rank 0 issues the collective: the cohort deadlocks."""
+    import jax
+
+    rows = None
+    if jax.process_index() == 0:
+        rows = run_with_deadline(lambda: multihost_utils.process_allgather(vec))  # expect: INV003
+    note_collective("shape", epoch=fence)
+    return rows
+
+
+def cache_keyed(vec, key, run_with_deadline, note_collective, fence):
+    """Branching a collective on a process-local cache: first-touch skew
+    between ranks issues it on some ranks and not others."""
+    rows = None
+    if key not in _LAYOUT_CACHE:
+        rows = run_with_deadline(lambda: multihost_utils.process_allgather(vec))  # expect: INV003
+    note_collective("payload", epoch=fence)
+    return rows

@@ -1,0 +1,43 @@
+"""Known-good fixture for the collective-discipline pass: the sanctioned
+patterns — primitive seam, delegated wrapper, guarded+audited protocol,
+and a rank-symmetric early-out. Zero findings expected."""
+import numpy as np  # noqa: F401 — fixture, never imported
+from jax.experimental import multihost_utils  # noqa: F401
+
+
+def _host_allgather(vec):
+    """The primitive seam itself is exempt: its CALLERS carry the guard."""
+    return multihost_utils.process_allgather(vec)
+
+
+def _exchange_once(vec, note_collective, fence):
+    """Delegated body: runs under the caller's run_with_deadline lambda and
+    audits its own collective slots against the fence (the _gather_once
+    pattern in parallel/sync.py)."""
+    rows = multihost_utils.process_allgather(vec)
+    note_collective("shape", epoch=fence)
+    return rows
+
+
+def protocol(vec, run_with_deadline, note_collective, world_epoch):
+    """Inline-guarded protocol: fence at entry, audit on every slot."""
+    fence = world_epoch()
+    rows = run_with_deadline(lambda: multihost_utils.process_allgather(vec))
+    note_collective("payload", nbytes=int(rows.size), epoch=fence)
+    return rows
+
+
+def delegating_protocol(vec, run_with_deadline, note_collective, world_epoch):
+    fence = world_epoch()
+    return run_with_deadline(lambda: _exchange_once(vec, note_collective, fence))
+
+
+def early_out(vec, distributed_available, run_with_deadline, note_collective, fence):
+    """Branching on distributed_available() is rank-symmetric (the process
+    count is uniform across the world) — allowed."""
+    if not distributed_available():
+        note_collective("shape", epoch=fence)
+        return vec[None]
+    rows = run_with_deadline(lambda: multihost_utils.process_allgather(vec))
+    note_collective("shape", epoch=fence)
+    return rows

@@ -1,0 +1,32 @@
+"""Known-bad fixture for the retry-purity pass (INV101/INV102)."""
+
+
+def protocol_no_fence(retry_with_backoff, run_with_deadline, gather, vec):
+    """The retried closure issues a collective but never re-checks the
+    epoch fence: a membership change between attempts re-issues into the
+    wrong cohort (pairs with the new cohort's next collective, or hangs)."""
+
+    def _attempt():  # expect: INV101
+        return run_with_deadline(lambda: gather(vec))
+
+    return retry_with_backoff(_attempt, attempts=2, base_delay_s=0.0)
+
+
+def protocol_mutating(retry_with_backoff, check_epoch, gather, node, fence):
+    """The retried closure mutates object state with no snapshot/restore in
+    scope: a half-applied failed attempt leaks into the retry."""
+
+    def _attempt():
+        check_epoch(fence)
+        node.value = gather()  # expect: INV102
+        return node.value
+
+    return retry_with_backoff(_attempt, attempts=1, base_delay_s=0.0)
+
+
+def protocol_setattr(retry_with_backoff, check_epoch, gather, node, fence):
+    def _attempt():
+        check_epoch(fence)
+        setattr(node, "value", gather())  # expect: INV102
+
+    return retry_with_backoff(_attempt, attempts=1, base_delay_s=0.0)

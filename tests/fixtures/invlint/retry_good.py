@@ -1,0 +1,29 @@
+"""Known-good fixture for the retry-purity pass: fence re-checked inside the
+closure; mutation covered by a snapshot/restore in scope. Zero findings."""
+
+
+def protocol(retry_with_backoff, run_with_deadline, check_epoch, note_collective, world_epoch, gather, vec):
+    fence = world_epoch()
+
+    def _attempt():
+        check_epoch(fence)
+        rows = run_with_deadline(lambda: gather(vec))
+        note_collective("payload", epoch=fence)
+        return rows
+
+    return retry_with_backoff(_attempt, attempts=2, base_delay_s=0.0)
+
+
+def protocol_with_snapshot(retry_with_backoff, check_epoch, gather, node, fence):
+    snapshot = {"value": node.value}
+
+    def _attempt():
+        check_epoch(fence)
+        node.value = gather()
+        return node.value
+
+    try:
+        return retry_with_backoff(_attempt, attempts=1, base_delay_s=0.0)
+    except Exception:
+        node.value = snapshot["value"]  # restore the entry state, then surface
+        raise

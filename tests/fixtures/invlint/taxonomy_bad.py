@@ -1,0 +1,26 @@
+"""Known-bad fixture for the fault-taxonomy pass (INV201/INV202)."""
+
+
+def swallow(fn):
+    """A broad handler that swallows silently: the failure never reaches
+    the taxonomy, the failure_log, or the operator."""
+    try:
+        return fn()
+    except Exception:  # expect: INV201
+        return None
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:  # noqa: E722 # expect: INV201
+        return None
+
+
+def unknown_injection_site(inject_faults):
+    with inject_faults("sync-gatherx"):  # expect: INV202
+        pass
+
+
+def unknown_span_site(_telemetry):
+    _telemetry.emit("sync-payload-gatherx", None, "sync")  # expect: INV202

@@ -1,0 +1,46 @@
+"""Known-good fixture for the fault-taxonomy pass: routed, re-raised,
+pragma'd and noqa'd handlers; registry-valid site strings. Zero findings."""
+
+
+def routed(fn, classify, note_fault):
+    try:
+        return fn()
+    except Exception as exc:
+        note_fault(classify(exc, "runtime"), error=exc)
+        return None
+
+
+def warned(fn, warn_fault, owner):
+    try:
+        return fn()
+    except Exception:
+        warn_fault(owner, "runtime", "probe failed; serving the fallback")
+        return None
+
+
+def reraised(fn, rollback):
+    try:
+        return fn()
+    except Exception:
+        rollback()
+        raise
+
+
+def pragma_escape(fn):
+    try:
+        return fn()
+    except Exception:  # invlint: allow(INV201) — intentional probe: the failure IS the signal under test
+        return None
+
+
+def noqa_escape(fn):
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001 — best-effort cleanup, outcome already recorded
+        return None
+
+
+def registry_valid_sites(inject_faults, maybe_fail, _telemetry):
+    with inject_faults("flush-chunk-3"):
+        maybe_fail("sync-gather")
+    _telemetry.emit("sync-payload-gather", None, "sync")

@@ -1,0 +1,14 @@
+"""Known-bad fixture for the telemetry-typing pass (INV301/INV302)."""
+
+_counters = {
+    "orphan_total": 0,  # expect: INV301
+    "bad-name": 0,  # expect: INV302
+}
+
+
+def bump_untyped():
+    _counters["orphan_total"] += 1  # expect: INV301
+
+
+def bump_invalid(_bump):
+    _bump("sync.dotted.name")  # expect: INV302

@@ -1,0 +1,17 @@
+"""Known-good fixture for the telemetry-typing pass: counter-prefixed keys
+plus a deliberate gauge carve-out (ratio suffix). Zero findings."""
+
+_counters = {
+    "sync_custom_exchanges": 0,
+    "journal_rewrites": 0,
+    "sync_window_ratio": 0,  # gauge carve-out: ratios recompute per scrape
+}
+
+
+def _bump(name, n=1):
+    _counters[name] += n  # dynamic key: typed at its literal call sites
+
+
+def bump_typed():
+    _counters["sync_custom_exchanges"] += 1
+    _bump("journal_rewrites")

@@ -1,0 +1,16 @@
+"""Known-bad fixture for the warn-once-discipline pass (INV401)."""
+import warnings
+import warnings as _w
+from warnings import warn as _direct_warn
+
+
+def hot_path_warning(value):
+    warnings.warn(f"value {value} fell back to the eager path")  # expect: INV401
+
+
+def aliased_module_warning(value):
+    _w.warn(f"value {value} fell back")  # expect: INV401
+
+
+def bare_imported_warning(value):
+    _direct_warn(f"value {value} fell back")  # expect: INV401

@@ -1,0 +1,15 @@
+"""Known-good fixture for the warn-once-discipline pass: the sanctioned
+spellings, plus the pragma escape for a deliberate direct warning."""
+import warnings
+
+
+def informational(rank_zero_warn):
+    rank_zero_warn("the span ring shrank; oldest spans dropped")
+
+
+def fault_driven(warn_fault, owner):
+    warn_fault(owner, "sync", "deadline exceeded; serving the degraded value")
+
+
+def deliberate_direct(message):
+    warnings.warn(message)  # invlint: allow(INV401) — fixture: demonstrates the sanctioned pragma escape

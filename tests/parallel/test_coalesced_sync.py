@@ -295,7 +295,9 @@ class TestFaultIntegration:
         m = mt.SumMetric()
         m.update(jnp.asarray([5.0]))
         monkeypatch.setattr(psync, "distributed_available", lambda: True)
-        monkeypatch.setattr(psync, "_gather_once", lambda result, members: [jnp.asarray(result)])
+        monkeypatch.setattr(
+            psync, "_gather_once", lambda result, members, epoch=None: [jnp.asarray(result)]
+        )
         with faults.inject_faults("sync-pack") as plan:
             with pytest.raises(RuntimeFault):
                 m.sync(distributed_available=DIST_ON)

@@ -105,7 +105,7 @@ class TestDeadline:
         monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", DEADLINE_MS)
         monkeypatch.setenv("METRICS_TPU_SYNC_COALESCE", "0")
 
-        def hung_gather(result, members):
+        def hung_gather(result, members, epoch=None):
             time.sleep(1.0)
             raise RuntimeError("abandoned hung gather (watchdog timed out long ago)")
 

@@ -1,0 +1,254 @@
+"""Invariant-linter contract tests.
+
+Fixture pairs under ``tests/fixtures/invlint/`` carry ``# expect: RULE``
+markers: every bad fixture must fire exactly the marked (line, rule) set,
+every good fixture must be clean. On top of that: pragma and baseline
+round-trips, the registry extraction vs the imported package, a seeded
+violation against the REAL sync protocol (the acceptance criterion), and
+the whole-tree run that ``make lint`` gates CI with.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.invlint import DEFAULT_BASELINE, DEFAULT_PATHS, RULES, registry  # noqa: E402
+from tools.invlint.core import (  # noqa: E402
+    BaselineError,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "invlint")
+_EXPECT = re.compile(r"#\s*expect:\s*(INV\d{3}(?:\s*,\s*INV\d{3})*)")
+
+BAD_FIXTURES = sorted(f for f in os.listdir(FIXTURES) if f.endswith("_bad.py"))
+GOOD_FIXTURES = sorted(f for f in os.listdir(FIXTURES) if f.endswith("_good.py"))
+
+
+def _expected(path):
+    out = set()
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, start=1):
+            m = _EXPECT.search(line)
+            if m:
+                for rule in m.group(1).split(","):
+                    out.add((lineno, rule.strip()))
+    return out
+
+
+def _findings(path, **kw):
+    report = run_paths([path], **kw)
+    assert not report["errors"], report["errors"]
+    return report
+
+
+class TestFixturePairs:
+    def test_fixture_inventory(self):
+        # one known-bad + one known-good file per pass
+        assert BAD_FIXTURES == [
+            "collective_bad.py",
+            "retry_bad.py",
+            "taxonomy_bad.py",
+            "telemetry_bad.py",
+            "warn_bad.py",
+        ]
+        assert [f.replace("_good", "_bad") for f in GOOD_FIXTURES] == BAD_FIXTURES
+
+    @pytest.mark.parametrize("name", BAD_FIXTURES)
+    def test_bad_fixture_fires_at_expected_lines(self, name):
+        path = os.path.join(FIXTURES, name)
+        expected = _expected(path)
+        assert expected, f"{name} carries no # expect markers"
+        got = {(f.line, f.rule) for f in _findings(path)["findings"]}
+        assert got == expected
+
+    @pytest.mark.parametrize("name", GOOD_FIXTURES)
+    def test_good_fixture_is_clean(self, name):
+        report = _findings(os.path.join(FIXTURES, name))
+        assert report["findings"] == []
+
+
+class TestSuppression:
+    def test_pragma_suppresses_and_requires_reason(self, tmp_path):
+        src = tmp_path / "swallow.py"
+        src.write_text(
+            "def f(fn):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception:  # invlint: allow(INV201) — probe: failure is the signal\n"
+            "        return None\n"
+        )
+        report = _findings(str(src))
+        assert report["findings"] == []
+        assert report["pragma_suppressed"] == 1
+
+        # a reasonless pragma does NOT suppress and is itself flagged
+        src.write_text(
+            "def f(fn):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception:  # invlint: allow(INV201)\n"
+            "        return None\n"
+        )
+        rules = sorted(f.rule for f in _findings(str(src))["findings"])
+        assert rules == ["INV000", "INV201"]
+
+    def test_pragma_on_preceding_line_suppresses(self, tmp_path):
+        src = tmp_path / "warned.py"
+        src.write_text(
+            "import warnings\n"
+            "def f(msg):\n"
+            "    # invlint: allow(INV401) — deliberate direct warning in a fixture\n"
+            "    warnings.warn(msg)\n"
+        )
+        assert _findings(str(src))["findings"] == []
+
+    def test_prose_mentioning_pragma_syntax_is_ignored(self, tmp_path):
+        src = tmp_path / "prose.py"
+        src.write_text('MSG = "use `# invlint: allow(RULE) — <reason>` to suppress"\n')
+        assert _findings(str(src))["findings"] == []
+
+
+class TestBaseline:
+    def test_roundtrip(self, tmp_path):
+        bad = os.path.join(FIXTURES, "taxonomy_bad.py")
+        first = _findings(bad)["findings"]
+        assert first
+        baseline_path = tmp_path / "baseline.json"
+        write_baseline(str(baseline_path), first, reason="accepted for the round-trip test")
+        entries = load_baseline(str(baseline_path))
+        assert len(entries) == len(first)
+        report = _findings(bad, baseline=entries)
+        assert report["findings"] == []
+        assert len(report["baselined"]) == len(first)
+        assert report["stale_baseline"] == []
+
+    def test_reason_is_required(self, tmp_path):
+        baseline_path = tmp_path / "baseline.json"
+        baseline_path.write_text(
+            json.dumps(
+                {"findings": [{"file": "x.py", "line": 1, "rule": "INV201", "reason": "  "}]}
+            )
+        )
+        with pytest.raises(BaselineError, match="reason"):
+            load_baseline(str(baseline_path))
+
+    def test_unknown_rule_rejected(self, tmp_path):
+        baseline_path = tmp_path / "baseline.json"
+        baseline_path.write_text(
+            json.dumps(
+                {"findings": [{"file": "x.py", "line": 1, "rule": "INV999", "reason": "r"}]}
+            )
+        )
+        with pytest.raises(BaselineError, match="unknown rule"):
+            load_baseline(str(baseline_path))
+
+    def test_shipped_baseline_loads_and_has_reasons(self):
+        entries = load_baseline(os.path.join(REPO, DEFAULT_BASELINE))
+        assert entries, "the shipped baseline must exist"
+        assert all(str(e["reason"]).strip() for e in entries)
+
+
+class TestRegistry:
+    """The AST-extracted registries must equal the imported package's — the
+    single-sourcing contract behind the linter, check_docs and fault_sweep."""
+
+    def test_fault_sites_match_package(self):
+        from metrics_tpu.ops import faults
+
+        assert registry.fault_sites() == faults.FAULT_SITES
+
+    def test_span_sites_match_package(self):
+        from metrics_tpu.ops import telemetry
+
+        assert registry.span_sites() == tuple(telemetry.SPAN_SITES)
+
+    def test_counter_typing_matches_package(self):
+        from metrics_tpu.ops import telemetry
+
+        keys = [
+            "sync_payload_collectives", "fault_sync", "journal_saves", "fleet_gathers",
+            "sync_coalesce_ratio", "sync_health_epoch", "sync_phase_stats_sync_gather_count",
+            "monotonic_step", "spans_retained", "world_size", "builds", "hits",
+        ]
+        for key in keys:
+            assert registry.is_counter_key(key) == telemetry.is_counter_key(key), key
+
+
+class TestSeededViolation:
+    """The acceptance criterion: deleting one ``note_collective`` epoch audit
+    from the REAL per-state sync protocol must make the linter fire INV002
+    with the correct rule id on the transport lines."""
+
+    def test_stripped_epoch_audit_fires_inv002(self, tmp_path):
+        src_path = os.path.join(REPO, "metrics_tpu", "parallel", "sync.py")
+        with open(src_path, encoding="utf-8") as fh:
+            source = fh.read()
+        assert "note_collective(\"shape\", epoch=epoch)" in source
+        seeded = source.replace(", epoch=epoch)", ")")
+        target = tmp_path / "sync_seeded.py"
+        target.write_text(seeded)
+        findings = _findings(str(target))["findings"]
+        rules = {f.rule for f in findings}
+        assert rules == {"INV002"}
+        # both multi-process transport slots (shape + payload exchange)
+        # plus the single-process accounting slots lose their audit
+        assert len(findings) >= 2
+
+    def test_unfenced_retry_fires_inv101(self, tmp_path):
+        target = tmp_path / "unfenced.py"
+        target.write_text(
+            "def proto(retry_with_backoff, run_with_deadline, gather):\n"
+            "    def _attempt():\n"
+            "        return run_with_deadline(lambda: gather())\n"
+            "    return retry_with_backoff(_attempt, attempts=1, base_delay_s=0.0)\n"
+        )
+        findings = _findings(str(target))["findings"]
+        assert [(f.line, f.rule) for f in findings] == [(2, "INV101")]
+
+
+class TestRealTree:
+    def test_default_paths_clean_with_shipped_baseline(self):
+        """What ``make lint`` gates CI with: zero non-baselined findings."""
+        baseline = load_baseline(os.path.join(REPO, DEFAULT_BASELINE))
+        report = run_paths(list(DEFAULT_PATHS), baseline=baseline)
+        assert report["errors"] == []
+        assert report["findings"] == [], [f.render() for f in report["findings"]]
+        assert report["stale_baseline"] == [], report["stale_baseline"]
+        assert report["files"] > 100  # the whole package really was scanned
+
+    def test_cli_exit_codes(self):
+        env = dict(os.environ, PYTHONPATH=REPO)
+        clean = subprocess.run(
+            [sys.executable, "-m", "tools.invlint",
+             os.path.join(FIXTURES, "collective_good.py"), "--no-baseline"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+        )
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        dirty = subprocess.run(
+            [sys.executable, "-m", "tools.invlint",
+             os.path.join(FIXTURES, "collective_bad.py"), "--no-baseline"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+        )
+        assert dirty.returncode == 1
+        assert "INV001" in dirty.stdout and "INV003" in dirty.stdout
+
+    def test_rule_catalogue_documented(self):
+        """Every rule id is documented in docs/robustness.md (the 'Enforced
+        invariants' section) — a new rule without docs is a lint-the-linter
+        failure."""
+        with open(os.path.join(REPO, "docs", "robustness.md"), encoding="utf-8") as fh:
+            text = fh.read()
+        for rule in RULES:
+            assert rule in text, f"{rule} missing from docs/robustness.md"

@@ -1,0 +1,5 @@
+"""Offline tooling for metrics_tpu (benches, sweeps, docs checks, linters).
+
+Package marker so `python -m tools.invlint` resolves from the repo root; the
+standalone scripts in this directory keep working unchanged.
+"""

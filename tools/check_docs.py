@@ -4,7 +4,12 @@ The reference builds sphinx docs in its Makefile (`/root/reference/Makefile:28-3
 this repo's docs are plain markdown, so the docs stage validates them instead
 of rendering: every relative link resolves, every in-repo file path named in
 backticks exists, and every `SWEEP_r0N.json` / bench artifact referenced is
-present. Exit non-zero with a list of broken references.
+present. The registry drift check then pins the docs tables to the canonical
+site registries (`faults.FAULT_SITES`, `telemetry.SPAN_SITES` — extracted
+statically via `tools.invlint.registry`, no jax import): a new injection site
+without a `docs/robustness.md` row, or a new span site without a
+`docs/observability.md` row, fails this stage. Exit non-zero with a list of
+broken references.
 """
 from __future__ import annotations
 
@@ -13,6 +18,10 @@ import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.invlint import registry as _registry  # noqa: E402
 
 # markdown link targets: [text](target)
 _LINK = re.compile(r"\[[^\]]*\]\(([^)#]+)(?:#[^)]*)?\)")
@@ -40,8 +49,27 @@ def _doc_files():
             yield os.path.join(docs, name)
 
 
-def main() -> int:
+def _registry_drift() -> list:
+    """Every canonical site must have a docs-table row. Indexed families are
+    documented with the ``-k`` spelling (``flush-chunk-k``)."""
     broken = []
+    tables = (
+        ("docs/robustness.md", _registry.fault_sites(), "faults.FAULT_SITES"),
+        ("docs/observability.md", _registry.span_sites(), "telemetry.SPAN_SITES"),
+    )
+    for rel, sites, origin in tables:
+        text = open(os.path.join(REPO, rel), encoding="utf-8").read()
+        # only markdown TABLE rows count — a prose mention is not the
+        # structured per-site row this check promises
+        rows = "\n".join(line for line in text.splitlines() if line.lstrip().startswith("|"))
+        for site in sites:
+            if f"`{site}`" not in rows and f"`{site}-k`" not in rows:
+                broken.append(f"{rel}: no table row for registered site `{site}` ({origin})")
+    return broken
+
+
+def main() -> int:
+    broken = _registry_drift()
     for path in _doc_files():
         rel = os.path.relpath(path, REPO)
         text = open(path, encoding="utf-8").read()

@@ -249,8 +249,34 @@ SWEEP = {
     "journal-load": _scenario_journal_load,
 }
 
+# Site families exercised by tools/chaos_sweep.py instead: a fence trip needs
+# a scripted membership race (epoch bump mid-protocol), which is a multi-step
+# chaos scenario, not a one-site injection sweep.
+CHAOS_COVERED = frozenset({"epoch-fence"})
+
+
+def _coverage_gaps():
+    """Every family in the canonical registry (``faults.FAULT_SITES`` — the
+    same tuple the linter and the docs drift check consume) must be exercised
+    here or in the chaos sweep; a new site without a scenario fails the
+    ``make faults`` stage instead of silently never firing."""
+    from tools.invlint.registry import site_family
+
+    swept = {site_family(site) for site in SWEEP}
+    return sorted(set(faults.FAULT_SITES) - swept - CHAOS_COVERED)
+
 
 def main() -> int:
+    gaps = _coverage_gaps()
+    if gaps:
+        print(json.dumps({"summary": "fault_sweep", "uncovered_sites": gaps}))
+        print(
+            f"fault_sweep: {len(gaps)} registered injection site(s) have no sweep"
+            f" scenario: {gaps} — add one here or declare it in CHAOS_COVERED"
+            " with a chaos_sweep scenario",
+            file=sys.stderr,
+        )
+        return 1
     faults.set_recovery_policy(steps=2)
     failures = 0
     results = {}

@@ -1,0 +1,30 @@
+"""Invariant linter: AST passes that prove collective discipline, fault
+taxonomy, and telemetry typing at review time.
+
+    python -m tools.invlint metrics_tpu tools
+
+The distributed-correctness contract this repo grew across PRs 3–8 (every
+collective epoch-fenced + deadline-guarded + audited, retried closures
+re-checking the fence, fallbacks classified through ``ops/faults``, snapshot
+keys typed by ``telemetry.is_counter_key``) lives here as five static
+passes, so a violation is a lint error at review time instead of a chaos
+sweep finding after merge. See docs/robustness.md "Enforced invariants" for
+each rule with its failing example and the sanctioned pattern.
+
+Findings are ``file:line``-anchored with stable rule ids; suppression is an
+inline ``# invlint: allow(RULE) — reason`` pragma or a reasoned entry in
+``tools/invlint_baseline.json``. ``make lint`` (wired into ``make ci``)
+exits nonzero on any non-baselined finding.
+"""
+from tools.invlint.core import (  # noqa: F401 — the public API
+    BaselineError,
+    Finding,
+    RULES,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+from tools.invlint import registry  # noqa: F401
+
+DEFAULT_PATHS = ("metrics_tpu", "tools")
+DEFAULT_BASELINE = "tools/invlint_baseline.json"

@@ -1,0 +1,93 @@
+"""CLI for the invariant linter (the ``make lint`` entry point).
+
+Exit codes: 0 clean, 1 non-baselined findings, 2 usage/baseline errors.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# `python tools/invlint/__main__.py` (no -m): make the repo root importable
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.invlint import DEFAULT_BASELINE, DEFAULT_PATHS, RULES  # noqa: E402
+from tools.invlint.core import BaselineError, load_baseline, run_paths, write_baseline  # noqa: E402
+from tools.invlint.registry import ROOT  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.invlint",
+        description="AST invariant linter: collective discipline, retry purity,"
+        " fault taxonomy, telemetry typing, warn-once discipline.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_PATHS),
+        help=f"files/directories to lint (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--baseline", default=os.path.join(ROOT, DEFAULT_BASELINE),
+        help="baseline JSON of accepted findings (every entry needs a reason)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true", help="ignore the baseline file"
+    )
+    parser.add_argument(
+        "--write-baseline", metavar="PATH",
+        help="write current findings to PATH as a baseline skeleton"
+        " (placeholder reasons — edit before committing) and exit 0",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalogue")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    try:
+        baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    except BaselineError as err:
+        print(f"invlint: {err}", file=sys.stderr)
+        return 2
+
+    report = run_paths(args.paths, baseline=baseline)
+    if report["errors"]:
+        for err in report["errors"]:
+            print(f"invlint: ERROR {err}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(
+            args.write_baseline,
+            report["findings"],
+            reason="TODO: replace with the real reason this finding is accepted",
+        )
+        print(
+            f"invlint: wrote {len(report['findings'])} finding(s) to"
+            f" {args.write_baseline} — fill in real reasons before committing"
+        )
+        return 0
+
+    for finding in report["findings"]:
+        print(finding.render())
+    for entry in report["stale_baseline"]:
+        print(
+            f"invlint: stale baseline entry {entry['file']}:{entry['line']}"
+            f" {entry['rule']} (no longer fires — prune it)",
+            file=sys.stderr,
+        )
+    print(
+        f"invlint: {len(report['findings'])} finding(s)"
+        f" ({len(report['baselined'])} baselined,"
+        f" {report['pragma_suppressed']} pragma-suppressed)"
+        f" across {report['files']} file(s)"
+    )
+    return 1 if report["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,390 @@
+"""Invariant-linter core: findings, pragmas, the baseline, and the runner.
+
+One :class:`Module` is built per scanned file (parse + parent links + pragma
+table); every pass is a pure function ``Module -> [Finding]``. Suppression
+has exactly two sanctioned shapes:
+
+- an inline pragma ``# invlint: allow(RULE[,RULE...]) — <reason>`` on the
+  flagged line or the line directly above it (the reason is REQUIRED — a
+  reasonless pragma does not suppress and is itself flagged as ``INV000``);
+- a baseline entry in ``tools/invlint_baseline.json`` carrying ``file``,
+  ``rule``, ``line`` and a non-empty ``reason``.
+
+For the bare-except rule (``INV201``) an existing reasoned
+``# noqa: BLE001 — <reason>`` annotation also counts: that is the idiom the
+tree already uses for deliberate broad handlers, and re-stating every one as
+a pragma would be churn without information.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.invlint import registry
+
+#: Rule catalogue — ids are stable (baselines and pragmas reference them).
+RULES: Dict[str, str] = {
+    "INV000": "invlint pragma is malformed or missing its reason",
+    "INV001": "transport collective not guarded by run_with_deadline",
+    "INV002": "collective protocol missing a note_collective(epoch=...) audit",
+    "INV003": "collective issued under control flow keyed on rank-local state",
+    "INV101": "retried collective closure does not re-check the epoch fence",
+    "INV102": "state mutation inside a retried closure without snapshot/restore in scope",
+    "INV201": "bare `except Exception` swallows without routing through faults",
+    "INV202": "site string is not in the canonical fault/span registry",
+    "INV301": "incremented stats key is untyped (neither counter-prefixed nor a gauge carve-out)",
+    "INV302": "stats key is not a valid Prometheus exposition name",
+    "INV401": "direct warnings.warn (route through faults.warn_fault or rank_zero_warn)",
+}
+
+_PRAGMA = re.compile(
+    r"#.*?invlint:\s*allow\(([^)]*)\)\s*(?:[—:-]+\s*(\S.*))?"
+)
+_NOQA_BLE = re.compile(r"#\s*noqa:\s*BLE001\b[^\w]*(\S.*)?")
+_RULE_ID = re.compile(r"INV\d{3}")
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str  # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class Module:
+    """Everything a pass needs about one scanned file."""
+
+    path: str  # repo-relative posix path
+    tree: ast.Module
+    lines: List[str]
+    root: str = registry.ROOT
+    pragmas: Dict[int, Tuple[Set[str], bool]] = field(default_factory=dict)
+    pragma_findings: List[Finding] = field(default_factory=list)
+    _parents: Dict[int, ast.AST] = field(default_factory=dict)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Lexically enclosing FunctionDef/Lambda chain, innermost first."""
+        return [
+            a
+            for a in self.ancestors(node)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        ]
+
+    def finding(self, node_or_line: Any, rule: str, message: str) -> Finding:
+        line = node_or_line if isinstance(node_or_line, int) else node_or_line.lineno
+        return Finding(self.path, line, rule, message)
+
+    def line_text(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    def has_reasoned_noqa_ble(self, line: int) -> bool:
+        m = _NOQA_BLE.search(self.line_text(line))
+        return bool(m and m.group(1) and m.group(1).strip())
+
+    def suppressed(self, finding: Finding) -> bool:
+        for line in (finding.line, finding.line - 1):
+            entry = self.pragmas.get(line)
+            if entry is not None:
+                rules, has_reason = entry
+                if has_reason and finding.rule in rules:
+                    return True
+        if finding.rule == "INV201" and self.has_reasoned_noqa_ble(finding.line):
+            return True
+        return False
+
+
+def _build_parents(mod: Module) -> None:
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            mod._parents[id(child)] = node
+
+
+def _collect_pragmas(mod: Module) -> None:
+    for idx, text in enumerate(mod.lines, start=1):
+        if "invlint" not in text:
+            continue
+        m = _PRAGMA.search(text)
+        if m is None:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        # only engage when a token is shaped like a rule id — prose that
+        # merely *describes* the pragma syntax (docstrings, error messages)
+        # is not a suppression attempt
+        if not any(_RULE_ID.fullmatch(r) for r in rules):
+            continue
+        reason = (m.group(2) or "").strip()
+        known = {r for r in rules if r in RULES}
+        if not known or not reason:
+            what = "unknown rule id(s)" if not known else "missing reason"
+            mod.pragma_findings.append(
+                Finding(
+                    mod.path,
+                    idx,
+                    "INV000",
+                    f"pragma does not suppress ({what}); use"
+                    " `# invlint: allow(RULE) — <reason>`",
+                )
+            )
+            mod.pragmas[idx] = (known, False)
+        else:
+            mod.pragmas[idx] = (known, True)
+
+
+def load_module(path: str, root: str = registry.ROOT) -> Module:
+    """Parse one file into a :class:`Module`. Unparseable files raise
+    (``SyntaxError``/``OSError``) — the runner reports them as hard errors,
+    never a silent skip."""
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    tree = ast.parse(source, filename=path)
+    mod = Module(path=rel, tree=tree, lines=source.splitlines(), root=root)
+    _build_parents(mod)
+    _collect_pragmas(mod)
+    return mod
+
+
+# ------------------------------------------------------------------ AST utils
+def call_name(node: ast.Call) -> Optional[str]:
+    """The terminal callee name of a call: ``f(...)`` and ``m.f(...)`` -> f."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def call_base(node: ast.Call) -> Optional[str]:
+    """For ``m.f(...)``: the name ``m``; None for plain calls."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id
+    return None
+
+
+def literal_str_arg(node: ast.Call, index: int = 0) -> Optional[str]:
+    if len(node.args) > index and isinstance(node.args[index], ast.Constant):
+        value = node.args[index].value
+        if isinstance(value, str):
+            return value
+    return None
+
+
+def has_keyword(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords)
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def contains_call(node: ast.AST, names: Iterable[str]) -> bool:
+    names = set(names)
+    return any(call_name(c) in names for c in walk_calls(node))
+
+
+def mentions_identifier(node: ast.AST, substrings: Sequence[str]) -> bool:
+    """Whether any Name/Attribute identifier in ``node`` contains one of
+    ``substrings`` (case-insensitive) — the loose "in scope" predicate."""
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ident = sub.name
+        if ident is not None:
+            low = ident.lower()
+            if any(s in low for s in substrings):
+                return True
+    return False
+
+
+def module_mutable_globals(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to mutable containers (dict/set literals,
+    comprehensions, ``dict()``/``set()`` calls) — process-local caches by
+    construction, which is what makes branching on them rank-divergent."""
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        else:
+            continue
+        mutable = isinstance(value, (ast.Dict, ast.Set, ast.DictComp, ast.SetComp)) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("dict", "set", "defaultdict", "OrderedDict")
+        )
+        if not mutable:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name):
+                out.add(target.id)
+    return out
+
+
+# ------------------------------------------------------------------- baseline
+class BaselineError(ValueError):
+    """The baseline file is malformed (every entry needs file/rule/line and a
+    non-empty reason — a baseline without reasons is just a mute button)."""
+
+
+def load_baseline(path: str) -> List[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        try:
+            data = json.load(fh)
+        except ValueError as err:
+            raise BaselineError(f"{path}: not valid JSON ({err})") from err
+    entries = data.get("findings") if isinstance(data, dict) else data
+    if not isinstance(entries, list):
+        raise BaselineError(f"{path}: expected a list (or {{'findings': [...]}})")
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise BaselineError(f"{path}: entry {i} is not an object")
+        for key in ("file", "rule", "line", "reason"):
+            if key not in entry:
+                raise BaselineError(f"{path}: entry {i} is missing {key!r}")
+        if entry["rule"] not in RULES:
+            raise BaselineError(f"{path}: entry {i} names unknown rule {entry['rule']!r}")
+        if not isinstance(entry["line"], int):
+            raise BaselineError(f"{path}: entry {i} line must be an integer")
+        if not str(entry["reason"]).strip():
+            raise BaselineError(
+                f"{path}: entry {i} ({entry['file']}:{entry['line']} {entry['rule']})"
+                " has an empty reason — baselined findings require a written reason"
+            )
+    return entries
+
+
+def write_baseline(path: str, findings: Sequence[Finding], reason: str) -> None:
+    """Serialize ``findings`` as a baseline (one shared placeholder reason —
+    meant as a starting point for a human to edit, not a final artifact)."""
+    entries = [
+        {"file": f.file, "line": f.line, "rule": f.rule, "message": f.message, "reason": reason}
+        for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule))
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"findings": entries}, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def _baseline_key(entry: Dict[str, Any]) -> Tuple[str, str, int]:
+    return (str(entry["file"]), str(entry["rule"]), int(entry["line"]))
+
+
+# --------------------------------------------------------------------- runner
+def iter_python_files(
+    paths: Sequence[str], root: str = registry.ROOT, errors: Optional[List[str]] = None
+) -> Iterator[str]:
+    for raw in paths:
+        path = raw if os.path.isabs(raw) else os.path.join(root, raw)
+        if not os.path.exists(path):
+            # a typo'd path must be a hard error, not a silently-empty scan
+            # that would turn the CI gate into a no-op
+            if errors is not None:
+                errors.append(f"{raw}: path does not exist")
+            continue
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def run_paths(
+    paths: Sequence[str],
+    *,
+    root: str = registry.ROOT,
+    baseline: Optional[Sequence[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Lint every ``.py`` file under ``paths``. Returns::
+
+        {"findings": [...],        # reported (non-suppressed, non-baselined)
+         "baselined": [...], "pragma_suppressed": int,
+         "stale_baseline": [...],  # entries matching nothing anymore
+         "files": int, "errors": [...]}
+    """
+    from tools.invlint import passes
+
+    all_findings: List[Finding] = []
+    pragma_suppressed = 0
+    errors: List[str] = []
+    scanned: Set[str] = set()
+    files = 0
+    for path in iter_python_files(paths, root, errors):
+        files += 1
+        try:
+            mod = load_module(path, root)
+        except (SyntaxError, UnicodeDecodeError, OSError) as err:
+            errors.append(f"{path}: {err}")
+            continue
+        scanned.add(mod.path)
+        raw = list(mod.pragma_findings)
+        for check in passes.ALL_PASSES:
+            raw.extend(check(mod))
+        for finding in raw:
+            if mod.suppressed(finding):
+                pragma_suppressed += 1
+            else:
+                all_findings.append(finding)
+
+    baselined: List[Finding] = []
+    reported: List[Finding] = []
+    entries = list(baseline or [])
+    keys = {_baseline_key(e) for e in entries}
+    matched: Set[Tuple[str, str, int]] = set()
+    for finding in all_findings:
+        key = (finding.file, finding.rule, finding.line)
+        if key in keys:
+            matched.add(key)
+            baselined.append(finding)
+        else:
+            reported.append(finding)
+    if files == 0 and not errors:
+        errors.append(f"no Python files found under {list(paths)!r} — nothing was linted")
+    # staleness is only decidable for files this run actually scanned — a
+    # subset run must not advise pruning entries that still fire elsewhere
+    stale = [
+        e
+        for e in entries
+        if str(e["file"]) in scanned and _baseline_key(e) not in matched
+    ]
+    reported.sort(key=lambda f: (f.file, f.line, f.rule))
+    return {
+        "findings": reported,
+        "baselined": baselined,
+        "pragma_suppressed": pragma_suppressed,
+        "stale_baseline": stale,
+        "files": files,
+        "errors": errors,
+    }

@@ -1,0 +1,463 @@
+"""The five invariant passes.
+
+Each pass is a pure function ``Module -> [Finding]`` over one file's AST.
+They encode the distributed-correctness contract PRs 3–8 established in
+prose and chaos tests (docs/robustness.md "Enforced invariants" is the
+human-readable twin of this file):
+
+1. collective-discipline (INV001/INV002/INV003)
+2. retry-purity          (INV101/INV102)
+3. fault-taxonomy        (INV201/INV202)
+4. telemetry-typing      (INV301/INV302)
+5. warn-once discipline  (INV401)
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from tools.invlint import registry
+from tools.invlint.core import (
+    Finding,
+    Module,
+    call_base,
+    call_name,
+    contains_call,
+    has_keyword,
+    literal_str_arg,
+    mentions_identifier,
+    module_mutable_globals,
+    walk_calls,
+)
+
+#: The transport primitives: every call that reaches one of these issues (or
+#: in single-process mode, *accounts*) a host collective. The discipline is
+#: enforced where these names are CALLED; their own definitions are the seam
+#: and are exempt (the guard belongs to the protocol, not the primitive).
+TRANSPORT_PRIMITIVES = frozenset(
+    {"process_allgather", "_host_allgather", "_payload_allgather"}
+)
+
+#: Handler calls that count as routing a caught exception through the fault
+#: taxonomy (``ops/faults.py``'s classification surface).
+FAULT_ROUTERS = frozenset({"classify", "note_fault", "warn_fault", "demote"})
+
+#: The file that owns the taxonomy — bare ``except Exception`` is its job.
+FAULTS_MODULE = "metrics_tpu/ops/faults.py"
+PRINTS_MODULE = "metrics_tpu/utils/prints.py"
+
+#: Stats dicts whose string-literal keys are scraped into the snapshot.
+STATS_DICT_NAMES = frozenset({"_counters", "_stats"})
+
+#: Prometheus exposition family-name alphabet (after the ``metrics_tpu_``
+#: prefix; ``:`` is reserved for recording rules, ``-`` would be mangled).
+PROM_NAME = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+# --------------------------------------------------------------- pass 1: collectives
+def _deadline_delegated_names(mod: Module) -> Set[str]:
+    """Function names CALLED inside an argument of a ``run_with_deadline``
+    call — their bodies execute under the watchdog even though the guard is
+    lexically at the caller (e.g. ``run_with_deadline(lambda: _gather_once(...))``).
+    Only call-position names (and bare callables passed directly) count:
+    sweeping up every identifier in the argument would exempt any function
+    that happens to share a name with a forwarded variable."""
+    names: Set[str] = set()
+    for call in walk_calls(mod.tree):
+        if call_name(call) != "run_with_deadline":
+            continue
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            # a bare callable handed straight to the guard
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                names.add(arg.attr)
+            for sub in walk_calls(arg):
+                name = call_name(sub)
+                if name is not None:
+                    names.add(name)
+    return names
+
+
+def _is_deadline_guarded(mod: Module, call: ast.Call, delegated: Set[str]) -> bool:
+    for anc in mod.ancestors(call):
+        if isinstance(anc, ast.Call) and call_name(anc) == "run_with_deadline":
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) and anc.name in delegated:
+            return True
+    return False
+
+
+def _rank_divergent_test(test: ast.AST, caches: Set[str]) -> Optional[str]:
+    """Why a branch condition is rank-local (None when it is not)."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call) and call_name(sub) == "process_index":
+            return "branches on process_index()"
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident in ("rank", "local_rank") or (ident or "").endswith("_rank"):
+            return f"branches on rank-local name {ident!r}"
+        if isinstance(sub, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in sub.ops
+        ):
+            for comp in sub.comparators:
+                if isinstance(comp, ast.Name) and comp.id in caches:
+                    return f"branches on process-local cache {comp.id!r}"
+    return None
+
+
+def check_collective_discipline(mod: Module) -> List[Finding]:
+    """INV001/INV002/INV003 — every transport call must run under the
+    watchdog deadline, inside a protocol that audits its collective slots
+    against the epoch fence, and never under rank-divergent control flow
+    (one rank issuing a collective the others skip is a deadlock)."""
+    findings: List[Finding] = []
+    delegated = _deadline_delegated_names(mod)
+    caches = module_mutable_globals(mod.tree)
+    for call in walk_calls(mod.tree):
+        name = call_name(call)
+        if name not in TRANSPORT_PRIMITIVES:
+            continue
+        encl = mod.enclosing_functions(call)
+        named_encl = [
+            f for f in encl if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # the primitive definitions themselves are the seam, not a call site
+        if any(f.name in TRANSPORT_PRIMITIVES for f in named_encl):
+            continue
+        if not _is_deadline_guarded(mod, call, delegated):
+            findings.append(
+                mod.finding(
+                    call,
+                    "INV001",
+                    f"transport call {name}() is not under a run_with_deadline guard"
+                    " — a hung peer blocks this protocol forever",
+                )
+            )
+        if not any(
+            call_name(c) == "note_collective" and has_keyword(c, "epoch")
+            for f in named_encl
+            for c in walk_calls(f)
+        ):
+            findings.append(
+                mod.finding(
+                    call,
+                    "INV002",
+                    f"no note_collective(epoch=...) audit in the protocol around {name}()"
+                    " — the stale-collective backstop cannot see this slot",
+                )
+            )
+        for anc in mod.ancestors(call):
+            if isinstance(anc, (ast.If, ast.While)):
+                why = _rank_divergent_test(anc.test, caches)
+                if why is not None:
+                    findings.append(
+                        mod.finding(
+                            call,
+                            "INV003",
+                            f"transport call {name}() {why} (line {anc.lineno})"
+                            " — rank-divergent collectives deadlock the cohort",
+                        )
+                    )
+    return findings
+
+
+# ------------------------------------------------------------------ pass 2: retries
+def _resolve_closure(mod: Module, call: ast.Call) -> Optional[ast.AST]:
+    """The closure passed to ``retry_with_backoff`` (arg0 or ``fn=``):
+    a Lambda inline, or a FunctionDef resolved by name — nearest enclosing
+    scope first, so two protocols may both name their closure ``_attempt``."""
+    fn_node: Optional[ast.AST] = call.args[0] if call.args else None
+    if fn_node is None:
+        for kw in call.keywords:
+            if kw.arg == "fn":
+                fn_node = kw.value
+    if isinstance(fn_node, ast.Lambda):
+        return fn_node
+    if not isinstance(fn_node, ast.Name):
+        return None
+    candidates = [
+        f
+        for f in ast.walk(mod.tree)
+        if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)) and f.name == fn_node.id
+    ]
+    scopes: List[ast.AST] = list(mod.enclosing_functions(call)) + [mod.tree]
+    for scope in scopes:
+        for f in candidates:
+            encl = mod.enclosing_functions(f)
+            nearest = encl[0] if encl else mod.tree
+            if nearest is scope:
+                return f
+    return candidates[0] if candidates else None
+
+
+def _issues_collectives(node: ast.AST) -> bool:
+    return contains_call(
+        node, TRANSPORT_PRIMITIVES | {"run_with_deadline", "note_collective"}
+    )
+
+
+def _mutation_sites(node: ast.AST) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+            if any(isinstance(t, ast.Attribute) for t in targets):
+                out.append(sub)
+        elif isinstance(sub, ast.Call) and call_name(sub) in ("setattr", "__setattr__"):
+            out.append(sub)
+    return out
+
+
+def check_retry_purity(mod: Module) -> List[Finding]:
+    """INV101/INV102 — a closure handed to ``faults.retry_with_backoff`` may
+    run MORE THAN ONCE: if it issues collectives it must re-check the epoch
+    fence (``sync.check_epoch``) before each issue, and if it mutates object
+    state the caller must hold a snapshot/restore so a half-applied attempt
+    cannot leak into the retry."""
+    findings: List[Finding] = []
+    if mod.path == FAULTS_MODULE:
+        return findings  # the definition site, not a protocol
+    for call in walk_calls(mod.tree):
+        if call_name(call) != "retry_with_backoff":
+            continue
+        closure = _resolve_closure(mod, call)
+        if closure is None:
+            continue
+        if _issues_collectives(closure) and not contains_call(closure, ("check_epoch",)):
+            name = getattr(closure, "name", "<lambda>")
+            findings.append(
+                mod.finding(
+                    closure,
+                    "INV101",
+                    f"retried closure {name!r} issues collectives without calling"
+                    " check_epoch inside the closure — a membership change between"
+                    " attempts re-issues into the wrong cohort",
+                )
+            )
+        mutations = _mutation_sites(closure)
+        if mutations:
+            scope_nodes: List[ast.AST] = [closure] + mod.enclosing_functions(call)
+            guarded = any(
+                mentions_identifier(s, ("snapshot", "restore")) for s in scope_nodes
+            )
+            if not guarded:
+                for m in mutations:
+                    findings.append(
+                        mod.finding(
+                            m,
+                            "INV102",
+                            "state mutated inside a retried closure with no"
+                            " snapshot/restore in scope — a failed attempt leaves"
+                            " half-applied state for the retry",
+                        )
+                    )
+    return findings
+
+
+# ----------------------------------------------------------------- pass 3: taxonomy
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare `except:`
+    if isinstance(t, ast.Name) and t.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in ("Exception", "BaseException")
+            for e in t.elts
+        )
+    return False
+
+
+def _handler_raises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(sub, ast.Raise) for sub in ast.walk(handler))
+
+
+def check_fault_taxonomy(mod: Module) -> List[Finding]:
+    """INV201/INV202 — broad handlers must not swallow silently: re-raise
+    (the caller classifies) or route through the taxonomy
+    (classify/note_fault/warn_fault/demote); and every literal site string
+    handed to the injection/span machinery must exist in the canonical
+    registries, so a typo'd site is a lint error instead of a dead hook."""
+    findings: List[Finding] = []
+    if mod.path != FAULTS_MODULE:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler) or not _is_broad_handler(node):
+                continue
+            if _handler_raises(node) or any(
+                call_name(c) in FAULT_ROUTERS for c in walk_calls(node)
+            ):
+                continue
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV201",
+                    "broad except swallows the exception without re-raising or"
+                    " routing through faults.classify/note_fault/warn_fault/demote",
+                )
+            )
+    fault_families = set(registry.fault_sites(mod.root))
+    span_names = set(registry.span_sites(mod.root))
+    for call in walk_calls(mod.tree):
+        name = call_name(call)
+        if name in ("inject_faults", "maybe_fail"):
+            site = literal_str_arg(call, 0)
+            if site is not None and registry.site_family(site) not in fault_families:
+                findings.append(
+                    mod.finding(
+                        call,
+                        "INV202",
+                        f"injection site {site!r} is not in faults.FAULT_SITES"
+                        " — the plan would never fire",
+                    )
+                )
+        elif name == "emit":
+            base = call_base(call)
+            if base is None or "telemetry" not in base.lower():
+                continue
+            site = literal_str_arg(call, 0)
+            if site is not None and site not in span_names:
+                findings.append(
+                    mod.finding(
+                        call,
+                        "INV202",
+                        f"span site {site!r} is not in telemetry.SPAN_SITES"
+                        " — traces and docs cannot account for it",
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------- pass 4: telemetry typing
+def _stats_keys(mod: Module):
+    """Yield ``(node, key)`` for every string-literal stats key:
+    ``_counters[...] += / = ...`` subscripts, ``_bump("...")`` calls, and the
+    declaring dict literals (``_counters = {...}`` / ``_stats.update({...})``)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in STATS_DICT_NAMES
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    yield node, t.slice.value
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id in STATS_DICT_NAMES:
+                        for key in node.value.keys:
+                            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                                yield key, key.value
+        elif isinstance(node, ast.Call):
+            if call_name(node) == "_bump":
+                key = literal_str_arg(node, 0)
+                if key is not None:
+                    yield node, key
+            elif (
+                call_name(node) == "update"
+                and call_base(node) in STATS_DICT_NAMES
+                and node.args
+                and isinstance(node.args[0], ast.Dict)
+            ):
+                for key in node.args[0].keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        yield key, key.value
+
+
+def check_telemetry_typing(mod: Module) -> List[Finding]:
+    """INV301/INV302 — every key a module counts into the snapshot surface
+    must carry a type: counter-prefixed (``telemetry.is_counter_key``) or a
+    deliberate gauge carve-out. An untyped key scrapes as a gauge by
+    accident AND the fleet merge min/median/maxes it instead of summing —
+    the scrape and the aggregate silently disagree about what it means."""
+    findings: List[Finding] = []
+    seen = set()
+    for node, key in _stats_keys(mod):
+        anchor = (node.lineno, key)
+        if anchor in seen:
+            continue
+        seen.add(anchor)
+        if not PROM_NAME.match(key):
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV302",
+                    f"stats key {key!r} is not a valid Prometheus family name"
+                    " (after sanitization two keys could collide)",
+                )
+            )
+        elif not registry.is_counter_key(key, mod.root) and not registry.is_gauge_carveout(
+            key, mod.root
+        ):
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV301",
+                    f"stats key {key!r} is untyped: telemetry.is_counter_key rejects it"
+                    " and it is not a gauge carve-out — add a counter prefix or"
+                    " carve it out explicitly in ops/telemetry.py",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------- pass 5: warn-once
+def _warnings_aliases(mod: Module) -> tuple:
+    """``(module_aliases, bare_warn_names)`` — every spelling this module can
+    reach ``warnings.warn`` under: ``import warnings [as w]`` and
+    ``from warnings import warn [as w]``."""
+    module_aliases: Set[str] = set()
+    bare_names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "warnings":
+                    module_aliases.add(alias.asname or "warnings")
+        elif isinstance(node, ast.ImportFrom) and node.module == "warnings":
+            for alias in node.names:
+                if alias.name == "warn":
+                    bare_names.add(alias.asname or "warn")
+    return module_aliases, bare_names
+
+
+def check_warn_discipline(mod: Module) -> List[Finding]:
+    """INV401 — direct ``warnings.warn`` bypasses both the rank-zero gate and
+    the per-owner+domain dedupe; on a hot path that is one warning per step
+    per rank. ``faults.warn_fault`` (fault-driven, deduped) and
+    ``rank_zero_warn`` (informational) are the sanctioned spellings. Aliased
+    spellings (``import warnings as w``, ``from warnings import warn``) are
+    resolved through the module's imports so they cannot slip past."""
+    if mod.path == PRINTS_MODULE:
+        return []  # the one module that may spell it out: it IS the wrapper
+    module_aliases, bare_names = _warnings_aliases(mod)
+    findings: List[Finding] = []
+    for call in walk_calls(mod.tree):
+        direct = call_name(call) == "warn" and call_base(call) in module_aliases
+        bare = isinstance(call.func, ast.Name) and call.func.id in bare_names
+        if direct or bare:
+            findings.append(
+                mod.finding(
+                    call,
+                    "INV401",
+                    "direct warnings.warn — use faults.warn_fault (deduped, classified)"
+                    " or utils.prints.rank_zero_warn (rank-gated)",
+                )
+            )
+    return findings
+
+
+ALL_PASSES = (
+    check_collective_discipline,
+    check_retry_purity,
+    check_fault_taxonomy,
+    check_telemetry_typing,
+    check_warn_discipline,
+)

@@ -1,0 +1,119 @@
+"""Canonical machine-readable registries, extracted from the source of truth.
+
+`ops/faults.py` owns the injection-site tuple (``FAULT_SITES``) and
+`ops/telemetry.py` owns the span-site table (``SPAN_SITES``) plus the
+counter/gauge typing rules behind ``is_counter_key``. Three consumers ride
+this module so none of them can drift from the package:
+
+- the invariant linter (site-string validation, counter typing) — this
+  package;
+- ``tools/check_docs.py`` — every registered site must have a docs-table row;
+- ``tools/fault_sweep.py`` imports ``faults.FAULT_SITES`` directly (it
+  already pays the package import) and asserts sweep coverage against it.
+
+Extraction is AST-based (``ast.literal_eval`` on the module-level literal
+assignments), NOT an import of ``metrics_tpu`` — the lint and docs stages
+stay stdlib-only and run in milliseconds, with no jax in sight. The
+companion test (``tests/tools/test_invlint.py``) pins the parsed values
+against the imported package, so the two views cannot diverge silently.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from functools import lru_cache
+from typing import Dict, Tuple
+
+#: Repo root (this file lives at tools/invlint/registry.py).
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FAULTS_SRC = os.path.join("metrics_tpu", "ops", "faults.py")
+_TELEMETRY_SRC = os.path.join("metrics_tpu", "ops", "telemetry.py")
+
+
+class RegistryError(RuntimeError):
+    """A canonical registry could not be extracted from its source module."""
+
+
+def _module_literals(rel_path: str, names: Tuple[str, ...], root: str = ROOT) -> Dict[str, object]:
+    """Evaluate the module-level literal assignments ``names`` in ``rel_path``."""
+    path = os.path.join(root, rel_path)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError) as err:
+        raise RegistryError(f"cannot parse {rel_path}: {err}") from err
+    wanted = set(names)
+    out: Dict[str, object] = {}
+    for node in tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id in wanted:
+                try:
+                    out[target.id] = ast.literal_eval(value)
+                except ValueError as err:
+                    raise RegistryError(
+                        f"{rel_path}:{node.lineno}: {target.id} is not a pure literal"
+                        f" ({err}); the registry must stay statically extractable"
+                    ) from err
+    missing = wanted - set(out)
+    if missing:
+        raise RegistryError(f"{rel_path}: registry name(s) not found: {sorted(missing)}")
+    return out
+
+
+@lru_cache(maxsize=8)
+def fault_sites(root: str = ROOT) -> Tuple[str, ...]:
+    """The canonical injection-site families (``faults.FAULT_SITES``)."""
+    return tuple(_module_literals(_FAULTS_SRC, ("FAULT_SITES",), root)["FAULT_SITES"])
+
+
+@lru_cache(maxsize=8)
+def span_sites(root: str = ROOT) -> Tuple[str, ...]:
+    """The canonical span-site names (keys of ``telemetry.SPAN_SITES``)."""
+    table = _module_literals(_TELEMETRY_SRC, ("SPAN_SITES",), root)["SPAN_SITES"]
+    return tuple(table)
+
+
+@lru_cache(maxsize=8)
+def counter_typing(root: str = ROOT) -> Tuple[Tuple[str, ...], Tuple[str, ...], Tuple[str, ...]]:
+    """``(counter_prefixes, gauge_suffixes, gauge_prefixes)`` — the typing
+    rules behind ``telemetry.is_counter_key``/``prometheus_text``."""
+    lits = _module_literals(
+        _TELEMETRY_SRC, ("_COUNTER_PREFIXES", "_GAUGE_SUFFIXES", "_GAUGE_PREFIXES"), root
+    )
+    return (
+        tuple(lits["_COUNTER_PREFIXES"]),
+        tuple(lits["_GAUGE_SUFFIXES"]),
+        tuple(lits["_GAUGE_PREFIXES"]),
+    )
+
+
+def is_counter_key(key: str, root: str = ROOT) -> bool:
+    """``telemetry.is_counter_key``, recomputed from the extracted rules."""
+    counter_prefixes, gauge_suffixes, gauge_prefixes = counter_typing(root)
+    return (
+        key.startswith(counter_prefixes)
+        and not key.endswith(gauge_suffixes)
+        and not key.startswith(gauge_prefixes)
+    )
+
+
+def is_gauge_carveout(key: str, root: str = ROOT) -> bool:
+    """Whether ``key`` is a DELIBERATE gauge (ratio suffix / health block),
+    as opposed to an untyped key that merely fails the counter prefixes."""
+    _, gauge_suffixes, gauge_prefixes = counter_typing(root)
+    return key.endswith(gauge_suffixes) or key.startswith(gauge_prefixes)
+
+
+def site_family(site: str) -> str:
+    """Collapse an indexed site (``flush-chunk-2``) onto its registry family."""
+    head, sep, tail = site.rpartition("-")
+    if sep and tail.isdigit():
+        return head
+    return site
