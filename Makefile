@@ -87,11 +87,14 @@ chaos:
 # Telemetry smoke: run a small suite with the flight recorder armed, export
 # the Chrome-trace/Perfetto JSON, and validate + summarize it with the
 # report tool (docs/observability.md). --smoke implies --check semantics:
-# a structurally invalid trace (bad events, non-monotonic timestamps) fails.
-# The fleet smoke then runs the dryrun-multichip fleet path: a simulated
-# 3-rank world (deliberately-slow rank flagged by the straggler report),
-# one merged one-process-per-rank trace validated with --check, and a
-# --diff counter-delta report between two consecutive snapshots.
+# a structurally invalid trace (bad events, non-monotonic timestamps, a
+# malformed latency histogram plane) fails, and the latency digest must be
+# present in the snapshot and the report. The fleet smoke then runs the
+# dryrun-multichip fleet path: a simulated 3-rank world (deliberately-slow
+# rank flagged by BOTH the mean-based and tail-aware straggler scores),
+# fleet histogram bucket counts asserted as exact per-rank sums, one merged
+# one-process-per-rank trace validated with --check, and a --diff
+# counter-delta report between two consecutive snapshots.
 trace:
 	$(PY) tools/trace_report.py --smoke
 	$(PY) tools/trace_report.py --fleet-smoke

@@ -51,6 +51,37 @@ if SMOKE:
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
+def _latency_percentiles(step, n: int) -> dict:
+    """Per-call latency percentiles (ms) over one extra ``n``-call pass,
+    accumulated through the SAME full-lifetime histogram class the telemetry
+    plane scrapes (``telemetry.LatencyHistogram``) — every percentile this
+    bench publishes is bucket-interpolated exactly the way
+    ``latency_stats()`` / ``prometheus_text()`` compute theirs, so a bench
+    row and a production scrape are comparable numbers. Mean-of-best
+    throughput hides the tail; these columns are what
+    ``tools/sweep_regress.py``'s distribution-aware gate compares."""
+    if _REPO_DIR not in sys.path:
+        sys.path.insert(0, _REPO_DIR)
+    from metrics_tpu.ops.telemetry import LatencyHistogram
+
+    h = LatencyHistogram()
+    for _ in range(n):
+        t0 = time.perf_counter()
+        step()
+        h.observe(time.perf_counter() - t0)
+    s = h.stats()
+    # ONE latency_ms schema across bench.py and tools/bench_sweep.py rows
+    # (ms values under p50/p95/p99/max) — tools/sweep_regress.py's
+    # distribution gate reads exactly these keys
+    return {
+        "p50": round(s["p50_s"] * 1000.0, 4),
+        "p95": round(s["p95_s"] * 1000.0, 4),
+        "p99": round(s["p99_s"] * 1000.0, 4),
+        "max": round(s["max_s"] * 1000.0, 4),
+        "n": int(s["count"]),
+    }
+
+
 def _reference():
     if _REPO_DIR not in sys.path:
         sys.path.insert(0, _REPO_DIR)
@@ -70,7 +101,7 @@ def _make_data(seed: int = 0):
 
 # ------------------------------------------------------- fused suite (headline)
 
-def bench_suite_ours(probs: np.ndarray, target: np.ndarray) -> float:
+def bench_suite_ours(probs: np.ndarray, target: np.ndarray) -> tuple:
     import jax
     import jax.numpy as jnp
 
@@ -103,8 +134,18 @@ def bench_suite_ours(probs: np.ndarray, target: np.ndarray) -> float:
             states = fused_update(states, p, t)
         jax.block_until_ready(states)
         best = min(best, time.perf_counter() - start)
-    _ = compute(states)
-    return STEPS * BATCH / best
+    # per-step dispatch-latency distribution (one extra pass): per-call wall
+    # time of the fused donated-state dispatch, final sync outside the timed
+    # calls — the tail (queue hiccups, tunnel jitter) the best-of mean hides
+    box = {"st": states}
+
+    def _step():
+        box["st"] = fused_update(box["st"], p, t)
+
+    lat = _latency_percentiles(_step, STEPS)
+    jax.block_until_ready(box["st"])
+    _ = compute(box["st"])
+    return STEPS * BATCH / best, lat
 
 
 def bench_suite_reference(probs: np.ndarray, target: np.ndarray) -> float:
@@ -573,7 +614,7 @@ def bench_overhead_batched_ours() -> float:
     return MANY_STEPS / best
 
 
-def bench_overhead_deferred_ours() -> float:
+def bench_overhead_deferred_ours() -> tuple:
     """Steps/s of the UNMODIFIED eager module API with deferred micro-batched
     dispatch on (the default): per-step `metric(preds, target)` calls enqueue
     and flush as stacked `lax.scan` programs at the queue threshold — the
@@ -609,7 +650,12 @@ def bench_overhead_deferred_ours() -> float:
             metric(p, t)
         jax.block_until_ready(metric.correct)  # observation: final flush
         best = min(best, time.perf_counter() - start)
-    return OVERHEAD_STEPS / best
+    # per-STEP latency distribution: most steps are a host-side enqueue
+    # (µs), every METRICS_TPU_DEFER_MAX-th step pays the flush dispatch —
+    # the bimodal shape is exactly what p50-vs-p99 makes visible
+    lat = _latency_percentiles(lambda: metric(p, t), OVERHEAD_STEPS)
+    jax.block_until_ready(metric.correct)
+    return OVERHEAD_STEPS / best, lat
 
 
 def bench_fault_overhead() -> dict:
@@ -691,15 +737,35 @@ def bench_telemetry_overhead() -> dict:
             best = min(best, time.perf_counter() - start)
         return OVERHEAD_STEPS / best
 
+    def loop_latency() -> dict:
+        metric = Accuracy()
+        metric(p, t)
+        for _ in range(OVERHEAD_STEPS):
+            metric(p, t)
+        jax.block_until_ready(metric.correct)
+        lat = _latency_percentiles(lambda: metric(p, t), OVERHEAD_STEPS)
+        jax.block_until_ready(metric.correct)
+        return lat
+
     was_armed = telemetry.armed
     try:
         telemetry.set_telemetry(False)
         disarmed = loop_steps_per_s()
+        disarmed_lat = loop_latency()
+        # armed now includes the FULL-LIFETIME histogram path: every timed
+        # span emit is additionally one bucket increment (plus the cached
+        # SLO-limit check), so armed≈disarmed pins histogram-armed overhead
         telemetry.set_telemetry(True)
         armed = loop_steps_per_s()
+        armed_lat = loop_latency()
     finally:
         telemetry.set_telemetry(was_armed)
-    return {"disarmed_steps_per_s": disarmed, "armed_steps_per_s": armed}
+    return {
+        "disarmed_steps_per_s": disarmed,
+        "armed_steps_per_s": armed,
+        "disarmed_latency_ms": disarmed_lat,
+        "armed_latency_ms": armed_lat,
+    }
 
 
 def bench_sync_per_call() -> dict:
@@ -757,7 +823,18 @@ def bench_sync_per_call() -> dict:
                 - s0["sync_shape_collectives"]
                 - s0["sync_payload_collectives"]
             ) / (n_syncs * TRIALS)
-            return {"syncs_per_s": n_syncs / best, "collectives_per_sync": per_sync}
+
+            def _cycle():
+                coll.sync(distributed_available=dist_on)
+                coll.unsync()
+
+            lat = _latency_percentiles(_cycle, n_syncs)
+            jax.block_until_ready(coll["mean"].value)
+            return {
+                "syncs_per_s": n_syncs / best,
+                "collectives_per_sync": per_sync,
+                "latency": lat,
+            }
         finally:
             os.environ.pop("METRICS_TPU_SYNC_COALESCE", None)
 
@@ -766,8 +843,10 @@ def bench_sync_per_call() -> dict:
     return {
         "coalesced_syncs_per_s": coalesced["syncs_per_s"],
         "coalesced_collectives_per_sync": coalesced["collectives_per_sync"],
+        "coalesced_latency_ms": coalesced["latency"],
         "per_state_syncs_per_s": per_state["syncs_per_s"],
         "per_state_collectives_per_sync": per_state["collectives_per_sync"],
+        "per_state_latency_ms": per_state["latency"],
     }
 
 
@@ -863,10 +942,12 @@ def bench_journal_write() -> dict:
         for _ in range(n_snaps):
             coll.save_state(path)
         best = min(best, time.perf_counter() - start)
+    lat = _latency_percentiles(lambda: coll.save_state(path), n_snaps)
     return {
         "snapshots_per_s": n_snaps / best,
         "ms_per_snapshot": 1000.0 * best / n_snaps,
         "record_bytes": nbytes,
+        "latency_ms": lat,
     }
 
 
@@ -909,6 +990,8 @@ def bench_fleet_snapshot() -> dict:
     try:
         telemetry.set_telemetry(True)
         armed = loop()
+        lat = _latency_percentiles(fleetobs.fleet_snapshot, n_snaps)
+        calls["n"] += n_snaps
         telemetry.set_telemetry(False)
         disarmed = loop()
     finally:
@@ -918,6 +1001,7 @@ def bench_fleet_snapshot() -> dict:
         "armed_snapshots_per_s": armed,
         "disarmed_snapshots_per_s": disarmed,
         "collectives_per_snapshot": collectives / max(1, calls["n"]),
+        "latency_ms": lat,
     }
 
 
@@ -957,7 +1041,7 @@ def main() -> None:
         sys.path.insert(0, _REPO_DIR)
     probs, target = _make_data()
 
-    ours_suite = bench_suite_ours(probs, target)
+    ours_suite, suite_lat = bench_suite_ours(probs, target)
     ref_suite = _safe(bench_suite_reference, probs, target)
 
     # per-step workloads run BEFORE the image/detection wall-clocks: FID's
@@ -972,7 +1056,7 @@ def main() -> None:
     floor = bench_dispatch_floor()
     # deferred row runs right after the floor probes it is compared against —
     # same backend regime, same shaped comparators
-    ours_overhead_deferred = bench_overhead_deferred_ours()
+    ours_overhead_deferred, deferred_lat = bench_overhead_deferred_ours()
     # fault instrumentation probe rides the same regime as the deferred row
     # it bounds (same loop shape, same backend state)
     fault_probe = bench_fault_overhead()
@@ -1012,6 +1096,9 @@ def main() -> None:
             "baseline": round(ref_suite, 1),
             "baseline_hardware": "torch-cpu",
             "vs_baseline": ratio(ours_suite, ref_suite),
+            # per-step dispatch-latency percentiles, bucket-interpolated by
+            # the telemetry plane's LatencyHistogram (docs/performance.md)
+            "latency_ms": suite_lat,
         },
         "fid_wallclock": {
             "value": round(ours_fid, 3),
@@ -1075,6 +1162,10 @@ def main() -> None:
             "vs_forward_many": round(ours_overhead_deferred / ours_overhead_batched, 3)
             if ours_overhead_batched > 0
             else None,
+            # per-step percentiles: p50 is the host-side enqueue, the tail is
+            # the every-DEFER_MAX-steps flush dispatch — the bimodal shape
+            # the mean throughput number averages away
+            "latency_ms": deferred_lat,
             "shaped_program_roundtrip_ms": round(floor["shaped_program_roundtrip_ms"], 3),
             "note": (
                 "eager API loop, zero code changes: per-step calls enqueue "
@@ -1120,14 +1211,22 @@ def main() -> None:
             )
             if telemetry_probe["disarmed_steps_per_s"] > 0
             else None,
+            # per-step percentile twins of the ratio pin: the armed pass now
+            # ALSO exercises the full-lifetime latency histogram (one bucket
+            # increment + cached SLO check per timed span)
+            "disarmed_latency_ms": telemetry_probe["disarmed_latency_ms"],
+            "armed_latency_ms": telemetry_probe["armed_latency_ms"],
             "unit": "forward steps/s (eager module API, deferred dispatch on)",
             "note": (
                 "armed_vs_disarmed >= 0.95 pins the ISSUE-7 acceptance bar "
                 "(< 5% armed overhead): per enqueue the recorder appends one "
                 "instant-span tuple to a bounded deque, and flush/dispatch/"
-                "compile slices amortize over the queue window; disarmed, "
-                "every site is a single predicate check and allocates "
-                "nothing (docs/observability.md)"
+                "compile slices amortize over the queue window; armed also "
+                "pays the ISSUE-11 latency-histogram path (one bucket-index "
+                "increment per TIMED span — instants skip it entirely, so "
+                "the hottest enqueue site pays nothing); disarmed, every "
+                "site is a single predicate check and allocates nothing "
+                "(docs/observability.md)"
             ),
         },
         "sync_per_call": {
@@ -1146,6 +1245,12 @@ def main() -> None:
             "per_state_collectives_per_sync": round(
                 sync_probe["per_state_collectives_per_sync"], 2
             ),
+            # per-cycle latency percentiles for both protocols: the tail of
+            # the coalesced cycle is the number the EQuARX-style quantized
+            # lane (ROADMAP item 3) must beat, measured the same way the
+            # production scrape measures it
+            "coalesced_latency_ms": sync_probe["coalesced_latency_ms"],
+            "per_state_latency_ms": sync_probe["per_state_latency_ms"],
             "unit": "suite sync+unsync cycles/s (4-metric multi-state suite, simulated world)",
             "note": (
                 "coalesced: ONE packed payload collective slot + one donated "
@@ -1203,6 +1308,7 @@ def main() -> None:
             "armed_snapshots_per_s": round(fleet_probe["armed_snapshots_per_s"], 1),
             "disarmed_snapshots_per_s": round(fleet_probe["disarmed_snapshots_per_s"], 1),
             "collectives_per_snapshot": round(fleet_probe["collectives_per_snapshot"], 4),
+            "latency_ms": fleet_probe["latency_ms"],
             "unit": "fleet_snapshot() calls/s (world size 1, 2-metric suite)",
             "note": (
                 "collectives_per_snapshot == 0 pins the world-size-1 "
@@ -1220,6 +1326,7 @@ def main() -> None:
             "snapshots_per_s": round(journal_probe["snapshots_per_s"], 1),
             "ms_per_snapshot": round(journal_probe["ms_per_snapshot"], 3),
             "record_bytes": journal_probe["record_bytes"],
+            "latency_ms": journal_probe["latency_ms"],
             "unit": "save_state() calls/s (4-metric multi-state suite)",
             "note": (
                 "bounds the journal(path, every_n) cadence: at every_n=N the "

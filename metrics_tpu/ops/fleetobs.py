@@ -12,9 +12,12 @@ This module is the fleet plane on top of the local one, in three faces:
   ``_host_allgather`` + ``run_with_deadline`` + ``check_epoch`` ladder every
   other collective protocol rides), merged into a schema-stable dict with
   per-rank planes, aggregate planes (counters summed exactly; gauges
-  min/median/max), dead-rank placeholders sourced from the membership
-  registry, the straggler report, and ``world_health()`` folded in. With a
-  world size of 1 the local plane is served directly — ZERO collectives.
+  min/median/max; the full-lifetime latency histograms merged by EXACT
+  bucket sums with fleet percentiles re-interpolated from the merged
+  buckets — :func:`merge_latency_stats`), dead-rank placeholders sourced
+  from the membership registry, the straggler report, and
+  ``world_health()`` folded in. With a world size of 1 the local plane is
+  served directly — ZERO collectives.
 
 - **Straggler attribution** — every rank's snapshot carries its
   ``sync_phase_stats`` block (per-phase span duration statistics:
@@ -53,6 +56,7 @@ __all__ = [
     "fleet_stats",
     "fleet_world",
     "local_rank",
+    "merge_latency_stats",
     "merge_snapshots",
     "reset_fleet_stats",
     "straggler_report",
@@ -236,6 +240,48 @@ def _median(values: List[float]) -> float:
     return float(vals[mid]) if n % 2 else float(vals[mid - 1] + vals[mid]) / 2.0
 
 
+def merge_latency_stats(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge the per-rank full-lifetime latency histogram planes
+    (``latency_stats`` blocks) into one fleet histogram per site. Bucket
+    counts, ``count`` and ``sum_s`` are plain counters on a SHARED bucket
+    layout, so the merge is an EXACT sum — no min/median/max approximation,
+    unlike the ring-windowed ``sync_phase_stats`` gauges. ``max_s`` maxes,
+    and the fleet percentiles are re-interpolated from the MERGED bucket
+    counts (never averaged across ranks — an average of per-rank p99s is
+    not a fleet p99). Dead/missing/corrupt placeholder planes are excluded."""
+    merged: Dict[str, _telemetry.LatencyHistogram] = {}
+    known = set(_telemetry._HIST_LABELS)
+    for _, plane in sorted(planes.items()):
+        if not _is_live_plane(plane):
+            continue
+        for site, block in (plane.get("latency_stats") or {}).items():
+            if not isinstance(block, dict):
+                continue
+            buckets = block.get("buckets") or {}
+            if not set(buckets) <= known:
+                # a mixed-version fleet shipped a DIFFERENT bucket layout:
+                # merging its sums while dropping its unknown buckets would
+                # corrupt the exact-sum contract silently — skip the block
+                # whole and warn once (no-silent-caps)
+                from metrics_tpu.ops import faults as _faults
+
+                _faults.warn_fault(
+                    _MERGE_WARN_OWNER,
+                    "fleet-merge-layout",
+                    f"A rank's {site!r} latency histogram carries bucket labels "
+                    "outside this build's layout (a mixed-version fleet?); its "
+                    "block is excluded from the fleet merge rather than summed "
+                    "inconsistently.",
+                )
+                continue
+            h = merged.setdefault(site, _telemetry.LatencyHistogram())
+            for i, label in enumerate(_telemetry._HIST_LABELS):
+                h.counts[i] += int(buckets.get(label, 0))
+            h.sum_s += float(block.get("sum_s", 0.0))
+            h.max_s = max(h.max_s, float(block.get("max_s", 0.0)))
+    return {site: merged[site].stats() for site in sorted(merged)}
+
+
 def merge_snapshots(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
     """Reduce per-rank snapshot planes into the aggregate plane: every
     flattened numeric key classified by the SAME predicate the Prometheus
@@ -243,8 +289,11 @@ def merge_snapshots(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
     — **counters summed exactly** (the dryrun certification pins aggregate ==
     sum of per-rank), gauges reduced to ``min``/``median``/``max``. The
     shared-monotonic-axis keys (:data:`FLEET_GAUGE_KEYS`) reduce as gauges —
-    cross-rank step skew is the signal, a sum would be noise. Dead /
-    missing / corrupt placeholder planes are excluded."""
+    cross-rank step skew is the signal, a sum would be noise. The latency
+    histogram planes additionally merge structurally under ``latency_stats``
+    (exact bucket sums + fleet percentiles re-interpolated from the merged
+    buckets — :func:`merge_latency_stats`). Dead / missing / corrupt
+    placeholder planes are excluded."""
     counters: Dict[str, float] = {}
     gauge_values: Dict[str, List[float]] = {}
     merged_ranks: List[int] = []
@@ -252,7 +301,15 @@ def merge_snapshots(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
         if not _is_live_plane(plane):
             continue
         merged_ranks.append(rank)
-        numeric = {k: v for k, v in plane.items() if k != "failure_log"}
+        # the latency histogram plane merges STRUCTURALLY below (exact bucket
+        # sums, percentiles re-interpolated); flattening it here too would
+        # duplicate the bucket counters and min/median/max the per-rank
+        # percentiles — the meaningless reduction this module exists to avoid
+        numeric = {
+            k: v
+            for k, v in plane.items()
+            if k not in ("failure_log", _telemetry._HIST_SNAPSHOT_KEY)
+        }
         for key, value in _telemetry._flat_numeric("", numeric):
             if _fleet_is_counter(key):
                 counters[key] = counters.get(key, 0) + value
@@ -267,32 +324,53 @@ def merge_snapshots(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
         k: {"min": float(min(v)), "median": _median(v), "max": float(max(v))}
         for k, v in sorted(gauge_values.items())
     }
-    return {"counters": counters_out, "gauges": gauges_out, "ranks_merged": merged_ranks}
+    return {
+        "counters": counters_out,
+        "gauges": gauges_out,
+        "latency_stats": merge_latency_stats(planes),
+        "ranks_merged": merged_ranks,
+    }
 
 
 def straggler_report(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
-    """Name the slowest ranks per sync phase, with deviation scores.
+    """Name the slowest ranks per sync phase, with deviation scores — both
+    mean-based and **tail-aware**.
 
-    Each live plane's ``sync_phase_stats`` block carries per-phase mean span
-    durations; for every phase with data the report records the per-rank
+    Each live plane carries two per-phase latency views: the ring-windowed
+    ``sync_phase_stats`` means and the full-lifetime ``latency_stats``
+    percentiles. For every phase with data the report records the per-rank
     means, the fleet median, the slowest rank and its deviation
-    ``(mean - median) / median``. ``stragglers`` lists the ranks whose worst
-    phase deviation exceeds :func:`straggler_threshold`, worst first;
-    ``ranked`` orders every attributed rank the same way."""
+    ``(mean - median) / median`` — and, beside it, the per-rank **p95**
+    latencies with the analogous tail deviation ``(p95 - median_p95) /
+    median_p95`` (a rank whose mean looks fine but whose tail is 10x the
+    fleet's is exactly the straggler the mean hides). ``stragglers`` lists
+    the ranks whose worst deviation on EITHER measure exceeds
+    :func:`straggler_threshold`, worst first; ``ranked`` orders every
+    attributed rank the same way, naming the measure that flagged it."""
     live = {
-        r: p["sync_phase_stats"]
+        r: p
         for r, p in planes.items()
         if _is_live_plane(p) and isinstance(p.get("sync_phase_stats"), dict)
     }
     threshold = straggler_threshold()
     phases: Dict[str, Dict[str, Any]] = {}
-    worst: Dict[int, Tuple[float, str]] = {}
+    worst: Dict[int, Tuple[float, str, str]] = {}
+
+    def _attribute(deviations: Dict[int, float], site: str, measure: str) -> None:
+        for r, d in deviations.items():
+            if r not in worst or d > worst[r][0]:
+                worst[r] = (d, site, measure)
+
     for site in _telemetry.SYNC_PHASE_SITES:
         per_rank = {}
-        for r, stats in live.items():
-            block = stats.get(site) or {}
+        per_rank_p95 = {}
+        for r, plane in live.items():
+            block = (plane.get("sync_phase_stats") or {}).get(site) or {}
             if float(block.get("count", 0)) > 0:
                 per_rank[r] = float(block.get("mean_s", 0.0))
+            lat = (plane.get("latency_stats") or {}).get(site) or {}
+            if float(lat.get("count", 0)) > 0:
+                per_rank_p95[r] = float(lat.get("p95_s", 0.0))
         entry: Dict[str, Any] = {
             "per_rank_mean_s": per_rank,
             "median_s": 0.0,
@@ -300,6 +378,12 @@ def straggler_report(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
             "slowest_mean_s": 0.0,
             "deviation": 0.0,
             "per_rank_deviation": {},
+            # the tail-aware plane (full-lifetime histogram p95 per rank)
+            "per_rank_p95_s": per_rank_p95,
+            "p95_median_s": 0.0,
+            "tail_slowest_rank": None,
+            "tail_deviation": 0.0,
+            "per_rank_tail_deviation": {},
         }
         if per_rank:
             med = _median(list(per_rank.values()))
@@ -314,13 +398,24 @@ def straggler_report(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
                 deviation=deviations[slowest],
                 per_rank_deviation=deviations,
             )
-            for r, d in deviations.items():
-                if r not in worst or d > worst[r][0]:
-                    worst[r] = (d, site)
+            _attribute(deviations, site, "mean_s")
+        if per_rank_p95:
+            med95 = _median(list(per_rank_p95.values()))
+            tail_devs = {
+                r: (v - med95) / max(med95, 1e-12) for r, v in per_rank_p95.items()
+            }
+            tail_slowest = max(per_rank_p95, key=lambda r: per_rank_p95[r])
+            entry.update(
+                p95_median_s=med95,
+                tail_slowest_rank=tail_slowest,
+                tail_deviation=tail_devs[tail_slowest],
+                per_rank_tail_deviation=tail_devs,
+            )
+            _attribute(tail_devs, site, "p95_s")
         phases[site] = entry
     ranked = [
-        {"rank": r, "phase": site, "deviation": d}
-        for r, (d, site) in sorted(worst.items(), key=lambda kv: -kv[1][0])
+        {"rank": r, "phase": site, "deviation": d, "measure": measure}
+        for r, (d, site, measure) in sorted(worst.items(), key=lambda kv: -kv[1][0])
     ]
     return {
         "phases": phases,
@@ -452,9 +547,14 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     ``gathered``), the aggregate counters (``metrics_tpu_fleet_<key>``,
     typed ``counter``) and aggregate gauges (``_min``/``_median``/``_max``),
     per-rank liveness/health gauges (``rank`` label), the per-rank sync
-    phase statistics (``rank`` + ``phase`` labels) and the straggler
-    deviation scores. Samples of one family are grouped under a single
-    ``# TYPE`` line, as the text format requires.
+    phase statistics (``rank`` + ``phase`` labels, mean AND full-lifetime
+    p95), the straggler deviation scores (mean-based and tail-aware), and
+    the latency **histogram** families: the fleet-merged
+    ``metrics_tpu_fleet_latency_seconds{site=...,le=...}`` (exact bucket
+    sums across ranks) and the rank-labelled
+    ``metrics_tpu_fleet_rank_latency_seconds{rank=...,site=...,le=...}``.
+    Samples of one family are grouped under a single ``# TYPE`` line, as
+    the text format requires.
 
     .. warning:: With no ``snap`` argument this calls
        :func:`fleet_snapshot`, which in a multi-rank world is a
@@ -492,6 +592,11 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
 
     agg = snap.get("aggregate") or {}
     for key, value in (agg.get("counters") or {}).items():
+        # histogram samples render as le-labelled families below, never as
+        # flat counter scalars — the same is_histogram_sample_key carve-out
+        # prometheus_text applies, so the two expositions cannot disagree
+        if _telemetry.is_histogram_sample_key(key):
+            continue
         family(_prom_name(key), "counter", [("", float(value))])
     for key, stats in (agg.get("gauges") or {}).items():
         for stat in ("min", "median", "max"):
@@ -500,8 +605,9 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     ranks = snap.get("ranks") or {}
     live_samples, dead_samples, degraded_samples = [], [], []
     phase_samples: Dict[str, List[Tuple[str, float]]] = {
-        "count": [], "mean": [], "max": [], "total": []
+        "count": [], "mean": [], "max": [], "total": [], "p95": []
     }
+    per_rank_latency: Dict[str, Dict[str, Any]] = {}
     for rank in sorted(ranks):
         plane = ranks[rank]
         label = f'{{rank="{rank}"}}'
@@ -512,6 +618,7 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
             health = plane.get("sync_health") or {}
             degraded_samples.append((label, 1 if health.get("degraded") else 0))
             stats = plane.get("sync_phase_stats") or {}
+            latency = plane.get("latency_stats") or {}
             for site in _telemetry.SYNC_PHASE_SITES:
                 block = stats.get(site) or {}
                 if not float(block.get("count", 0)):
@@ -521,6 +628,14 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
                 phase_samples["mean"].append((plabel, float(block.get("mean_s", 0.0))))
                 phase_samples["max"].append((plabel, float(block.get("max_s", 0.0))))
                 phase_samples["total"].append((plabel, float(block.get("total_s", 0.0))))
+                lat = latency.get(site) or {}
+                if float(lat.get("count", 0)) > 0:
+                    # tail-aware twin of the mean sample: full-lifetime p95
+                    phase_samples["p95"].append((plabel, float(lat.get("p95_s", 0.0))))
+            for site, block in latency.items():
+                # composite key carries rank + site through the shared
+                # histogram renderer ('\x00' cannot appear in a site name)
+                per_rank_latency[f"{rank}\x00{site}"] = block
     family("metrics_tpu_fleet_rank_live", "gauge", live_samples)
     family("metrics_tpu_fleet_rank_dead", "gauge", dead_samples)
     family("metrics_tpu_fleet_rank_degraded", "gauge", degraded_samples)
@@ -528,13 +643,17 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     family("metrics_tpu_fleet_sync_phase_mean_seconds", "gauge", phase_samples["mean"])
     family("metrics_tpu_fleet_sync_phase_max_seconds", "gauge", phase_samples["max"])
     family("metrics_tpu_fleet_sync_phase_total_seconds", "gauge", phase_samples["total"])
+    family("metrics_tpu_fleet_sync_phase_p95_seconds", "gauge", phase_samples["p95"])
 
     stragglers = snap.get("stragglers") or {}
-    dev_samples = []
+    dev_samples, tail_samples = [], []
     for site, entry in (stragglers.get("phases") or {}).items():
         for rank, dev in (entry.get("per_rank_deviation") or {}).items():
             dev_samples.append((f'{{rank="{rank}",phase="{site}"}}', float(dev)))
+        for rank, dev in (entry.get("per_rank_tail_deviation") or {}).items():
+            tail_samples.append((f'{{rank="{rank}",phase="{site}"}}', float(dev)))
     family("metrics_tpu_fleet_straggler_deviation", "gauge", dev_samples)
+    family("metrics_tpu_fleet_straggler_tail_deviation", "gauge", tail_samples)
     flagged = [(f'{{rank="{r}"}}', 1.0) for r in stragglers.get("stragglers") or ()]
     family("metrics_tpu_fleet_straggler_flagged", "gauge", flagged)
 
@@ -542,6 +661,28 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     for name, kind, samples in families:
         lines.append(f"# TYPE {name} {kind}")
         lines.extend(samples)
+    # histogram families LAST (the scalar families above stay one TYPE line +
+    # unlabelled/labelled samples; the renderer below emits its own headers):
+    # the fleet-merged histograms (exact bucket sums, le-labelled) and the
+    # rank-labelled per-rank histograms — same renderer prometheus_text uses,
+    # so the local and fleet expositions cannot disagree about layout
+    lines.extend(
+        _telemetry._histogram_exposition_lines(
+            agg.get("latency_stats") or {}, family="metrics_tpu_fleet_latency_seconds"
+        )
+    )
+
+    def _rank_site_label(key: str) -> str:
+        rank, site = key.split("\x00", 1)
+        return f'rank="{rank}",site="{site}"'
+
+    lines.extend(
+        _telemetry._histogram_exposition_lines(
+            per_rank_latency,
+            family="metrics_tpu_fleet_rank_latency_seconds",
+            label_for=_rank_site_label,
+        )
+    )
     return "\n".join(lines) + "\n"
 
 
@@ -716,7 +857,10 @@ def export_fleet_trace(path: str) -> int:
             "dead_ranks": sorted(dead),
             "clock_offsets_s": {str(r): offsets[r] for r in sorted(offsets)},
         },
-        "snapshot": merged["counters"],
+        # the exact-summed counter plane plus the structurally-merged latency
+        # histograms, so the trace report's latency digest works on a merged
+        # fleet trace too
+        "snapshot": dict(merged["counters"], latency_stats=merged["latency_stats"]),
         "traceEvents": meta + events,
     }
     with open(path, "w", encoding="utf-8") as fh:

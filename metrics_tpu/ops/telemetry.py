@@ -29,26 +29,44 @@ around which flush. This module is the missing plane, in three layers:
   sweeps re-observe warnings deterministically; default keeps the
   warn-once lifetime). The monotonic step index never resets.
 
+- **Latency histogram plane** — the ring answers "what happened recently";
+  it cannot answer "what is p99 sync latency over this process's life",
+  because old spans drop. Every *timed* span therefore also lands in a
+  fixed log2-spaced-bucket histogram per site (:data:`_HIST_BOUNDS_S`, 1 µs
+  to ~134 s plus ``+Inf``), accumulated for the FULL process lifetime —
+  never windowed. The armed hot path stays one bucket-index increment per
+  span emit (buckets preallocated per registered site, zero allocation);
+  :func:`latency_stats` reads exact bucket counts plus interpolated
+  p50/p95/p99 per site, :func:`prometheus_text` renders them as cumulative
+  ``le``-labelled **histogram** families, and — bucket counts being plain
+  counters — ``fleet_snapshot()`` sums them EXACTLY across ranks (the
+  windowed phase means can only be min/median/max'd). Declared per-phase
+  SLO budgets (``METRICS_TPU_SLO_<PHASE>_MS``) count violations through
+  the ``slo_violations_*`` counter family and warn once per owner+phase.
+
 - **Faces** — :func:`snapshot` (alias ``telemetry_snapshot``): ONE merged,
   schema-stable dict — a strict superset of ``engine_stats()`` (which
   already folds fault + sync + journal counters) plus the span-ring
-  counters, the program-ledger summary and a global sync-health block —
-  THE monitoring surface, with :func:`prometheus_text` rendering its
-  numeric keys as a Prometheus-style exposition. :func:`export_trace`
-  writes the ring as Chrome-trace/Perfetto JSON (one track per owner,
-  nested slices; the program ledger joined under ``programLedger``) —
-  summarized offline by ``tools/trace_report.py``. See
-  docs/observability.md.
+  counters, the latency histogram plane, the program-ledger summary and a
+  global sync-health block — THE monitoring surface, with
+  :func:`prometheus_text` rendering its numeric keys as a Prometheus-style
+  exposition (counter/gauge scalars plus the ``le``-labelled histogram
+  families). :func:`export_trace` writes the ring as Chrome-trace/Perfetto
+  JSON (one track per owner, nested slices; the program ledger joined
+  under ``programLedger``) — summarized offline by
+  ``tools/trace_report.py``. See docs/observability.md.
 """
 from __future__ import annotations
 
 import json
 import os
 import time
+from bisect import bisect_left
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
+    "LatencyHistogram",
     "SPAN_SITES",
     "SYNC_PHASE_SITES",
     "armed",
@@ -56,12 +74,17 @@ __all__ = [
     "emit",
     "export_trace",
     "is_counter_key",
+    "is_histogram_sample_key",
+    "latency_stats",
     "now",
     "prometheus_text",
     "register_reset",
     "register_warning_reset",
     "reset_all",
+    "reset_latency",
     "set_telemetry",
+    "slo_limit_s",
+    "slo_violations",
     "snapshot",
     "spans",
     "sync_phase_stats",
@@ -135,10 +158,50 @@ _DEFAULT_CAP = 4096
 _TRANSITIONS_CAP = 32
 
 
+class _TelemetryWarnOwner:
+    """Warn-dedupe anchor for this module's env-knob parse warnings
+    (``faults.warn_fault`` keeps its once-per-domain marker on the owner)."""
+
+
+_ENV_WARN_OWNER = _TelemetryWarnOwner()
+
+# Env parses that run at module-import time cannot reach ``faults.warn_fault``
+# (faults imports us — warn_fault is not defined yet mid-import), so their
+# warn-once messages queue here as ``(env_name, message)`` and drain at the
+# first cold surface (``snapshot``/``latency_stats``/``set_telemetry``).
+# warn_fault's owner+domain dedupe (domain = the env name) keeps each knob's
+# warning at once per process.
+_pending_env_warnings: List[Tuple[str, str]] = []
+
+
+def _flush_env_warnings() -> None:
+    if not _pending_env_warnings:
+        return
+    from metrics_tpu.ops import faults as _faults
+
+    while _pending_env_warnings:
+        env_name, message = _pending_env_warnings.pop(0)
+        _faults.warn_fault(_ENV_WARN_OWNER, f"env:{env_name}", message)
+
+
 def _env_cap() -> int:
+    """Span-ring capacity (``METRICS_TPU_TELEMETRY_SPANS``). The same
+    warn-once contract as ``parallel/sync.py``'s ``_env_int``: unset/blank
+    is the default, a garbage value warns once NAMING the offending value
+    (queued — this runs at import) and falls back to the default."""
+    raw = os.environ.get("METRICS_TPU_TELEMETRY_SPANS")
+    if raw is None or not raw.strip():
+        return _DEFAULT_CAP
     try:
-        return max(16, int(os.environ.get("METRICS_TPU_TELEMETRY_SPANS", str(_DEFAULT_CAP))))
+        return max(16, int(raw))
     except ValueError:
+        _pending_env_warnings.append(
+            (
+                "METRICS_TPU_TELEMETRY_SPANS",
+                f"METRICS_TPU_TELEMETRY_SPANS={raw!r} is not an integer; falling back "
+                f"to the default span-ring capacity ({_DEFAULT_CAP}).",
+            )
+        )
         return _DEFAULT_CAP
 
 
@@ -156,6 +219,189 @@ def now() -> float:
     return time.perf_counter()
 
 
+# ------------------------------------------------------- latency histograms
+#: Log2-spaced latency bucket UPPER bounds in seconds (1 µs doubling to
+#: ~134 s; observations above the last bound land in the implicit ``+Inf``
+#: bucket). The ONE layout every latency histogram rides — the per-site
+#: plane, the bench-row histograms and the fleet merge — kept a PURE literal
+#: so ``tools/invlint/registry.py`` can extract it statically (INV303:
+#: bounds must stay positive and strictly increasing, or the cumulative
+#: ``le`` exposition stops being monotone).
+_HIST_BOUNDS_S = (
+    1e-06, 2e-06, 4e-06, 8e-06, 1.6e-05, 3.2e-05, 6.4e-05, 0.000128,
+    0.000256, 0.000512, 0.001024, 0.002048, 0.004096, 0.008192, 0.016384,
+    0.032768, 0.065536, 0.131072, 0.262144, 0.524288, 1.048576, 2.097152,
+    4.194304, 8.388608, 16.777216, 33.554432, 67.108864, 134.217728,
+)
+#: Prometheus family stem for the per-site histograms
+#: (``metrics_tpu_latency_seconds{site=...,le=...}``).
+_HIST_FAMILY = "latency_seconds"
+#: The snapshot key the plane lives under; its flattened sample keys
+#: (``latency_stats_<site>_buckets_<le>`` / ``_count`` / ``_sum_s``) MUST
+#: classify as counters (``is_counter_key``) so the fleet merge sums them
+#: exactly — INV303 pins that statically.
+_HIST_SNAPSHOT_KEY = "latency_stats"
+
+#: Bucket labels: one ``le`` label per finite bound (its repr — exact float
+#: round-trip), then ``+Inf``. Order IS the cumulative exposition order.
+_HIST_LABELS = tuple(repr(b) for b in _HIST_BOUNDS_S) + ("+Inf",)
+_N_BUCKETS = len(_HIST_BOUNDS_S) + 1
+
+
+def _bucket_quantile(counts: List[int], total: int, q: float, max_s: float) -> float:
+    """Interpolated quantile from per-bucket counts: find the bucket holding
+    rank ``q*total`` and interpolate linearly inside it (a log2 bucket is at
+    most 2x wide, so the estimate is within 2x of exact — the documented
+    resolution caveat). The ``+Inf`` bucket (and every estimate) clamps to
+    the exact observed maximum."""
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        prev = cum
+        cum += c
+        if cum >= rank:
+            lo = _HIST_BOUNDS_S[i - 1] if i > 0 else 0.0
+            hi = _HIST_BOUNDS_S[i] if i < len(_HIST_BOUNDS_S) else max_s
+            est = lo + (hi - lo) * ((rank - prev) / c)
+            return min(max_s, est) if max_s > 0 else est
+    return max_s
+
+
+class LatencyHistogram:
+    """One fixed log2-bucket latency histogram on the shared layout
+    (:data:`_HIST_BOUNDS_S`). The per-site plane, the bench rows
+    (``bench.py`` / ``tools/bench_sweep.py`` percentile columns) and the
+    fleet merge all ride instances of this class, so every percentile the
+    tree reports is computed the same way.
+
+    Example:
+        >>> from metrics_tpu.ops.telemetry import LatencyHistogram
+        >>> h = LatencyHistogram()
+        >>> for ms in (1, 1, 2, 40):
+        ...     h.observe(ms / 1000.0)
+        >>> block = h.stats()
+        >>> block["count"], block["max_s"]
+        (4, 0.04)
+        >>> block["p50_s"] <= block["p95_s"] <= block["p99_s"] <= block["max_s"]
+        True
+    """
+
+    __slots__ = ("counts", "sum_s", "max_s")
+
+    def __init__(self) -> None:
+        self.counts: List[int] = [0] * _N_BUCKETS
+        self.sum_s = 0.0
+        self.max_s = 0.0
+
+    def observe(self, dur_s: float) -> None:
+        """Record one duration (seconds; non-positive values are ignored —
+        instants carry no latency). One bucket-index increment."""
+        if dur_s <= 0.0:
+            return
+        self.counts[bisect_left(_HIST_BOUNDS_S, dur_s)] += 1
+        self.sum_s += dur_s
+        if dur_s > self.max_s:
+            self.max_s = dur_s
+
+    def stats(self) -> Dict[str, Any]:
+        """The schema-stable per-site block: exact ``count``/``sum_s``/
+        ``max_s``/``buckets`` (counters — the fleet merge sums them) plus
+        interpolated ``p50_s``/``p95_s``/``p99_s`` (gauges)."""
+        total = sum(self.counts)
+        block: Dict[str, Any] = {
+            "count": total,
+            "sum_s": self.sum_s,
+            "max_s": self.max_s,
+            "p50_s": 0.0,
+            "p95_s": 0.0,
+            "p99_s": 0.0,
+            "buckets": dict(zip(_HIST_LABELS, self.counts)),
+        }
+        if total:
+            for q, key in ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s")):
+                block[key] = _bucket_quantile(self.counts, total, q, self.max_s)
+        return block
+
+
+#: The per-site plane, preallocated for every registered site so the armed
+#: hot path never allocates (an unregistered site allocates once, cold).
+_site_hists: Dict[str, LatencyHistogram] = {site: LatencyHistogram() for site in SPAN_SITES}
+
+
+# ------------------------------------------------------------- SLO budgets
+class _SLOWarnOwner:
+    """Per-site warn-dedupe anchor for SLO violations emitted with no owner
+    instance (``faults.warn_fault`` stores its marker on the owner)."""
+
+
+_SLO_UNSET = object()
+#: site -> parsed budget in seconds (None = no SLO declared/off). Lazily
+#: filled on a site's first timed span; cleared by :func:`reset_latency` so
+#: tests and redeploys re-read the environment.
+_slo_limits: Dict[str, Any] = {}
+_slo_violations: Dict[str, int] = {}
+_slo_warn_owners: Dict[str, _SLOWarnOwner] = {}
+
+
+def _slo_env_name(site: str) -> str:
+    return "METRICS_TPU_SLO_" + site.upper().replace("-", "_") + "_MS"
+
+
+def slo_limit_s(site: str) -> Optional[float]:
+    """The declared latency budget for ``site`` in seconds
+    (``METRICS_TPU_SLO_<PHASE>_MS`` with the site name uppercased and
+    ``-`` -> ``_``; e.g. ``METRICS_TPU_SLO_SYNC_PAYLOAD_GATHER_MS=80``), or
+    None when unset/non-positive. An unparseable value warns once (naming
+    the offending value) and leaves the budget OFF. Cached per site until
+    :func:`reset_latency`."""
+    limit = _slo_limits.get(site, _SLO_UNSET)
+    if limit is not _SLO_UNSET:
+        return limit
+    env_name = _slo_env_name(site)
+    raw = os.environ.get(env_name)
+    limit = None
+    if raw is not None and raw.strip():
+        try:
+            ms = float(raw)
+            limit = ms / 1000.0 if ms > 0 else None
+        except (TypeError, ValueError):
+            # cold (once per site): runtime-deferred faults import, the same
+            # seam the ring-overflow warning uses
+            from metrics_tpu.ops import faults as _faults
+
+            _faults.warn_fault(
+                _ENV_WARN_OWNER,
+                f"env:{env_name}",
+                f"{env_name}={raw!r} is not a number; the {site} latency SLO stays OFF.",
+            )
+    _slo_limits[site] = limit
+    return limit
+
+
+def _note_slo_violation(site: str, owner: Any, dur: float, limit: float) -> None:
+    """Count one budget violation and warn ONCE per owner+phase (the warn
+    marker rides the emitting owner when there is one, else a per-site
+    module anchor; ``reset_stats(reset_warnings=True)`` re-arms it)."""
+    _slo_violations[site] = _slo_violations.get(site, 0) + 1
+    from metrics_tpu.ops import faults as _faults
+
+    if owner is None or type(owner) is str:
+        anchor = _slo_warn_owners.get(site)
+        if anchor is None:
+            anchor = _slo_warn_owners[site] = _SLOWarnOwner()
+    else:
+        anchor = owner
+    _faults.warn_fault(
+        anchor,
+        f"slo:{site}",
+        f"The {site} span ran {dur * 1e3:.3f} ms, over its declared "
+        f"{limit * 1e3:.3f} ms budget ({_slo_env_name(site)}); violations count "
+        "in the slo_violations_* family and in sync_health.",
+    )
+
+
 def set_telemetry(enabled: Optional[bool] = None, *, span_cap: Optional[int] = None) -> None:
     """Override the recorder at runtime (None leaves a knob unchanged; takes
     precedence over ``METRICS_TPU_TELEMETRY`` / ``_TELEMETRY_SPANS``).
@@ -167,6 +413,7 @@ def set_telemetry(enabled: Optional[bool] = None, *, span_cap: Optional[int] = N
         >>> set_telemetry(True, span_cap=4096)
     """
     global armed, _ring
+    _flush_env_warnings()
     if enabled is not None:
         armed = bool(enabled)
     if span_cap is not None:
@@ -213,9 +460,11 @@ def emit(
 ) -> None:
     """Record one span. Callers guard with ``if telemetry.armed:`` — this
     function assumes the recorder is armed and does no re-check, keeping the
-    armed path at one tuple append. ``t_start=0.0`` stamps "now" (an instant
-    event); ``owner`` may be the owning instance (stored as its type name)
-    or a pre-rendered string."""
+    armed path at one tuple append (plus, for timed spans only, one bucket
+    increment into the site's full-lifetime latency histogram and the SLO
+    budget check). ``t_start=0.0`` stamps "now" (an instant event);
+    ``owner`` may be the owning instance (stored as its type name) or a
+    pre-rendered string."""
     _emitted[0] += 1
     if len(_ring) == _ring.maxlen and not _overflow_warned[0]:
         _overflow_warned[0] = True
@@ -231,6 +480,22 @@ def emit(
             attrs,
         )
     )
+    if dur > 0.0:
+        # full-lifetime latency plane: instants (dur == 0) carry no latency
+        # and skip this entirely, so the hottest site (engine-enqueue) pays
+        # nothing. Registered sites are preallocated — zero allocation here.
+        h = _site_hists.get(site)
+        if h is None:  # unregistered site: allocate once, cold
+            h = _site_hists.setdefault(site, LatencyHistogram())
+        h.counts[bisect_left(_HIST_BOUNDS_S, dur)] += 1
+        h.sum_s += dur
+        if dur > h.max_s:
+            h.max_s = dur
+        limit = _slo_limits.get(site, _SLO_UNSET)
+        if limit is _SLO_UNSET:
+            limit = slo_limit_s(site)
+        if limit is not None and dur > limit:
+            _note_slo_violation(site, owner, dur, limit)
 
 
 _SPAN_KEYS = ("step", "owner", "lane", "site", "t_start", "dur", "attrs")
@@ -271,6 +536,55 @@ def sync_phase_stats() -> Dict[str, Dict[str, float]]:
         if d["count"]:
             d["mean_s"] = d["total_s"] / d["count"]
     return agg
+
+
+def latency_stats() -> Dict[str, Dict[str, Any]]:
+    """The full-lifetime latency histogram plane: one block per span site
+    that has observed at least one timed span (sites with no observations
+    are omitted — the fleet gather must not ship ~30 all-zero histograms),
+    each with exact ``count``/``sum_s``/``max_s``/``buckets`` (counters:
+    never windowed, summed exactly across ranks by ``fleet_snapshot()``)
+    and bucket-interpolated ``p50_s``/``p95_s``/``p99_s`` (gauges; a log2
+    bucket is at most 2x wide — see docs/observability.md for the
+    resolution caveat). Unlike :func:`sync_phase_stats` these never decay
+    when old spans drop from the ring.
+
+    Example:
+        >>> from metrics_tpu.ops import telemetry
+        >>> telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.002)
+        >>> block = telemetry.latency_stats()["suite-sync"]
+        >>> block["count"] >= 1 and block["buckets"]["0.002048"] >= 1
+        True
+    """
+    _flush_env_warnings()
+    out: Dict[str, Dict[str, Any]] = {}
+    for site in sorted(_site_hists):
+        h = _site_hists[site]
+        if h.max_s > 0.0:
+            out[site] = h.stats()
+    return out
+
+
+def slo_violations() -> Dict[str, int]:
+    """Per-site SLO budget violation counts (plus ``total``) — the
+    ``slo_violations_*`` counter family."""
+    out = {"total": sum(_slo_violations.values())}
+    for site in sorted(_slo_violations):
+        out[site] = _slo_violations[site]
+    return out
+
+
+def reset_latency() -> None:
+    """Zero the latency histogram plane and the SLO violation counters, and
+    drop the cached SLO budgets so the environment is re-read (part of the
+    registered ``engine.reset_stats()`` walk; warn-once markers survive
+    unless ``reset_warnings=True``)."""
+    for h in _site_hists.values():
+        h.counts = [0] * _N_BUCKETS
+        h.sum_s = 0.0
+        h.max_s = 0.0
+    _slo_violations.clear()
+    _slo_limits.clear()
 
 
 def telemetry_stats() -> Dict[str, Any]:
@@ -323,7 +637,12 @@ def reset_all(reset_warnings: bool = False) -> None:
             fn()
 
 
-register_reset("telemetry", clear_spans)
+def _reset_telemetry_plane() -> None:
+    clear_spans()
+    reset_latency()
+
+
+register_reset("telemetry", _reset_telemetry_plane)
 # overflow warn-once clears only under the explicit reset_warnings opt-in —
 # a plain counter reset must not resurrect the truncation warning
 register_warning_reset("telemetry", _reset_overflow_warning)
@@ -359,6 +678,7 @@ def snapshot() -> Dict[str, Any]:
 
     from metrics_tpu.parallel import sync as _world
 
+    _flush_env_warnings()
     out: Dict[str, Any] = {"snapshot_schema": 1}
     out.update(_engine.engine_stats())
     out.update(telemetry_stats())
@@ -384,6 +704,9 @@ def snapshot() -> Dict[str, Any]:
         "sync_degraded_serves": out.get("sync_degraded_serves", 0),
         "sync_quorum_serves": out.get("sync_quorum_serves", 0),
         "sync_deadline_timeouts": out.get("sync_deadline_timeouts", 0),
+        # total SLO budget violations, folded in as health STATE (the
+        # per-phase counter family lives under slo_violations_*)
+        "slo_violations": sum(_slo_violations.values()),
         "fault_domain_counts": domain_counts,
         # the bounded membership transition log (epoch bumps, peer-dead /
         # rejoin records), each entry stamped with the shared monotonic step
@@ -393,6 +716,11 @@ def snapshot() -> Dict[str, Any]:
     # per-phase sync span statistics (the straggler-attribution plane) —
     # ring-windowed gauges, one block per SYNC_PHASE_SITES entry
     out["sync_phase_stats"] = sync_phase_stats()
+    # the full-lifetime latency histogram plane (exact bucket counters +
+    # interpolated percentiles) and the SLO violation counter family —
+    # additive keys: the snapshot stays a strict engine_stats superset
+    out[_HIST_SNAPSHOT_KEY] = latency_stats()
+    out["slo_violations"] = slo_violations()
     return out
 
 
@@ -414,12 +742,13 @@ def _flat_numeric(prefix: str, value: Any) -> Iterator[Tuple[str, float]]:
 
 _COUNTER_PREFIXES = (
     "builds", "hits", "deferred_", "fault_", "sync_", "journal_", "fleet_",
-    "spans_recorded", "spans_dropped", "monotonic_step",
+    "latency_", "slo_", "spans_recorded", "spans_dropped", "monotonic_step",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —
-# would read garbage off them)
-_GAUGE_SUFFIXES = ("_ratio",)
+# would read garbage off them). The latency percentiles (p50/p95/p99 and
+# the per-site max) re-interpolate per read.
+_GAUGE_SUFFIXES = ("_ratio", "_p50_s", "_p95_s", "_p99_s", "_max_s")
 # the flattened sync_health block is health STATE, not event counts: the
 # degraded flag clears, dead ranks rejoin, suspicion resets — every key
 # scrapes as a gauge even though the "sync_" prefix matches above. The
@@ -441,11 +770,74 @@ def is_counter_key(key: str) -> bool:
     )
 
 
+def is_histogram_sample_key(key: str) -> bool:
+    """Whether a flattened snapshot key is a histogram SAMPLE (a bucket
+    count, ``_count`` or ``_sum_s`` under the latency plane). These carry
+    counter semantics (:func:`is_counter_key` is True — the INV303 pin),
+    but they never travel as flat scalars: the exposition renders them only
+    inside the ``le``-labelled histogram families, and the fleet plane
+    merges them structurally (``fleetobs.merge_latency_stats`` — exact
+    bucket sums) while excluding the whole plane from its flat
+    counter/gauge walk. The third classification beside counter/gauge;
+    ``ops/fleetobs`` rides the same predicate defensively so a hand-fed
+    snapshot cannot leak histogram samples into a scalar family."""
+    if not key.startswith(_HIST_SNAPSHOT_KEY + "_"):
+        return False
+    return "_buckets_" in key or key.endswith(("_count", "_sum_s"))
+
+
+def _render_value(value: float) -> str:
+    # integers render exactly ('%g' rounds to 6 significant digits — a
+    # multi-MiB byte counter would scrape off by thousands); floats keep
+    # repr's round-trip precision
+    return str(int(value)) if float(value).is_integer() else repr(float(value))
+
+
+def _histogram_exposition_lines(
+    stats: Dict[str, Any],
+    family: str = "",
+    label_for: Optional[Callable[[str], str]] = None,
+) -> List[str]:
+    """Render a :func:`latency_stats`-shaped block as Prometheus histogram
+    families: one ``# TYPE ... histogram`` header, then per site the
+    CUMULATIVE ``le``-labelled ``_bucket`` samples ending at ``+Inf``
+    (== ``_count``), ``_sum`` and ``_count`` — plus one gauge family per
+    interpolated percentile (``<family>_p50``/``_p95``/``_p99``/``_max``).
+    ``label_for`` maps a stats key to its label body (default
+    ``site="<key>"``; the fleet exposition adds a ``rank`` label). Sites
+    render in the dict's insertion order (:func:`latency_stats` sorts)."""
+    lines: List[str] = []
+    if not stats:
+        return lines
+    name = family or ("metrics_tpu_" + _HIST_FAMILY)
+    labels = label_for or (lambda site: f'site="{site}"')
+    lines.append(f"# TYPE {name} histogram")
+    for site, block in stats.items():
+        block = block or {}
+        buckets = block.get("buckets") or {}
+        base = labels(site)
+        cum = 0
+        for label in _HIST_LABELS:
+            cum += int(buckets.get(label, 0))
+            lines.append(f'{name}_bucket{{{base},le="{label}"}} {cum}')
+        lines.append(f'{name}_sum{{{base}}} {_render_value(float(block.get("sum_s", 0.0)))}')
+        lines.append(f'{name}_count{{{base}}} {int(block.get("count", 0))}')
+    for stat_key, suffix in (("p50_s", "p50"), ("p95_s", "p95"), ("p99_s", "p99"), ("max_s", "max")):
+        lines.append(f"# TYPE {name}_{suffix} gauge")
+        for site, block in stats.items():
+            value = float((block or {}).get(stat_key, 0.0))
+            lines.append(f"{name}_{suffix}{{{labels(site)}}} {_render_value(value)}")
+    return lines
+
+
 def prometheus_text(data: Optional[Dict[str, Any]] = None) -> str:
     """Render :func:`snapshot` (or ``data``) as a Prometheus-style text
-    exposition: every numeric key (nested dicts flattened with ``_``) becomes
-    one ``metrics_tpu_<key> <value>`` sample with a ``# TYPE`` line
-    (monotonic counters as ``counter``, the rest as ``gauge``). Non-numeric
+    exposition: every numeric key (nested dicts flattened with ``_``)
+    becomes one ``metrics_tpu_<key> <value>`` sample with a ``# TYPE`` line
+    (monotonic counters as ``counter``, the rest as ``gauge``), and the
+    latency plane renders as cumulative ``le``-labelled **histogram**
+    families (``metrics_tpu_latency_seconds{site=...,le=...}`` with
+    ``_sum``/``_count``, percentiles as site-labelled gauges). Non-numeric
     values (the failure log, per-program rows) are omitted — they belong to
     the trace, not the scrape.
 
@@ -459,15 +851,13 @@ def prometheus_text(data: Optional[Dict[str, Any]] = None) -> str:
     """
     data = snapshot() if data is None else data
     lines: List[str] = []
-    for key, value in sorted(_flat_numeric("", {k: v for k, v in data.items() if k != "failure_log"})):
+    flat_src = {k: v for k, v in data.items() if k not in ("failure_log", _HIST_SNAPSHOT_KEY)}
+    for key, value in sorted(_flat_numeric("", flat_src)):
         name = "metrics_tpu_" + "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
         kind = "counter" if is_counter_key(key) else "gauge"
-        # integers render exactly ('%g' rounds to 6 significant digits — a
-        # multi-MiB byte counter would scrape off by thousands); floats keep
-        # repr's round-trip precision
-        rendered = str(int(value)) if float(value).is_integer() else repr(float(value))
         lines.append(f"# TYPE {name} {kind}")
-        lines.append(f"{name} {rendered}")
+        lines.append(f"{name} {_render_value(value)}")
+    lines.extend(_histogram_exposition_lines(data.get(_HIST_SNAPSHOT_KEY) or {}))
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,10 @@
+"""Known-bad fixture for the histogram-typing pass (INV303)."""
+
+# not strictly increasing: the cumulative le exposition would decrease
+_HIST_BOUNDS_S = (0.001, 0.0005, 0.002)  # expect: INV303
+
+# '-' is not in the Prometheus name alphabet
+_HIST_FAMILY = "latency-seconds"  # expect: INV303
+
+# flattened bucket/count/sum samples would NOT classify as counters
+_HIST_SNAPSHOT_KEY = "orphan_hist"  # expect: INV303

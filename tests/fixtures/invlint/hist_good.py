@@ -1,0 +1,8 @@
+"""Known-good fixture for the histogram-typing pass: positive strictly
+increasing bounds, a valid family stem, a snapshot key whose flattened
+bucket/count/sum samples classify as counters (and whose percentile
+samples stay gauge carve-outs). Zero findings."""
+
+_HIST_BOUNDS_S = (0.001, 0.002, 0.004, 0.008)
+_HIST_FAMILY = "latency_seconds"
+_HIST_SNAPSHOT_KEY = "latency_stats"

@@ -140,7 +140,7 @@ def test_fleet_schema_stable_and_keys():
     }
     assert set(snap) == expected
     assert set(snap) == set(fleetobs.fleet_snapshot()), "fleet keys drift call-over-call"
-    assert set(snap["aggregate"]) == {"counters", "gauges", "ranks_merged"}
+    assert set(snap["aggregate"]) == {"counters", "gauges", "latency_stats", "ranks_merged"}
     assert set(snap["stragglers"]) == {"phases", "ranked", "threshold", "stragglers"}
 
 
@@ -157,11 +157,13 @@ def test_fleet_merge_sums_counters_exactly(monkeypatch):
     snap = fleetobs.fleet_snapshot()
     assert snap["world_size"] == 3 and snap["gathered"] is True
     assert sorted(snap["ranks"]) == [0, 1, 2]
-    # independent exact-sum oracle over the per-rank planes
+    # independent exact-sum oracle over the per-rank planes (the latency
+    # plane merges structurally, not through the flat counter/gauge walk)
     expected = {}
     gauge_vals = {}
     for plane in snap["ranks"].values():
-        for key, val in telemetry._flat_numeric("", plane):
+        numeric = {k: v for k, v in plane.items() if k != "latency_stats"}
+        for key, val in telemetry._flat_numeric("", numeric):
             if fleetobs._fleet_is_counter(key):
                 expected[key] = expected.get(key, 0) + val
             else:
@@ -286,21 +288,29 @@ def test_fleet_prometheus_well_formed(monkeypatch):
     text = fleetobs.fleet_prometheus_text()
     lines = [ln for ln in text.strip().splitlines() if ln]
     sample_re = re.compile(
-        r"^(metrics_tpu_fleet_[a-zA-Z0-9_]+)(\{[a-z]+=\"[^\"]+\"(,[a-z]+=\"[^\"]+\")*\})? (-?[0-9.e+-]+)$"
+        r"^(metrics_tpu_fleet_[a-zA-Z0-9_]+)(\{[a-z]+=\"[^\"]+\"(,[a-z]+=\"[^\"]+\")*\})? (-?[0-9.e+-]+|\+?[0-9.e+-]*inf)$",
+        re.IGNORECASE,
     )
-    current_family = None
+    current_family, current_kind = None, None
     seen_families = set()
     for ln in lines:
         if ln.startswith("# TYPE "):
             _, _, name, kind = ln.split(" ")
-            assert kind in ("counter", "gauge")
+            assert kind in ("counter", "gauge", "histogram")
             assert name not in seen_families, f"family {name} split across TYPE lines"
             seen_families.add(name)
-            current_family = name
+            current_family, current_kind = name, kind
             continue
         m = sample_re.match(ln)
         assert m, f"malformed sample line: {ln!r}"
-        assert m.group(1) == current_family, f"{ln!r} outside its TYPE block"
+        base = m.group(1)
+        # a histogram family carries _bucket/_sum/_count suffixed samples
+        assert base == current_family or (
+            current_kind == "histogram"
+            and base in (
+                f"{current_family}_bucket", f"{current_family}_sum", f"{current_family}_count"
+            )
+        ), f"{ln!r} outside its TYPE block"
         float(m.group(4))
     # the headline fleet families, with rank/phase labels where promised
     assert "# TYPE metrics_tpu_fleet_world_size gauge" in text
@@ -313,6 +323,91 @@ def test_fleet_prometheus_well_formed(monkeypatch):
         r'metrics_tpu_fleet_straggler_deviation\{rank="2",phase="[a-z-]+"\}', text
     )
     assert 'metrics_tpu_fleet_straggler_flagged{rank="2"} 1' in text
+    # the histogram planes: fleet-merged (site label) and per-rank (rank +
+    # site labels), both passing the shared --check exposition validator
+    from tools.trace_report import check_histogram_exposition
+
+    assert "# TYPE metrics_tpu_fleet_latency_seconds histogram" in text
+    assert re.search(
+        r'metrics_tpu_fleet_rank_latency_seconds_bucket\{rank="2",site="[a-z-]+",le="\+Inf"\}',
+        text,
+    )
+    assert check_histogram_exposition(text) == []
+    # histogram SAMPLE keys never render as flat aggregate counter scalars
+    flat_counter_lines = [
+        ln for ln in lines
+        if ln.startswith("metrics_tpu_fleet_latency_stats_") and "_buckets_" in ln
+    ]
+    assert not flat_counter_lines, flat_counter_lines[:3]
+
+
+def test_fleet_latency_bucket_sums_exact_vs_oracle(monkeypatch):
+    """The fleet histogram merge is EXACT: every site's merged bucket/count/
+    sum equals an independent per-rank sum (the planes are deliberately
+    asymmetric so symmetry cannot fake it), max maxes, and the fleet
+    percentiles re-interpolate from the MERGED buckets."""
+    suite = _suite()
+    _sync_cycle(suite)
+
+    def tweak(r, plane):
+        lat = plane.get("latency_stats") or {}
+        block = lat.get("suite-sync")
+        if block:
+            block["buckets"]["0.002048"] = int(block["buckets"].get("0.002048", 0)) + 10 * r
+            block["count"] = int(block["count"]) + 10 * r
+            block["sum_s"] = float(block["sum_s"]) + 0.002 * 10 * r
+            block["max_s"] = max(float(block["max_s"]), 0.002)
+
+    _FakeWorld(monkeypatch, _plane_blobs(tweak))
+    snap = fleetobs.fleet_snapshot()
+    merged = snap["aggregate"]["latency_stats"]
+    assert merged, "no latency histograms travelled in the fleet gather"
+    live = [p for p in snap["ranks"].values() if fleetobs._is_live_plane(p)]
+    assert len(live) == 3
+    for site, block in merged.items():
+        per_rank = [b for b in ((p.get("latency_stats") or {}).get(site) for p in live) if b]
+        assert block["count"] == sum(int(b["count"]) for b in per_rank), site
+        assert block["sum_s"] == pytest.approx(sum(float(b["sum_s"]) for b in per_rank)), site
+        assert block["max_s"] == max(float(b["max_s"]) for b in per_rank), site
+        for label, n in block["buckets"].items():
+            oracle = sum(int((b.get("buckets") or {}).get(label, 0)) for b in per_rank)
+            assert n == oracle, (site, label)
+        if block["count"]:
+            assert 0 < block["p50_s"] <= block["p95_s"] <= block["p99_s"] <= block["max_s"] * (
+                1 + 1e-9
+            )
+    # the deliberate asymmetry really merged three distinct planes
+    base = snap["ranks"][0]["latency_stats"]["suite-sync"]["count"]
+    assert merged["suite-sync"]["count"] == 3 * base + 30
+
+
+def test_straggler_report_tail_aware_deviation(monkeypatch):
+    """A rank whose MEAN looks healthy but whose full-lifetime p95 is 10x
+    the fleet's is flagged by the tail measure — exactly the straggler the
+    windowed mean hides."""
+    suite = _suite()
+    _sync_cycle(suite)
+
+    def tweak(r, plane):
+        if r == 2:
+            # leave sync_phase_stats (the mean plane) untouched; inflate
+            # only the full-lifetime tail
+            for block in (plane.get("latency_stats") or {}).values():
+                for key in ("p50_s", "p95_s", "p99_s", "max_s", "sum_s"):
+                    block[key] = float(block.get(key, 0.0)) * 10.0
+
+    _FakeWorld(monkeypatch, _plane_blobs(tweak))
+    report = fleetobs.fleet_snapshot()["stragglers"]
+    phase = report["phases"]["sync-payload-gather"]
+    # the mean-based scoring sees three identical planes...
+    assert phase["slowest_rank"] in (0, 1, 2) and phase["deviation"] == pytest.approx(0.0)
+    # ...the tail-aware scoring names the slow rank
+    assert phase["tail_slowest_rank"] == 2
+    assert phase["tail_deviation"] == pytest.approx(9.0)
+    assert set(phase["per_rank_p95_s"]) == {0, 1, 2}
+    assert 2 in report["stragglers"]
+    top = report["ranked"][0]
+    assert top["rank"] == 2 and top["measure"] == "p95_s"
 
 
 # -------------------------------------------------------------- merged trace

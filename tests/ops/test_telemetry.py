@@ -68,14 +68,17 @@ def _suite():
 
 @pytest.fixture(autouse=True)
 def _armed_and_clean():
-    """Every test starts armed with an empty ring and leaves the recorder in
-    its default state."""
+    """Every test starts armed with an empty ring, a zeroed latency plane
+    (full-lifetime in production, isolated per test here) and leaves the
+    recorder in its default state."""
     was = telemetry.armed
     telemetry.set_telemetry(True)
     telemetry.clear_spans()
+    telemetry.reset_latency()
     yield
     telemetry.set_telemetry(was)
     telemetry.clear_spans()
+    telemetry.reset_latency()
 
 
 def _sites():
@@ -248,6 +251,8 @@ def test_snapshot_schema_superset_and_stable():
         "programs",
         "sync_health",
         "sync_phase_stats",
+        "latency_stats",
+        "slo_violations",
     ):
         assert key in snap, f"snapshot is missing its own {key!r}"
     assert snap["snapshot_schema"] == 1
@@ -265,6 +270,7 @@ def test_snapshot_schema_superset_and_stable():
         "sync_degraded_serves",
         "sync_quorum_serves",
         "sync_deadline_timeouts",
+        "slo_violations",
         "fault_domain_counts",
         "transitions",
     }
@@ -280,14 +286,31 @@ def test_prometheus_text_well_formed():
     _suite().compute()
     text = mt.prometheus_text()
     lines = [ln for ln in text.strip().splitlines() if ln]
-    assert lines and len(lines) % 2 == 0
-    for type_line, sample in zip(lines[::2], lines[1::2]):
-        assert type_line.startswith("# TYPE metrics_tpu_")
-        kind = type_line.rsplit(" ", 1)[1]
-        assert kind in ("counter", "gauge")
-        name, value = sample.rsplit(" ", 1)
-        assert name == type_line.split(" ")[2]
-        float(value)  # parses
+    assert lines and lines[0].startswith("# TYPE metrics_tpu_")
+    family_name, family_kind, family_samples = None, None, 0
+    for line in lines:
+        if line.startswith("# TYPE "):
+            if family_name is not None:
+                assert family_samples >= 1, f"family {family_name} has no samples"
+            _, _, family_name, family_kind = line.split(" ")
+            assert family_kind in ("counter", "gauge", "histogram")
+            family_samples = 0
+        else:
+            name, value = line.rsplit(" ", 1)
+            base = name.split("{", 1)[0]
+            # histogram families carry _bucket/_sum/_count suffixed samples
+            assert base == family_name or (
+                family_kind == "histogram"
+                and base in (f"{family_name}_bucket", f"{family_name}_sum", f"{family_name}_count")
+            ), f"sample {name} outside its family {family_name}"
+            # scalar counter/gauge families carry exactly one unlabelled
+            # sample; labelled families (histogram + site-labelled gauges)
+            # may carry many
+            if "{" not in name:
+                assert family_samples == 0, f"unlabelled family {family_name} has >1 sample"
+            float(value)  # parses
+            family_samples += 1
+    assert family_samples >= 1
     # the headline counters are scrapeable
     assert "metrics_tpu_sync_payload_collectives" in text
     assert "metrics_tpu_programs_count" in text
@@ -360,8 +383,13 @@ def test_program_report_ledger():
 def test_disarmed_emits_nothing_and_allocates_nothing(tmp_path):
     suite = _suite()
     telemetry.set_telemetry(False)
+    telemetry.reset_latency()
     before = telemetry.telemetry_stats()
     ring_id = id(telemetry._ring)
+    # the histogram plane too: same preallocated dict object, same site
+    # count, same (all-zero) per-site counts lists after the loop
+    hists_id = id(telemetry._site_hists)
+    n_sites = len(telemetry._site_hists)
     for _ in range(4):
         suite.update(*_batch())
     suite.sync(distributed_available=DIST_ON)
@@ -373,6 +401,8 @@ def test_disarmed_emits_nothing_and_allocates_nothing(tmp_path):
     assert after["spans_retained"] == before["spans_retained"] == 0
     assert id(telemetry._ring) == ring_id  # no reallocation either
     assert after["telemetry_armed"] is False
+    assert telemetry.latency_stats() == {}, "a disarmed recorder fed the histograms"
+    assert id(telemetry._site_hists) == hists_id and len(telemetry._site_hists) == n_sites
 
 
 def test_span_ring_bounded():
@@ -492,6 +522,196 @@ def test_reset_stats_unifies_every_counter_plane(tmp_path):
     # the never-resetting monotonic step and per-owner ladder state persist
     assert faults.current_step() == step_before
     assert suite.__dict__["_fault_ladders"] == ladders_before
+
+
+# ------------------------------------------------- latency histogram plane
+def test_latency_plane_is_full_lifetime_not_ring_windowed():
+    """The ring drops old spans; the histogram plane NEVER does — 100 timed
+    spans through a 32-slot ring keep exact count/sum/buckets."""
+    import warnings as _warnings
+
+    telemetry.set_telemetry(True, span_cap=32)
+    try:
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")  # the ring-overflow warn-once
+            for _ in range(100):
+                telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.002)
+        assert telemetry.telemetry_stats()["spans_retained"] == 32
+        block = telemetry.latency_stats()["suite-sync"]
+        assert block["count"] == 100
+        assert block["buckets"]["0.002048"] == 100
+        assert sum(block["buckets"].values()) == block["count"]
+        assert block["sum_s"] == pytest.approx(0.2)
+        assert block["max_s"] == pytest.approx(0.002)
+        assert 0 < block["p50_s"] <= block["p95_s"] <= block["p99_s"] <= block["max_s"]
+        # the windowed view decayed; the full-lifetime one did not
+        assert mt.telemetry_snapshot()["sync_phase_stats"]["suite-sync"]["count"] == 32
+    finally:
+        telemetry.set_telemetry(True, span_cap=4096)
+
+
+def test_latency_percentiles_interpolate_within_their_bucket():
+    """A bimodal 90/10 distribution: p50 must land in the 1 ms bucket, p95/
+    p99 in the 100 ms bucket — each within its log2 bucket's bounds (the
+    documented <=2x resolution), clamped to the exact observed max."""
+    for _ in range(90):
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.001)
+    for _ in range(10):
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.1)
+    block = telemetry.latency_stats()["suite-sync"]
+    assert block["count"] == 100
+    assert block["buckets"]["0.001024"] == 90 and block["buckets"]["0.131072"] == 10
+    assert 0.000512 < block["p50_s"] <= 0.001024
+    assert 0.065536 < block["p95_s"] <= 0.1  # clamped to the observed max
+    assert 0.065536 < block["p99_s"] <= 0.1
+    assert block["max_s"] == pytest.approx(0.1)
+
+
+def test_histogram_exposition_conformance():
+    """The le-labelled histogram families pass the shared --check validator:
+    cumulative buckets non-decreasing, ending at +Inf == _count, _sum
+    consistent — and the flattened histogram SAMPLE keys never leak into the
+    scalar exposition beside them."""
+    from tools.trace_report import check_histogram_exposition
+
+    suite = _suite()
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    suite.compute()
+    text = mt.prometheus_text()
+    assert check_histogram_exposition(text) == []
+    assert "# TYPE metrics_tpu_latency_seconds histogram" in text
+    # manual spot check on one site: cumulative ordering and the +Inf==count
+    site_lines = [
+        ln for ln in text.splitlines()
+        if ln.startswith('metrics_tpu_latency_seconds_bucket{site="suite-sync"')
+    ]
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in site_lines]
+    assert cums and all(b >= a for a, b in zip(cums, cums[1:]))
+    assert 'le="+Inf"' in site_lines[-1]
+    count_line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith('metrics_tpu_latency_seconds_count{site="suite-sync"}')
+    )
+    assert int(count_line.rsplit(" ", 1)[1]) == cums[-1]
+    # percentile gauges render per site; the flat scalar plane must NOT
+    # carry the histogram samples a second time
+    assert 'metrics_tpu_latency_seconds_p99{site="suite-sync"}' in text
+    assert "metrics_tpu_latency_stats_" not in text
+    # every flattened histogram sample classifies as BOTH a counter (the
+    # fleet merge sums it) and a histogram sample (the exposition hides it)
+    key = "latency_stats_suite-sync_buckets_+Inf"
+    assert telemetry.is_counter_key(key) and telemetry.is_histogram_sample_key(key)
+    assert not telemetry.is_histogram_sample_key("latency_stats_suite-sync_p99_s")
+
+
+def test_snapshot_latency_stats_round_trip_check(tmp_path):
+    """The exported trace's embedded latency plane passes check_trace's
+    histogram well-formedness validation, and a corrupted plane fails it."""
+    suite = _suite()
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    path = str(tmp_path / "trace.json")
+    engine.export_trace(path)
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert check_trace(doc) == []
+    # corrupt one bucket: count no longer equals the bucket total
+    site = next(iter(doc["snapshot"]["latency_stats"]))
+    doc["snapshot"]["latency_stats"][site]["count"] += 1
+    assert any("bucket total" in p for p in check_trace(doc))
+
+
+# ----------------------------------------------------------------- SLO budgets
+def test_slo_budget_counts_violations_and_warns_once(monkeypatch):
+    import warnings as _warnings
+
+    monkeypatch.setenv("METRICS_TPU_SLO_SUITE_SYNC_MS", "1")
+    telemetry.reset_latency()  # drop cached budgets: re-read the env
+    engine.reset_stats(reset_warnings=True)
+    assert telemetry.slo_limit_s("suite-sync") == pytest.approx(0.001)
+    with pytest.warns(UserWarning, match="suite-sync span ran"):
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.05)
+    # warn-once per owner+phase: the second violation counts silently
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.05)
+    v = telemetry.slo_violations()
+    assert v["suite-sync"] == 2 and v["total"] == 2
+    # a within-budget span does not count
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.0005)
+    assert telemetry.slo_violations()["suite-sync"] == 2
+    # surfaced in the snapshot (health state + counter family) and scrape
+    snap = mt.telemetry_snapshot()
+    assert snap["sync_health"]["slo_violations"] == 2
+    assert snap["slo_violations"]["suite-sync"] == 2
+    text = mt.prometheus_text()
+    assert "# TYPE metrics_tpu_slo_violations_total counter" in text
+    assert "# TYPE metrics_tpu_sync_health_slo_violations gauge" in text
+
+
+def test_slo_reset_rereads_environment_and_rearms_warning(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SLO_SUITE_SYNC_MS", "1")
+    telemetry.reset_latency()
+    engine.reset_stats(reset_warnings=True)
+    with pytest.warns(UserWarning, match="budget"):
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.05)
+    assert telemetry.slo_violations()["total"] == 1
+    # a plain counter reset zeroes the counts AND drops the cached budget,
+    # so a redeploy's new environment is honored...
+    monkeypatch.delenv("METRICS_TPU_SLO_SUITE_SYNC_MS")
+    engine.reset_stats()
+    assert telemetry.slo_violations() == {"total": 0}
+    telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.05)
+    assert telemetry.slo_violations() == {"total": 0}  # budget now OFF
+    # ...but does NOT resurrect the warning; reset_warnings=True does
+    monkeypatch.setenv("METRICS_TPU_SLO_SUITE_SYNC_MS", "1")
+    engine.reset_stats()
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.05)
+    assert telemetry.slo_violations()["total"] == 1
+    engine.reset_stats(reset_warnings=True)
+    with pytest.warns(UserWarning, match="budget"):
+        telemetry.emit("suite-sync", None, "sync", telemetry.now(), 0.05)
+
+
+def test_slo_unparseable_env_warns_once_naming_value(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SLO_SUITE_SYNC_MS", "not-a-number")
+    telemetry.reset_latency()
+    engine.reset_stats(reset_warnings=True)
+    with pytest.warns(UserWarning, match="not-a-number"):
+        assert telemetry.slo_limit_s("suite-sync") is None
+    # the budget stays OFF: violations never count
+    telemetry.emit("suite-sync", None, "sync", telemetry.now(), 10.0)
+    assert telemetry.slo_violations() == {"total": 0}
+
+
+# ------------------------------------------------------------ env-knob parses
+def test_span_cap_garbage_env_warns_once_naming_value(monkeypatch):
+    """The satellite contract: a garbage METRICS_TPU_TELEMETRY_SPANS no
+    longer falls back SILENTLY — the queued import-time warning drains at
+    the first cold surface, naming the offending value, once."""
+    import warnings as _warnings
+
+    monkeypatch.setenv("METRICS_TPU_TELEMETRY_SPANS", "a-lot")
+    engine.reset_stats(reset_warnings=True)
+    assert telemetry._env_cap() == telemetry._DEFAULT_CAP
+    with pytest.warns(UserWarning, match="a-lot"):
+        mt.telemetry_snapshot()
+    # drained: the next snapshot is silent
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        mt.telemetry_snapshot()
+    # unset/blank stays the silent default
+    monkeypatch.setenv("METRICS_TPU_TELEMETRY_SPANS", "")
+    assert telemetry._env_cap() == telemetry._DEFAULT_CAP
+    monkeypatch.delenv("METRICS_TPU_TELEMETRY_SPANS")
+    assert telemetry._env_cap() == telemetry._DEFAULT_CAP
 
 
 def test_reset_warnings_is_an_explicit_optin():

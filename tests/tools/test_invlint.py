@@ -58,6 +58,7 @@ class TestFixturePairs:
         # one known-bad + one known-good file per pass
         assert BAD_FIXTURES == [
             "collective_bad.py",
+            "hist_bad.py",
             "retry_bad.py",
             "taxonomy_bad.py",
             "telemetry_bad.py",
@@ -181,9 +182,33 @@ class TestRegistry:
             "sync_payload_collectives", "fault_sync", "journal_saves", "fleet_gathers",
             "sync_coalesce_ratio", "sync_health_epoch", "sync_phase_stats_sync_gather_count",
             "monotonic_step", "spans_retained", "world_size", "builds", "hits",
+            "latency_stats_suite-sync_count", "latency_stats_suite-sync_p99_s",
+            "slo_violations_total",
         ]
         for key in keys:
             assert registry.is_counter_key(key) == telemetry.is_counter_key(key), key
+
+    def test_histogram_layout_matches_package(self):
+        from metrics_tpu.ops import telemetry
+
+        bounds, family, snapshot_key = registry.histogram_layout()
+        assert bounds == telemetry._HIST_BOUNDS_S
+        assert family == telemetry._HIST_FAMILY
+        assert snapshot_key == telemetry._HIST_SNAPSHOT_KEY
+        keys = [
+            "latency_stats_suite-sync_buckets_1e-06",
+            "latency_stats_suite-sync_count",
+            "latency_stats_suite-sync_sum_s",
+            "latency_stats_suite-sync_p95_s",  # percentile: NOT a sample key
+            "sync_payload_collectives",
+        ]
+        for key in keys:
+            assert registry.is_histogram_sample_key(key) == telemetry.is_histogram_sample_key(
+                key
+            ), key
+        # every histogram SAMPLE must also be a counter — the fleet-merge
+        # exactness contract INV303 pins statically
+        assert telemetry.is_counter_key("latency_stats_suite-sync_buckets_+Inf")
 
 
 class TestSeededViolation:

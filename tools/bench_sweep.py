@@ -27,6 +27,33 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 BATCH, C = 4096, 16
 STEPS, TRIALS = 20, 3
 
+
+def _latency_ms(step, n, final=None):
+    """Per-call latency percentiles (ms) over one extra ``n``-call pass,
+    bucket-interpolated by the telemetry plane's ``LatencyHistogram`` — the
+    same computation ``latency_stats()`` scrapes, so a sweep row's
+    distribution column and a production percentile are comparable. Medians
+    are far stabler run-to-run than the best-of mean throughput (the reason
+    ``tools/sweep_regress.py``'s distribution-aware mode can gate tighter
+    than the 5x mean-ratio threshold), and p99/p50 is the tail-ratio the
+    gate watches for blowups."""
+    from metrics_tpu.ops.telemetry import LatencyHistogram
+
+    h = LatencyHistogram()
+    for _ in range(n):
+        t0 = time.perf_counter()
+        step()
+        h.observe(time.perf_counter() - t0)
+    if final is not None:
+        final()
+    s = h.stats()
+    return {
+        "p50": round(s["p50_s"] * 1000.0, 4),
+        "p95": round(s["p95_s"] * 1000.0, 4),
+        "p99": round(s["p99_s"] * 1000.0, 4),
+        "max": round(s["max_s"] * 1000.0, 4),
+    }
+
 # per-row timed-step overrides: the fused wrapper rows (both BootStrapper
 # strategies and both MultioutputWrapper configs run as ONE program per step
 # since round 5) get MORE steps so their one blocking clone-state sync per
@@ -580,6 +607,8 @@ def main() -> None:
                         metric.update(*jdata)
                     _sync_all()
                     best = min(best, time.perf_counter() - start)
+                metric.reset()
+                latency = _latency_ms(lambda: metric.update(*jdata), steps, _sync_all)
             else:
                 mode = "jit"
                 init, upd, _ = metric.as_functions()
@@ -599,8 +628,22 @@ def main() -> None:
                         state = fused(state, *data)
                     jax.block_until_ready(state)
                     best = min(best, time.perf_counter() - start)
+                sbox = {"st": state}
+
+                def _fused_step(f=fused, d=data):
+                    sbox["st"] = f(sbox["st"], *d)
+
+                latency = _latency_ms(
+                    _fused_step, STEPS, lambda: jax.block_until_ready(sbox["st"])
+                )
             rate = steps * samples / best
-            row = {"metric": name, "mode": mode, "updates_per_s": round(steps / best, 1), "samples_per_s": round(rate, 1)}
+            row = {
+                "metric": name,
+                "mode": mode,
+                "updates_per_s": round(steps / best, 1),
+                "samples_per_s": round(rate, 1),
+                "latency_ms": latency,
+            }
             if isinstance(metric, mt.BootStrapper):
                 # the one-program bootstrap rows get the GENUINELY-shaped
                 # probe (same state leaves, same row-delta output buffers as
@@ -648,11 +691,18 @@ def main() -> None:
                     metric.update(*jdata)
                 jax.block_until_ready(metric.metric_state)
                 best = min(best, time.perf_counter() - start)
+            metric.reset()
+            latency = _latency_ms(
+                lambda: metric.update(*jdata),
+                DEFERRED_STEPS,
+                lambda: jax.block_until_ready(metric.metric_state),
+            )
             row = {
                 "metric": name,
                 "mode": "deferred",
                 "updates_per_s": round(DEFERRED_STEPS / best, 1),
                 "samples_per_s": round(DEFERRED_STEPS * samples / best, 1),
+                "latency_ms": latency,
             }
             floor_s = _shaped_floor_ms(metric, DEFERRED_STEPS)
             if floor_s > 0:
@@ -684,11 +734,14 @@ def main() -> None:
                 for _ in range(steps):
                     metric.update(*data)
                 best = min(best, time.perf_counter() - start)
+            metric.reset()
+            latency = _latency_ms(lambda: metric.update(*data), steps)
             row = {
                 "metric": name,
                 "mode": "host",
                 "updates_per_s": round(steps / best, 1),
                 "samples_per_s": round(steps * samples / best, 1),
+                "latency_ms": latency,
             }
             results.append(row)
             print(json.dumps(row))
@@ -734,11 +787,19 @@ def main() -> None:
                 s1["sync_shape_collectives"] + s1["sync_payload_collectives"]
                 - s0["sync_shape_collectives"] - s0["sync_payload_collectives"]
             ) / (n_syncs * TRIALS)
+            def _cycle():
+                coll.sync(distributed_available=dist_on)
+                coll.unsync()
+
+            latency = _latency_ms(
+                _cycle, n_syncs, lambda: jax.block_until_ready(coll["mean"].value)
+            )
             row = {
                 "metric": label,
                 "mode": "sync",
                 "updates_per_s": round(n_syncs / best, 1),
                 "collectives_per_sync": round(per_sync, 2),
+                "latency_ms": latency,
             }
             results.append(row)
             print(json.dumps(row))

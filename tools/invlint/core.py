@@ -38,6 +38,7 @@ RULES: Dict[str, str] = {
     "INV202": "site string is not in the canonical fault/span registry",
     "INV301": "incremented stats key is untyped (neither counter-prefixed nor a gauge carve-out)",
     "INV302": "stats key is not a valid Prometheus exposition name",
+    "INV303": "latency-histogram layout breaks its contract (non-monotone bounds, invalid family stem, or bucket samples not counter-classified)",
     "INV401": "direct warnings.warn (route through faults.warn_fault or rank_zero_warn)",
 }
 

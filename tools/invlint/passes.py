@@ -8,7 +8,9 @@ human-readable twin of this file):
 1. collective-discipline (INV001/INV002/INV003)
 2. retry-purity          (INV101/INV102)
 3. fault-taxonomy        (INV201/INV202)
-4. telemetry-typing      (INV301/INV302)
+4. telemetry-typing      (INV301/INV302/INV303 — scalar keys AND the
+   latency-histogram layout: bounds monotone, family name valid, bucket
+   samples counter-classified)
 5. warn-once discipline  (INV401)
 """
 from __future__ import annotations
@@ -409,6 +411,115 @@ def check_telemetry_typing(mod: Module) -> List[Finding]:
     return findings
 
 
+#: The latency-histogram layout literals (single-sourced in
+#: ``ops/telemetry.py``; any module declaring them is held to the contract).
+HIST_LAYOUT_NAMES = ("_HIST_BOUNDS_S", "_HIST_FAMILY", "_HIST_SNAPSHOT_KEY")
+
+
+def check_histogram_typing(mod: Module) -> List[Finding]:
+    """INV303 — the latency-histogram layout contract. A module declaring
+    the layout literals must keep: bucket bounds positive and STRICTLY
+    increasing (the cumulative ``le`` exposition stops being monotone
+    otherwise, and every scrape-side histogram_quantile silently lies), the
+    exposition family stem a valid Prometheus name without the reserved
+    ``_bucket``/``_sum``/``_count`` suffixes, and the snapshot key's
+    flattened bucket/count/sum samples classifying as COUNTERS under
+    ``telemetry.is_counter_key`` (the fleet merge sums what the typing rules
+    call a counter — a histogram the merge min/median/maxes is corrupt) with
+    the interpolated percentiles staying gauge carve-outs."""
+    findings: List[Finding] = []
+    decls = {}
+    for node in mod.tree.body:
+        targets: List[ast.AST] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in HIST_LAYOUT_NAMES:
+                try:
+                    decls[t.id] = (node, ast.literal_eval(value))
+                except ValueError:
+                    findings.append(
+                        mod.finding(
+                            node,
+                            "INV303",
+                            f"{t.id} is not a pure literal — the histogram layout"
+                            " must stay statically extractable (registry single-sourcing)",
+                        )
+                    )
+    if "_HIST_BOUNDS_S" in decls:
+        node, bounds = decls["_HIST_BOUNDS_S"]
+        numeric = (
+            isinstance(bounds, (tuple, list))
+            and bool(bounds)
+            and all(isinstance(b, (int, float)) and not isinstance(b, bool) for b in bounds)
+        )
+        if (
+            not numeric
+            or any(b <= 0 for b in bounds)
+            or any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:]))
+        ):
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV303",
+                    "_HIST_BOUNDS_S bounds must be positive and strictly increasing"
+                    " — otherwise the cumulative le exposition stops being monotone",
+                )
+            )
+    if "_HIST_FAMILY" in decls:
+        node, fam = decls["_HIST_FAMILY"]
+        if (
+            not isinstance(fam, str)
+            or not PROM_NAME.match(fam)
+            or fam.endswith(("_bucket", "_sum", "_count"))
+        ):
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV303",
+                    f"_HIST_FAMILY {fam!r} is not a valid Prometheus histogram family"
+                    " stem (the renderer appends the reserved _bucket/_sum/_count"
+                    " suffixes and the le label)",
+                )
+            )
+    if "_HIST_SNAPSHOT_KEY" in decls:
+        node, key = decls["_HIST_SNAPSHOT_KEY"]
+        counter_samples = (
+            f"{key}_site_buckets_1e-06",
+            f"{key}_site_count",
+            f"{key}_site_sum_s",
+        )
+        if not isinstance(key, str) or not all(
+            registry.is_counter_key(s, mod.root) for s in counter_samples
+        ):
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV303",
+                    f"_HIST_SNAPSHOT_KEY {key!r}: its flattened bucket/count/sum"
+                    " samples must classify as counters (telemetry.is_counter_key)"
+                    " — the fleet merge would min/median/max exact bucket counts",
+                )
+            )
+        elif not all(
+            registry.is_gauge_carveout(f"{key}_site{sfx}", mod.root)
+            for sfx in ("_p50_s", "_p95_s", "_p99_s", "_max_s")
+        ):
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV303",
+                    f"_HIST_SNAPSHOT_KEY {key!r}: its interpolated percentile samples"
+                    " (_p50_s/_p95_s/_p99_s/_max_s) must stay gauge carve-outs —"
+                    " they re-interpolate per read and can fall",
+                )
+            )
+    return findings
+
+
 # ---------------------------------------------------------------- pass 5: warn-once
 def _warnings_aliases(mod: Module) -> tuple:
     """``(module_aliases, bare_warn_names)`` — every spelling this module can
@@ -459,5 +570,6 @@ ALL_PASSES = (
     check_retry_purity,
     check_fault_taxonomy,
     check_telemetry_typing,
+    check_histogram_typing,
     check_warn_discipline,
 )

@@ -104,6 +104,33 @@ def is_counter_key(key: str, root: str = ROOT) -> bool:
     )
 
 
+@lru_cache(maxsize=8)
+def histogram_layout(root: str = ROOT) -> Tuple[Tuple[float, ...], str, str]:
+    """``(bounds_s, family, snapshot_key)`` — the latency histogram layout
+    literals behind ``telemetry.latency_stats`` / ``prometheus_text``'s
+    ``le``-labelled families (``_HIST_BOUNDS_S`` / ``_HIST_FAMILY`` /
+    ``_HIST_SNAPSHOT_KEY``). Single-sourced like the site tables: the INV303
+    pass, this module and the package must agree (companion test pins the
+    parse against the import)."""
+    lits = _module_literals(
+        _TELEMETRY_SRC, ("_HIST_BOUNDS_S", "_HIST_FAMILY", "_HIST_SNAPSHOT_KEY"), root
+    )
+    return (
+        tuple(lits["_HIST_BOUNDS_S"]),
+        str(lits["_HIST_FAMILY"]),
+        str(lits["_HIST_SNAPSHOT_KEY"]),
+    )
+
+
+def is_histogram_sample_key(key: str, root: str = ROOT) -> bool:
+    """``telemetry.is_histogram_sample_key``, recomputed from the extracted
+    layout: a flattened bucket/count/sum sample under the snapshot key."""
+    _, _, snapshot_key = histogram_layout(root)
+    if not key.startswith(snapshot_key + "_"):
+        return False
+    return "_buckets_" in key or key.endswith(("_count", "_sum_s"))
+
+
 def is_gauge_carveout(key: str, root: str = ROOT) -> bool:
     """Whether ``key`` is a DELIBERATE gauge (ratio suffix / health block),
     as opposed to an untyped key that merely fails the counter prefixes."""

@@ -6,14 +6,29 @@ the comparison is on the **vs-torch-CPU ratios** and on mode changes (a jit
 row silently degrading to eager is a regression even at equal throughput).
 The ratios themselves still carry noise: two same-code runs measured ratio
 swings up to ~4x on individual rows (the torch-CPU reference arm is
-host-contention-sensitive, our arm tunnel-latency-sensitive), so the default
+host-contention-sensitive, our arm tunnel-latency-sensitive), so the mean
 threshold sits at 5x — it catches collapses and mode flips, not weather.
+
+**Distribution-aware mode** (automatic when both rows carry the
+``latency_ms`` percentile column `tools/bench_sweep.py` records through the
+telemetry plane's shared histogram): per-call **p50 latency** is far stabler
+than the best-of mean — the median ignores the tunnel's tail hiccups that
+swing the mean 4x — so p50 ratios gate at ``--p50-threshold`` (default 3x,
+tighter than the 5x mean gate). Separately, the **tail ratio** ``p99/p50``
+is compared old-vs-new: a row whose median held but whose p99 blew up (a
+flush stall, a new lock, a recompile in the loop) fails the
+``--tail-threshold`` gate (default 4x growth) even though every mean- and
+median-based number looks fine. Rows without percentiles fall back to the
+5x mean-ratio gate unchanged, so old artifacts keep comparing.
 
     python tools/sweep_regress.py SWEEP_r04.json SWEEP_r05.json
     python tools/sweep_regress.py --threshold 2.5 old.json new.json
+    python tools/sweep_regress.py --p50-threshold 2.0 --tail-threshold 3.0 old.json new.json
 
-Exit 1 when any metric's ratio worsened by more than ``threshold``x, a row's
-mode flipped jit->eager, or a previously-present metric disappeared.
+Exit 1 when any metric's ratio worsened by more than ``threshold``x, a p50
+latency worsened by more than ``p50-threshold``x, a p99/p50 tail ratio grew
+by more than ``tail-threshold``x, a row's mode flipped jit->eager, or a
+previously-present metric disappeared.
 """
 from __future__ import annotations
 
@@ -21,7 +36,20 @@ import json
 import sys
 
 
-def compare(old: dict, new: dict, threshold: float = 5.0) -> list:
+def _tail_ratio(row: dict) -> float:
+    """p99/p50 of a row's latency distribution (0.0 when absent/degenerate)."""
+    lat = row.get("latency_ms") or {}
+    p50, p99 = float(lat.get("p50", 0.0)), float(lat.get("p99", 0.0))
+    return p99 / p50 if p50 > 0 and p99 > 0 else 0.0
+
+
+def compare(
+    old: dict,
+    new: dict,
+    threshold: float = 5.0,
+    p50_threshold: float = 3.0,
+    tail_threshold: float = 4.0,
+) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
     problems = []
@@ -44,31 +72,62 @@ def compare(old: dict, new: dict, threshold: float = 5.0) -> list:
                 problems.append(
                     f"{name}: vs_baseline {old_ratio} -> {new_ratio} ({old_ratio / new_ratio:.1f}x worse)"
                 )
+        # ---- distribution-aware gates (both rows carry percentiles) ----
+        old_p50 = float((old_row.get("latency_ms") or {}).get("p50", 0.0))
+        new_p50 = float((new_row.get("latency_ms") or {}).get("p50", 0.0))
+        if old_p50 > 0 and new_p50 > 0:
+            if new_p50 / old_p50 > p50_threshold:
+                problems.append(
+                    f"{name}: p50 latency {old_p50} -> {new_p50} ms "
+                    f"({new_p50 / old_p50:.1f}x worse, median gate {p50_threshold}x)"
+                )
+            old_tail, new_tail = _tail_ratio(old_row), _tail_ratio(new_row)
+            if old_tail > 0 and new_tail / old_tail > tail_threshold:
+                problems.append(
+                    f"{name}: tail ratio p99/p50 {old_tail:.1f} -> {new_tail:.1f} "
+                    f"({new_tail / old_tail:.1f}x blowup, tail gate {tail_threshold}x)"
+                )
     return problems
 
 
+def _pop_flag(argv: list, flag: str, default: float):
+    if flag not in argv:
+        return argv, default, True
+    i = argv.index(flag)
+    try:
+        value = float(argv[i + 1])
+    except (IndexError, ValueError):
+        return argv, default, False
+    return argv[:i] + argv[i + 2:], value, True
+
+
+_USAGE = (
+    "usage: sweep_regress.py [--threshold X] [--p50-threshold X] "
+    "[--tail-threshold X] OLD.json NEW.json"
+)
+
+
 def main(argv) -> int:
-    threshold = 5.0
-    if "--threshold" in argv:
-        i = argv.index("--threshold")
-        try:
-            threshold = float(argv[i + 1])
-        except (IndexError, ValueError):
-            print("usage: sweep_regress.py [--threshold X] OLD.json NEW.json")
-            return 2
-        argv = argv[:i] + argv[i + 2 :]
-    if len(argv) != 2:
-        print("usage: sweep_regress.py [--threshold X] OLD.json NEW.json")
+    argv, threshold, ok1 = _pop_flag(list(argv), "--threshold", 5.0)
+    argv, p50_threshold, ok2 = _pop_flag(argv, "--p50-threshold", 3.0)
+    argv, tail_threshold, ok3 = _pop_flag(argv, "--tail-threshold", 4.0)
+    if not (ok1 and ok2 and ok3) or len(argv) != 2:
+        print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
         old, new = json.load(f_old), json.load(f_new)
-    problems = compare(old, new, threshold)
+    problems = compare(old, new, threshold, p50_threshold, tail_threshold)
     if problems:
         print("\n".join(problems))
-        print(f"\n{len(problems)} sweep regression(s) beyond {threshold}x")
+        print(f"\n{len(problems)} sweep regression(s) beyond the gates")
         return 1
-    n = len([r for r in new["rows"] if "updates_per_s" in r])
-    print(f"sweep ok: {n} rows, no ratio regression beyond {threshold}x, no mode downgrades")
+    rows = [r for r in new["rows"] if "updates_per_s" in r]
+    with_pct = sum(1 for r in rows if (r.get("latency_ms") or {}).get("p50"))
+    print(
+        f"sweep ok: {len(rows)} rows ({with_pct} with percentile columns), no ratio "
+        f"regression beyond {threshold}x, no p50 regression beyond {p50_threshold}x, "
+        f"no p99/p50 tail blowup beyond {tail_threshold}x, no mode downgrades"
+    )
     return 0
 
 

@@ -10,6 +10,10 @@ BENCH/SWEEP artifact review) actually reads:
   accessed / peak footprint from XLA cost analysis;
 - **collectives** — the sync-face spans (pack, metadata, payload gather,
   unpack, per-state gather) by count, bytes and latency;
+- **latency digest** — per-site p50/p95/p99/max from the embedded
+  snapshot's full-lifetime histogram plane (``latency_stats``) plus any
+  SLO budget violations — the percentiles that survive after the span
+  ring has dropped old spans;
 - **fault-lane timeline** — every instant mark (faults, ladder demotions/
   promotions, deadline timeouts, degraded serves, journal demotions) in
   monotonic-step order.
@@ -30,7 +34,13 @@ Modes::
                                                       # smoke the --diff path
 
 ``--check`` exits non-zero on any structural problem (not valid JSON, missing
-or non-monotonic timestamps, malformed events) — the ``make trace`` gate.
+or non-monotonic timestamps, malformed events, or a malformed latency
+histogram plane: negative bucket counts, ``count`` != the ``+Inf`` bucket,
+``sum_s`` inconsistent with count*max, non-monotone percentiles) — the
+``make trace`` gate. :func:`check_histogram_exposition` applies the same
+family rules to a rendered ``prometheus_text()`` exposition (cumulative
+``le`` buckets monotone and ending at ``+Inf`` == ``_count``) — the
+validator the ``latency_plane_certification`` runs.
 ``--diff`` accepts either an ``export_trace``/``export_fleet_trace`` JSON
 (its embedded ``snapshot`` is used) or a raw ``telemetry_snapshot()`` dump,
 and prints new/removed keys plus the top movers.
@@ -68,6 +78,125 @@ COLLECTIVE_SITES = (
     "fleet-snapshot",
     "fleet-trace",
 )
+
+
+def check_histogram_stats(latency_stats: Any, where: str = "snapshot.latency_stats") -> List[str]:
+    """Well-formedness of a ``latency_stats``-shaped histogram plane (the
+    per-site blocks ``telemetry.latency_stats()`` / the fleet merge emit):
+    non-negative integer bucket counts on strictly-increasing finite ``le``
+    bounds ending at ``+Inf``, ``count`` == the bucket total (== the ``+Inf``
+    cumulative bucket), ``sum_s`` consistent with ``count``/``max_s``, and
+    monotone percentiles. Stdlib-only, like the rest of ``--check``."""
+    problems: List[str] = []
+    if latency_stats in (None, {}):
+        return problems
+    if not isinstance(latency_stats, dict):
+        return [f"{where} must be an object, got {type(latency_stats).__name__}"]
+    for site, block in latency_stats.items():
+        tag = f"{where}[{site!r}]"
+        if not isinstance(block, dict):
+            problems.append(f"{tag} is not an object")
+            continue
+        buckets = block.get("buckets")
+        if not isinstance(buckets, dict) or not buckets:
+            problems.append(f"{tag} has no buckets")
+            continue
+        labels = list(buckets)
+        if labels[-1] != "+Inf":
+            problems.append(f"{tag} buckets do not end at '+Inf' (last: {labels[-1]!r})")
+        bounds = []
+        for label in labels[:-1]:
+            try:
+                bounds.append(float(label))
+            except ValueError:
+                problems.append(f"{tag} has a non-numeric le label {label!r}")
+        if any(b <= 0 for b in bounds) or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ):
+            problems.append(f"{tag} le bounds are not positive and strictly increasing")
+        counts = list(buckets.values())
+        if any((not isinstance(c, int)) or c < 0 for c in counts):
+            problems.append(f"{tag} has a negative or non-integer bucket count")
+            continue
+        count = block.get("count")
+        if count != sum(counts):
+            problems.append(
+                f"{tag} count {count!r} != bucket total {sum(counts)}"
+                " (the +Inf cumulative bucket)"
+            )
+        sum_s = float(block.get("sum_s", 0.0))
+        max_s = float(block.get("max_s", 0.0))
+        if sum_s < 0:
+            problems.append(f"{tag} sum_s is negative")
+        if not count and (sum_s or max_s):
+            problems.append(f"{tag} is empty but carries sum_s/max_s")
+        if count:
+            if not (0 < sum_s <= count * max_s * (1 + 1e-9)):
+                problems.append(
+                    f"{tag} sum_s {sum_s} inconsistent with count {count} * max_s {max_s}"
+                )
+            p50, p95, p99 = (float(block.get(k, 0.0)) for k in ("p50_s", "p95_s", "p99_s"))
+            if not (0 <= p50 <= p95 <= p99 <= max_s * (1 + 1e-9)):
+                problems.append(f"{tag} percentiles not monotone: {p50} {p95} {p99} {max_s}")
+    return problems
+
+
+def check_histogram_exposition(text: str) -> List[str]:
+    """Validate every ``# TYPE ... histogram`` family in a Prometheus text
+    exposition (local ``prometheus_text()`` or the fleet rendering): each
+    labelset's ``le`` buckets must be CUMULATIVE (non-decreasing in
+    exposition order), end at ``le="+Inf"``, and agree exactly with the
+    labelset's ``_count`` sample; ``_sum`` must be present and non-negative
+    (zero only for an empty series)."""
+    problems: List[str] = []
+    hist_families: List[str] = []
+    for line in text.splitlines():
+        if line.startswith("# TYPE ") and line.rstrip().endswith(" histogram"):
+            hist_families.append(line.split(" ")[2])
+    if not hist_families:
+        return ["no histogram family in the exposition"]
+    for fam in hist_families:
+        series: Dict[str, List[float]] = {}
+        last_le: Dict[str, str] = {}
+        counts: Dict[str, float] = {}
+        sums: Dict[str, float] = {}
+        for line in text.splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            name_labels, value = line.rsplit(" ", 1)
+            base = name_labels.split("{", 1)[0]
+            labels = name_labels[len(base):]
+            if base == f"{fam}_bucket":
+                le = labels.rsplit('le="', 1)[-1].split('"', 1)[0]
+                key = labels.replace(f'le="{le}"', "").strip("{},")
+                series.setdefault(key, []).append(float(value))
+                last_le[key] = le
+            elif base == f"{fam}_count":
+                counts[labels.strip("{}")] = float(value)
+            elif base == f"{fam}_sum":
+                sums[labels.strip("{}")] = float(value)
+        if not series:
+            problems.append(f"{fam}: histogram family has no _bucket samples")
+            continue
+        for key, cum in series.items():
+            tag = f"{fam}{{{key}}}"
+            if any(b - a < 0 for a, b in zip(cum, cum[1:])):
+                problems.append(f"{tag}: cumulative le buckets decrease")
+            if last_le.get(key) != "+Inf":
+                problems.append(f"{tag}: last bucket is not le=\"+Inf\"")
+            if key not in counts:
+                problems.append(f"{tag}: no _count sample")
+            elif counts[key] != cum[-1]:
+                problems.append(
+                    f"{tag}: _count {counts[key]} != +Inf bucket {cum[-1]}"
+                )
+            if key not in sums:
+                problems.append(f"{tag}: no _sum sample")
+            else:
+                s = sums[key]
+                if s < 0 or (cum[-1] == 0) != (s == 0):
+                    problems.append(f"{tag}: _sum {s} inconsistent with count {cum[-1]}")
+    return problems
 
 
 def check_trace(doc: Any) -> List[str]:
@@ -116,6 +245,8 @@ def check_trace(doc: Any) -> List[str]:
     snap = doc.get("snapshot")
     if snap is not None and not isinstance(snap, dict):
         problems.append("'snapshot' must be an object")
+    elif snap:
+        problems.extend(check_histogram_stats(snap.get("latency_stats")))
     return problems
 
 
@@ -184,6 +315,28 @@ def summarize(doc: Dict[str, Any], top: int = 10) -> str:
         lines.append(
             f"  {site:<22} n={len(evs):<6} bytes={_fmt_bytes(total_bytes):<12} "
             f"mean={sum(durs) / len(durs) / 1000.0:8.4f} ms  max={max(durs) / 1000.0:8.4f} ms"
+        )
+
+    # ---- latency digest (full-lifetime histogram plane) ----
+    latency = (doc.get("snapshot") or {}).get("latency_stats") or {}
+    lines.append(f"\n== latency digest ({len(latency)} sites, full-lifetime histograms) ==")
+    for site, block in sorted(
+        latency.items(), key=lambda kv: -float((kv[1] or {}).get("sum_s", 0.0))
+    )[:top]:
+        lines.append(
+            f"  {site:<22} n={int(block.get('count', 0)):<6} "
+            f"p50={float(block.get('p50_s', 0.0)) * 1e3:8.3f} ms  "
+            f"p95={float(block.get('p95_s', 0.0)) * 1e3:8.3f} ms  "
+            f"p99={float(block.get('p99_s', 0.0)) * 1e3:8.3f} ms  "
+            f"max={float(block.get('max_s', 0.0)) * 1e3:8.3f} ms"
+        )
+    slo = (doc.get("snapshot") or {}).get("slo_violations") or {}
+    violated = {k: v for k, v in slo.items() if k != "total" and v}
+    if violated:
+        lines.append(
+            "  SLO violations: "
+            + ", ".join(f"{k}×{v}" for k, v in sorted(violated.items()))
+            + f" (total {slo.get('total', 0)})"
         )
 
     # ---- fault-lane timeline ----
@@ -307,6 +460,13 @@ def run_fleet_smoke(out_path: str) -> str:
                     for block in (d.get("sync_phase_stats") or {}).values():
                         for key in ("total_s", "mean_s", "max_s"):
                             block[key] = float(block.get(key, 0.0)) * slowdown
+                    # the full-lifetime plane's gauges slow down too, so the
+                    # tail-aware straggler scoring sees the same slow rank
+                    # (bucket COUNTS stay untouched: the merge-exactness
+                    # assertion below sums them against a per-rank oracle)
+                    for lat in (d.get("latency_stats") or {}).values():
+                        for key in ("p50_s", "p95_s", "p99_s", "max_s", "sum_s"):
+                            lat[key] = float(lat.get(key, 0.0)) * slowdown
                 rows.append(json.dumps(d, separators=(",", ":")).encode("utf-8"))
             return rows
 
@@ -320,6 +480,25 @@ def run_fleet_smoke(out_path: str) -> str:
         assert 2 in report["stragglers"], (
             f"the deliberately-slow rank 2 was not flagged: {report['ranked']}"
         )
+
+        # ---- the fleet histogram merge is EXACT: aggregate bucket counts ==
+        # per-rank sums, for every site and every le bucket ----
+        agg_lat = snap["aggregate"]["latency_stats"]
+        assert agg_lat, "fleet merge carries no latency histograms"
+        live_planes = [
+            p for p in snap["ranks"].values()
+            if isinstance(p, dict) and not (p.get("dead") or p.get("missing") or p.get("corrupt"))
+        ]
+        for site, block in agg_lat.items():
+            per_rank = [b for b in ((p.get("latency_stats") or {}).get(site) for p in live_planes) if b]
+            assert block["count"] == sum(int(b.get("count", 0)) for b in per_rank), site
+            for label, n_bucket in block["buckets"].items():
+                oracle = sum(int((b.get("buckets") or {}).get(label, 0)) for b in per_rank)
+                assert n_bucket == oracle, (site, label, n_bucket, oracle)
+        # the tail-aware score names the same deliberately-slow rank
+        tail_phase = report["phases"]["sync-payload-gather"]
+        assert tail_phase.get("tail_slowest_rank") == 2, tail_phase
+
         n = fleetobs.export_fleet_trace(out_path)
         assert n > 0, "fleet trace exported no span events"
 
@@ -375,6 +554,18 @@ def run_smoke(out_path: str) -> str:
     suite.compute()
     suite.save_state(out_path + ".journal")
     engine.export_trace(out_path)
+    # the latency digest must be present in the exported snapshot AND in the
+    # report text — the `make trace` pin for the full-lifetime plane
+    with open(out_path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    latency = (doc.get("snapshot") or {}).get("latency_stats") or {}
+    assert latency, "--smoke trace carries no latency digest (latency_stats empty)"
+    assert "suite-sync" in latency, f"no suite-sync histogram in {sorted(latency)}"
+    assert "latency digest" in summarize(doc), "report lost its latency-digest section"
+    # the RENDERED exposition's histogram families must pass the same
+    # validator (cumulative le monotone, +Inf == _count, _sum consistent)
+    problems = check_histogram_exposition(mt.prometheus_text())
+    assert not problems, f"prometheus_text histogram families invalid: {problems[:3]}"
     return out_path
 
 
