@@ -88,8 +88,11 @@ chaos:
 # the Chrome-trace/Perfetto JSON, and validate + summarize it with the
 # report tool (docs/observability.md). --smoke implies --check semantics:
 # a structurally invalid trace (bad events, non-monotonic timestamps, a
-# malformed latency histogram plane) fails, and the latency digest must be
-# present in the snapshot and the report. The fleet smoke then runs the
+# malformed latency histogram plane) fails, the latency digest must be
+# present in the snapshot and the report, perf_report()'s phase
+# decomposition must reconcile against the measured loop wall (device
+# probes sampling), and the --perf rendering must produce a populated
+# decomposition with at least one probed roofline row. The fleet smoke then runs the
 # dryrun-multichip fleet path: a simulated 3-rank world (deliberately-slow
 # rank flagged by BOTH the mean-based and tail-aware straggler scores),
 # fleet histogram bucket counts asserted as exact per-rank sums, one merged

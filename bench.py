@@ -144,8 +144,46 @@ def bench_suite_ours(probs: np.ndarray, target: np.ndarray) -> tuple:
 
     lat = _latency_percentiles(_step, STEPS)
     jax.block_until_ready(box["st"])
+
+    # roofline columns (ISSUE 12): join the fused program's XLA cost analysis
+    # with a device-INCLUSIVE per-step wall (every call blocked — the same
+    # measurement the engine's sampled device probes land) into achieved
+    # FLOP/s, achieved bytes/s and a bound classification against the
+    # calibrated machine peaks — the evidence for WHY the row is as fast as
+    # it is, not just how fast
+    roofline = {}
+    try:
+        from metrics_tpu.ops import engine as _engine
+
+        # lower through the ALREADY-jitted donated wrapper: same cache, same
+        # donation configuration as the measured program — no second compile
+        compiled = fused_update.lower(box["st"], p, t).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        analysis = {
+            "flops": float(cost.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
+        }
+
+        def _blocked_step():
+            box["st"] = fused_update(box["st"], p, t)
+            jax.block_until_ready(box["st"])
+
+        n_probe = max(5, STEPS // 2)
+        dev = _latency_percentiles(_blocked_step, n_probe)
+        device_block = {
+            "count": dev["n"],
+            "p50_s": dev["p50"] / 1000.0,
+            "sum_s": dev["p50"] / 1000.0 * max(1, dev["n"]),
+        }
+        roofline = _engine._roofline_row(
+            analysis, device_block, lat["p50"] / 1000.0, _engine.roofline_peaks()
+        )
+    except Exception:  # noqa: BLE001 — a bench column must never kill the run
+        pass
     _ = compute(box["st"])
-    return STEPS * BATCH / best, lat
+    return STEPS * BATCH / best, lat, roofline
 
 
 def bench_suite_reference(probs: np.ndarray, target: np.ndarray) -> float:
@@ -768,6 +806,64 @@ def bench_telemetry_overhead() -> dict:
     }
 
 
+def bench_device_probe_overhead() -> dict:
+    """Cost of the sampled device-time probes (ISSUE 12) on the hot deferred
+    eager-API loop: telemetry armed in BOTH passes, probes disarmed
+    (``METRICS_TPU_DEVICE_PROBE_EVERY`` unset — one cached-int compare per
+    dispatch, nothing allocated) vs armed at ``EVERY=8`` (every 8th program
+    dispatch blocks until the device finishes and lands its inclusive wall
+    in the ``device-dispatch:<program>`` family). The disarmed rate must sit
+    inside the existing telemetry armed≈disarmed envelope — probes off is
+    the bench-pinned default."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.ops import engine, telemetry
+    from metrics_tpu.utils.checks import set_validation_mode
+
+    set_validation_mode("first")
+    engine.set_deferred_dispatch(True)
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(BATCH).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, BATCH))
+    PROBE_EVERY = 8
+
+    def loop_steps_per_s() -> float:
+        metric = Accuracy()
+        metric(p, t)
+        for _ in range(OVERHEAD_STEPS):
+            metric(p, t)
+        jax.block_until_ready(metric.correct)
+        best = float("inf")
+        for _ in range(TRIALS):
+            start = time.perf_counter()
+            for _ in range(OVERHEAD_STEPS):
+                metric(p, t)
+            jax.block_until_ready(metric.correct)
+            best = min(best, time.perf_counter() - start)
+        return OVERHEAD_STEPS / best
+
+    was_armed = telemetry.armed
+    try:
+        telemetry.set_telemetry(True)
+        engine.set_device_probe(0)
+        disarmed = loop_steps_per_s()
+        probes_before = engine.engine_stats()["device_probes"]
+        engine.set_device_probe(PROBE_EVERY)
+        armed = loop_steps_per_s()
+        probes = engine.engine_stats()["device_probes"] - probes_before
+    finally:
+        engine.set_device_probe(None)  # back to the env-driven default (off)
+        telemetry.set_telemetry(was_armed)
+    return {
+        "disarmed_steps_per_s": disarmed,
+        "armed_steps_per_s": armed,
+        "probe_every": PROBE_EVERY,
+        "device_probes": int(probes),
+    }
+
+
 def bench_sync_per_call() -> dict:
     """Whole-suite sync round-trip cost: coalesced vs per-state protocol.
 
@@ -807,7 +903,11 @@ def bench_sync_per_call() -> dict:
             # warmup compiles the pack/unpack (or per-state apply) programs
             coll.sync(distributed_available=dist_on)
             coll.unsync()
+            from metrics_tpu.ops import perf as _perf
+            from metrics_tpu.ops import telemetry as _telemetry
+
             s0 = engine.engine_stats()
+            lat0 = _telemetry.latency_stats()
             best = float("inf")
             for _ in range(TRIALS):
                 start = time.perf_counter()
@@ -823,6 +923,22 @@ def bench_sync_per_call() -> dict:
                 - s0["sync_shape_collectives"]
                 - s0["sync_payload_collectives"]
             ) / (n_syncs * TRIALS)
+            # sync-phase attribution columns (ISSUE 12): the per-phase wall
+            # this row's cycles spent, the bytes that crossed the (simulated)
+            # wire and the effective bandwidth — the decomposition
+            # sweep_regress --explain consumes round over round
+            phases = _perf.phase_columns(lat0, _telemetry.latency_stats())
+            wire_ms = phases.get("wire", 0.0)
+            bytes_gathered = s1["sync_bytes_gathered"] - s0["sync_bytes_gathered"]
+            sync_phases = {
+                k: v for k, v in phases.items()
+                if k in ("pack", "serialize", "wire", "unpack", "orchestrate")
+            }
+            bound = (
+                max(sync_phases, key=lambda k: sync_phases[k]) + "-bound"
+                if sync_phases
+                else "untelemetered"
+            )
 
             def _cycle():
                 coll.sync(distributed_available=dist_on)
@@ -834,6 +950,11 @@ def bench_sync_per_call() -> dict:
                 "syncs_per_s": n_syncs / best,
                 "collectives_per_sync": per_sync,
                 "latency": lat,
+                "phases_ms": phases,
+                "achieved_gbps": (
+                    (bytes_gathered / (wire_ms / 1000.0)) / 1e9 if wire_ms > 0 else 0.0
+                ),
+                "bound": bound,
             }
         finally:
             os.environ.pop("METRICS_TPU_SYNC_COALESCE", None)
@@ -844,6 +965,9 @@ def bench_sync_per_call() -> dict:
         "coalesced_syncs_per_s": coalesced["syncs_per_s"],
         "coalesced_collectives_per_sync": coalesced["collectives_per_sync"],
         "coalesced_latency_ms": coalesced["latency"],
+        "coalesced_phases_ms": coalesced["phases_ms"],
+        "achieved_gbps": coalesced["achieved_gbps"],
+        "bound": coalesced["bound"],
         "per_state_syncs_per_s": per_state["syncs_per_s"],
         "per_state_collectives_per_sync": per_state["collectives_per_sync"],
         "per_state_latency_ms": per_state["latency"],
@@ -1041,7 +1165,7 @@ def main() -> None:
         sys.path.insert(0, _REPO_DIR)
     probs, target = _make_data()
 
-    ours_suite, suite_lat = bench_suite_ours(probs, target)
+    ours_suite, suite_lat, suite_roofline = bench_suite_ours(probs, target)
     ref_suite = _safe(bench_suite_reference, probs, target)
 
     # per-step workloads run BEFORE the image/detection wall-clocks: FID's
@@ -1063,6 +1187,9 @@ def main() -> None:
     # telemetry probe rides the identical loop right after (same regime):
     # the flight recorder's armed cost must stay under 5% there
     telemetry_probe = bench_telemetry_overhead()
+    # device-probe probe rides the identical loop right after the telemetry
+    # row it extends (probes disarmed must stay inside its envelope)
+    probe_probe = bench_device_probe_overhead()
     sync_probe = bench_sync_per_call()
     # durability probes ride the same backend regime as the sync row they
     # extend (same loop shape, same simulated-distributed surface)
@@ -1099,6 +1226,19 @@ def main() -> None:
             # per-step dispatch-latency percentiles, bucket-interpolated by
             # the telemetry plane's LatencyHistogram (docs/performance.md)
             "latency_ms": suite_lat,
+            # roofline columns (ISSUE 12): XLA cost analysis joined with a
+            # device-inclusive per-step wall — achieved rates and the bound
+            # classification docs/performance.md "Where the time goes" defines
+            "achieved_gflops": round(
+                suite_roofline.get("achieved_flops_per_s", 0.0) / 1e9, 4
+            ),
+            "achieved_gbps": round(
+                suite_roofline.get("achieved_bytes_per_s", 0.0) / 1e9, 4
+            ),
+            "arithmetic_intensity": round(
+                suite_roofline.get("arithmetic_intensity", 0.0), 4
+            ),
+            "bound": suite_roofline.get("bound", "unprobed"),
         },
         "fid_wallclock": {
             "value": round(ours_fid, 3),
@@ -1251,6 +1391,13 @@ def main() -> None:
             # production scrape measures it
             "coalesced_latency_ms": sync_probe["coalesced_latency_ms"],
             "per_state_latency_ms": sync_probe["per_state_latency_ms"],
+            # ISSUE 12: the sync decomposition's archived evidence — per-phase
+            # wall (pack/serialize/wire/unpack/orchestrate), the effective
+            # wire bandwidth the gathered bytes imply, and which phase the
+            # cycle is bound by (the 69 ms itemization, per round)
+            "coalesced_phases_ms": sync_probe["coalesced_phases_ms"],
+            "achieved_gbps": round(sync_probe["achieved_gbps"], 4),
+            "bound": sync_probe["bound"],
             "unit": "suite sync+unsync cycles/s (4-metric multi-state suite, simulated world)",
             "note": (
                 "coalesced: ONE packed payload collective slot + one donated "
@@ -1259,6 +1406,32 @@ def main() -> None:
                 "per state per metric — the collective-slot ratio is the "
                 "multi-process round-trip saving (each slot is a blocking "
                 "~sync_roundtrip_ms exchange on the tunneled backend)"
+            ),
+        },
+        "device_probe_overhead": {
+            # ISSUE 12: the sampled device-time probes' cost envelope. Probes
+            # DISARMED (the default) must sit inside the telemetry
+            # armed≈disarmed band — the dispatch path pays one cached-int
+            # compare; armed at EVERY=8, every 8th dispatch blocks until the
+            # device finishes (deliberately paid: it buys the device-
+            # inclusive wall the roofline ledger joins).
+            "disarmed_steps_per_s": round(probe_probe["disarmed_steps_per_s"], 1),
+            "armed_steps_per_s": round(probe_probe["armed_steps_per_s"], 1),
+            "armed_vs_disarmed": round(
+                probe_probe["armed_steps_per_s"] / probe_probe["disarmed_steps_per_s"], 3
+            )
+            if probe_probe["disarmed_steps_per_s"] > 0
+            else None,
+            "probe_every": probe_probe["probe_every"],
+            "device_probes": probe_probe["device_probes"],
+            "unit": "forward steps/s (eager module API, deferred dispatch on, telemetry armed)",
+            "note": (
+                "disarmed (METRICS_TPU_DEVICE_PROBE_EVERY unset/0): one int "
+                "compare per dispatch, nothing allocated — the bench-pinned "
+                "default; armed: every Nth dispatch is forced with "
+                "block_until_ready and its device-inclusive wall lands in the "
+                "device-dispatch:<program> histogram family the roofline "
+                "ledger and perf_report() join (docs/performance.md)"
             ),
         },
         "sync_deadline_overhead": {

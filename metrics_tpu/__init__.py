@@ -144,9 +144,14 @@ from metrics_tpu.ops.telemetry import (  # noqa: E402
 # aggregation, straggler attribution, and the merged one-process-per-rank trace
 from metrics_tpu.ops.fleetobs import (  # noqa: E402
     export_fleet_trace,
+    fleet_perf_report,
     fleet_prometheus_text,
     fleet_snapshot,
 )
+
+# the performance attribution plane (docs/performance.md "Where the time
+# goes"): step-latency decomposition, roofline ledger, ranked opportunities
+from metrics_tpu.ops.perf import perf_report  # noqa: E402
 
 # world membership (docs/robustness.md "World membership"): epoch registry +
 # peer-health surface behind epoch-fenced collectives and quorum compute
@@ -161,8 +166,10 @@ __all__ = [
     "telemetry_snapshot",
     "world_health",
     "export_fleet_trace",
+    "fleet_perf_report",
     "fleet_prometheus_text",
     "fleet_snapshot",
+    "perf_report",
     "Metric",
     "CompositionalMetric",
     "MetricCollection",

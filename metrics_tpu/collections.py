@@ -107,21 +107,32 @@ class MetricCollection:
         enqueue instead and the suite flushes as one stacked scan covering
         the whole queue — the returned dict holds lazy per-member handles.
         """
-        deferred = self._defer_forward(args, kwargs)
-        if deferred is not None:
+        # suite-step telemetry span: the per-call parent wall perf_report()'s
+        # step decomposition attributes (enqueue = the exclusive time not
+        # covered by nested flush/compile/dispatch spans)
+        t_step = _telemetry.now() if _telemetry.armed else 0.0
+        try:
+            deferred = self._defer_forward(args, kwargs)
+            if deferred is not None:
+                self._journal_tick()
+                return deferred
+            fused = self._forward_fused(*args, **kwargs)
+            if fused is not None:
+                self._journal_tick()
+                return fused
+            result = self._forward_member_wise(
+                list(self.items(keep_base=True, copy_state=False)), *args, **kwargs
+            )
+            # clean member-wise step: demoted suite lanes count toward recovery
+            self._fault_note_clean()
             self._journal_tick()
-            return deferred
-        fused = self._forward_fused(*args, **kwargs)
-        if fused is not None:
-            self._journal_tick()
-            return fused
-        result = self._forward_member_wise(
-            list(self.items(keep_base=True, copy_state=False)), *args, **kwargs
-        )
-        # clean member-wise step: demoted suite lanes count toward recovery
-        self._fault_note_clean()
-        self._journal_tick()
-        return result
+            return result
+        finally:
+            if t_step and _telemetry.armed:
+                _telemetry.emit(
+                    "suite-step", self, "suite", t_step, _telemetry.now() - t_step,
+                    {"api": "forward"},
+                )
 
     def _forward_member_wise(self, members: List[Tuple[str, Metric]], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in members}
@@ -990,31 +1001,40 @@ class MetricCollection:
         With deferred dispatch on, steady-state calls enqueue into ONE
         suite-level queue that flushes as a single stacked scan program
         across the compute-group leaders."""
-        if self._defer_update(args, kwargs):
+        # suite-step span: see forward() — the step-decomposition parent wall
+        t_step = _telemetry.now() if _telemetry.armed else 0.0
+        try:
+            if self._defer_update(args, kwargs):
+                self._journal_tick()
+                return
+            if self._groups_checked:
+                for cg in self._groups.values():
+                    m0 = self._modules[cg[0]]
+                    m0.update(*args, **m0._filter_kwargs(**kwargs))
+                    for name in cg[1:]:
+                        mi = self._modules[name]
+                        mi._update_count = m0._update_count
+                        mi._computed = None  # leader's update must invalidate members' caches
+                if self._state_is_copy:
+                    self._compute_groups_create_state_ref()
+                    self._state_is_copy = False
+            else:
+                for _, m in self.items(keep_base=True, copy_state=False):
+                    m.update(*args, **m._filter_kwargs(**kwargs))
+                if self._enable_compute_groups:
+                    self._merge_compute_groups()
+                    self._compute_groups_create_state_ref()
+                    self._groups_checked = True
+            # clean suite step at whatever tier ran: demoted suite lanes count
+            # toward their recovery edge
+            self._fault_note_clean()
             self._journal_tick()
-            return
-        if self._groups_checked:
-            for cg in self._groups.values():
-                m0 = self._modules[cg[0]]
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
-                for name in cg[1:]:
-                    mi = self._modules[name]
-                    mi._update_count = m0._update_count
-                    mi._computed = None  # leader's update must invalidate members' caches
-            if self._state_is_copy:
-                self._compute_groups_create_state_ref()
-                self._state_is_copy = False
-        else:
-            for _, m in self.items(keep_base=True, copy_state=False):
-                m.update(*args, **m._filter_kwargs(**kwargs))
-            if self._enable_compute_groups:
-                self._merge_compute_groups()
-                self._compute_groups_create_state_ref()
-                self._groups_checked = True
-        # clean suite step at whatever tier ran: demoted suite lanes count
-        # toward their recovery edge
-        self._fault_note_clean()
-        self._journal_tick()
+        finally:
+            if t_step and _telemetry.armed:
+                _telemetry.emit(
+                    "suite-step", self, "suite", t_step, _telemetry.now() - t_step,
+                    {"api": "update"},
+                )
 
     def compute(self) -> Dict[str, Any]:
         # suite-coalesced auto-sync: in a live multi-process world the whole

@@ -27,10 +27,12 @@ from metrics_tpu.ops.faults import (
 )
 from metrics_tpu.ops.fleetobs import (
     export_fleet_trace,
+    fleet_perf_report,
     fleet_prometheus_text,
     fleet_snapshot,
     straggler_report,
 )
+from metrics_tpu.ops.perf import perf_report
 from metrics_tpu.ops.journal import journal_generations, journal_stats, journalable
 from metrics_tpu.ops.telemetry import (
     SPAN_SITES,
@@ -82,7 +84,9 @@ __all__ = [
     "set_telemetry",
     "telemetry_snapshot",
     "export_fleet_trace",
+    "fleet_perf_report",
     "fleet_prometheus_text",
     "fleet_snapshot",
+    "perf_report",
     "straggler_report",
 ]

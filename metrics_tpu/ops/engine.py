@@ -84,6 +84,7 @@ __all__ = [
     "defer_enabled",
     "defer_max_age_s",
     "defer_max_pending",
+    "device_probe_every",
     "donation_supported",
     "engine_stats",
     "export_trace",
@@ -92,7 +93,10 @@ __all__ = [
     "program_summary",
     "reset_engine",
     "reset_stats",
+    "roofline_peaks",
     "set_deferred_dispatch",
+    "set_device_probe",
+    "set_roofline_peaks",
     "state_donatable",
     "state_intact",
 ]
@@ -159,6 +163,60 @@ def state_intact(state: Any) -> bool:
         if isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer) and leaf.is_deleted():
             return False
     return True
+
+
+# ---------------------------------------------------------- device-time probes
+class _EngineWarnOwner:
+    """Warn-dedupe anchor for this module's env-knob parse warnings."""
+
+
+_ENV_WARN_OWNER = _EngineWarnOwner()
+
+#: Resolved ``METRICS_TPU_DEVICE_PROBE_EVERY`` (None = not yet read; 0 = off).
+_probe_every: Optional[int] = None
+_probe_countdown: List[int] = [0]
+
+
+def device_probe_every() -> int:
+    """The device-probe sampling period: every Nth :class:`Executable`
+    dispatch is forced with ``jax.block_until_ready`` and its
+    device-INCLUSIVE wall lands in the ``device-dispatch:<program>``
+    latency-histogram family (``METRICS_TPU_DEVICE_PROBE_EVERY=N``).
+
+    0 / unset (the DEFAULT) disarms the probe entirely: the dispatch path
+    pays one cached-int comparison and allocates nothing — pinned by the
+    ``device_probe_overhead`` bench row. A garbage value warns once (naming
+    the offending value) and stays disarmed. Host dispatch is asynchronous,
+    so without probes every ``engine-dispatch`` span under-measures device
+    time; a probed dispatch trades one pipeline bubble for the real
+    measurement the roofline ledger joins (see docs/performance.md "Where
+    the time goes")."""
+    global _probe_every
+    if _probe_every is None:
+        raw = os.environ.get("METRICS_TPU_DEVICE_PROBE_EVERY")
+        if raw is None or not raw.strip():
+            _probe_every = 0
+        else:
+            try:
+                _probe_every = max(0, int(raw))
+            except ValueError:
+                _probe_every = 0
+                _faults.warn_fault(
+                    _ENV_WARN_OWNER,
+                    "env:METRICS_TPU_DEVICE_PROBE_EVERY",
+                    f"METRICS_TPU_DEVICE_PROBE_EVERY={raw!r} is not an integer; "
+                    "device-time probes stay OFF.",
+                )
+    return _probe_every
+
+
+def set_device_probe(every: Optional[int]) -> None:
+    """Override the probe period at runtime (``0`` disarms; ``None`` drops
+    the cached value so ``METRICS_TPU_DEVICE_PROBE_EVERY`` is re-read on the
+    next dispatch). Takes precedence over the environment."""
+    global _probe_every
+    _probe_every = None if every is None else max(0, int(every))
+    _probe_countdown[0] = 0
 
 
 # ----------------------------------------------------------------- fingerprints
@@ -252,13 +310,16 @@ class Executable:
         "aux",
         "kind",
         "key_digest",
+        "probe_key",
         "hits",
         "donated_runs",
         "plain_runs",
         "compiles",
         "compile_time_s",
+        "dispatch_time_s",
         "arg_structs",
         "analysis",
+        "analysis_failed",
         "__weakref__",
     )
 
@@ -269,13 +330,16 @@ class Executable:
         self.aux = aux
         self.kind = "anonymous"
         self.key_digest = ""
+        self.probe_key = "anonymous"
         self.hits = 0
         self.donated_runs = 0
         self.plain_runs = 0
         self.compiles = 0
         self.compile_time_s = 0.0
+        self.dispatch_time_s = 0.0
         self.arg_structs: Optional[tuple] = None
         self.analysis: Optional[Dict[str, Any]] = None
+        self.analysis_failed = False
 
     def _capture_structs(self, state: Any, args: tuple, kwargs: dict) -> None:
         """Retain the just-compiled call's abstract signature (arrays as
@@ -291,7 +355,10 @@ class Executable:
                 return x
 
             self.arg_structs = jax.tree.map(leaf, (state, args, kwargs))
-            self.analysis = None  # a new signature invalidates the cached analysis
+            # a new signature invalidates the memoized analysis (success AND
+            # the memoized-failure marker — the new avals may analyze fine)
+            self.analysis = None
+            self.analysis_failed = False
         except Exception:  # noqa: BLE001 — the ledger never breaks a dispatch
             pass
 
@@ -309,11 +376,12 @@ class Executable:
         size_fn = getattr(fn, "_cache_size", None)
         before = size_fn() if size_fn is not None else -1
         out = fn(state, *args, **kwargs)
+        compiled = size_fn is not None and size_fn() > before
         if donated:
             self.donated_runs += 1
         else:
             self.plain_runs += 1
-        if size_fn is not None and size_fn() > before:
+        if compiled:
             # this call traced+compiled a new aval signature: a ledger
             # compile event (its wall time IS the cold-start cost the
             # persistent-AOT-cache roadmap item needs attributed per program)
@@ -323,10 +391,37 @@ class Executable:
             self._capture_structs(state, args, kwargs)
             if _telemetry.armed:
                 _telemetry.emit("engine-compile", self.kind, "engine", t0, dur, {"donated": donated})
-        elif record_span and _telemetry.armed:
-            _telemetry.emit(
-                "engine-dispatch", self.kind, "engine", t0, time.perf_counter() - t0, None
-            )
+        else:
+            host_dur = time.perf_counter() - t0
+            self.dispatch_time_s += host_dur
+            if record_span and _telemetry.armed:
+                # async_host_wall: XLA dispatch is asynchronous — this span
+                # ends when the runtime ACCEPTS the dispatch, not when the
+                # device finishes, so it under-measures device time (the
+                # probed device-dispatch spans carry the inclusive wall)
+                _telemetry.emit(
+                    "engine-dispatch", self.kind, "engine", t0, host_dur,
+                    {"async_host_wall": True},
+                )
+        # sampled device-time probe (METRICS_TPU_DEVICE_PROBE_EVERY=N): every
+        # Nth dispatch blocks until the device finishes and lands the
+        # device-INCLUSIVE wall in the per-program device-dispatch family.
+        # Compile events are skipped — their wall is trace+XLA-compile, and
+        # folding it into the device plane would poison the roofline join. A
+        # probed flush chunk forces the WHOLE chunk's scan program and counts
+        # as ONE probe (one dispatch = one program, however many steps it
+        # stacked). Disarmed (EVERY=0, the default) this is one int compare.
+        every = _probe_every if _probe_every is not None else device_probe_every()
+        if every and not compiled:
+            n = _probe_countdown[0] + 1
+            if n >= every:
+                n = 0
+                jax.block_until_ready(out)
+                _stats["device_probes"] += 1
+                _telemetry.observe_device_dispatch(
+                    self.probe_key, t0, time.perf_counter() - t0
+                )
+            _probe_countdown[0] = n
         return out
 
     def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
@@ -374,7 +469,7 @@ class Executable:
 
 _PROGRAM_CACHE: "OrderedDict[tuple, Executable]" = OrderedDict()
 _CACHE_CAP = 256
-_stats = {"builds": 0, "hits": 0}
+_stats = {"builds": 0, "hits": 0, "device_probes": 0, "program_analyses": 0}
 
 
 def acquire(
@@ -427,6 +522,9 @@ def acquire_keyed(
     )
     exe.kind = str(key[0])
     exe.key_digest = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+    # the per-program device-histogram identity: kind alone collides (every
+    # same-kind config shares it), so the cache-key digest disambiguates
+    exe.probe_key = f"{exe.kind}:{exe.key_digest[:8]}"
     if _telemetry.armed:
         _telemetry.emit(
             "engine-build", exe.kind, "engine", t0, time.perf_counter() - t0, {"key": exe.key_digest}
@@ -463,6 +561,10 @@ def engine_stats() -> Dict[str, Any]:
         "deferred_steps": _stats["deferred_steps"],
         "deferred_flushes": _stats["deferred_flushes"],
         "deferred_fallbacks": _stats["deferred_fallbacks"],
+        # the performance-attribution plane: sampled block_until_ready
+        # dispatches and memoized cost-analysis lowers actually performed
+        "device_probes": _stats["device_probes"],
+        "program_analyses": _stats["program_analyses"],
     }
     out.update(_faults.fault_stats())
     from metrics_tpu.ops import journal as _journal
@@ -476,13 +578,18 @@ def engine_stats() -> Dict[str, Any]:
 # ------------------------------------------------------------- program ledger
 def _analyze(exe: Executable) -> Optional[Dict[str, Any]]:
     """XLA cost/memory analysis for one cached program, via an AOT re-lower
-    of the plain twin at its last-compiled abstract signature. Cached on the
-    executable; any failure (no recorded signature, a backend without
-    analysis support) reports None rather than raising."""
+    of the plain twin at its last-compiled abstract signature. MEMOIZED per
+    retained signature — success caches the dict, failure caches a marker —
+    so repeated ``program_report(analyze=True)`` / ``perf_report()`` calls
+    never re-lower (``_capture_structs`` drops both memos when a new
+    signature compiles; the ``program_analyses`` counter counts the lowers
+    actually performed). Any failure (no recorded signature, a backend
+    without analysis support) reports None rather than raising."""
     if exe.analysis is not None:
         return exe.analysis
-    if exe.arg_structs is None:
+    if exe.arg_structs is None or exe.analysis_failed:
         return None
+    _stats["program_analyses"] += 1
     try:
         state_s, args_s, kwargs_s = exe.arg_structs
         compiled = exe.plain.lower(state_s, *args_s, **kwargs_s).compile()
@@ -506,33 +613,227 @@ def _analyze(exe: Executable) -> Optional[Dict[str, Any]]:
             "peak_bytes": arg_b + out_b + tmp_b,
         }
     except Exception:  # noqa: BLE001 — a report must never raise
+        exe.analysis_failed = True  # memoized: no re-lower per report call
         return None
     return exe.analysis
+
+
+# ----------------------------------------------------------- roofline ledger
+#: Utilization floor below which a probed program is considered bound by
+#: dispatch/launch latency rather than by either machine roof (neither the
+#: compute nor the memory roofline explains where the wall went).
+_DISPATCH_BOUND_UTILIZATION = 0.05
+#: Share of the device-inclusive wall the async host dispatch must reach for
+#: a program to classify host-bound (the time is python/dispatch on the
+#: host, not the device at all).
+_HOST_BOUND_SHARE = 0.6
+
+_roofline_peaks: Optional[Dict[str, Any]] = None
+
+
+def roofline_peaks() -> Dict[str, Any]:
+    """The machine roofline this process classifies against: peak FLOP/s
+    (one jitted f32 matmul chain, best-of) and peak bytes/s (one jitted
+    streaming add over a 32 MiB buffer), calibrated ONCE per process and
+    cached (~tens of ms, paid on the first ``analyze=True`` report — never
+    on a dispatch path). ``ridge_flops_per_byte`` is their quotient: the
+    arithmetic intensity where the two roofs cross. ``calibrated=False``
+    rows fall back to host/dispatch-only classification. Override with
+    :func:`set_roofline_peaks` (pinned CI machines, known hardware specs)."""
+    global _roofline_peaks
+    if _roofline_peaks is not None:
+        return _roofline_peaks
+    peaks: Dict[str, Any] = {
+        "peak_flops_per_s": 0.0,
+        "peak_bytes_per_s": 0.0,
+        "ridge_flops_per_byte": 0.0,
+        "calibrated": False,
+    }
+    try:
+        import jax.numpy as jnp
+
+        n, reps = 384, 4
+        a = jnp.ones((n, n), jnp.float32)
+        matmul = jax.jit(lambda x: x @ x)
+        jax.block_until_ready(matmul(a))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = a
+            for _ in range(reps):
+                out = matmul(out)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        peak_flops = (2.0 * n * n * n * reps) / best if best > 0 else 0.0
+
+        m = 8 * 1024 * 1024  # 32 MiB of f32: reads + writes = 64 MiB moved
+        x = jnp.ones((m,), jnp.float32)
+        stream = jax.jit(lambda v: v + 1.0)
+        jax.block_until_ready(stream(x))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = stream(x)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        peak_bytes = (2.0 * 4 * m) / best if best > 0 else 0.0
+        if peak_flops > 0 and peak_bytes > 0:
+            peaks = {
+                "peak_flops_per_s": peak_flops,
+                "peak_bytes_per_s": peak_bytes,
+                "ridge_flops_per_byte": peak_flops / peak_bytes,
+                "calibrated": True,
+            }
+    except Exception:  # noqa: BLE001 — a report must never raise
+        pass
+    _roofline_peaks = peaks
+    return peaks
+
+
+def set_roofline_peaks(
+    flops_per_s: Optional[float] = None, bytes_per_s: Optional[float] = None
+) -> None:
+    """Pin the machine roofline instead of calibrating (both None drops the
+    cache so the next report re-calibrates)."""
+    global _roofline_peaks
+    if flops_per_s is None and bytes_per_s is None:
+        _roofline_peaks = None
+        return
+    f = float(flops_per_s or 0.0)
+    b = float(bytes_per_s or 0.0)
+    _roofline_peaks = {
+        "peak_flops_per_s": f,
+        "peak_bytes_per_s": b,
+        "ridge_flops_per_byte": (f / b) if b > 0 else 0.0,
+        "calibrated": bool(f > 0 and b > 0),
+    }
+
+
+def _roofline_row(
+    analysis: Optional[Dict[str, Any]],
+    device: Optional[Dict[str, Any]],
+    host_mean_s: float,
+    peaks: Dict[str, Any],
+) -> Dict[str, Any]:
+    """Join one program's XLA cost analysis with its probed device-time
+    percentiles into achieved rates and a bound classification.
+
+    Classification (documented in docs/performance.md "Where the time
+    goes"): ``unprobed`` with no device samples; ``host-bound`` when the
+    async host dispatch wall is ≥ ``_HOST_BOUND_SHARE`` of the
+    device-inclusive p50 (the time never reaches the device); else, against
+    the calibrated machine roofline, ``dispatch-bound`` when neither
+    utilization clears ``_DISPATCH_BOUND_UTILIZATION`` (the wall is launch /
+    roundtrip latency); else ``compute-bound`` / ``memory-bound`` by which
+    utilization is higher."""
+    row: Dict[str, Any] = {
+        "bound": "unprobed",
+        "device_p50_s": 0.0,
+        "host_dispatch_mean_s": round(host_mean_s, 9),
+        "host_share": 0.0,
+        "achieved_flops_per_s": 0.0,
+        "achieved_bytes_per_s": 0.0,
+        "arithmetic_intensity": 0.0,
+        "compute_utilization": 0.0,
+        "memory_utilization": 0.0,
+        "probes": 0,
+    }
+    if not device or not device.get("count"):
+        return row
+    p50 = float(device.get("p50_s", 0.0)) or (
+        float(device.get("sum_s", 0.0)) / max(1, int(device.get("count", 0)))
+    )
+    if p50 <= 0:
+        return row
+    row["probes"] = int(device["count"])
+    row["device_p50_s"] = round(p50, 9)
+    flops = float((analysis or {}).get("flops", 0.0) or 0.0)
+    nbytes = float((analysis or {}).get("bytes_accessed", 0.0) or 0.0)
+    row["achieved_flops_per_s"] = flops / p50
+    row["achieved_bytes_per_s"] = nbytes / p50
+    row["arithmetic_intensity"] = (flops / nbytes) if nbytes > 0 else 0.0
+    host_share = min(1.0, host_mean_s / p50) if host_mean_s > 0 else 0.0
+    row["host_share"] = round(host_share, 4)
+    if host_share >= _HOST_BOUND_SHARE:
+        row["bound"] = "host-bound"
+        return row
+    if peaks.get("calibrated"):
+        u_c = row["achieved_flops_per_s"] / peaks["peak_flops_per_s"]
+        u_m = row["achieved_bytes_per_s"] / peaks["peak_bytes_per_s"]
+        row["compute_utilization"] = round(u_c, 6)
+        row["memory_utilization"] = round(u_m, 6)
+        if max(u_c, u_m) < _DISPATCH_BOUND_UTILIZATION:
+            row["bound"] = "dispatch-bound"
+        elif u_c >= u_m:
+            row["bound"] = "compute-bound"
+        else:
+            row["bound"] = "memory-bound"
+    else:
+        # no machine roofline (calibration failed): fall back to the only
+        # evidence left — a program with no analyzed work is dispatch-bound,
+        # one-sided analysis decides directly, and a mixed program compares
+        # its arithmetic intensity against a generic ~4 flops/byte ridge
+        # (an uncalibrated peaks dict carries ridge 0.0, which must not win)
+        if flops == 0 and nbytes == 0:
+            row["bound"] = "dispatch-bound"
+        elif nbytes == 0:
+            row["bound"] = "compute-bound"
+        elif flops == 0:
+            row["bound"] = "memory-bound"
+        else:
+            ridge = peaks.get("ridge_flops_per_byte") or 4.0
+            row["bound"] = (
+                "compute-bound" if row["arithmetic_intensity"] >= ridge else "memory-bound"
+            )
+    return row
 
 
 def program_report(analyze: bool = True) -> List[Dict[str, Any]]:
     """The program ledger: one row per cached executable — kind, cache-key
     digest, acquisition ``hits``, ``donated_runs`` / ``plain_runs``, compile
-    events and their total wall seconds, compiled aval signatures, and (with
-    ``analyze=True``) the XLA ``cost_analysis`` / ``memory_analysis`` facts:
-    FLOPs, bytes accessed, argument/output/temp bytes and the peak live
-    footprint. Analysis is computed lazily (an AOT re-lower per program,
-    cached) — pass ``analyze=False`` for a counters-only report with zero
-    compile cost. Joined into :func:`metrics_tpu.ops.telemetry.export_trace`
-    under ``programLedger``."""
+    events and their total wall seconds, compiled aval signatures, the
+    accumulated async host dispatch wall, and the probed device-time block
+    (``device``: count + percentiles from the ``device-dispatch:<program>``
+    histogram family, when probes are armed). With ``analyze=True`` each row
+    also carries the XLA ``cost_analysis`` / ``memory_analysis`` facts
+    (FLOPs, bytes accessed, argument/output/temp bytes, peak live footprint
+    — memoized per retained signature, see :func:`_analyze`) and the
+    ``roofline`` join: achieved FLOP/s, achieved bytes/s, arithmetic
+    intensity and a bound classification (compute- / memory- / dispatch- /
+    host-bound) against the calibrated machine peaks
+    (:func:`roofline_peaks`). Pass ``analyze=False`` for a counters-only
+    report with zero compile/calibration cost. Joined into
+    :func:`metrics_tpu.ops.telemetry.export_trace` under ``programLedger``."""
+    device_stats = _telemetry.device_dispatch_stats()
+    peaks = roofline_peaks() if (analyze and device_stats) else {
+        "calibrated": False, "ridge_flops_per_byte": 0.0,
+    }
     rows: List[Dict[str, Any]] = []
     for exe in _PROGRAM_CACHE.values():
+        runs = exe.donated_runs + exe.plain_runs
+        device = device_stats.get(exe.probe_key)
         row: Dict[str, Any] = {
             "kind": exe.kind,
             "key": exe.key_digest,
+            "program": exe.probe_key,
             "hits": exe.hits,
             "donated_runs": exe.donated_runs,
             "plain_runs": exe.plain_runs,
             "compiles": exe.compiles,
             "compile_time_s": round(exe.compile_time_s, 6),
             "compiled_signatures": exe.compiled_signatures(),
+            "dispatch_time_s": round(exe.dispatch_time_s, 6),
+            "device": device,
         }
-        row["analysis"] = _analyze(exe) if analyze else None
+        analysis = _analyze(exe) if analyze else None
+        row["analysis"] = analysis
+        if analyze:
+            # dispatch_time_s accumulates only on non-compile dispatches, so
+            # the mean must divide by the same population (a compile run in
+            # the denominator would dilute host_share and skew the bound)
+            dispatch_runs = max(0, runs - exe.compiles)
+            host_mean = exe.dispatch_time_s / dispatch_runs if dispatch_runs else 0.0
+            row["roofline"] = _roofline_row(analysis, device, host_mean, peaks)
         rows.append(row)
     rows.sort(key=lambda r: r["compile_time_s"], reverse=True)
     return rows
@@ -575,6 +876,8 @@ def _zero_engine_counters() -> None:
     _stats["deferred_steps"] = 0
     _stats["deferred_flushes"] = 0
     _stats["deferred_fallbacks"] = 0
+    _stats["device_probes"] = 0
+    _stats["program_analyses"] = 0
 
 
 _telemetry.register_reset("engine", _zero_engine_counters)

@@ -51,6 +51,7 @@ from metrics_tpu.ops import telemetry as _telemetry
 __all__ = [
     "FLEET_SCHEMA",
     "export_fleet_trace",
+    "fleet_perf_report",
     "fleet_prometheus_text",
     "fleet_snapshot",
     "fleet_stats",
@@ -684,6 +685,77 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
         )
     )
     return "\n".join(lines) + "\n"
+
+
+def fleet_perf_report() -> Dict[str, Any]:
+    """The cross-rank face of :func:`metrics_tpu.ops.perf.perf_report`:
+    every rank's step-latency decomposition merged into one fleet view.
+
+    In a multi-rank world each rank's locally-computed report rides ONE
+    epoch-fenced, deadline-guarded blob gather (:func:`_gather_blobs`) — a
+    **collective**, every live rank in lockstep, like ``fleet_snapshot()``.
+    With a world size of 1 the local report is served directly, zero
+    collectives. Keys: per-rank ``reports`` (corrupt rows get a
+    ``{"corrupt": True}`` placeholder), ``aggregate_phases`` — per-phase
+    exclusive seconds SUMMED EXACTLY across live ranks (phase time is a
+    duration counter over each rank's window, so the sum is the fleet's
+    total attributed wall), and ``slowest_rank_per_phase`` — the rank
+    spending the most wall in each phase, the per-phase twin of the
+    straggler report.
+
+    Example:
+        >>> from metrics_tpu import fleet_perf_report
+        >>> report = fleet_perf_report()   # single process: local only
+        >>> report["gathered"], report["rank"] in report["reports"]
+        (False, True)
+    """
+    from metrics_tpu.ops import perf as _perf
+    from metrics_tpu.parallel import sync as _sync
+
+    local = _perf.perf_report()
+    wh = _sync.world_health()
+    world = fleet_world()
+    rank = local_rank()
+    dead = set(wh.get("dead_ranks") or ())
+    reports: Dict[int, Dict[str, Any]] = {}
+    gathered = False
+    if world > 1:
+        blob = json.dumps(_telemetry._json_safe(local), separators=(",", ":")).encode("utf-8")
+        payloads = _gather_blobs(blob, site="fleet-snapshot")
+        for r, raw in zip(_participant_ranks(world, dead), payloads):
+            try:
+                decoded = json.loads(raw.decode("utf-8"))
+                if not isinstance(decoded, dict):
+                    raise ValueError("rank report must be an object")
+                reports[r] = decoded
+            except (ValueError, UnicodeDecodeError):
+                reports[r] = {"corrupt": True, "rank": r}
+        gathered = True
+    else:
+        reports[rank] = local
+    agg: Dict[str, float] = {p: 0.0 for p in _perf.PHASES}
+    slowest: Dict[str, Tuple[int, float]] = {}
+    for r, rep in sorted(reports.items()):
+        if not _is_live_plane(rep):
+            continue
+        for p, block in (rep.get("phases") or {}).items():
+            t = float((block or {}).get("total_s", 0.0))
+            if p not in agg:
+                continue  # unknown phase (mixed-version fleet): neither table
+            agg[p] += t
+            if t > 0 and (p not in slowest or t > slowest[p][1]):
+                slowest[p] = (r, t)
+    return {
+        "fleet_schema": FLEET_SCHEMA,
+        "world_size": world,
+        "rank": rank,
+        "gathered": gathered,
+        "reports": reports,
+        "aggregate_phases": {p: round(v, 6) for p, v in agg.items()},
+        "slowest_rank_per_phase": {
+            p: {"rank": r, "total_s": round(t, 6)} for p, (r, t) in sorted(slowest.items())
+        },
+    }
 
 
 # ----------------------------------------------------------- merged trace

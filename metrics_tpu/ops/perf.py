@@ -1,0 +1,363 @@
+"""Performance attribution: the step-latency decomposition over the span
+ring, the roofline ledger join, and ranked optimization opportunities.
+
+The flight recorder answers "what happened"; the latency histograms answer
+"how slow"; this module answers **"where does the time actually go"** — the
+question every open perf item (async pipelined sync, AOT cold-start caching,
+in-graph state) must answer before and after its change. Three layers:
+
+- **Interval-exclusive phase decomposition** — every timed span in the ring
+  is attributed to exactly ONE phase by a nesting scan over the recorded
+  ``(t_start, dur)`` intervals: a child span's duration is subtracted from
+  its nearest enclosing ancestor, so summing phases never double-counts
+  (an ``engine-dispatch`` nested in an ``engine-flush`` nested in a
+  ``suite-step`` contributes once, to ``dispatch``). Phases:
+
+  ========== =====================================================
+  phase       exclusive time of
+  ========== =====================================================
+  enqueue     ``suite-step`` spans (validation + queue append — the
+              per-call python cost left after nested spans are removed)
+  flush       ``engine-flush`` (stack/bucket/host-stage overhead)
+  trace       ``engine-build`` (program construction closures)
+  compile     ``engine-compile`` (first-call trace+XLA wall)
+  dispatch    ``engine-dispatch`` (ASYNC host wall — tagged
+              ``async_host_wall``; under-measures device)
+  device      ``device-dispatch`` probes' excess over their host
+              dispatch sibling (the measured device-only wall; only
+              probed dispatches add real wall)
+  pack        ``sync-pack`` (tree walk + bitcast-concat program)
+  serialize   ``sync-metadata`` (dyn-shape / cross-check exchanges)
+  wire        ``sync-payload-gather`` + per-state ``sync-gather`` (the
+              blocking collective itself; its effective bytes/s comes
+              from the spans' byte attrs — the share the 69 ms sync
+              wall actually spends on the wire)
+  unpack      ``sync-unpack`` (slice/bitcast/reduce programs)
+  orchestrate ``suite-sync`` residual (member walk, eligibility,
+              snapshot bookkeeping around the sync phases)
+  host        every other timed span (journal saves, fleet gathers,
+              observation-time work outside the suite parents)
+  ========== =====================================================
+
+- **Reconciliation** — the phase sum equals the top-level span wall by
+  construction; against an EXTERNALLY measured wall (pass
+  ``measured_wall_s``) the coverage states how much of real time the spans
+  explain. The certification drives a live suite loop and requires
+  coverage within :data:`TOLERANCE`.
+
+- **Roofline + opportunities** — ``engine.program_report()``'s per-program
+  roofline join (probed device p50 x XLA cost analysis -> achieved FLOP/s,
+  achieved bytes/s, bound classification) rides along under ``programs``,
+  and ``opportunities`` ranks the heaviest phases with the evidence for
+  each (bytes over the wire at the effective bandwidth, compile events and
+  their wall, dispatch counts) — the queryable answer to "what should the
+  next perf PR attack".
+
+``fleet_perf_report()`` (``ops/fleetobs.py``) merges every rank's report;
+``tools/trace_report.py --perf`` renders the same decomposition offline
+from an exported trace file. See docs/performance.md "Where the time goes".
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.ops import telemetry as _telemetry
+
+__all__ = [
+    "PHASES",
+    "SITE_PHASES",
+    "TOLERANCE",
+    "perf_report",
+    "perf_stats",
+    "phase_columns",
+    "reset_perf_stats",
+]
+
+#: Reconciliation tolerance: phases must cover the measured wall within
+#: this relative share (the certification pins it over a live suite loop).
+TOLERANCE = 0.15
+
+#: Span site -> phase. Sites absent here fold into the ``host`` phase.
+SITE_PHASES = {
+    "suite-step": "enqueue",
+    "engine-flush": "flush",
+    "engine-build": "trace",
+    "engine-compile": "compile",
+    "engine-dispatch": "dispatch",
+    "device-dispatch": "device",
+    "suite-sync": "orchestrate",
+    "sync-pack": "pack",
+    "sync-metadata": "serialize",
+    "sync-payload-gather": "wire",
+    "sync-gather": "wire",
+    "sync-unpack": "unpack",
+}
+
+#: Every phase, in report order. ``step`` phases then ``sync`` phases then
+#: the catch-all.
+PHASES = (
+    "enqueue", "flush", "trace", "compile", "dispatch", "device",
+    "pack", "serialize", "wire", "unpack", "orchestrate", "host",
+)
+
+_STEP_PHASES = ("enqueue", "flush", "trace", "compile", "dispatch", "device")
+_SYNC_PHASES = ("pack", "serialize", "wire", "unpack", "orchestrate")
+
+_counters: Dict[str, int] = {"perf_reports": 0}
+
+
+def perf_stats() -> Dict[str, int]:
+    return dict(_counters)
+
+
+def reset_perf_stats() -> None:
+    for key in _counters:
+        _counters[key] = 0
+
+
+_telemetry.register_reset("perf", reset_perf_stats)
+
+
+def _exclusive_spans(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Attribute every timed span its EXCLUSIVE duration (own wall minus the
+    wall of spans nested inside it) via one stack scan over the interval
+    tree. Spans are emitted single-threaded, so intervals either nest or
+    are disjoint; ties at the same start (a probed ``device-dispatch`` and
+    its ``engine-dispatch`` sibling share ``t_start``) order the longer
+    interval as the parent."""
+    timed = [r for r in rows if (r.get("dur") or 0.0) > 0.0]
+    timed.sort(key=lambda r: (r["t_start"], -(r["t_start"] + r["dur"])))
+    eps = 1e-9
+    stack: List[Tuple[float, Dict[str, Any]]] = []
+    out: List[Dict[str, Any]] = []
+    for r in timed:
+        start = float(r["t_start"])
+        dur = float(r["dur"])
+        while stack and start >= stack[-1][0] - eps:
+            stack.pop()
+        rec = {
+            "site": r.get("site"),
+            "dur": dur,
+            "attrs": r.get("attrs") or {},
+            "child_s": 0.0,
+            "parent": stack[-1][1]["site"] if stack else None,
+            "top": not stack,
+        }
+        if stack:
+            stack[-1][1]["child_s"] += dur
+        out.append(rec)
+        stack.append((start + dur, rec))
+    for rec in out:
+        rec["exclusive_s"] = max(0.0, rec["dur"] - rec["child_s"])
+    return out
+
+
+def _phase_of(site: Any) -> str:
+    return SITE_PHASES.get(site, "host")
+
+
+def phase_columns(
+    before: Dict[str, Dict[str, Any]], after: Dict[str, Dict[str, Any]]
+) -> Dict[str, float]:
+    """Per-phase total milliseconds between two ``telemetry.latency_stats()``
+    snapshots — the cheap windowed phase columns ``tools/bench_sweep.py``
+    archives per row and ``tools/sweep_regress.py --explain`` consumes.
+    INCLUSIVE sums (no interval data in a histogram): a flush's nested
+    dispatches count in both ``flush`` and ``dispatch`` — consistent across
+    artifacts, which is all a round-over-round delta needs."""
+    out: Dict[str, float] = {}
+    for site, block in after.items():
+        if site.startswith(_telemetry._DEVICE_HIST_SITE + ":"):
+            continue  # per-program families: the aggregate site carries them
+        prev = float((before.get(site) or {}).get("sum_s", 0.0))
+        delta = float(block.get("sum_s", 0.0)) - prev
+        if delta > 0:
+            phase = _phase_of(site)
+            out[phase] = out.get(phase, 0.0) + delta * 1000.0
+    return {k: round(v, 4) for k, v in sorted(out.items())}
+
+
+def _wire_evidence(recs: List[Dict[str, Any]], wire_s: float, sync_wall_s: float) -> Dict[str, Any]:
+    nbytes = 0
+    collectives = 0
+    for rec in recs:
+        if _phase_of(rec["site"]) == "wire":
+            collectives += 1
+            nbytes += int(rec["attrs"].get("bytes", 0) or 0)
+    return {
+        "bytes_gathered": nbytes,
+        "collectives": collectives,
+        "effective_bytes_per_s": (nbytes / wire_s) if wire_s > 0 else 0.0,
+        "wire_share_of_sync": (wire_s / sync_wall_s) if sync_wall_s > 0 else 0.0,
+    }
+
+
+def _reconcile(attributed_s: float, measured_s: float) -> Dict[str, Any]:
+    coverage = (attributed_s / measured_s) if measured_s > 0 else 0.0
+    return {
+        "attributed_s": round(attributed_s, 6),
+        "measured_wall_s": round(measured_s, 6),
+        "coverage": round(coverage, 4),
+        "tolerance": TOLERANCE,
+        "within_tolerance": measured_s > 0 and abs(coverage - 1.0) <= TOLERANCE,
+    }
+
+
+def _opportunity(phase: str, block: Dict[str, Any], report: Dict[str, Any]) -> str:
+    """One evidence sentence per ranked phase (the 'why' next to the
+    'where') — each names the roadmap lever that attacks it."""
+    total_ms = block["total_s"] * 1e3
+    n = block["spans"]
+    if phase == "wire":
+        w = report["sync"]["wire"]
+        mbps = w["effective_bytes_per_s"] / 1e6
+        return (
+            f"{w['bytes_gathered']} B over {w['collectives']} collective(s) at "
+            f"{mbps:.1f} MB/s effective — overlap the gather (async sync futures) "
+            "or shrink the payload (quantized lanes), ROADMAP #3"
+        )
+    if phase == "compile":
+        return (
+            f"{n} compile event(s), {total_ms:.1f} ms — AOT precompile + a "
+            "persistent cross-process program cache removes this from steady "
+            "state, ROADMAP #4"
+        )
+    if phase == "dispatch":
+        mean_us = (block["total_s"] / n * 1e6) if n else 0.0
+        return (
+            f"{n} program dispatch(es), mean {mean_us:.1f} us host wall — raise "
+            "the deferral window or arena-batch same-config suites, ROADMAP #2"
+        )
+    if phase == "device":
+        worst = ""
+        for row in report.get("programs") or ():
+            rl = row.get("roofline") or {}
+            if rl.get("bound") in ("compute-bound", "memory-bound"):
+                worst = (
+                    f"; heaviest: {row.get('program')} {rl['bound']} at "
+                    f"{rl['achieved_flops_per_s'] / 1e9:.2f} GFLOP/s"
+                )
+                break
+        return f"{n} probed dispatch(es), {total_ms:.1f} ms device-only wall{worst}"
+    if phase == "enqueue":
+        return (
+            f"{n} suite step(s), {total_ms:.1f} ms host enqueue/validation — "
+            "moving the step in-graph (state-as-pytree core) removes the "
+            "per-call python entirely, ROADMAP #1"
+        )
+    if phase in ("pack", "unpack", "serialize"):
+        return f"{n} span(s), {total_ms:.1f} ms {phase} work around the collective"
+    if phase == "orchestrate":
+        return f"{total_ms:.1f} ms suite-sync residual (member walk + eligibility)"
+    if phase == "flush":
+        return f"{n} flush(es), {total_ms:.1f} ms stacking/bucketing beyond the programs dispatched"
+    return f"{n} span(s), {total_ms:.1f} ms"
+
+
+def perf_report(
+    measured_wall_s: Optional[float] = None,
+    top: int = 5,
+) -> Dict[str, Any]:
+    """The step-latency decomposition: where the time in the current span
+    ring actually went, reconciled and ranked.
+
+    ``measured_wall_s`` (optional) is an externally measured end-to-end wall
+    for the same window (e.g. ``perf_counter`` around the driven loop after
+    ``clear_spans()``); the top-level reconciliation then states how much of
+    REAL time the spans explain — the certification requires coverage
+    within :data:`TOLERANCE` over a live suite loop. Without it, the
+    reconciliation is against the top-level span wall (coverage 1.0 by
+    construction — phase exactness, not coverage, is the claim). The scan
+    reads THIS process's span ring; cross-rank views go through
+    ``fleet_perf_report()`` (per-rank reports merged — never one scan over
+    clock-skewed multi-rank rings) or ``trace_report.py --perf`` (per-pid
+    scans over an exported trace).
+
+    Returns a schema-stable dict: ``phases`` (every phase's exclusive
+    seconds + span count), ``step`` / ``sync`` sub-blocks with their own
+    walls and reconciliations (sync carries the ``wire`` evidence:
+    bytes gathered, effective bytes/s, wire share), ``programs`` (the
+    roofline ledger join from ``engine.program_report``), ``device_probe``
+    (sampling state), and ``opportunities`` — the top-``top`` phases by
+    exclusive time, each with its evidence sentence.
+
+    Example:
+        >>> from metrics_tpu import perf_report
+        >>> report = perf_report()
+        >>> report["perf_schema"]
+        1
+        >>> sorted(report["phases"]) == sorted(PHASES)
+        True
+        >>> 0.0 <= report["sync"]["wire"]["wire_share_of_sync"] <= 1.0
+        True
+    """
+    from metrics_tpu.ops import engine as _engine
+
+    _counters["perf_reports"] += 1
+    recs = _exclusive_spans(_telemetry.spans())
+    phases: Dict[str, Dict[str, Any]] = {
+        p: {"total_s": 0.0, "spans": 0} for p in PHASES
+    }
+    top_level_s = 0.0
+    step_wall_s = 0.0
+    sync_wall_s = 0.0
+    for rec in recs:
+        block = phases[_phase_of(rec["site"])]
+        block["total_s"] += rec["exclusive_s"]
+        block["spans"] += 1
+        if rec["top"]:
+            top_level_s += rec["dur"]
+            if rec["site"] == "suite-sync":
+                sync_wall_s += rec["dur"]
+            else:
+                step_wall_s += rec["dur"]
+
+    stats = _engine.engine_stats()
+    step_attr = sum(phases[p]["total_s"] for p in _STEP_PHASES)
+    sync_attr = sum(phases[p]["total_s"] for p in _SYNC_PHASES)
+    wire_s = phases["wire"]["total_s"]
+
+    report: Dict[str, Any] = {
+        "perf_schema": 1,
+        "spans_decomposed": len(recs),
+        "phases": {
+            p: {"total_s": round(b["total_s"], 6), "spans": b["spans"]}
+            for p, b in phases.items()
+        },
+        "step": {
+            "measured_wall_s": round(step_wall_s, 6),
+            "steps": phases["enqueue"]["spans"],
+            "phases": {p: round(phases[p]["total_s"], 6) for p in _STEP_PHASES},
+        },
+        "sync": {
+            "measured_wall_s": round(sync_wall_s, 6),
+            "syncs": phases["orchestrate"]["spans"],
+            "phases": {p: round(phases[p]["total_s"], 6) for p in _SYNC_PHASES},
+            "wire": _wire_evidence(recs, wire_s, sync_wall_s),
+            "reconciliation": _reconcile(sync_attr, sync_wall_s),
+        },
+        "reconciliation": _reconcile(
+            sum(b["total_s"] for b in phases.values()),
+            top_level_s if measured_wall_s is None else float(measured_wall_s),
+        ),
+        "device_probe": {
+            "every": _engine.device_probe_every(),
+            "probes": stats.get("device_probes", 0),
+        },
+        "programs": _engine.program_report(analyze=True),
+    }
+    ranked = sorted(
+        ((p, b) for p, b in phases.items() if b["total_s"] > 0),
+        key=lambda kv: -kv[1]["total_s"],
+    )
+    total = sum(b["total_s"] for b in phases.values()) or 1.0
+    report["opportunities"] = [
+        {
+            "phase": p,
+            "total_s": round(b["total_s"], 6),
+            "share": round(b["total_s"] / total, 4),
+            "evidence": _opportunity(p, b, report),
+        }
+        for p, b in ranked[: max(1, top)]
+    ]
+    return report

@@ -71,8 +71,10 @@ __all__ = [
     "SYNC_PHASE_SITES",
     "armed",
     "clear_spans",
+    "device_dispatch_stats",
     "emit",
     "export_trace",
+    "observe_device_dispatch",
     "is_counter_key",
     "is_histogram_sample_key",
     "latency_stats",
@@ -99,7 +101,11 @@ SPAN_SITES = {
     "engine-flush": "a pending queue flushed as stacked scan program(s)",
     "engine-build": "a program-cache miss traced a new program (build closure)",
     "engine-compile": "a dispatch compiled a new aval signature (trace+compile+run wall)",
-    "engine-dispatch": "one cached-program execution (dispatch wall; completion is async)",
+    "engine-dispatch": "one cached-program execution (ASYNC host wall: the span ends "
+    "when XLA accepts the dispatch, not when the device finishes — it "
+    "under-measures device time; see device-dispatch)",
+    "device-dispatch": "one sampled DEVICE-INCLUSIVE dispatch wall: a probed "
+    "execution forced with block_until_ready (METRICS_TPU_DEVICE_PROBE_EVERY)",
     "host-lane": "one host fast-lane update (list append tier, instant)",
     # sync (parallel/sync.py + parallel/bucketing.py)
     "sync-pack": "coalesced pack: tree walk + bitcast-concat program",
@@ -125,6 +131,7 @@ SPAN_SITES = {
     "journal-load": "one record verified + restored",
     "journal-demote": "a journal generation failed verification (instant)",
     # suite (collections.py)
+    "suite-step": "one whole-suite update/forward call (enqueue + any nested flush)",
     "suite-sync": "one whole-suite sync (coalesced + individual members)",
     # fleet plane (ops/fleetobs.py)
     "fleet-gather": "one fleet metadata/blob exchange (length + padded payload)",
@@ -246,6 +253,17 @@ _HIST_SNAPSHOT_KEY = "latency_stats"
 #: round-trip), then ``+Inf``. Order IS the cumulative exposition order.
 _HIST_LABELS = tuple(repr(b) for b in _HIST_BOUNDS_S) + ("+Inf",)
 _N_BUCKETS = len(_HIST_BOUNDS_S) + 1
+
+#: The per-PROGRAM device-time histogram family prefix: every sampled
+#: device-inclusive dispatch (``METRICS_TPU_DEVICE_PROBE_EVERY``) lands both
+#: in the aggregate ``device-dispatch`` site histogram and in a per-program
+#: site named ``device-dispatch:<program>`` (program = the executable's kind
+#: plus its cache-key digest), so :func:`latency_stats` / the fleet merge /
+#: the exposition carry per-program device percentiles on the SAME bucket
+#: layout. Kept a PURE literal so ``tools/invlint/registry.py`` extracts it
+#: statically (INV303 pins that the derived sample keys classify as
+#: counters and that the prefix stays label-safe).
+_DEVICE_HIST_SITE = "device-dispatch"
 
 
 def _bucket_quantile(counts: List[int], total: int, q: float, max_s: float) -> float:
@@ -498,6 +516,43 @@ def emit(
             _note_slo_violation(site, owner, dur, limit)
 
 
+def observe_device_dispatch(program: str, t_start: float, dur_s: float) -> None:
+    """Land one PROBED, device-inclusive dispatch wall (``engine``'s sampled
+    ``block_until_ready`` path). Two observations from one measurement:
+
+    - a timed ``device-dispatch`` span (aggregate site histogram + trace
+      slice + SLO budget, via :func:`emit` — distinct from the async
+      host-wall ``engine-dispatch`` span, which starts at the same instant
+      but ends when XLA *accepts* the dispatch);
+    - the per-program full-lifetime family ``device-dispatch:<program>``
+      (:data:`_DEVICE_HIST_SITE`), the probed-latency plane
+      ``engine.program_report()`` joins with XLA cost analysis into the
+      roofline ledger.
+
+    Callers guard with ``if telemetry.armed:`` like every other emit site.
+    """
+    emit(_DEVICE_HIST_SITE, program, "engine", t_start, dur_s, {"program": program})
+    site = _DEVICE_HIST_SITE + ":" + program
+    h = _site_hists.get(site)
+    if h is None:  # one cold allocation per program, never on later probes
+        h = _site_hists.setdefault(site, LatencyHistogram())
+    h.observe(dur_s)
+
+
+def device_dispatch_stats() -> Dict[str, Dict[str, Any]]:
+    """The per-program probed device-time plane: ``{program: stats block}``
+    for every ``device-dispatch:<program>`` family with at least one probe
+    (same block schema as :func:`latency_stats` sites)."""
+    prefix = _DEVICE_HIST_SITE + ":"
+    out: Dict[str, Dict[str, Any]] = {}
+    for site in sorted(_site_hists):
+        if site.startswith(prefix):
+            h = _site_hists[site]
+            if h.max_s > 0.0:
+                out[site[len(prefix):]] = h.stats()
+    return out
+
+
 _SPAN_KEYS = ("step", "owner", "lane", "site", "t_start", "dur", "attrs")
 
 
@@ -743,6 +798,9 @@ def _flat_numeric(prefix: str, value: Any) -> Iterator[Tuple[str, float]]:
 _COUNTER_PREFIXES = (
     "builds", "hits", "deferred_", "fault_", "sync_", "journal_", "fleet_",
     "latency_", "slo_", "spans_recorded", "spans_dropped", "monotonic_step",
+    # the performance-attribution plane: device-probe events, memoized
+    # program cost-analysis lowers, perf-report invocations — all monotonic
+    "device_", "program_", "perf_",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —

@@ -1,0 +1,12 @@
+"""Known-bad fixture for the ISSUE-12 performance-attribution carve-outs:
+probe/analysis counters must carry a counter prefix (INV301), and the
+per-program device-histogram site prefix must stay label-safe (INV303)."""
+
+# untyped: neither a counter prefix nor a declared gauge carve-out — the
+# probe counter would scrape as a gauge and the fleet merge would
+# min/median/max it instead of summing
+_stats = {"probe_block_walls": 0}  # expect: INV301
+
+# a quote inside the site prefix would corrupt every le-labelled exposition
+# line the per-program families render into
+_DEVICE_HIST_SITE = 'device "dispatch"'  # expect: INV303

@@ -1,0 +1,10 @@
+"""Known-good fixture for the ISSUE-12 performance-attribution carve-outs:
+the probe / analysis / report counters all classify as counters under the
+``device_`` / ``program_`` / ``perf_`` prefixes, and the device-histogram
+site prefix is label-safe. Zero findings."""
+
+_stats = {"device_probes": 0, "program_analyses": 0}
+
+_counters = {"perf_reports": 0}
+
+_DEVICE_HIST_SITE = "device-dispatch"

@@ -1,0 +1,218 @@
+"""Device-time probes: the ISSUE-12 sampled ``block_until_ready`` path.
+
+Contracts (`metrics_tpu/ops/engine.py` + `ops/telemetry.py`):
+
+- **Bit-exact** — probing only *observes* (a forced wait on the output);
+  a probed loop's results equal an unprobed loop's exactly, including
+  through the deferral queue.
+- **Disarmed allocates nothing** — ``METRICS_TPU_DEVICE_PROBE_EVERY``
+  unset/0 (the default) leaves the counter at zero and creates no
+  per-program histogram families; a garbage value warns once NAMING the
+  offending value and stays disarmed.
+- **Sampling** — ``EVERY=N`` probes every Nth non-compile dispatch
+  globally; compile events are never probed (their wall is trace+XLA, not
+  device execution).
+- **Composes with deferral** — a probed flush forces the WHOLE stacked
+  chunk and counts as ONE probe per chunk program dispatched, never one
+  per enqueued step.
+- **The plane lands where the roofline reads it** — probes fill the
+  aggregate ``device-dispatch`` site histogram, the per-program
+  ``device-dispatch:<program>`` families (``device_dispatch_stats``), and
+  ``program_report`` rows join them under ``device`` / ``roofline``.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, telemetry
+
+RNG = np.random.RandomState(11)
+
+
+def _batch(n=32):
+    return (
+        jnp.asarray(RNG.rand(n).astype(np.float32)),
+        jnp.asarray(RNG.randint(0, 2, n)),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _probe_isolation():
+    """Probes off on entry and exit (re-armed per test), recorder armed,
+    latency plane isolated."""
+    was = telemetry.armed
+    telemetry.set_telemetry(True)
+    telemetry.clear_spans()
+    telemetry.reset_latency()
+    engine.set_device_probe(0)
+    yield
+    engine.set_device_probe(None)
+    telemetry.set_telemetry(was)
+    telemetry.clear_spans()
+    telemetry.reset_latency()
+
+
+def _drive(metric, batches, probe_every):
+    engine.set_device_probe(probe_every)
+    for b in batches:
+        metric.update(*b)
+    value = metric.compute()
+    engine.set_device_probe(0)
+    return value
+
+
+def test_probed_dispatch_is_bit_exact_vs_unprobed():
+    batches = [_batch() for _ in range(9)]
+    engine.set_deferred_dispatch(True)
+    unprobed = _drive(mt.Accuracy(), batches, 0)
+    probed = _drive(mt.Accuracy(), batches, 1)
+    np.testing.assert_array_equal(np.asarray(unprobed), np.asarray(probed))
+    assert engine.engine_stats()["device_probes"] > 0
+
+
+def test_unset_allocates_nothing_and_counts_nothing():
+    probes_before = engine.engine_stats()["device_probes"]
+    metric = mt.Accuracy()
+    for _ in range(5):
+        metric.update(*_batch())
+    metric.compute()
+    assert engine.engine_stats()["device_probes"] == probes_before
+    assert telemetry.device_dispatch_stats() == {}
+    assert not any(
+        site.startswith(telemetry._DEVICE_HIST_SITE)
+        for site in telemetry.latency_stats()
+    )
+
+
+def test_garbage_env_warns_once_naming_value(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_DEVICE_PROBE_EVERY", "banana")
+    engine.set_device_probe(None)  # drop the cache so the env is re-read
+    engine.reset_stats(reset_warnings=True)
+    with pytest.warns(UserWarning, match="banana"):
+        assert engine.device_probe_every() == 0
+    # warn-once: the cached parse re-serves without a second warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert engine.device_probe_every() == 0
+
+
+def test_probe_sampling_period_counts_every_nth_dispatch():
+    exe = engine.acquire_keyed(
+        ("probe-period-test",), lambda: (lambda s: s + 1, None, {}), donate=False
+    )
+    x = jnp.zeros((), jnp.float32)
+    exe.run(x, donate=False)  # compile event: never probed
+    engine.set_device_probe(3)
+    before = engine.engine_stats()["device_probes"]
+    for _ in range(9):
+        exe.run(x, donate=False)
+    assert engine.engine_stats()["device_probes"] - before == 3
+    block = telemetry.device_dispatch_stats()[exe.probe_key]
+    assert block["count"] == 3 and block["sum_s"] > 0
+
+
+def test_compile_events_are_never_probed():
+    engine.set_device_probe(1)
+    exe = engine.acquire_keyed(
+        ("probe-compile-test",), lambda: (lambda s: s * 2, None, {}), donate=False
+    )
+    exe.run(jnp.zeros((4,), jnp.float32), donate=False)  # compile
+    exe.run(jnp.zeros((8,), jnp.float32), donate=False)  # new aval: compile
+    assert exe.compiles == 2
+    assert exe.probe_key not in telemetry.device_dispatch_stats()
+    exe.run(jnp.zeros((8,), jnp.float32), donate=False)  # cached: probed
+    assert telemetry.device_dispatch_stats()[exe.probe_key]["count"] == 1
+
+
+def test_probed_flush_forces_whole_chunk_counted_once():
+    """8 enqueued steps flush as ONE stacked chunk program: with EVERY=1 the
+    probe blocks the whole chunk and counts once per chunk DISPATCH, never
+    per step — and the flushed value is bit-exact vs the unprobed queue."""
+    engine.set_deferred_dispatch(True)
+    batches = [_batch() for _ in range(8)]
+
+    def run(probe_every):
+        metric = mt.Accuracy()
+        metric.update(*batches[0])  # eager first sight (validated)
+        # warm the chunk program (the queue below re-hits this exact shape)
+        for b in batches[1:]:
+            metric.update(*b)
+        jax.block_until_ready(metric.metric_state)
+        metric.reset()
+        metric.update(*batches[0])
+        jax.block_until_ready(metric.metric_state)
+        engine.set_device_probe(probe_every)
+        before = engine.engine_stats()["device_probes"]
+        dispatch_spans_before = sum(
+            1 for s in telemetry.spans() if s["site"] == "engine-dispatch"
+        )
+        for b in batches[1:]:
+            metric.update(*b)  # 7 enqueues, zero dispatches
+        assert engine.engine_stats()["device_probes"] == before, (
+            "enqueues must not probe — nothing dispatched yet"
+        )
+        value = metric.compute()  # observation: the flush dispatches chunks
+        engine.set_device_probe(0)
+        probes = engine.engine_stats()["device_probes"] - before
+        dispatches = (
+            sum(1 for s in telemetry.spans() if s["site"] == "engine-dispatch")
+            - dispatch_spans_before
+        )
+        return value, probes, dispatches
+
+    unprobed_value, zero_probes, _ = run(0)
+    assert zero_probes == 0
+    probed_value, probes, dispatches = run(1)
+    np.testing.assert_array_equal(np.asarray(unprobed_value), np.asarray(probed_value))
+    assert probes >= 1, "a probed flush must land at least one device sample"
+    # one probe per PROGRAM DISPatch in the flush (EVERY=1 probes each
+    # non-compile dispatch; compile dispatches carry no probe), never one
+    # per enqueued step
+    assert probes <= dispatches + 1 < len(batches), (probes, dispatches)
+
+
+def test_program_report_joins_probes_into_roofline():
+    engine.set_deferred_dispatch(True)
+    batches = [_batch() for _ in range(6)]
+    _drive(mt.MeanMetric(), batches, 0)  # warmup: compiles
+    _drive(mt.MeanMetric(), batches, 1)  # probed pass over cached programs
+    rows = engine.program_report(analyze=True)
+    probed = [r for r in rows if (r.get("device") or {}).get("count")]
+    assert probed, "no ledger row carries a probed device block"
+    for row in probed:
+        rl = row["roofline"]
+        assert rl["probes"] == row["device"]["count"]
+        assert rl["bound"] in (
+            "compute-bound", "memory-bound", "dispatch-bound", "host-bound"
+        )
+        assert rl["device_p50_s"] > 0
+    # achieved FLOP/s nonzero wherever the cost analysis reports arithmetic
+    for row in probed:
+        flops = float((row.get("analysis") or {}).get("flops", 0.0) or 0.0)
+        if flops > 0:
+            assert row["roofline"]["achieved_flops_per_s"] > 0
+
+
+def test_analysis_memoized_per_signature():
+    """program_report(analyze=True) twice must lower each program at most
+    once (the program_analyses counter counts actual lowers) — the roofline
+    join stays cheap enough for perf_report() to call per invocation."""
+    exe = engine.acquire_keyed(
+        ("probe-memo-test",), lambda: (lambda s: s + 1, None, {}), donate=False
+    )
+    exe.run(jnp.zeros((), jnp.float32), donate=False)
+    engine.program_report(analyze=True)
+    analyses_after_first = engine.engine_stats()["program_analyses"]
+    engine.program_report(analyze=True)
+    engine.program_report(analyze=True)
+    assert engine.engine_stats()["program_analyses"] == analyses_after_first
+    # a NEW compiled signature invalidates the memo: exactly one more lower
+    exe.run(jnp.zeros((2,), jnp.float32), donate=False)
+    engine.program_report(analyze=True)
+    assert engine.engine_stats()["program_analyses"] > analyses_after_first

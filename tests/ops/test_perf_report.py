@@ -1,0 +1,231 @@
+"""Performance attribution plane: the ISSUE-12 perf_report contracts.
+
+Contracts (`metrics_tpu/ops/perf.py` + `ops/fleetobs.fleet_perf_report`):
+
+- **Exclusive decomposition** — the interval nesting scan attributes every
+  timed span exactly once: phase totals sum to the top-level span wall, a
+  dispatch nested in a flush nested in a suite-step counts only under
+  ``dispatch``, and a probed device span's excess over its host sibling is
+  the ``device`` phase.
+- **Reconciliation** — against an externally measured wall over a driven
+  suite loop, coverage sits within the stated tolerance.
+- **Sync decomposition** — pack/serialize/wire/unpack itemize the
+  suite-sync span, with the wire block carrying gathered bytes and the
+  effective bandwidth.
+- **Opportunities** — ranked worst-first with per-phase evidence.
+- **Fleet merge** — ``fleet_perf_report()`` at world size 1 serves the
+  local report with ZERO collectives; the aggregate sums phase seconds
+  exactly across hand-fed rank reports.
+- **suite-step span** — every MetricCollection update/forward emits one.
+"""
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, fleetobs, perf, telemetry
+
+RNG = np.random.RandomState(23)
+DIST_ON = lambda: True  # noqa: E731
+
+
+def _batch(n=32):
+    return (
+        jnp.asarray(RNG.rand(n).astype(np.float32)),
+        jnp.asarray(RNG.randint(0, 2, n)),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _armed_and_clean():
+    was = telemetry.armed
+    telemetry.set_telemetry(True)
+    telemetry.clear_spans()
+    telemetry.reset_latency()
+    yield
+    engine.set_device_probe(None)
+    telemetry.set_telemetry(was)
+    telemetry.clear_spans()
+    telemetry.reset_latency()
+
+
+# ------------------------------------------------- the exclusive interval scan
+def test_exclusive_spans_subtract_nested_children():
+    rows = [
+        {"site": "suite-step", "t_start": 0.0, "dur": 1.0, "attrs": None},
+        {"site": "engine-flush", "t_start": 0.1, "dur": 0.6, "attrs": None},
+        {"site": "engine-dispatch", "t_start": 0.2, "dur": 0.2, "attrs": None},
+        {"site": "journal-save", "t_start": 2.0, "dur": 0.5, "attrs": None},
+    ]
+    recs = {r["site"]: r for r in perf._exclusive_spans(rows)}
+    assert recs["suite-step"]["top"] and recs["suite-step"]["exclusive_s"] == pytest.approx(0.4)
+    assert recs["engine-flush"]["parent"] == "suite-step"
+    assert recs["engine-flush"]["exclusive_s"] == pytest.approx(0.4)
+    assert recs["engine-dispatch"]["parent"] == "engine-flush"
+    assert recs["engine-dispatch"]["exclusive_s"] == pytest.approx(0.2)
+    assert recs["journal-save"]["top"] and recs["journal-save"]["exclusive_s"] == pytest.approx(0.5)
+    # phase totals == top-level wall: nothing double-counted, nothing lost
+    total = sum(r["exclusive_s"] for r in recs.values())
+    assert total == pytest.approx(1.0 + 0.5)
+
+
+def test_device_span_excess_over_host_sibling_is_device_phase():
+    # a probed dispatch emits BOTH spans from the same t_start: the host
+    # async wall (shorter) and the device-inclusive wall (longer); the
+    # exclusive scan must make the host span the child of the device span
+    rows = [
+        {"site": "device-dispatch", "t_start": 0.0, "dur": 0.010, "attrs": None},
+        {"site": "engine-dispatch", "t_start": 0.0, "dur": 0.002, "attrs": None},
+    ]
+    recs = {r["site"]: r for r in perf._exclusive_spans(rows)}
+    assert recs["engine-dispatch"]["parent"] == "device-dispatch"
+    assert recs["device-dispatch"]["exclusive_s"] == pytest.approx(0.008)
+    assert recs["engine-dispatch"]["exclusive_s"] == pytest.approx(0.002)
+
+
+# --------------------------------------------------------- the live report
+def _drive_suite(steps=10):
+    engine.set_deferred_dispatch(True)
+    suite = mt.MetricCollection({"mean": mt.MeanMetric(), "acc": mt.Accuracy()})
+    b = _batch()
+    # warmup: two full cycles so the measured window is steady state
+    for _ in range(2):
+        for _ in range(steps):
+            suite.update(*b)
+        suite.sync(distributed_available=DIST_ON)
+        suite.unsync()
+    telemetry.clear_spans()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        suite.update(*b)
+    suite.sync(distributed_available=DIST_ON)
+    suite.unsync()
+    return suite, time.perf_counter() - t0
+
+
+def test_perf_report_reconciles_against_measured_wall():
+    engine.set_device_probe(1)
+    _, wall = _drive_suite()
+    report = mt.perf_report(measured_wall_s=wall)
+    recon = report["reconciliation"]
+    assert recon["within_tolerance"], recon
+    assert recon["attributed_s"] <= recon["measured_wall_s"] * (1 + 1e-6)
+    assert sorted(report["phases"]) == sorted(perf.PHASES)
+    assert report["step"]["steps"] == 10
+    assert report["device_probe"]["every"] == 1
+    assert report["device_probe"]["probes"] > 0
+
+
+def test_sync_decomposition_itemizes_the_suite_sync_span():
+    _, _ = _drive_suite()
+    report = mt.perf_report()
+    sync = report["sync"]
+    assert sync["syncs"] == 1
+    assert sync["reconciliation"]["within_tolerance"], sync["reconciliation"]
+    assert sync["phases"]["wire"] > 0 and sync["phases"]["pack"] > 0
+    wire = sync["wire"]
+    assert wire["bytes_gathered"] > 0
+    assert wire["effective_bytes_per_s"] > 0
+    assert 0.0 < wire["wire_share_of_sync"] <= 1.0
+
+
+def test_opportunities_ranked_worst_first_with_evidence():
+    _, _ = _drive_suite()
+    report = mt.perf_report(top=4)
+    opps = report["opportunities"]
+    assert 1 <= len(opps) <= 4
+    totals = [o["total_s"] for o in opps]
+    assert totals == sorted(totals, reverse=True)
+    for o in opps:
+        assert o["phase"] in perf.PHASES
+        assert o["evidence"] and isinstance(o["evidence"], str)
+        assert 0.0 < o["share"] <= 1.0
+
+
+def test_suite_step_span_emitted_per_update_and_forward():
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    b = _batch()
+    telemetry.clear_spans()
+    suite.update(*b)
+    suite(*b)
+    apis = [
+        (s["attrs"] or {}).get("api")
+        for s in telemetry.spans()
+        if s["site"] == "suite-step"
+    ]
+    assert apis.count("update") == 1 and apis.count("forward") == 1
+
+
+def test_phase_columns_between_latency_snapshots():
+    before = telemetry.latency_stats()
+    _, _ = _drive_suite(steps=6)
+    cols = perf.phase_columns(before, telemetry.latency_stats())
+    assert cols.get("wire", 0) > 0 and cols.get("enqueue", 0) > 0
+    # per-program device families are excluded (the aggregate site carries
+    # them); every column is a known phase
+    assert set(cols) <= set(perf.PHASES)
+
+
+def test_perf_reports_counter_on_reset_registry():
+    before = perf.perf_stats()["perf_reports"]
+    mt.perf_report()
+    assert perf.perf_stats()["perf_reports"] == before + 1
+    engine.reset_stats()
+    assert perf.perf_stats()["perf_reports"] == 0
+
+
+# ------------------------------------------------------------- fleet merge
+def test_fleet_perf_report_world_one_zero_collectives():
+    from metrics_tpu.parallel import sync as psync
+
+    _drive_suite(steps=4)
+    gathers_before = fleetobs.fleet_stats()["fleet_gathers"]
+    collectives_before = psync.collective_stats()["sync_collectives_issued"]
+    report = mt.fleet_perf_report()
+    assert report["gathered"] is False
+    assert report["rank"] in report["reports"]
+    assert fleetobs.fleet_stats()["fleet_gathers"] == gathers_before
+    assert psync.collective_stats()["sync_collectives_issued"] == collectives_before
+    # the local report travels whole: aggregate == the one rank's phases
+    local = report["reports"][report["rank"]]
+    for p, total in report["aggregate_phases"].items():
+        assert total == pytest.approx(local["phases"][p]["total_s"], abs=1e-9)
+
+
+def test_fleet_perf_report_merge_sums_phases_exactly(monkeypatch):
+    import json as _json
+
+    from metrics_tpu.parallel import sync as psync
+
+    _drive_suite(steps=4)
+
+    def fake_gather(blob, *, owner=None, site="fleet-gather"):
+        doc = _json.loads(blob.decode("utf-8"))
+        rows = [blob]
+        for scale in (2.0, 3.0):
+            d = _json.loads(blob.decode("utf-8"))
+            for p in d["phases"]:
+                d["phases"][p]["total_s"] = doc["phases"][p]["total_s"] * scale
+            rows.append(_json.dumps(d).encode("utf-8"))
+        rows.append(b"not json")  # a corrupt row must placeholder, not crash
+        return rows
+
+    monkeypatch.setattr(fleetobs, "_gather_blobs", fake_gather)
+    psync.set_expected_world(4)
+    try:
+        report = mt.fleet_perf_report()
+    finally:
+        psync.reset_membership()
+    assert report["gathered"] and report["world_size"] == 4
+    assert report["reports"][3].get("corrupt") is True
+    local = report["reports"][0]
+    for p, total in report["aggregate_phases"].items():
+        oracle = local["phases"][p]["total_s"] * (1.0 + 2.0 + 3.0)
+        assert total == pytest.approx(oracle, rel=1e-6, abs=1e-9), p
+    # the slowest rank per phase is the 3x clone wherever there is any time
+    for p, row in report["slowest_rank_per_phase"].items():
+        assert row["rank"] == 2, (p, row)

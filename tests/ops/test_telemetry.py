@@ -381,21 +381,29 @@ def test_program_report_ledger():
 
 # ------------------------------------------------------------- disarmed path
 def test_disarmed_emits_nothing_and_allocates_nothing(tmp_path):
-    suite = _suite()
+    suite = _suite()  # constructed armed: its first update emits (suite-step)
+    telemetry.clear_spans()
     telemetry.set_telemetry(False)
     telemetry.reset_latency()
     before = telemetry.telemetry_stats()
+    probes_before = engine.engine_stats()["device_probes"]
     ring_id = id(telemetry._ring)
     # the histogram plane too: same preallocated dict object, same site
     # count, same (all-zero) per-site counts lists after the loop
     hists_id = id(telemetry._site_hists)
     n_sites = len(telemetry._site_hists)
-    for _ in range(4):
-        suite.update(*_batch())
-    suite.sync(distributed_available=DIST_ON)
-    suite.unsync()
-    suite.compute()
-    suite.save_state(str(tmp_path / "j"))
+    # device probes ride the ARMED dispatch branch: with the recorder off,
+    # even an aggressive EVERY=1 must neither block nor count nor allocate
+    engine.set_device_probe(1)
+    try:
+        for _ in range(4):
+            suite.update(*_batch())
+        suite.sync(distributed_available=DIST_ON)
+        suite.unsync()
+        suite.compute()
+        suite.save_state(str(tmp_path / "j"))
+    finally:
+        engine.set_device_probe(None)
     after = telemetry.telemetry_stats()
     assert after["spans_recorded"] == before["spans_recorded"]
     assert after["spans_retained"] == before["spans_retained"] == 0
@@ -403,6 +411,8 @@ def test_disarmed_emits_nothing_and_allocates_nothing(tmp_path):
     assert after["telemetry_armed"] is False
     assert telemetry.latency_stats() == {}, "a disarmed recorder fed the histograms"
     assert id(telemetry._site_hists) == hists_id and len(telemetry._site_hists) == n_sites
+    assert engine.engine_stats()["device_probes"] == probes_before
+    assert telemetry.device_dispatch_stats() == {}
 
 
 def test_span_ring_bounded():

@@ -59,6 +59,7 @@ class TestFixturePairs:
         assert BAD_FIXTURES == [
             "collective_bad.py",
             "hist_bad.py",
+            "perfkeys_bad.py",
             "retry_bad.py",
             "taxonomy_bad.py",
             "telemetry_bad.py",
@@ -184,6 +185,9 @@ class TestRegistry:
             "monotonic_step", "spans_retained", "world_size", "builds", "hits",
             "latency_stats_suite-sync_count", "latency_stats_suite-sync_p99_s",
             "slo_violations_total",
+            # ISSUE-12 carve-outs: probe / analysis / report counters
+            "device_probes", "program_analyses", "perf_reports",
+            "programs_count",  # the ledger summary block stays a gauge
         ]
         for key in keys:
             assert registry.is_counter_key(key) == telemetry.is_counter_key(key), key
@@ -209,6 +213,15 @@ class TestRegistry:
         # every histogram SAMPLE must also be a counter — the fleet-merge
         # exactness contract INV303 pins statically
         assert telemetry.is_counter_key("latency_stats_suite-sync_buckets_+Inf")
+
+    def test_device_dispatch_site_matches_package(self):
+        from metrics_tpu.ops import telemetry
+
+        assert registry.device_dispatch_site() == telemetry._DEVICE_HIST_SITE
+        # the per-PROGRAM family keys are histogram samples (and counters)
+        # just like the aggregate-site keys — the fleet merge sums them
+        key = f"latency_stats_{telemetry._DEVICE_HIST_SITE}:metric-update:1a2b3c4d_count"
+        assert registry.is_histogram_sample_key(key) and telemetry.is_counter_key(key)
 
 
 class TestSeededViolation:

@@ -1,0 +1,99 @@
+"""Sweep regression gate: the ``--explain`` attribution contract (ISSUE 12).
+
+A synthetically perturbed artifact — one row whose p50 gate fails because
+the archived ``compile`` phase column exploded — must be attributed to that
+phase by name; rows without phase columns must say so instead of guessing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.sweep_regress import compare, explain  # noqa: E402
+
+
+def _row(name, p50, phases=None, ratio=10.0):
+    row = {
+        "metric": name,
+        "mode": "deferred",
+        "updates_per_s": 100.0,
+        "vs_baseline": ratio,
+        "latency_ms": {"p50": p50, "p95": p50 * 1.5, "p99": p50 * 2.0},
+    }
+    if phases is not None:
+        row["phases_ms"] = phases
+    return row
+
+
+OLD = {
+    "rows": [
+        _row("Accuracy", 1.0, {"enqueue": 2.0, "flush": 10.0, "compile": 0.0, "wire": 5.0}),
+        _row("MeanMetric", 1.0),  # no phase columns archived
+        _row("F1Score", 1.0, {"flush": 8.0, "dispatch": 1.0}),
+    ]
+}
+NEW = {
+    "rows": [
+        # p50 blew past the 3x gate; the compile phase is what moved
+        _row("Accuracy", 9.0, {"enqueue": 2.1, "flush": 11.0, "compile": 812.0, "wire": 5.2}),
+        _row("MeanMetric", 9.0),
+        _row("F1Score", 1.1, {"flush": 8.2, "dispatch": 1.0}),  # healthy
+    ]
+}
+
+
+def test_explain_names_the_regressed_phase():
+    problems = compare(OLD, NEW)
+    assert any(p.startswith("Accuracy:") for p in problems)
+    lines = explain(OLD, NEW, problems)
+    acc = [ln for ln in lines if ln.startswith("Accuracy:")]
+    assert len(acc) == 1
+    assert "regressed phase: compile" in acc[0]
+    assert "0.000->812.000" in acc[0]
+    # the healthy row is not attributed at all
+    assert not any(ln.startswith("F1Score:") for ln in lines)
+
+
+def test_explain_reports_missing_phase_columns_explicitly():
+    problems = compare(OLD, NEW)
+    lines = explain(OLD, NEW, problems)
+    mean = [ln for ln in lines if ln.startswith("MeanMetric:")]
+    assert len(mean) == 1 and "no archived phase columns" in mean[0]
+
+
+def test_cli_explain_prints_attribution_and_exits_one(tmp_path):
+    a, b = tmp_path / "old.json", tmp_path / "new.json"
+    a.write_text(json.dumps(OLD))
+    b.write_text(json.dumps(NEW))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sweep_regress.py"),
+         "--explain", str(a), str(b)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "attribution (--explain)" in r.stdout
+    assert "regressed phase: compile" in r.stdout
+    # without the flag the attribution section stays out
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sweep_regress.py"),
+         str(a), str(b)],
+        capture_output=True, text=True,
+    )
+    assert r2.returncode == 1
+    assert "attribution" not in r2.stdout
+
+
+def test_sync_rows_use_coalesced_phase_spelling():
+    old = {"rows": [dict(_row("suite_sync(coalesced)", 1.0),
+                         coalesced_phases_ms={"wire": 30.0, "pack": 2.0})]}
+    new = {"rows": [dict(_row("suite_sync(coalesced)", 9.0),
+                         coalesced_phases_ms={"wire": 300.0, "pack": 2.1})]}
+    problems = compare(old, new)
+    lines = explain(old, new, problems)
+    assert lines and "regressed phase: wire" in lines[0]

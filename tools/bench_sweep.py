@@ -683,6 +683,10 @@ def main() -> None:
             for _ in range(DEFERRED_STEPS):
                 metric.update(*jdata)
             jax.block_until_ready(metric.metric_state)  # observation: flush
+            from metrics_tpu.ops import perf as _perf
+            from metrics_tpu.ops import telemetry as _phase_telemetry
+
+            lat0 = _phase_telemetry.latency_stats()
             best = float("inf")
             for _ in range(TRIALS):
                 metric.reset()
@@ -691,6 +695,11 @@ def main() -> None:
                     metric.update(*jdata)
                 jax.block_until_ready(metric.metric_state)
                 best = min(best, time.perf_counter() - start)
+            # archived phase columns (ISSUE 12): per-phase milliseconds the
+            # timed trials spent, recorded from the telemetry latency plane —
+            # what tools/sweep_regress.py --explain attributes a future
+            # regression to (flush stall vs compile-in-loop vs dispatch)
+            phases_ms = _perf.phase_columns(lat0, _phase_telemetry.latency_stats())
             metric.reset()
             latency = _latency_ms(
                 lambda: metric.update(*jdata),
@@ -703,6 +712,7 @@ def main() -> None:
                 "updates_per_s": round(DEFERRED_STEPS / best, 1),
                 "samples_per_s": round(DEFERRED_STEPS * samples / best, 1),
                 "latency_ms": latency,
+                "phases_ms": phases_ms,
             }
             floor_s = _shaped_floor_ms(metric, DEFERRED_STEPS)
             if floor_s > 0:
@@ -773,7 +783,11 @@ def main() -> None:
             coll.sync(distributed_available=dist_on)  # warmup: programs compile
             coll.unsync()
             n_syncs = max(3, STEPS // 5)
+            from metrics_tpu.ops import perf as _sync_perf
+            from metrics_tpu.ops import telemetry as _sync_telemetry
+
             s0 = _sync_engine.engine_stats()
+            lat0 = _sync_telemetry.latency_stats()
             best = float("inf")
             for _ in range(TRIALS):
                 start = time.perf_counter()
@@ -787,6 +801,10 @@ def main() -> None:
                 s1["sync_shape_collectives"] + s1["sync_payload_collectives"]
                 - s0["sync_shape_collectives"] - s0["sync_payload_collectives"]
             ) / (n_syncs * TRIALS)
+            # archived sync phase columns: pack/serialize/wire/unpack/
+            # orchestrate milliseconds over the timed cycles, what --explain
+            # names when a sync row's gate fails round over round
+            phases_ms = _sync_perf.phase_columns(lat0, _sync_telemetry.latency_stats())
             def _cycle():
                 coll.sync(distributed_available=dist_on)
                 coll.unsync()
@@ -800,6 +818,7 @@ def main() -> None:
                 "updates_per_s": round(n_syncs / best, 1),
                 "collectives_per_sync": round(per_sync, 2),
                 "latency_ms": latency,
+                "phases_ms": phases_ms,
             }
             results.append(row)
             print(json.dumps(row))

@@ -413,7 +413,15 @@ def check_telemetry_typing(mod: Module) -> List[Finding]:
 
 #: The latency-histogram layout literals (single-sourced in
 #: ``ops/telemetry.py``; any module declaring them is held to the contract).
-HIST_LAYOUT_NAMES = ("_HIST_BOUNDS_S", "_HIST_FAMILY", "_HIST_SNAPSHOT_KEY")
+HIST_LAYOUT_NAMES = (
+    "_HIST_BOUNDS_S", "_HIST_FAMILY", "_HIST_SNAPSHOT_KEY", "_DEVICE_HIST_SITE"
+)
+
+#: Alphabet for a histogram SITE prefix (``_DEVICE_HIST_SITE``): it travels
+#: as a Prometheus label VALUE and as a snapshot dict key, never as a family
+#: name — so ``-`` is fine, but quotes/braces/newlines would corrupt the
+#: exposition line and ``:`` is reserved as the per-program separator.
+SITE_PREFIX = re.compile(r"^[A-Za-z0-9_.-]+$")
 
 
 def check_histogram_typing(mod: Module) -> List[Finding]:
@@ -483,6 +491,19 @@ def check_histogram_typing(mod: Module) -> List[Finding]:
                     f"_HIST_FAMILY {fam!r} is not a valid Prometheus histogram family"
                     " stem (the renderer appends the reserved _bucket/_sum/_count"
                     " suffixes and the le label)",
+                )
+            )
+    if "_DEVICE_HIST_SITE" in decls:
+        node, site = decls["_DEVICE_HIST_SITE"]
+        if not isinstance(site, str) or not SITE_PREFIX.match(site):
+            findings.append(
+                mod.finding(
+                    node,
+                    "INV303",
+                    f"_DEVICE_HIST_SITE {site!r} is not a label-safe histogram site"
+                    " prefix (letters/digits/_/./- only; ':' is reserved for the"
+                    " per-program suffix) — a quote or brace would corrupt every"
+                    " le-labelled exposition line it reaches",
                 )
             )
     if "_HIST_SNAPSHOT_KEY" in decls:

@@ -122,6 +122,18 @@ def histogram_layout(root: str = ROOT) -> Tuple[Tuple[float, ...], str, str]:
     )
 
 
+@lru_cache(maxsize=8)
+def device_dispatch_site(root: str = ROOT) -> str:
+    """The per-program device-time family prefix (``telemetry.
+    _DEVICE_HIST_SITE``): probed dispatches land in latency-histogram sites
+    named ``<prefix>:<program>``, and INV303 holds the literal to the same
+    contract as the scalar family stem (label-safe, flattened samples
+    classifying as counters)."""
+    return str(
+        _module_literals(_TELEMETRY_SRC, ("_DEVICE_HIST_SITE",), root)["_DEVICE_HIST_SITE"]
+    )
+
+
 def is_histogram_sample_key(key: str, root: str = ROOT) -> bool:
     """``telemetry.is_histogram_sample_key``, recomputed from the extracted
     layout: a flattened bucket/count/sum sample under the snapshot key."""

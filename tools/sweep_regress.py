@@ -24,6 +24,16 @@ median-based number looks fine. Rows without percentiles fall back to the
     python tools/sweep_regress.py SWEEP_r04.json SWEEP_r05.json
     python tools/sweep_regress.py --threshold 2.5 old.json new.json
     python tools/sweep_regress.py --p50-threshold 2.0 --tail-threshold 3.0 old.json new.json
+    python tools/sweep_regress.py --explain old.json new.json
+
+**--explain** (ISSUE 12): when a gate fails AND both artifacts archived the
+per-row phase columns (``phases_ms`` — per-phase milliseconds recorded from
+the telemetry latency plane by ``tools/bench_sweep.py``), each failing row
+is additionally ATTRIBUTED: the phase whose absolute delta grew the most is
+named with its old -> new milliseconds, so "Accuracy got 4x slower" becomes
+"Accuracy got 4x slower *because the compile phase went from 0 to 800 ms*"
+— the regressed layer, not just the regressed number. Rows without
+archived phase columns say so rather than guessing.
 
 Exit 1 when any metric's ratio worsened by more than ``threshold``x, a p50
 latency worsened by more than ``p50-threshold``x, a p99/p50 tail ratio grew
@@ -90,6 +100,50 @@ def compare(
     return problems
 
 
+def _row_phases(row: dict) -> dict:
+    """The archived per-phase milliseconds of one sweep row (``phases_ms``;
+    the sync rows spell it ``coalesced_phases_ms``). Empty when the artifact
+    predates the phase columns."""
+    p = row.get("phases_ms") or row.get("coalesced_phases_ms") or {}
+    return {k: float(v) for k, v in p.items()} if isinstance(p, dict) else {}
+
+
+def explain(old: dict, new: dict, problems: list) -> list:
+    """Attribute each failing row to the phase whose delta moved: one line
+    per problem row naming the phase with the largest absolute millisecond
+    growth between the archived ``phases_ms`` columns (old -> new). Rows
+    without phase columns in BOTH artifacts report that explicitly."""
+    old_rows = {r["metric"]: r for r in old.get("rows", ()) if "metric" in r}
+    new_rows = {r["metric"]: r for r in new.get("rows", ()) if "metric" in r}
+    lines = []
+    for name in sorted({p.split(":", 1)[0] for p in problems}):
+        o, n = old_rows.get(name), new_rows.get(name)
+        if o is None or n is None:
+            continue
+        op, np_ = _row_phases(o), _row_phases(n)
+        if not op or not np_:
+            lines.append(
+                f"{name}: no archived phase columns to attribute "
+                "(re-record with tools/bench_sweep.py to enable --explain)"
+            )
+            continue
+        deltas = {p: np_.get(p, 0.0) - op.get(p, 0.0) for p in set(op) | set(np_)}
+        worst = max(deltas, key=lambda p: deltas[p])
+        if deltas[worst] <= 0:
+            lines.append(f"{name}: no phase grew (phase columns stable; the "
+                         "regression is outside the instrumented phases)")
+            continue
+        grew = sorted(
+            ((p, d) for p, d in deltas.items() if d > 0), key=lambda kv: -kv[1]
+        )
+        detail = ", ".join(f"{p} {op.get(p, 0.0):.3f}->{np_.get(p, 0.0):.3f} ms" for p, _ in grew[:3])
+        lines.append(
+            f"{name}: regressed phase: {worst} "
+            f"(+{deltas[worst]:.3f} ms; movers: {detail})"
+        )
+    return lines
+
+
 def _pop_flag(argv: list, flag: str, default: float):
     if flag not in argv:
         return argv, default, True
@@ -103,12 +157,16 @@ def _pop_flag(argv: list, flag: str, default: float):
 
 _USAGE = (
     "usage: sweep_regress.py [--threshold X] [--p50-threshold X] "
-    "[--tail-threshold X] OLD.json NEW.json"
+    "[--tail-threshold X] [--explain] OLD.json NEW.json"
 )
 
 
 def main(argv) -> int:
-    argv, threshold, ok1 = _pop_flag(list(argv), "--threshold", 5.0)
+    argv = list(argv)
+    do_explain = "--explain" in argv
+    if do_explain:
+        argv.remove("--explain")
+    argv, threshold, ok1 = _pop_flag(argv, "--threshold", 5.0)
     argv, p50_threshold, ok2 = _pop_flag(argv, "--p50-threshold", 3.0)
     argv, tail_threshold, ok3 = _pop_flag(argv, "--tail-threshold", 4.0)
     if not (ok1 and ok2 and ok3) or len(argv) != 2:
@@ -119,6 +177,11 @@ def main(argv) -> int:
     problems = compare(old, new, threshold, p50_threshold, tail_threshold)
     if problems:
         print("\n".join(problems))
+        if do_explain:
+            attributions = explain(old, new, problems)
+            if attributions:
+                print("\n-- attribution (--explain) --")
+                print("\n".join(attributions))
         print(f"\n{len(problems)} sweep regression(s) beyond the gates")
         return 1
     rows = [r for r in new["rows"] if "updates_per_s" in r]

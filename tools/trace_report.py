@@ -22,6 +22,10 @@ Modes::
 
     python tools/trace_report.py TRACE.json           # full report
     python tools/trace_report.py TRACE.json --check   # validate only (CI)
+    python tools/trace_report.py TRACE.json --perf    # step/sync phase
+                                                      # decomposition + the
+                                                      # roofline ledger (the
+                                                      # perf_report() twin)
     python tools/trace_report.py --smoke              # run a small suite with
                                                       # telemetry armed, export,
                                                       # validate, report
@@ -275,9 +279,20 @@ def summarize(doc: Dict[str, Any], top: int = 10) -> str:
     lines.append(f"== span sites by total time ({len(rows)} events) ==")
     for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1]))[:top]:
         total = sum(durs) / 1000.0
+        # the dispatch caveat belongs NEXT TO the number it qualifies: these
+        # spans end when XLA accepts the dispatch, not when the device
+        # finishes — reading them as device time is the misread the probed
+        # device-dispatch rows exist to correct
+        caveat = (
+            "  [async host wall — under-measures device; probe with "
+            "METRICS_TPU_DEVICE_PROBE_EVERY]"
+            if name == "engine-dispatch"
+            else ""
+        )
         lines.append(
             f"  {name:<22} n={len(durs):<6} total={total:9.3f} ms  "
             f"mean={total / len(durs):8.4f} ms  max={max(durs) / 1000.0:8.4f} ms"
+            + caveat
         )
     instants = defaultdict(int)
     for ev in rows:
@@ -363,6 +378,101 @@ def summarize(doc: Dict[str, Any], top: int = 10) -> str:
         )
         lines.append("\n== snapshot ==")
         lines.append("  " + "  ".join(f"{k}={snap.get(k)}" for k in keys if k in snap))
+    return "\n".join(lines)
+
+
+def perf_summary(doc: Dict[str, Any], top: int = 10) -> str:
+    """Render the ISSUE-12 step-latency decomposition from one exported
+    trace: the same interval-exclusive phase attribution ``perf_report()``
+    computes live, recomputed offline from the file's span events (one
+    decomposition per ``pid`` — a merged fleet trace reports per rank and
+    in aggregate), plus the sync wire evidence and the ledger's roofline
+    rows. Imports the in-package phase map so the offline and live
+    decompositions can never disagree."""
+    if _REPO_DIR not in sys.path:
+        sys.path.insert(0, _REPO_DIR)
+    from metrics_tpu.ops import perf as _perf
+
+    rows_by_pid: Dict[Any, List[Dict[str, Any]]] = defaultdict(list)
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        rows_by_pid[ev.get("pid", 0)].append(
+            {
+                "site": ev.get("name"),
+                "t_start": float(ev.get("ts", 0.0)) / 1e6,
+                "dur": float(ev.get("dur", 0.0)) / 1e6,
+                "attrs": ev.get("args") or {},
+            }
+        )
+    lines: List[str] = []
+    phase_totals: Dict[str, float] = {p: 0.0 for p in _perf.PHASES}
+    phase_counts: Dict[str, int] = {p: 0 for p in _perf.PHASES}
+    top_level_s = 0.0
+    sync_wall_s = 0.0
+    wire_bytes = 0
+    for pid in sorted(rows_by_pid):
+        for rec in _perf._exclusive_spans(rows_by_pid[pid]):
+            phase = _perf.SITE_PHASES.get(rec["site"], "host")
+            phase_totals[phase] += rec["exclusive_s"]
+            phase_counts[phase] += 1
+            if phase == "wire":
+                wire_bytes += int(rec["attrs"].get("bytes", 0) or 0)
+            if rec["top"]:
+                top_level_s += rec["dur"]
+                if rec["site"] == "suite-sync":
+                    sync_wall_s += rec["dur"]
+    total = sum(phase_totals.values())
+    n_ranks = len(rows_by_pid)
+    lines.append(
+        f"== step/sync phase decomposition ({n_ranks} rank(s), "
+        f"{sum(len(v) for v in rows_by_pid.values())} timed spans, "
+        f"{total * 1e3:.3f} ms attributed) =="
+    )
+    for phase in sorted(phase_totals, key=lambda p: -phase_totals[p]):
+        t = phase_totals[phase]
+        if t <= 0:
+            continue
+        share = t / total if total > 0 else 0.0
+        lines.append(
+            f"  {phase:<12} {t * 1e3:10.3f} ms  {share:6.1%}  "
+            f"(n={phase_counts[phase]})"
+        )
+    sync_attr = sum(
+        phase_totals[p] for p in ("pack", "serialize", "wire", "unpack", "orchestrate")
+    )
+    if sync_wall_s > 0:
+        wire_s = phase_totals["wire"]
+        bw = (wire_bytes / wire_s / 1e6) if wire_s > 0 else 0.0
+        lines.append(
+            f"  sync: wall={sync_wall_s * 1e3:.3f} ms attributed={sync_attr * 1e3:.3f} ms "
+            f"wire={wire_s * 1e3:.3f} ms ({wire_bytes} B @ {bw:.1f} MB/s effective, "
+            f"{wire_s / sync_wall_s:.1%} of sync)"
+        )
+    lines.append(
+        f"  reconciliation: attributed {total * 1e3:.3f} ms of "
+        f"{top_level_s * 1e3:.3f} ms top-level span wall"
+        + (f" ({total / top_level_s:.1%})" if top_level_s > 0 else "")
+    )
+
+    # ---- roofline ledger rows (from the embedded programLedger) ----
+    ledger = [r for r in (doc.get("programLedger") or []) if r.get("roofline")]
+    probed = [r for r in ledger if (r["roofline"].get("probes") or 0) > 0]
+    lines.append(f"\n== roofline ledger ({len(probed)} probed of {len(ledger)} programs) ==")
+    probed.sort(key=lambda r: -(r["roofline"].get("device_p50_s") or 0.0))
+    for row in probed[:top]:
+        rl = row["roofline"]
+        lines.append(
+            f"  {row.get('program', row.get('kind', '?')):<36} {rl['bound']:<15} "
+            f"p50={rl['device_p50_s'] * 1e3:8.4f} ms  "
+            f"{rl['achieved_flops_per_s'] / 1e9:8.3f} GFLOP/s  "
+            f"{rl['achieved_bytes_per_s'] / 1e9:8.3f} GB/s  AI={rl['arithmetic_intensity']:.2f}"
+        )
+    if not probed:
+        lines.append(
+            "  (no probed programs — arm METRICS_TPU_DEVICE_PROBE_EVERY to fill "
+            "the device plane)"
+        )
     return "\n".join(lines)
 
 
@@ -525,10 +635,13 @@ def run_fleet_smoke(out_path: str) -> str:
 
 def run_smoke(out_path: str) -> str:
     """The ``make trace`` driver: run a small 4-metric suite with telemetry
-    armed (deferred updates, one coalesced sync, a compute, one journal
-    snapshot), export the trace, and return its path."""
+    armed (deferred updates, device probes sampling, one coalesced sync, a
+    compute, one journal snapshot), assert the perf decomposition reconciles
+    against the measured loop wall, export the trace, and return its path."""
     if _REPO_DIR not in sys.path:
         sys.path.insert(0, _REPO_DIR)
+    import time as _time
+
     import numpy as np
     import jax.numpy as jnp
 
@@ -536,6 +649,7 @@ def run_smoke(out_path: str) -> str:
     from metrics_tpu.ops import engine, telemetry
 
     telemetry.set_telemetry(True)
+    engine.set_device_probe(2)  # sample the device plane through the smoke
     rng = np.random.RandomState(0)
     p = jnp.asarray(rng.rand(64).astype(np.float32))
     t = jnp.asarray(rng.randint(0, 2, 64))
@@ -547,13 +661,37 @@ def run_smoke(out_path: str) -> str:
             "mae": mt.MeanAbsoluteError(),
         }
     )
-    for _ in range(12):
+    try:
+        # warmup outside the measured window: first-sight validation + the
+        # sync programs compile here, so the measured loop is steady state
         suite.update(p, t)
-    suite.sync(distributed_available=lambda: True)
-    suite.unsync()
-    suite.compute()
-    suite.save_state(out_path + ".journal")
-    engine.export_trace(out_path)
+        suite.sync(distributed_available=lambda: True)
+        suite.unsync()
+        # ---- the measured perf window: spans must explain this wall ----
+        # (update + sync only — compute()'s per-member host math is eager
+        # jnp outside the engine, deliberately not a spanned phase)
+        telemetry.clear_spans()
+        t0 = _time.perf_counter()
+        for _ in range(12):
+            suite.update(p, t)
+        suite.sync(distributed_available=lambda: True)
+        suite.unsync()
+        wall = _time.perf_counter() - t0
+        suite.compute()
+        report = mt.perf_report(measured_wall_s=wall)
+        recon = report["reconciliation"]
+        assert recon["within_tolerance"], (
+            f"perf_report phases do not reconcile with the measured wall: {recon}"
+        )
+        assert report["sync"]["reconciliation"]["within_tolerance"], (
+            f"sync phase decomposition does not reconcile: {report['sync']}"
+        )
+        assert report["sync"]["wire"]["bytes_gathered"] > 0, report["sync"]["wire"]
+        assert report["opportunities"], "perf_report ranked no opportunities"
+        suite.save_state(out_path + ".journal")
+        engine.export_trace(out_path)
+    finally:
+        engine.set_device_probe(None)  # back to the env-driven default (off)
     # the latency digest must be present in the exported snapshot AND in the
     # report text — the `make trace` pin for the full-lifetime plane
     with open(out_path, encoding="utf-8") as fh:
@@ -562,6 +700,11 @@ def run_smoke(out_path: str) -> str:
     assert latency, "--smoke trace carries no latency digest (latency_stats empty)"
     assert "suite-sync" in latency, f"no suite-sync histogram in {sorted(latency)}"
     assert "latency digest" in summarize(doc), "report lost its latency-digest section"
+    # the --perf rendering must work offline from the exported file, with a
+    # populated decomposition and at least one probed roofline row
+    perf_text = perf_summary(doc)
+    assert "phase decomposition" in perf_text and "roofline ledger" in perf_text
+    assert "probed of" in perf_text and "(0 probed" not in perf_text, perf_text
     # the RENDERED exposition's histogram families must pass the same
     # validator (cumulative le monotone, +Inf == _count, _sum consistent)
     problems = check_histogram_exposition(mt.prometheus_text())
@@ -573,6 +716,12 @@ def main(argv: List[str]) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", nargs="?", help="path to an export_trace() JSON file")
     ap.add_argument("--check", action="store_true", help="validate only; exit non-zero on problems")
+    ap.add_argument(
+        "--perf",
+        action="store_true",
+        help="render the step/sync phase decomposition + roofline ledger "
+        "(perf_report()'s offline twin) instead of the standard report",
+    )
     ap.add_argument("--top", type=int, default=10, help="rows per summary table")
     ap.add_argument(
         "--smoke",
@@ -633,7 +782,9 @@ def main(argv: List[str]) -> int:
         return 1
     n_events = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
     print(f"trace OK: {path} ({n_events} events, {len(doc.get('programLedger') or [])} ledger rows)")
-    if not args.check:
+    if args.perf:
+        print(perf_summary(doc, top=args.top))
+    elif not args.check:
         print(summarize(doc, top=args.top))
     return 0
 
