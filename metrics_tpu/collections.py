@@ -1037,6 +1037,30 @@ class MetricCollection:
                 )
 
     def compute(self) -> Dict[str, Any]:
+        # compute() is the force point of an in-flight async suite sync:
+        # block (under the watchdog deadline), re-check the fence, apply —
+        # then every member computes presynced and the suite unsyncs, exactly
+        # like the blocking auto-sync cycle. A classified force failure rides
+        # the same degraded tier a blocking sync failure would.
+        pending = self.__dict__.get("_pending_sync")
+        if pending is not None:
+            pending_tier = _psync.sync_degraded_tier()
+            forced_async = False
+            try:
+                pending.wait()
+                _psync._bump("sync_async_auto_forces")
+                forced_async = True
+            except Exception as exc:  # noqa: BLE001 — degradable sync faults only
+                if pending_tier is None or not _degradable_sync_failure(exc):
+                    raise
+                _enter_degraded(self, exc, pending_tier)
+            if forced_async:
+                try:
+                    res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+                finally:
+                    self.unsync()
+                res = _flatten_dict(res)
+                return {self._set_name(k): v for k, v in res.items()}
         # suite-coalesced auto-sync: in a live multi-process world the whole
         # suite syncs as ONE packed collective up front, so every member's
         # compute sees itself presynced instead of issuing its own 2-per-state
@@ -1117,46 +1141,19 @@ class MetricCollection:
                 m._to_sync = flag
 
     # ------------------------------------------------------------------- sync
-    def sync(
-        self,
-        dist_sync_fn: Optional[Any] = None,
-        process_group: Optional[Any] = None,
-        should_sync: bool = True,
-        distributed_available: Optional[Any] = jit_distributed_available,
-    ) -> None:
-        """Sync every member across processes — the whole suite as ONE
-        coalesced payload collective where possible.
-
-        Every eligible member's state tree (including wrapper children) packs
-        into a single flat buffer; one shape/metadata exchange (skipped
-        entirely on the static fast lane) plus one payload ``process_allgather``
-        replaces the per-member, per-state 2-collective walk, and one
-        engine-cached jitted program unpacks and reduces everything (see
-        :mod:`metrics_tpu.parallel.bucketing`). Members are packed member-wise
-        (not leader-wise): the packed layout then depends only on the
-        constructed suite, never on the data-dependent compute-group merge,
-        so every process builds the identical layout. Ineligible members — a
-        custom ``dist_sync_fn``, un-coalescible states, a demoted
-        ``sync-pack`` lane, a divergent ``process_group`` — sync individually
-        through their own :meth:`Metric.sync`. A pack failure demotes the
-        suite's ``sync-pack`` ladder lane and replays member-wise (bit-exact);
-        any transport failure rolls back every already-synced member and
-        re-raises classified, so a failed suite sync leaves ALL local state
-        intact and retryable.
-        """
-        if not should_sync:
-            return
-        is_distributed = distributed_available() if callable(distributed_available) else None
-        if not is_distributed:
-            return
-        self._defer_barrier()
+    def _partition_sync_members(
+        self, dist_sync_fn: Optional[Any], process_group: Optional[Any]
+    ) -> Tuple[List[Tuple[str, Metric]], List[Tuple[Metric, List[Metric]]], List[Metric], Any]:
+        """The one eligibility walk both :meth:`sync` and :meth:`sync_async`
+        ride: every member is flushed/canonicalized and partitioned into the
+        suite-coalesced set (their trees pack into ONE payload collective)
+        and the individual set (custom gather, demoted lane, un-coalescible
+        states, divergent group — each syncs through its own
+        ``Metric.sync``). Returns ``(members, coalesced, individual,
+        anchor_group)``; raises when any member is already synced."""
         members = list(self.items(keep_base=True, copy_state=False))
         if any(m._is_synced for _, m in members):
             raise MetricsUserError("The Metric has already been synced.")
-        # suite-sync telemetry span: the parent slice the pack / metadata /
-        # payload-gather / unpack spans nest under on the trace timeline
-        t_suite = _telemetry.now() if _telemetry.armed else 0.0
-
         suite_lad = self.__dict__.get("_fault_ladders", {}).get("sync-pack")
         suite_ok = (
             dist_sync_fn is None
@@ -1196,6 +1193,221 @@ class MetricCollection:
                 coalesced.append((m, nodes))
             else:
                 individual.append(m)
+        return members, coalesced, individual, anchor_group
+
+    def sync_async(
+        self,
+        dist_sync_fn: Optional[Any] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Any] = jit_distributed_available,
+    ) -> Optional[Any]:
+        """Dispatch the whole suite's sync WITHOUT blocking: hide the wire.
+
+        The suite-coalesced members pack into ONE payload collective that
+        runs in flight on the dispatcher thread while the caller keeps
+        computing; ineligible members (custom gather, un-coalescible states,
+        a demoted lane, a divergent group) sync BLOCKING here — they cannot
+        ride the one in-flight buffer. Returns a
+        :class:`~metrics_tpu.parallel.sync.SyncFuture` (force with ``wait()``
+        or let :meth:`compute` auto-force), or ``None`` when there is nothing
+        to sync. The force re-checks the epoch fence, so an in-flight future
+        from a dead world classifies as ``EpochFault`` instead of pairing
+        stale rows; a force failure rolls every member back to intact,
+        retryable local state."""
+        if self.__dict__.get("_pending_sync") is not None:
+            raise MetricsUserError(
+                "A suite sync is already in flight; force it with wait() or"
+                " compute() before dispatching another."
+            )
+        if not should_sync:
+            return None
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not is_distributed:
+            return None
+        self._defer_barrier()
+        members, coalesced, individual, anchor_group = self._partition_sync_members(
+            dist_sync_fn, process_group
+        )
+
+        def _rollback() -> None:
+            for _, m in members:
+                if m._is_synced:
+                    try:
+                        m.unsync()
+                    except Exception:  # noqa: BLE001 — best-effort rollback
+                        pass
+
+        fallback_members: List[Metric] = []
+        try:
+            # ineligible members sync BLOCKING here — they cannot ride the
+            # one in-flight buffer. Note: like any blocking sync, updates to
+            # THESE members during the overlap window land on their merged
+            # state and restore away at unsync; the tail-preservation
+            # contract belongs to the coalesced (truly in-flight) members
+            for m in individual:
+                m.sync(
+                    dist_sync_fn=dist_sync_fn,
+                    process_group=process_group,
+                    should_sync=True,
+                    distributed_available=distributed_available,
+                )
+            if individual:
+                _psync._bump("sync_async_fallbacks")
+            all_nodes = [n for _, nodes in coalesced for n in nodes]
+            try:
+                disp = (
+                    _bucketing.dispatch_coalesced_sync(
+                        all_nodes,
+                        group=None if anchor_group is _UNSET_GROUP else anchor_group,
+                        owner=self,
+                    )
+                    if all_nodes
+                    else None
+                )
+            except _bucketing.CoalesceError as err:
+                # pack/program failure at dispatch: demote-and-replay
+                # member-wise blocking, exactly like the blocking suite sync
+                if not _bucketing.should_fallback(err):
+                    raise err.original from err
+                _bucketing.handle_coalesce_failure(
+                    self,
+                    [(n, n._state_snapshot()) for n in all_nodes],
+                    err,
+                    warn=(
+                        "Async coalesced suite sync failed at dispatch; replaying"
+                        " member-wise blocking syncs (bit-exact)."
+                    ),
+                )
+                fallback_members = [m for m, _nodes in coalesced]
+                for m in fallback_members:
+                    m.sync(
+                        dist_sync_fn=dist_sync_fn,
+                        process_group=process_group,
+                        should_sync=True,
+                        distributed_available=distributed_available,
+                    )
+                disp = None
+        except Exception as exc:
+            _rollback()
+            _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
+            raise
+        if disp is None:
+            # nothing in flight (no coalescible members / all-empty trees /
+            # a dispatch-time pack failure replayed blocking): whatever
+            # could sync has synced blocking above — a completed future,
+            # REGISTERED like a live one so compute() unsyncs after serving,
+            # keeps the caller's force/compute flow uniform
+            done_fut = _psync.SyncFuture.completed(self)
+            object.__setattr__(self, "_pending_sync", done_fut)
+            return done_fut
+
+        def _force() -> None:
+            object.__setattr__(self, "_pending_sync", None)
+            try:
+                snaps = _bucketing.force_coalesced_sync(disp)
+            except _bucketing.CoalesceError as err:
+                if not _bucketing.should_fallback(err):
+                    _rollback()
+                    _faults.note_fault(
+                        _faults.classify(err.original, "sync"), site="sync", owner=self, error=err.original
+                    )
+                    raise err.original from err
+                _bucketing.handle_coalesce_failure(
+                    self,
+                    [(n, n._state_snapshot()) for n in all_nodes],
+                    err,
+                    warn=(
+                        "Async coalesced suite sync failed at force; replaying"
+                        " member-wise blocking syncs (bit-exact)."
+                    ),
+                )
+                try:
+                    for m, _nodes in coalesced:
+                        m.sync(
+                            dist_sync_fn=dist_sync_fn,
+                            process_group=process_group,
+                            should_sync=True,
+                            distributed_available=distributed_available,
+                        )
+                except Exception as exc:
+                    _rollback()
+                    _faults.note_fault(
+                        _faults.classify(exc, "sync"), site="sync", owner=self, error=exc
+                    )
+                    raise
+            except Exception as exc:
+                _rollback()
+                _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
+                raise
+            else:
+                for n, snap in snaps:
+                    n._cache = snap
+                    n._is_synced = True
+            if _psync.is_full_world_group(process_group):
+                step = _faults.tick()
+                object.__setattr__(self, "_last_good_sync_step", step)
+                if self.__dict__.get("_degraded_since_step") is not None:
+                    object.__setattr__(self, "_degraded_since_step", None)
+                for _, m in members:
+                    for n in _bucketing.tree_nodes(m):
+                        object.__setattr__(n, "_last_good_sync_step", step)
+                        if n.__dict__.get("_degraded_since_step") is not None:
+                            object.__setattr__(n, "_degraded_since_step", None)
+
+        fut = _psync.SyncFuture(self, _force, done=disp.done, quant_tier=disp.ctx.quant_tier)
+        object.__setattr__(self, "_pending_sync", fut)
+        return fut
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Any] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Any] = jit_distributed_available,
+    ) -> None:
+        """Sync every member across processes — the whole suite as ONE
+        coalesced payload collective where possible.
+
+        Every eligible member's state tree (including wrapper children) packs
+        into a single flat buffer; one shape/metadata exchange (skipped
+        entirely on the static fast lane) plus one payload ``process_allgather``
+        replaces the per-member, per-state 2-collective walk, and one
+        engine-cached jitted program unpacks and reduces everything (see
+        :mod:`metrics_tpu.parallel.bucketing`). Members are packed member-wise
+        (not leader-wise): the packed layout then depends only on the
+        constructed suite, never on the data-dependent compute-group merge,
+        so every process builds the identical layout. Ineligible members — a
+        custom ``dist_sync_fn``, un-coalescible states, a demoted
+        ``sync-pack`` lane, a divergent ``process_group`` — sync individually
+        through their own :meth:`Metric.sync`. A pack failure demotes the
+        suite's ``sync-pack`` ladder lane and replays member-wise (bit-exact);
+        any transport failure rolls back every already-synced member and
+        re-raises classified, so a failed suite sync leaves ALL local state
+        intact and retryable.
+        """
+        if not should_sync:
+            return
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not is_distributed:
+            return
+        self._defer_barrier()
+        if self.__dict__.get("_pending_sync") is not None:
+            raise MetricsUserError(
+                "A suite sync is already in flight (sync_async); force it with"
+                " wait() or compute() before syncing again."
+            )
+        # collectives pair by issue order: OTHER owners' in-flight async
+        # syncs must land BEFORE the eligibility walk snapshots anything (a
+        # drain mid-protocol would apply merged rows the pack then
+        # double-merges). Self's future raised above.
+        _psync.drain_inflight()
+        # suite-sync telemetry span: the parent slice the pack / metadata /
+        # payload-gather / unpack spans nest under on the trace timeline
+        t_suite = _telemetry.now() if _telemetry.armed else 0.0
+        members, coalesced, individual, anchor_group = self._partition_sync_members(
+            dist_sync_fn, process_group
+        )
 
         try:
             if coalesced:
@@ -1278,6 +1490,11 @@ class MetricCollection:
         """Restore every member's pre-sync local state."""
         if not should_unsync:
             return
+        # a SPENT pending future (completed fallback, forced, or cancelled)
+        # must not block the next sync once the cycle closes here
+        fut = self.__dict__.get("_pending_sync")
+        if fut is not None and (fut._forced or fut._cancelled):
+            object.__setattr__(self, "_pending_sync", None)
         for _, m in self.items(keep_base=True, copy_state=False):
             if m._is_synced:
                 m.unsync()
@@ -1337,6 +1554,12 @@ class MetricCollection:
         return self.sync_context()
 
     def reset(self) -> None:
+        # an in-flight async suite sync is cancelled: merged rows landing on
+        # top of a reset would resurrect the cleared accumulators
+        fut = self.__dict__.get("_pending_sync")
+        if fut is not None:
+            fut.cancel()
+            object.__setattr__(self, "_pending_sync", None)
         for _, m in self.items(keep_base=True, copy_state=False):
             m.reset()
         if self._enable_compute_groups and self._groups_checked:
@@ -1350,6 +1573,7 @@ class MetricCollection:
         values."""
         lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
         members = {k: m.sync_health() for k, m in self.items(keep_base=True, copy_state=False)}
+        fut = self.__dict__.get("_pending_sync")
         return {
             "degraded": bool(lad is not None and lad.demoted)
             or any(h["degraded"] for h in members.values()),
@@ -1359,6 +1583,16 @@ class MetricCollection:
             "degraded_since_step": self.__dict__.get("_degraded_since_step"),
             "degraded_serves": self.__dict__.get("_degraded_serves", 0),
             "quorum_serves": self.__dict__.get("_quorum_serves", 0),
+            # the in-flight async suite sync, if any (see Metric.sync_health)
+            "inflight": None
+            if fut is None
+            else {
+                "age_steps": fut.age_steps(),
+                "dispatch_epoch": fut.dispatch_epoch,
+                "dispatch_step": fut.dispatch_step,
+                "quant_tier": fut.quant_tier,
+                "done": fut.done(),
+            },
             "members": members,
             # the fleet-level membership view (dead ranks, surviving cohort,
             # suspicion counters, transition log) — one dict for dashboards

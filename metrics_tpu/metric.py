@@ -1929,6 +1929,17 @@ class Metric(ABC):
         distributed_available: Optional[Callable] = jit_distributed_available,
     ) -> None:
         """Manually sync state across processes (reference `metric.py:416-450`)."""
+        if should_sync and self.__dict__.get("_pending_sync") is not None:
+            raise MetricsUserError(
+                "A sync is already in flight for this Metric (sync_async); force it"
+                " with wait() or compute() before syncing again."
+            )
+        if should_sync:
+            # collectives pair by issue order: OTHER owners' in-flight async
+            # syncs must land BEFORE this protocol snapshots or issues (a
+            # drain mid-protocol would apply merged rows to state the pack
+            # then double-merges). Self's future raised above.
+            _psync.drain_inflight()
         if self._is_synced and should_sync:
             raise MetricsUserError("The Metric has already been synced.")
 
@@ -2003,10 +2014,168 @@ class Metric(ABC):
                 if n.__dict__.get("_degraded_since_step") is not None:
                     object.__setattr__(n, "_degraded_since_step", None)
 
+    def sync_async(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = jit_distributed_available,
+    ) -> Optional["_psync.SyncFuture"]:
+        """Dispatch this metric tree's sync WITHOUT blocking: hide the wire.
+
+        The packed payload collective (the coalesced protocol's single
+        all-gather) is handed to the dispatcher thread and runs OVERLAPPED
+        with whatever the caller does next — subsequent ``update``/``forward``
+        compute, other metrics' work — while local state stays untouched (the
+        pack is a snapshot of the dispatch point; jax arrays are immutable).
+        Returns a :class:`~metrics_tpu.parallel.sync.SyncFuture`; force it
+        with ``wait()`` or let ``compute()`` auto-force it. The force
+        re-checks the epoch fence, so an in-flight future from a dead world
+        classifies as ``EpochFault`` instead of pairing stale rows. Returns
+        ``None`` when there is nothing to sync (non-distributed world or
+        ``should_sync=False``). When the tree cannot ride the packed protocol
+        (custom gather, un-coalescible states, a demoted ``sync-pack`` lane,
+        ``METRICS_TPU_SYNC_COALESCE=0``) the BLOCKING protocol runs here and
+        an already-completed future returns, so callers treat both uniformly.
+
+        Updates issued while the sync is in flight accumulate locally: the
+        forced (merged) value reflects the dispatch point, and the tail
+        restores through ``unsync`` — the same visibility a blocking
+        ``sync()`` at the dispatch point would have given."""
+        if self.__dict__.get("_pending_sync") is not None:
+            raise MetricsUserError(
+                "A sync is already in flight for this Metric; force it with wait()"
+                " or compute() before dispatching another."
+            )
+        if self._is_synced and should_sync:
+            raise MetricsUserError("The Metric has already been synced.")
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not should_sync or not is_distributed:
+            return None
+        resolved_fn = dist_sync_fn or self.dist_sync_fn or gather_all_tensors
+        lad = self.__dict__.get("_fault_ladders", {}).get("sync-pack")
+        nodes = _bucketing.tree_nodes(self)
+        eligible = (
+            resolved_fn is gather_all_tensors
+            and _bucketing.coalesce_enabled()
+            and not (lad is not None and lad.demoted)
+            and not any(n._is_synced for n in nodes)
+            and (
+                process_group is not None
+                or not any(n.process_group != self.process_group for n in nodes[1:])
+            )
+        )
+        if eligible:
+            for n in nodes:
+                n._defer_barrier()
+                n._canonicalize_list_states()
+            eligible = _bucketing.coalescible(nodes)
+        def _blocking_fallback() -> "_psync.SyncFuture":
+            # the async lane requires the packed protocol (one in-flight
+            # buffer to force); everything else syncs blocking right here.
+            # The completed future is REGISTERED like a live one, so the
+            # compute() auto-force path unsyncs after serving — both lanes
+            # leave the metric in the same state (note: like a blocking
+            # sync, updates issued after this point land on the merged
+            # state and restore away at unsync — the tail-preservation
+            # contract belongs to the truly-in-flight lane only)
+            _psync._bump("sync_async_fallbacks")
+            self.sync(
+                dist_sync_fn=dist_sync_fn,
+                process_group=process_group,
+                should_sync=should_sync,
+                distributed_available=distributed_available,
+            )
+            done_fut = _psync.SyncFuture.completed(self)
+            object.__setattr__(self, "_pending_sync", done_fut)
+            return done_fut
+
+        if not eligible:
+            return _blocking_fallback()
+        group = process_group or self.process_group
+        try:
+            disp = _bucketing.dispatch_coalesced_sync(nodes, group=group, owner=self)
+        except _bucketing.CoalesceError as err:
+            # pack/program failure at dispatch: same demote-and-replay the
+            # blocking paths run — the lane heals itself instead of the raw
+            # CoalesceError recurring on every dispatch
+            if not _bucketing.should_fallback(err):
+                _faults.note_fault(
+                    _faults.classify(err.original, "sync"), site="sync", owner=self, error=err.original
+                )
+                raise err.original from err
+            _bucketing.handle_coalesce_failure(
+                self,
+                [(n, n._state_snapshot()) for n in nodes],
+                err,
+                warn=(
+                    f"Async coalesced sync failed at dispatch for {type(self).__name__};"
+                    " the blocking per-state protocol runs instead (bit-exact)."
+                ),
+            )
+            return _blocking_fallback()
+        if disp is None:
+            return _psync.SyncFuture.completed(self)  # all-empty tree: nothing in flight
+
+        def _force() -> None:
+            object.__setattr__(self, "_pending_sync", None)
+            try:
+                snaps = _bucketing.force_coalesced_sync(disp)
+            except _bucketing.CoalesceError as err:
+                if not _bucketing.should_fallback(err):
+                    # live world, rank-LOCAL failure: surface classified — a
+                    # unilateral protocol switch cannot pair with the other
+                    # ranks' collectives (local state is intact: nothing was
+                    # applied)
+                    _faults.note_fault(
+                        _faults.classify(err.original, "sync"), site="sync", owner=self, error=err.original
+                    )
+                    raise err.original from err
+                _bucketing.handle_coalesce_failure(
+                    self,
+                    [(n, n._state_snapshot()) for n in nodes],
+                    err,
+                    warn=(
+                        f"Async coalesced sync failed at force for {type(self).__name__};"
+                        " replaying the blocking per-state protocol (bit-exact)."
+                    ),
+                )
+                self.sync(
+                    dist_sync_fn=dist_sync_fn,
+                    process_group=process_group,
+                    should_sync=True,
+                    distributed_available=distributed_available,
+                )
+                return
+            except Exception as exc:
+                _faults.note_fault(_faults.classify(exc, "sync"), site="sync", owner=self, error=exc)
+                raise
+            # success: the pre-apply snapshots become the unsync caches (they
+            # carry any overlap-window tail updates), the tree marks synced,
+            # and a full-world force stamps the health marker like sync()
+            for n, snap in snaps:
+                n._cache = snap
+                n._is_synced = True
+            if _psync.is_full_world_group(group):
+                step = _faults.tick()
+                for n in nodes:
+                    object.__setattr__(n, "_last_good_sync_step", step)
+                    if n.__dict__.get("_degraded_since_step") is not None:
+                        object.__setattr__(n, "_degraded_since_step", None)
+
+        fut = _psync.SyncFuture(self, _force, done=disp.done, quant_tier=disp.ctx.quant_tier)
+        object.__setattr__(self, "_pending_sync", fut)
+        return fut
+
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore pre-sync local state (reference `metric.py:452-472`)."""
         if not should_unsync:
             return
+        # a SPENT pending future (completed blocking-fallback, forced, or
+        # cancelled) must not block the next sync once the cycle closes here
+        fut = self.__dict__.get("_pending_sync")
+        if fut is not None and (fut._forced or fut._cancelled):
+            object.__setattr__(self, "_pending_sync", None)
         if not self._is_synced:
             raise MetricsUserError("The Metric has already been un-synced.")
         if self._cache is None:
@@ -2025,6 +2194,12 @@ class Metric(ABC):
             self.should_unsync = kwargs.pop("should_unsync", True)
 
         def __enter__(self) -> "Metric":
+            # in-flight async syncs land BEFORE the presynced read: a drain
+            # later (mid-protocol) would flip _is_synced under the context —
+            # e.g. a member computing while its collection's future is in
+            # flight must see itself presynced by the forced suite rows
+            if self.kwargs.get("should_sync", True):
+                _psync.drain_inflight()
             # a metric synced before entering (e.g. a wrapper's child, synced
             # by the parent's recursion) just computes on the merged state —
             # double-syncing would raise, and unsyncing on exit would undo
@@ -2074,6 +2249,7 @@ class Metric(ABC):
         domain_counts: Dict[str, int] = {}
         for entry in _faults.fault_stats()["failure_log"]:
             domain_counts[entry["domain"]] = domain_counts.get(entry["domain"], 0) + 1
+        fut = self.__dict__.get("_pending_sync")
         return {
             "degraded": bool(lad is not None and lad.demoted),
             "degraded_tier": _psync.sync_degraded_tier(),
@@ -2082,6 +2258,19 @@ class Metric(ABC):
             "degraded_since_step": self.__dict__.get("_degraded_since_step"),
             "degraded_serves": self.__dict__.get("_degraded_serves", 0),
             "quorum_serves": self.__dict__.get("_quorum_serves", 0),
+            # the in-flight async sync, if any: age in monotonic steps, the
+            # epoch it was dispatched at (behind the live epoch => the force
+            # WILL fence-trip), the quant tier it shipped under, and whether
+            # the wire has already landed (forcing will not block)
+            "inflight": None
+            if fut is None
+            else {
+                "age_steps": fut.age_steps(),
+                "dispatch_epoch": fut.dispatch_epoch,
+                "dispatch_step": fut.dispatch_step,
+                "quant_tier": fut.quant_tier,
+                "done": fut.done(),
+            },
             "fault_domain_counts": domain_counts,
         }
 
@@ -2132,6 +2321,26 @@ class Metric(ABC):
                 return self._computed
 
             self._defer_barrier()
+            # compute() is the force point of an in-flight async sync: block
+            # (under the watchdog deadline), re-check the fence, apply. A
+            # classified force failure rides the SAME degraded tier a
+            # blocking sync failure would — local state is intact either way.
+            pending = self.__dict__.get("_pending_sync")
+            forced_async = False
+            if pending is not None:
+                pending_tier = _psync.sync_degraded_tier()
+                try:
+                    pending.wait()
+                    _psync._bump("sync_async_auto_forces")
+                    forced_async = self._is_synced
+                except Exception as exc:  # noqa: BLE001 — degradable sync faults only
+                    if not (
+                        pending_tier is not None
+                        and _degradable_sync_failure(exc)
+                        and not self._is_synced
+                    ):
+                        raise
+                    _enter_degraded(self, exc, pending_tier)
             should_sync = self._to_sync
             # degraded compute tier (METRICS_TPU_SYNC_DEGRADED=local|quorum,
             # default off — one env read only when a sync is actually
@@ -2173,6 +2382,11 @@ class Metric(ABC):
                 value = _compute_under_sync(should_sync, quorum_group)
                 if quorum_group is not None:
                     _note_quorum_serve(self, quorum_group)
+                if forced_async and self._should_unsync and self._is_synced:
+                    # the auto-forced sync mirrors the blocking auto-sync's
+                    # exit: restore local state (incl. any overlap-window
+                    # tail updates) once the value is computed and cached
+                    self.unsync()
                 return value
             except Exception as exc:  # noqa: BLE001 — only degradable sync faults caught
                 if not (
@@ -2234,8 +2448,14 @@ class Metric(ABC):
 
         An observation point: pending deferred calls flush first, so lazy
         ``forward`` handles issued before the reset keep their values (eager
-        semantics — their batches ran before the reset)."""
+        semantics — their batches ran before the reset). An in-flight async
+        sync is CANCELLED — merged rows landing on top of a reset would
+        resurrect the cleared accumulators."""
         self._defer_barrier()
+        fut = self.__dict__.get("_pending_sync")
+        if fut is not None:
+            fut.cancel()
+            object.__setattr__(self, "_pending_sync", None)
         self._update_count = 0
         self._forward_cache = None
         self._computed = None

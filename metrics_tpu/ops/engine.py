@@ -561,6 +561,7 @@ def engine_stats() -> Dict[str, Any]:
         "deferred_steps": _stats["deferred_steps"],
         "deferred_flushes": _stats["deferred_flushes"],
         "deferred_fallbacks": _stats["deferred_fallbacks"],
+        "deferred_sync_barrier_flushes": _stats["deferred_sync_barrier_flushes"],
         # the performance-attribution plane: sampled block_until_ready
         # dispatches and memoized cost-analysis lowers actually performed
         "device_probes": _stats["device_probes"],
@@ -876,6 +877,7 @@ def _zero_engine_counters() -> None:
     _stats["deferred_steps"] = 0
     _stats["deferred_flushes"] = 0
     _stats["deferred_fallbacks"] = 0
+    _stats["deferred_sync_barrier_flushes"] = 0
     _stats["device_probes"] = 0
     _stats["program_analyses"] = 0
 
@@ -922,8 +924,41 @@ def reset_engine() -> None:
     _bucketing._MANIFEST_CACHE.clear()
 
 
+def flush_barrier(owners) -> int:
+    """Order every owner's pending deferred work before a cross-owner
+    observation — the seam the coalesced sync pack (and the async
+    dispatch/force split) rides: a pending queue's stacked flush MUST land
+    before the pack reads state attrs (while a queue is pending, state access
+    routes through the owner's barrier), and again before an async force
+    applies merged rows on top (tail updates enqueued during the overlap
+    window materialize first, then restore through the force's pre-apply
+    snapshot). Flushes each distinct pending queue exactly once even when
+    owners share one, then folds any host-side pending buffers. Returns the
+    number of queues flushed (counted in ``deferred_sync_barrier_flushes``)."""
+    seen = set()
+    flushed = 0
+    for owner in owners:
+        q = owner.__dict__.get("_defer_pending")
+        if q is not None and id(q) not in seen:
+            seen.add(id(q))
+            flushed += 1
+        # ONE protocol, owned by the metric: whatever the per-owner barrier
+        # grows (a new pending lane, another host hook) this seam inherits
+        owner._defer_barrier()
+    if flushed:
+        _stats["deferred_sync_barrier_flushes"] += flushed
+    return flushed
+
+
 # ----------------------------------------------- deferred micro-batched dispatch
-_stats.update({"deferred_steps": 0, "deferred_flushes": 0, "deferred_fallbacks": 0})
+_stats.update(
+    {
+        "deferred_steps": 0,
+        "deferred_flushes": 0,
+        "deferred_fallbacks": 0,
+        "deferred_sync_barrier_flushes": 0,
+    }
+)
 
 _defer_enabled: Optional[bool] = None  # resolved lazily from METRICS_TPU_DEFER
 _defer_max_pending: Optional[int] = None

@@ -170,6 +170,9 @@ def _gather_blobs(blob: bytes, *, owner: Any = None, site: str = "fleet-gather")
     from metrics_tpu.parallel import bucketing as _bucketing
     from metrics_tpu.parallel import sync as _sync
 
+    # collectives pair by issue order: any in-flight async sync must land
+    # before this blocking exchange issues (see sync.drain_inflight)
+    _sync.drain_inflight()
     fence = _sync.world_epoch()
     t0 = _telemetry.now() if _telemetry.armed else 0.0
     local_vec = np.frombuffer(blob, np.uint8)

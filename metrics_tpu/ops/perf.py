@@ -78,6 +78,11 @@ __all__ = [
 TOLERANCE = 0.15
 
 #: Span site -> phase. Sites absent here fold into the ``host`` phase.
+#: ``sync-force``'s EXCLUSIVE time (its wall minus the nested unpack) is the
+#: wait the caller actually blocked on for an in-flight collective — the
+#: non-hidden wire; ``sync-dispatch``'s exclusive residual (after the nested
+#: pack) is async bookkeeping; ``sync-quantize`` is payload serialization
+#: work like the metadata exchange.
 SITE_PHASES = {
     "suite-step": "enqueue",
     "engine-flush": "flush",
@@ -88,9 +93,12 @@ SITE_PHASES = {
     "suite-sync": "orchestrate",
     "sync-pack": "pack",
     "sync-metadata": "serialize",
+    "sync-quantize": "serialize",
     "sync-payload-gather": "wire",
     "sync-gather": "wire",
     "sync-unpack": "unpack",
+    "sync-dispatch": "orchestrate",
+    "sync-force": "wire",
 }
 
 #: Every phase, in report order. ``step`` phases then ``sync`` phases then
@@ -121,11 +129,23 @@ _telemetry.register_reset("perf", reset_perf_stats)
 def _exclusive_spans(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Attribute every timed span its EXCLUSIVE duration (own wall minus the
     wall of spans nested inside it) via one stack scan over the interval
-    tree. Spans are emitted single-threaded, so intervals either nest or
-    are disjoint; ties at the same start (a probed ``device-dispatch`` and
-    its ``engine-dispatch`` sibling share ``t_start``) order the longer
-    interval as the parent."""
+    tree. Host-side spans are emitted single-threaded, so intervals either
+    nest or are disjoint; ties at the same start (a probed
+    ``device-dispatch`` and its ``engine-dispatch`` sibling share
+    ``t_start``) order the longer interval as the parent.
+
+    Spans tagged ``overlapped`` in their attrs — the async sync lane's
+    in-flight wire spans, emitted from the dispatcher thread — COEXIST with
+    host compute instead of nesting inside it: a ``sync-dispatch`` →
+    ``sync-force`` pair brackets an overlapped interval. They are excluded
+    from the nesting scan (their wall would otherwise be double-counted
+    against whatever host span they land inside, blowing the reconciliation)
+    and returned with ``exclusive_s == 0`` and ``overlapped: True`` so the
+    wire evidence can account them separately — the force span's exclusive
+    wait is the only wall the host actually paid."""
     timed = [r for r in rows if (r.get("dur") or 0.0) > 0.0]
+    inflight = [r for r in timed if (r.get("attrs") or {}).get("overlapped")]
+    timed = [r for r in timed if not (r.get("attrs") or {}).get("overlapped")]
     timed.sort(key=lambda r: (r["t_start"], -(r["t_start"] + r["dur"])))
     eps = 1e-9
     stack: List[Tuple[float, Dict[str, Any]]] = []
@@ -149,6 +169,19 @@ def _exclusive_spans(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         stack.append((start + dur, rec))
     for rec in out:
         rec["exclusive_s"] = max(0.0, rec["dur"] - rec["child_s"])
+    for r in inflight:
+        out.append(
+            {
+                "site": r.get("site"),
+                "dur": float(r["dur"]),
+                "attrs": r.get("attrs") or {},
+                "child_s": 0.0,
+                "parent": None,
+                "top": False,
+                "overlapped": True,
+                "exclusive_s": 0.0,
+            }
+        )
     return out
 
 
@@ -177,18 +210,53 @@ def phase_columns(
     return {k: round(v, 4) for k, v in sorted(out.items())}
 
 
+#: The span sites that ARE payload transports (carry wire bytes and count
+#: as collectives in the wire evidence); the in-flight metadata/cross-check
+#: exchanges and the force's wait wall are wire-phase time, not collectives.
+_WIRE_TRANSPORT_SITES = ("sync-payload-gather", "sync-gather")
+
+
 def _wire_evidence(recs: List[Dict[str, Any]], wire_s: float, sync_wall_s: float) -> Dict[str, Any]:
     nbytes = 0
     collectives = 0
+    overlapped_s = 0.0
+    waited_s = 0.0
+    blocking_transport_s = 0.0
     for rec in recs:
-        if _phase_of(rec["site"]) == "wire":
+        if rec.get("overlapped"):
+            # in-flight wire spans (dispatcher thread): their wall coexists
+            # with host compute — accounted here, never against host wall
+            overlapped_s += rec["dur"]
+            if rec["site"] in _WIRE_TRANSPORT_SITES:
+                nbytes += int(rec["attrs"].get("bytes", 0) or 0)
+                collectives += 1
+            continue
+        if rec["site"] == "sync-force":
+            waited_s += float(rec["attrs"].get("waited_s", 0.0) or 0.0)
+        elif _phase_of(rec["site"]) == "wire":
             collectives += 1
             nbytes += int(rec["attrs"].get("bytes", 0) or 0)
+            blocking_transport_s += rec["exclusive_s"]
+    # effective rate divides by TRANSPORT wall only: blocking transport
+    # spans plus in-flight spans. The sync-force wait is wire-phase TIME for
+    # attribution, but it covers the same window the in-flight span already
+    # measures — adding it would double-count and understate the rate.
+    transport_s = blocking_transport_s + overlapped_s
+    # the hidden fraction: how much of the in-flight wire wall the host never
+    # blocked on (waited_s is the force-side wait actually paid). 0.0 with no
+    # async syncs in the window; >= 0.5 is the certification bar on the
+    # simulated slow transport.
+    hidden = 0.0
+    if overlapped_s > 0:
+        hidden = max(0.0, min(1.0, (overlapped_s - waited_s) / overlapped_s))
     return {
         "bytes_gathered": nbytes,
         "collectives": collectives,
-        "effective_bytes_per_s": (nbytes / wire_s) if wire_s > 0 else 0.0,
+        "effective_bytes_per_s": (nbytes / transport_s) if transport_s > 0 else 0.0,
         "wire_share_of_sync": (wire_s / sync_wall_s) if sync_wall_s > 0 else 0.0,
+        "overlapped_wire_s": round(overlapped_s, 6),
+        "forced_wait_s": round(waited_s, 6),
+        "wire_hidden_fraction": round(hidden, 4),
     }
 
 
@@ -211,10 +279,17 @@ def _opportunity(phase: str, block: Dict[str, Any], report: Dict[str, Any]) -> s
     if phase == "wire":
         w = report["sync"]["wire"]
         mbps = w["effective_bytes_per_s"] / 1e6
+        if w.get("overlapped_wire_s", 0.0) > 0:
+            return (
+                f"{w['bytes_gathered']} B over {w['collectives']} collective(s) at "
+                f"{mbps:.1f} MB/s effective; {w['wire_hidden_fraction']:.0%} of the "
+                f"in-flight wire wall hidden behind compute (async sync) — raise the "
+                "overlap window or shrink the payload (METRICS_TPU_SYNC_QUANT)"
+            )
         return (
             f"{w['bytes_gathered']} B over {w['collectives']} collective(s) at "
-            f"{mbps:.1f} MB/s effective — overlap the gather (async sync futures) "
-            "or shrink the payload (quantized lanes), ROADMAP #3"
+            f"{mbps:.1f} MB/s effective — overlap the gather (sync_async futures) "
+            "or shrink the payload (METRICS_TPU_SYNC_QUANT), ROADMAP #3"
         )
     if phase == "compile":
         return (
@@ -302,6 +377,8 @@ def perf_report(
     step_wall_s = 0.0
     sync_wall_s = 0.0
     for rec in recs:
+        if rec.get("overlapped"):
+            continue  # in-flight wire: accounted in the wire evidence block
         block = phases[_phase_of(rec["site"])]
         block["total_s"] += rec["exclusive_s"]
         block["spans"] += 1

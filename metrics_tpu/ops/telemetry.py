@@ -110,9 +110,16 @@ SPAN_SITES = {
     # sync (parallel/sync.py + parallel/bucketing.py)
     "sync-pack": "coalesced pack: tree walk + bitcast-concat program",
     "sync-metadata": "coalesced metadata exchange (dyn-shape lane)",
-    "sync-payload-gather": "coalesced payload all-gather",
+    "sync-payload-gather": "coalesced payload all-gather (attr overlapped=true "
+    "when it ran in flight on the async dispatcher thread)",
     "sync-unpack": "coalesced unpack + reduce (donated program + dyn entries)",
     "sync-gather": "per-state gather_all_tensors exchange (shape + payload)",
+    "sync-dispatch": "async sync dispatched: pack + handoff to the dispatcher "
+    "thread (the collective is now in flight)",
+    "sync-force": "async sync forced: wait-for-wire + fence re-check + apply "
+    "(attr waited_s = the wall actually blocked on)",
+    "sync-quantize": "quantized payload lane encode (METRICS_TPU_SYNC_QUANT; "
+    "attrs carry before/after bytes)",
     "sync-timeout": "a blocking collective hit the watchdog deadline (instant)",
     "sync-degrade-serve": "compute() served a local-only degraded value (instant)",
     "sync-quorum-serve": "compute() served the surviving-quorum aggregate (instant)",
@@ -149,6 +156,9 @@ SYNC_PHASE_SITES = (
     "sync-unpack",
     "sync-gather",
     "suite-sync",
+    "sync-dispatch",
+    "sync-force",
+    "sync-quantize",
 )
 
 # ------------------------------------------------------------------ the gate
@@ -763,6 +773,11 @@ def snapshot() -> Dict[str, Any]:
         # per-phase counter family lives under slo_violations_*)
         "slo_violations": sum(_slo_violations.values()),
         "fault_domain_counts": domain_counts,
+        # the in-flight async-sync block (dispatched, not yet forced): count,
+        # the oldest future's age in monotonic steps, and its dispatch epoch
+        # — a dispatch epoch behind the live epoch means the force WILL
+        # fence-trip; every key is a gauge (futures force and leave)
+        "inflight": _world.inflight_stats(),
         # the bounded membership transition log (epoch bumps, peer-dead /
         # rejoin records), each entry stamped with the shared monotonic step
         # — the fleet merge orders membership events against spans with it

@@ -4,10 +4,12 @@ from metrics_tpu.parallel.collectives import sync_array, sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
 from metrics_tpu.parallel.sharding import shard_states, state_shardings
 from metrics_tpu.parallel.sync import (
+    SyncFuture,
     class_reduce,
     collective_stats,
     distributed_available,
     gather_all_tensors,
+    inflight_stats,
     reduce,
     world_size,
 )
@@ -25,4 +27,6 @@ __all__ = [
     "class_reduce",
     "coalesce_enabled",
     "collective_stats",
+    "SyncFuture",
+    "inflight_stats",
 ]

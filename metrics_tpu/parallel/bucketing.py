@@ -42,6 +42,28 @@ phase runs under the same retry-with-backoff budget and the classified
 ``SyncFault`` surfaces to the caller's snapshot/restore.
 
 ``METRICS_TPU_SYNC_COALESCE=0`` restores the per-state protocol exactly.
+
+Three opt-in lanes ride the packed protocol (docs/performance.md "Hiding
+the wire"):
+
+- **Async dispatch/force** (``dispatch_coalesced_sync`` /
+  ``force_coalesced_sync``): the pack runs on the caller, the retried
+  collective closure runs on the dispatcher thread, and the unpack+apply
+  runs at force — the wire time overlaps subsequent ``update``/``forward``
+  compute. The force re-checks the epoch fence before applying rows, so an
+  in-flight future from a dead world classifies as ``EpochFault`` instead
+  of pairing stale rows.
+- **Quantized payloads** (``METRICS_TPU_SYNC_QUANT=bf16|int8``, off by
+  default — EQuARX, arXiv:2506.17615): float states ship narrow on the
+  wire; integer/bool count states and ``cat`` sample rows route around the
+  lossy encoder unchanged (the exactness carve-outs), so all-integer
+  classification suites stay bit-exact under any tier.
+- **Hierarchical topology** (``METRICS_TPU_SYNC_HIER=<node_size>``): the
+  payload collective runs intra-node first (the ``_intranode_allgather``
+  seam — the fast local interconnect), and only node blocks cross the slow
+  inter-node wire; all-integer sum layouts REDUCE intra-node so the
+  inter-node gather carries one partial row per node, bit-exact by integer
+  associativity.
 """
 from __future__ import annotations
 
@@ -64,6 +86,8 @@ __all__ = [
     "coalesce_enabled",
     "coalesced_sync_nodes",
     "coalescible",
+    "dispatch_coalesced_sync",
+    "force_coalesced_sync",
     "tree_nodes",
 ]
 
@@ -170,9 +194,13 @@ class _Entry:
     ``kind``: "static" (fixed shape, byte range known from the layout),
     "dyn" (``cat`` list state — shape exchanged), "empty" (never-updated
     list state — zero bytes, applies ``[]`` like the per-state path).
+    ``quant`` marks the wire encoding of a lossy-lane static float state
+    (``None`` = bit-exact bytes; ``"bf16"``/``"int8"`` per
+    ``METRICS_TPU_SYNC_QUANT``), with ``wire_nbytes`` its on-wire byte span
+    (int8 carries a 4-byte f32 scale rider after the quantized elements).
     """
 
-    __slots__ = ("node_idx", "name", "kind", "spec", "dtype", "shape", "ndim")
+    __slots__ = ("node_idx", "name", "kind", "spec", "dtype", "shape", "ndim", "quant", "wire_nbytes")
 
     def __init__(self, node_idx, name, kind, spec, dtype=None, shape=None, ndim=None):
         self.node_idx = node_idx
@@ -182,6 +210,8 @@ class _Entry:
         self.dtype = dtype
         self.shape = shape
         self.ndim = ndim
+        self.quant = None
+        self.wire_nbytes = None
 
     def sig(self) -> tuple:
         return (
@@ -192,6 +222,8 @@ class _Entry:
             None if self.dtype is None else jnp.dtype(self.dtype).name,
             self.shape,
             self.ndim,
+            self.quant,
+            self.wire_nbytes,
         )
 
 
@@ -262,6 +294,107 @@ def _from_bytes(seg: jax.Array, shape: tuple, dtype: Any) -> jax.Array:
     return jax.lax.bitcast_convert_type(seg.reshape(tuple(shape) + (itemsize,)), dt)
 
 
+def _entry_nbytes(e: "_Entry", shape: tuple) -> int:
+    """One entry's on-wire byte span: the quantized wire length for a
+    lossy-lane entry, the raw byte length otherwise."""
+    if e.quant is not None:
+        return int(e.wire_nbytes)
+    return _byte_len(shape, e.dtype)
+
+
+def _decode_static(seg: jax.Array, e: "_Entry") -> jax.Array:
+    """Decode one static entry's wire segment back to its state dtype/shape
+    (trace-safe — runs inside the jitted unpack program). Bit-exact bytes for
+    the exact lane; bf16 widens back; int8 rescales by the f32 rider."""
+    if e.quant is None:
+        return _from_bytes(seg, e.shape, e.dtype)
+    n = 1
+    for d in e.shape:
+        n *= int(d)
+    if e.quant == "bf16":
+        return _from_bytes(seg[: 2 * n], e.shape, jnp.bfloat16).astype(e.dtype)
+    q = _from_bytes(seg[:n], e.shape, jnp.int8)
+    scale = _from_bytes(seg[n : n + 4], (1,), jnp.float32)
+    return (q.astype(jnp.float32) * scale[0]).astype(e.dtype)
+
+
+def _quant_encode(entries: Sequence["_Entry"], values: List[Any], tier: str, owner: Any) -> None:
+    """The lossy payload encoder (``METRICS_TPU_SYNC_QUANT=bf16|int8``):
+    re-encode eligible static FLOAT states to their wire bytes in place,
+    marking each entry's ``quant``/``wire_nbytes``. The exactness carve-outs
+    route everything else around the encoder unchanged: integer/bool count
+    states (which dominate classification suites and compress losslessly —
+    they ARE their own wire form), ``cat`` list states (raw sample rows), and
+    any state whose wire form would not actually shrink (a scalar f32 under
+    int8 would GROW by the scale rider). One engine-cached program per
+    (tier, dtypes) encodes every lossy state in a single dispatch; the
+    ``sync-quantize`` span carries the before/after byte evidence."""
+    from metrics_tpu.ops import engine as _engine
+
+    lossy_idx: List[int] = []
+    lossy_entries: List[_Entry] = []
+    exact = 0
+    orig_bytes = 0
+    wire_bytes = 0
+    vi = 0
+    for e in entries:
+        if e.kind == "empty":
+            continue
+        idx = vi
+        vi += 1
+        dt = jnp.dtype(e.dtype)
+        if e.kind != "static" or not jnp.issubdtype(dt, jnp.floating):
+            exact += 1
+            continue
+        full = _byte_len(e.shape, dt)
+        n = full // max(1, dt.itemsize)
+        wire = 2 * n if tier == "bf16" else n + 4
+        if wire >= full:
+            exact += 1
+            continue
+        e.quant = tier
+        e.wire_nbytes = wire
+        lossy_idx.append(idx)
+        lossy_entries.append(e)
+        orig_bytes += full
+        wire_bytes += wire
+    _sync._bump("sync_quant_exact_states", exact)
+    if not lossy_idx:
+        return
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
+    enc_vals = [jnp.asarray(values[i]) for i in lossy_idx]
+    key = ("sync-quant-encode", tier, tuple(jnp.dtype(v.dtype).name for v in enc_vals))
+
+    def build():
+        def program(xs):
+            outs = []
+            for x in xs:
+                if tier == "bf16":
+                    outs.append(_to_bytes(x.astype(jnp.bfloat16)))
+                else:
+                    xf = x.astype(jnp.float32)
+                    scale = jnp.maximum(jnp.max(jnp.abs(xf)), jnp.float32(1e-30)) / jnp.float32(127.0)
+                    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
+                    outs.append(jnp.concatenate([_to_bytes(q), _to_bytes(scale.reshape(1))]))
+            return tuple(outs)
+
+        return program, None, {}
+
+    exe = _engine.acquire_keyed(key, build, donate=False)
+    encoded = exe(enc_vals)  # plain twin: inputs are live state buffers
+    for i, enc in zip(lossy_idx, encoded):
+        values[i] = enc
+    _sync._bump("sync_quant_payloads")
+    _sync._bump("sync_quant_lossy_states", len(lossy_idx))
+    _sync._bump("sync_quant_bytes_saved", orig_bytes - wire_bytes)
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "sync-quantize", owner, "sync", t0, _telemetry.now() - t0,
+            {"tier": tier, "states": len(lossy_idx),
+             "bytes_before": orig_bytes, "bytes_after": wire_bytes},
+        )
+
+
 # ------------------------------------------------------------------ transport
 # Module-level hooks so tests can simulate an N-process world without a real
 # multi-host runtime (monkeypatch these two; see tests/parallel/
@@ -282,6 +415,42 @@ def _payload_allgather(packed: jax.Array) -> jax.Array:
     from jax.experimental import multihost_utils
 
     return jnp.asarray(multihost_utils.process_allgather(packed))
+
+
+def _intranode_allgather(packed: jax.Array) -> jax.Array:
+    """Intra-node stage of the hierarchical payload topology
+    (``METRICS_TPU_SYNC_HIER``): exchange the flat byte buffer over the FAST
+    local interconnect → (node_size, bytes), row 0 the caller's own. The
+    default is the single-cohort identity — a real deployment (or the fake
+    world in tests/chaos) binds this seam to its intra-node transport
+    (ICI psum / shared-memory gather)."""
+    return jnp.asarray(packed)[None]
+
+
+def _internode_allgather(block: jax.Array) -> jax.Array:
+    """Inter-node stage of the hierarchical topology: exchange ONE block per
+    node across the slow wire → (n_nodes, block_bytes). A real deployment
+    binds this seam to a LEADER-scoped gather (only node leaders exchange —
+    every rank participating in a full-world gather here would duplicate
+    each node's block node_size times); the default delegates to the flat
+    payload collective, which is correct only in the single-process /
+    simulated world where the intra-node stage returned one row. The
+    hierarchical lane refuses to engage in a LIVE multi-process world unless
+    BOTH seams are bound (warn once + flat gather instead)."""
+    return _payload_allgather(block)
+
+
+#: Kept so the hierarchical lane can detect "nobody bound the seams" after
+#: tests monkeypatch and restore the hooks.
+_default_intranode_allgather = _intranode_allgather
+_default_internode_allgather = _internode_allgather
+
+
+class _HierWarnOwner:
+    """Warn-dedupe anchor for the unbound-intranode-transport fallback."""
+
+
+_HIER_FALLBACK_WARN_OWNER = _HierWarnOwner()
 
 
 # ------------------------------------------------------------- pack / unpack
@@ -357,39 +526,55 @@ def _rank_offsets(
         if e.kind == "empty":
             continue
         shape = e.shape if e.kind == "static" else next(di)
-        n = _byte_len(shape, e.dtype)
+        n = _entry_nbytes(e, shape)
         out.append((off, n, shape))
         off += n
     return out
 
 
-def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> None:
-    """Sync every node's states with ONE payload collective and one program.
+class _ProtocolCtx:
+    """Everything one coalesced protocol instance carries between its pack,
+    collective, and unpack phases — the seam the async dispatch/force split
+    rides (pack on the caller, collective in flight, unpack at force)."""
 
-    The caller must have flushed/canonicalized/snapshotted every node. All
-    ``setattr`` happen only after the whole unpack succeeds, so any failure
-    leaves every node's local state intact. Raises:
+    __slots__ = (
+        "nodes", "owner", "members", "fence", "entries", "packed_entries",
+        "packed", "meta_vec", "key", "has_dyn", "async_mode", "quant_tier",
+        "node_reducible",
+    )
 
-    - ``SyncConfigFault`` — invalid group (structural, never retried);
-    - ``SyncFault`` — the collective phase failed past its retry budget
-      (caller's snapshot/restore surfaces it, exactly like the per-state
-      path);
-    - :class:`CoalesceError` — pack/unpack/program failure (caller demotes
-      its ``sync-pack`` lane and replays the per-state protocol).
-    """
-    from metrics_tpu.ops import engine as _engine
+
+def _guarded(ctx: "_ProtocolCtx", fn, site: str = "sync-gather"):
+    """One blocking transport call under the mode-matched guard: the blocking
+    protocol rides the per-call watchdog (``run_with_deadline``); the async
+    protocol's transports run unguarded on the dispatcher thread
+    (``run_inflight``) because the deadline is measured at the FORCE — the
+    only wall the caller actually blocks on (``wait_with_deadline``). The
+    invlint collective-discipline pass recognizes both spellings as the
+    sanctioned pair."""
+    if ctx.async_mode:
+        return _sync.run_inflight(fn, site=site)
+    return _sync.run_with_deadline(fn, site=site)
+
+
+def _pack_phase(
+    nodes: Sequence[Any], group: Optional[Any], owner: Any = None, async_mode: bool = False
+) -> Optional["_ProtocolCtx"]:
+    """Validate + fence + pack: the host-side front of the protocol (the
+    "sync-pack" deterministic injection site). Returns ``None`` when the tree
+    holds no packable states (empties applied in place — nothing to
+    exchange). Raises ``SyncConfigFault`` (invalid group, structural) or
+    :class:`CoalesceError` (pack/program failure)."""
     from metrics_tpu.ops import faults as _faults
     from metrics_tpu.utils.exceptions import SyncFault
 
     members = _sync.validate_group_live(group)
     # epoch fence: this protocol instance pairs with the cohort that exists
-    # NOW; every transport attempt below re-checks the fence before issuing,
-    # so a membership change mid-sync (peer declared dead, rank rejoined)
-    # raises the classified EpochFault instead of pairing with the wrong
-    # cohort — and every collective slot is audited against the stamp
+    # NOW; every transport attempt re-checks the fence before issuing — and
+    # the async force re-checks it AGAIN before applying rows, so an
+    # in-flight future from a dead world classifies instead of pairing stale
     fence = _sync.world_epoch()
 
-    # ---- pack (the "sync-pack" deterministic injection site) ----
     t_pack = _telemetry.now() if _telemetry.armed else 0.0
     try:
         if _faults.armed:
@@ -399,7 +584,10 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
         if not packed_entries:
             for e in entries:
                 setattr(nodes[e.node_idx], e.name, [])
-            return
+            return None
+        quant_tier = _sync.sync_quant_tier()
+        if quant_tier is not None:
+            _quant_encode(entries, values, quant_tier, owner or nodes[0])
         packed, meta_vec = _pack(entries, values)
         key = _layout_key(entries)
         has_dyn = any(e.kind == "dyn" for e in entries)
@@ -409,39 +597,145 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
         raise CoalesceError(exc) from exc
     if t_pack and _telemetry.armed:
         _telemetry.emit(
-            "sync-pack", nodes[0], "sync", t_pack, _telemetry.now() - t_pack,
+            "sync-pack", owner or nodes[0], "sync", t_pack, _telemetry.now() - t_pack,
             {"states": len(packed_entries), "bytes": int(packed.shape[0])},
         )
+    ctx = _ProtocolCtx()
+    ctx.nodes = list(nodes)
+    ctx.owner = owner or nodes[0]
+    ctx.members = members
+    ctx.fence = fence
+    ctx.entries = entries
+    ctx.packed_entries = packed_entries
+    ctx.packed = packed
+    ctx.meta_vec = meta_vec
+    ctx.key = key
+    ctx.has_dyn = has_dyn
+    ctx.async_mode = async_mode
+    ctx.quant_tier = quant_tier
+    # the hierarchical psum lane: an all-integer, all-"sum", unquantized
+    # static layout may REDUCE intra-node (bit-exact by integer
+    # associativity) so the inter-node wire carries one partial per node
+    ctx.node_reducible = not has_dyn and all(
+        e.kind == "static"
+        and e.spec == "sum"
+        and e.quant is None
+        and jnp.issubdtype(jnp.dtype(e.dtype), jnp.integer)
+        for e in packed_entries
+    )
+    return ctx
 
-    # ---- collective phase (same retry budget + injection site as the
-    # per-state gather; a post-budget transient surfaces as SyncFault).
-    # Layout disagreement is NOT raised inside the retried closure: a raise
-    # there would be retried (a unilateral re-issued exchange cannot pair
-    # with the other ranks' collectives) and then re-wrapped as a misleading
-    # SyncFault — the mismatch rides out as a sentinel and classifies as a
-    # CoalesceError below, where the caller's demote-to-per-state fallback
-    # can actually catch it.
-    # Every blocking transport call below runs under the watchdog deadline
-    # (METRICS_TPU_SYNC_DEADLINE_MS, default off — a direct call): a hung
-    # peer raises a classified SyncTimeoutFault instead of blocking forever,
-    # inside the retried closure so it rides the same retry/snapshot-restore
-    # lane as any other transport fault.
+
+def _node_reduce(ctx: "_ProtocolCtx", intra: jax.Array) -> jax.Array:
+    """Sum one node cohort's packed rows into a single partial row (the
+    hierarchical "psum" stage): decode each all-integer sum state, sum over
+    the cohort axis, re-encode — one engine-cached program per (layout, k)."""
+    from metrics_tpu.ops import engine as _engine
+
+    ents = [e for e in ctx.entries if e.kind == "static"]
+    offsets = _rank_offsets(ents, ())
+    k = int(intra.shape[0])
+    key = ("sync-hier-reduce", tuple(e.sig() for e in ents), k)
+
+    def build():
+        def program(stack):
+            parts = []
+            for (off, n, shape), e in zip(offsets, ents):
+                rows = jnp.stack(
+                    [_from_bytes(stack[r, off : off + n], shape, e.dtype) for r in range(k)]
+                )
+                parts.append(_to_bytes(rows.sum(axis=0).astype(e.dtype)))
+            return jnp.concatenate(parts)
+
+        return program, None, {}
+
+    exe = _engine.acquire_keyed(key, build, donate=False)
+    return exe(intra)
+
+
+def _payload_exchange(ctx: "_ProtocolCtx", padded: jax.Array) -> Tuple[jax.Array, bool]:
+    """The payload collective, topology-aware. Flat: one all-gather →
+    (world, bytes). Hierarchical (``METRICS_TPU_SYNC_HIER=<node_size>``,
+    full-world only): intra-node stage over the fast local interconnect,
+    then ONLY node blocks cross the inter-node wire — reduced to one partial
+    row per node for all-integer sum layouts (returns ``reduced=True``; the
+    unpack's sum over node partials equals the flat sum bit-exactly), or
+    concatenated and reassembled otherwise (bit-exact for every layout). A
+    live world with no intra-node transport bound warns once and rides the
+    flat gather."""
+    from metrics_tpu.ops import faults as _faults
+
+    node_size = _sync.sync_hier_node_size()
+    if node_size > 1 and ctx.members is None:
+        seams_unbound = (
+            _intranode_allgather is _default_intranode_allgather
+            or _internode_allgather is _default_internode_allgather
+        )
+        if seams_unbound and _sync.distributed_available():
+            # with either seam unbound in a LIVE world the default inter-node
+            # stage would be a full-world gather duplicating every node's
+            # block node_size times — refuse, loudly, and ride the flat lane
+            _faults.warn_fault(
+                _HIER_FALLBACK_WARN_OWNER,
+                "sync",
+                f"METRICS_TPU_SYNC_HIER={node_size} is set but the hierarchical transport "
+                "seams are not (both) bound (bucketing._intranode_allgather / "
+                "_internode_allgather); the payload collective rides the flat gather "
+                "instead of double-counting node blocks.",
+            )
+        else:
+            intra = jnp.asarray(_guarded(ctx, lambda: _intranode_allgather(padded)))
+            _sync._bump("sync_hier_intranode_collectives")
+            if ctx.node_reducible:
+                block = _node_reduce(ctx, intra)
+                _sync._bump("sync_hier_node_reduces")
+            else:
+                block = intra.reshape(-1)
+            inter = jnp.asarray(_guarded(ctx, lambda: _internode_allgather(block)))
+            _sync._bump("sync_hier_internode_collectives")
+            _sync.note_collective("payload", nbytes=int(np.prod(inter.shape)), epoch=ctx.fence)
+            if ctx.node_reducible:
+                return inter, True
+            return inter.reshape(-1, int(padded.shape[0])), False
+    gathered = jnp.asarray(_guarded(ctx, lambda: _payload_allgather(padded)))
+    _sync.note_collective("payload", nbytes=int(np.prod(gathered.shape)), epoch=ctx.fence)
+    return gathered, False
+
+
+def _make_attempt(ctx: "_ProtocolCtx"):
+    """Build the retried collective closure for one protocol instance (same
+    retry budget + injection site as the per-state gather; a post-budget
+    transient surfaces as SyncFault). Layout disagreement is NOT raised
+    inside the retried closure: a raise there would be retried (a unilateral
+    re-issued exchange cannot pair with the other ranks' collectives) and
+    then re-wrapped as a misleading SyncFault — the mismatch rides out as a
+    sentinel and classifies as a CoalesceError at the call site, where the
+    caller's demote-to-per-state fallback can actually catch it. Every
+    blocking transport call runs under the mode-matched guard (see
+    :func:`_guarded`); async attempts tag their spans ``overlapped`` so the
+    perf decomposition attributes the hidden wire window instead of
+    double-counting it against host wall."""
+    from metrics_tpu.ops import faults as _faults
+
+    nodes, entries, fence, key = ctx.nodes, ctx.entries, ctx.fence, ctx.key
+    packed, meta_vec, has_dyn = ctx.packed, ctx.meta_vec, ctx.has_dyn
+
     def _attempt():
-        _sync.check_epoch(fence, site="sync-gather", owner=nodes[0])
+        _sync.check_epoch(fence, site="sync-gather", owner=ctx.owner)
         if _faults.armed:
             _faults.maybe_fail("sync-gather")
         local_total = int(packed.shape[0])
         if has_dyn:
             # uneven-shape lane: ONE metadata exchange for every dyn state
             t_meta = _telemetry.now() if _telemetry.armed else 0.0
-            all_vecs = _sync.run_with_deadline(
-                lambda: _host_allgather(meta_vec), site="sync-gather"
-            )
+            all_vecs = _guarded(ctx, lambda: _host_allgather(meta_vec))
             _sync.note_collective("shape", epoch=fence)
             if t_meta and _telemetry.armed:
+                attrs = {"dims": int(meta_vec.shape[0])}
+                if ctx.async_mode:
+                    attrs["overlapped"] = True
                 _telemetry.emit(
-                    "sync-metadata", nodes[0], "sync", t_meta, _telemetry.now() - t_meta,
-                    {"dims": int(meta_vec.shape[0])},
+                    "sync-metadata", ctx.owner, "sync", t_meta, _telemetry.now() - t_meta, attrs
                 )
             _sync._bump("sync_fastlane_misses")
             rank_meta = [_parse_rank_meta(entries, all_vecs[r]) for r in range(all_vecs.shape[0])]
@@ -458,19 +752,21 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             # layout at the same completed sync.
             if key not in _MANIFEST_CACHE and _sync.distributed_available():
                 t_meta = _telemetry.now() if _telemetry.armed else 0.0
-                totals = _sync.run_with_deadline(
+                totals = _guarded(
+                    ctx,
                     # invlint: allow(INV003) — the manifest cache is rank-symmetric by construction: a jax multi-host world runs the same program on every process, so every rank caches a layout at the same completed sync (see the comment above)
                     lambda: _host_allgather(np.asarray([local_total], np.int64)),
-                    site="sync-gather",
                 )
                 _sync.note_collective("shape", epoch=fence)
                 if t_meta and _telemetry.armed:
+                    attrs = {"cross_check": True}
+                    if ctx.async_mode:
+                        attrs["overlapped"] = True
                     _telemetry.emit(
-                        "sync-metadata", nodes[0], "sync", t_meta, _telemetry.now() - t_meta,
-                        {"cross_check": True},
+                        "sync-metadata", ctx.owner, "sync", t_meta, _telemetry.now() - t_meta, attrs
                     )
                 if int(totals.max()) != int(totals.min()):
-                    return _LAYOUT_MISMATCH, sorted(set(int(t) for t in totals[:, 0]))
+                    return _LAYOUT_MISMATCH, sorted(set(int(t) for t in totals[:, 0])), False
             if key in _MANIFEST_CACHE:
                 _sync._bump("sync_fastlane_hits")
             else:
@@ -483,55 +779,59 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
             else jnp.pad(packed, (0, max_total - local_total))
         )
         t_gather = _telemetry.now() if _telemetry.armed else 0.0
-        gathered = _sync.run_with_deadline(
-            lambda: _payload_allgather(padded), site="sync-gather"
-        )
+        # the payload slot itself is audited (note_collective) inside
+        # _payload_exchange, right beside the transport it accounts
+        gathered, node_reduced = _payload_exchange(ctx, padded)
         gathered_bytes = int(np.prod(gathered.shape))
-        _sync.note_collective("payload", nbytes=gathered_bytes, epoch=fence)
         if t_gather and _telemetry.armed:
             # seq: the payload-collective ordinal, identical on every rank
             # (collectives issue in lockstep) — the fleet trace merge pairs
             # same-seq spans across ranks as clock-offset anchors
+            attrs = {"bytes": gathered_bytes, "world": int(gathered.shape[0]), "epoch": fence,
+                     "seq": _sync._counters["sync_payload_collectives"]}
+            if ctx.async_mode:
+                # the dispatcher-thread wire span coexists with host-side
+                # compute spans: the perf scan must treat it as an overlapped
+                # interval, not a nested child of whatever it lands inside
+                attrs["overlapped"] = True
             _telemetry.emit(
-                "sync-payload-gather", nodes[0], "sync", t_gather, _telemetry.now() - t_gather,
-                {"bytes": gathered_bytes, "world": int(gathered.shape[0]), "epoch": fence,
-                 "seq": _sync._counters["sync_payload_collectives"]},
+                "sync-payload-gather", ctx.owner, "sync", t_gather, _telemetry.now() - t_gather,
+                attrs,
             )
-        return gathered, rank_meta
+        return gathered, rank_meta, node_reduced
 
-    gathered, rank_meta = _faults.retry_with_backoff(
-        _attempt,
-        attempts=_sync.sync_retries(),
-        base_delay_s=_sync.sync_backoff_s(),
-        site="sync-gather",
-    )
-    if gathered is _LAYOUT_MISMATCH:
-        # every rank ran the same cross-check exchange and saw the same
-        # totals: this failure (and the resulting demotion) is rank-symmetric
-        raise CoalesceError(
-            ValueError(f"static-shape layouts disagree across processes (packed totals {rank_meta})"),
-            rank_symmetric=True,
-        )
-    # the collective phase completed: clear cohort-wide timeout suspicion and
-    # (on a full-world sync) the degraded flag; a multi-row gather also
-    # teaches the membership registry the world size
-    _sync.note_sync_success(world=int(gathered.shape[0]), members=members)
+    return _attempt
 
-    # ---- unpack + reduce ----
-    # Static entries (the fixed prefix of every rank's buffer) unpack through
-    # ONE donated, engine-cached program whose key depends only on the static
-    # layout — a growing cat state never retraces it. Dynamic (cat) entries
-    # unpack with per-op eager dispatches (slice/bitcast/dim_zero_cat), the
-    # same op-level cost profile the per-state path paid for them — baking
-    # their per-sync shapes into the big program would recompile it on every
-    # sync and churn the engine's program cache.
+
+def _finish(
+    ctx: "_ProtocolCtx", gathered: jax.Array, rank_meta: Optional[list], node_reduced: bool
+) -> None:
+    """Unpack + reduce + apply (all ``setattr`` only after the whole unpack
+    succeeds, so any failure leaves every node's local state intact).
+
+    Static entries (the fixed prefix of every rank's buffer) unpack through
+    ONE donated, engine-cached program whose key depends only on the static
+    layout — a growing cat state never retraces it. Dynamic (cat) entries
+    unpack with per-op eager dispatches (slice/bitcast/dim_zero_cat), the
+    same op-level cost profile the per-state path paid for them — baking
+    their per-sync shapes into the big program would recompile it on every
+    sync and churn the engine's program cache. ``node_reduced`` rows are
+    per-NODE partials (the hierarchical psum lane); the sum reduction over
+    them equals the flat sum bit-exactly by integer associativity."""
+    from metrics_tpu.ops import engine as _engine
+
+    nodes, entries, packed_entries, members = ctx.nodes, ctx.entries, ctx.packed_entries, ctx.members
     t_unpack = _telemetry.now() if _telemetry.armed else 0.0
     try:
         world = int(gathered.shape[0])
-        ranks = list(range(world)) if members is None else [r for r in members if r < world]
+        ranks = (
+            list(range(world))
+            if members is None or node_reduced
+            else [r for r in members if r < world]
+        )
         static_entries = [e for e in packed_entries if e.kind == "static"]
         dyn_entries = [e for e in packed_entries if e.kind == "dyn"]
-        static_total = sum(_byte_len(e.shape, e.dtype) for e in static_entries)
+        static_total = sum(_entry_nbytes(e, e.shape) for e in static_entries)
 
         results: Dict[Tuple[int, str], Any] = {}
         if static_entries:
@@ -552,7 +852,7 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
                     outs = []
                     for (off, n, shape), e in zip(offsets, ents):
                         stacked = jnp.stack(
-                            [_from_bytes(buf[r, off : off + n], shape, e.dtype) for r in ranks]
+                            [_decode_static(buf[r, off : off + n], e) for r in ranks]
                         )
                         fn = _SPEC_TO_FN.get(e.spec)
                         # None/custom specs return the stack; custom callables
@@ -600,14 +900,181 @@ def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> N
 
     if t_unpack and _telemetry.armed:
         _telemetry.emit(
-            "sync-unpack", nodes[0], "sync", t_unpack, _telemetry.now() - t_unpack,
+            "sync-unpack", ctx.owner, "sync", t_unpack, _telemetry.now() - t_unpack,
             {"states": len(packed_entries)},
         )
-    _MANIFEST_CACHE[key] = True
+    _MANIFEST_CACHE[ctx.key] = True
     while len(_MANIFEST_CACHE) > _MANIFEST_CACHE_CAP:
         _MANIFEST_CACHE.pop(next(iter(_MANIFEST_CACHE)))
     _sync._bump("sync_states_coalesced", len(packed_entries))
     _sync._bump("sync_coalesced_payloads")
+
+
+def coalesced_sync_nodes(nodes: Sequence[Any], group: Optional[Any] = None) -> None:
+    """Sync every node's states with ONE payload collective and one program.
+
+    The caller must have flushed/canonicalized/snapshotted every node. All
+    ``setattr`` happen only after the whole unpack succeeds, so any failure
+    leaves every node's local state intact. Raises:
+
+    - ``SyncConfigFault`` — invalid group (structural, never retried);
+    - ``SyncFault`` — the collective phase failed past its retry budget
+      (caller's snapshot/restore surfaces it, exactly like the per-state
+      path);
+    - :class:`CoalesceError` — pack/unpack/program failure (caller demotes
+      its ``sync-pack`` lane and replays the per-state protocol).
+    """
+    from metrics_tpu.ops import faults as _faults
+
+    # NOTE on ordering: in-flight async syncs are drained at the PROTOCOL
+    # ENTRY (Metric.sync / MetricCollection.sync / sync_context enter /
+    # gather_all_tensors), never here — the caller has already snapshotted
+    # and packed against pre-drain state, and a force landing merged rows at
+    # this point would make the pack below double-merge them
+    ctx = _pack_phase(nodes, group)
+    if ctx is None:
+        return
+    gathered, rank_meta, node_reduced = _faults.retry_with_backoff(
+        _make_attempt(ctx),
+        attempts=_sync.sync_retries(),
+        base_delay_s=_sync.sync_backoff_s(),
+        site="sync-gather",
+    )
+    if gathered is _LAYOUT_MISMATCH:
+        # every rank ran the same cross-check exchange and saw the same
+        # totals: this failure (and the resulting demotion) is rank-symmetric
+        raise CoalesceError(
+            ValueError(f"static-shape layouts disagree across processes (packed totals {rank_meta})"),
+            rank_symmetric=True,
+        )
+    # the collective phase completed: clear cohort-wide timeout suspicion and
+    # (on a full-world sync) the degraded flag; a multi-row gather also
+    # teaches the membership registry the world size — EXCEPT node-reduced
+    # rows, which count nodes, not ranks
+    _sync.note_sync_success(
+        world=None if node_reduced else int(gathered.shape[0]), members=ctx.members
+    )
+    _finish(ctx, gathered, rank_meta, node_reduced)
+
+
+# ----------------------------------------------------- async dispatch / force
+class _Dispatched:
+    """Handle to one in-flight coalesced protocol: the pack context plus the
+    dispatcher-thread result slot. Carried inside a ``sync.SyncFuture`` by
+    the metric-level force closure."""
+
+    __slots__ = ("ctx", "box", "done", "t_dispatch")
+
+    def __init__(self, ctx: "_ProtocolCtx", box: dict, done: Any, t_dispatch: float):
+        self.ctx = ctx
+        self.box = box
+        self.done = done
+        self.t_dispatch = t_dispatch
+
+
+def dispatch_coalesced_sync(
+    nodes: Sequence[Any], group: Optional[Any] = None, owner: Any = None
+) -> Optional["_Dispatched"]:
+    """Pack now, gather in flight: the async front of the coalesced protocol.
+
+    The pack runs synchronously on the caller (ordering: the deferral layer's
+    pending-queue flush — ``engine.flush_barrier`` — must land before the
+    pack reads state, and packing never mutates state, so the caller is free
+    to keep updating the moment this returns; jax arrays are immutable, so
+    the packed buffer is a stable snapshot of the dispatch point). The retried
+    collective closure is handed to the dispatcher thread — the wire time
+    runs OVERLAPPED with subsequent compute — and
+    :func:`force_coalesced_sync` completes the protocol. Returns ``None``
+    when the tree holds no packable states (empties applied — nothing in
+    flight). Raises like the pack phase of :func:`coalesced_sync_nodes`."""
+    from metrics_tpu.ops import engine as _engine
+    from metrics_tpu.ops import faults as _faults
+
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
+    # recorded unconditionally: the force's inflight_s attr must never read
+    # against the 0.0 "telemetry disarmed" span sentinel
+    t_dispatch = _telemetry.now()
+    _engine.flush_barrier(nodes)
+    ctx = _pack_phase(nodes, group, owner=owner, async_mode=True)
+    if ctx is None:
+        return None
+    attempt = _make_attempt(ctx)
+    attempts = _sync.sync_retries()
+    backoff = _sync.sync_backoff_s()
+    box, done = _sync.submit_async(
+        lambda: _faults.retry_with_backoff(
+            attempt, attempts=attempts, base_delay_s=backoff, site="sync-gather"
+        )
+    )
+    disp = _Dispatched(ctx, box, done, t_dispatch)
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "sync-dispatch", ctx.owner, "sync", t0, _telemetry.now() - t0,
+            {"states": len(ctx.packed_entries), "bytes": int(ctx.packed.shape[0]),
+             "epoch": ctx.fence, "quant": ctx.quant_tier or "off"},
+        )
+    return disp
+
+
+def force_coalesced_sync(disp: "_Dispatched") -> List[Tuple[Any, Any]]:
+    """Complete one in-flight coalesced protocol: block until the collective
+    lands (under the watchdog deadline — ``wait_with_deadline``; a hung peer
+    raises the classified ``SyncTimeoutFault`` with nothing applied),
+    **re-check the epoch fence** (a membership change between dispatch and
+    force classifies as ``EpochFault`` instead of pairing stale rows — the
+    in-flight rows are discarded, never applied), order any pending deferred
+    flushes before the apply, then unpack + apply. Returns the per-node
+    PRE-APPLY state snapshots (the caller's ``unsync`` cache — overlapped
+    tail updates restore through it). Raises with local state bit-exact and
+    retryable on every failure path."""
+    from metrics_tpu.ops import engine as _engine
+    from metrics_tpu.ops import faults as _faults
+    from metrics_tpu.utils.exceptions import EpochFault
+
+    ctx = disp.ctx
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
+    t_wait = _telemetry.now()
+    _sync.wait_with_deadline(disp.done, site="sync-force", owner=ctx.owner)
+    waited = _telemetry.now() - t_wait
+    if "error" in disp.box:
+        err = disp.box["error"]
+        if isinstance(err, EpochFault):
+            # the membership change raced the dispatcher thread itself: the
+            # in-flight attempt's fence tripped before issue — same stale
+            # future, counted on the same axis as a force-side trip
+            _sync._bump("sync_async_stale_futures")
+        raise err
+    gathered, rank_meta, node_reduced = disp.box["value"]
+    if gathered is _LAYOUT_MISMATCH:
+        raise CoalesceError(
+            ValueError(f"static-shape layouts disagree across processes (packed totals {rank_meta})"),
+            rank_symmetric=True,
+        )
+    # the force-side fence: the collective paired with the cohort that
+    # existed at dispatch, but the MERGE is only valid if that cohort is
+    # still the world — an epoch bump while in flight (peer died, rank
+    # rejoined) means these rows pair dead ranks with live state
+    try:
+        _sync.check_epoch(ctx.fence, site="sync-force", owner=ctx.owner)
+    except EpochFault:
+        _sync._bump("sync_async_stale_futures")
+        raise
+    # a pending deferred flush enqueued during the overlap window must land
+    # before the apply below overwrites state attrs (the engine's pending
+    # queues route state access through the owner's barrier)
+    _engine.flush_barrier(ctx.nodes)
+    snaps = [(n, n._state_snapshot()) for n in ctx.nodes]
+    _sync.note_sync_success(
+        world=None if node_reduced else int(gathered.shape[0]), members=ctx.members
+    )
+    _finish(ctx, gathered, rank_meta, node_reduced)
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "sync-force", ctx.owner, "sync", t0, _telemetry.now() - t0,
+            {"waited_s": waited, "epoch": ctx.fence, "states": len(ctx.packed_entries),
+             "inflight_s": max(0.0, t_wait - disp.t_dispatch)},
+        )
+    return snaps
 
 
 def handle_coalesce_failure(owner: Any, snaps: Sequence[Tuple[Any, Any]], err: "CoalesceError", warn: str) -> None:

@@ -120,6 +120,8 @@ _RETRIES_WARN_OWNER = _EnvWarnOwner()
 _BACKOFF_WARN_OWNER = _EnvWarnOwner()
 _DEADLINE_WARN_OWNER = _EnvWarnOwner()
 _MEMBERSHIP_WARN_OWNER = _EnvWarnOwner()
+_QUANT_WARN_OWNER = _EnvWarnOwner()
+_HIER_WARN_OWNER = _EnvWarnOwner()
 
 
 def _env_parse(name: str, default: Any, parse: Callable[[str], Any], kind: str, *, owner: Any, fallback_desc: Optional[str] = None) -> Any:
@@ -351,6 +353,327 @@ def sync_degraded_tier() -> Optional[str]:
         " degraded compute stays OFF (sync failures raise classified).",
     )
     return None
+
+
+# -------------------------------------------------------- quantized payload lane
+def sync_quant_tier() -> Optional[str]:
+    """The opt-in quantized payload lane (``METRICS_TPU_SYNC_QUANT``).
+
+    ``"bf16"`` — float states ship as bfloat16 on the wire (half the bytes of
+    f32, an eighth of f64); ``"int8"`` — float states ship as per-state
+    symmetric int8 with one f32 scale rider (~quarter of f32). Integer and
+    bool **count states route around the lossy encoder unchanged** (the
+    exactness carve-out — classification suites whose states are counts stay
+    bit-exact under any tier), as do ``cat`` list states (raw sample rows,
+    where resolution matters most and shapes vary). Unset/empty (the default)
+    keeps every payload bit-exact. Any other value warns once, naming the
+    offending value, and the lane stays OFF. Following EQuARX
+    (arXiv:2506.17615): small-payload collectives are latency-bound, but the
+    hierarchical inter-node stage is byte-bound — quantization is the
+    explicitly-requested degraded tier for that wire."""
+    raw = os.environ.get("METRICS_TPU_SYNC_QUANT")
+    if not raw:
+        return None
+    value = raw.strip().lower()
+    if value in ("0", "false", "off"):
+        return None
+    if value in ("bf16", "int8"):
+        return value
+    from metrics_tpu.ops import faults as _faults
+
+    _faults.warn_fault(
+        _QUANT_WARN_OWNER,
+        "sync",
+        f"METRICS_TPU_SYNC_QUANT={raw!r} is not a known tier ('bf16' or 'int8');"
+        " the quantized payload lane stays OFF (payloads ship bit-exact).",
+    )
+    return None
+
+
+def sync_hier_node_size() -> int:
+    """Ranks per node for the hierarchical payload topology
+    (``METRICS_TPU_SYNC_HIER``, default 0 = off; values < 2 stay off).
+
+    When armed, the payload collective runs as **intra-node stage →
+    inter-node gather**: each node's cohort exchanges over the fast local
+    interconnect (the ``bucketing._intranode_allgather`` hook), then only
+    node blocks cross the slow inter-node wire. For all-integer sum-reduced
+    layouts the intra-node stage REDUCES (psum) to one partial row per node —
+    the inter-node gather then carries 1/node_size of the bytes, bit-exact by
+    integer associativity. Other layouts ride a bit-exact two-stage gather
+    (node blocks concatenated, full stack reassembled)."""
+    n = _env_int("METRICS_TPU_SYNC_HIER", 0, owner=_HIER_WARN_OWNER)
+    return int(n) if n and n >= 2 else 0
+
+
+# ----------------------------------------------------- async dispatch machinery
+# One long-lived daemon dispatcher (lazily created), mirroring the watchdog's
+# shape: async syncs are serialized per process (collectives must issue in a
+# deterministic order on every rank — two interleaved in-flight payloads would
+# pair across ranks nondeterministically), so a single worker with a handoff
+# queue is both sufficient and the ordering guarantee. A worker stuck inside a
+# hung collective is abandoned at force time (wait_with_deadline) and replaced
+# on next use, exactly like the watchdog.
+class _AsyncDispatcher:
+    def __init__(self) -> None:
+        import queue
+
+        self.queue: "queue.Queue" = queue.Queue()
+        self.thread = threading.Thread(
+            target=self._run, name="metrics-tpu-sync-dispatcher", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                box["value"] = fn()
+            except BaseException as exc:  # noqa: BLE001 — re-raised at force
+                box["error"] = exc
+            done.set()
+
+
+_dispatcher: Optional[_AsyncDispatcher] = None
+_dispatcher_lock = threading.Lock()
+#: The newest submitted closure's done event (FIFO worker: waiting on it
+#: covers everything submitted before it) — how :func:`drain_inflight` waits
+#: out CANCELLED work whose collective is still on the wire.
+_last_submitted_done: List[Optional["threading.Event"]] = [None]
+
+
+def submit_async(fn: Callable[[], Any]):
+    """Hand one collective closure to the async dispatcher thread; returns
+    ``(box, done)`` — the force side waits on ``done`` (under the watchdog
+    deadline via :func:`wait_with_deadline`) and reads the result or the
+    re-raisable error out of ``box``. The sanctioned async collective shape:
+    transports called under :func:`run_inflight` inside a closure submitted
+    here are deadline-guarded at the FORCE, not per-call (the invlint
+    collective-discipline pass recognizes both spellings)."""
+    global _dispatcher
+    with _dispatcher_lock:
+        if _dispatcher is None or not _dispatcher.thread.is_alive():
+            _dispatcher = _AsyncDispatcher()
+        box: dict = {}
+        done = threading.Event()
+        _dispatcher.queue.put((fn, box, done))
+        _last_submitted_done[0] = done
+        return box, done
+
+
+def _abandon_dispatcher() -> None:
+    global _dispatcher
+    with _dispatcher_lock:
+        stuck, _dispatcher = _dispatcher, None
+        # an abandoned dispatcher's pending work is WRITTEN OFF (standard
+        # watchdog semantics, same as run_with_deadline's retired worker):
+        # drain_inflight must not keep waiting out a collective the timeout
+        # already classified — the healed path re-enters on a fresh worker
+        _last_submitted_done[0] = None
+    if stuck is not None:
+        stuck.queue.put(None)  # poison: exit when (if ever) the hung call returns
+
+
+def run_inflight(fn: Callable[[], Any], *, site: str = "sync-gather") -> Any:
+    """The async twin of :func:`run_with_deadline`: a direct call, because an
+    in-flight collective's deadline is measured at the FORCE (the wall the
+    caller actually blocks on — the whole point of dispatching is that the
+    wire time itself is hidden), not per transport call on the dispatcher
+    thread. :func:`wait_with_deadline` owns the timeout; a closure running its
+    transports under this guard MUST be reached through :func:`submit_async`
+    (the invlint collective-discipline pass pins that pairing)."""
+    return fn()
+
+
+def wait_with_deadline(done: "threading.Event", *, site: str = "sync-force", owner: Any = None) -> None:
+    """Block until an in-flight collective's ``done`` event fires, under the
+    same watchdog deadline contract as :func:`run_with_deadline`
+    (``METRICS_TPU_SYNC_DEADLINE_MS``, default off = wait forever). On
+    timeout the stuck dispatcher is abandoned (replaced on next use), the
+    timeout folds into the membership registry (K consecutive → peer prober),
+    and the classified :class:`SyncTimeoutFault` raises with the caller's
+    local state untouched — the force degrades through the existing
+    quorum/local tier exactly like a blocking collective's timeout."""
+    deadline = sync_deadline_s()
+    if deadline is None:
+        done.wait()
+        return
+    if not done.wait(deadline):
+        _abandon_dispatcher()
+        _bump("sync_deadline_timeouts")
+        note_sync_timeout(site)
+        if _telemetry.armed:
+            _telemetry.emit(
+                "sync-timeout", owner, "sync", attrs={"site": site, "deadline_ms": deadline * 1000.0}
+            )
+        raise SyncTimeoutFault(
+            f"in-flight collective exceeded the {deadline * 1000.0:.0f} ms watchdog deadline "
+            f"at force (site {site!r}, METRICS_TPU_SYNC_DEADLINE_MS) — a peer rank is hung or "
+            "dead; local state is intact (nothing was applied) and the sync is retryable",
+            site=site,
+        )
+
+
+# -------------------------------------------------------------- the SyncFuture
+class SyncFuture:
+    """Handle to one asynchronously dispatched sync protocol.
+
+    Returned by ``Metric.sync_async()`` / ``MetricCollection.sync_async()``:
+    the packed payload collective is in flight on the dispatcher thread while
+    the caller keeps running ``update``/``forward`` compute. :meth:`wait`
+    forces it — blocks (under the watchdog deadline) until the collective
+    lands, **re-checks the epoch fence** (an in-flight future from a dead
+    world classifies as :class:`EpochFault` instead of pairing stale rows),
+    then unpacks and applies the merged states. ``compute()`` auto-forces a
+    pending future, so callers that never touch the future still get the
+    blocking protocol's semantics. Double-force is idempotent: after the
+    first :meth:`wait` completes (success or classified raise), subsequent
+    calls are no-ops. Local state is never touched while in flight — the
+    pack snapshots values at dispatch, and a failed force leaves every
+    accumulator bit-exact and retryable.
+    """
+
+    __slots__ = (
+        "owner", "dispatch_epoch", "dispatch_step", "quant_tier", "site",
+        "_force_fn", "_done", "_forced", "_cancelled",
+    )
+
+    def __init__(
+        self,
+        owner: Any,
+        force_fn: Optional[Callable[[], None]],
+        *,
+        done: Optional["threading.Event"] = None,
+        quant_tier: Optional[str] = None,
+        site: str = "sync-force",
+    ) -> None:
+        from metrics_tpu.ops import faults as _faults
+
+        self.owner = owner
+        self.dispatch_epoch = world_epoch()
+        self.dispatch_step = _faults.current_step()
+        self.quant_tier = quant_tier
+        self.site = site
+        self._force_fn = force_fn
+        self._done = done
+        self._forced = force_fn is None  # a completed (fallback) future
+        self._cancelled = False
+        if not self._forced:
+            _inflight.append(self)
+            _bump("sync_async_dispatches")
+
+    @classmethod
+    def completed(cls, owner: Any) -> "SyncFuture":
+        """An already-resolved future — returned when the async path fell
+        back to the blocking protocol at dispatch, so callers treat both
+        uniformly (``wait()`` is a no-op)."""
+        return cls(owner, None)
+
+    def done(self) -> bool:
+        """Whether the in-flight collective has landed (forcing will not
+        block on the wire). Completed/cancelled futures are trivially done."""
+        return self._forced or self._cancelled or self._done is None or self._done.is_set()
+
+    def age_steps(self) -> int:
+        """Monotonic fault/sync steps elapsed since dispatch — the staleness
+        axis ``sync_health()``'s ``inflight`` block reports."""
+        from metrics_tpu.ops import faults as _faults
+
+        return max(0, _faults.current_step() - self.dispatch_step)
+
+    def _clear_owner(self) -> None:
+        # a spent future must not keep blocking its owner's next sync: the
+        # owner registers the future under ``_pending_sync`` (including the
+        # already-completed blocking-fallback futures, so compute() treats
+        # both lanes uniformly) and the future deregisters itself when spent
+        owner = self.owner
+        if owner is not None and owner.__dict__.get("_pending_sync") is self:
+            object.__setattr__(owner, "_pending_sync", None)
+
+    def cancel(self) -> None:
+        """Abandon the future without applying its rows (``reset()`` calls
+        this: merged rows landing on top of a reset would resurrect cleared
+        state). The dispatcher's result is discarded when it arrives."""
+        if self._forced or self._cancelled:
+            return
+        self._cancelled = True
+        try:
+            _inflight.remove(self)
+        except ValueError:
+            pass
+        self._clear_owner()
+
+    def wait(self) -> None:
+        """Force the in-flight sync: block until the collective lands, fence,
+        unpack, apply. Idempotent — the second call is a no-op. Raises the
+        classified fault (``EpochFault`` on a fence trip at force,
+        ``SyncTimeoutFault`` on a force deadline, ``SyncFault`` on transport
+        exhaustion) with local state intact."""
+        if self._forced or self._cancelled:
+            self._clear_owner()
+            return
+        self._forced = True
+        try:
+            _inflight.remove(self)
+        except ValueError:
+            pass
+        _bump("sync_async_forces")
+        self._force_fn()
+        self._clear_owner()
+
+
+#: The process-local in-flight futures, dispatch order. Surfaced through
+#: :func:`inflight_stats` into ``telemetry_snapshot()['sync_health']`` (and
+#: thence the fleet plane).
+_inflight: List["SyncFuture"] = []
+
+
+def drain_inflight() -> int:
+    """Force every in-flight async sync, dispatch order, and return how many
+    were forced. Called at the entry of every BLOCKING collective protocol
+    (``gather_all_tensors``, ``coalesced_sync_nodes``, the fleet blob
+    gather): host collectives pair strictly by issue order, so a blocking
+    protocol racing the dispatcher thread could pair with DIFFERENT partners
+    on different ranks (rank A issues the in-flight payload first, rank B the
+    blocking one) — merged garbage or a distributed hang. Draining first
+    restores a total order: the in-flight collective completes and applies
+    on every rank before the blocking one issues. Forcing here is just the
+    documented force point arriving early; a classified force failure
+    (``EpochFault``, ``SyncTimeoutFault``) surfaces at this blocking call
+    site — still classified, local state still intact."""
+    n = 0
+    while _inflight:
+        _inflight[0].wait()
+        n += 1
+    # CANCELLED futures leave the registry but their collective may still be
+    # on the wire (the dispatcher cannot interrupt a blocking transport):
+    # the FIFO worker must go idle before a blocking collective issues, or
+    # the two could pair across ranks with different partners. Waiting on
+    # the newest submitted done event covers everything queued before it;
+    # the result is discarded either way. Rides the same force-side
+    # watchdog deadline (a hung cancelled collective abandons the
+    # dispatcher and raises classified, exactly like a hung force).
+    done = _last_submitted_done[0]
+    if done is not None and not done.is_set():
+        wait_with_deadline(done, site="sync-drain")
+    return n
+
+
+def inflight_stats() -> Dict[str, Any]:
+    """The in-flight-future health block: how many syncs are dispatched but
+    not yet forced, the oldest future's age in monotonic steps, and the epoch
+    the oldest was dispatched at (a dispatch epoch behind the live epoch
+    means the force WILL fence-trip — alert before it does). Every numeric
+    key is a gauge (futures force and leave)."""
+    oldest = _inflight[0] if _inflight else None
+    return {
+        "count": len(_inflight),
+        "oldest_age_steps": oldest.age_steps() if oldest is not None else 0,
+        "oldest_dispatch_epoch": oldest.dispatch_epoch if oldest is not None else 0,
+    }
 
 
 # ------------------------------------------------------ world membership/epochs
@@ -685,6 +1008,21 @@ _counters: dict = {
     "sync_stale_collectives": 0,
     "sync_peers_declared_dead": 0,
     "sync_rank_rejoins": 0,
+    # the async pipelined lane (dispatch/force split)
+    "sync_async_dispatches": 0,
+    "sync_async_forces": 0,
+    "sync_async_auto_forces": 0,
+    "sync_async_fallbacks": 0,
+    "sync_async_stale_futures": 0,
+    # the quantized payload lane (METRICS_TPU_SYNC_QUANT)
+    "sync_quant_payloads": 0,
+    "sync_quant_exact_states": 0,
+    "sync_quant_lossy_states": 0,
+    "sync_quant_bytes_saved": 0,
+    # the hierarchical payload topology (METRICS_TPU_SYNC_HIER)
+    "sync_hier_intranode_collectives": 0,
+    "sync_hier_internode_collectives": 0,
+    "sync_hier_node_reduces": 0,
 }
 
 
@@ -809,6 +1147,9 @@ def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[j
     """
     from metrics_tpu.ops import faults as _faults
 
+    # collectives pair by issue order: any in-flight async sync must land
+    # before a blocking one issues (see drain_inflight)
+    drain_inflight()
     members = validate_group_live(group)
     # epoch fence: the protocol pairs with the cohort that existed NOW; a
     # membership change before any (re)issued collective trips check_epoch
@@ -878,7 +1219,15 @@ __all__ = [
     "sync_deadline_s",
     "sync_dead_after",
     "sync_degraded_tier",
+    "sync_quant_tier",
+    "sync_hier_node_size",
     "run_with_deadline",
+    "run_inflight",
+    "submit_async",
+    "wait_with_deadline",
+    "SyncFuture",
+    "inflight_stats",
+    "drain_inflight",
     "note_collective",
     "collective_stats",
     "reset_collective_stats",

@@ -272,8 +272,12 @@ def test_snapshot_schema_superset_and_stable():
         "sync_deadline_timeouts",
         "slo_violations",
         "fault_domain_counts",
+        # the in-flight async-sync block (ISSUE 13): count + oldest future
+        # age/dispatch-epoch gauges from the SyncFuture registry
+        "inflight",
         "transitions",
     }
+    assert set(health["inflight"]) == {"count", "oldest_age_steps", "oldest_dispatch_epoch"}
     # the per-phase sync span statistics (the fleet straggler input) cover
     # every documented phase, schema-stable
     stats = snap["sync_phase_stats"]

@@ -1,0 +1,672 @@
+"""Async pipelined sync (dispatch/force split) vs the blocking oracle.
+
+The async lane (``Metric.sync_async`` / ``MetricCollection.sync_async`` →
+``SyncFuture``) must be observationally identical to the blocking protocol:
+the forced value BIT-EXACT against the ``_FakeGather`` per-state rank-walk
+oracle, compute() auto-forcing a pending future, double-force idempotent,
+local state intact and retryable across every failure path (force deadline,
+fence trip at force), and the quantized payload lane
+(``METRICS_TPU_SYNC_QUANT``) exact for integer count states, within
+tolerance for float states, warning once on a garbage value. The
+multi-process world is simulated at the transport hooks exactly like
+``test_coalesced_sync.py``.
+"""
+from __future__ import annotations
+
+import copy
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, faults
+from metrics_tpu.parallel import bucketing
+from metrics_tpu.parallel import sync as psync
+from metrics_tpu.utils.exceptions import EpochFault, MetricsUserError, SyncTimeoutFault
+from tests.helpers.testers import _FakeGather
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SYNC_BACKOFF_MS", "0")
+    yield
+    psync.reset_membership()
+
+
+DIST_ON = lambda: True  # noqa: E731
+
+
+def _install_world(monkeypatch, rank_node_lists):
+    """Simulate an N-process world at the transport hooks: rank 0 is the live
+    syncing instance; the other ranks' trees pack lazily through the SAME
+    layout/pack/quantize code at collective time."""
+    cache = {}
+
+    def _rank_packs():
+        if "packs" not in cache:
+            packs, vecs = [], []
+            for nodes in rank_node_lists[1:]:
+                for n in nodes:
+                    n._canonicalize_list_states()
+                entries, values = bucketing._collect(nodes)
+                tier = psync.sync_quant_tier()
+                if tier is not None:
+                    bucketing._quant_encode(entries, values, tier, nodes[0])
+                p, v = bucketing._pack(entries, values)
+                packs.append(p)
+                vecs.append(v)
+            cache["packs"], cache["vecs"] = packs, vecs
+        return cache["packs"], cache["vecs"]
+
+    def host(vec):
+        _, vecs = _rank_packs()
+        return np.stack([np.asarray(vec)] + [np.asarray(v) for v in vecs])
+
+    def payload(x):
+        packs, _ = _rank_packs()
+        pad_to = int(x.shape[0])
+        return jnp.stack([x] + [jnp.pad(p, (0, pad_to - int(p.shape[0]))) for p in packs])
+
+    monkeypatch.setattr(bucketing, "_host_allgather", host)
+    monkeypatch.setattr(bucketing, "_payload_allgather", payload)
+
+
+def _oracle_sync(rank_metrics):
+    """The blocking per-state protocol on deep copies: the reference walk."""
+    copies = [copy.deepcopy(m) for m in rank_metrics]
+    copies[0].sync(dist_sync_fn=_FakeGather(copies), distributed_available=DIST_ON)
+    return copies[0]
+
+
+def _mean_ranks(n=3):
+    ranks = []
+    for r in range(n):
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([1.0 + r, 4.0 * (r + 1)]))
+        ranks.append(m)
+    return ranks
+
+
+class TestAsyncBitExact:
+    def test_overlapped_sync_bitexact_vs_blocking_oracle(self, monkeypatch):
+        ranks = _mean_ranks()
+        oracle = _oracle_sync(ranks)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        s0 = engine.engine_stats()
+        fut = ranks[0].sync_async(distributed_available=DIST_ON)
+        assert fut is not None and not fut._forced
+        fut.wait()
+        s1 = engine.engine_stats()
+        assert s1["sync_async_dispatches"] - s0["sync_async_dispatches"] == 1
+        assert s1["sync_async_forces"] - s0["sync_async_forces"] == 1
+        assert s1["sync_payload_collectives"] - s0["sync_payload_collectives"] == 1
+        assert ranks[0]._is_synced
+        for name in ranks[0].metric_state:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ranks[0], name)), np.asarray(getattr(oracle, name))
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ranks[0].compute()), np.asarray(oracle.compute())
+        )
+        ranks[0].unsync()
+        # zero stale collectives across the whole cycle: the fence held
+        assert engine.engine_stats()["sync_stale_collectives"] == s0["sync_stale_collectives"]
+
+    def test_compute_before_force_auto_waits(self, monkeypatch):
+        ranks = _mean_ranks()
+        oracle_val = float(_oracle_sync(ranks).compute())
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        s0 = engine.engine_stats()["sync_async_auto_forces"]
+        ranks[0].sync_async(distributed_available=DIST_ON)
+        # no explicit wait(): compute() is the force point
+        assert float(ranks[0].compute()) == oracle_val
+        assert engine.engine_stats()["sync_async_auto_forces"] == s0 + 1
+        # the auto-forced cycle mirrored the blocking auto-sync: local state
+        # restored after the value was computed and cached
+        assert not ranks[0]._is_synced
+        assert ranks[0].__dict__.get("_pending_sync") is None
+
+    def test_double_force_idempotent(self, monkeypatch):
+        ranks = _mean_ranks()
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        fut = ranks[0].sync_async(distributed_available=DIST_ON)
+        fut.wait()
+        state = {k: np.asarray(v) for k, v in ranks[0].metric_state.items()}
+        forces = engine.engine_stats()["sync_async_forces"]
+        fut.wait()  # idempotent: no second apply, no error, no counter
+        fut.wait()
+        assert engine.engine_stats()["sync_async_forces"] == forces
+        for k, v in ranks[0].metric_state.items():
+            np.testing.assert_array_equal(np.asarray(v), state[k])
+        ranks[0].unsync()
+
+    def test_inflight_tail_updates_restore_through_unsync(self, monkeypatch):
+        ranks = _mean_ranks()
+        oracle = _oracle_sync(ranks)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        m = ranks[0]
+        fut = m.sync_async(distributed_available=DIST_ON)
+        # overlap window: a tail update lands locally while the wire flies
+        m.update(jnp.asarray([100.0]))
+        tail_state = {k: np.asarray(v) for k, v in m.metric_state.items()}
+        fut.wait()
+        # the forced (merged) value reflects the DISPATCH point
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(oracle.compute()))
+        m.unsync()
+        # ...and the tail restores through unsync
+        for k, v in m.metric_state.items():
+            np.testing.assert_array_equal(np.asarray(v), tail_state[k])
+
+    def test_dispatch_while_pending_raises(self, monkeypatch):
+        ranks = _mean_ranks()
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        fut = ranks[0].sync_async(distributed_available=DIST_ON)
+        with pytest.raises(MetricsUserError, match="in flight"):
+            ranks[0].sync_async(distributed_available=DIST_ON)
+        with pytest.raises(MetricsUserError, match="in flight"):
+            ranks[0].sync(distributed_available=DIST_ON)
+        fut.wait()
+        ranks[0].unsync()
+
+    def test_suite_async_bitexact_and_auto_force(self, monkeypatch):
+        rng = np.random.RandomState(3)
+        p = rng.rand(48).astype(np.float32)
+        t = rng.randint(0, 2, 48)
+
+        def make():
+            c = mt.MetricCollection({"mean": mt.MeanMetric(), "acc": mt.Accuracy()})
+            c.update(jnp.asarray(p), jnp.asarray(t))
+            return c
+
+        suites = [make() for _ in range(3)]
+        # blocking oracle: the identical fake world, blocking suite sync
+        oracles = [make() for _ in range(3)]
+
+        def trees(suite_list):
+            return [
+                [
+                    n
+                    for _, m in s.items(keep_base=True, copy_state=False)
+                    for n in bucketing.tree_nodes(m)
+                ]
+                for s in suite_list
+            ]
+
+        _install_world(monkeypatch, trees(oracles))
+        oracles[0].sync(distributed_available=DIST_ON)
+        oracle_vals = {k: np.asarray(v) for k, v in oracles[0].compute().items()}
+        oracles[0].unsync()
+
+        _install_world(monkeypatch, trees(suites))
+        fut = suites[0].sync_async(distributed_available=DIST_ON)
+        assert fut is not None
+        got = {k: np.asarray(v) for k, v in suites[0].compute().items()}
+        for k, v in oracle_vals.items():
+            np.testing.assert_array_equal(got[k], v)
+        # compute auto-forced and unsynced the suite
+        assert suites[0].__dict__.get("_pending_sync") is None
+        for _, m in suites[0].items(keep_base=True, copy_state=False):
+            assert not m._is_synced
+
+    def test_blocking_sync_drains_inflight_first(self, monkeypatch):
+        # collectives pair by issue order: a blocking protocol entered while
+        # another owner's async sync is in flight must drain (force) it
+        # first, or the two could pair with different partners across ranks
+        ranks = _mean_ranks()
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        m1 = ranks[0]
+        fut = m1.sync_async(distributed_available=DIST_ON)
+        assert psync.inflight_stats()["count"] == 1
+        other = mt.MeanMetric()
+        other.update(jnp.asarray([5.0, 7.0]))
+        other.sync(distributed_available=DIST_ON)  # blocking: drains m1 first
+        assert psync.inflight_stats()["count"] == 0
+        assert m1._is_synced and fut._forced
+        other.unsync()
+        m1.unsync()
+
+    def test_member_compute_during_suite_flight_no_double_merge(self, monkeypatch):
+        # a member computing while its COLLECTION's future is in flight: the
+        # drain at the sync-context entry forces the suite rows first and
+        # the member computes presynced — it must NOT re-sync its already-
+        # merged state (which would double the merged counts)
+        rng = np.random.RandomState(5)
+        p = rng.rand(48).astype(np.float32)
+        t = rng.randint(0, 2, 48)
+
+        def make():
+            c = mt.MetricCollection({"mean": mt.MeanMetric(), "acc": mt.Accuracy()})
+            c.update(jnp.asarray(p), jnp.asarray(t))
+            return c
+
+        suites = [make() for _ in range(2)]
+        oracles = [make() for _ in range(2)]
+
+        def trees(ss):
+            return [
+                [
+                    n
+                    for _, m in s.items(keep_base=True, copy_state=False)
+                    for n in bucketing.tree_nodes(m)
+                ]
+                for s in ss
+            ]
+
+        _install_world(monkeypatch, trees(oracles))
+        oracles[0].sync(distributed_available=DIST_ON)
+        oracle_mean = float(oracles[0]["mean"].compute())
+        oracles[0].unsync()
+
+        _install_world(monkeypatch, trees(suites))
+        import metrics_tpu.metric as metric_mod
+
+        monkeypatch.setattr(metric_mod, "_dist_available", lambda: True)
+        fut = suites[0].sync_async(distributed_available=DIST_ON)
+        assert fut is not None
+        # the member's own compute while the suite future is in flight
+        got = float(suites[0]["mean"].compute())
+        assert got == oracle_mean, f"member compute double-merged: {got} != {oracle_mean}"
+        assert fut._forced  # the drain at sync-context entry forced it
+
+    def test_cancel_still_blocks_next_collective_until_wire_idle(self, monkeypatch):
+        # a CANCELLED future's collective may still be on the wire — the
+        # next blocking sync must wait the dispatcher out, not race it
+        ranks = _mean_ranks(2)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        real_payload = bucketing._payload_allgather
+        calls = []
+
+        def slow_payload(x):
+            calls.append(("start", time.perf_counter()))
+            time.sleep(0.15)
+            calls.append(("end", time.perf_counter()))
+            return real_payload(x)
+
+        monkeypatch.setattr(bucketing, "_payload_allgather", slow_payload)
+        m = ranks[0]
+        m.sync_async(distributed_available=DIST_ON)
+        m.reset()  # cancels the future; the slow gather is still flying
+        assert psync.inflight_stats()["count"] == 0
+        other = mt.MeanMetric()
+        other.update(jnp.asarray([5.0, 7.0]))
+        other.sync(distributed_available=DIST_ON)  # must wait out the wire first
+        other.unsync()
+        # two gathers ran, STRICTLY serialized: the blocking one started
+        # only after the cancelled in-flight one ended
+        assert len(calls) == 4, calls
+        (k0, _), (k1, t_end_cancelled), (k2, t_start_blocking), _ = calls
+        assert (k0, k1, k2) == ("start", "end", "start")
+        assert t_start_blocking >= t_end_cancelled, "blocking sync raced the cancelled wire"
+
+    def test_fallback_future_auto_unsyncs_at_compute(self, monkeypatch):
+        # the blocking-fallback future is registered like a live one: the
+        # compute() auto-force path must unsync after serving, leaving the
+        # metric in the same state as the truly-async lane
+        monkeypatch.setenv("METRICS_TPU_SYNC_COALESCE", "0")
+        ranks = _mean_ranks(2)
+        oracle_val = float(_oracle_sync(ranks).compute())
+        m = ranks[0]
+        fut = m.sync_async(dist_sync_fn=_FakeGather(ranks), distributed_available=DIST_ON)
+        assert fut.done() and m.__dict__.get("_pending_sync") is fut
+        assert float(m.compute()) == oracle_val
+        assert not m._is_synced, "fallback lane left the metric synced after compute"
+        assert m.__dict__.get("_pending_sync") is None
+        # the cycle closed: a fresh dispatch must not raise "in flight"
+        m._computed = None
+        fut2 = m.sync_async(dist_sync_fn=_FakeGather(ranks), distributed_available=DIST_ON)
+        fut2.wait()
+        m.unsync()
+
+    def test_dispatch_pack_fault_demotes_and_replays_blocking(self, monkeypatch):
+        # a pack failure at DISPATCH must demote the sync-pack lane and
+        # replay the blocking protocol, exactly like the blocking paths —
+        # never leak the internal CoalesceError to the caller. The oracle is
+        # the BLOCKING twin under the identical injected fault (in this fake
+        # world the per-state replay is the single-process identity — the
+        # hooks only simulate the coalesced transports — so twin-vs-twin is
+        # the apples-to-apples comparison).
+        ranks = _mean_ranks(2)
+        twin = copy.deepcopy(ranks[0])
+        with faults.inject_faults("sync-pack", count=1):
+            with pytest.warns(UserWarning, match="Coalesced sync failed"):
+                twin.sync(distributed_available=DIST_ON)
+        twin_val = np.asarray(twin.compute())
+        twin.unsync()
+
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        m = ranks[0]
+        with faults.inject_faults("sync-pack", count=1) as plan:
+            with pytest.warns(UserWarning, match="dispatch"):
+                fut = m.sync_async(distributed_available=DIST_ON)
+        assert plan.fired >= 1
+        assert fut is not None and fut.done()
+        assert m._is_synced  # the blocking replay completed the sync
+        np.testing.assert_array_equal(np.asarray(m.compute()), twin_val)
+        # the registered fallback future made compute() auto-unsync —
+        # the same end state as the truly-async lane
+        assert not m._is_synced
+        lad = m.__dict__.get("_fault_ladders", {}).get("sync-pack")
+        assert lad is not None and lad.demoted
+
+    def test_fallback_to_blocking_when_not_coalescible(self, monkeypatch):
+        # METRICS_TPU_SYNC_COALESCE=0: the async lane cannot pack — the
+        # blocking protocol runs at dispatch and a completed future returns
+        monkeypatch.setenv("METRICS_TPU_SYNC_COALESCE", "0")
+        ranks = _mean_ranks(2)
+        oracle = _oracle_sync(ranks)
+        fb0 = engine.engine_stats()["sync_async_fallbacks"]
+        fut = ranks[0].sync_async(
+            dist_sync_fn=_FakeGather(ranks), distributed_available=DIST_ON
+        )
+        assert fut is not None and fut.done()
+        fut.wait()  # no-op on a completed future
+        assert engine.engine_stats()["sync_async_fallbacks"] == fb0 + 1
+        assert ranks[0]._is_synced
+        np.testing.assert_array_equal(
+            np.asarray(ranks[0].compute()), np.asarray(oracle.compute())
+        )
+        ranks[0].unsync()
+
+
+class TestForceFaults:
+    def test_fence_trip_at_force_classified_state_intact(self, monkeypatch):
+        ranks = _mean_ranks()
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        m = ranks[0]
+        before = {k: np.asarray(v) for k, v in m.metric_state.items()}
+        s0 = engine.engine_stats()
+        fut = m.sync_async(distributed_available=DIST_ON)
+        # membership changes between dispatch and force: the in-flight
+        # future is from a dead world — the force must classify, not pair
+        psync.bump_epoch("test-membership-race")
+        with pytest.raises(EpochFault):
+            fut.wait()
+        s1 = engine.engine_stats()
+        assert s1["sync_epoch_fence_trips"] > s0["sync_epoch_fence_trips"]
+        assert s1["sync_stale_collectives"] == s0["sync_stale_collectives"]
+        assert not m._is_synced
+        for k, v in m.metric_state.items():
+            np.testing.assert_array_equal(np.asarray(v), before[k])
+        # spent future: the second wait is a no-op, and a fresh sync at the
+        # current epoch succeeds
+        fut.wait()
+        m.sync(distributed_available=DIST_ON)
+        m.unsync()
+
+    def test_force_deadline_timeout_classified_state_intact(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", "80")
+        ranks = _mean_ranks(2)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+
+        def hung(x):
+            time.sleep(0.5)
+            raise RuntimeError("abandoned hung collective (force deadline fired long ago)")
+
+        monkeypatch.setattr(bucketing, "_payload_allgather", hung)
+        m = ranks[0]
+        before = {k: np.asarray(v) for k, v in m.metric_state.items()}
+        t0 = engine.engine_stats()["sync_deadline_timeouts"]
+        fut = m.sync_async(distributed_available=DIST_ON)
+        with pytest.raises(SyncTimeoutFault):
+            fut.wait()
+        assert engine.engine_stats()["sync_deadline_timeouts"] > t0
+        assert not m._is_synced
+        for k, v in m.metric_state.items():
+            np.testing.assert_array_equal(np.asarray(v), before[k])
+
+    def test_force_timeout_degrades_through_local_tier(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEADLINE_MS", "80")
+        monkeypatch.setenv("METRICS_TPU_SYNC_DEGRADED", "local")
+        ranks = _mean_ranks(2)
+        local_val = float(copy.deepcopy(ranks[0]).compute())
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+
+        def hung(x):
+            time.sleep(0.5)
+            raise RuntimeError("abandoned hung collective")
+
+        monkeypatch.setattr(bucketing, "_payload_allgather", hung)
+        m = ranks[0]
+        fut = m.sync_async(distributed_available=DIST_ON)
+        assert fut is not None
+        with pytest.warns(UserWarning, match="LOCAL-ONLY"):
+            served = float(m.compute())
+        assert served == local_val
+        health = m.sync_health()
+        assert health["degraded"] and health["degraded_serves"] >= 1
+
+    def test_reset_cancels_inflight_future(self, monkeypatch):
+        ranks = _mean_ranks()
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        m = ranks[0]
+        fut = m.sync_async(distributed_available=DIST_ON)
+        m.reset()
+        assert m.__dict__.get("_pending_sync") is None
+        fut.wait()  # cancelled: a no-op, nothing applied on the reset state
+        assert not m._is_synced
+        assert float(np.asarray(m.weight)) == 0.0
+
+
+class TestSyncHealthInflight:
+    def test_inflight_block_surfaces(self, monkeypatch):
+        ranks = _mean_ranks()
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        m = ranks[0]
+        assert m.sync_health()["inflight"] is None
+        fut = m.sync_async(distributed_available=DIST_ON)
+        block = m.sync_health()["inflight"]
+        assert block is not None
+        assert block["dispatch_epoch"] == fut.dispatch_epoch
+        assert block["age_steps"] >= 0 and block["quant_tier"] is None
+        # the global plane carries the registry view
+        from metrics_tpu.ops import telemetry
+
+        snap_inflight = telemetry.snapshot()["sync_health"]["inflight"]
+        assert snap_inflight["count"] >= 1
+        fut.wait()
+        assert m.sync_health()["inflight"] is None
+        assert telemetry.snapshot()["sync_health"]["inflight"]["count"] == 0
+        m.unsync()
+
+
+class TestQuantLane:
+    def test_integer_states_exact_under_any_tier(self, monkeypatch):
+        rng = np.random.RandomState(0)
+        for tier in ("bf16", "int8"):
+            ranks = []
+            for r in range(3):
+                m = mt.ConfusionMatrix(num_classes=4)
+                m.update(jnp.asarray(rng.randint(0, 4, 32)), jnp.asarray(rng.randint(0, 4, 32)))
+                ranks.append(m)
+            oracle = _oracle_sync(ranks)  # quant off: the bit-exact protocol
+            monkeypatch.setenv("METRICS_TPU_SYNC_QUANT", tier)
+            _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+            s0 = engine.engine_stats()
+            ranks[0].sync(distributed_available=DIST_ON)
+            s1 = engine.engine_stats()
+            # every state routed the exact carve-out: integer counts
+            assert s1["sync_quant_exact_states"] > s0["sync_quant_exact_states"]
+            assert s1["sync_quant_lossy_states"] == s0["sync_quant_lossy_states"]
+            np.testing.assert_array_equal(
+                np.asarray(ranks[0].compute()), np.asarray(oracle.compute())
+            )
+            ranks[0].unsync()
+            monkeypatch.delenv("METRICS_TPU_SYNC_QUANT")
+
+    def test_float_states_within_tolerance_and_fewer_bytes(self, monkeypatch):
+        rng = np.random.RandomState(7)
+
+        def make_ranks():
+            ranks = []
+            for r in range(3):
+                m = mt.BinnedPrecisionRecallCurve(num_classes=2, thresholds=11)
+                probs = rng.rand(32, 2).astype(np.float32)
+                probs /= probs.sum(axis=1, keepdims=True)
+                m.update(jnp.asarray(probs), jnp.asarray(rng.randint(0, 2, 32)))
+                # BinnedPrecisionRecallCurve state dtypes are float vectors —
+                # the lossy lane's target shape
+                return_ranks = m
+                ranks.append(m)
+            return ranks
+
+        exact_ranks = make_ranks()
+        rng = np.random.RandomState(7)
+        quant_ranks = make_ranks()
+        # exact baseline
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in exact_ranks])
+        b0 = engine.engine_stats()["sync_bytes_gathered"]
+        exact_ranks[0].sync(distributed_available=DIST_ON)
+        exact_bytes = engine.engine_stats()["sync_bytes_gathered"] - b0
+        exact_vals = [np.asarray(v) for v in exact_ranks[0].compute()[0]]
+        exact_ranks[0].unsync()
+        # bf16 lane
+        monkeypatch.setenv("METRICS_TPU_SYNC_QUANT", "bf16")
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in quant_ranks])
+        s0 = engine.engine_stats()
+        quant_ranks[0].sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        quant_bytes = s1["sync_bytes_gathered"] - s0["sync_bytes_gathered"]
+        assert s1["sync_quant_lossy_states"] > s0["sync_quant_lossy_states"]
+        assert s1["sync_quant_bytes_saved"] > s0["sync_quant_bytes_saved"]
+        assert quant_bytes < exact_bytes
+        quant_vals = [np.asarray(v) for v in quant_ranks[0].compute()[0]]
+        quant_ranks[0].unsync()
+        for e, q in zip(exact_vals, quant_vals):
+            np.testing.assert_allclose(q, e, atol=2e-2)
+
+    def test_async_quant_tier_rides_the_future(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_QUANT", "bf16")
+        ranks = _mean_ranks()
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        fut = ranks[0].sync_async(distributed_available=DIST_ON)
+        assert fut.quant_tier == "bf16"
+        assert ranks[0].sync_health()["inflight"]["quant_tier"] == "bf16"
+        fut.wait()
+        ranks[0].unsync()
+
+    def test_env_garbage_warns_once_naming_value(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_QUANT", "fp4")
+        monkeypatch.setattr(psync, "_QUANT_WARN_OWNER", psync._EnvWarnOwner())
+        with pytest.warns(UserWarning, match="fp4"):
+            assert psync.sync_quant_tier() is None
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            assert psync.sync_quant_tier() is None
+
+
+class TestHierarchicalLane:
+    def test_two_node_psum_lane_bitexact(self, monkeypatch):
+        rng = np.random.RandomState(1)
+        ranks = []
+        for r in range(4):
+            m = mt.ConfusionMatrix(num_classes=3)
+            m.update(jnp.asarray(rng.randint(0, 3, 16)), jnp.asarray(rng.randint(0, 3, 16)))
+            ranks.append(m)
+        flat_oracle = sum(np.asarray(m.confmat) for m in ranks)
+        trees = [bucketing.tree_nodes(m) for m in ranks]
+
+        def pack_tree(nodes):
+            for n in nodes:
+                n._canonicalize_list_states()
+            e, v = bucketing._collect(nodes)
+            return bucketing._pack(e, v)[0]
+
+        ctx_box = {}
+        orig_pack_phase = bucketing._pack_phase
+
+        def spy_pack_phase(*a, **k):
+            ctx = orig_pack_phase(*a, **k)
+            ctx_box["ctx"] = ctx
+            return ctx
+
+        monkeypatch.setattr(bucketing, "_pack_phase", spy_pack_phase)
+
+        def intranode(x):  # node 0 = ranks {0, 1}
+            return jnp.stack([x, pack_tree(trees[1])])
+
+        def internode(block):  # node 1's leader reduced ranks {2, 3}
+            intra2 = jnp.stack([pack_tree(trees[2]), pack_tree(trees[3])])
+            other = bucketing._node_reduce(ctx_box["ctx"], intra2)
+            return jnp.stack([block, other])
+
+        monkeypatch.setattr(bucketing, "_intranode_allgather", intranode)
+        monkeypatch.setattr(bucketing, "_internode_allgather", internode)
+        monkeypatch.setenv("METRICS_TPU_SYNC_HIER", "2")
+        s0 = engine.engine_stats()
+        ranks[0].sync(distributed_available=DIST_ON)
+        s1 = engine.engine_stats()
+        assert s1["sync_hier_intranode_collectives"] - s0["sync_hier_intranode_collectives"] == 1
+        assert s1["sync_hier_internode_collectives"] - s0["sync_hier_internode_collectives"] == 1
+        assert s1["sync_hier_node_reduces"] - s0["sync_hier_node_reduces"] == 1
+        assert s1["sync_stale_collectives"] == s0["sync_stale_collectives"]
+        np.testing.assert_array_equal(np.asarray(ranks[0].compute()), flat_oracle)
+        ranks[0].unsync()
+
+    def test_two_stage_gather_bitexact_for_float_layouts(self, monkeypatch):
+        # float sum states decline the psum reduce (reassociation) but still
+        # ride the bit-exact two-stage block gather
+        ranks = _mean_ranks(4)
+        oracle = _oracle_sync(ranks)
+        trees = [bucketing.tree_nodes(m) for m in ranks]
+
+        def pack_tree(nodes):
+            for n in nodes:
+                n._canonicalize_list_states()
+            e, v = bucketing._collect(nodes)
+            return bucketing._pack(e, v)[0]
+
+        def intranode(x):
+            return jnp.stack([x, pack_tree(trees[1])])
+
+        def internode(block):
+            other = jnp.concatenate([pack_tree(trees[2]), pack_tree(trees[3])])
+            return jnp.stack([block, other])
+
+        monkeypatch.setattr(bucketing, "_intranode_allgather", intranode)
+        monkeypatch.setattr(bucketing, "_internode_allgather", internode)
+        monkeypatch.setenv("METRICS_TPU_SYNC_HIER", "2")
+        s0 = engine.engine_stats()["sync_hier_node_reduces"]
+        ranks[0].sync(distributed_available=DIST_ON)
+        assert engine.engine_stats()["sync_hier_node_reduces"] == s0  # no reduce: floats
+        np.testing.assert_array_equal(
+            np.asarray(ranks[0].compute()), np.asarray(oracle.compute())
+        )
+        ranks[0].unsync()
+
+
+class TestPerfAttribution:
+    def test_wire_hidden_fraction_on_slow_transport(self, monkeypatch):
+        from metrics_tpu import perf_report
+        from metrics_tpu.ops import telemetry
+
+        ranks = _mean_ranks(2)
+        _install_world(monkeypatch, [bucketing.tree_nodes(m) for m in ranks])
+        real_payload = bucketing._payload_allgather
+
+        def slow_payload(x):  # the simulated tunnel round trip
+            time.sleep(0.05)
+            return real_payload(x)
+
+        monkeypatch.setattr(bucketing, "_payload_allgather", slow_payload)
+        was_armed = telemetry.armed
+        telemetry.set_telemetry(True)
+        try:
+            telemetry.clear_spans()
+            fut = ranks[0].sync_async(distributed_available=DIST_ON)
+            # the overlap window: host compute longer than the wire
+            other = mt.MeanMetric()
+            deadline = time.perf_counter() + 0.1
+            while time.perf_counter() < deadline:
+                other.update(jnp.asarray([1.0]))
+            fut.wait()
+            report = perf_report()
+            wire = report["sync"]["wire"]
+            assert wire["overlapped_wire_s"] > 0
+            assert wire["wire_hidden_fraction"] >= 0.5, wire
+        finally:
+            telemetry.set_telemetry(was_armed)
+        ranks[0].unsync()

@@ -827,6 +827,51 @@ def main() -> None:
         finally:
             os.environ.pop("METRICS_TPU_SYNC_COALESCE", None)
 
+    # async_sync_overlap row (ISSUE 13): the wire off the critical path —
+    # wire_hidden_fraction is what sweep_regress gates round over round (a
+    # healthy fraction collapsing below 0.5 means the overlap broke); the
+    # full overlap methodology (simulated slow transport, sized window)
+    # lives in bench.py bench_async_sync_overlap, reused here verbatim.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_async_sync_overlap()
+        row = {
+            "metric": "async_sync(overlap)",
+            "mode": "sync",
+            "updates_per_s": round(probe["async_steps_per_s"], 1),
+            "blocking_updates_per_s": round(probe["blocking_steps_per_s"], 1),
+            "overlap_speedup": round(probe["overlap_speedup"], 3),
+            "wire_hidden_fraction": round(probe["wire_hidden_fraction"], 4),
+            "simulated_rtt_ms": probe["simulated_rtt_ms"],
+            "updates_per_cycle": probe["updates_per_cycle"],
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "async_sync(overlap)", "error": str(err)[:160]}))
+
+    # sync_quant_payload row (ISSUE 13): bytes on the wire per suite sync
+    # under the quantized lanes (bf16/int8 vs f32), archived so a round can
+    # prove the payload shrank (and by how much) without rerunning bench.py.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_sync_quant_payload()
+        row = {
+            "metric": "suite_sync(quant_payload)",
+            "mode": "sync",
+            "f32_bytes_per_sync": probe["f32_bytes_per_sync"],
+            "bf16_bytes_per_sync": probe["bf16_bytes_per_sync"],
+            "int8_bytes_per_sync": probe["int8_bytes_per_sync"],
+            "bf16_reduction": round(probe["bf16_reduction"], 3),
+            "int8_reduction": round(probe["int8_reduction"], 3),
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "suite_sync(quant_payload)", "error": str(err)[:160]}))
+
     # telemetry-armed row (ISSUE 7): the deferred Accuracy loop re-run with
     # the flight recorder ON, exporting + validating a Chrome-trace at the
     # end — pins that a trace-enabled sweep run stays in the deferred rows'

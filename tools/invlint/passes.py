@@ -38,7 +38,25 @@ from tools.invlint.core import (
 #: enforced where these names are CALLED; their own definitions are the seam
 #: and are exempt (the guard belongs to the protocol, not the primitive).
 TRANSPORT_PRIMITIVES = frozenset(
-    {"process_allgather", "_host_allgather", "_payload_allgather"}
+    {
+        "process_allgather",
+        "_host_allgather",
+        "_payload_allgather",
+        "_intranode_allgather",
+        "_internode_allgather",
+    }
+)
+
+#: The sanctioned blocking-guard spellings. ``run_with_deadline`` is the
+#: per-call watchdog; ``run_inflight`` is its async twin — a transport under
+#: it runs on the dispatcher thread of a closure reached via ``submit_async``,
+#: and the watchdog deadline is applied at the FORCE (``wait_with_deadline``),
+#: the only wall the caller actually blocks on. ``_guarded`` is the
+#: mode-dispatching wrapper in ``parallel/bucketing.py`` that picks between
+#: them. A transport call lexically inside an argument of any of these (or
+#: inside a function whose name is called there) is deadline-guarded.
+DEADLINE_GUARD_CALLS = frozenset(
+    {"run_with_deadline", "run_inflight", "_guarded", "submit_async"}
 )
 
 #: Handler calls that count as routing a caught exception through the fault
@@ -59,15 +77,18 @@ PROM_NAME = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
 
 # --------------------------------------------------------------- pass 1: collectives
 def _deadline_delegated_names(mod: Module) -> Set[str]:
-    """Function names CALLED inside an argument of a ``run_with_deadline``
-    call — their bodies execute under the watchdog even though the guard is
-    lexically at the caller (e.g. ``run_with_deadline(lambda: _gather_once(...))``).
-    Only call-position names (and bare callables passed directly) count:
-    sweeping up every identifier in the argument would exempt any function
-    that happens to share a name with a forwarded variable."""
+    """Function names CALLED inside an argument of a guard call
+    (:data:`DEADLINE_GUARD_CALLS`) — their bodies execute under the watchdog
+    even though the guard is lexically at the caller (e.g.
+    ``run_with_deadline(lambda: _gather_once(...))``, or the async shape
+    ``submit_async(lambda: retry_with_backoff(attempt, ...))`` whose deadline
+    lands at the force). Only call-position names (and bare callables passed
+    directly) count: sweeping up every identifier in the argument would
+    exempt any function that happens to share a name with a forwarded
+    variable."""
     names: Set[str] = set()
     for call in walk_calls(mod.tree):
-        if call_name(call) != "run_with_deadline":
+        if call_name(call) not in DEADLINE_GUARD_CALLS:
             continue
         for arg in list(call.args) + [kw.value for kw in call.keywords]:
             # a bare callable handed straight to the guard
@@ -84,7 +105,7 @@ def _deadline_delegated_names(mod: Module) -> Set[str]:
 
 def _is_deadline_guarded(mod: Module, call: ast.Call, delegated: Set[str]) -> bool:
     for anc in mod.ancestors(call):
-        if isinstance(anc, ast.Call) and call_name(anc) == "run_with_deadline":
+        if isinstance(anc, ast.Call) and call_name(anc) in DEADLINE_GUARD_CALLS:
             return True
         if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) and anc.name in delegated:
             return True
@@ -199,7 +220,9 @@ def _resolve_closure(mod: Module, call: ast.Call) -> Optional[ast.AST]:
 
 def _issues_collectives(node: ast.AST) -> bool:
     return contains_call(
-        node, TRANSPORT_PRIMITIVES | {"run_with_deadline", "note_collective"}
+        node,
+        TRANSPORT_PRIMITIVES
+        | {"run_with_deadline", "run_inflight", "_guarded", "_payload_exchange", "note_collective"},
     )
 
 

@@ -59,6 +59,7 @@ def compare(
     threshold: float = 5.0,
     p50_threshold: float = 3.0,
     tail_threshold: float = 4.0,
+    wire_hidden_floor: float = 0.5,
 ) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
@@ -96,6 +97,20 @@ def compare(
                 problems.append(
                     f"{name}: tail ratio p99/p50 {old_tail:.1f} -> {new_tail:.1f} "
                     f"({new_tail / old_tail:.1f}x blowup, tail gate {tail_threshold}x)"
+                )
+        # ---- the async-overlap gate (ISSUE 13): a row that archived
+        # wire_hidden_fraction must keep the wire off the critical path —
+        # a healthy fraction collapsing below the floor means the overlap
+        # broke (the force started blocking out the whole wire again),
+        # even when the throughput numbers still look fine ----
+        old_wire = old_row.get("wire_hidden_fraction")
+        new_wire = new_row.get("wire_hidden_fraction")
+        if old_wire is not None and new_wire is not None:
+            if float(old_wire) >= wire_hidden_floor and float(new_wire) < wire_hidden_floor:
+                problems.append(
+                    f"{name}: wire_hidden_fraction {float(old_wire):.2f} -> "
+                    f"{float(new_wire):.2f} (below the {wire_hidden_floor} floor — "
+                    "the async sync stopped hiding the wire)"
                 )
     return problems
 
@@ -157,7 +172,7 @@ def _pop_flag(argv: list, flag: str, default: float):
 
 _USAGE = (
     "usage: sweep_regress.py [--threshold X] [--p50-threshold X] "
-    "[--tail-threshold X] [--explain] OLD.json NEW.json"
+    "[--tail-threshold X] [--wire-hidden-floor X] [--explain] OLD.json NEW.json"
 )
 
 
@@ -169,12 +184,13 @@ def main(argv) -> int:
     argv, threshold, ok1 = _pop_flag(argv, "--threshold", 5.0)
     argv, p50_threshold, ok2 = _pop_flag(argv, "--p50-threshold", 3.0)
     argv, tail_threshold, ok3 = _pop_flag(argv, "--tail-threshold", 4.0)
-    if not (ok1 and ok2 and ok3) or len(argv) != 2:
+    argv, wire_floor, ok4 = _pop_flag(argv, "--wire-hidden-floor", 0.5)
+    if not (ok1 and ok2 and ok3 and ok4) or len(argv) != 2:
         print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
         old, new = json.load(f_old), json.load(f_new)
-    problems = compare(old, new, threshold, p50_threshold, tail_threshold)
+    problems = compare(old, new, threshold, p50_threshold, tail_threshold, wire_floor)
     if problems:
         print("\n".join(problems))
         if do_explain:
