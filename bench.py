@@ -51,7 +51,7 @@ if SMOKE:
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def _latency_percentiles(step, n: int) -> dict:
+def _latency_percentiles(step, n: int, setup=None) -> dict:
     """Per-call latency percentiles (ms) over one extra ``n``-call pass,
     accumulated through the SAME full-lifetime histogram class the telemetry
     plane scrapes (``telemetry.LatencyHistogram``) — every percentile this
@@ -66,6 +66,8 @@ def _latency_percentiles(step, n: int) -> dict:
 
     h = LatencyHistogram()
     for _ in range(n):
+        if setup is not None:
+            setup()  # untimed per-call staging (e.g. the stride updates a window close consumes)
         t0 = time.perf_counter()
         step()
         h.observe(time.perf_counter() - t0)
@@ -1308,6 +1310,127 @@ def bench_fleet_snapshot() -> dict:
     }
 
 
+def bench_window_close() -> dict:
+    """``window_close``: wall-clock cost of one window close on a 4-metric
+    suite — agree the close id, merge the stride state, pack it into a ring
+    slot — the cadence budget for ``Windowed(suite, window, stride)``. The
+    stride updates stage OUTSIDE the timer: the row prices the close itself.
+    Two collective budgets ride along, counted rather than timed: a
+    world-size-1 close issues ZERO collectives, and a simulated 3-rank close
+    issues exactly ONE payload collective (``collectives_per_close_live``) —
+    the ceiling ``tools/sweep_regress.py`` gates."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        Accuracy,
+        MeanAbsoluteError,
+        MeanMetric,
+        MeanSquaredError,
+        MetricCollection,
+        Windowed,
+    )
+    from metrics_tpu.ops import engine
+    from metrics_tpu.parallel import bucketing
+    from metrics_tpu.parallel import sync as psync
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(BATCH).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, BATCH))
+
+    def suite() -> MetricCollection:
+        return MetricCollection(
+            {
+                "mean": MeanMetric(),
+                "mse": MeanSquaredError(),
+                "mae": MeanAbsoluteError(),
+                "acc": Accuracy(),
+            }
+        )
+
+    win = Windowed(suite(), window=8, stride=2, name="bench-window")
+
+    def stage() -> None:
+        win.base.update(p, t)
+        win.base.update(p, t)
+
+    stage()
+    win.close_window()  # warmup: compiles the pack program
+    record_bytes = len(win._ring[-1][1])
+    n_closes = max(3, STEPS // 5)
+    c0 = engine.engine_stats()["sync_collectives_issued"]
+    best = float("inf")
+    for _ in range(TRIALS):
+        elapsed = 0.0
+        for _ in range(n_closes):
+            stage()
+            start = time.perf_counter()
+            win.close_window()
+            elapsed += time.perf_counter() - start
+        best = min(best, elapsed)
+    lat = _latency_percentiles(win.close_window, n_closes, setup=stage)
+    n_local = TRIALS * n_closes + n_closes
+    collectives_local = (engine.engine_stats()["sync_collectives_issued"] - c0) / n_local
+
+    # the live budget: a fake 3-rank world over stacked local transports —
+    # counted, not timed (a stacked transport has no wire worth measuring)
+    saved_payload = bucketing._payload_allgather
+    saved_host = bucketing._host_allgather
+    psync.reset_membership()
+    try:
+        psync.set_expected_world(3)
+        bucketing._host_allgather = lambda vec: np.stack([np.asarray(vec)] * 3)
+        bucketing._payload_allgather = lambda packed: jnp.stack([packed] * 3)
+        fwin = Windowed(suite(), window=4, stride=2, name="bench-window-live")
+        n_live = 4
+        p0 = engine.engine_stats()["sync_payload_collectives"]
+        for _ in range(n_live):
+            fwin.base.update(p, t)
+            fwin.base.update(p, t)
+            fwin.close_window(distributed_available=lambda: True)
+        live = (engine.engine_stats()["sync_payload_collectives"] - p0) / n_live
+    finally:
+        bucketing._payload_allgather = saved_payload
+        bucketing._host_allgather = saved_host
+        psync.reset_membership()
+    return {
+        "closes_per_s": n_closes / best,
+        "ms_per_close": 1000.0 * best / n_closes,
+        "record_bytes": record_bytes,
+        "collectives_per_close": collectives_local,
+        "collectives_per_close_live": live,
+        "latency_ms": lat,
+    }
+
+
+def bench_drift_report() -> dict:
+    """``drift_report``: cost of one PSI/KS drift computation over two
+    4096-sample raw-state vectors (shared linear binning through
+    ``ops/histogram.py``) — the scrape-cadence budget for
+    ``Windowed.drift_report()`` and the module-level ``drift_report``."""
+    from metrics_tpu import drift_report
+
+    rng = np.random.RandomState(15)
+    cur = rng.normal(0.5, 1.2, 4096).astype(np.float32)
+    ref = rng.normal(0.0, 1.0, 4096).astype(np.float32)
+    report = drift_report(cur, ref)  # warmup: compiles the fused bincount
+    n_reports = max(5, STEPS // 5)
+    best = float("inf")
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        for _ in range(n_reports):
+            drift_report(cur, ref)
+        best = min(best, time.perf_counter() - start)
+    lat = _latency_percentiles(lambda: drift_report(cur, ref), n_reports)
+    return {
+        "reports_per_s": n_reports / best,
+        "ms_per_report": 1000.0 * best / n_reports,
+        "sample_size": 4096,
+        "psi": float(report["psi"]),
+        "ks": float(report["ks"]),
+        "latency_ms": lat,
+    }
+
+
 def bench_overhead_reference() -> float:
     tm = _reference()
     if tm is None:
@@ -1380,6 +1503,11 @@ def main() -> None:
     journal_probe = bench_journal_write()
     # fleet probe rides the same simulated-world regime as the sync rows
     fleet_probe = bench_fleet_snapshot()
+    # streaming probes ride the same regime as the journal/fleet rows they
+    # extend (ISSUE 15): the window close reuses the journal pack program,
+    # the drift report reuses the fused bincount
+    window_probe = bench_window_close()
+    drift_probe = bench_drift_report()
     boot_floor = bench_bootstrap_shaped_floor()
     ours_overhead_batched = bench_overhead_batched_ours()
     ref_overhead = _safe(bench_overhead_reference)
@@ -1739,6 +1867,47 @@ def main() -> None:
                 "steady-state per-update journaling cost is ms_per_snapshot/N; "
                 "with no journal configured the hook is one dict lookup per "
                 "update (nothing on the hot path)"
+            ),
+        },
+        "window_close": {
+            # ISSUE 15: one fleet-agreed window close on a 4-metric suite —
+            # agree the close id, merge the stride state, pack it into a
+            # ring slot (the journal pack program, reused). The stride
+            # updates stage outside the timer; the row prices the close.
+            "closes_per_s": round(window_probe["closes_per_s"], 1),
+            "ms_per_close": round(window_probe["ms_per_close"], 3),
+            "record_bytes": window_probe["record_bytes"],
+            "collectives_per_close": round(window_probe["collectives_per_close"], 4),
+            "collectives_per_close_live": round(
+                window_probe["collectives_per_close_live"], 4
+            ),
+            "latency_ms": window_probe["latency_ms"],
+            "unit": "close_window() calls/s (4-metric suite, window=8 stride=2)",
+            "note": (
+                "collectives_per_close == 0 pins the world-size-1 "
+                "zero-collective contract; collectives_per_close_live == 1 "
+                "pins the one-payload-collective-per-close budget in a "
+                "simulated 3-rank world (counted, not timed) — a close that "
+                "starts issuing more is a regression tools/sweep_regress.py "
+                "fails (docs/performance.md Window-close cost model)"
+            ),
+        },
+        "drift_report": {
+            # ISSUE 15: one PSI/KS drift computation over two 4096-sample
+            # raw-state vectors — shared linear binning through the fused
+            # bincount, probability-floored histograms, closed-form scores.
+            "reports_per_s": round(drift_probe["reports_per_s"], 1),
+            "ms_per_report": round(drift_probe["ms_per_report"], 3),
+            "sample_size": drift_probe["sample_size"],
+            "psi": round(drift_probe["psi"], 4),
+            "ks": round(drift_probe["ks"], 4),
+            "latency_ms": drift_probe["latency_ms"],
+            "unit": "drift_report() calls/s (2x4096 float32 samples, 16 bins)",
+            "note": (
+                "bounds the drift-scrape cadence: host-side outside the "
+                "update hot path entirely — psi/ks columns double as a "
+                "determinism canary (fixed seed, fixed shift) "
+                "(docs/observability.md Model-monitoring plane)"
             ),
         },
         "eager_per_step": {

@@ -153,6 +153,14 @@ from metrics_tpu.ops.fleetobs import (  # noqa: E402
 # goes"): step-latency decomposition, roofline ledger, ranked opportunities
 from metrics_tpu.ops.perf import perf_report  # noqa: E402
 
+# the model-monitoring plane (docs/observability.md "Model-monitoring
+# plane"): windowed/decayed metrics over the journal ring + PSI/KS drift
+from metrics_tpu.streaming import (  # noqa: E402
+    Decayed,
+    Windowed,
+    drift_report,
+)
+
 # world membership (docs/robustness.md "World membership"): epoch registry +
 # peer-health surface behind epoch-fenced collectives and quorum compute
 from metrics_tpu.parallel.sync import world_health  # noqa: E402
@@ -170,6 +178,9 @@ __all__ = [
     "fleet_prometheus_text",
     "fleet_snapshot",
     "perf_report",
+    "Decayed",
+    "Windowed",
+    "drift_report",
     "Metric",
     "CompositionalMetric",
     "MetricCollection",

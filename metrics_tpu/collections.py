@@ -1704,8 +1704,10 @@ class MetricCollection:
         variant of :meth:`save_state` a globally-consistent restore needs.
 
         A collective: **every rank calls it**. One small metadata exchange
-        (epoch-fenced, deadline-guarded, riding the standard retry budget)
-        gathers each rank's monotonic event step; the maximum is the agreed
+        (epoch-fenced, deadline-guarded, riding the standard retry budget —
+        the shared :func:`metrics_tpu.parallel.bucketing.agree_step`
+        exchange, which the streaming window closes reuse) gathers each
+        rank's monotonic event step; the maximum is the agreed
         ``barrier_step``, stamped — together with the world epoch and world
         size — into every rank's record manifest. A fleet-wide restore then
         verifies all rank files carry the same ``(epoch, barrier_step)``
@@ -1716,41 +1718,15 @@ class MetricCollection:
         from metrics_tpu.ops import journal as _journal
 
         self._defer_barrier()
-        fence = _psync.world_epoch()
         t0 = _telemetry.now() if _telemetry.armed else 0.0
         # the barrier is itself an event on the shared monotonic fault/sync
         # axis: each rank contributes its NEXT step, so consecutive barriers
         # always agree strictly increasing steps (and order against the
         # failure log without a second clock)
-        local = np.asarray([_faults.tick()], np.int64)
-
-        def _exchange():
-            _psync.check_epoch(fence, site="checkpoint-barrier", owner=self)
-            return _psync.run_with_deadline(
-                lambda: _bucketing._host_allgather(local), site="checkpoint-barrier"
-            )
-
-        vec = np.asarray(
-            _faults.retry_with_backoff(
-                _exchange,
-                attempts=_psync.sync_retries(),
-                base_delay_s=_psync.sync_backoff_s(),
-                owner=self,
-                site="checkpoint-barrier",
-            )
-        )
-        _psync.note_collective("shape", epoch=fence)
-        agreed = int(vec.max())
-        world = int(vec.shape[0])
-        # the completed exchange is a collective success: clear the
-        # cohort-wide timeout suspicion (as a subgroup success while peers
-        # are declared dead — a barrier proves the current cohort responded,
-        # not that the full world healed)
-        _psync.note_sync_success(world=world, members=_psync.surviving_members())
-        # the epoch must still hold when the record is stamped: a membership
-        # change during the exchange would stamp a manifest no surviving
-        # cohort agrees on
-        _psync.check_epoch(fence, site="checkpoint-barrier", owner=self)
+        agreement = _bucketing.agree_step(self, _faults.tick(), site="checkpoint-barrier")
+        agreed = agreement["agreed"]
+        world = agreement["world"]
+        fence = agreement["epoch"]
         nbytes = _journal.save_nodes(
             self,
             self._journal_nodes(),

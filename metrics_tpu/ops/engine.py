@@ -552,8 +552,12 @@ def engine_stats() -> Dict[str, Any]:
     coalesced payload), fast-lane hit/miss counts and
     ``sync_pack_fallbacks`` — and the journal counters from
     :mod:`metrics_tpu.ops.journal` (saves, loads, bytes written, generation
-    demotions). ``telemetry.snapshot()`` is the superset surface that adds
-    the span-recorder counters and the program-ledger summary on top."""
+    demotions) and the streaming-plane counters from
+    :mod:`metrics_tpu.streaming` (window closes and the payload collectives
+    they issued, ring slots packed/persisted/demoted, epoch trips mid-close,
+    decay ticks, drift reports). ``telemetry.snapshot()`` is the superset
+    surface that adds the span-recorder counters and the program-ledger
+    summary on top."""
     out: Dict[str, Any] = {
         "builds": _stats["builds"],
         "hits": _stats["hits"],
@@ -573,6 +577,12 @@ def engine_stats() -> Dict[str, Any]:
 
     out.update(_psync.collective_stats())
     out.update(_journal.journal_stats())
+    # the streaming plane's event counters (window closes, ring slots,
+    # demotions, epoch trips, decay ticks, drift reports) — lazy like the
+    # journal's: streaming imports engine for its decay programs
+    from metrics_tpu import streaming as _streaming
+
+    out.update(_streaming.streaming_stats())
     return out
 
 

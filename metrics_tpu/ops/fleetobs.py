@@ -336,6 +336,50 @@ def merge_snapshots(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def merge_streaming(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge the per-rank ``streaming`` snapshot blocks (the model-monitoring
+    plane — ``metrics_tpu.streaming``) into one fleet view.
+
+    Window values are **fleet-agreed** (a close merges the stride state
+    through one payload collective before packing, so every live rank's
+    block for a given close id is identical) — the merge takes the first
+    live rank's block per window rather than re-reducing, and spends its
+    effort on the one thing that CAN differ: the window id each rank has
+    reached. ``window_skew`` attributes that — per window name, the agreed
+    (max) id, the max cross-rank skew, and each rank's lag behind the
+    agreed id. A rank lagging its peers' window ids is a rank whose close
+    loop stalled — the streaming twin of the straggler report."""
+    windows: Dict[str, Dict[str, Any]] = {}
+    drift: Dict[str, Dict[str, float]] = {}
+    per_rank_ids: Dict[str, Dict[int, int]] = {}
+    for rank, plane in sorted(planes.items()):
+        if not _is_live_plane(plane):
+            continue
+        block = plane.get("streaming")
+        if not isinstance(block, dict):
+            continue
+        for name, win in (block.get("windows") or {}).items():
+            if not isinstance(win, dict):
+                continue
+            windows.setdefault(name, win)
+            try:
+                per_rank_ids.setdefault(name, {})[rank] = int(win.get("window", 0))
+            except (TypeError, ValueError):
+                continue
+        for name, scores in (block.get("drift") or {}).items():
+            if isinstance(scores, dict):
+                drift.setdefault(name, scores)
+    window_skew: Dict[str, Dict[str, Any]] = {}
+    for name, ids in sorted(per_rank_ids.items()):
+        agreed = max(ids.values())
+        window_skew[name] = {
+            "agreed": agreed,
+            "max_skew": agreed - min(ids.values()),
+            "per_rank_lag": {r: agreed - wid for r, wid in sorted(ids.items())},
+        }
+    return {"windows": windows, "drift": drift, "window_skew": window_skew}
+
+
 def straggler_report(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
     """Name the slowest ranks per sync phase, with deviation scores — both
     mean-based and **tail-aware**.
@@ -453,6 +497,9 @@ def fleet_snapshot() -> Dict[str, Any]:
     - ``aggregate`` — :func:`merge_snapshots` over the live planes
       (counters summed exactly; gauges min/median/max).
     - ``stragglers`` — :func:`straggler_report`.
+    - ``streaming`` — :func:`merge_streaming`: the model-monitoring plane
+      (fleet-agreed window values, drift scores, per-rank window-skew
+      attribution).
     - ``world_health`` — the membership registry surface, folded in.
     - ``fleet_stats`` — this plane's own counters.
 
@@ -528,6 +575,7 @@ def fleet_snapshot() -> Dict[str, Any]:
         "ranks": planes,
         "aggregate": merge_snapshots(planes),
         "stragglers": straggler_report(planes),
+        "streaming": merge_streaming(planes),
         "world_health": wh,
         "fleet_stats": fleet_stats(),
     }
@@ -552,7 +600,11 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     typed ``counter``) and aggregate gauges (``_min``/``_median``/``_max``),
     per-rank liveness/health gauges (``rank`` label), the per-rank sync
     phase statistics (``rank`` + ``phase`` labels, mean AND full-lifetime
-    p95), the straggler deviation scores (mean-based and tail-aware), and
+    p95), the straggler deviation scores (mean-based and tail-aware), the
+    model-monitoring families (``metrics_tpu_metric_value{name,window}``
+    per-window metric values, ``metrics_tpu_drift_score{name,kind}`` PSI/KS
+    scores, ``metrics_tpu_fleet_window_id{name}`` and the per-rank
+    ``metrics_tpu_fleet_window_skew{rank,name}`` lag attribution), and
     the latency **histogram** families: the fleet-merged
     ``metrics_tpu_fleet_latency_seconds{site=...,le=...}`` (exact bucket
     sums across ranks) and the rank-labelled
@@ -660,6 +712,38 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     family("metrics_tpu_fleet_straggler_tail_deviation", "gauge", tail_samples)
     flagged = [(f'{{rank="{r}"}}', 1.0) for r in stragglers.get("stragglers") or ()]
     family("metrics_tpu_fleet_straggler_flagged", "gauge", flagged)
+
+    # the model-monitoring families (streaming.py): fleet-agreed per-window
+    # METRIC VALUES (the first exposition of metric values, not system
+    # telemetry), the agreed window ids, drift scores, and per-rank
+    # window-skew attribution — names per the docs/observability.md table
+    streaming = snap.get("streaming") or {}
+    value_samples, id_samples = [], []
+    for wname, block in (streaming.get("windows") or {}).items():
+        if not isinstance(block, dict):
+            continue
+        id_samples.append((f'{{name="{wname}"}}', float(block.get("window", 0))))
+        for wid, values in (block.get("values") or {}).items():
+            for key, value in (values or {}).items():
+                label_name = wname if key == "value" else f"{wname}.{key}"
+                value_samples.append(
+                    (f'{{name="{label_name}",window="{wid}"}}', float(value))
+                )
+    family("metrics_tpu_metric_value", "gauge", value_samples)
+    family("metrics_tpu_fleet_window_id", "gauge", id_samples)
+    drift_samples = []
+    for dname, scores in (streaming.get("drift") or {}).items():
+        for kind in ("psi", "ks"):
+            if isinstance(scores, dict) and kind in scores:
+                drift_samples.append(
+                    (f'{{name="{dname}",kind="{kind}"}}', float(scores[kind]))
+                )
+    family("metrics_tpu_drift_score", "gauge", drift_samples)
+    skew_samples = []
+    for wname, entry in (streaming.get("window_skew") or {}).items():
+        for rank, lag in (entry.get("per_rank_lag") or {}).items():
+            skew_samples.append((f'{{rank="{rank}",name="{wname}"}}', float(lag)))
+    family("metrics_tpu_fleet_window_skew", "gauge", skew_samples)
 
     lines: List[str] = []
     for name, kind, samples in families:

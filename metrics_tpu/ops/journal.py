@@ -65,6 +65,7 @@ __all__ = [
     "read_record",
     "restored_meta",
     "save_nodes",
+    "stage_states",
     "world_meta",
     "write_record",
 ]
@@ -260,13 +261,18 @@ def decode_record(data: bytes, origin: str = "<bytes>") -> Tuple[Dict[str, Any],
     return manifest, payload
 
 
-def restore_nodes(nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes) -> None:
-    """Apply a decoded record to ``nodes`` — all-or-nothing.
+def stage_states(
+    nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes
+) -> List[Tuple[int, str, Any]]:
+    """Validate and decode one record's payload against the live ``nodes``
+    WITHOUT mutating anything: returns ``(node_index, state_name, value)``
+    staging rows (dyn/cat entries as single-row lists, empties as ``[]``).
 
-    Every segment is sliced, bitcast back through the same
-    ``bucketing._from_bytes`` the sync unpack uses, and staged; ``setattr``
-    runs only after the WHOLE record parses, so a layout-incompatible record
-    (classified :class:`JournalFault`) leaves every node untouched."""
+    The shared first half of :func:`restore_nodes` — and the entry the
+    streaming window plane (:mod:`metrics_tpu.streaming`) re-accumulates
+    through, merging a ring slot's states into a scratch clone instead of
+    overwriting them. Raises the classified :class:`JournalFault` on any
+    layout mismatch, leaving every node untouched."""
 
     def _bad(why: str) -> JournalFault:
         return JournalFault(f"journal record does not match this state tree: {why}", site="journal-load")
@@ -283,7 +289,7 @@ def restore_nodes(nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes
         )
 
     buf = jnp.asarray(np.frombuffer(payload, np.uint8))
-    staged: List[Tuple[Any, str, Any]] = []
+    staged: List[Tuple[int, str, Any]] = []
     off = 0
     for e in manifest["entries"]:
         idx, name, kind = e["node"], e["name"], e["kind"]
@@ -293,7 +299,7 @@ def restore_nodes(nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes
         if name not in node._defaults:
             raise _bad(f"{type(node).__name__} has no state {name!r}")
         if kind == "empty":
-            staged.append((node, name, []))
+            staged.append((idx, name, []))
             continue
         shape, dtype = tuple(e["shape"]), e["dtype"]
         n = _bucketing._byte_len(shape, dtype)
@@ -305,7 +311,7 @@ def restore_nodes(nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes
             # cat list state: restored as the single pre-concatenated row the
             # pack wrote — dim_zero_cat of [concat] == concat, so compute()
             # is bit-exact vs the multi-row live buffer
-            staged.append((node, name, [value]))
+            staged.append((idx, name, [value]))
         else:
             current = getattr(node, name)
             if not isinstance(current, list) and jnp.dtype(jnp.asarray(current).dtype).name != dtype:
@@ -313,15 +319,26 @@ def restore_nodes(nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes
                     f"{type(node).__name__}.{name} is {jnp.asarray(current).dtype} live but "
                     f"{dtype} in the record (construction mismatch)"
                 )
-            staged.append((node, name, value))
+            staged.append((idx, name, value))
     if off != len(payload):
         raise _bad(f"record carries {len(payload) - off} unclaimed payload bytes")
+    return staged
 
+
+def restore_nodes(nodes: Sequence[Any], manifest: Dict[str, Any], payload: bytes) -> None:
+    """Apply a decoded record to ``nodes`` — all-or-nothing.
+
+    Every segment is sliced, bitcast back through the same
+    ``bucketing._from_bytes`` the sync unpack uses, and staged
+    (:func:`stage_states`); ``setattr`` runs only after the WHOLE record
+    parses, so a layout-incompatible record (classified
+    :class:`JournalFault`) leaves every node untouched."""
+    staged = stage_states(nodes, manifest, payload)
     counts = manifest.get("update_counts", [])
     statics = manifest.get("static_attrs", [])
     extras = manifest.get("extras", [])
-    for node, name, value in staged:
-        setattr(node, name, value)
+    for idx, name, value in staged:
+        setattr(nodes[idx], name, value)
     for i, node in enumerate(nodes):
         if i < len(statics) and statics[i]:
             for key, value in statics[i].items():

@@ -144,6 +144,10 @@ SPAN_SITES = {
     "fleet-gather": "one fleet metadata/blob exchange (length + padded payload)",
     "fleet-snapshot": "one cross-rank snapshot gather + merge",
     "fleet-trace": "one cross-rank span-ring gather + merged trace export",
+    # streaming plane (streaming.py)
+    "window-close": "one fleet-agreed window close: close-id agreement + "
+    "payload sync + ring-slot pack (+ slot persistence when journaling)",
+    "drift-report": "one PSI/KS drift computation over binned raw states",
 }
 
 #: The sync-protocol phases the fleet straggler report attributes
@@ -791,6 +795,14 @@ def snapshot() -> Dict[str, Any]:
     # additive keys: the snapshot stays a strict engine_stats superset
     out[_HIST_SNAPSHOT_KEY] = latency_stats()
     out["slo_violations"] = slo_violations()
+    # the model-monitoring plane: per-window ids/boundaries/values and drift
+    # scores (streaming.py). The window_*/drift_* EVENT counters already rode
+    # in through engine_stats(); this block is window STATE — its flattened
+    # keys start "streaming_" and scrape as gauges (window values and drift
+    # scores move both ways)
+    from metrics_tpu import streaming as _streaming
+
+    out["streaming"] = _streaming.streaming_snapshot()
     return out
 
 
@@ -816,6 +828,9 @@ _COUNTER_PREFIXES = (
     # the performance-attribution plane: device-probe events, memoized
     # program cost-analysis lowers, perf-report invocations — all monotonic
     "device_", "program_", "perf_",
+    # the streaming plane's event counters: window closes / slots packed /
+    # ring demotions / epoch trips, drift reports (streaming.py)
+    "window_", "drift_",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —
@@ -826,8 +841,10 @@ _GAUGE_SUFFIXES = ("_ratio", "_p50_s", "_p95_s", "_p99_s", "_max_s")
 # degraded flag clears, dead ranks rejoin, suspicion resets — every key
 # scrapes as a gauge even though the "sync_" prefix matches above. The
 # sync_phase_stats block is ring-windowed (old spans drop), so its counts
-# and totals can fall too.
-_GAUGE_PREFIXES = ("sync_health_", "sync_phase_stats_")
+# and totals can fall too. The flattened streaming block is window STATE
+# (window ids jump on rejoin, per-window values and drift scores move both
+# ways) — the value-gauge carve-out beside the window_*/drift_* counters.
+_GAUGE_PREFIXES = ("sync_health_", "sync_phase_stats_", "streaming_")
 
 
 def is_counter_key(key: str) -> bool:

@@ -417,6 +417,52 @@ def _payload_allgather(packed: jax.Array) -> jax.Array:
     return jnp.asarray(multihost_utils.process_allgather(packed))
 
 
+def agree_step(owner: Any, local_step: int, *, site: str) -> Dict[str, int]:
+    """Agree ONE monotonic step fleet-wide: the small epoch-fenced metadata
+    exchange ``MetricCollection.checkpoint_barrier`` pioneered, factored out
+    so every coordinated cut (barrier journals, streaming window closes)
+    rides the same discipline instead of re-deriving it.
+
+    A collective: every live rank calls it in lockstep. Each rank
+    contributes ``local_step``; the maximum across the world is the agreed
+    step. The exchange is deadline-guarded, rides the standard retry budget,
+    and re-checks the world epoch inside the retried closure AND after the
+    gather — a membership change mid-exchange classifies as ``EpochFault``
+    (never retried unilaterally, never a torn agreement). Returns
+    ``{"agreed", "world", "epoch"}``."""
+    from metrics_tpu.ops import faults as _faults
+
+    fence = _sync.world_epoch()
+    vec_local = np.asarray([int(local_step)], np.int64)
+
+    def _exchange():
+        _sync.check_epoch(fence, site=site, owner=owner)
+        return _sync.run_with_deadline(lambda: _host_allgather(vec_local), site=site)
+
+    vec = np.asarray(
+        _faults.retry_with_backoff(
+            _exchange,
+            attempts=_sync.sync_retries(),
+            base_delay_s=_sync.sync_backoff_s(),
+            owner=owner,
+            site=site,
+        )
+    )
+    _sync.note_collective("shape", epoch=fence)
+    agreed = int(vec.max())
+    world = int(vec.shape[0])
+    # the completed exchange is a collective success: clear the cohort-wide
+    # timeout suspicion (as a subgroup success while peers are declared dead
+    # — the agreement proves the current cohort responded, not that the full
+    # world healed)
+    _sync.note_sync_success(world=world, members=_sync.surviving_members())
+    # the epoch must still hold when the agreement is consumed: a membership
+    # change during the exchange would hand back a step no surviving cohort
+    # agrees on
+    _sync.check_epoch(fence, site=site, owner=owner)
+    return {"agreed": agreed, "world": world, "epoch": fence}
+
+
 def _intranode_allgather(packed: jax.Array) -> jax.Array:
     """Intra-node stage of the hierarchical payload topology
     (``METRICS_TPU_SYNC_HIER``): exchange the flat byte buffer over the FAST

@@ -1,0 +1,800 @@
+"""Streaming-evaluation monitoring plane: windows, decay, drift.
+
+Every accumulator in this library is monotone over the whole run — right for
+offline eval, wrong for live model monitoring, where "accuracy over the last
+N batches" and "has the prediction distribution drifted since deploy" are
+the questions a serving fleet actually asks. This module adds that plane on
+top of the existing snapshot/journal/barrier substrate, introducing **no new
+serialization and no new collective protocol**:
+
+- :class:`Windowed` — tumbling/sliding windows as a **ring buffer of packed
+  state snapshots**. A ring slot IS a crash-consistent journal record
+  (:func:`metrics_tpu.ops.journal.pack_record` — the same bitcast byte pack
+  the coalesced sync exchanges), so window arithmetic is "restore the ring,
+  merge via re-accumulation of the retained slots" (re-accumulation rather
+  than subtraction, so ``max``/``min``/``cat`` states window correctly),
+  and persistence is one atomic generation-ringed record per slot. In a
+  live world a window close is fleet-agreed: the
+  :func:`metrics_tpu.parallel.bucketing.agree_step` exchange
+  ``checkpoint_barrier`` rides (epoch-fenced, deadline-guarded) picks the
+  close id, then ONE coalesced payload collective merges the stride state
+  fleet-wide. A membership change mid-close classifies as ``EpochFault``
+  with the ring and the live accumulator intact — never a torn window.
+- :class:`Decayed` — exponential decay (EMA) as a fused scale on the
+  merge-reduction states through an engine-cached donated program: each
+  tick multiplies every ``sum``-reduction state by ``0.5**(1/halflife)``
+  before the update lands, so ``compute()`` serves the decay-weighted value
+  with zero extra state.
+- :func:`drift_report` — PSI and KS between two samples over a shared
+  binning (:func:`metrics_tpu.ops.histogram.fused_bincount`), the first
+  consumer of the window plane: ``Windowed.drift_report()`` scores the
+  newest retained slot's raw states against the oldest.
+
+Observability: module counters (``window_*`` / ``drift_*``, typed as
+Prometheus counters) merge into ``engine_stats()`` / ``telemetry_snapshot()``
+like the journal's; window ids/values/close latency and drift scores ride
+``telemetry_snapshot()['streaming']`` (flattened keys type as gauges via the
+``streaming_`` carve-out), and the fleet plane renders
+``metrics_tpu_metric_value{name,window}`` /
+``metrics_tpu_drift_score{name,kind}`` families plus per-rank window-skew
+attribution (``ops/fleetobs.py``). See docs/observability.md
+("Model-monitoring plane").
+
+Env knobs (all parsed through the shared ``parallel/sync.py`` helpers —
+unparseable values warn once naming the offending value and fall back):
+``METRICS_TPU_WINDOW_DEFAULT_STRIDE``, ``METRICS_TPU_WINDOW_VALUES_KEPT``,
+``METRICS_TPU_DRIFT_BINS``, ``METRICS_TPU_DRIFT_EPS``.
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+from copy import deepcopy
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops import engine as _engine
+from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import journal as _journal
+from metrics_tpu.ops import telemetry as _telemetry
+from metrics_tpu.ops.histogram import fused_bincount
+from metrics_tpu.parallel import bucketing as _bucketing
+from metrics_tpu.parallel import sync as _psync
+from metrics_tpu.utils.exceptions import EpochFault
+
+__all__ = [
+    "Decayed",
+    "Windowed",
+    "drift_bins",
+    "drift_eps",
+    "drift_report",
+    "streaming_snapshot",
+    "streaming_stats",
+    "window_default_stride",
+    "window_values_kept",
+]
+
+# Streaming-plane counters (merged into ``engine.engine_stats()`` and the
+# telemetry snapshot beside the journal's; zeroed through the shared reset
+# registry). Every key rides the ``window_``/``drift_`` counter prefixes.
+_counters: Dict[str, int] = {
+    "window_closes": 0,
+    "window_close_payload_collectives": 0,
+    "window_slots_packed": 0,
+    "window_slot_writes": 0,
+    "window_ring_demotions": 0,
+    "window_epoch_trips": 0,
+    "window_decay_ticks": 0,
+    "drift_reports": 0,
+}
+
+#: Live window registry: one block per Windowed name — window id, boundary
+#: facts, per-window computed scalar values. Rendered (as gauges) under
+#: ``telemetry_snapshot()['streaming']['windows']`` and by the fleet
+#: ``metrics_tpu_metric_value`` family.
+_WINDOWS: Dict[str, Dict[str, Any]] = {}
+
+#: Newest drift scores per report name: ``{name: {"psi": x, "ks": y}}``.
+_DRIFT: Dict[str, Dict[str, float]] = {}
+
+
+def streaming_stats() -> Dict[str, int]:
+    """Healthy-path streaming counters: window closes (and the payload
+    collectives they issued), ring slots packed/persisted, load-time ring
+    demotions, epoch-fence trips mid-close, decay ticks, drift reports."""
+    return dict(_counters)
+
+
+def _reset_streaming() -> None:
+    for key in _counters:
+        _counters[key] = 0
+    _WINDOWS.clear()
+    _DRIFT.clear()
+
+
+_telemetry.register_reset("streaming", _reset_streaming)
+
+
+def streaming_snapshot() -> Dict[str, Any]:
+    """The JSON-safe ``streaming`` block ``telemetry_snapshot()`` carries:
+    ``windows`` (per-name window id, boundaries, last close latency,
+    per-window computed scalar values) and ``drift`` (newest PSI/KS scores
+    per report name). Flattened numeric keys type as gauges (the
+    ``streaming_`` prefix carve-out in ``telemetry.is_counter_key``) —
+    window values and drift scores move both ways, unlike the ``window_*``
+    event counters."""
+    return {
+        "windows": {
+            name: dict(block, values={k: dict(v) for k, v in block["values"].items()})
+            for name, block in _WINDOWS.items()
+        },
+        "drift": {name: dict(scores) for name, scores in _DRIFT.items()},
+    }
+
+
+# ------------------------------------------------------------------ env knobs
+class _StreamingWarnOwner:
+    """Warn-dedupe anchor for this module's env-knob parse warnings."""
+
+
+_STRIDE_WARN_OWNER = _StreamingWarnOwner()
+_KEPT_WARN_OWNER = _StreamingWarnOwner()
+_BINS_WARN_OWNER = _StreamingWarnOwner()
+_EPS_WARN_OWNER = _StreamingWarnOwner()
+
+
+def window_default_stride() -> int:
+    """Default stride (updates per ring slot) when :class:`Windowed` is
+    constructed without one (``METRICS_TPU_WINDOW_DEFAULT_STRIDE``; 0 —
+    the default — means tumbling: stride == window)."""
+    return max(0, _psync._env_int("METRICS_TPU_WINDOW_DEFAULT_STRIDE", 0, owner=_STRIDE_WARN_OWNER))
+
+
+def window_values_kept() -> int:
+    """How many per-window computed values each window retains in the
+    telemetry registry (``METRICS_TPU_WINDOW_VALUES_KEPT``, default 8,
+    floor 1) — the scrape history depth, not the ring depth."""
+    return max(1, _psync._env_int("METRICS_TPU_WINDOW_VALUES_KEPT", 8, owner=_KEPT_WARN_OWNER))
+
+
+def drift_bins() -> int:
+    """Shared binning resolution for :func:`drift_report`
+    (``METRICS_TPU_DRIFT_BINS``, default 16, floor 2)."""
+    return max(2, _psync._env_int("METRICS_TPU_DRIFT_BINS", 16, owner=_BINS_WARN_OWNER))
+
+
+def drift_eps() -> float:
+    """Probability floor applied to every bin before the PSI log-ratio
+    (``METRICS_TPU_DRIFT_EPS``, default 1e-6) — an empty bin must never
+    produce an infinite score. Non-positive values fall back."""
+    eps = _psync._env_float("METRICS_TPU_DRIFT_EPS", 1e-6, owner=_EPS_WARN_OWNER)
+    return float(eps) if eps and eps > 0 else 1e-6
+
+
+# ------------------------------------------------------------------- plumbing
+def _safe_name(name: Any) -> str:
+    """Label-safe registry/exposition name: anything that would break a
+    Prometheus label value or a flattened snapshot key becomes ``_``."""
+    return "".join(c if (c.isalnum() or c in "_.:-/") else "_" for c in str(name)) or "_"
+
+
+def _node_list(metric: Union[Metric, MetricCollection]) -> List[Metric]:
+    """The deterministic node walk the pack/journal layout depends on."""
+    if isinstance(metric, MetricCollection):
+        return metric._journal_nodes()
+    return _bucketing.tree_nodes(metric)
+
+
+def _scalar_map(value: Any) -> Dict[str, float]:
+    """Flatten one computed value into label-safe scalars: a scalar Metric
+    value maps to ``{"value": x}``, a collection's dict to one entry per
+    scalar member. Non-scalar leaves (curves, concatenated samples) are
+    skipped — they belong to the trace, not the scrape."""
+    items = value.items() if isinstance(value, dict) else [("value", value)]
+    out: Dict[str, float] = {}
+    for key, v in items:
+        try:
+            arr = np.asarray(v)
+        except Exception:  # noqa: BLE001 — non-numeric member values simply don't scrape
+            continue
+        if arr.size == 1 and np.issubdtype(arr.dtype, np.number):
+            out[_safe_name(key)] = float(arr.reshape(()))
+    return out
+
+
+def _flat_states(nodes: List[Metric]) -> np.ndarray:
+    """Every reduce-path state of ``nodes``, raveled and concatenated as
+    float64 — the raw-state sample the drift detector bins."""
+    rows: List[np.ndarray] = []
+    for node in nodes:
+        for name in node._reduction_specs:
+            value = getattr(node, name)
+            for leaf in value if isinstance(value, list) else [value]:
+                arr = np.asarray(leaf, dtype=np.float64).ravel()
+                if arr.size:
+                    rows.append(arr)
+    return np.concatenate(rows) if rows else np.zeros((0,), dtype=np.float64)
+
+
+_MERGEABLE_SPECS = ("sum", "mean", "max", "min", "cat")
+
+
+def _check_mergeable(nodes: List[Metric], what: str) -> None:
+    """Raise at construction (not at the Nth close) when a state's reduction
+    cannot be re-accumulated across ring slots."""
+    for node in nodes:
+        for name, spec in node._reduction_specs.items():
+            if spec in _MERGEABLE_SPECS:
+                continue
+            if callable(node._reductions.get(name)):
+                continue  # custom reduction: merged via the declared callable
+            raise ValueError(
+                f"{what} cannot merge state {type(node).__name__}.{name}: reduction "
+                f"spec {spec!r} has no slot-merge rule (supported: "
+                f"{', '.join(_MERGEABLE_SPECS)}, or a custom reduction callable)"
+            )
+
+
+def _merge_record(nodes: List[Metric], manifest: Dict[str, Any], payload: bytes) -> None:
+    """Merge one decoded ring slot INTO the live states of ``nodes`` — the
+    "re-accumulation" half of window arithmetic. Same merge semantics as the
+    cross-replica reduce (``sum`` adds, ``max``/``min`` take extrema,
+    ``cat`` concatenates rows, ``mean`` weights by update counts, custom
+    specs apply the metric's own reduction callable), so a window value is
+    exactly what a fresh metric fed the retained strides would compute."""
+    staged = _journal.stage_states(nodes, manifest, payload)
+    local_counts = [int(n._update_count) for n in nodes]
+    rec_counts = list(manifest.get("update_counts", []))
+    inc_counts = [int(rec_counts[i]) if i < len(rec_counts) else 0 for i in range(len(nodes))]
+    for idx, name, value in staged:
+        node = nodes[idx]
+        spec = node._reduction_specs.get(name)
+        local = getattr(node, name)
+        if spec == "cat" or isinstance(local, list) or isinstance(value, list):
+            local_rows = local if isinstance(local, list) else [local]
+            inc_rows = value if isinstance(value, list) else [value]
+            merged: Any = list(local_rows) + list(inc_rows)
+        elif spec == "sum":
+            merged = local + value
+        elif spec == "mean":
+            c_loc, c_inc = local_counts[idx], inc_counts[idx]
+            total = max(c_loc + c_inc, 1)
+            merged = (c_loc * local + c_inc * value) / total
+        elif spec == "max":
+            merged = jnp.maximum(local, value)
+        elif spec == "min":
+            merged = jnp.minimum(local, value)
+        else:
+            merged = node._reductions[name](jnp.stack([jnp.asarray(local), jnp.asarray(value)]))
+        setattr(node, name, merged)
+    for i, node in enumerate(nodes):
+        node._update_count = local_counts[i] + inc_counts[i]
+        node._computed = None
+        node._is_synced = False
+        node._cache = None
+
+
+# ------------------------------------------------------------------- Windowed
+class Windowed:
+    """Tumbling/sliding window over a metric: a ring of packed snapshots.
+
+    ``window`` is the window width in updates, ``stride`` how many updates
+    advance it (``window % stride == 0``; ``stride == window`` — the
+    default — is a tumbling window, smaller strides slide). Every ``stride``
+    updates the current accumulation **closes**: its state is packed into a
+    ring slot (journal-record bytes — crash-consistent when
+    ``journal_path`` is set), the live accumulator resets, and the window
+    value is served by re-accumulating the ``window // stride`` retained
+    slots into a scratch clone.
+
+    In a live world a close is a **collective** (every rank enters it, like
+    ``sync()``): the close id is fleet-agreed through the
+    ``checkpoint_barrier`` step-agreement exchange, then ONE coalesced
+    payload collective merges the stride state fleet-wide, so every rank's
+    ring holds identical fleet-level slots. A membership change mid-close
+    classifies as ``EpochFault`` with the ring and live state intact;
+    survivors simply re-close at the new epoch. At world size 1 a close
+    issues zero collectives.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric, Windowed
+        >>> win = Windowed(MeanMetric(), window=4, stride=2, name="mean")
+        >>> for step in range(6):
+        ...     _ = win.update(jnp.asarray([float(step)]))
+        >>> win.window_id  # three closes: after updates 2, 4 and 6
+        3
+        >>> float(win.value())  # mean of the last window=4 updates: 2,3,4,5
+        3.5
+    """
+
+    def __init__(
+        self,
+        metric: Union[Metric, MetricCollection],
+        window: int,
+        stride: Optional[int] = None,
+        *,
+        name: Optional[str] = None,
+        journal_path: Optional[str] = None,
+    ) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Windowed wraps a metrics_tpu `Metric` or `MetricCollection`, "
+                f"got {type(metric).__name__}"
+            )
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be a positive update count, got {window}")
+        if stride is None:
+            stride = window_default_stride() or window
+        stride = int(stride)
+        if stride < 1 or window % stride:
+            raise ValueError(
+                f"stride must be a positive divisor of window, got stride={stride} window={window}"
+            )
+        self._base = metric
+        self._window = window
+        self._stride = stride
+        self._slots_cap = window // stride
+        self._name = _safe_name(name if name is not None else type(metric).__name__)
+        self._journal_path = str(journal_path) if journal_path else None
+        self._ring: Deque[Tuple[int, bytes]] = deque(maxlen=self._slots_cap)
+        self._closes = 0
+        self._pending = 0
+        self._nodes = _node_list(metric)
+        reason = _journal.journalable(self._nodes)
+        if reason is not None:
+            raise ValueError(f"Windowed requires a journal-packable metric tree: {reason}")
+        _check_mergeable(self._nodes, "Windowed")
+        self._scratch = deepcopy(metric)
+        self._scratch.reset()
+        self._scratch_nodes = _node_list(self._scratch)
+        # ring slots hold FLEET-merged state (the close already paid the one
+        # payload collective): the scratch compute must never re-sync, or a
+        # live world would multiply the window value by the world size
+        for node in self._scratch_nodes:
+            node.sync_on_compute = False
+            node._to_sync = False
+
+    # ------------------------------------------------------------- properties
+    @property
+    def window_id(self) -> int:
+        """The newest (fleet-agreed) close id; 0 before any close."""
+        return self._closes
+
+    @property
+    def slots(self) -> int:
+        """Retained ring slots (``<= window // stride``)."""
+        return len(self._ring)
+
+    @property
+    def base(self) -> Union[Metric, MetricCollection]:
+        """The live (current-stride) accumulator."""
+        return self._base
+
+    # ------------------------------------------------------------ accumulation
+    def update(self, *args: Any, **kwargs: Any) -> Optional[Dict[str, Any]]:
+        """Update the live accumulator; auto-closes the window every
+        ``stride`` updates and returns that close's summary (else None)."""
+        self._base.update(*args, **kwargs)
+        self._pending += 1
+        if self._pending >= self._stride:
+            return self.close_window()
+        return None
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward through the live accumulator (its batch value), counting
+        toward the stride like :meth:`update`; auto-closes on the boundary."""
+        out = self._base(*args, **kwargs)
+        self._pending += 1
+        if self._pending >= self._stride:
+            self.close_window()
+        return out
+
+    def reset(self) -> None:
+        """Drop every retained slot and the live accumulation. Close ids
+        stay monotonic — a fleet-agreed id can never be reissued."""
+        self._ring.clear()
+        self._base.reset()
+        self._pending = 0
+
+    # ------------------------------------------------------------- the close
+    def close_window(self, *, distributed_available: Optional[Callable] = None) -> Dict[str, Any]:
+        """Close the current stride: fleet-agree the close id, merge the
+        stride state fleet-wide (ONE payload collective in a live world, zero
+        at world size 1), pack the merged state as a ring slot, persist it
+        when journaling, reset the live accumulator, and return
+        ``{window, value, world, epoch, slots, bytes}``."""
+        base = self._base
+        base._defer_barrier()
+        t0 = _telemetry.now() if _telemetry.armed else 0.0
+        dist_fn = distributed_available if distributed_available is not None else _psync.distributed_available
+        live = bool(dist_fn()) if callable(dist_fn) else bool(dist_fn)
+        close_id = self._closes + 1
+        world = 1
+        epoch = _psync.world_epoch()
+        if live:
+            try:
+                agreement = _bucketing.agree_step(self, close_id, site="window-close")
+                # a rank that missed strides (rejoin, degraded lane) jumps to
+                # the fleet-agreed id rather than reissuing a stale one
+                close_id = max(close_id, agreement["agreed"])
+                world = agreement["world"]
+                epoch = agreement["epoch"]
+                payload0 = int(_psync.collective_stats().get("sync_payload_collectives", 0))
+                base.sync(distributed_available=dist_fn)
+                payload_delta = (
+                    int(_psync.collective_stats().get("sync_payload_collectives", 0)) - payload0
+                )
+                _counters["window_close_payload_collectives"] += max(payload_delta, 0)
+            except EpochFault:
+                # membership changed mid-close: the ring and the live
+                # accumulator are untouched — survivors re-close at the new
+                # epoch, the window is never torn
+                _counters["window_epoch_trips"] += 1
+                raise
+        for node in self._nodes:
+            node._defer_barrier()
+            node._canonicalize_list_states()
+        record = _journal.pack_record(
+            self._nodes,
+            manifest_extra={
+                "epoch": epoch,
+                "window": close_id,
+                "window_name": self._name,
+                "window_updates": self._window,
+                "stride": self._stride,
+                "world_size": world,
+            },
+        )
+        self._closes = close_id
+        self._ring.append((close_id, record))
+        _counters["window_slots_packed"] += 1
+        if self._journal_path:
+            slot_path = self._slot_path(close_id % self._slots_cap)
+            try:
+                _journal.write_record(slot_path, record)
+                _counters["window_slot_writes"] += 1
+            except Exception as exc:  # noqa: BLE001 — classified; a broken disk degrades persistence, never the close
+                _faults.note_fault(
+                    _faults.classify(exc, "journal"), site="journal-write", owner=self, error=exc
+                )
+                _faults.warn_fault(
+                    self,
+                    "journal",
+                    f"Window ring slot write to {slot_path!r} failed "
+                    f"({type(exc).__name__}: {exc}); the in-memory ring is intact and "
+                    "closes continue without persistence for this slot.",
+                )
+        base.reset()
+        self._pending = 0
+        value = self.value()
+        _counters["window_closes"] += 1
+        dur = (_telemetry.now() - t0) if (t0 and _telemetry.armed) else 0.0
+        self._record_close(close_id, value, world=world, epoch=epoch, close_s=dur, nbytes=len(record))
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "window-close", self._name, "streaming", t0, dur,
+                {"window": close_id, "world": world, "slots": len(self._ring), "bytes": len(record)},
+            )
+        return {
+            "window": close_id,
+            "value": value,
+            "world": world,
+            "epoch": epoch,
+            "slots": len(self._ring),
+            "bytes": len(record),
+        }
+
+    def _record_close(
+        self, close_id: int, value: Any, *, world: int, epoch: int, close_s: float, nbytes: int
+    ) -> None:
+        block = _WINDOWS.setdefault(self._name, {"name": self._name, "values": {}})
+        block.update(
+            window=close_id,
+            oldest=self._ring[0][0] if self._ring else close_id,
+            slots=len(self._ring),
+            stride=self._stride,
+            window_updates=self._window,
+            world=world,
+            epoch=epoch,
+            last_close_s=close_s,
+            last_record_bytes=nbytes,
+        )
+        values: Dict[str, Dict[str, float]] = block["values"]
+        values[str(close_id)] = _scalar_map(value)
+        keep = window_values_kept()
+        for wid in sorted(values, key=int)[:-keep]:
+            del values[wid]
+
+    # ------------------------------------------------------------- the value
+    def value(self) -> Any:
+        """The current window value: restore the oldest retained slot into
+        the scratch clone, re-accumulate every younger slot on top
+        (:func:`_merge_record`), and compute. None before the first close."""
+        if not self._ring:
+            return None
+        self._scratch.reset()
+        first = True
+        for _, record in self._ring:
+            manifest, payload = _journal.decode_record(record, origin=f"<window {self._name}>")
+            if first:
+                _journal.restore_nodes(self._scratch_nodes, manifest, payload)
+                first = False
+            else:
+                _merge_record(self._scratch_nodes, manifest, payload)
+        return self._scratch.compute()
+
+    compute = value
+
+    # ----------------------------------------------------------- persistence
+    def _slot_path(self, slot: int) -> str:
+        return f"{self._journal_path}.slot{slot}"
+
+    def restore(self) -> Dict[str, Any]:
+        """Rebuild the in-memory ring from the on-disk slot files after a
+        crash. Each slot walks its generation ring newest-first: a torn or
+        checksum-failed generation classifies a ``journal`` fault, counts a
+        ``window_ring_demotions`` and **demotes to the previous good
+        generation** — the window narrows to the slots that verify, it never
+        restores corrupt bytes. Returns ``{slots, window, value}``."""
+        if not self._journal_path:
+            raise ValueError("this Windowed was constructed without journal_path")
+        recovered: List[Tuple[int, bytes]] = []
+        for slot in range(self._slots_cap):
+            path = self._slot_path(slot)
+            for gen in range(_journal.journal_generations() + 8):
+                gpath = _journal._gen_path(path, gen)
+                if not os.path.exists(gpath):
+                    continue
+                try:
+                    with open(gpath, "rb") as fh:
+                        data = fh.read()
+                    manifest, _ = _journal.decode_record(data, origin=repr(gpath))
+                except Exception as exc:  # noqa: BLE001 — demote to the previous generation
+                    _counters["window_ring_demotions"] += 1
+                    _faults.note_fault(
+                        _faults.classify(exc, "journal"), site="journal-load", owner=self, error=exc
+                    )
+                    _faults.warn_fault(
+                        self,
+                        "journal",
+                        f"Window ring slot {gpath!r} failed verification "
+                        f"({type(exc).__name__}: {exc}); demoting to the previous good "
+                        "generation of this slot.",
+                    )
+                    continue
+                recovered.append((int(manifest.get("window", 0)), data))
+                break
+        recovered.sort()
+        self._ring.clear()
+        for close_id, data in recovered[-self._slots_cap:]:
+            self._ring.append((close_id, data))
+        if recovered:
+            self._closes = max(self._closes, recovered[-1][0])
+        return {"slots": len(self._ring), "window": self._closes, "value": self.value()}
+
+    # ------------------------------------------------------------------ drift
+    def drift_report(self, reference: Any = None, *, bins: Optional[int] = None) -> Dict[str, Any]:
+        """PSI/KS of the newest retained slot's raw states against the
+        oldest retained slot (or an explicit ``reference`` sample) — "has
+        what this metric accumulates moved across the window". Scores land
+        in the streaming registry under this window's name (scraped as
+        ``metrics_tpu_drift_score{name,kind}``)."""
+        if not self._ring:
+            raise ValueError("drift_report needs at least one closed slot")
+        current = self._slot_sample(-1)
+        if reference is None:
+            if len(self._ring) < 2:
+                raise ValueError(
+                    "drift_report needs >= 2 retained slots (or an explicit reference sample)"
+                )
+            reference = self._slot_sample(0)
+        return drift_report(current, reference, bins=bins, name=self._name)
+
+    def _slot_sample(self, pos: int) -> np.ndarray:
+        _, record = self._ring[pos]
+        manifest, payload = _journal.decode_record(record, origin=f"<window {self._name}>")
+        self._scratch.reset()
+        _journal.restore_nodes(self._scratch_nodes, manifest, payload)
+        return _flat_states(self._scratch_nodes)
+
+
+# -------------------------------------------------------------------- Decayed
+class Decayed:
+    """Exponential decay (EMA) over a metric's ``sum``-reduction states.
+
+    Each update first scales every state by ``0.5 ** (1 / halflife)``
+    through ONE engine-cached donated program (a fused elementwise scale
+    over the whole state tree — the "scale" half of scale-and-add; the
+    update itself is the "add"), so after ``T`` updates every contribution
+    ``i`` is weighted ``decay**(T-i)`` and ``compute()`` serves the
+    decay-weighted value with zero extra state. ``halflife`` is measured in
+    updates.
+
+    Restricted by construction to metrics whose every state reduces by
+    ``sum`` over floating dtypes — the family whose accumulators ARE linear,
+    so scaling them is exactly the EMA re-weighting (``MeanMetric``'s
+    value/weight pair decays into a weighted EMA; integer count states and
+    ``max``/``min``/``cat`` states have no meaningful decay and are
+    rejected with the state named).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric, Decayed
+        >>> ema = Decayed(MeanMetric(), halflife=2.0)
+        >>> for x in (0.0, 0.0, 8.0):
+        ...     ema.update(jnp.asarray([x]))
+        >>> round(float(ema.compute()), 4)  # 8 / (1 + d + d**2), d = 0.5**(1/2)
+        3.6247
+    """
+
+    def __init__(
+        self,
+        metric: Union[Metric, MetricCollection],
+        halflife: float,
+        *,
+        name: Optional[str] = None,
+    ) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Decayed wraps a metrics_tpu `Metric` or `MetricCollection`, "
+                f"got {type(metric).__name__}"
+            )
+        halflife = float(halflife)
+        if not halflife > 0:
+            raise ValueError(f"halflife must be a positive update count, got {halflife}")
+        self._base = metric
+        self._name = _safe_name(name if name is not None else type(metric).__name__)
+        self._decay = float(0.5 ** (1.0 / halflife))
+        self._halflife = halflife
+        self._nodes = _node_list(metric)
+        for node in self._nodes:
+            for sname, spec in node._reduction_specs.items():
+                if spec != "sum":
+                    raise ValueError(
+                        f"Decayed requires sum-reduction states; "
+                        f"{type(node).__name__}.{sname} reduces by {spec!r}"
+                    )
+                value = getattr(node, sname)
+                rows = value if isinstance(value, list) else [value]
+                for row in rows:
+                    if not jnp.issubdtype(jnp.asarray(row).dtype, jnp.floating):
+                        raise ValueError(
+                            f"Decayed requires floating states; {type(node).__name__}.{sname} "
+                            f"is {jnp.asarray(row).dtype} (an integer count cannot decay exactly)"
+                        )
+
+    @property
+    def base(self) -> Union[Metric, MetricCollection]:
+        return self._base
+
+    @property
+    def decay(self) -> float:
+        """Per-update retention factor ``0.5 ** (1 / halflife)``."""
+        return self._decay
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Decay every state one tick, then land the update on top."""
+        self._decay_tick()
+        self._base.update(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        self._decay_tick()
+        return self._base(*args, **kwargs)
+
+    def compute(self) -> Any:
+        return self._base.compute()
+
+    def reset(self) -> None:
+        self._base.reset()
+
+    def _decay_tick(self) -> None:
+        state: Dict[str, Any] = {}
+        avoid: set = set()
+        for i, node in enumerate(self._nodes):
+            node._defer_barrier()
+            for sname in node._reduction_specs:
+                state[f"{i}:{sname}"] = jnp.asarray(getattr(node, sname))
+            avoid.update(node._default_leaf_ids())
+        if not state:
+            return
+        decay = self._decay
+        dtypes = tuple(sorted((k, jnp.dtype(v.dtype).name) for k, v in state.items()))
+
+        def build():
+            def step(st):
+                return {k: v * jnp.asarray(decay, v.dtype) for k, v in st.items()}
+
+            return step, None, {}
+
+        exe = _engine.acquire_keyed(("streaming-decay", decay, dtypes), build)
+        new_state = exe.run(state, avoid_ids=frozenset(avoid))
+        for i, node in enumerate(self._nodes):
+            for sname in node._reduction_specs:
+                setattr(node, sname, new_state[f"{i}:{sname}"])
+            node._computed = None
+            node._is_synced = False
+            node._cache = None
+        _counters["window_decay_ticks"] += 1
+
+
+# ---------------------------------------------------------------------- drift
+def drift_report(
+    current: Any,
+    reference: Any,
+    *,
+    bins: Optional[int] = None,
+    eps: Optional[float] = None,
+    name: Optional[str] = None,
+) -> Dict[str, Any]:
+    """PSI and KS between two samples over one shared linear binning.
+
+    Both samples bin into ``bins`` equal-width buckets spanning their
+    combined finite range (:func:`~metrics_tpu.ops.histogram.fused_bincount`
+    does the counting), each histogram normalizes with an ``eps``
+    probability floor, and two scores come back:
+
+    - ``psi`` — Population Stability Index,
+      ``sum((p - q) * ln(p / q))`` (0 = identical; > 0.2 is the classic
+      "investigate" threshold).
+    - ``ks`` — Kolmogorov–Smirnov statistic over the binned CDFs,
+      ``max |CDF_p - CDF_q|`` (in [0, 1]).
+
+    ``name`` records the scores in the streaming registry (scraped as
+    ``metrics_tpu_drift_score{name,kind}`` and merged fleet-wide).
+
+    Example:
+        >>> import numpy as np
+        >>> from metrics_tpu import drift_report
+        >>> same = drift_report(np.arange(100.0), np.arange(100.0))
+        >>> round(same["psi"], 6), round(same["ks"], 6)
+        (0.0, 0.0)
+        >>> shifted = drift_report(np.arange(100.0), np.arange(100.0) + 80.0)
+        >>> shifted["psi"] > 0.2 and shifted["ks"] > 0.2
+        True
+    """
+    t0 = _telemetry.now() if _telemetry.armed else 0.0
+    bins = int(bins) if bins else drift_bins()
+    eps = float(eps) if eps else drift_eps()
+    cur = np.asarray(jnp.ravel(jnp.asarray(current)), dtype=np.float64)
+    ref = np.asarray(jnp.ravel(jnp.asarray(reference)), dtype=np.float64)
+    cur = cur[np.isfinite(cur)]
+    ref = ref[np.isfinite(ref)]
+    if cur.size == 0 or ref.size == 0:
+        raise ValueError("drift_report needs non-empty finite current and reference samples")
+    lo = float(min(cur.min(), ref.min()))
+    hi = float(max(cur.max(), ref.max()))
+    if hi <= lo:
+        hi = lo + 1.0  # degenerate constant samples: all mass lands in bin 0 on both sides
+    scale = bins / (hi - lo)
+    cur_idx = jnp.asarray(np.clip((cur - lo) * scale, 0, bins - 1).astype(np.int32))
+    ref_idx = jnp.asarray(np.clip((ref - lo) * scale, 0, bins - 1).astype(np.int32))
+    p = np.asarray(fused_bincount(cur_idx, bins), dtype=np.float64)
+    q = np.asarray(fused_bincount(ref_idx, bins), dtype=np.float64)
+    p = (p + eps) / (p.sum() + eps * bins)
+    q = (q + eps) / (q.sum() + eps * bins)
+    psi = float(np.sum((p - q) * np.log(p / q)))
+    ks = float(np.max(np.abs(np.cumsum(p) - np.cumsum(q))))
+    out = {
+        "psi": psi,
+        "ks": ks,
+        "bins": bins,
+        "n_current": int(cur.size),
+        "n_reference": int(ref.size),
+        "lo": lo,
+        "hi": hi,
+    }
+    _counters["drift_reports"] += 1
+    if name is not None:
+        _DRIFT[_safe_name(name)] = {"psi": psi, "ks": ks, "bins": bins}
+    if t0 and _telemetry.armed:
+        _telemetry.emit(
+            "drift-report", _safe_name(name) if name is not None else None, "streaming",
+            t0, _telemetry.now() - t0, {"bins": bins, "psi": psi, "ks": ks},
+        )
+    return out

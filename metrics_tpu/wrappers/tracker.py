@@ -2,6 +2,18 @@
 
 Parity: reference `wrappers/tracker.py:26-213` (``increment`` appends a clone,
 ``compute_all`` stacks, ``best_metric`` arg-max/min with ``maximize``).
+
+The tracker is a **degenerate infinite window**: every step is retained and
+none ever expires — exactly `metrics_tpu.streaming.Windowed` with an
+unbounded ring (for a bounded, fleet-synchronized view of the same history,
+wrap the metric in ``Windowed`` instead). It shares the window plane's
+storage strategy too: when the metric tree is journal-packable
+(``ops/journal.journalable``), ``increment()`` snapshots the finished step
+as ONE packed journal record (a bitcast byte pack — restore is bit-exact,
+and one flat byte string is measurably cheaper than a Python ``deepcopy``
+of a many-state suite); ``deepcopy`` remains the fallback for trees the
+pack declines (non-``cat`` list states, non-array leaves). The newest
+history entry is always a live metric — it is the accumulating step.
 """
 from __future__ import annotations
 
@@ -13,6 +25,9 @@ import jax.numpy as jnp
 
 from metrics_tpu.collections import MetricCollection
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops import journal as _journal
+from metrics_tpu.parallel import bucketing as _bucketing
+from metrics_tpu.utils.exceptions import JournalFault
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -49,8 +64,13 @@ class MetricTracker:
         if isinstance(metric, Metric) and not isinstance(maximize, bool):
             raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
         self.maximize = maximize
-        self._history: List[Union[Metric, MetricCollection]] = []
+        # finished steps as packed journal records (bytes) when the tree is
+        # packable, live clones otherwise; the LAST entry is always live
+        self._history: List[Union[Metric, MetricCollection, bytes]] = []
         self._increment_called = False
+        self._packed_mode: Optional[bool] = None  # decided at first increment
+        self._pristine: Optional[bytes] = None  # packed default state, for reset_all
+        self._scratch: Optional[Union[Metric, MetricCollection]] = None
 
     @property
     def n_steps(self) -> int:
@@ -63,8 +83,36 @@ class MetricTracker:
         return len(self._history)
 
     def increment(self) -> None:
-        """Start a new time step: append a fresh copy of the base metric."""
+        """Start a new time step.
+
+        The finished step snapshots as one packed journal record when the
+        tree is packable (bit-exact restore, cheaper than ``deepcopy``); the
+        new step reuses the live accumulator. A tree the pack declines —
+        at construction or, for dynamic states, mid-run — falls back to the
+        reference ``deepcopy``-per-step history."""
         self._increment_called = True
+        if not self._history:
+            live = deepcopy(self._base_metric)
+            live.reset()
+            self._history.append(live)
+            self._packed_mode = _journal.journalable(self._node_list(live)) is None
+            if self._packed_mode:
+                self._pristine = self._pack(live)
+            return
+        if self._packed_mode:
+            live = self._history[-1]
+            try:
+                record = self._pack(live)
+            except JournalFault:
+                # a state evolved into something the pack declines (e.g. a
+                # list state the canonicalizer cannot concatenate): restore
+                # the byte history into live clones and stay on deepcopy
+                self._materialize()
+            else:
+                self._history[-1] = record
+                self._history.append(live)
+                live.reset()
+                return
         self._history.append(deepcopy(self._base_metric))
         self._history[-1].reset()
 
@@ -83,7 +131,7 @@ class MetricTracker:
     def compute_all(self) -> Union[jax.Array, Dict[str, jax.Array]]:
         """Stack computed values across all steps."""
         self._check_for_increment("compute_all")
-        res = [metric.compute() for metric in self._history]
+        res = [self._step_metric(i).compute() for i in range(len(self._history))]
         if isinstance(self._base_metric, MetricCollection):
             keys = res[0].keys()
             return {k: jnp.stack([r[k] for r in res], axis=0) for k in keys}
@@ -95,8 +143,11 @@ class MetricTracker:
             self._history[-1].reset()
 
     def reset_all(self) -> None:
-        for metric in self._history:
-            metric.reset()
+        for i, entry in enumerate(self._history):
+            if isinstance(entry, (bytes, bytearray)):
+                self._history[i] = self._pristine
+            else:
+                entry.reset()
 
     def best_metric(
         self, return_step: bool = False
@@ -155,6 +206,47 @@ class MetricTracker:
             if return_step:
                 return value, idx
             return value
+
+    # ------------------------------------------------- packed-history plumbing
+    @staticmethod
+    def _node_list(metric: Union[Metric, MetricCollection]) -> List[Metric]:
+        if isinstance(metric, MetricCollection):
+            return metric._journal_nodes()
+        return _bucketing.tree_nodes(metric)
+
+    def _pack(self, metric: Union[Metric, MetricCollection]) -> bytes:
+        nodes = self._node_list(metric)
+        for node in nodes:
+            node._defer_barrier()
+            node._canonicalize_list_states()
+        return _journal.pack_record(nodes)
+
+    def _step_metric(self, i: int) -> Union[Metric, MetricCollection]:
+        """The live view of step ``i``: the entry itself when live, else the
+        packed record restored into one shared scratch clone (valid until the
+        next ``_step_metric`` call)."""
+        entry = self._history[i]
+        if not isinstance(entry, (bytes, bytearray)):
+            return entry
+        if self._scratch is None:
+            self._scratch = deepcopy(self._base_metric)
+        self._scratch.reset()
+        manifest, payload = _journal.decode_record(entry, origin=f"<tracker step {i}>")
+        _journal.restore_nodes(self._node_list(self._scratch), manifest, payload)
+        return self._scratch
+
+    def _materialize(self) -> None:
+        """Fall back from packed to deepcopy history: every byte record
+        restores (bit-exact) into its own live clone."""
+        for i, entry in enumerate(self._history):
+            if not isinstance(entry, (bytes, bytearray)):
+                continue
+            clone = deepcopy(self._base_metric)
+            clone.reset()
+            manifest, payload = _journal.decode_record(entry, origin=f"<tracker step {i}>")
+            _journal.restore_nodes(self._node_list(clone), manifest, payload)
+            self._history[i] = clone
+        self._packed_mode = False
 
     def _check_for_increment(self, method: str) -> None:
         if not self._increment_called:

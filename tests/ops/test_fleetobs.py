@@ -136,7 +136,8 @@ def test_fleet_schema_stable_and_keys():
     assert snap["fleet_schema"] == fleetobs.FLEET_SCHEMA == 1
     expected = {
         "fleet_schema", "world_size", "rank", "epoch", "gathered", "dead_ranks",
-        "ranks", "aggregate", "stragglers", "world_health", "fleet_stats",
+        "ranks", "aggregate", "stragglers", "streaming", "world_health",
+        "fleet_stats",
     }
     assert set(snap) == expected
     assert set(snap) == set(fleetobs.fleet_snapshot()), "fleet keys drift call-over-call"

@@ -1,0 +1,335 @@
+"""Streaming monitoring plane: windows, decay, drift (ISSUE-15 contracts).
+
+Contracts (`metrics_tpu/streaming.py`):
+
+- **Window arithmetic is re-accumulation over packed ring slots** — a
+  sliding window's value is bit-exact vs a fresh metric fed only the
+  retained raw updates, across multiple closes and for sum/mean/cat/max
+  reduction families.
+- **A fleet close is ONE payload collective** — in a fake 3-rank world the
+  close id rides the ``agree_step`` exchange and the stride state merges
+  through exactly one coalesced payload gather (zero collectives at world
+  size 1, counter-asserted); a membership change mid-close classifies as
+  ``EpochFault`` with the ring AND the live accumulator intact, and
+  survivors re-close at the new epoch.
+- **Crash consistency through the journal** — ring slots persist as
+  generation-ringed journal records; a torn newest generation demotes to
+  the previous good one (classified, counted) instead of restoring corrupt
+  bytes.
+- **Decay is the closed form** — ``Decayed`` matches the host EMA oracle
+  within float32 tolerance and rejects non-``sum``/integer state trees at
+  construction.
+- **Drift scores flow to the scrape** — PSI/KS are zero for identical
+  samples, positive for shifted ones, and render through
+  ``fleet_prometheus_text`` as ``metrics_tpu_drift_score{name,kind}``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu import streaming
+from metrics_tpu.ops import engine, fleetobs, journal as journal_mod, telemetry
+from metrics_tpu.parallel import bucketing
+from metrics_tpu.parallel import sync as psync
+from metrics_tpu.utils.exceptions import EpochFault
+
+DIST_ON = lambda: True  # noqa: E731
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    psync.reset_membership()
+    engine.reset_stats()
+    yield
+    psync.reset_membership()
+    engine.reset_stats()
+    # simulated fleet closes complete real coalesced syncs, which memoize
+    # their layout in the fast-lane manifest cache — a later test in the
+    # same process must see the first-sync cross-check path again
+    bucketing._MANIFEST_CACHE.clear()
+
+
+# ----------------------------------------------------------- window arithmetic
+def test_sliding_window_bit_exact_vs_oracle():
+    win = streaming.Windowed(mt.SumMetric(), window=6, stride=2, name="s")
+    fed = []
+    closes = 0
+    for step in range(12):
+        x = jnp.asarray([float(step), float(step) * 0.5])
+        fed.append(np.asarray(x))
+        out = win.update(x)
+        if out is not None:
+            closes += 1
+            # oracle: a fresh metric fed ONLY the updates inside the window
+            oracle = mt.SumMetric()
+            for row in fed[-win._window:]:
+                oracle.update(jnp.asarray(row))
+            assert np.array_equal(np.asarray(out["value"]), np.asarray(oracle.compute())), (
+                f"window {out['window']} value diverged from the re-accumulation oracle"
+            )
+    assert closes >= 3 and win.window_id == closes
+
+
+def test_window_families_mean_cat_max():
+    data = [np.asarray([float(i), float(i) + 0.25], np.float32) for i in range(8)]
+    for base, oracle_base in ((mt.MeanMetric, mt.MeanMetric), (mt.CatMetric, mt.CatMetric), (mt.MaxMetric, mt.MaxMetric)):
+        win = streaming.Windowed(base(), window=4, stride=2)
+        for x in data:
+            win.update(jnp.asarray(x))
+        oracle = oracle_base()
+        for x in data[-4:]:
+            oracle.update(jnp.asarray(x))
+        assert np.array_equal(np.asarray(win.value()), np.asarray(oracle.compute())), base.__name__
+
+
+def test_tumbling_default_and_validation():
+    win = streaming.Windowed(mt.SumMetric(), window=3)
+    assert win._stride == 3 and win._slots_cap == 1
+    assert win.value() is None and win.slots == 0
+    with pytest.raises(ValueError, match="divisor"):
+        streaming.Windowed(mt.SumMetric(), window=4, stride=3)
+    with pytest.raises(ValueError, match="positive"):
+        streaming.Windowed(mt.SumMetric(), window=0)
+    with pytest.raises(TypeError):
+        streaming.Windowed(object(), window=2)
+
+
+def test_window_collection_and_reset():
+    suite = mt.MetricCollection({"mean": mt.MeanMetric(), "total": mt.SumMetric()})
+    win = streaming.Windowed(suite, window=2, stride=2, name="suite")
+    for i in range(4):
+        win.update(jnp.asarray([float(i)]))
+    value = win.value()
+    assert set(value) == {"mean", "total"}
+    assert float(value["mean"]) == 2.5 and float(value["total"]) == 5.0
+    before = win.window_id
+    win.reset()
+    assert win.slots == 0 and win.value() is None
+    assert win.window_id == before, "close ids must stay monotonic across reset"
+
+
+# ------------------------------------------------------------------ fleet close
+class _FakeFleet:
+    """3 identical ranks at both transport seams (shape + payload)."""
+
+    def __init__(self, monkeypatch):
+        psync.set_expected_world(3)
+        monkeypatch.setattr(
+            bucketing, "_host_allgather", lambda vec: np.stack([np.asarray(vec)] * 3)
+        )
+        monkeypatch.setattr(
+            bucketing, "_payload_allgather", lambda packed: jnp.stack([packed] * 3)
+        )
+
+
+def test_fleet_close_is_one_payload_collective(monkeypatch):
+    _FakeFleet(monkeypatch)
+    win = streaming.Windowed(mt.SumMetric(), window=4, stride=2, name="fleet")
+    win.base.update(jnp.asarray([1.0, 2.0]))
+    win.base.update(jnp.asarray([3.0, 4.0]))
+    p0 = psync.collective_stats()["sync_payload_collectives"]
+    out = win.close_window(distributed_available=DIST_ON)
+    p1 = psync.collective_stats()["sync_payload_collectives"]
+    assert p1 - p0 == 1, "a fleet window close must issue exactly ONE payload collective"
+    assert out["world"] == 3
+    # the fake world stacks 3 identical rows: fleet sum = 3x local
+    assert float(out["value"]) == 3.0 * 10.0
+    assert streaming.streaming_stats()["window_close_payload_collectives"] >= 1
+
+
+def test_world1_close_is_zero_collectives():
+    win = streaming.Windowed(mt.SumMetric(), window=2, stride=2)
+    before = psync.collective_stats()["sync_collectives_issued"]
+    win.update(jnp.asarray([1.0]))
+    win.update(jnp.asarray([2.0]))
+    after = psync.collective_stats()["sync_collectives_issued"]
+    assert win.window_id == 1
+    assert after == before, "a world-size-1 close must issue zero collectives"
+
+
+def test_membership_change_mid_close_is_epoch_fault(monkeypatch):
+    _FakeFleet(monkeypatch)
+    win = streaming.Windowed(mt.SumMetric(), window=2, stride=2, name="fence")
+    win.base.update(jnp.asarray([5.0]))
+    state_before = np.asarray(win.base.compute())
+
+    def racing(vec):
+        psync.bump_epoch("test-membership-race")
+        raise RuntimeError("transport interrupted by membership change")
+
+    monkeypatch.setattr(bucketing, "_host_allgather", racing)
+    trips0 = streaming.streaming_stats()["window_epoch_trips"]
+    with pytest.raises(EpochFault):
+        win.close_window(distributed_available=DIST_ON)
+    assert streaming.streaming_stats()["window_epoch_trips"] == trips0 + 1
+    # never a torn window: ring empty, live accumulator intact
+    assert win.slots == 0 and win.window_id == 0
+    assert np.array_equal(np.asarray(win.base.compute()), state_before)
+    # survivors re-close at the new epoch once the transport heals
+    monkeypatch.setattr(
+        bucketing, "_host_allgather", lambda vec: np.stack([np.asarray(vec)] * 3)
+    )
+    out = win.close_window(distributed_available=DIST_ON)
+    assert out["window"] == 1 and out["epoch"] == psync.world_epoch()
+    assert float(out["value"]) == 15.0  # 3 ranks x 5.0
+
+
+# ------------------------------------------------------------ crash consistency
+def test_ring_persistence_and_torn_slot_demotes(tmp_path):
+    path = str(tmp_path / "win.journal")
+    win = streaming.Windowed(mt.SumMetric(), window=4, stride=2, name="disk", journal_path=path)
+    for i in range(8):
+        win.update(jnp.asarray([float(i)]))
+    live_value = float(win.value())
+    assert streaming.streaming_stats()["window_slot_writes"] >= 4
+
+    fresh = streaming.Windowed(mt.SumMetric(), window=4, stride=2, name="disk", journal_path=path)
+    report = fresh.restore()
+    assert report["slots"] == 2 and report["window"] == win.window_id
+    assert float(report["value"]) == live_value
+
+    # tear the NEWEST generation of one slot: restore must demote to the
+    # previous good generation, not restore corrupt bytes
+    victim = win._slot_path(win.window_id % win._slots_cap)
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(raw))
+    torn = streaming.Windowed(mt.SumMetric(), window=4, stride=2, name="disk", journal_path=path)
+    demotions0 = streaming.streaming_stats()["window_ring_demotions"]
+    report = torn.restore()
+    assert streaming.streaming_stats()["window_ring_demotions"] > demotions0
+    # the generation ring held an older good copy of the slot, so the window
+    # still re-accumulates from verified records only
+    assert report["slots"] >= 1
+    for _, record in torn._ring:
+        journal_mod.decode_record(record)  # every retained slot verifies
+
+
+# ------------------------------------------------------------------------ decay
+def test_decayed_matches_closed_form():
+    halflife = 3.0
+    ema = streaming.Decayed(mt.SumMetric(), halflife=halflife)
+    xs = [1.0, 2.0, 4.0, 8.0, 16.0]
+    for x in xs:
+        ema.update(jnp.asarray([x]))
+    d = 0.5 ** (1.0 / halflife)
+    oracle = sum(x * d ** (len(xs) - 1 - i) for i, x in enumerate(xs))
+    assert float(ema.compute()) == pytest.approx(oracle, rel=1e-6)
+    assert streaming.streaming_stats()["window_decay_ticks"] == len(xs)
+    ema.reset()
+    assert float(ema.compute()) == 0.0
+
+
+def test_decayed_mean_is_weighted_ema():
+    halflife = 2.0
+    ema = streaming.Decayed(mt.MeanMetric(), halflife=halflife, name="ema-mean")
+    xs = [0.0, 0.0, 8.0]
+    for x in xs:
+        ema.update(jnp.asarray([x]))
+    d = 0.5 ** (1.0 / halflife)
+    num = sum(x * d ** (len(xs) - 1 - i) for i, x in enumerate(xs))
+    den = sum(d ** (len(xs) - 1 - i) for i in range(len(xs)))
+    assert float(ema.compute()) == pytest.approx(num / den, rel=1e-6)
+
+
+def test_decayed_rejects_nonlinear_states():
+    with pytest.raises(ValueError, match="sum-reduction"):
+        streaming.Decayed(mt.MaxMetric(), halflife=2.0)
+    with pytest.raises(ValueError, match="positive"):
+        streaming.Decayed(mt.SumMetric(), halflife=0.0)
+
+
+# ------------------------------------------------------------------------ drift
+def test_drift_report_scores():
+    rng = np.random.RandomState(3)
+    base = rng.normal(0.0, 1.0, 2000)
+    same = streaming.drift_report(base, base)
+    assert same["psi"] == pytest.approx(0.0, abs=1e-9)
+    assert same["ks"] == pytest.approx(0.0, abs=1e-9)
+    shifted = streaming.drift_report(base + 3.0, base, name="shifted")
+    assert shifted["psi"] > 0.2 and 0.0 < shifted["ks"] <= 1.0
+    # degenerate constant samples score zero drift, not NaN/inf
+    flat = streaming.drift_report(np.ones(10), np.ones(10))
+    assert np.isfinite(flat["psi"]) and flat["psi"] == pytest.approx(0.0, abs=1e-9)
+    with pytest.raises(ValueError, match="non-empty"):
+        streaming.drift_report(np.asarray([np.nan]), base)
+
+
+def test_windowed_drift_detects_shift():
+    win = streaming.Windowed(mt.CatMetric(), window=4, stride=2, name="dist")
+    rng = np.random.RandomState(7)
+    for i in range(4):
+        loc = 0.0 if i < 2 else 5.0  # distribution shifts mid-stream
+        win.update(jnp.asarray(rng.normal(loc, 1.0, 64).astype(np.float32)))
+    report = win.drift_report()
+    assert report["psi"] > 0.2
+    assert streaming.streaming_snapshot()["drift"]["dist"]["psi"] == report["psi"]
+
+
+# -------------------------------------------------------------- observability
+def test_streaming_block_and_counter_typing():
+    win = streaming.Windowed(mt.MeanMetric(), window=2, stride=2, name="obs")
+    win.update(jnp.asarray([1.0]))
+    win.update(jnp.asarray([3.0]))
+    snap = telemetry.telemetry_snapshot()
+    block = snap["streaming"]["windows"]["obs"]
+    assert block["window"] == 1 and block["values"]["1"]["value"] == 2.0
+    assert snap["window_closes"] >= 1  # event counters ride engine_stats
+    # typing discipline: events are counters, window STATE/VALUES are gauges
+    assert telemetry.is_counter_key("window_closes")
+    assert telemetry.is_counter_key("drift_reports")
+    assert not telemetry.is_counter_key("streaming_windows_obs_window")
+    assert not telemetry.is_counter_key("streaming_windows_obs_values_1_value")
+
+
+def test_drift_renders_in_fleet_prometheus_text():
+    win = streaming.Windowed(mt.MeanMetric(), window=2, stride=2, name="served")
+    win.update(jnp.asarray([1.0]))
+    win.update(jnp.asarray([2.0]))
+    streaming.drift_report(np.arange(50.0) + 40.0, np.arange(50.0), name="served")
+    text = fleetobs.fleet_prometheus_text()
+    assert 'metrics_tpu_metric_value{name="served",window="1"} 1.5' in text
+    assert 'metrics_tpu_drift_score{name="served",kind="psi"}' in text
+    psi_line = next(
+        line for line in text.splitlines()
+        if line.startswith('metrics_tpu_drift_score{name="served",kind="psi"}')
+    )
+    assert float(psi_line.rsplit(" ", 1)[1]) > 0.0
+    assert 'metrics_tpu_fleet_window_id{name="served"} 1' in text
+    assert 'metrics_tpu_fleet_window_skew{rank="0",name="served"} 0' in text
+
+
+def test_window_skew_attribution(monkeypatch):
+    # two live planes whose "served" windows reached different close ids
+    planes = {
+        0: {"snapshot_schema": 1, "streaming": {"windows": {"w": {"window": 5}}, "drift": {}}},
+        1: {"snapshot_schema": 1, "streaming": {"windows": {"w": {"window": 3}}, "drift": {}}},
+        2: {"dead": True, "rank": 2},
+    }
+    merged = fleetobs.merge_streaming(planes)
+    skew = merged["window_skew"]["w"]
+    assert skew["agreed"] == 5 and skew["max_skew"] == 2
+    assert skew["per_rank_lag"] == {0: 0, 1: 2}
+
+
+# -------------------------------------------------------------------- env knobs
+def test_env_knobs_parse_and_fall_back(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_DRIFT_BINS", "32")
+    assert streaming.drift_bins() == 32
+    monkeypatch.setenv("METRICS_TPU_DRIFT_BINS", "banana")
+    assert streaming.drift_bins() == 16  # warn-once fallback, never a crash
+    monkeypatch.setenv("METRICS_TPU_DRIFT_EPS", "-3")
+    assert streaming.drift_eps() == 1e-6
+    monkeypatch.setenv("METRICS_TPU_WINDOW_DEFAULT_STRIDE", "2")
+    win = streaming.Windowed(mt.SumMetric(), window=4)
+    assert win._stride == 2
+    monkeypatch.setenv("METRICS_TPU_WINDOW_VALUES_KEPT", "1")
+    w2 = streaming.Windowed(mt.SumMetric(), window=2, stride=2, name="kept")
+    for i in range(6):
+        w2.update(jnp.asarray([float(i)]))
+    values = streaming.streaming_snapshot()["windows"]["kept"]["values"]
+    assert list(values) == [str(w2.window_id)], "only the newest value is retained"

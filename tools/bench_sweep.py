@@ -872,6 +872,55 @@ def main() -> None:
     except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
         print(json.dumps({"metric": "suite_sync(quant_payload)", "error": str(err)[:160]}))
 
+    # window_close row (ISSUE 15): one fleet-agreed window close on a
+    # 4-metric suite — collectives_per_close_live is what sweep_regress
+    # gates round over round (a close issuing more than one payload
+    # collective means the coalesced stride merge broke apart into
+    # per-state gathers); the full close methodology (staged stride
+    # updates, counted fake 3-rank world) lives in bench.py
+    # bench_window_close, reused here verbatim.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_window_close()
+        row = {
+            "metric": "window_close(streaming)",
+            "mode": "sync",
+            "updates_per_s": round(probe["closes_per_s"], 1),
+            "ms_per_close": round(probe["ms_per_close"], 3),
+            "record_bytes": probe["record_bytes"],
+            "collectives_per_close": round(probe["collectives_per_close"], 4),
+            "collectives_per_close_live": round(probe["collectives_per_close_live"], 4),
+            "latency_ms": probe["latency_ms"],
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "window_close(streaming)", "error": str(err)[:160]}))
+
+    # drift_report row (ISSUE 15): one PSI/KS drift computation over two
+    # 4096-sample vectors — the psi/ks columns double as a determinism
+    # canary (fixed seed, fixed shift: a changed score means the binning
+    # or the probability floor changed, not the weather).
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_drift_report()
+        row = {
+            "metric": "drift_report(streaming)",
+            "mode": "host",
+            "updates_per_s": round(probe["reports_per_s"], 1),
+            "ms_per_report": round(probe["ms_per_report"], 3),
+            "sample_size": probe["sample_size"],
+            "psi": round(probe["psi"], 4),
+            "ks": round(probe["ks"], 4),
+            "latency_ms": probe["latency_ms"],
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "drift_report(streaming)", "error": str(err)[:160]}))
+
     # telemetry-armed row (ISSUE 7): the deferred Accuracy loop re-run with
     # the flight recorder ON, exporting + validating a Chrome-trace at the
     # end — pins that a trace-enabled sweep run stays in the deferred rows'
@@ -1004,8 +1053,11 @@ def main() -> None:
                     "headline fused_suite_update_throughput bench.py workload"
                 ),
                 "MetricTracker": (
-                    "bookkeeping wrapper (increment() clones per timestep); its "
-                    "per-update cost is the wrapped metric's, swept above"
+                    "bookkeeping wrapper (increment() snapshots the prior timestep "
+                    "as packed journal-record bytes when the metric packs, deepcopy "
+                    "fallback otherwise); its per-update cost is the wrapped "
+                    "metric's, swept above — the pack cost itself is the "
+                    "window_close(streaming) row's record_bytes column"
                 ),
             },
             # rows measured on our side whose reference arm cannot run here

@@ -49,6 +49,16 @@ Scenarios:
   demotes to the previous good generation and a survivor's handoff record
   (one bucketed state record) fast-forwards the rejoiner to the barrier
   state bit-exactly.
+- ``rank-dies-mid-window-close`` — a 3-rank world loses a rank mid
+  ``Windowed.close_window()``: the epoch fence classifies the interrupted
+  close as ``EpochFault`` (never a torn window — ring and live accumulator
+  bit-intact), the survivors re-close at the new epoch, and the window
+  value is bit-exact vs the uninterrupted fleet-level oracle.
+- ``torn-window-ring-slot`` — a crashed ``Windowed`` restores its on-disk
+  ring with the newest generation of one slot torn: the slot demotes to its
+  previous good generation (classified, counted), so the recovered window
+  is the previous good window — re-accumulated only from records that
+  verify, never from corrupt bytes.
 
 ``--fast`` runs everything except the deferral interaction (the
 ``make faults`` / CI subset); the full sweep adds it. One JSON line per
@@ -562,6 +572,130 @@ def scenario_membership_change_inflight() -> dict:
     return {"scenario": "membership-change-inflight", "ok": bool(ok)}
 
 
+def scenario_rank_dies_mid_window_close() -> dict:
+    """A 3-rank world loses a rank mid ``Windowed.close_window()``: the
+    epoch fence classifies the interrupted close as EpochFault (ring and
+    live accumulator bit-intact — never a torn window), the survivors
+    re-close at the new epoch, and the window value is bit-exact vs the
+    uninterrupted fleet-level re-accumulation oracle."""
+    engine.reset_engine()
+    psync.reset_membership()
+    from metrics_tpu import streaming
+
+    with _env(METRICS_TPU_SYNC_RETRIES="1") as env:
+        env.simulate_distributed()
+        # 3 identical ranks: one stack covers both the close-id agreement
+        # vector and the packed-state payload, and the fleet slot is
+        # world * local by construction (integer-valued -> order-exact)
+        world = {"n": 3}
+        psync.set_expected_world(3)
+        bucketing._host_allgather = lambda vec: np.stack([np.asarray(vec)] * world["n"])
+        bucketing._payload_allgather = lambda x: jnp.stack([x] * world["n"])
+
+        win = streaming.Windowed(mt.SumMetric(), window=4, stride=2, name="chaos-win")
+        s1 = [jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 4.0])]
+        s2 = [jnp.asarray([5.0, 6.0]), jnp.asarray([7.0, 8.0])]
+        for x in s1:
+            win.base.update(x)
+        out1 = win.close_window(distributed_available=DIST_ON)
+        ok = out1["world"] == 3 and _eq(out1["value"], np.float32(30.0))
+
+        # stride 2 lands; rank 2 dies mid-close: the close-id agreement
+        # exchange aborts AND the death bumps the epoch under the close
+        for x in s2:
+            win.base.update(x)
+        live_before = np.asarray(win.base.compute())
+
+        def dying(vec):
+            psync.bump_epoch("rank-2-died-mid-window-close")
+            raise RuntimeError("transport reset: rank died mid window close")
+
+        bucketing._host_allgather = dying
+        trips0 = engine.engine_stats()["window_epoch_trips"]
+        fenced = False
+        try:
+            win.close_window(distributed_available=DIST_ON)
+        except EpochFault:
+            fenced = True  # classified, never a torn window
+        ok = ok and fenced
+        ok = ok and engine.engine_stats()["window_epoch_trips"] == trips0 + 1
+        ok = ok and win.slots == 1 and win.window_id == 1  # ring intact
+        ok = ok and _eq(np.asarray(win.base.compute()), live_before)
+
+        # the survivors {0,1} re-close at the new epoch
+        world["n"] = 2
+        psync.set_expected_world(2)
+        bucketing._host_allgather = lambda vec: np.stack([np.asarray(vec)] * world["n"])
+        out2 = win.close_window(distributed_available=DIST_ON)
+        ok = ok and out2["world"] == 2 and out2["epoch"] == psync.world_epoch()
+
+        # uninterrupted oracle: the same fleet-level slots re-accumulated
+        # from scratch (3 ranks closed slot 1, the 2 survivors slot 2);
+        # sync_on_compute=False — the oracle already holds the fleet total
+        oracle = mt.SumMetric(sync_on_compute=False)
+        for _ in range(3):
+            for x in s1:
+                oracle.update(x)
+        for _ in range(2):
+            for x in s2:
+                oracle.update(x)
+        ok = ok and _eq(np.asarray(win.value()), np.asarray(oracle.compute()))
+        ok = ok and engine.engine_stats()["sync_stale_collectives"] == 0
+    return {
+        "scenario": "rank-dies-mid-window-close",
+        "ok": bool(ok),
+        "epoch": psync.world_epoch(),
+    }
+
+
+def scenario_torn_window_ring_slot() -> dict:
+    """A crashed ``Windowed`` restores its on-disk ring with the newest
+    generation of one slot torn: the slot demotes to its previous good
+    generation (classified journal fault, counted as a ring demotion), so
+    the recovered window is the previous good window — re-accumulated only
+    from records that verify."""
+    engine.reset_engine()
+    from metrics_tpu import streaming
+
+    d = tempfile.mkdtemp(prefix="mt-chaos-")
+    path = os.path.join(d, "win.journal")
+    win = streaming.Windowed(
+        mt.SumMetric(), window=4, stride=2, name="chaos-ring", journal_path=path
+    )
+    updates = [jnp.asarray([float(i), float(i) + 1.0]) for i in range(8)]
+    for x in updates:
+        win.update(x)  # 4 closes: ids 1..4 over a 2-slot ring
+    ok = win.window_id == 4 and win.slots == 2
+    # crash: the process state is gone; the newest generation of the
+    # newest ring slot (close 4) is ALSO torn
+    victim = win._slot_path(win.window_id % win._slots_cap)
+    with open(victim, "r+b") as fh:
+        fh.seek(30)
+        byte = fh.read(1)
+        fh.seek(30)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    j0 = engine.engine_stats()["fault_journal"]
+    demo0 = engine.engine_stats()["window_ring_demotions"]
+    fresh = streaming.Windowed(
+        mt.SumMetric(), window=4, stride=2, name="chaos-ring-restored", journal_path=path
+    )
+    report = fresh.restore()
+    ok = ok and engine.engine_stats()["window_ring_demotions"] == demo0 + 1
+    ok = ok and engine.engine_stats()["fault_journal"] > j0
+    # the torn slot demoted to its previous generation (close 2), so the
+    # recovered window is the previous good window {closes 2, 3}
+    oracle = mt.SumMetric()
+    for x in updates[2:6]:
+        oracle.update(x)
+    ok = ok and report["slots"] == 2 and fresh.window_id == 3
+    ok = ok and _eq(np.asarray(report["value"]), np.asarray(oracle.compute()))
+    return {
+        "scenario": "torn-window-ring-slot",
+        "ok": bool(ok),
+        "recovered_window": fresh.window_id,
+    }
+
+
 FAST = [
     scenario_timeout_then_compile,
     scenario_crash_with_torn_journal,
@@ -571,6 +705,8 @@ FAST = [
     scenario_force_deadline_degraded,
     scenario_membership_change_inflight,
     scenario_barrier_with_torn_generation,
+    scenario_rank_dies_mid_window_close,
+    scenario_torn_window_ring_slot,
 ]
 FULL = FAST + [scenario_flush_fault_during_journal_save]
 

@@ -60,6 +60,7 @@ def compare(
     p50_threshold: float = 3.0,
     tail_threshold: float = 4.0,
     wire_hidden_floor: float = 0.5,
+    close_collective_ceiling: float = 1.0,
 ) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
@@ -112,6 +113,21 @@ def compare(
                     f"{float(new_wire):.2f} (below the {wire_hidden_floor} floor — "
                     "the async sync stopped hiding the wire)"
                 )
+        # ---- the window-close collective gate (ISSUE 15): a row that
+        # archived collectives_per_close_live must keep a fleet window
+        # close at ONE payload collective — a close issuing more means the
+        # coalesced stride merge broke apart into per-state gathers, a
+        # collective-budget regression even when every throughput and
+        # latency column still looks fine ----
+        new_cpc = new_row.get("collectives_per_close_live")
+        if new_cpc is not None and float(new_cpc) > close_collective_ceiling:
+            old_cpc = old_row.get("collectives_per_close_live")
+            problems.append(
+                f"{name}: collectives_per_close_live "
+                f"{'(unrecorded)' if old_cpc is None else f'{float(old_cpc):.2f}'} -> "
+                f"{float(new_cpc):.2f} (above the {close_collective_ceiling} ceiling — "
+                "a fleet window close stopped merging in one payload collective)"
+            )
     return problems
 
 
@@ -172,7 +188,8 @@ def _pop_flag(argv: list, flag: str, default: float):
 
 _USAGE = (
     "usage: sweep_regress.py [--threshold X] [--p50-threshold X] "
-    "[--tail-threshold X] [--wire-hidden-floor X] [--explain] OLD.json NEW.json"
+    "[--tail-threshold X] [--wire-hidden-floor X] "
+    "[--close-collective-ceiling X] [--explain] OLD.json NEW.json"
 )
 
 
@@ -185,12 +202,15 @@ def main(argv) -> int:
     argv, p50_threshold, ok2 = _pop_flag(argv, "--p50-threshold", 3.0)
     argv, tail_threshold, ok3 = _pop_flag(argv, "--tail-threshold", 4.0)
     argv, wire_floor, ok4 = _pop_flag(argv, "--wire-hidden-floor", 0.5)
-    if not (ok1 and ok2 and ok3 and ok4) or len(argv) != 2:
+    argv, close_ceiling, ok5 = _pop_flag(argv, "--close-collective-ceiling", 1.0)
+    if not (ok1 and ok2 and ok3 and ok4 and ok5) or len(argv) != 2:
         print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
         old, new = json.load(f_old), json.load(f_new)
-    problems = compare(old, new, threshold, p50_threshold, tail_threshold, wire_floor)
+    problems = compare(
+        old, new, threshold, p50_threshold, tail_threshold, wire_floor, close_ceiling
+    )
     if problems:
         print("\n".join(problems))
         if do_explain:

@@ -206,6 +206,46 @@ def check_histogram_exposition(text: str) -> List[str]:
     return problems
 
 
+def check_streaming_exposition(text: str) -> List[str]:
+    """Validate the streaming-plane families in a fleet exposition
+    (``fleet_prometheus_text()``): every ``metrics_tpu_drift_score`` sample
+    must carry ``name`` and ``kind`` labels with ``kind`` in {psi, ks} and a
+    finite value, and every ``metrics_tpu_metric_value`` sample must carry
+    ``name`` and an integer ``window`` label — the same discipline
+    ``streaming_monitoring_certification`` asserts end to end."""
+    import math
+
+    problems: List[str] = []
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name_labels, value = line.rsplit(" ", 1)
+        base = name_labels.split("{", 1)[0]
+        if base not in ("metrics_tpu_drift_score", "metrics_tpu_metric_value"):
+            continue
+        labels = dict(
+            part.split("=", 1)
+            for part in name_labels[len(base):].strip("{}").split(",")
+            if "=" in part
+        )
+        labels = {k: v.strip('"') for k, v in labels.items()}
+        tag = name_labels
+        try:
+            v = float(value)
+        except ValueError:
+            problems.append(f"{tag}: non-numeric value {value!r}")
+            continue
+        if not math.isfinite(v):
+            problems.append(f"{tag}: non-finite value")
+        if "name" not in labels:
+            problems.append(f"{tag}: missing name label")
+        if base == "metrics_tpu_drift_score" and labels.get("kind") not in ("psi", "ks"):
+            problems.append(f"{tag}: kind label must be psi or ks")
+        if base == "metrics_tpu_metric_value" and not labels.get("window", "").isdigit():
+            problems.append(f"{tag}: window label must be an integer close id")
+    return problems
+
+
 def check_trace(doc: Any) -> List[str]:
     """Structural validation of one loaded trace document; returns the list
     of problems (empty == valid Chrome-trace JSON with monotonic span
@@ -356,6 +396,35 @@ def summarize(doc: Dict[str, Any], top: int = 10) -> str:
             + ", ".join(f"{k}×{v}" for k, v in sorted(violated.items()))
             + f" (total {slo.get('total', 0)})"
         )
+
+    # ---- window timeline (streaming plane) ----
+    streaming = (doc.get("snapshot") or {}).get("streaming") or {}
+    windows = streaming.get("windows") or {}
+    drift = streaming.get("drift") or {}
+    if windows or drift:
+        lines.append(f"\n== window timeline ({len(windows)} windows, streaming plane) ==")
+        for wname, info in sorted(windows.items()):
+            values = info.get("values") or {}
+            tail = []
+            for wid in sorted(values, key=lambda k: int(k))[-max(top, 5):]:
+                val = values[wid] or {}
+                if set(val) == {"value"}:
+                    shown = f"{float(val['value']):.4g}"
+                elif val:
+                    shown = "{" + ",".join(f"{k}={float(v):.4g}" for k, v in sorted(val.items())) + "}"
+                else:
+                    shown = "(non-scalar)"
+                tail.append(f"#{wid}={shown}")
+            lines.append(
+                f"  {wname:<22} window={info.get('window_updates', '?')} "
+                f"stride={info.get('stride', '?')} closed={info.get('window', '?')} "
+                f"slots={info.get('slots', '?')}  " + "  ".join(tail)
+            )
+        for dname, scores in sorted(drift.items()):
+            lines.append(
+                f"  drift {dname:<16} psi={float(scores.get('psi', 0.0)):.4f} "
+                f"ks={float(scores.get('ks', 0.0)):.4f} bins={scores.get('bins', '?')}"
+            )
 
     # ---- fault-lane timeline ----
     marks = [e for e in rows if e["name"] in FAULT_MARKS]
@@ -709,6 +778,17 @@ def run_smoke(out_path: str) -> str:
         )
         assert report["sync"]["wire"]["bytes_gathered"] > 0, report["sync"]["wire"]
         assert report["opportunities"], "perf_report ranked no opportunities"
+        # ---- the streaming plane: a sliding window with an injected
+        # distribution shift, so the export carries window values AND a
+        # nonzero drift score ----
+        win = mt.Windowed(mt.CatMetric(), window=8, stride=2, name="smoke-window")
+        mwin = mt.Windowed(mt.MeanMetric(), window=4, stride=2, name="smoke-mean")
+        for i in range(8):
+            loc = 0.0 if i < 4 else 4.0
+            batch = jnp.asarray(rng.normal(loc, 1.0, 32).astype(np.float32))
+            win.update(batch)
+            mwin.update(batch)
+        win.drift_report()  # newest (shifted) slot vs oldest (pre-shift) slot
         suite.save_state(out_path + ".journal")
         engine.export_trace(out_path)
     finally:
@@ -730,6 +810,22 @@ def run_smoke(out_path: str) -> str:
     # validator (cumulative le monotone, +Inf == _count, _sum consistent)
     problems = check_histogram_exposition(mt.prometheus_text())
     assert not problems, f"prometheus_text histogram families invalid: {problems[:3]}"
+    # the streaming block must round-trip through the export, and the drift
+    # families must pass the exposition validator (world size 1: the fleet
+    # rendering serves the local plane, zero collectives)
+    streaming = (doc.get("snapshot") or {}).get("streaming") or {}
+    assert (streaming.get("windows") or {}).get("smoke-window", {}).get("values"), (
+        f"--smoke trace lost the streaming window block: {sorted(streaming.get('windows') or {})}"
+    )
+    assert float((streaming.get("drift") or {}).get("smoke-window", {}).get("psi", 0.0)) > 0, (
+        "--smoke drift report carries no shift signal"
+    )
+    assert "window timeline" in summarize(doc), "report lost its window-timeline section"
+    fleet_text = mt.fleet_prometheus_text()
+    assert 'metrics_tpu_drift_score{name="smoke-window",kind="psi"}' in fleet_text
+    assert 'metrics_tpu_metric_value{name="smoke-mean",window="' in fleet_text
+    problems = check_streaming_exposition(fleet_text)
+    assert not problems, f"streaming exposition families invalid: {problems[:3]}"
     return out_path
 
 
