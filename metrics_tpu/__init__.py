@@ -165,9 +165,31 @@ from metrics_tpu.streaming import (  # noqa: E402
 # peer-health surface behind epoch-fenced collectives and quorum compute
 from metrics_tpu.parallel.sync import world_health  # noqa: E402
 
+# the functional pytree core (docs/performance.md "Zero host round trips"):
+# state-as-pytree apply_* API riding inside the user's jitted SPMD step —
+# in-graph collectives, epoch-stamped state trees, the host_handoff seam
+from metrics_tpu.functional_core import (  # noqa: E402
+    FuncState,
+    apply_compute,
+    apply_update,
+    funcore_stats,
+    host_handoff,
+)
+from metrics_tpu.parallel.sharding import (  # noqa: E402
+    infer_state_pspecs,
+    infer_state_shardings,
+)
+
 __all__ = [
     "__version__",
     "functional",
+    "FuncState",
+    "apply_compute",
+    "apply_update",
+    "funcore_stats",
+    "host_handoff",
+    "infer_state_pspecs",
+    "infer_state_shardings",
     "export_trace",
     "prometheus_text",
     "set_telemetry",

@@ -1857,28 +1857,47 @@ class MetricCollection:
         of the reference's host-side compute groups (`collections.py:191-267`).
         ``compute(states, axis_name=...)`` inside ``shard_map`` syncs every
         state with fused collectives.
+
+        Delegates to :mod:`metrics_tpu.functional_core` (the one functional
+        implementation the ``apply_*`` methods also ride); the export is
+        cached per member-fingerprint tuple, so repeated calls — and every
+        ``apply_update`` in a hot loop — reuse the member templates.
         """
-        items = list(self.items(keep_base=True, copy_state=False))
-        fns = {name: m.as_functions() for name, m in items}
-        filters = {name: m._filter_kwargs for name, m in items}
-        set_name = self._set_name
+        from metrics_tpu import functional_core as _funcore
 
-        def init() -> Dict[str, Any]:
-            return {name: f[0]() for name, f in fns.items()}
+        return _funcore.metric_functions(self)
 
-        def update(states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-            return {
-                name: fns[name][1](states[name], *args, **filters[name](**kwargs)) for name in fns
-            }
+    def init(self) -> Any:
+        """A fresh epoch-stamped ``{metric_name: state}`` tree for the whole
+        suite (:class:`metrics_tpu.functional_core.FuncState`). See
+        :func:`metrics_tpu.functional_core.init`."""
+        from metrics_tpu import functional_core as _funcore
 
-        def compute(states: Dict[str, Any], axis_name: Optional[str] = None) -> Dict[str, Any]:
-            # same naming contract as the stateful path: flatten dict-valued
-            # results, then apply prefix/postfix to every flat key
-            res = {name: fns[name][2](states[name], axis_name=axis_name) for name in fns}
-            res = _flatten_dict(res)
-            return {set_name(k): v for k, v in res.items()}
+        return _funcore.init(self)
 
-        return init, update, compute
+    def apply_update(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        """Pure whole-suite update over one explicit state tree — ONE
+        jittable function covering every member. See
+        :func:`metrics_tpu.functional_core.apply_update`."""
+        from metrics_tpu import functional_core as _funcore
+
+        return _funcore.apply_update(self, state, *args, **kwargs)
+
+    def apply_compute(self, state: Any, *, axis_name: Optional[str] = None) -> Any:
+        """Pure whole-suite compute; with ``axis_name`` every member merges
+        with in-graph collectives (zero host round trips). See
+        :func:`metrics_tpu.functional_core.apply_compute`."""
+        from metrics_tpu import functional_core as _funcore
+
+        return _funcore.apply_compute(self, state, axis_name=axis_name)
+
+    def host_handoff(self, state: Any, *, merged: bool = True) -> "MetricCollection":
+        """Land an in-graph suite state tree back into every member shell
+        without double-merging. See
+        :func:`metrics_tpu.functional_core.host_handoff`."""
+        from metrics_tpu import functional_core as _funcore
+
+        return _funcore.host_handoff(self, state, merged=merged)
 
     # ---------------------------------------------------------- compute groups
     def _merge_compute_groups(self) -> None:
@@ -2037,6 +2056,8 @@ class MetricCollection:
             # per-process health bookkeeping, not suite state
             "_fault_ladders",
             "_fault_warned",
+            # the functional-core export cache (closures over member templates)
+            "_funcore_export",
         )
         return {k: v for k, v in self.__dict__.items() if k not in drop}
 

@@ -1,0 +1,385 @@
+"""The functional pytree core: metric state as an explicit, epoch-stamped tree.
+
+This is the in-graph SPMD backend the stateful API is a shell over. State
+lives as a pytree the *caller* owns — ``init() -> state``,
+``apply_update(state, *batch) -> state``, ``apply_compute(state) -> value`` —
+so an entire metric suite rides INSIDE the user's jitted/``shard_map``'d
+training step: cross-device merge lowers to in-graph ``lax.psum`` /
+``lax.all_gather`` keyed on a mesh axis name
+(:mod:`metrics_tpu.parallel.collectives`), and a step issues **zero host
+round trips at any world size** — the host-driven sync plane
+(:mod:`metrics_tpu.parallel.sync`) never runs. Usage::
+
+    state = suite.init()                         # FuncState, epoch-stamped
+    @partial(shard_map, mesh=mesh, in_specs=(..., P("dp")), out_specs=...)
+    def train_step(state, batch):
+        ...
+        state = suite.apply_update(state, preds, target)
+        return state                              # still per-device partials
+    value = suite.apply_compute(state, axis_name="dp")   # in-graph merge
+
+Three contracts define the jit boundary:
+
+- **One code path.** The pure functions are built from the SAME
+  ``_inner_update`` / ``_inner_compute`` bodies the module API dispatches
+  (``Metric.update``/``compute``) — ``Metric.as_functions()`` and the
+  ``apply_*`` methods both delegate here, so the stateful shell and the
+  functional core cannot drift.
+- **Epoch in the state tree.** :class:`FuncState` carries the world epoch as
+  STATIC pytree metadata: a membership transition changes the treedef, so a
+  jitted step retraces (the in-graph analogue of the host plane's epoch
+  fence), and :func:`host_handoff` classifies a stale stamp as
+  :class:`~metrics_tpu.utils.exceptions.EpochFault` before any state lands.
+- **Explicit hand-back.** :func:`host_handoff` is the ONE seam where
+  in-graph state re-enters the host-side planes (journal packs, window
+  closes, fleet scrapes): it drains the shell's pending async sync, restores
+  the tree, and marks it pre-synced so ``compute()``/window closes never
+  double-merge an already-merged state.
+
+The export closures are cached per config fingerprint on the owning
+instance (``__getstate__`` drops the cache), so hot-path ``apply_update``
+calls do not re-deepcopy the template the way a fresh ``as_functions()``
+export would.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops import telemetry as _telemetry
+from metrics_tpu.parallel import sync as _psync
+from metrics_tpu.parallel.collectives import sync_pytree
+
+_EXPORT_CACHE_ATTR = "_funcore_export"
+
+_counters: Dict[str, int] = {
+    # export-closure builds (template deepcopies) vs fingerprint cache hits —
+    # the hot-path pin: N apply_update calls on one config build ONE template
+    "funcore_exports": 0,
+    "funcore_export_hits": 0,
+    # API events (host-visible: eager calls and jit traces, never in-graph
+    # steps — a compiled step is invisible to the host by design)
+    "funcore_inits": 0,
+    "funcore_updates": 0,
+    "funcore_computes": 0,
+    # the hand-back seam
+    "funcore_handoffs": 0,
+    "funcore_handoff_nodes": 0,
+    "funcore_handoff_sync_cancels": 0,
+}
+
+
+def funcore_stats() -> Dict[str, int]:
+    """Functional-core event counters (folded into ``engine_stats()``)."""
+    return dict(_counters)
+
+
+def _reset_funcore_counters() -> None:
+    for key in _counters:
+        _counters[key] = 0
+
+
+_telemetry.register_reset("funcore", _reset_funcore_counters)
+
+
+# ------------------------------------------------------------------ FuncState
+@jax.tree_util.register_pytree_node_class
+class FuncState:
+    """An epoch-stamped functional state tree.
+
+    ``states`` is the plain pytree (``{state_name: leaf}`` for a Metric,
+    ``{metric_name: {state_name: leaf}}`` for a collection); ``epoch`` is the
+    :func:`metrics_tpu.parallel.sync.world_epoch` stamp carried as STATIC
+    pytree aux data. Being static, the stamp participates in jit cache keys:
+    a membership transition (peer death, rejoin) produces states whose
+    treedef differs, so every compiled step retraces instead of silently
+    pairing a pre-transition cohort's state with a post-transition world —
+    and :func:`host_handoff` raises the classified ``EpochFault`` when a
+    stale-stamped tree tries to land. All leaves flatten/donate like any
+    pytree (``jax.jit(step, donate_argnums=0)`` works unchanged).
+    """
+
+    __slots__ = ("states", "epoch")
+
+    def __init__(self, states: Any, epoch: int) -> None:
+        self.states = states
+        self.epoch = int(epoch)
+
+    def tree_flatten(self) -> Tuple[Tuple[Any], int]:
+        return (self.states,), self.epoch
+
+    @classmethod
+    def tree_unflatten(cls, epoch: int, children: Tuple[Any]) -> "FuncState":
+        return cls(children[0], epoch)
+
+    def with_epoch(self, epoch: int) -> "FuncState":
+        """The same state tree restamped (explicit re-entry after a fence)."""
+        return FuncState(self.states, epoch)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"FuncState(epoch={self.epoch}, states={list(self.states)!r})"
+
+
+def _unwrap(state: Any) -> Tuple[Any, Optional[int]]:
+    if isinstance(state, FuncState):
+        return state.states, state.epoch
+    return state, None
+
+
+def _rewrap(states: Any, template: Any) -> Any:
+    if isinstance(template, FuncState):
+        return FuncState(states, template.epoch)
+    return states
+
+
+# ------------------------------------------------------------- metric exports
+def _build_metric_functions(metric: Any) -> Tuple[Callable, Callable, Callable]:
+    """``(init, update, compute)`` pure closures for one Metric.
+
+    The kernels are the metric's own ``_inner_update``/``_inner_compute``
+    bodies run on a reset template clone — the single implementation the
+    stateful wrappers also dispatch — with update-inferred static
+    hyperparameters flowing back to the template
+    (``_propagate_static_attrs``) so ``compute``'s clone sees them.
+    """
+    from metrics_tpu.metric import _propagate_static_attrs
+
+    if not metric._defaults and metric._named_child_metrics():
+        # child-holding wrappers register no states of their own — the base
+        # export would be an empty state dict whose update XLA
+        # dead-code-eliminates, silently dropping every child update
+        raise NotImplementedError(
+            f"{type(metric).__name__} holds its state in child metrics; the base "
+            "export would produce an empty state dict and a no-op update. "
+            "Export the wrapped metric's as_functions() directly, or use a "
+            "wrapper that provides its own export (ClasswiseWrapper; "
+            "MultioutputWrapper(remove_nans=False))."
+        )
+    template = metric._bare_clone()
+
+    def init() -> Dict[str, Any]:
+        # fresh copies, never references to the template defaults: callers
+        # jit the update with donate_argnums, and donating a buffer shared
+        # with a live Metric instance would invalidate that metric's state
+        return {
+            k: (list(v) if isinstance(v, list) else jnp.asarray(v).copy())
+            for k, v in template._defaults.items()
+        }
+
+    def update_fn(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        m = template._bare_clone()
+        m._restore_state(state)
+        m._inner_update(*args, **kwargs)
+        _propagate_static_attrs(m, template)
+        return m._state_snapshot()
+
+    def compute_fn(state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
+        m = template._bare_clone()
+        if axis_name is not None:
+            custom = {k: fn for k, fn in m._reductions.items() if m._reduction_specs[k] == "custom"}
+            state = sync_pytree(state, m._reduction_specs, axis_name, custom)
+        m._restore_state(state)
+        return m._inner_compute()
+
+    return init, update_fn, compute_fn
+
+
+def _build_collection_functions(collection: Any) -> Tuple[Callable, Callable, Callable]:
+    """The collection lift: one ``{metric_name: state}`` tree, one jittable
+    update covering the whole suite, one compute applying the collection's
+    flatten/prefix naming contract."""
+    from metrics_tpu.utils.data import _flatten_dict
+
+    items = list(collection.items(keep_base=True, copy_state=False))
+    fns = {name: metric_functions(m) for name, m in items}
+    filters = {name: m._filter_kwargs for name, m in items}
+    set_name = collection._set_name
+
+    def init() -> Dict[str, Any]:
+        return {name: f[0]() for name, f in fns.items()}
+
+    def update(states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return {
+            name: fns[name][1](states[name], *args, **filters[name](**kwargs)) for name in fns
+        }
+
+    def compute(states: Dict[str, Any], axis_name: Optional[str] = None) -> Dict[str, Any]:
+        # same naming contract as the stateful path: flatten dict-valued
+        # results, then apply prefix/postfix to every flat key
+        res = {name: fns[name][2](states[name], axis_name=axis_name) for name in fns}
+        res = _flatten_dict(res)
+        return {set_name(k): v for k, v in res.items()}
+
+    return init, update, compute
+
+
+def _export_key(owner: Any) -> tuple:
+    from metrics_tpu.ops.engine import config_fingerprint
+
+    if _is_collection(owner):
+        return tuple(
+            (name, config_fingerprint(m))
+            for name, m in owner.items(keep_base=True, copy_state=False)
+        )
+    return config_fingerprint(owner)
+
+
+def _is_collection(owner: Any) -> bool:
+    from metrics_tpu.collections import MetricCollection
+
+    return isinstance(owner, MetricCollection)
+
+
+def metric_functions(owner: Any) -> Tuple[Callable, Callable, Callable]:
+    """The cached ``(init, update, compute)`` export for a Metric or
+    MetricCollection — ``as_functions()`` and the ``apply_*`` methods both
+    resolve through here, keyed by config fingerprint so a hot loop builds
+    the template once instead of deep-copying per call (the cache rides the
+    instance and ``__getstate__`` drops it for pickle/clone)."""
+    key = _export_key(owner)
+    cached = owner.__dict__.get(_EXPORT_CACHE_ATTR)
+    if cached is not None and cached[0] == key:
+        _counters["funcore_export_hits"] += 1
+        return cached[1]
+    # The build clones a reset template whose state arrays must be CONCRETE:
+    # a first call from inside a jit/shard_map trace would otherwise bind the
+    # template's reset ops to the ambient trace and cache leaked tracers that
+    # poison every later host-side init().
+    with jax.ensure_compile_time_eval():
+        if _is_collection(owner):
+            fns = _build_collection_functions(owner)
+        else:
+            fns = _build_metric_functions(owner)
+    object.__setattr__(owner, _EXPORT_CACHE_ATTR, (key, fns))
+    _counters["funcore_exports"] += 1
+    return fns
+
+
+# ------------------------------------------------------------------ the API
+def init(owner: Any) -> FuncState:
+    """A fresh epoch-stamped state tree for ``owner`` (Metric or
+    MetricCollection). The stamp is the live world epoch; a membership
+    transition before hand-back classifies as ``EpochFault`` at the seam."""
+    init_fn, _, _ = metric_functions(owner)
+    _counters["funcore_inits"] += 1
+    return FuncState(init_fn(), _psync.world_epoch())
+
+
+def apply_update(owner: Any, state: Any, *args: Any, **kwargs: Any) -> Any:
+    """Pure update: ``state`` in, next ``state`` out, no host effects.
+
+    Accepts either a :class:`FuncState` (epoch preserved through the step)
+    or a bare state pytree (the ``as_functions()`` shape) and returns the
+    same kind. Jit/``shard_map`` this freely; inside a compiled step the
+    host never sees the call."""
+    _, update_fn, _ = metric_functions(owner)
+    states, _ = _unwrap(state)
+    _counters["funcore_updates"] += 1
+    return _rewrap(update_fn(states, *args, **kwargs), state)
+
+
+def apply_compute(owner: Any, state: Any, *, axis_name: Optional[str] = None) -> Any:
+    """Pure compute. With ``axis_name`` (inside ``shard_map``/``pjit`` over a
+    mesh axis) every state's reduction spec lowers to ONE in-graph XLA
+    collective (psum/pmean/pmax/pmin/all_gather) — the zero-host-round-trip
+    replacement for the host sync plane."""
+    _, _, compute_fn = metric_functions(owner)
+    states, _ = _unwrap(state)
+    _counters["funcore_computes"] += 1
+    return compute_fn(states, axis_name=axis_name)
+
+
+def state_shardings_for(
+    owner: Any, state: Any, mesh: Any, axis_name: Optional[str] = None
+) -> Any:
+    """Per-leaf ``NamedSharding`` inference for a functional state tree —
+    see :func:`metrics_tpu.parallel.sharding.infer_state_shardings`."""
+    from metrics_tpu.parallel.sharding import infer_state_shardings
+
+    states, _ = _unwrap(state)
+    if _is_collection(owner):
+        specs = {
+            name: dict(m._reduction_specs)
+            for name, m in owner.items(keep_base=True, copy_state=False)
+        }
+        out = {
+            name: infer_state_shardings(states[name], mesh, specs[name], axis_name=axis_name)
+            for name in states
+        }
+    else:
+        out = infer_state_shardings(states, mesh, dict(owner._reduction_specs), axis_name=axis_name)
+    return _rewrap(out, state)
+
+
+# ------------------------------------------------------------------ hand-back
+def host_handoff(owner: Any, state: Any, *, merged: bool = True) -> Any:
+    """Land an in-graph state tree back into the stateful shell.
+
+    The ONE sanctioned seam between the functional core and the host-side
+    planes. For each shell node this: flushes the deferred-dispatch queue
+    (an enqueued host-path update would otherwise land ON TOP of the
+    restored tree), CANCELS any in-flight async sync (its merged rows
+    describe pre-handoff state), restores the tree, and — when ``merged``
+    (the default; the state came through an in-graph ``apply_compute`` merge
+    or is world-size-1) — marks the node pre-synced with the landed tree as
+    its sync snapshot, so ``compute()``, window closes and journal packs
+    serve it WITHOUT re-entering the sync protocol: no double merge, no
+    collective issued.
+
+    An epoch-stamped :class:`FuncState` is fenced first: a stamp behind the
+    live world epoch raises the classified ``EpochFault`` (site
+    ``funcore-handoff``) before anything lands — local shell state is
+    intact, exactly like the host plane's fence. Re-stamp with
+    :meth:`FuncState.with_epoch` after handling the transition to land
+    anyway. Returns ``owner``.
+    """
+    states, epoch = _unwrap(state)
+    if epoch is not None:
+        _psync.check_epoch(epoch, site="funcore-handoff", owner=owner)
+    if _is_collection(owner):
+        nodes = [(m, states[name]) for name, m in owner.items(keep_base=True, copy_state=False)]
+    else:
+        nodes = [(owner, states)]
+    for m, s in nodes:
+        m._defer_barrier()
+        fut = m.__dict__.get("_pending_sync")
+        if fut is not None:
+            fut.cancel()
+            object.__setattr__(m, "_pending_sync", None)
+            _counters["funcore_handoff_sync_cancels"] += 1
+        landed = {k: (list(v) if isinstance(v, list) else v) for k, v in s.items()}
+        m._restore_state(landed)
+        m._computed = None
+        m._update_count = max(int(getattr(m, "_update_count", 0)), 1)
+        if merged:
+            # the landed tree IS the merged snapshot: _is_synced makes every
+            # sync_context enter presynced (compute serves without issuing a
+            # collective), and _cache makes an explicit unsync() a no-op
+            # restore of the same tree instead of a missing-cache error
+            m._is_synced = True
+            m._cache = m._state_snapshot()
+        else:
+            m._is_synced = False
+            m._cache = None
+    _counters["funcore_handoffs"] += 1
+    _counters["funcore_handoff_nodes"] += len(nodes)
+    if _telemetry.armed:
+        _telemetry.emit(
+            "funcore-handoff", owner, "sync",
+            attrs={"nodes": len(nodes), "merged": bool(merged), "epoch": epoch},
+        )
+    return owner
+
+
+__all__ = [
+    "FuncState",
+    "apply_compute",
+    "apply_update",
+    "funcore_stats",
+    "host_handoff",
+    "init",
+    "metric_functions",
+    "state_shardings_for",
+]

@@ -41,7 +41,6 @@ from metrics_tpu.ops import engine as _engine
 from metrics_tpu.ops import faults as _faults
 from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.parallel import bucketing as _bucketing
-from metrics_tpu.parallel.collectives import sync_pytree
 from metrics_tpu.parallel.reductions import resolve_reduction
 from metrics_tpu.parallel import sync as _psync
 from metrics_tpu.parallel.sync import distributed_available as _dist_available
@@ -52,6 +51,11 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 def jit_distributed_available() -> bool:
+    """Hot-path distributed probe: one memoized backend walk per process
+    (``parallel.sync.distributed_available`` caches the resolution; the
+    ``sync_dist_resolutions`` counter pins it) — this runs on EVERY
+    ``compute()``/``sync()`` and used to re-walk the backend client per
+    call."""
     return _dist_available()
 
 
@@ -2480,45 +2484,44 @@ class Metric(ABC):
         ``axis_name`` lowers each state's reduction spec to a single XLA
         collective (psum/pmax/all_gather) — the TPU-native replacement for the
         reference's ``_sync_dist`` gather path.
+
+        Delegates to :mod:`metrics_tpu.functional_core` (the one functional
+        implementation the ``apply_*`` methods also ride), which caches the
+        export per config fingerprint — repeated calls reuse the template.
         """
-        if not self._defaults and self._named_child_metrics():
-            # child-holding wrappers register no states of their own — the
-            # base export would be an empty state dict whose update XLA
-            # dead-code-eliminates, silently dropping every child update
-            raise NotImplementedError(
-                f"{type(self).__name__} holds its state in child metrics; the base "
-                "export would produce an empty state dict and a no-op update. "
-                "Export the wrapped metric's as_functions() directly, or use a "
-                "wrapper that provides its own export (ClasswiseWrapper; "
-                "MultioutputWrapper(remove_nans=False))."
-            )
-        template = self._bare_clone()
+        from metrics_tpu import functional_core as _funcore
 
-        def init() -> Dict[str, Any]:
-            # fresh copies, never references to the template defaults: callers
-            # jit the update with donate_argnums, and donating a buffer shared
-            # with a live Metric instance would invalidate that metric's state
-            return {
-                k: (list(v) if isinstance(v, list) else jnp.asarray(v).copy())
-                for k, v in template._defaults.items()
-            }
+        return _funcore.metric_functions(self)
 
-        def update_fn(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-            m = template._bare_clone()
-            m._restore_state(state)
-            m._inner_update(*args, **kwargs)
-            _propagate_static_attrs(m, template)
-            return m._state_snapshot()
+    def init(self) -> "Any":
+        """A fresh epoch-stamped functional state tree
+        (:class:`metrics_tpu.functional_core.FuncState`). See
+        :func:`metrics_tpu.functional_core.init`."""
+        from metrics_tpu import functional_core as _funcore
 
-        def compute_fn(state: Dict[str, Any], axis_name: Optional[str] = None) -> Any:
-            m = template._bare_clone()
-            if axis_name is not None:
-                custom = {k: fn for k, fn in m._reductions.items() if m._reduction_specs[k] == "custom"}
-                state = sync_pytree(state, m._reduction_specs, axis_name, custom)
-            m._restore_state(state)
-            return m._inner_compute()
+        return _funcore.init(self)
 
-        return init, update_fn, compute_fn
+    def apply_update(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        """Pure update over an explicit state tree — jit/``shard_map`` this
+        freely. See :func:`metrics_tpu.functional_core.apply_update`."""
+        from metrics_tpu import functional_core as _funcore
+
+        return _funcore.apply_update(self, state, *args, **kwargs)
+
+    def apply_compute(self, state: Any, *, axis_name: Optional[str] = None) -> Any:
+        """Pure compute; with ``axis_name`` the cross-device merge is ONE
+        in-graph XLA collective per state (zero host round trips). See
+        :func:`metrics_tpu.functional_core.apply_compute`."""
+        from metrics_tpu import functional_core as _funcore
+
+        return _funcore.apply_compute(self, state, axis_name=axis_name)
+
+    def host_handoff(self, state: Any, *, merged: bool = True) -> "Metric":
+        """Land an in-graph state tree back into this stateful shell without
+        double-merging. See :func:`metrics_tpu.functional_core.host_handoff`."""
+        from metrics_tpu import functional_core as _funcore
+
+        return _funcore.host_handoff(self, state, merged=merged)
 
     def _inner_update(self, *args: Any, **kwargs: Any) -> None:
         self.update.__wrapped__(*args, **kwargs)  # type: ignore[attr-defined]
@@ -2641,6 +2644,9 @@ class Metric(ABC):
             "_update_lane",
             "_fused_probe_results",
             "_default_ids_cache",
+            # the functional-core export cache: closures over a template
+            # clone, rebuilt lazily keyed by config fingerprint
+            "_funcore_export",
             # fault-ladder state is per-process health bookkeeping, not
             # metric state: a restored/cloned instance starts healthy
             "_fault_ladders",

@@ -583,6 +583,12 @@ def engine_stats() -> Dict[str, Any]:
     from metrics_tpu import streaming as _streaming
 
     out.update(_streaming.streaming_stats())
+    # the functional core's host-visible events (export builds/hits, api
+    # calls, hand-backs) — lazy: functional_core imports engine for its
+    # config fingerprints
+    from metrics_tpu import functional_core as _funcore
+
+    out.update(_funcore.funcore_stats())
     return out
 
 

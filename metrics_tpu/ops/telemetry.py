@@ -148,6 +148,9 @@ SPAN_SITES = {
     "window-close": "one fleet-agreed window close: close-id agreement + "
     "payload sync + ring-slot pack (+ slot persistence when journaling)",
     "drift-report": "one PSI/KS drift computation over binned raw states",
+    # functional core (functional_core.py)
+    "funcore-handoff": "an in-graph state tree landed back into the stateful "
+    "shell (epoch-fenced; pending async sync cancelled; instant)",
 }
 
 #: The sync-protocol phases the fleet straggler report attributes
@@ -831,6 +834,9 @@ _COUNTER_PREFIXES = (
     # the streaming plane's event counters: window closes / slots packed /
     # ring demotions / epoch trips, drift reports (streaming.py)
     "window_", "drift_",
+    # the functional core's host-visible events: export builds/hits, API
+    # calls (eager or trace-time), hand-backs (functional_core.py)
+    "funcore_",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —

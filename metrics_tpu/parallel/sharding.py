@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Any, Dict, Mapping, Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
@@ -77,4 +78,74 @@ def shard_states(
     }
 
 
-__all__ = ["shard_states", "state_shardings"]
+# ------------------------------------------------------- per-leaf inference
+def _first_divisible_spec(shape: tuple, size: int, axis_name: str) -> PartitionSpec:
+    """Shard the FIRST dimension divisible by the mesh axis size along
+    ``axis_name``; preceding dims stay unsharded, trailing dims implicitly
+    replicate. No divisible dimension (including the empty dim-0 of a fresh
+    cat state) replicates — the conservative default that is always legal."""
+    for i, dim in enumerate(shape):
+        if dim and dim % size == 0:
+            return PartitionSpec(*([None] * i + [axis_name]))
+    return PartitionSpec()
+
+
+def infer_state_pspecs(
+    states: Dict[str, Any],
+    mesh: Mesh,
+    reduction_specs: Mapping[str, Any],
+    axis_name: Optional[str] = None,
+) -> Dict[str, Optional[PartitionSpec]]:
+    """Per-leaf ``PartitionSpec`` inference for a functional state tree.
+
+    The reduction spec of each state decides its natural layout under a
+    data-parallel mesh axis (``axis_name``; default: the mesh's first axis):
+
+    - **cat-kind** array states (``"cat"`` or ``None``) are row accumulators
+      growing along dim 0 — shard the first dimension divisible by the axis
+      size (the first-divisible-dimension idiom), replicate otherwise (a
+      fresh empty accumulator has nothing to split).
+    - **reduced** states (``sum``/``mean``/``max``/``min``/custom) are
+      replicated (``PartitionSpec()``): every device's partial occupies the
+      full shape and the in-graph collective merges values, not layout.
+    - **python-list** cat states map to ``None`` (host-side rows; not a
+      device placement).
+    """
+    if axis_name is None:
+        axis_name = mesh.axis_names[0]
+    size = mesh.shape[axis_name]
+    out: Dict[str, Optional[PartitionSpec]] = {}
+    for name, value in states.items():
+        if isinstance(value, (list, tuple)):
+            out[name] = None
+            continue
+        spec = reduction_specs.get(name)
+        if spec in ("cat", None) and not callable(spec):
+            out[name] = _first_divisible_spec(tuple(jnp.shape(value)), size, axis_name)
+        else:
+            out[name] = PartitionSpec()
+    return out
+
+
+def infer_state_shardings(
+    states: Dict[str, Any],
+    mesh: Mesh,
+    reduction_specs: Mapping[str, Any],
+    axis_name: Optional[str] = None,
+) -> Dict[str, Optional[NamedSharding]]:
+    """:func:`infer_state_pspecs` lifted to ``NamedSharding`` (what
+    ``jax.jit(..., in_shardings=...)`` / ``device_put`` consume). List
+    states stay ``None``."""
+    pspecs = infer_state_pspecs(states, mesh, reduction_specs, axis_name=axis_name)
+    return {
+        name: None if spec is None else NamedSharding(mesh, spec)
+        for name, spec in pspecs.items()
+    }
+
+
+__all__ = [
+    "infer_state_pspecs",
+    "infer_state_shardings",
+    "shard_states",
+    "state_shardings",
+]

@@ -26,12 +26,40 @@ from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.utils.exceptions import EpochFault, SyncConfigFault, SyncTimeoutFault
 
 
+#: Memoized distributed resolution: ``jax.process_count()`` walks the backend
+#: client on EVERY call, and the hot paths (``jit_distributed_available`` in
+#: every compute, the fused-update gating, the streaming planes) re-resolved
+#: it per call. The process count is fixed once the runtime initializes, so
+#: one resolution serves the process lifetime; an un-initialized backend
+#: (RuntimeError) is NOT cached — it may initialize later. Tests and
+#: membership transitions drop the memo via
+#: :func:`invalidate_distributed_cache`.
+_dist_cache: Optional[bool] = None
+
+
 def distributed_available() -> bool:
-    """True when more than one JAX process participates (multi-host)."""
-    try:
-        return jax.process_count() > 1
-    except RuntimeError:
-        return False
+    """True when more than one JAX process participates (multi-host).
+
+    Cached after the first successful resolution (the
+    ``sync_dist_resolutions`` counter pins the hot paths to one backend walk
+    per process — see ``invalidate_distributed_cache``)."""
+    global _dist_cache
+    if _dist_cache is None:
+        try:
+            resolved = jax.process_count() > 1
+        except RuntimeError:
+            return False
+        _dist_cache = resolved
+        _bump("sync_dist_resolutions")
+    return _dist_cache
+
+
+def invalidate_distributed_cache() -> None:
+    """Drop the memoized :func:`distributed_available` resolution (the next
+    call re-walks the backend). Membership transitions and tests that stand
+    up/tear down ``jax.distributed`` call this."""
+    global _dist_cache
+    _dist_cache = None
 
 
 def world_size() -> int:
@@ -983,6 +1011,9 @@ def reset_membership() -> None:
     m.transitions.clear()
     global _peer_prober
     _peer_prober = None
+    # a membership reset usually brackets a world stand-up/tear-down in
+    # tests — re-resolve the distributed memo rather than serve a stale one
+    invalidate_distributed_cache()
 
 
 # ----------------------------------------------------------- collective audit
@@ -1006,6 +1037,9 @@ _counters: dict = {
     "sync_epoch_bumps": 0,
     "sync_epoch_fence_trips": 0,
     "sync_stale_collectives": 0,
+    # backend walks actually performed by distributed_available() — the
+    # hot-path memo pin: N calls resolve once (see invalidate_distributed_cache)
+    "sync_dist_resolutions": 0,
     "sync_peers_declared_dead": 0,
     "sync_rank_rejoins": 0,
     # the async pipelined lane (dispatch/force split)

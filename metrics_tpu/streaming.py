@@ -89,6 +89,11 @@ _counters: Dict[str, int] = {
     "window_ring_demotions": 0,
     "window_epoch_trips": 0,
     "window_decay_ticks": 0,
+    # hot-path memo pins (ISSUE 16): value() re-serves the cached window
+    # value until the next close; _decay_tick reuses its one-time state
+    # layout instead of re-deriving dtypes/avoid-ids per tick
+    "window_value_cache_hits": 0,
+    "window_decay_layout_reuses": 0,
     "drift_reports": 0,
 }
 
@@ -345,6 +350,8 @@ class Windowed:
         self._ring: Deque[Tuple[int, bytes]] = deque(maxlen=self._slots_cap)
         self._closes = 0
         self._pending = 0
+        # (close_id, value) memo served by value() between closes
+        self._value_cache: Optional[Tuple[int, Any]] = None
         self._nodes = _node_list(metric)
         reason = _journal.journalable(self._nodes)
         if reason is not None:
@@ -515,9 +522,19 @@ class Windowed:
     def value(self) -> Any:
         """The current window value: restore the oldest retained slot into
         the scratch clone, re-accumulate every younger slot on top
-        (:func:`_merge_record`), and compute. None before the first close."""
+        (:func:`_merge_record`), and compute. None before the first close.
+
+        Memoized per close id: the ring only changes at a close (or a
+        :meth:`restore`, which drops the memo), so a dashboard polling
+        ``value()`` every step pays the decode + re-accumulate + compute
+        once per window instead of once per poll
+        (``window_value_cache_hits`` pins this)."""
         if not self._ring:
             return None
+        cached = self._value_cache
+        if cached is not None and cached[0] == self._closes:
+            _counters["window_value_cache_hits"] += 1
+            return cached[1]
         self._scratch.reset()
         first = True
         for _, record in self._ring:
@@ -527,7 +544,9 @@ class Windowed:
                 first = False
             else:
                 _merge_record(self._scratch_nodes, manifest, payload)
-        return self._scratch.compute()
+        value = self._scratch.compute()
+        self._value_cache = (self._closes, value)
+        return value
 
     compute = value
 
@@ -572,6 +591,7 @@ class Windowed:
                 break
         recovered.sort()
         self._ring.clear()
+        self._value_cache = None  # ring contents change under the same close id
         for close_id, data in recovered[-self._slots_cap:]:
             self._ring.append((close_id, data))
         if recovered:
@@ -704,7 +724,16 @@ class Decayed:
         if not state:
             return
         decay = self._decay
-        dtypes = tuple(sorted((k, jnp.dtype(v.dtype).name) for k, v in state.items()))
+        # the engine key's dtype layout is pinned by construction (every
+        # state validated floating, the name set fixed) — derive it once and
+        # reuse per tick instead of re-sorting the whole layout every update
+        # (window_decay_layout_reuses pins the memo)
+        dtypes = self.__dict__.get("_tick_layout")
+        if dtypes is None:
+            dtypes = tuple(sorted((k, jnp.dtype(v.dtype).name) for k, v in state.items()))
+            self._tick_layout = dtypes
+        else:
+            _counters["window_decay_layout_reuses"] += 1
 
         def build():
             def step(st):

@@ -1,0 +1,425 @@
+"""Functional pytree core contracts (ISSUE-16 tentpole).
+
+Contracts (`metrics_tpu/functional_core.py`):
+
+- **One code path** — ``init()/apply_update()/apply_compute()`` are built
+  from the same ``_inner_update``/``_inner_compute`` bodies the module API
+  dispatches, so the two surfaces are bit-exact on identical data
+  (Accuracy, MeanMetric, AUROC, CatMetric, a compute-group collection).
+- **Epoch rides the state tree** — ``FuncState`` carries the world epoch as
+  STATIC pytree aux data: a membership transition changes the treedef (jit
+  retraces), and a stale-stamped tree classifies as ``EpochFault`` at the
+  ``host_handoff`` seam with the shell state intact.
+- **Donation-safe** — ``init()`` returns fresh buffers, so
+  ``jax.jit(..., donate_argnums=0)`` steps never alias a live module's
+  state or the cached template defaults.
+- **In-graph merge == host sync** — under an 8-device ``shard_map`` world,
+  ``apply_compute(axis_name=...)`` matches the host-path ``_FakeGather``
+  sync oracle bit-for-bit, with ZERO host sync collectives issued.
+- **No double merge at the seam** — ``host_handoff`` lands merged state
+  pre-synced: a following ``sync_context``/``compute()`` serves it without
+  re-entering the sync protocol; ``unsync()`` is an idempotent restore.
+- **Hot-path caching pins** — one export build per config fingerprint
+  (``funcore_exports``), one backend walk per process
+  (``sync_dist_resolutions``), memoized window values and decay layouts
+  (``window_value_cache_hits`` / ``window_decay_layout_reuses``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu import streaming
+from metrics_tpu.functional_core import FuncState, funcore_stats
+from metrics_tpu.ops import engine
+from metrics_tpu.parallel import sync as psync
+from metrics_tpu.parallel.sharding import infer_state_pspecs
+from metrics_tpu.utils.exceptions import EpochFault
+from tests.helpers.testers import _FakeGather
+
+DIST_ON = lambda: True  # noqa: E731
+N_DEV = 8
+
+
+def shard_map(f, **kw):
+    kw.setdefault("check_vma", False)
+    return jax.shard_map(f, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    psync.reset_membership()
+    engine.reset_stats()
+    yield
+    psync.reset_membership()
+    engine.reset_stats()
+
+
+def _cls_data(n=64, c=8, seed=0):
+    rng = np.random.RandomState(seed)
+    logits = rng.rand(n, c).astype(np.float32)
+    preds = logits / logits.sum(axis=1, keepdims=True)
+    target = rng.randint(0, c, size=n)
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def _bin_data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, size=n)),
+    )
+
+
+# ------------------------------------------------------------------- parity
+class TestModuleParity:
+    """apply_update/apply_compute bit-exact vs the stateful module API."""
+
+    @pytest.mark.parametrize(
+        "build, batches",
+        [
+            pytest.param(
+                lambda: mt.Accuracy(num_classes=8),
+                [_cls_data(seed=s) for s in range(3)],
+                id="accuracy",
+            ),
+            pytest.param(
+                lambda: mt.MeanMetric(),
+                [(jnp.asarray([float(s), float(s) + 2.0]),) for s in range(3)],
+                id="mean",
+            ),
+            pytest.param(
+                lambda: mt.AUROC(pos_label=1),
+                [_bin_data(seed=s) for s in range(3)],
+                id="auroc-cat-lists",
+            ),
+            pytest.param(
+                lambda: mt.CatMetric(),
+                [(jnp.arange(4.0) + s,) for s in range(3)],
+                id="cat",
+            ),
+        ],
+    )
+    def test_bit_exact(self, build, batches):
+        m = build()
+        state = m.init()
+        assert isinstance(state, FuncState)
+        for batch in batches:
+            state = m.apply_update(state, *batch)
+        value = m.apply_compute(state)
+
+        oracle = build()
+        for batch in batches:
+            oracle.update(*batch)
+        np.testing.assert_array_equal(np.asarray(value), np.asarray(oracle.compute()))
+
+    def test_jitted_update_parity(self):
+        m = mt.Accuracy(num_classes=8)
+        step = jax.jit(lambda st, p, t: m.apply_update(st, p, t))
+        state = m.init()
+        for seed in range(3):
+            state = step(state, *_cls_data(seed=seed))
+        oracle = mt.Accuracy(num_classes=8)
+        for seed in range(3):
+            oracle.update(*_cls_data(seed=seed))
+        np.testing.assert_array_equal(
+            np.asarray(m.apply_compute(state)), np.asarray(oracle.compute())
+        )
+
+    def test_compute_group_collection_parity(self):
+        suite = mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=8), "prec": mt.Precision(num_classes=8, average="macro")},
+            compute_groups=True,
+        )
+        state = suite.init()
+        for seed in range(3):
+            state = suite.apply_update(state, *_cls_data(seed=seed))
+        values = suite.apply_compute(state)
+
+        oracle = mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=8), "prec": mt.Precision(num_classes=8, average="macro")},
+            compute_groups=True,
+        )
+        for seed in range(3):
+            oracle.update(*_cls_data(seed=seed))
+        expected = oracle.compute()
+        assert set(values) == set(expected) == {"acc", "prec"}
+        for key in expected:
+            np.testing.assert_array_equal(np.asarray(values[key]), np.asarray(expected[key]))
+
+
+# --------------------------------------------------------------- epoch fence
+class TestEpochInState:
+    def test_init_stamps_live_epoch(self):
+        state = mt.MeanMetric().init()
+        assert state.epoch == psync.world_epoch()
+
+    def test_epoch_is_static_treedef_metadata(self):
+        """A restamped tree has a DIFFERENT treedef — jit retraces, the
+        in-graph analogue of the host plane's epoch fence."""
+        state = mt.SumMetric().init()
+        traces = []
+
+        @jax.jit
+        def f(st):
+            traces.append(1)
+            return jax.tree_util.tree_map(lambda x: x + 1, st)
+
+        f(state)
+        f(state)
+        assert len(traces) == 1  # same epoch: cache hit
+        bumped = f(state.with_epoch(state.epoch + 1))
+        assert len(traces) == 2  # new epoch: new treedef, retrace
+        assert isinstance(bumped, FuncState) and bumped.epoch == state.epoch + 1
+
+    def test_stale_handoff_classifies_epoch_fault(self):
+        m = mt.SumMetric()
+        state = m.apply_update(m.init(), jnp.asarray([3.0]))
+        trips = psync.collective_stats()["sync_epoch_fence_trips"]
+        psync.bump_epoch("simulated membership transition")
+        with pytest.raises(EpochFault):
+            m.host_handoff(state)
+        assert psync.collective_stats()["sync_epoch_fence_trips"] == trips + 1
+        # shell state intact: nothing landed
+        assert float(m.compute()) == 0.0
+        # explicit restamp lands the same tree
+        m.host_handoff(state.with_epoch(psync.world_epoch()))
+        assert float(m.compute()) == 3.0
+
+
+# ----------------------------------------------------------------- donation
+class TestDonationSafety:
+    def test_donated_step_never_aliases_template(self):
+        m = mt.SumMetric()
+        step = jax.jit(lambda st, x: m.apply_update(st, x), donate_argnums=0)
+        state = m.init()
+        state = step(state, jnp.asarray([2.0]))
+        state = step(state, jnp.asarray([4.0]))
+        assert float(m.apply_compute(state)) == 6.0
+        # the donated buffers were fresh copies: the cached template's
+        # defaults are untouched and a new tree starts at zero
+        fresh = m.init()
+        assert float(m.apply_compute(fresh)) == 0.0
+        # and the live module shell never shared those buffers either
+        assert float(m.compute()) == 0.0
+
+    def test_funcstate_is_donatable(self):
+        state = mt.SumMetric().init()
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        assert len(leaves) == 1
+        assert engine.state_donatable(state)
+
+
+# ---------------------------------------------------------- shard_map world
+class TestInGraphMerge:
+    """The zero-host-round-trip claim on an 8-device shard_map world."""
+
+    C = 8
+
+    def test_matches_host_sync_oracle_zero_host_collectives(self):
+        mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("dp",))
+        m = mt.Accuracy(num_classes=self.C)
+        preds, target = _cls_data(n=N_DEV * 16, c=self.C, seed=11)
+
+        def f(p, t):
+            st = m.apply_update(m.init(), p, t)
+            return m.apply_compute(st, axis_name="dp")
+
+        before = psync.collective_stats()["sync_collectives_issued"]
+        value = jax.jit(
+            shard_map(f, mesh=mesh, in_specs=(P("dp", None), P("dp")), out_specs=P())
+        )(preds, target)
+        assert psync.collective_stats()["sync_collectives_issued"] == before, (
+            "the in-graph merge must issue ZERO host sync collectives"
+        )
+
+        # host-sync oracle: one module instance per rank fed that rank's
+        # shard, merged through the host gather path
+        ranks = [mt.Accuracy(num_classes=self.C) for _ in range(N_DEV)]
+        for i, rank in enumerate(ranks):
+            rank.update(
+                preds[i * 16 : (i + 1) * 16], target[i * 16 : (i + 1) * 16]
+            )
+        gather = _FakeGather(ranks)
+        with ranks[0].sync_context(dist_sync_fn=gather, distributed_available=DIST_ON):
+            host_value = ranks[0].compute()
+        np.testing.assert_array_equal(np.asarray(value), np.asarray(host_value))
+
+    def test_collection_suite_in_one_step(self):
+        mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("dp",))
+        suite = mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=self.C), "prec": mt.Precision(num_classes=self.C, average="macro")}
+        )
+        preds, target = _cls_data(n=N_DEV * 16, c=self.C, seed=5)
+
+        def f(p, t):
+            st = suite.apply_update(suite.init(), p, t)
+            return suite.apply_compute(st, axis_name="dp")
+
+        values = jax.jit(
+            shard_map(f, mesh=mesh, in_specs=(P("dp", None), P("dp")), out_specs=P())
+        )(preds, target)
+
+        oracle = mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=self.C), "prec": mt.Precision(num_classes=self.C, average="macro")}
+        )
+        oracle.update(preds, target)
+        expected = oracle.compute()
+        assert set(values) == set(expected)
+        for key in expected:
+            np.testing.assert_array_equal(np.asarray(values[key]), np.asarray(expected[key]))
+
+    def test_pspec_inference(self):
+        mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("dp",))
+        states = {
+            "tp": jnp.zeros((64,)),          # sum-reduced: replicate
+            "preds": jnp.zeros((16, 4)),     # cat-kind: shard the sample axis
+            "rows": [jnp.zeros((3,))],       # list state: host-owned, no placement
+        }
+        specs = {"tp": "sum", "preds": "cat", "rows": "cat"}
+        pspecs = infer_state_pspecs(states, mesh, specs)
+        assert pspecs["tp"] == P()
+        assert pspecs["preds"] == P("dp")
+        assert pspecs["rows"] is None
+
+
+# ------------------------------------------------------------- handoff seam
+class TestHostHandoff:
+    def test_merged_handoff_serves_without_resync(self):
+        m = mt.SumMetric()
+        state = m.apply_update(m.init(), jnp.asarray([5.0]))
+        out = m.host_handoff(state)
+        assert out is m and m._is_synced
+        # a sync context that WOULD merge again enters pre-synced: the
+        # landed value is served as-is, no gather, no double merge
+        peer = mt.SumMetric()
+        peer.update(jnp.asarray([5.0]))
+        with m.sync_context(dist_sync_fn=_FakeGather([m, peer]), distributed_available=DIST_ON):
+            assert float(m.compute()) == 5.0
+        # explicit unsync is an idempotent restore of the same tree
+        m.unsync()
+        assert not m._is_synced and float(m.compute()) == 5.0
+
+    def test_unmerged_handoff_leaves_sync_armed(self):
+        m = mt.SumMetric()
+        state = m.apply_update(m.init(), jnp.asarray([2.0]))
+        m.host_handoff(state, merged=False)
+        assert not m._is_synced and m._cache is None
+        peer = mt.SumMetric()
+        peer.update(jnp.asarray([3.0]))
+        with m.sync_context(dist_sync_fn=_FakeGather([m, peer]), distributed_available=DIST_ON):
+            assert float(m.compute()) == 5.0  # per-rank partial: host sync merges
+        # local state restored after the context (the compute cache keeps the
+        # merged value until the next update, as on the host path)
+        m.update(jnp.asarray([0.0]))
+        assert float(m.compute()) == 2.0
+
+    def test_collection_handoff(self):
+        suite = mt.MetricCollection({"mean": mt.MeanMetric(), "total": mt.SumMetric()})
+        state = suite.apply_update(suite.init(), jnp.asarray([2.0, 4.0]))
+        before = funcore_stats()
+        suite.host_handoff(state)
+        after = funcore_stats()
+        assert after["funcore_handoffs"] - before["funcore_handoffs"] == 1
+        assert after["funcore_handoff_nodes"] - before["funcore_handoff_nodes"] == 2
+        values = suite.compute()
+        assert float(values["mean"]) == 3.0 and float(values["total"]) == 6.0
+
+
+# ------------------------------------------------------------- caching pins
+class TestCachingPins:
+    def test_export_built_inside_trace_stays_concrete(self):
+        # The first export build may happen INSIDE a jit/shard_map trace (a
+        # user's first call is their training step). The cached template's
+        # reset state must still be concrete — a build that binds to the
+        # ambient trace caches leaked tracers and every later host-side
+        # init() dies with UnexpectedTracerError.
+        suite = mt.MetricCollection(
+            {
+                "acc": mt.Accuracy(num_classes=4, average="macro"),
+                "prec": mt.Precision(num_classes=4, average="macro"),
+            }
+        )
+        preds, target = _cls_data(n=N_DEV * 8, c=4, seed=3)
+
+        def step(p, t):
+            st = suite.apply_update(suite.init(), p, t)
+            return suite.apply_compute(st, axis_name="dp")
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
+        jax.jit(
+            shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds, target)
+        # host-side init/update/compute on the SAME cached export must work
+        state = suite.init()
+        for leaf in jax.tree_util.tree_leaves(state):
+            assert isinstance(leaf, jax.Array) and not isinstance(
+                leaf, jax.core.Tracer
+            )
+        state = suite.apply_update(state, preds, target)
+        vals = suite.apply_compute(state)
+        assert all(np.isfinite(float(v)) for v in vals.values())
+
+    def test_one_export_build_per_config(self):
+        m = mt.Accuracy(num_classes=8)
+        before = funcore_stats()
+        state = m.init()
+        for seed in range(5):
+            state = m.apply_update(state, *_cls_data(seed=seed))
+        m.apply_compute(state)
+        after = funcore_stats()
+        assert after["funcore_exports"] - before["funcore_exports"] == 1, (
+            "a hot loop must build the export template ONCE per config"
+        )
+        assert after["funcore_export_hits"] - before["funcore_export_hits"] == 6
+        # a config change invalidates the fingerprint key: fresh build
+        m.persistent(True)  # persistence is not fingerprinted — still cached
+        m.init()
+        assert funcore_stats()["funcore_exports"] - before["funcore_exports"] == 1
+
+    def test_export_cache_dropped_on_clone(self):
+        import copy
+
+        m = mt.MeanMetric()
+        m.init()
+        assert "_funcore_export" in m.__dict__
+        clone = copy.deepcopy(m)
+        assert "_funcore_export" not in clone.__dict__
+
+    def test_distributed_available_single_resolution(self):
+        psync.invalidate_distributed_cache()
+        before = psync.collective_stats()["sync_dist_resolutions"]
+        for _ in range(5):
+            psync.distributed_available()
+        assert psync.collective_stats()["sync_dist_resolutions"] == before + 1, (
+            "the backend walk must be memoized after the first resolution"
+        )
+        psync.invalidate_distributed_cache()
+        psync.distributed_available()
+        assert psync.collective_stats()["sync_dist_resolutions"] == before + 2
+
+    def test_window_value_memoized_between_closes(self):
+        win = streaming.Windowed(mt.SumMetric(), window=2, stride=2, name="memo")
+        for i in range(2):
+            win.update(jnp.asarray([float(i)]))
+        first = win.value()
+        before = streaming.streaming_stats()["window_value_cache_hits"]
+        assert np.array_equal(np.asarray(win.value()), np.asarray(first))
+        assert np.array_equal(np.asarray(win.value()), np.asarray(first))
+        assert streaming.streaming_stats()["window_value_cache_hits"] == before + 2
+        # the next close invalidates the memo
+        for i in range(2):
+            win.update(jnp.asarray([10.0 + i]))
+        assert float(win.value()) == 21.0
+
+    def test_decay_layout_memoized_across_ticks(self):
+        ema = streaming.Decayed(mt.SumMetric(), halflife=2.0, name="memo-ema")
+        before = streaming.streaming_stats()["window_decay_layout_reuses"]
+        for x in (1.0, 2.0, 4.0, 8.0):
+            ema.update(jnp.asarray([x]))
+        reuses = streaming.streaming_stats()["window_decay_layout_reuses"] - before
+        assert reuses >= 2, "decay ticks after the first must reuse the dtype layout"

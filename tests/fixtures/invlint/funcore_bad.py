@@ -1,0 +1,36 @@
+"""Known-bad fixture for the in-graph collective discipline (INV003).
+
+Never imported — parsed by ``tools/invlint`` in ``tests/tools/test_invlint.py``.
+In-graph ``lax`` collectives are exempt from the host-transport rules
+(INV001/INV002: no host wall, no protocol audit), but rank-divergent control
+flow around one desyncs the compiled mesh program exactly like a host
+collective — INV003 must still fire.
+"""
+from jax import lax  # noqa: F401 — fixture, never imported
+
+_SPEC_CACHE = {}
+
+
+def rank_keyed_compute(state, axis_name):
+    """Only rank 0 merges: every other device's trace skips the psum."""
+    import jax
+
+    merged = state
+    if jax.process_index() == 0:
+        merged = lax.psum(state, axis_name)  # expect: INV003
+    return merged
+
+
+def rank_name_keyed(state, axis_name, rank):
+    """Branching the gather on a rank-local name."""
+    if rank == 0:
+        return lax.all_gather(state, axis_name, axis=0, tiled=True)  # expect: INV003
+    return state
+
+
+def cache_keyed_merge(state, key, axis_name):
+    """First-touch skew on a process-local cache: some ranks trace the
+    pmean, others serve the memo and skip it."""
+    if key not in _SPEC_CACHE:
+        _SPEC_CACHE[key] = lax.pmean(state, axis_name)  # expect: INV003
+    return _SPEC_CACHE[key]

@@ -1,0 +1,43 @@
+"""Known-good fixture for the in-graph collective discipline: the sanctioned
+functional-core pattern — pure ``apply_*`` kernels whose cross-device merge
+is an in-graph ``lax`` collective keyed on a mesh axis name. No watchdog, no
+``note_collective`` audit: there is no host transport to guard (INV001/INV002
+are host-transport discipline), and the epoch fence rides the state treedef.
+Spec-keyed and world-size branches are rank-SYMMETRIC (every device traces
+the same program), so INV003 stays quiet. Zero findings expected."""
+from jax import lax  # noqa: F401 — fixture, never imported
+
+
+def apply_update(state, batch):
+    """Pure per-device accumulation: no collective at all."""
+    return {k: v + batch[k] for k, v in state.items()}
+
+
+def sync_array(x, spec, axis_name):
+    """The spec -> collective lowering (parallel/collectives.py): the branch
+    is keyed on the reduction SPEC, identical on every device."""
+    if spec == "sum":
+        return lax.psum(x, axis_name)
+    if spec == "mean":
+        return lax.pmean(x, axis_name)
+    if spec == "max":
+        return lax.pmax(x, axis_name)
+    if spec == "min":
+        return lax.pmin(x, axis_name)
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def apply_compute(state, specs, axis_name=None):
+    """The in-graph merge: one collective per state, inside the jitted step,
+    gated only on the (trace-time, rank-symmetric) axis name."""
+    if axis_name is not None:
+        state = {k: sync_array(v, specs[k], axis_name) for k, v in state.items()}
+    return sum(state.values())
+
+
+def world_size_early_out(x, axis_name, world_size):
+    """Branching on the world size is rank-symmetric (uniform across the
+    mesh) — allowed, mirroring the host path's distributed_available gate."""
+    if world_size == 1:
+        return x
+    return lax.psum(x, axis_name)

@@ -58,6 +58,7 @@ class TestFixturePairs:
         # one known-bad + one known-good file per pass
         assert BAD_FIXTURES == [
             "collective_bad.py",
+            "funcore_bad.py",
             "hist_bad.py",
             "perfkeys_bad.py",
             "retry_bad.py",

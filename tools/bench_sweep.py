@@ -872,6 +872,32 @@ def main() -> None:
     except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
         print(json.dumps({"metric": "suite_sync(quant_payload)", "error": str(err)[:160]}))
 
+    # ingraph_step row (ISSUE 16): the functional-core whole-suite step —
+    # host_collectives_per_step and wire_share are what sweep_regress gates
+    # round over round (both must stay EXACTLY 0: an in-graph step that
+    # starts issuing host collectives, or growing a wire phase, means the
+    # zero-host-round-trip contract broke); the full step methodology
+    # (donated jitted FuncState program, counted host sync counters) lives
+    # in bench.py bench_ingraph_step, reused here verbatim.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_ingraph_step()
+        row = {
+            "metric": "ingraph_step(functional_core)",
+            "mode": "sync",
+            "updates_per_s": round(probe["steps_per_s"], 1),
+            "ms_per_step": round(probe["ms_per_step"], 4),
+            "host_collectives_per_step": round(probe["host_collectives_per_step"], 4),
+            "wire_share": round(probe["wire_share"], 4),
+            "latency_ms": probe["latency_ms"],
+            "devices": probe["devices"],
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "ingraph_step(functional_core)", "error": str(err)[:160]}))
+
     # window_close row (ISSUE 15): one fleet-agreed window close on a
     # 4-metric suite — collectives_per_close_live is what sweep_regress
     # gates round over round (a close issuing more than one payload
@@ -1013,7 +1039,9 @@ def main() -> None:
         summary = {
             "metric": "SWEEP_SUMMARY",
             "n": len(results),
-            "median_updates_per_s": round(float(np.median([r["updates_per_s"] for r in results])), 1),
+            "median_updates_per_s": round(
+                float(np.median([r["updates_per_s"] for r in results if "updates_per_s" in r])), 1
+            ),
             "median_vs_baseline": round(float(np.median(with_ratio)), 2) if with_ratio else None,
             # ANY sub-1x row without a note (curated or measured-floor) is a
             # regression to chase; a fast row (>10x) without a note is

@@ -47,6 +47,28 @@ TRANSPORT_PRIMITIVES = frozenset(
     }
 )
 
+#: The sanctioned IN-GRAPH collectives (``jax.lax``): inside a pure
+#: functional-core kernel (``apply_update``/``apply_compute``/``sync_array``)
+#: these compile INTO the step program — no host transport runs, so the
+#: watchdog-deadline and epoch-audit disciplines (INV001/INV002) do not
+#: apply; the compiler schedules them and the epoch fence lives in the state
+#: treedef instead (``functional_core.FuncState``). Rank-divergent control
+#: flow around one still desyncs the mesh exactly like a host collective —
+#: one device tracing a psum the others skip is a compile-time shape error
+#: at best and a hang at worst — so INV003 fires unchanged.
+INGRAPH_COLLECTIVES = frozenset(
+    {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+        "psum_scatter",
+        "all_to_all",
+        "ppermute",
+    }
+)
+
 #: The sanctioned blocking-guard spellings. ``run_with_deadline`` is the
 #: per-call watchdog; ``run_inflight`` is its async twin — a transport under
 #: it runs on the dispatcher thread of a closure reached via ``submit_async``,
@@ -143,6 +165,25 @@ def check_collective_discipline(mod: Module) -> List[Finding]:
     caches = module_mutable_globals(mod.tree)
     for call in walk_calls(mod.tree):
         name = call_name(call)
+        if name in INGRAPH_COLLECTIVES:
+            # in-graph SPMD collective: exempt from the host-transport
+            # watchdog/audit (INV001/INV002 — there is no host wall to guard
+            # and the epoch fence is static state-tree metadata), but held to
+            # the rank-symmetry discipline: a rank-divergent branch around an
+            # in-graph collective desyncs the compiled mesh program too
+            for anc in mod.ancestors(call):
+                if isinstance(anc, (ast.If, ast.While)):
+                    why = _rank_divergent_test(anc.test, caches)
+                    if why is not None:
+                        findings.append(
+                            mod.finding(
+                                call,
+                                "INV003",
+                                f"in-graph collective {name}() {why} (line {anc.lineno})"
+                                " — rank-divergent collectives deadlock the cohort",
+                            )
+                        )
+            continue
         if name not in TRANSPORT_PRIMITIVES:
             continue
         encl = mod.enclosing_functions(call)

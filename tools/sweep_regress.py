@@ -61,10 +61,25 @@ def compare(
     tail_threshold: float = 4.0,
     wire_hidden_floor: float = 0.5,
     close_collective_ceiling: float = 1.0,
+    ingraph_collective_ceiling: float = 0.0,
 ) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
     problems = []
+    # A sweep run without the reference package mounted loses EVERY
+    # vs_baseline column at once — that is one environment fact (report it
+    # once, still a failure: a baseline cannot silently vanish), not a
+    # per-metric regression; per-row ratio checks still fire when only
+    # SOME rows lost their ratio.
+    old_with_ratio = sum(1 for r in old_rows.values() if r.get("vs_baseline"))
+    new_with_ratio = sum(1 for r in new_rows.values() if r.get("vs_baseline"))
+    baseline_vanished = old_with_ratio > 0 and new_with_ratio == 0
+    if baseline_vanished:
+        problems.append(
+            f"reference baseline absent from new sweep ({old_with_ratio} old rows "
+            f"carried vs_baseline, 0 new rows do — the torch reference was not "
+            "mounted for this run; ratio gates skipped, all other gates applied)"
+        )
     for name, old_row in old_rows.items():
         new_row = new_rows.get(name)
         if new_row is None:
@@ -73,7 +88,7 @@ def compare(
         if old_row["mode"] == "jit" and new_row["mode"] != "jit":
             problems.append(f"{name}: mode regressed jit -> {new_row['mode']}")
         old_ratio, new_ratio = old_row.get("vs_baseline"), new_row.get("vs_baseline")
-        if old_ratio:
+        if old_ratio and not baseline_vanished:
             if not new_ratio:
                 # a collapsed (rounds-to-0) or vanished ratio IS the
                 # worst-case regression, not a row to skip
@@ -127,6 +142,28 @@ def compare(
                 f"{'(unrecorded)' if old_cpc is None else f'{float(old_cpc):.2f}'} -> "
                 f"{float(new_cpc):.2f} (above the {close_collective_ceiling} ceiling — "
                 "a fleet window close stopped merging in one payload collective)"
+            )
+        # ---- the in-graph zero-host gate (ISSUE 16): a row that archived
+        # host_collectives_per_step made the zero-host-round-trip promise —
+        # the ceiling is EXACTLY 0 (default): an in-graph functional-core
+        # step that starts issuing host sync collectives, or growing a wire
+        # share, silently reintroduced the host protocol it exists to
+        # delete, even when every throughput column still looks fine ----
+        new_hps = new_row.get("host_collectives_per_step")
+        if new_hps is not None and float(new_hps) > ingraph_collective_ceiling:
+            old_hps = old_row.get("host_collectives_per_step")
+            problems.append(
+                f"{name}: host_collectives_per_step "
+                f"{'(unrecorded)' if old_hps is None else f'{float(old_hps):.2f}'} -> "
+                f"{float(new_hps):.2f} (above the {ingraph_collective_ceiling} ceiling — "
+                "the in-graph step started paying host round trips)"
+            )
+        new_ws = new_row.get("wire_share")
+        if new_ws is not None and float(new_ws) > ingraph_collective_ceiling:
+            problems.append(
+                f"{name}: wire_share {float(new_ws):.4f} (above the "
+                f"{ingraph_collective_ceiling} ceiling — the in-graph step "
+                "grew a host wire phase)"
             )
     return problems
 
@@ -189,7 +226,8 @@ def _pop_flag(argv: list, flag: str, default: float):
 _USAGE = (
     "usage: sweep_regress.py [--threshold X] [--p50-threshold X] "
     "[--tail-threshold X] [--wire-hidden-floor X] "
-    "[--close-collective-ceiling X] [--explain] OLD.json NEW.json"
+    "[--close-collective-ceiling X] [--ingraph-collective-ceiling X] "
+    "[--explain] OLD.json NEW.json"
 )
 
 
@@ -203,13 +241,21 @@ def main(argv) -> int:
     argv, tail_threshold, ok3 = _pop_flag(argv, "--tail-threshold", 4.0)
     argv, wire_floor, ok4 = _pop_flag(argv, "--wire-hidden-floor", 0.5)
     argv, close_ceiling, ok5 = _pop_flag(argv, "--close-collective-ceiling", 1.0)
-    if not (ok1 and ok2 and ok3 and ok4 and ok5) or len(argv) != 2:
+    argv, ingraph_ceiling, ok6 = _pop_flag(argv, "--ingraph-collective-ceiling", 0.0)
+    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6) or len(argv) != 2:
         print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
         old, new = json.load(f_old), json.load(f_new)
     problems = compare(
-        old, new, threshold, p50_threshold, tail_threshold, wire_floor, close_ceiling
+        old,
+        new,
+        threshold,
+        p50_threshold,
+        tail_threshold,
+        wire_floor,
+        close_ceiling,
+        ingraph_ceiling,
     )
     if problems:
         print("\n".join(problems))
