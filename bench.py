@@ -1431,6 +1431,117 @@ def bench_drift_report() -> dict:
     }
 
 
+def bench_arena_suites() -> dict:
+    """``arena_suites``: N concurrent 2-metric suites as ONE ``MetricArena``
+    (ISSUE 17) vs the per-instance Python loop. Three numbers matter per
+    tenant tier: ``suites_per_s`` (tenant-updates the vmapped donated
+    program retires per second), the per-instance loop's rate measured on a
+    sample of real module instances (linear extrapolation — each instance
+    pays its own dispatch), and their ratio (``vs_loop`` — the ≥10x floor
+    ``tools/sweep_regress.py`` gates at the 100k tier). The 1M tier proves
+    the slab-bucketed shape discipline: its ``builds`` column counts every
+    program the engine traced for the whole tier — bounded by the distinct
+    slab buckets and pow2 chunk sizes touched, NOT by N. ``retraces_per_add``
+    pins the lifecycle cost: one-at-a-time adds across slab boundaries
+    retrace only when a new capacity bucket appears. ``slab_record_bytes``
+    prices one CRC-framed per-slab journal record."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MeanMetric, MetricCollection
+    from metrics_tpu.arena import MetricArena
+    from metrics_tpu.ops import engine
+
+    def make_suite():
+        return MetricCollection({"acc": Accuracy(num_classes=2), "mean": MeanMetric()})
+
+    rng = np.random.RandomState(17)
+    per_tenant = 8  # samples each tenant sees per step
+    tiers = (64, 256, 1024) if SMOKE else (1_000, 100_000, 1_000_000)
+    slab = 64 if SMOKE else 1024
+    loop_sample = 32 if SMOKE else 256
+    out: dict = {"tiers": {}, "slab": slab, "per_tenant_batch": per_tenant}
+
+    # per-instance loop rate, measured once on a sample of real module
+    # instances and extrapolated linearly (the loop IS linear in N: each
+    # instance pays its own dispatch) — timing 1M python dispatches would
+    # burn minutes to state the obvious
+    preds_s = jnp.asarray(rng.randint(0, 2, (loop_sample, per_tenant)).astype(np.int32))
+    target_s = jnp.asarray(rng.randint(0, 2, (loop_sample, per_tenant)).astype(np.int32))
+    instances = [make_suite() for _ in range(loop_sample)]
+    for i, m in enumerate(instances):  # warmup: compiles the member programs
+        m.update(preds_s[i], target_s[i])
+    loop_steps = 1 if SMOKE else 3
+    best_loop = float("inf")
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        for _ in range(loop_steps):
+            for i, m in enumerate(instances):
+                m.update(preds_s[i], target_s[i])
+        for m in instances:
+            for node in m.values(copy_state=False):
+                jax.block_until_ready(jax.tree.leaves(node.metric_state))
+        best_loop = min(best_loop, time.perf_counter() - start)
+    loop_suites_per_s = loop_sample * loop_steps / best_loop if best_loop > 0 else 0.0
+    out["loop_suites_per_s"] = round(loop_suites_per_s, 1)
+    out["loop_sample"] = loop_sample
+
+    for n in tiers:
+        arena = MetricArena(make_suite(), capacity=n, slab=slab, name=f"bench{n}")
+        ids = arena.add(n)
+        preds = jnp.asarray(rng.randint(0, 2, (n, per_tenant)).astype(np.int32))
+        target = jnp.asarray(rng.randint(0, 2, (n, per_tenant)).astype(np.int32))
+        b0 = engine.engine_stats()["builds"]
+        arena.update(ids, preds, target)  # warmup: traces the chunk programs
+        jax.block_until_ready(jax.tree.leaves(arena._stacked))
+        steps = max(1, (STEPS // 5) if n >= 100_000 else STEPS // 2)
+        best = float("inf")
+        for _ in range(TRIALS):
+            start = time.perf_counter()
+            for _ in range(steps):
+                arena.update(ids, preds, target)
+            jax.block_until_ready(jax.tree.leaves(arena._stacked))
+            best = min(best, time.perf_counter() - start)
+        builds = engine.engine_stats()["builds"] - b0
+        suites_per_s = n * steps / best if best > 0 else 0.0
+        out["tiers"][str(n)] = {
+            "suites_per_s": round(suites_per_s, 1),
+            "vs_loop": round(suites_per_s / loop_suites_per_s, 2)
+            if loop_suites_per_s > 0
+            else 0.0,
+            "builds": int(builds),
+            "ms_per_step": round(1000.0 * best / steps, 3),
+        }
+        del arena, preds, target
+
+    # lifecycle: one-at-a-time adds across slab boundaries, updating only the
+    # new tenant — the builds delta counts exactly one chunk-1 program per
+    # NEW capacity bucket (zero retraces inside a bucket)
+    small_slab = 8 if SMOKE else 64
+    adds = small_slab * 8  # crosses three slab-bucket boundaries
+    arena = MetricArena(make_suite(), capacity=small_slab, slab=small_slab, name="bench_life")
+    one_p = jnp.asarray(rng.randint(0, 2, (1, per_tenant)).astype(np.int32))
+    one_t = jnp.asarray(rng.randint(0, 2, (1, per_tenant)).astype(np.int32))
+    b0 = engine.engine_stats()["builds"]
+    for _ in range(adds):
+        (tid,) = arena.add(1)
+        arena.update([tid], one_p, one_t)
+    lifecycle_builds = engine.engine_stats()["builds"] - b0
+    out["retraces_per_add"] = round(lifecycle_builds / adds, 4)
+    out["lifecycle_builds"] = int(lifecycle_builds)
+    out["lifecycle_adds"] = adds
+    out["lifecycle_buckets"] = 4  # small_slab*1, *2, *4, *8
+
+    # slab-record bytes: one CRC-framed record per slab (pack_raw_record)
+    with tempfile.TemporaryDirectory() as d:
+        total = arena.save(os.path.join(d, "arena.j"))
+    out["slab_record_bytes"] = int(total // arena.slabs)
+    out["slabs"] = arena.slabs
+    return out
+
+
 def bench_ingraph_step() -> dict:
     """``ingraph_step``: the functional-core whole-suite step — ONE jitted,
     donated ``apply_update`` program over an epoch-stamped ``FuncState``
@@ -1590,6 +1701,10 @@ def main() -> None:
     # the drift report reuses the fused bincount
     window_probe = bench_window_close()
     drift_probe = bench_drift_report()
+    # the tenant-arena probe rides the same regime as the in-graph row it
+    # scales out (ISSUE 17): same pure kernels, but N suites share ONE
+    # vmapped donated program instead of N dispatch loops
+    arena_probe = bench_arena_suites()
     boot_floor = bench_bootstrap_shaped_floor()
     ours_overhead_batched = bench_overhead_batched_ours()
     ref_overhead = _safe(bench_overhead_reference)
@@ -2001,6 +2116,34 @@ def main() -> None:
                 "simulated 3-rank world (counted, not timed) — a close that "
                 "starts issuing more is a regression tools/sweep_regress.py "
                 "fails (docs/performance.md Window-close cost model)"
+            ),
+        },
+        "arena_suites": {
+            # ISSUE 17: N concurrent 2-metric suites stacked in ONE
+            # MetricArena vs the per-instance Python loop. Per tier:
+            # suites/s through the vmapped donated programs, the ratio over
+            # the (sampled, linearly extrapolated) loop, and the builds the
+            # whole tier cost — bounded by slab buckets + pow2 chunks, not
+            # by N. sweep_regress gates the 100k-tier ≥10x floor and the
+            # retraces_per_add lifecycle pin.
+            "tiers": arena_probe["tiers"],
+            "loop_suites_per_s": arena_probe["loop_suites_per_s"],
+            "loop_sample": arena_probe["loop_sample"],
+            "retraces_per_add": arena_probe["retraces_per_add"],
+            "lifecycle_builds": arena_probe["lifecycle_builds"],
+            "lifecycle_adds": arena_probe["lifecycle_adds"],
+            "lifecycle_buckets": arena_probe["lifecycle_buckets"],
+            "slab": arena_probe["slab"],
+            "slab_record_bytes": arena_probe["slab_record_bytes"],
+            "slabs": arena_probe["slabs"],
+            "per_tenant_batch": arena_probe["per_tenant_batch"],
+            "unit": "tenant suite-updates/s (2-metric suite per tenant)",
+            "note": (
+                "one vmapped donated program over the stacked FuncState "
+                "trees (arena.py): the per-instance loop pays per-tenant "
+                "dispatch, the arena pays one dispatch per pow2 chunk — "
+                "compile count stays bounded by the slab-bucket set at any "
+                "tenant count (docs/performance.md Tenant arenas)"
             ),
         },
         "drift_report": {

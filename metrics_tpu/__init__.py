@@ -161,6 +161,17 @@ from metrics_tpu.streaming import (  # noqa: E402
     drift_report,
 )
 
+# multi-tenant metric arenas (docs/performance.md "Tenant arenas"): N
+# same-config suites stacked on a leading axis, driven by engine-cached
+# vmapped donated programs with slab-bucketed shapes and slab-granular
+# journal records
+from metrics_tpu.arena import (  # noqa: E402
+    MetricArena,
+    arena_stats,
+    stack_states,
+    unstack_states,
+)
+
 # world membership (docs/robustness.md "World membership"): epoch registry +
 # peer-health surface behind epoch-fenced collectives and quorum compute
 from metrics_tpu.parallel.sync import world_health  # noqa: E402
@@ -203,6 +214,10 @@ __all__ = [
     "Decayed",
     "Windowed",
     "drift_report",
+    "MetricArena",
+    "arena_stats",
+    "stack_states",
+    "unstack_states",
     "Metric",
     "CompositionalMetric",
     "MetricCollection",

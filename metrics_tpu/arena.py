@@ -1,0 +1,1137 @@
+"""Multi-tenant metric arenas: one vmapped program for N concurrent suites.
+
+Production serving means per-user / per-cohort / per-model metric streams —
+millions of independent suite instances with tiny states, which a Python
+loop would feed one dispatch at a time. :class:`MetricArena` stacks the
+functional states (:mod:`metrics_tpu.functional_core`) of N same-config
+tenants on a leading axis and drives them with **engine-cached vmapped
+donated programs**: ``update(tenant_ids, *batch)``, ``compute()``,
+``reset(mask)`` and the per-cohort streaming views each lower to one
+program over the whole stack, whatever N is.
+
+The pure kernels that get vmapped are exactly the ones the stateful API
+dispatches (``metric_functions`` — one code path, no drift;
+``BootStrapper``'s clone fan-out delegates to the same
+:func:`stack_states` helper). Three disciplines keep the arena
+production-shaped:
+
+- **Slab-bucketed shapes.** Capacity only ever takes the values
+  ``slab * 2**k`` (the deferral layer's power-of-two bucketing —
+  ``engine.pow2_chunks`` also chunks ragged update batches), so however
+  tenants come and go the program cache sees a bounded set of state
+  shapes: zero retraces within a slab bucket, one build per program kind
+  per new bucket. Removed tenant ids recycle through a free list; a
+  per-tenant reset mask clears rows without perturbing neighbours.
+- **Slab-granular durability.** ``save()`` writes one CRC-framed journal
+  record per slab (``journal.pack_raw_record`` — the sync-pack byte
+  discipline), each with its own atomic-write generation ring. A torn
+  slab record **demotes to its previous good generation** on
+  ``restore()``; neighbouring slabs are never torn with it.
+- **Arena-native streaming.** Per-cohort ``Windowed``/``Decayed`` views
+  and drift reports run over the stacked states as fused programs
+  (segment-reduce merge + vmapped compute), and cohort values land in the
+  fleet exposition as ``metrics_tpu_metric_value{tenant_cohort=...}``.
+
+Metrics whose states are ``cat`` lists (the raw-row curve family — AUROC,
+ROC, …) cannot ride a fixed-shape stack for ``update``; the arena routes
+them through a **row lane** (per-tenant pure-kernel updates, list appends)
+and still batches ``compute`` by stacking same-layout tenants and vmapping
+the compute kernel per group. Array-state suites get the full fused lane.
+
+Env knobs (shared warn-once parsers — a garbage value warns naming it):
+``METRICS_TPU_ARENA_SLAB`` (initial slab size, default 256) and
+``METRICS_TPU_ARENA_JOURNAL_EVERY`` (auto-save every N updates when a
+``journal_path`` is set; 0 — the default — disables).
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import functional_core as _funcore
+from metrics_tpu.ops import engine as _engine
+from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import journal as _journal
+from metrics_tpu.ops import telemetry as _telemetry
+from metrics_tpu.parallel import sync as _psync
+
+__all__ = [
+    "MetricArena",
+    "arena_default_slab",
+    "arena_journal_every",
+    "arena_snapshot",
+    "arena_stats",
+    "stack_states",
+    "unstack_states",
+]
+
+# Arena-plane counters (merged into ``engine.engine_stats()`` and the
+# telemetry snapshot; zeroed through the shared reset registry). Every key
+# rides the ``arena_`` counter prefix.
+_counters: Dict[str, int] = {
+    # tenant lifecycle
+    "arena_tenants_added": 0,
+    "arena_tenants_removed": 0,
+    "arena_ids_recycled": 0,
+    "arena_grows": 0,
+    "arena_shrinks": 0,
+    # the vmapped hot path
+    "arena_updates": 0,
+    "arena_update_chunks": 0,
+    "arena_row_updates": 0,
+    "arena_computes": 0,
+    "arena_resets": 0,
+    # streaming views over the stack
+    "arena_closes": 0,
+    "arena_decay_ticks": 0,
+    "arena_cohort_programs": 0,
+    "arena_drift_reports": 0,
+    # slab-granular durability
+    "arena_slab_saves": 0,
+    "arena_slab_bytes_written": 0,
+    "arena_slab_restores": 0,
+    "arena_slab_demotions": 0,
+}
+
+#: Live arena registry: one JSON-safe block per arena name (capacity, tenant
+#: count, newest per-cohort values keyed by close id). Carried inside the
+#: ``streaming`` telemetry block and rendered fleet-wide as
+#: ``metrics_tpu_metric_value{tenant_cohort=...}``.
+_ARENAS: Dict[str, Dict[str, Any]] = {}
+
+
+def arena_stats() -> Dict[str, int]:
+    """Arena-plane event counters (folded into ``engine_stats()``): tenant
+    lifecycle (adds/removes/recycles, slab grows/shrinks), vmapped program
+    traffic (updates and their pow2 chunks, row-lane updates, computes,
+    resets), streaming views (closes, decay ticks, cohort programs, drift
+    reports), and slab-journal traffic (saves, bytes, restores, demotions).
+
+    Example:
+        >>> from metrics_tpu import arena_stats
+        >>> arena_stats()["arena_updates"] >= 0
+        True
+    """
+    return dict(_counters)
+
+
+def _reset_arena() -> None:
+    for key in _counters:
+        _counters[key] = 0
+    _ARENAS.clear()
+
+
+_telemetry.register_reset("arena", _reset_arena)
+
+
+def arena_snapshot() -> Dict[str, Any]:
+    """The JSON-safe ``arenas`` sub-block the streaming telemetry snapshot
+    carries: per arena name — capacity/slab facts, live tenant count, the
+    close id, and the newest per-cohort computed scalar values."""
+    return {
+        name: dict(block, cohorts={k: dict(v) for k, v in block.get("cohorts", {}).items()})
+        for name, block in _ARENAS.items()
+    }
+
+
+# ------------------------------------------------------------------ env knobs
+class _ArenaWarnOwner:
+    """Warn-dedupe anchor for this module's env-knob parse warnings."""
+
+
+_SLAB_WARN_OWNER = _ArenaWarnOwner()
+_JOURNAL_WARN_OWNER = _ArenaWarnOwner()
+
+
+def arena_default_slab() -> int:
+    """Default slab size (tenant rows per journal record, and the capacity
+    quantum) when :class:`MetricArena` is constructed without ``slab``
+    (``METRICS_TPU_ARENA_SLAB``, default 256, floor 1). An unparseable
+    value warns once naming it and falls back."""
+    return max(1, _psync._env_int("METRICS_TPU_ARENA_SLAB", 256, owner=_SLAB_WARN_OWNER))
+
+
+def arena_journal_every() -> int:
+    """Auto-journal cadence in updates (``METRICS_TPU_ARENA_JOURNAL_EVERY``,
+    default 0 = off, floor 0) for arenas constructed with a
+    ``journal_path`` and no explicit ``journal_every``."""
+    return max(0, _psync._env_int("METRICS_TPU_ARENA_JOURNAL_EVERY", 0, owner=_JOURNAL_WARN_OWNER))
+
+
+# ----------------------------------------------------------------- tree utils
+def stack_states(states: Sequence[Any]) -> Any:
+    """THE stacking code path: N same-structure state trees become one tree
+    whose every leaf carries a new leading axis (``jnp.stack`` leaf-wise).
+    The arena stacks tenants through here, and ``BootStrapper``'s fused
+    clone fan-out stacks its clones through here — one implementation, so
+    the two cannot drift.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import stack_states
+        >>> stack_states([{"s": jnp.ones(2)}, {"s": jnp.zeros(2)}])["s"].shape
+        (2, 2)
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def unstack_states(stacked: Any, n: int) -> List[Any]:
+    """Inverse of :func:`stack_states`: split the leading axis back into
+    ``n`` per-instance state trees (leaf views, no copies).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import stack_states, unstack_states
+        >>> rows = unstack_states(stack_states([{"s": jnp.ones(2)}] * 3), 3)
+        >>> len(rows), rows[0]["s"].shape
+        (3, (2,))
+    """
+    return [jax.tree.map(lambda x: x[i], stacked) for i in range(n)]
+
+
+_SEP = "\x1f"  # flat-name separator (unit separator: never in a state name)
+
+
+def _flatten_state(tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten the (≤2-level) functional state dict into ``{name: leaf}``
+    with collection members joined by an unprintable separator — the slab
+    record layout and the per-leaf walk the fused programs share."""
+    flat: Dict[str, Any] = {}
+    for key, value in tree.items():
+        if isinstance(value, dict):
+            for sub, leaf in value.items():
+                flat[f"{key}{_SEP}{sub}"] = leaf
+        else:
+            flat[key] = value
+    return flat
+
+
+def _unflatten_state(flat: Dict[str, Any], like: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, value in like.items():
+        if isinstance(value, dict):
+            out[key] = {sub: flat[f"{key}{_SEP}{sub}"] for sub in value}
+        else:
+            out[key] = flat[key]
+    return out
+
+
+def _has_list_state(tree: Any) -> bool:
+    if isinstance(tree, dict):
+        return any(_has_list_state(v) for v in tree.values())
+    return isinstance(tree, list)
+
+
+def _mask_broadcast(mask: jax.Array, ndim: int) -> jax.Array:
+    return mask.reshape(mask.shape + (1,) * (ndim - 1))
+
+
+def _min_identity(dtype: Any) -> Any:
+    dt = jnp.dtype(dtype)
+    if dt == jnp.bool_:
+        return False
+    if jnp.issubdtype(dt, jnp.floating):
+        return -jnp.inf
+    return jnp.iinfo(dt).min
+
+
+def _max_identity(dtype: Any) -> Any:
+    dt = jnp.dtype(dtype)
+    if dt == jnp.bool_:
+        return True
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.inf
+    return jnp.iinfo(dt).max
+
+
+def _safe_name(name: Any) -> str:
+    from metrics_tpu import streaming as _streaming
+
+    return _streaming._safe_name(name)
+
+
+_ANON_SEQ = [0]
+
+
+# ------------------------------------------------------------------ the arena
+class MetricArena:
+    """N same-config metric suites as ONE leading-axis device state.
+
+    ``template`` is a ``Metric`` or ``MetricCollection`` describing every
+    tenant's configuration; its pure functional kernels
+    (:func:`metrics_tpu.functional_core.metric_functions`) are what the
+    arena vmaps. Tenants are integer ids handed out by :meth:`add` (and
+    recycled by :meth:`remove` through a free list); ``capacity`` rounds up
+    to the slab bucket ``slab * 2**k`` so the engine's program cache sees at
+    most one build per program kind per bucket.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> from metrics_tpu.arena import MetricArena
+        >>> arena = MetricArena(MeanMetric(), capacity=4, slab=4)
+        >>> ids = arena.add(3)
+        >>> arena.update(ids, jnp.asarray([[1.0], [2.0], [3.0]]))
+        >>> [round(float(v), 1) for v in arena.compute(ids)]
+        [1.0, 2.0, 3.0]
+    """
+
+    def __init__(
+        self,
+        template: Any,
+        capacity: int = 0,
+        *,
+        name: Optional[str] = None,
+        slab: Optional[int] = None,
+        cohort: Optional[str] = None,
+        journal_path: Optional[str] = None,
+        journal_every: Optional[int] = None,
+        window_slots: int = 8,
+    ) -> None:
+        fns = _funcore.metric_functions(template)
+        self._template = template
+        self._init_fn, self._update_fn, self._compute_fn = fns
+        self._key = _funcore._export_key(template)
+        if name is None:
+            _ANON_SEQ[0] += 1
+            name = f"{type(template).__name__}_arena{_ANON_SEQ[0]}"
+        self._name = _safe_name(name)
+        self._slab = max(1, int(slab)) if slab else arena_default_slab()
+        self._default_cohort = str(cohort) if cohort is not None else "default"
+        self._journal_path = str(journal_path) if journal_path else None
+        self._journal_every = (
+            max(0, int(journal_every)) if journal_every is not None else arena_journal_every()
+        )
+        self._updates_since_save = 0
+        self._window_slots = max(1, int(window_slots))
+        self._closes = 0
+        #: ring of per-close, per-cohort merged host states (the arena's
+        #: window arithmetic — re-merged by spec at window_values() time)
+        self._ring: Deque[Tuple[int, Dict[str, Dict[str, Any]]]] = deque(maxlen=self._window_slots)
+
+        with jax.ensure_compile_time_eval():
+            self._proto = self._init_fn()
+        self._fused = not _has_list_state(self._proto)
+        self._spec_tree = self._build_spec_tree()
+        self._flat_proto = _flatten_state(self._proto)
+        self._flat_specs = _flatten_state(self._spec_tree)
+        self._decay_validated: Dict[float, float] = {}
+
+        self._capacity = 0
+        self._stacked: Optional[Dict[str, Any]] = None  # fused lane
+        self._rows: List[Optional[Dict[str, Any]]] = []  # row lane
+        self._live = np.zeros((0,), dtype=bool)
+        self._counts = np.zeros((0,), dtype=np.int64)
+        self._cohorts: List[Optional[str]] = []
+        self._free: List[int] = []  # recycled ids, descending (pop() = lowest)
+        self._watermark = 0  # never-issued id frontier
+        self._grow_to(self._bucket_capacity(max(int(capacity), 1)))
+
+    # ------------------------------------------------------------- properties
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def capacity(self) -> int:
+        """Allocated tenant rows — always ``slab * 2**k``."""
+        return self._capacity
+
+    @property
+    def slab_size(self) -> int:
+        return self._slab
+
+    @property
+    def slabs(self) -> int:
+        return self._capacity // self._slab
+
+    @property
+    def tenants(self) -> int:
+        """Live tenant count."""
+        return int(self._live.sum())
+
+    @property
+    def fused(self) -> bool:
+        """True when every state is a fixed-shape array (the vmapped donated
+        lane); False routes updates through the per-tenant row lane."""
+        return self._fused
+
+    @property
+    def window_id(self) -> int:
+        return self._closes
+
+    def live_ids(self) -> np.ndarray:
+        """Live tenant ids, ascending."""
+        return np.nonzero(self._live)[0].astype(np.int64)
+
+    def cohort_of(self, tenant_id: int) -> str:
+        self._check_live(np.asarray([tenant_id]))
+        return self._cohorts[int(tenant_id)] or self._default_cohort
+
+    # ------------------------------------------------------- capacity buckets
+    def _bucket_capacity(self, n: int) -> int:
+        """The smallest ``slab * 2**k`` covering ``n`` tenants — the bounded
+        shape set the program cache keys on (same power-of-two discipline as
+        ``engine.pow2_chunks``)."""
+        slabs = max(1, -(-int(n) // self._slab))
+        return self._slab * (1 << (slabs - 1).bit_length())
+
+    def _grow_to(self, new_cap: int) -> None:
+        old_cap = self._capacity
+        if new_cap <= old_cap:
+            return
+        pad = new_cap - old_cap
+        if self._fused:
+            if self._stacked is None:
+                self._stacked = {
+                    k: jnp.broadcast_to(p, (new_cap,) + p.shape)
+                    for k, p in self._flat_proto.items()
+                }
+            else:
+                self._stacked = {
+                    k: jnp.concatenate(
+                        [leaf, jnp.broadcast_to(self._flat_proto[k], (pad,) + self._flat_proto[k].shape)]
+                    )
+                    for k, leaf in self._stacked.items()
+                }
+        else:
+            self._rows.extend([None] * pad)
+        self._live = np.concatenate([self._live, np.zeros(pad, dtype=bool)])
+        self._counts = np.concatenate([self._counts, np.zeros(pad, dtype=np.int64)])
+        self._cohorts.extend([None] * pad)
+        self._capacity = new_cap
+        if old_cap:
+            _counters["arena_grows"] += 1
+
+    def _maybe_shrink(self) -> None:
+        """Shrink trailing slabs when no live tenant occupies them — ids are
+        stable (no compaction), so only the empty tail can be released."""
+        live = np.nonzero(self._live)[0]
+        high = int(live.max()) + 1 if live.size else 1
+        new_cap = self._bucket_capacity(high)
+        if new_cap >= self._capacity:
+            return
+        if self._fused:
+            self._stacked = {k: leaf[:new_cap] for k, leaf in self._stacked.items()}
+        else:
+            del self._rows[new_cap:]
+        self._live = self._live[:new_cap]
+        self._counts = self._counts[:new_cap]
+        del self._cohorts[new_cap:]
+        self._free = [i for i in self._free if i < new_cap]
+        self._watermark = min(self._watermark, new_cap)
+        self._capacity = new_cap
+        _counters["arena_shrinks"] += 1
+
+    # -------------------------------------------------------- tenant lifecycle
+    def add(self, count: int = 1, *, cohort: Optional[str] = None) -> List[int]:
+        """Allocate ``count`` tenant ids (free-list recycles removed ids
+        first; fresh ids grow the stack in slab buckets). ``cohort`` labels
+        every allocated tenant for the per-cohort streaming views."""
+        count = int(count)
+        if count < 1:
+            raise ValueError(f"add() needs a positive tenant count, got {count}")
+        ids: List[int] = []
+        while self._free and len(ids) < count:
+            ids.append(self._free.pop())
+            _counters["arena_ids_recycled"] += 1
+        fresh = count - len(ids)
+        if fresh:
+            needed = self._watermark + fresh
+            if needed > self._capacity:
+                self._grow_to(self._bucket_capacity(needed))
+            ids.extend(range(self._watermark, needed))
+            self._watermark = needed
+        label = str(cohort) if cohort is not None else None
+        for tid in ids:
+            self._live[tid] = True
+            self._counts[tid] = 0
+            self._cohorts[tid] = label
+            if not self._fused:
+                self._rows[tid] = self._fresh_row()
+        _counters["arena_tenants_added"] += len(ids)
+        return ids
+
+    def remove(self, tenant_ids: Any) -> None:
+        """Retire tenants: their rows reset (isolated by mask), their ids go
+        back on the free list, and fully-empty trailing slabs shrink off."""
+        ids = self._as_ids(tenant_ids)
+        self._check_live(ids)
+        self.reset(tenant_ids=ids)
+        for tid in ids.tolist():
+            self._live[tid] = False
+            self._cohorts[tid] = None
+            if not self._fused:
+                self._rows[tid] = None
+        self._free = sorted(set(self._free).union(ids.tolist()), reverse=True)
+        _counters["arena_tenants_removed"] += int(ids.size)
+        self._maybe_shrink()
+
+    def _fresh_row(self) -> Dict[str, Any]:
+        with jax.ensure_compile_time_eval():
+            return self._init_fn()
+
+    def _as_ids(self, tenant_ids: Any) -> np.ndarray:
+        ids = np.asarray(tenant_ids, dtype=np.int64).ravel()
+        if ids.size == 0:
+            raise ValueError("empty tenant id list")
+        if np.unique(ids).size != ids.size:
+            raise ValueError("duplicate tenant ids in one call (scatter order would be undefined)")
+        if ids.min() < 0 or ids.max() >= self._capacity:
+            raise ValueError(
+                f"tenant id out of range [0, {self._capacity}): {ids.min()}..{ids.max()}"
+            )
+        return ids
+
+    def _check_live(self, ids: np.ndarray) -> None:
+        dead = ids[~self._live[ids]]
+        if dead.size:
+            raise ValueError(f"tenant id(s) {dead.tolist()} are not live (add() them first)")
+
+    # ------------------------------------------------------------ the hot path
+    def update(self, tenant_ids: Any, *args: Any, **kwargs: Any) -> None:
+        """Apply one batch per tenant: every array leaf of ``args``/``kwargs``
+        carries a leading axis of ``len(tenant_ids)``. The fused lane runs
+        gather → ``vmap(update)`` → scatter as engine-cached donated
+        programs, with ragged tenant counts split into ``pow2_chunks``
+        buckets so the shape set stays bounded; the row lane applies the
+        same pure kernel per tenant (``cat``-state suites)."""
+        ids = self._as_ids(tenant_ids)
+        self._check_live(ids)
+        t0 = _telemetry.now() if _telemetry.armed else 0.0
+        chunks = 0
+        if self._fused:
+            off = 0
+            for size in _engine.pow2_chunks(int(ids.size)):
+                sl = slice(off, off + size)
+                chunk_ids = jnp.asarray(ids[sl].astype(np.int32))
+                chunk_batch = jax.tree.map(lambda leaf: leaf[sl], (args, kwargs))
+                exe = self._update_exe(size)
+                self._stacked = exe.run(self._stacked, (chunk_ids,) + chunk_batch)
+                chunks += 1
+                off += size
+            _counters["arena_update_chunks"] += chunks
+        else:
+            for pos, tid in enumerate(ids.tolist()):
+                row_batch = jax.tree.map(lambda leaf: leaf[pos], (args, kwargs))
+                row_args, row_kwargs = row_batch
+                self._rows[tid] = self._update_fn(self._rows[tid], *row_args, **row_kwargs)
+            _counters["arena_row_updates"] += int(ids.size)
+        self._counts[ids] += 1
+        _counters["arena_updates"] += 1
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "arena-update", self._name, "arena", t0, _telemetry.now() - t0,
+                {
+                    "tenants": int(ids.size),
+                    "chunks": chunks,
+                    "capacity": self._capacity,
+                    "lane": "fused" if self._fused else "rows",
+                },
+            )
+        self._updates_since_save += 1
+        if (
+            self._journal_path
+            and self._journal_every
+            and self._updates_since_save >= self._journal_every
+        ):
+            self.save()
+
+    def _update_exe(self, chunk: int) -> Any:
+        update_fn = self._update_fn
+        proto = self._proto
+
+        def build() -> Tuple[Callable, Any, Dict[str, Any]]:
+            def step(stacked: Dict[str, Any], ids: jax.Array, a: tuple, k: dict):
+                sub = _unflatten_state(
+                    {name: jnp.take(leaf, ids, axis=0) for name, leaf in stacked.items()}, proto
+                )
+                new = jax.vmap(lambda s, aa, kk: update_fn(s, *aa, **kk))(sub, a, k)
+                flat_new = _flatten_state(new)
+                return {name: leaf.at[ids].set(flat_new[name]) for name, leaf in stacked.items()}
+
+            return step, None, {}
+
+        return _engine.acquire_keyed(("arena-update", self._key, self._capacity, chunk), build)
+
+    def compute(self, tenant_ids: Optional[Any] = None) -> Any:
+        """Per-tenant computed values with a leading axis aligned to
+        ``tenant_ids`` (default: every live tenant ascending — pair with
+        :meth:`live_ids`). One vmapped program over the whole stack per
+        capacity bucket; row-lane tenants batch per state layout."""
+        ids = self.live_ids() if tenant_ids is None else self._as_ids(tenant_ids)
+        if ids.size == 0:
+            raise ValueError("compute() on an empty arena (no live tenants)")
+        self._check_live(ids)
+        _counters["arena_computes"] += 1
+        if self._fused:
+            exe = self._compute_exe()
+            values = exe(self._stacked)
+            sel = jnp.asarray(ids.astype(np.int32))
+            return jax.tree.map(lambda v: jnp.take(jnp.asarray(v), sel, axis=0), values)
+        # row lane: group same-layout tenants, stack each group, vmap once
+        groups: Dict[Any, List[int]] = {}
+        for pos, tid in enumerate(ids.tolist()):
+            leaves, treedef = jax.tree.flatten(self._rows[tid])
+            sig = (treedef, tuple((tuple(l.shape), jnp.dtype(l.dtype).name) for l in leaves))
+            groups.setdefault(sig, []).append(pos)
+        per_pos: List[Any] = [None] * int(ids.size)
+        compute_fn = self._compute_fn
+        for sig, positions in groups.items():
+            stacked = stack_states([self._rows[int(ids[p])] for p in positions])
+
+            def build() -> Tuple[Callable, Any, Dict[str, Any]]:
+                def step(st):
+                    return jax.vmap(lambda s: compute_fn(s, axis_name=None))(st)
+
+                return step, None, {}
+
+            exe = _engine.acquire_keyed(
+                ("arena-compute-rows", self._key, len(positions), sig), build, donate=False
+            )
+            vals = exe(stacked)
+            for i, p in enumerate(positions):
+                per_pos[p] = jax.tree.map(lambda v: v[i], vals)
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *per_pos)
+
+    def _compute_exe(self) -> Any:
+        compute_fn = self._compute_fn
+        proto = self._proto
+
+        def build() -> Tuple[Callable, Any, Dict[str, Any]]:
+            def step(stacked: Dict[str, Any]):
+                tree = _unflatten_state(stacked, proto)
+                return jax.vmap(lambda s: compute_fn(s, axis_name=None))(tree)
+
+            return step, None, {}
+
+        return _engine.acquire_keyed(
+            ("arena-compute", self._key, self._capacity), build, donate=False
+        )
+
+    def reset(self, mask: Optional[Any] = None, *, tenant_ids: Optional[Any] = None) -> None:
+        """Reset selected tenants to their init state through one donated
+        masked program — tenant A's reset never perturbs tenant B (the
+        unmasked rows pass through untouched, bit-exact). ``mask`` is a
+        length-``capacity`` bool vector; ``tenant_ids`` is the sparse
+        equivalent; neither resets every live tenant."""
+        if mask is not None and tenant_ids is not None:
+            raise ValueError("pass mask OR tenant_ids, not both")
+        if mask is not None:
+            m = np.asarray(mask, dtype=bool).ravel()
+            if m.size != self._capacity:
+                raise ValueError(f"mask has {m.size} rows, arena capacity is {self._capacity}")
+        else:
+            m = np.zeros(self._capacity, dtype=bool)
+            ids = self.live_ids() if tenant_ids is None else self._as_ids(tenant_ids)
+            m[ids] = True
+        if self._fused:
+            exe = self._reset_exe()
+            self._stacked = exe.run(self._stacked, (jnp.asarray(m),))
+        else:
+            for tid in np.nonzero(m)[0].tolist():
+                if self._rows[tid] is not None:
+                    self._rows[tid] = self._fresh_row()
+        self._counts[m] = 0
+        _counters["arena_resets"] += 1
+
+    def _reset_exe(self) -> Any:
+        flat_proto = self._flat_proto
+
+        def build() -> Tuple[Callable, Any, Dict[str, Any]]:
+            def step(stacked: Dict[str, Any], m: jax.Array):
+                return {
+                    name: jnp.where(_mask_broadcast(m, leaf.ndim), flat_proto[name], leaf)
+                    for name, leaf in stacked.items()
+                }
+
+            return step, None, {}
+
+        return _engine.acquire_keyed(("arena-reset", self._key, self._capacity), build)
+
+    # ------------------------------------------------------ per-tenant states
+    def tenant_state(self, tenant_id: int) -> Dict[str, Any]:
+        """One tenant's functional state tree (a view of the stack) — the
+        bridge back to ``host_handoff``/per-instance tooling."""
+        ids = self._as_ids([tenant_id])
+        self._check_live(ids)
+        tid = int(ids[0])
+        if self._fused:
+            return jax.tree.map(lambda leaf: leaf[tid], _unflatten_state(self._stacked, self._proto))
+        return jax.tree.map(lambda leaf: leaf, self._rows[tid])
+
+    # --------------------------------------------------------- cohort streaming
+    def _build_spec_tree(self) -> Dict[str, Any]:
+        if _funcore._is_collection(self._template):
+            return {
+                name: {s: str(spec) for s, spec in m._reduction_specs.items()}
+                for name, m in self._template.items(keep_base=True, copy_state=False)
+            }
+        return {s: str(spec) for s, spec in self._template._reduction_specs.items()}
+
+    def _check_cohort_mergeable(self, what: str) -> None:
+        for name, spec in self._flat_specs.items():
+            if spec not in ("sum", "mean", "max", "min"):
+                raise ValueError(
+                    f"{what} needs cohort-mergeable states (sum/mean/max/min); "
+                    f"state {name.replace(_SEP, '.')} of {self._name} reduces by {spec!r}"
+                )
+
+    def _cohort_layout(self) -> Tuple[List[str], np.ndarray]:
+        """(sorted cohort labels, per-row segment index) — dead rows land in
+        the drop segment ``len(cohorts)``."""
+        labels = sorted(
+            {self._cohorts[i] or self._default_cohort for i in np.nonzero(self._live)[0]}
+        )
+        index = {c: i for i, c in enumerate(labels)}
+        seg = np.full(self._capacity, len(labels), dtype=np.int32)
+        for tid in np.nonzero(self._live)[0]:
+            seg[tid] = index[self._cohorts[tid] or self._default_cohort]
+        return labels, seg
+
+    def _cohort_exe(self, num_cohorts: int) -> Any:
+        flat_specs = self._flat_specs
+        compute_fn = self._compute_fn
+        proto = self._proto
+
+        def build() -> Tuple[Callable, Any, Dict[str, Any]]:
+            def step(stacked: Dict[str, Any], seg: jax.Array, live: jax.Array, w: jax.Array):
+                n = num_cohorts + 1  # +1 drop segment for dead rows
+                wsum = jax.ops.segment_sum(w, seg, num_segments=n)[:num_cohorts]
+                merged: Dict[str, Any] = {}
+                for name, leaf in stacked.items():
+                    spec = flat_specs[name]
+                    if spec == "sum":
+                        z = jnp.where(_mask_broadcast(live, leaf.ndim), leaf, jnp.zeros((), leaf.dtype))
+                        merged[name] = jax.ops.segment_sum(z, seg, num_segments=n)[:num_cohorts]
+                    elif spec == "mean":
+                        wb = _mask_broadcast(w, leaf.ndim)
+                        num = jax.ops.segment_sum(
+                            leaf.astype(jnp.float32) * wb, seg, num_segments=n
+                        )[:num_cohorts]
+                        den = jnp.maximum(_mask_broadcast(wsum, leaf.ndim), 1.0)
+                        merged[name] = (num / den).astype(leaf.dtype)
+                    elif spec == "max":
+                        z = jnp.where(
+                            _mask_broadcast(live, leaf.ndim), leaf, jnp.asarray(_min_identity(leaf.dtype), leaf.dtype)
+                        )
+                        merged[name] = jax.ops.segment_max(z, seg, num_segments=n)[:num_cohorts]
+                    else:  # min
+                        z = jnp.where(
+                            _mask_broadcast(live, leaf.ndim), leaf, jnp.asarray(_max_identity(leaf.dtype), leaf.dtype)
+                        )
+                        merged[name] = jax.ops.segment_min(z, seg, num_segments=n)[:num_cohorts]
+                values = jax.vmap(lambda s: compute_fn(s, axis_name=None))(
+                    _unflatten_state(merged, proto)
+                )
+                return merged, values
+
+            return step, None, {}
+
+        return _engine.acquire_keyed(
+            ("arena-cohort", self._key, self._capacity, num_cohorts), build, donate=False
+        )
+
+    def cohort_values(self) -> Dict[str, Any]:
+        """Per-cohort computed values, merged across each cohort's tenants
+        as ONE fused program (spec-faithful segment reduce — ``sum`` adds,
+        ``mean`` weights by per-tenant update counts, ``max``/``min`` take
+        extrema — then a vmapped compute over the C merged states). Also
+        refreshes this arena's exposition block."""
+        self._check_cohort_mergeable("cohort_values()")
+        if not self._fused:
+            raise ValueError(
+                f"cohort_values() needs the fused lane; arena {self._name!r} carries "
+                "cat/list states (row lane)"
+            )
+        labels, seg = self._cohort_layout()
+        if not labels:
+            return {}
+        _, values = self._cohort_step(labels, seg)
+        out = self._slice_cohort_values(labels, values)
+        self._publish(cohorts=out)
+        return out
+
+    def _cohort_step(self, labels: List[str], seg: np.ndarray) -> Tuple[Dict[str, Any], Any]:
+        exe = self._cohort_exe(len(labels))
+        w = (self._counts * self._live).astype(np.float32)
+        merged, values = exe(
+            self._stacked, jnp.asarray(seg), jnp.asarray(self._live), jnp.asarray(w)
+        )
+        _counters["arena_cohort_programs"] += 1
+        return merged, values
+
+    def _slice_cohort_values(self, labels: List[str], values: Any) -> Dict[str, Any]:
+        return {
+            label: jax.tree.map(lambda v: jnp.asarray(v)[i], values)
+            for i, label in enumerate(labels)
+        }
+
+    def close_window(self) -> Dict[str, Any]:
+        """Close one arena-wide window: merge every cohort's tenants (one
+        fused program), push the merged per-cohort states into the window
+        ring, reset every live tenant's accumulation (the next stride
+        starts clean), and publish the close's per-cohort values keyed by
+        the close id. Returns ``{window, cohorts, slots}``."""
+        self._check_cohort_mergeable("close_window()")
+        if not self._fused:
+            raise ValueError(
+                f"close_window() needs the fused lane; arena {self._name!r} carries "
+                "cat/list states (row lane)"
+            )
+        t0 = _telemetry.now() if _telemetry.armed else 0.0
+        labels, seg = self._cohort_layout()
+        close_id = self._closes + 1
+        slot: Dict[str, Dict[str, Any]] = {}
+        values: Dict[str, Any] = {}
+        if labels:
+            merged, vals = self._cohort_step(labels, seg)
+            counts = np.zeros(len(labels), dtype=np.int64)
+            for tid in np.nonzero(self._live)[0]:
+                counts[labels.index(self._cohorts[tid] or self._default_cohort)] += int(
+                    self._counts[tid]
+                )
+            for i, label in enumerate(labels):
+                slot[label] = {
+                    "states": {name: np.asarray(leaf[i]) for name, leaf in merged.items()},
+                    "count": int(counts[i]),
+                }
+            values = self._slice_cohort_values(labels, vals)
+            self.reset()  # every live tenant starts the next stride clean
+        self._closes = close_id
+        self._ring.append((close_id, slot))
+        _counters["arena_closes"] += 1
+        from metrics_tpu import streaming as _streaming
+
+        self._publish(
+            cohorts=values,
+            values_entry=(close_id, {c: _streaming._scalar_map(v) for c, v in values.items()}),
+        )
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "arena-close", self._name, "arena", t0, _telemetry.now() - t0,
+                {"window": close_id, "cohorts": len(labels), "slots": len(self._ring)},
+            )
+        return {"window": close_id, "cohorts": values, "slots": len(self._ring)}
+
+    def window_values(self) -> Dict[str, Any]:
+        """Per-cohort windowed values: re-merge the retained ring slots
+        (spec-faithful, like the streaming plane's ``_merge_record``) and
+        compute — a cohort's window value is exactly what one fresh suite
+        fed the retained strides would compute."""
+        folded: Dict[str, Tuple[Dict[str, np.ndarray], int]] = {}
+        for _, slot in self._ring:
+            for label, entry in slot.items():
+                if label not in folded:
+                    folded[label] = (
+                        {k: np.array(v, copy=True) for k, v in entry["states"].items()},
+                        int(entry["count"]),
+                    )
+                    continue
+                acc, c_acc = folded[label]
+                c_inc = int(entry["count"])
+                for name, inc in entry["states"].items():
+                    spec = self._flat_specs[name]
+                    if spec == "sum":
+                        acc[name] = acc[name] + inc
+                    elif spec == "mean":
+                        total = max(c_acc + c_inc, 1)
+                        acc[name] = (c_acc * acc[name] + c_inc * inc) / total
+                    elif spec == "max":
+                        acc[name] = np.maximum(acc[name], inc)
+                    else:
+                        acc[name] = np.minimum(acc[name], inc)
+                folded[label] = (acc, c_acc + c_inc)
+        out: Dict[str, Any] = {}
+        for label, (flat, _count) in folded.items():
+            state = _unflatten_state({k: jnp.asarray(v) for k, v in flat.items()}, self._proto)
+            out[label] = self._compute_fn(state, axis_name=None)
+        return out
+
+    def decay_tick(self, halflife: float) -> None:
+        """One EMA tick over the WHOLE arena: every tenant's every state
+        scales by ``0.5 ** (1 / halflife)`` through one donated program —
+        the arena-native ``Decayed`` view. Requires every state to reduce by
+        ``sum`` over a floating dtype (same contract as ``Decayed``)."""
+        halflife = float(halflife)
+        if not halflife > 0:
+            raise ValueError(f"halflife must be a positive update count, got {halflife}")
+        decay = self._decay_validated.get(halflife)
+        if decay is None:
+            if not self._fused:
+                raise ValueError(
+                    f"decay_tick() needs the fused lane; arena {self._name!r} carries "
+                    "cat/list states (row lane)"
+                )
+            for name, spec in self._flat_specs.items():
+                if spec != "sum":
+                    raise ValueError(
+                        f"decay_tick() requires sum-reduction states; "
+                        f"{name.replace(_SEP, '.')} reduces by {spec!r}"
+                    )
+                if not jnp.issubdtype(self._flat_proto[name].dtype, jnp.floating):
+                    raise ValueError(
+                        f"decay_tick() requires floating states; {name.replace(_SEP, '.')} is "
+                        f"{self._flat_proto[name].dtype} (an integer count cannot decay exactly)"
+                    )
+            decay = float(0.5 ** (1.0 / halflife))
+            self._decay_validated[halflife] = decay
+
+        def build() -> Tuple[Callable, Any, Dict[str, Any]]:
+            def step(stacked: Dict[str, Any]):
+                return {k: v * jnp.asarray(decay, v.dtype) for k, v in stacked.items()}
+
+            return step, None, {}
+
+        exe = _engine.acquire_keyed(("arena-decay", self._key, self._capacity, decay), build)
+        self._stacked = exe.run(self._stacked)
+        _counters["arena_decay_ticks"] += 1
+
+    def cohort_drift(
+        self, cohort: str, reference: Optional[Any] = None, *, bins: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """PSI/KS of one cohort's stacked raw states against another cohort
+        (``reference`` as a label) or an explicit sample — scores land in
+        the streaming registry as ``metrics_tpu_drift_score{name=
+        "<arena>/<cohort>"}``."""
+        from metrics_tpu import streaming as _streaming
+
+        current = self._cohort_sample(str(cohort))
+        if reference is None:
+            raise ValueError("cohort_drift needs a reference cohort label or sample")
+        ref = self._cohort_sample(str(reference)) if isinstance(reference, str) else reference
+        _counters["arena_drift_reports"] += 1
+        return _streaming.drift_report(
+            current, ref, bins=bins, name=f"{self._name}/{_safe_name(cohort)}"
+        )
+
+    def _cohort_sample(self, cohort: str) -> np.ndarray:
+        ids = [
+            tid
+            for tid in np.nonzero(self._live)[0].tolist()
+            if (self._cohorts[tid] or self._default_cohort) == cohort
+        ]
+        if not ids:
+            raise ValueError(f"cohort {cohort!r} has no live tenants in arena {self._name!r}")
+        rows: List[np.ndarray] = []
+        if self._fused:
+            for leaf in self._stacked.values():
+                arr = np.asarray(leaf[np.asarray(ids)], dtype=np.float64).ravel()
+                if arr.size:
+                    rows.append(arr)
+        else:
+            for tid in ids:
+                for leaf in jax.tree.leaves(self._rows[tid]):
+                    arr = np.asarray(leaf, dtype=np.float64).ravel()
+                    if arr.size:
+                        rows.append(arr)
+        return np.concatenate(rows) if rows else np.zeros((0,), dtype=np.float64)
+
+    def _publish(
+        self,
+        *,
+        cohorts: Optional[Dict[str, Any]] = None,
+        values_entry: Optional[Tuple[int, Dict[str, Dict[str, float]]]] = None,
+    ) -> None:
+        from metrics_tpu import streaming as _streaming
+
+        block = _ARENAS.setdefault(self._name, {"name": self._name, "values": {}})
+        block.update(
+            capacity=self._capacity,
+            tenants=self.tenants,
+            slab=self._slab,
+            slabs=self.slabs,
+            window=self._closes,
+            lane="fused" if self._fused else "rows",
+        )
+        if cohorts is not None:
+            block["cohorts"] = {
+                _safe_name(c): _streaming._scalar_map(v) for c, v in cohorts.items()
+            }
+        if values_entry is not None:
+            close_id, per_cohort = values_entry
+            block["values"][str(close_id)] = per_cohort
+            keep = _streaming.window_values_kept()
+            for wid in sorted(block["values"], key=int)[:-keep]:
+                del block["values"][wid]
+
+    # ------------------------------------------------------------- durability
+    def _slab_path(self, path: str, k: int) -> str:
+        return f"{path}.slab{k}"
+
+    def save(self, path: Optional[str] = None) -> int:
+        """Persist the arena as ONE CRC-framed journal record per slab (each
+        with its own atomic-write generation ring) — slab-granular
+        durability: a crash tears at most the slab being written, and that
+        slab demotes to its previous good generation on :meth:`restore`.
+        Returns total bytes written."""
+        path = str(path) if path else self._journal_path
+        if not path:
+            raise ValueError("this arena was constructed without journal_path")
+        if not self._fused:
+            raise ValueError(
+                f"arena {self._name!r} carries cat/list states; the slab byte layout "
+                "needs fixed-shape array states (journal the tenants individually)"
+            )
+        t0 = _telemetry.now() if _telemetry.armed else 0.0
+        total = 0
+        S = self._slab
+        host = {name: np.asarray(leaf) for name, leaf in self._stacked.items()}
+        statics = self._static_attrs()
+        for k in range(self.slabs):
+            sl = slice(k * S, (k + 1) * S)
+            arrays = {name: arr[sl] for name, arr in host.items()}
+            record = _journal.pack_raw_record(
+                arrays,
+                manifest_extra={
+                    "arena": {
+                        "name": self._name,
+                        "slab": k,
+                        "slab_size": S,
+                        "capacity": self._capacity,
+                        "live": [int(b) for b in self._live[sl]],
+                        "counts": [int(c) for c in self._counts[sl]],
+                        "cohorts": list(self._cohorts[sl.start : sl.stop]),
+                        "static_attrs": statics,
+                    },
+                    "epoch": _psync.world_epoch(),
+                },
+            )
+            _journal.write_record(self._slab_path(path, k), record)
+            total += len(record)
+            _counters["arena_slab_saves"] += 1
+        _counters["arena_slab_bytes_written"] += total
+        self._updates_since_save = 0
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "arena-journal", self._name, "arena", t0, _telemetry.now() - t0,
+                {"op": "save", "slabs": self.slabs, "bytes": total},
+            )
+        return total
+
+    def _static_attrs(self) -> Dict[str, Dict[str, Any]]:
+        if _funcore._is_collection(self._template):
+            return {
+                name: _journal._static_attrs(m)
+                for name, m in self._template.items(keep_base=True, copy_state=False)
+            }
+        return {"": _journal._static_attrs(self._template)}
+
+    def _apply_static_attrs(self, statics: Dict[str, Dict[str, Any]]) -> None:
+        if _funcore._is_collection(self._template):
+            members = dict(self._template.items(keep_base=True, copy_state=False))
+            for name, attrs in (statics or {}).items():
+                node = members.get(name)
+                if node is not None:
+                    for key, value in (attrs or {}).items():
+                        setattr(node, key, value)
+        else:
+            for key, value in (statics or {}).get("", {}).items():
+                setattr(self._template, key, value)
+
+    def restore(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """Rebuild the stack from the per-slab records. Each slab walks its
+        generation ring newest-first: a torn or checksum-failed generation
+        classifies a ``journal`` fault, counts an ``arena_slab_demotions``
+        and demotes to the previous good generation OF THAT SLAB — other
+        slabs restore untouched. A slab with no good generation resets to
+        init (its tenants report dead). Returns ``{slabs, demotions,
+        tenants}``."""
+        path = str(path) if path else self._journal_path
+        if not path:
+            raise ValueError("this arena was constructed without journal_path")
+        t0 = _telemetry.now() if _telemetry.armed else 0.0
+        gens = _journal.journal_generations() + 8
+        recovered: Dict[int, Tuple[Dict[str, Any], Dict[str, np.ndarray]]] = {}
+        demotions = 0
+        k = 0
+        while True:
+            base = self._slab_path(path, k)
+            paths = [_journal._gen_path(base, g) for g in range(gens)]
+            if not any(os.path.exists(p) for p in paths):
+                break
+            for gpath in paths:
+                if not os.path.exists(gpath):
+                    continue
+                try:
+                    with open(gpath, "rb") as fh:
+                        data = fh.read()
+                    manifest, payload = _journal.decode_record(data, origin=repr(gpath))
+                    arrays = _journal.unpack_raw_record(manifest, payload)
+                    meta = manifest.get("arena") or {}
+                    if int(meta.get("slab_size", self._slab)) != self._slab:
+                        raise ValueError(
+                            f"slab record carries slab_size={meta.get('slab_size')}, "
+                            f"arena uses {self._slab}"
+                        )
+                except Exception as exc:  # noqa: BLE001 — demote to the previous generation of THIS slab
+                    demotions += 1
+                    _counters["arena_slab_demotions"] += 1
+                    _faults.note_fault(
+                        _faults.classify(exc, "journal"), site="journal-load", owner=self, error=exc
+                    )
+                    _faults.warn_fault(
+                        self,
+                        "journal",
+                        f"Arena slab record {gpath!r} failed verification "
+                        f"({type(exc).__name__}: {exc}); demoting to the previous good "
+                        "generation of this slab (other slabs are unaffected).",
+                    )
+                    continue
+                recovered[k] = (meta, arrays)
+                break
+            k += 1
+        slab_count = k
+        if slab_count == 0:
+            raise _journal.JournalFault(
+                f"no arena slab records found at {path!r}", site="journal-load"
+            )
+        cap = max(
+            (int(meta.get("capacity", slab_count * self._slab)) for meta, _ in recovered.values()),
+            default=slab_count * self._slab,
+        )
+        # rebuild the stack host-side, then land it as one device tree
+        S = self._slab
+        host = {
+            name: np.broadcast_to(np.asarray(p), (cap,) + p.shape).copy()
+            for name, p in self._flat_proto.items()
+        }
+        live = np.zeros(cap, dtype=bool)
+        counts = np.zeros(cap, dtype=np.int64)
+        cohorts: List[Optional[str]] = [None] * cap
+        for k, (meta, arrays) in recovered.items():
+            sl = slice(k * S, (k + 1) * S)
+            for name in host:
+                if name in arrays:
+                    host[name][sl] = arrays[name]
+            live[sl] = np.asarray(meta.get("live", [0] * S), dtype=bool)[: S]
+            counts[sl] = np.asarray(meta.get("counts", [0] * S), dtype=np.int64)[: S]
+            for i, label in enumerate((meta.get("cohorts") or [None] * S)[:S]):
+                cohorts[k * S + i] = label
+            self._apply_static_attrs(meta.get("static_attrs") or {})
+            _counters["arena_slab_restores"] += 1
+        self._capacity = cap
+        self._stacked = {name: jnp.asarray(arr) for name, arr in host.items()}
+        self._live = live
+        self._counts = counts
+        self._cohorts = cohorts
+        self._watermark = cap
+        self._free = sorted(np.nonzero(~live)[0].tolist(), reverse=True)
+        if t0 and _telemetry.armed:
+            _telemetry.emit(
+                "arena-journal", self._name, "arena", t0, _telemetry.now() - t0,
+                {"op": "restore", "slabs": slab_count, "demotions": demotions},
+            )
+        return {"slabs": slab_count, "demotions": demotions, "tenants": self.tenants}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"MetricArena({self._name!r}, tenants={self.tenants}, "
+            f"capacity={self._capacity}, slab={self._slab}, "
+            f"lane={'fused' if self._fused else 'rows'})"
+        )

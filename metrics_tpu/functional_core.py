@@ -72,7 +72,13 @@ _counters: Dict[str, int] = {
 
 
 def funcore_stats() -> Dict[str, int]:
-    """Functional-core event counters (folded into ``engine_stats()``)."""
+    """Functional-core event counters (folded into ``engine_stats()``).
+
+    Example:
+        >>> from metrics_tpu import funcore_stats
+        >>> funcore_stats()["funcore_updates"] >= 0
+        True
+    """
     return dict(_counters)
 
 
@@ -99,6 +105,14 @@ class FuncState:
     and :func:`host_handoff` raises the classified ``EpochFault`` when a
     stale-stamped tree tries to land. All leaves flatten/donate like any
     pytree (``jax.jit(step, donate_argnums=0)`` works unchanged).
+
+    Example:
+        >>> from metrics_tpu import MeanMetric
+        >>> state = MeanMetric().init()
+        >>> type(state).__name__
+        'FuncState'
+        >>> state.with_epoch(state.epoch + 1).epoch == state.epoch + 1
+        True
     """
 
     __slots__ = ("states", "epoch")
@@ -273,7 +287,16 @@ def apply_update(owner: Any, state: Any, *args: Any, **kwargs: Any) -> Any:
     Accepts either a :class:`FuncState` (epoch preserved through the step)
     or a bare state pytree (the ``as_functions()`` shape) and returns the
     same kind. Jit/``shard_map`` this freely; inside a compiled step the
-    host never sees the call."""
+    host never sees the call.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric, apply_update, apply_compute
+        >>> m = MeanMetric()
+        >>> state = apply_update(m, m.init(), jnp.asarray([1.0, 3.0]))
+        >>> float(apply_compute(m, state))
+        2.0
+    """
     _, update_fn, _ = metric_functions(owner)
     states, _ = _unwrap(state)
     _counters["funcore_updates"] += 1
@@ -284,7 +307,16 @@ def apply_compute(owner: Any, state: Any, *, axis_name: Optional[str] = None) ->
     """Pure compute. With ``axis_name`` (inside ``shard_map``/``pjit`` over a
     mesh axis) every state's reduction spec lowers to ONE in-graph XLA
     collective (psum/pmean/pmax/pmin/all_gather) — the zero-host-round-trip
-    replacement for the host sync plane."""
+    replacement for the host sync plane.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric, apply_update, apply_compute
+        >>> m = SumMetric()
+        >>> state = apply_update(m, m.init(), jnp.asarray([2.0, 5.0]))
+        >>> float(apply_compute(m, state))
+        7.0
+    """
     _, _, compute_fn = metric_functions(owner)
     states, _ = _unwrap(state)
     _counters["funcore_computes"] += 1
@@ -334,6 +366,14 @@ def host_handoff(owner: Any, state: Any, *, merged: bool = True) -> Any:
     intact, exactly like the host plane's fence. Re-stamp with
     :meth:`FuncState.with_epoch` after handling the transition to land
     anyway. Returns ``owner``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric, apply_update, host_handoff
+        >>> m = MeanMetric()
+        >>> state = apply_update(m, m.init(), jnp.asarray([2.0, 4.0]))
+        >>> float(host_handoff(m, state).compute())
+        3.0
     """
     states, epoch = _unwrap(state)
     if epoch is not None:

@@ -555,7 +555,10 @@ def engine_stats() -> Dict[str, Any]:
     demotions) and the streaming-plane counters from
     :mod:`metrics_tpu.streaming` (window closes and the payload collectives
     they issued, ring slots packed/persisted/demoted, epoch trips mid-close,
-    decay ticks, drift reports). ``telemetry.snapshot()`` is the superset
+    decay ticks, drift reports) — and the tenant-arena counters from
+    :mod:`metrics_tpu.arena` (``arena_*``: tenant lifecycle, vmapped
+    update/compute/reset program traffic, slab-journal saves, bytes and
+    demotions). ``telemetry.snapshot()`` is the superset
     surface that adds the span-recorder counters and the program-ledger
     summary on top."""
     out: Dict[str, Any] = {
@@ -589,6 +592,12 @@ def engine_stats() -> Dict[str, Any]:
     from metrics_tpu import functional_core as _funcore
 
     out.update(_funcore.funcore_stats())
+    # the tenant-arena plane (lifecycle, vmapped program traffic, slab
+    # journal bytes and demotions) — lazy: the arena imports engine for
+    # its cached programs
+    from metrics_tpu import arena as _arena
+
+    out.update(_arena.arena_stats())
     return out
 
 

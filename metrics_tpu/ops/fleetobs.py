@@ -351,6 +351,7 @@ def merge_streaming(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
     loop stalled — the streaming twin of the straggler report."""
     windows: Dict[str, Dict[str, Any]] = {}
     drift: Dict[str, Dict[str, float]] = {}
+    arenas: Dict[str, Dict[str, Any]] = {}
     per_rank_ids: Dict[str, Dict[int, int]] = {}
     for rank, plane in sorted(planes.items()):
         if not _is_live_plane(plane):
@@ -369,6 +370,13 @@ def merge_streaming(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
         for name, scores in (block.get("drift") or {}).items():
             if isinstance(scores, dict):
                 drift.setdefault(name, scores)
+        # arena blocks ride the same first-live-rank discipline as window
+        # values: every rank publishing an arena name holds that arena's
+        # own state, and duplicate names across ranks are the same logical
+        # arena restored fleet-wide
+        for name, arena in (block.get("arenas") or {}).items():
+            if isinstance(arena, dict):
+                arenas.setdefault(name, arena)
     window_skew: Dict[str, Dict[str, Any]] = {}
     for name, ids in sorted(per_rank_ids.items()):
         agreed = max(ids.values())
@@ -377,7 +385,7 @@ def merge_streaming(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
             "max_skew": agreed - min(ids.values()),
             "per_rank_lag": {r: agreed - wid for r, wid in sorted(ids.items())},
         }
-    return {"windows": windows, "drift": drift, "window_skew": window_skew}
+    return {"windows": windows, "drift": drift, "arenas": arenas, "window_skew": window_skew}
 
 
 def straggler_report(planes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
@@ -729,8 +737,34 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
                 value_samples.append(
                     (f'{{name="{label_name}",window="{wid}"}}', float(value))
                 )
+    # the tenant-arena cohorts (arena.py): every cohort's newest computed
+    # values join the SAME metric-value family, disambiguated by the
+    # tenant_cohort label — one dashboard family for singleton windows and
+    # million-tenant arenas alike
+    tenant_samples = []
+    for aname, block in (streaming.get("arenas") or {}).items():
+        if not isinstance(block, dict):
+            continue
+        tenant_samples.append((f'{{name="{aname}"}}', float(block.get("tenants", 0))))
+        for cohort, scalars in (block.get("cohorts") or {}).items():
+            for key, value in (scalars or {}).items():
+                label_name = aname if key == "value" else f"{aname}.{key}"
+                value_samples.append(
+                    (f'{{name="{label_name}",tenant_cohort="{cohort}"}}', float(value))
+                )
+        for wid, per_cohort in (block.get("values") or {}).items():
+            for cohort, scalars in (per_cohort or {}).items():
+                for key, value in (scalars or {}).items():
+                    label_name = aname if key == "value" else f"{aname}.{key}"
+                    value_samples.append(
+                        (
+                            f'{{name="{label_name}",tenant_cohort="{cohort}",window="{wid}"}}',
+                            float(value),
+                        )
+                    )
     family("metrics_tpu_metric_value", "gauge", value_samples)
     family("metrics_tpu_fleet_window_id", "gauge", id_samples)
+    family("metrics_tpu_fleet_arena_tenants", "gauge", tenant_samples)
     drift_samples = []
     for dname, scores in (streaming.get("drift") or {}).items():
         for kind in ("psi", "ks"):

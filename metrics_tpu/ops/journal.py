@@ -61,11 +61,13 @@ __all__ = [
     "journal_stats",
     "journalable",
     "load_nodes",
+    "pack_raw_record",
     "pack_record",
     "read_record",
     "restored_meta",
     "save_nodes",
     "stage_states",
+    "unpack_raw_record",
     "world_meta",
     "write_record",
 ]
@@ -215,11 +217,84 @@ def pack_record(nodes: Sequence[Any], manifest_extra: Optional[Dict[str, Any]] =
         for key, value in manifest_extra.items():
             # extra stamps never shadow the structural schema
             manifest.setdefault(key, value)
+    return _frame_record(manifest, payload)
+
+
+def _frame_record(manifest: Dict[str, Any], payload: bytes) -> bytes:
+    """CRC-frame one ``(manifest, payload)`` pair into the on-disk record
+    layout — the shared tail of :func:`pack_record` and
+    :func:`pack_raw_record`, so every record kind verifies through the same
+    :func:`decode_record` discipline."""
     mbytes = json.dumps(manifest, separators=(",", ":")).encode("utf-8")
     header = _HEADER.pack(
         _MAGIC, _VERSION, len(mbytes), len(payload), zlib.crc32(mbytes), zlib.crc32(payload)
     )
     return header + mbytes + payload
+
+
+def pack_raw_record(
+    arrays: Dict[str, Any], manifest_extra: Optional[Dict[str, Any]] = None
+) -> bytes:
+    """Serialize a flat ``{name: array}`` dict into one CRC-framed record.
+
+    The node-less twin of :func:`pack_record` for callers whose durable unit
+    is a plain array layout rather than a ``Metric`` tree — the tenant
+    arena's per-slab state records (:mod:`metrics_tpu.arena`) ride this.
+    Entries are typed ``kind="raw"``; the payload is the concatenation of
+    each array's native bytes in manifest order (bit-exact for every
+    fixed-width dtype, bfloat16 included — the bitcast unpack is shared with
+    the sync plane). Atomic write + generation ring + verified load all come
+    from the shared :func:`write_record` / :func:`decode_record` machinery.
+    """
+    entries: List[Dict[str, Any]] = []
+    chunks: List[bytes] = []
+    for name in arrays:
+        arr = np.asarray(arrays[name])
+        entries.append(
+            {
+                "node": 0,
+                "name": str(name),
+                "kind": "raw",
+                "dtype": jnp.dtype(arr.dtype).name,
+                "shape": [int(d) for d in arr.shape],
+            }
+        )
+        chunks.append(np.ascontiguousarray(arr).tobytes())
+    manifest: Dict[str, Any] = {"version": _VERSION, "raw": True, "entries": entries}
+    if manifest_extra:
+        for key, value in manifest_extra.items():
+            manifest.setdefault(key, value)
+    return _frame_record(manifest, b"".join(chunks))
+
+
+def unpack_raw_record(manifest: Dict[str, Any], payload: bytes) -> Dict[str, np.ndarray]:
+    """Decode a :func:`pack_raw_record` payload back into ``{name: array}``.
+
+    Expects the ``(manifest, payload)`` pair :func:`decode_record` already
+    CRC-verified; raises the classified :class:`JournalFault` on any layout
+    mismatch (non-raw entries, overrun, unclaimed bytes) — the same
+    all-or-nothing posture as :func:`stage_states`."""
+
+    def _bad(why: str) -> JournalFault:
+        return JournalFault(f"raw journal record is corrupt: {why}", site="journal-load")
+
+    if not manifest.get("raw"):
+        raise _bad("manifest is not a raw record (missing the 'raw' stamp)")
+    buf = jnp.asarray(np.frombuffer(payload, np.uint8))
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for e in manifest["entries"]:
+        if e.get("kind") != "raw":
+            raise _bad(f"entry {e.get('name')!r} has kind {e.get('kind')!r}, expected 'raw'")
+        shape, dtype = tuple(e["shape"]), e["dtype"]
+        n = _bucketing._byte_len(shape, dtype)
+        if off + n > len(payload):
+            raise _bad(f"entry {e['name']!r} overruns the payload")
+        out[e["name"]] = np.asarray(_bucketing._from_bytes(buf[off : off + n], shape, dtype))
+        off += n
+    if off != len(payload):
+        raise _bad(f"record carries {len(payload) - off} unclaimed payload bytes")
+    return out
 
 
 def decode_record(data: bytes, origin: str = "<bytes>") -> Tuple[Dict[str, Any], bytes]:

@@ -151,6 +151,13 @@ SPAN_SITES = {
     # functional core (functional_core.py)
     "funcore-handoff": "an in-graph state tree landed back into the stateful "
     "shell (epoch-fenced; pending async sync cancelled; instant)",
+    # tenant arenas (arena.py)
+    "arena-update": "one multi-tenant arena update: pow2-chunked gather + "
+    "vmapped kernel + scatter over the stacked tenant states",
+    "arena-close": "one arena-wide window close: fused per-cohort merge + "
+    "vmapped compute + ring slot + live-tenant reset",
+    "arena-journal": "one slab-granular arena save or restore (one CRC-framed "
+    "record per slab, per-slab generation demotion)",
 }
 
 #: The sync-protocol phases the fleet straggler report attributes
@@ -837,6 +844,9 @@ _COUNTER_PREFIXES = (
     # the functional core's host-visible events: export builds/hits, API
     # calls (eager or trace-time), hand-backs (functional_core.py)
     "funcore_",
+    # the tenant-arena plane: lifecycle, vmapped program traffic, slab
+    # journal bytes/demotions (arena.py)
+    "arena_",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —

@@ -110,6 +110,14 @@ def infer_state_pspecs(
       full shape and the in-graph collective merges values, not layout.
     - **python-list** cat states map to ``None`` (host-side rows; not a
       device placement).
+
+    Example:
+        >>> import jax, numpy as np, jax.numpy as jnp
+        >>> from jax.sharding import Mesh
+        >>> from metrics_tpu import infer_state_pspecs
+        >>> mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+        >>> infer_state_pspecs({"total": jnp.zeros(())}, mesh, {"total": "sum"})
+        {'total': PartitionSpec()}
     """
     if axis_name is None:
         axis_name = mesh.axis_names[0]
@@ -135,7 +143,17 @@ def infer_state_shardings(
 ) -> Dict[str, Optional[NamedSharding]]:
     """:func:`infer_state_pspecs` lifted to ``NamedSharding`` (what
     ``jax.jit(..., in_shardings=...)`` / ``device_put`` consume). List
-    states stay ``None``."""
+    states stay ``None``.
+
+    Example:
+        >>> import jax, numpy as np, jax.numpy as jnp
+        >>> from jax.sharding import Mesh
+        >>> from metrics_tpu import infer_state_shardings
+        >>> mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+        >>> out = infer_state_shardings({"total": jnp.zeros(())}, mesh, {"total": "sum"})
+        >>> out["total"].spec
+        PartitionSpec()
+    """
     pspecs = infer_state_pspecs(states, mesh, reduction_specs, axis_name=axis_name)
     return {
         name: None if spec is None else NamedSharding(mesh, spec)

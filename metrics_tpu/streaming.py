@@ -127,17 +127,22 @@ _telemetry.register_reset("streaming", _reset_streaming)
 def streaming_snapshot() -> Dict[str, Any]:
     """The JSON-safe ``streaming`` block ``telemetry_snapshot()`` carries:
     ``windows`` (per-name window id, boundaries, last close latency,
-    per-window computed scalar values) and ``drift`` (newest PSI/KS scores
-    per report name). Flattened numeric keys type as gauges (the
-    ``streaming_`` prefix carve-out in ``telemetry.is_counter_key``) —
-    window values and drift scores move both ways, unlike the ``window_*``
-    event counters."""
+    per-window computed scalar values), ``drift`` (newest PSI/KS scores
+    per report name), and ``arenas`` (per-arena capacity, tenant count and
+    newest per-cohort values — the ``tenant_cohort`` exposition source).
+    Flattened numeric keys type as gauges (the ``streaming_`` prefix
+    carve-out in ``telemetry.is_counter_key``) — window values and drift
+    scores move both ways, unlike the ``window_*`` event counters."""
+    # lazy: the arena imports this module for its scalar/label helpers
+    from metrics_tpu import arena as _arena
+
     return {
         "windows": {
             name: dict(block, values={k: dict(v) for k, v in block["values"].items()})
             for name, block in _WINDOWS.items()
         },
         "drift": {name: dict(scores) for name, scores in _DRIFT.items()},
+        "arenas": _arena.arena_snapshot(),
     }
 
 

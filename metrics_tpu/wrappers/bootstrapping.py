@@ -340,12 +340,15 @@ class BootStrapper(Metric):
 
         def build(upd):
             init_fn = clone0.as_functions()[0]  # only needed at (re)build
+            # the arena's stacking helpers ARE the clone fan-out's stacking
+            # (one leading-axis code path — lazy import, arena sits above
+            # the wrappers in the package graph)
+            from metrics_tpu.arena import stack_states, unstack_states
 
             def program(states, w, *a, **k):
                 deltas = row_deltas(upd, init_fn(), a, k)
-                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
-                new = weighted_state_apply(stacked, deltas, w)
-                return [jax.tree.map(lambda x: x[i], new) for i in range(len(states))]
+                new = weighted_state_apply(stack_states(states), deltas, w)
+                return unstack_states(new, len(states))
 
             return program
 
@@ -425,15 +428,17 @@ class BootStrapper(Metric):
         draws, draws_dev = self._consume_or_draw(size, draw_indices)
 
         def build(upd):
+            # same leading-axis stacking the tenant arena uses (arena.py)
+            from metrics_tpu.arena import stack_states, unstack_states
+
             def program(states, idx, *a, **k):
                 def one(state, rows):
                     ra = apply_to_collection(a, jax.Array, jnp.take, rows, axis=0)
                     rk = apply_to_collection(k, jax.Array, jnp.take, rows, axis=0)
                     return upd(state, *ra, **rk)
 
-                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
-                out = jax.vmap(one)(stacked, idx)
-                return [jax.tree.map(lambda x: x[i], out) for i in range(len(states))]
+                out = jax.vmap(one)(stack_states(states), idx)
+                return unstack_states(out, len(states))
 
             return program
 

@@ -1,0 +1,407 @@
+"""Multi-tenant metric arenas (ISSUE-17 contracts).
+
+Contracts (`metrics_tpu/arena.py`):
+
+- **Vmapped parity** — `update(tenant_ids, *batch)` / `compute()` /
+  `reset(mask)` over the stacked `FuncState` trees are bit-exact vs a
+  per-instance module loop, across the fused lane (Accuracy, Mean, a
+  compute-group collection) and the row lane (AUROC's cat states).
+- **Slab-bucketed shapes** — capacity only takes `slab * 2**k` values, so
+  add/remove across a slab boundary retraces exactly once per NEW bucket
+  (pinned by the engine's `builds` counter) and zero times inside one.
+- **Reset-mask isolation** — resetting tenant A never perturbs tenant B's
+  state, bit-exactly; removed ids recycle through the free list.
+- **Slab-granular durability** — one CRC-framed journal record per slab,
+  each with its own generation ring; a torn slab record demotes to ITS
+  previous good generation while every other slab restores untouched.
+- **Warn-once env knobs** — `METRICS_TPU_ARENA_*` garbage values warn once
+  naming the value and fall back to the default.
+- **Arena-native streaming** — per-cohort merge/close/drift run as fused
+  programs and render in `fleet_prometheus_text` with `tenant_cohort`
+  labels.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu import arena as arena_mod
+from metrics_tpu.arena import MetricArena, stack_states, unstack_states
+from metrics_tpu.ops import engine, fleetobs, journal as journal_mod, telemetry
+from metrics_tpu.parallel import sync as psync
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    psync.reset_membership()
+    engine.reset_stats()
+    yield
+    psync.reset_membership()
+    engine.reset_stats()
+
+
+def _binary_batch(rng, n, b=8):
+    preds = jnp.asarray(rng.randint(0, 2, (n, b)).astype(np.int32))
+    target = jnp.asarray(rng.randint(0, 2, (n, b)).astype(np.int32))
+    return preds, target
+
+
+# ------------------------------------------------------------------- parity
+def test_parity_accuracy_vs_oracle():
+    rng = np.random.RandomState(0)
+    n = 6
+    arena = MetricArena(mt.Accuracy(num_classes=2), capacity=n, slab=8, name="par-acc")
+    ids = arena.add(n)
+    oracles = [mt.Accuracy(num_classes=2) for _ in range(n)]
+    for _ in range(3):
+        preds, target = _binary_batch(rng, n)
+        arena.update(ids, preds, target)
+        for i, m in enumerate(oracles):
+            m.update(preds[i], target[i])
+    got = np.asarray(arena.compute(ids))
+    want = np.stack([np.asarray(m.compute()) for m in oracles])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_parity_mean_ragged_rounds():
+    rng = np.random.RandomState(1)
+    n = 5
+    arena = MetricArena(mt.MeanMetric(), capacity=n, slab=4, name="par-mean")
+    ids = arena.add(n)
+    oracles = [mt.MeanMetric() for _ in range(n)]
+    for r in range(4):
+        sub = list(range(n - r))  # ragged: shrinking tenant subset
+        vals = jnp.asarray(rng.randn(len(sub), 3).astype(np.float32))
+        arena.update(sub, vals)
+        for pos, tid in enumerate(sub):
+            oracles[tid].update(vals[pos])
+    got = np.asarray(arena.compute(ids))
+    want = np.stack([np.asarray(m.compute()) for m in oracles])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_parity_auroc_row_lane():
+    rng = np.random.RandomState(2)
+    n = 4
+    arena = MetricArena(mt.AUROC(pos_label=1), capacity=n, slab=4, name="par-roc")
+    ids = arena.add(n)
+    assert not arena.fused  # cat-state suites ride the row lane
+    oracles = [mt.AUROC(pos_label=1) for _ in range(n)]
+    for _ in range(2):
+        scores = jnp.asarray(rng.rand(n, 16).astype(np.float32))
+        hits = jnp.asarray(rng.randint(0, 2, (n, 16)))
+        arena.update(ids, scores, hits)
+        for i, m in enumerate(oracles):
+            m.update(scores[i], hits[i])
+    got = np.asarray(arena.compute(ids))
+    want = np.stack([np.asarray(m.compute()) for m in oracles])
+    # the batched compute vmaps the trapezoid fold, which may reassociate
+    # the float32 sum by one ulp vs the scalar oracle
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_parity_compute_group_collection():
+    rng = np.random.RandomState(3)
+    n = 4
+
+    def make():
+        return mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=2), "mean": mt.MeanMetric()}
+        )
+
+    arena = MetricArena(make(), capacity=n, slab=4, name="par-col")
+    ids = arena.add(n)
+    oracles = [make() for _ in range(n)]
+    for _ in range(2):
+        preds, target = _binary_batch(rng, n)
+        arena.update(ids, preds, target)
+        for i, m in enumerate(oracles):
+            m.update(preds[i], target[i])
+    got = arena.compute(ids)
+    for key in got:
+        want = np.stack([np.asarray(m.compute()[key]) for m in oracles])
+        np.testing.assert_array_equal(np.asarray(got[key]), want)
+
+
+# --------------------------------------------------- slab buckets / retraces
+def test_slab_boundary_retraces_exactly_once_per_bucket():
+    engine.reset_engine()  # drop cached programs: pin builds from a cold cache
+    rng = np.random.RandomState(4)
+    arena = MetricArena(mt.MeanMetric(), capacity=8, slab=8, name="slabs")
+    one = jnp.asarray(rng.randn(1, 2).astype(np.float32))
+    builds0 = engine.engine_stats()["builds"]
+    for _ in range(32):  # capacity walks 8 -> 16 -> 32: three buckets
+        (tid,) = arena.add(1)
+        arena.update([tid], one)
+    built = engine.engine_stats()["builds"] - builds0
+    assert built == 3, f"expected one chunk-1 program per bucket, built {built}"
+    assert arena.capacity == 32
+    # inside the bucket: more adds + updates retrace nothing
+    builds1 = engine.engine_stats()["builds"]
+    arena.update([0], one)
+    arena.update([5], one)
+    assert engine.engine_stats()["builds"] == builds1
+
+
+def test_remove_recycles_ids_and_shrinks_trailing_slabs():
+    arena = MetricArena(mt.MeanMetric(), capacity=8, slab=8, name="recycle")
+    ids = arena.add(20)  # grows to 32
+    assert arena.capacity == 32
+    arena.remove([2, 5])  # mid-stack holes go on the free list, no shrink
+    assert arena.capacity == 32
+    new_ids = arena.add(2)
+    assert set(new_ids) == {2, 5}  # lowest freed ids recycle first
+    assert arena_mod.arena_stats()["arena_ids_recycled"] == 2
+    arena.remove(ids[8:])  # trailing tenants gone -> trailing slabs release
+    assert arena.capacity == 8
+    assert arena_mod.arena_stats()["arena_shrinks"] >= 1
+    assert arena.tenants == 8
+
+
+def test_duplicate_and_dead_tenant_ids_rejected():
+    arena = MetricArena(mt.MeanMetric(), capacity=4, slab=4, name="ids")
+    ids = arena.add(2)
+    one = jnp.ones((2, 1))
+    with pytest.raises(ValueError, match="duplicate"):
+        arena.update([ids[0], ids[0]], one)
+    with pytest.raises(ValueError, match="not live"):
+        arena.update([3], jnp.ones((1, 1)))
+
+
+# ---------------------------------------------------------- reset isolation
+def test_reset_mask_isolation_bit_exact():
+    rng = np.random.RandomState(5)
+    n = 8
+    arena = MetricArena(mt.MeanMetric(), capacity=n, slab=8, name="isolate")
+    ids = arena.add(n)
+    arena.update(ids, jnp.asarray(rng.randn(n, 4).astype(np.float32)))
+    before = np.asarray(arena.compute(ids))
+    reset_ids = [2, 6]
+    arena.reset(tenant_ids=reset_ids)
+    after = np.asarray(arena.compute(ids))
+    survivors = [i for i in range(n) if i not in reset_ids]
+    np.testing.assert_array_equal(after[survivors], before[survivors])
+    # the reset tenants restart from init: their next update is their whole state
+    vals = jnp.asarray([[3.0], [7.0]])
+    arena.update(reset_ids, vals)
+    np.testing.assert_array_equal(
+        np.asarray(arena.compute(reset_ids)), np.asarray([3.0, 7.0])
+    )
+
+
+def test_reset_full_mask_matches_capacity():
+    arena = MetricArena(mt.MeanMetric(), capacity=4, slab=4, name="mask")
+    ids = arena.add(2)
+    arena.update(ids, jnp.ones((2, 1)))
+    mask = np.zeros(arena.capacity, dtype=bool)
+    mask[ids[0]] = True
+    arena.reset(mask)
+    with pytest.raises(ValueError, match="capacity"):
+        arena.reset(np.zeros(3, dtype=bool))
+
+
+# ------------------------------------------------------------- durability
+def test_slab_journal_roundtrip(tmp_path):
+    rng = np.random.RandomState(6)
+    path = str(tmp_path / "arena.j")
+    arena = MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="dur", journal_path=path)
+    ids = arena.add(8, cohort="blue")
+    vals = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+    arena.update(ids, vals)
+    total = arena.save()
+    assert total > 0 and os.path.exists(path + ".slab0") and os.path.exists(path + ".slab1")
+    twin = MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="dur2", journal_path=path)
+    info = twin.restore()
+    assert info == {"slabs": 2, "demotions": 0, "tenants": 8}
+    np.testing.assert_array_equal(
+        np.asarray(twin.compute()), np.asarray(arena.compute())
+    )
+    assert twin.cohort_of(0) == "blue"
+
+
+def test_torn_slab_record_demotes_without_touching_neighbours(tmp_path):
+    path = str(tmp_path / "arena.j")
+    arena = MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="torn", journal_path=path)
+    ids = arena.add(8)
+    arena.update(ids, jnp.arange(8.0).reshape(8, 1) + 1)
+    arena.save()  # generation 1 (rotated to .g1 by the next save)
+    gen1 = np.asarray(arena.compute())
+    arena.update(ids, jnp.arange(8.0).reshape(8, 1) + 100)
+    arena.save()  # generation 0 (newest)
+    gen0 = np.asarray(arena.compute())
+    # tear slab 1's NEWEST generation mid-record
+    with open(path + ".slab1", "r+b") as fh:
+        fh.seek(24)
+        fh.write(b"\xff\xff\xff\xff")
+    twin = MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="torn2", journal_path=path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        info = twin.restore()
+    assert info["demotions"] == 1
+    restored = np.asarray(twin.compute())
+    # slab 0 (tenants 0-3) restored from the newest generation, untouched
+    np.testing.assert_array_equal(restored[:4], gen0[:4])
+    # slab 1 (tenants 4-7) demoted to ITS previous good generation
+    np.testing.assert_array_equal(restored[4:], gen1[4:])
+    assert arena_mod.arena_stats()["arena_slab_demotions"] == 1
+
+
+def test_all_generations_torn_slab_resets_to_init(tmp_path):
+    path = str(tmp_path / "arena.j")
+    arena = MetricArena(mt.MeanMetric(), capacity=4, slab=4, name="dead", journal_path=path)
+    ids = arena.add(4)
+    arena.update(ids, jnp.ones((4, 1)))
+    arena.save()
+    with open(path + ".slab0", "r+b") as fh:
+        fh.seek(0)
+        fh.write(b"XXXX")  # foreign magic: the only generation is bad
+    twin = MetricArena(mt.MeanMetric(), capacity=4, slab=4, name="dead2", journal_path=path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        info = twin.restore()
+    assert info["demotions"] == 1 and info["tenants"] == 0  # slab reset to init, dead
+
+
+def test_row_lane_refuses_slab_journal(tmp_path):
+    arena = MetricArena(mt.AUROC(pos_label=1), capacity=2, slab=2, name="rowj")
+    with pytest.raises(ValueError, match="cat/list"):
+        arena.save(str(tmp_path / "x.j"))
+
+
+# --------------------------------------------------------------- env knobs
+def test_env_knobs_warn_once_naming_value(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_ARENA_SLAB", "not-a-number")
+    monkeypatch.setattr(arena_mod, "_SLAB_WARN_OWNER", arena_mod._ArenaWarnOwner())
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert arena_mod.arena_default_slab() == 256
+        assert arena_mod.arena_default_slab() == 256
+    messages = [str(w.message) for w in caught]
+    assert len(messages) == 1 and "not-a-number" in messages[0]
+    monkeypatch.setenv("METRICS_TPU_ARENA_JOURNAL_EVERY", "-3")
+    assert arena_mod.arena_journal_every() == 0  # floored, no warning (parseable)
+
+
+def test_journal_every_autosaves(tmp_path, monkeypatch):
+    path = str(tmp_path / "auto.j")
+    arena = MetricArena(
+        mt.MeanMetric(), capacity=4, slab=4, name="auto",
+        journal_path=path, journal_every=2,
+    )
+    ids = arena.add(2)
+    arena.update(ids, jnp.ones((2, 1)))
+    assert not os.path.exists(path + ".slab0")
+    arena.update(ids, jnp.ones((2, 1)))
+    assert os.path.exists(path + ".slab0")  # every-2 cadence fired
+
+
+# ------------------------------------------------- streaming / exposition
+def test_cohort_values_match_merged_oracle():
+    arena = MetricArena(mt.MeanMetric(), capacity=8, slab=8, name="cohorts")
+    eu = arena.add(2, cohort="eu")
+    us = arena.add(2, cohort="us")
+    arena.update(eu + us, jnp.asarray([[1.0], [3.0], [10.0], [30.0]]))
+    vals = arena.cohort_values()
+    assert float(np.asarray(vals["eu"])) == 2.0
+    assert float(np.asarray(vals["us"])) == 20.0
+    # count-weighted: one more update for eu tenant 0 only
+    arena.update([eu[0]], jnp.asarray([[5.0]]))
+    oracle = mt.MeanMetric()
+    oracle.update(jnp.asarray([1.0, 5.0]))
+    oracle.update(jnp.asarray([3.0]))
+    np.testing.assert_allclose(
+        float(np.asarray(arena.cohort_values()["eu"])), float(oracle.compute()), atol=1e-6
+    )
+
+
+def test_close_window_resets_and_window_values_fold():
+    arena = MetricArena(mt.SumMetric(), capacity=4, slab=4, name="win", window_slots=2)
+    ids = arena.add(2, cohort="c")
+    arena.update(ids, jnp.asarray([[1.0], [2.0]]))
+    out = arena.close_window()
+    assert out["window"] == 1
+    assert float(np.asarray(out["cohorts"]["c"])) == 3.0
+    # close resets the live tenants: next stride starts clean
+    arena.update(ids, jnp.asarray([[10.0], [20.0]]))
+    arena.close_window()
+    folded = arena.window_values()
+    assert float(np.asarray(folded["c"])) == 33.0  # both retained slots fold
+
+
+def test_decay_tick_scales_and_validates():
+    arena = MetricArena(mt.SumMetric(), capacity=2, slab=2, name="decay")
+    ids = arena.add(2)
+    arena.update(ids, jnp.asarray([[8.0], [16.0]]))
+    arena.decay_tick(1.0)  # halflife of one tick: exactly halve
+    np.testing.assert_array_equal(np.asarray(arena.compute(ids)), [4.0, 8.0])
+    acc = MetricArena(mt.Accuracy(num_classes=2), capacity=2, slab=2, name="decay-int")
+    with pytest.raises(ValueError, match="decay_tick"):
+        acc.decay_tick(4.0)
+
+
+def test_cohort_drift_and_fleet_exposition():
+    arena = MetricArena(mt.MeanMetric(), capacity=8, slab=8, name="expo")
+    a = arena.add(3, cohort="ref")
+    b = arena.add(3, cohort="cur")
+    arena.update(a + b, jnp.concatenate([jnp.ones((3, 2)), 5 * jnp.ones((3, 2))]))
+    report = arena.cohort_drift("cur", "ref")
+    assert report["psi"] > 0
+    arena.cohort_values()  # publish the cohort block
+    from metrics_tpu import streaming
+
+    assert "expo" in streaming.streaming_snapshot()["arenas"]
+    text = fleetobs.fleet_prometheus_text()
+    assert 'tenant_cohort="ref"' in text and 'tenant_cohort="cur"' in text
+    assert 'metrics_tpu_fleet_arena_tenants{name="expo"} 6' in text
+    assert 'metrics_tpu_drift_score{name="expo/cur",kind="psi"}' in text
+
+
+def test_arena_counters_fold_into_engine_stats():
+    arena = MetricArena(mt.MeanMetric(), capacity=2, slab=2, name="stats")
+    ids = arena.add(2)
+    arena.update(ids, jnp.ones((2, 1)))
+    stats = engine.engine_stats()
+    assert stats["arena_updates"] >= 1 and stats["arena_tenants_added"] >= 2
+    assert telemetry.is_counter_key("arena_updates")
+    engine.reset_stats()
+    assert engine.engine_stats()["arena_updates"] == 0
+
+
+# ------------------------------------------------------- stacking helpers
+def test_stack_unstack_roundtrip():
+    trees = [
+        {"a": jnp.asarray([float(i)]), "b": jnp.asarray(i)} for i in range(3)
+    ]
+    stacked = stack_states(trees)
+    assert stacked["a"].shape == (3, 1)
+    back = unstack_states(stacked, 3)
+    for i in range(3):
+        np.testing.assert_array_equal(np.asarray(back[i]["a"]), np.asarray(trees[i]["a"]))
+
+
+def test_bootstrapper_uses_arena_stacking(monkeypatch):
+    # the fused clone fan-out must flow through the arena's stacking helper
+    engine.reset_engine()  # drop cached fan-out programs so build() reruns
+    calls = {"n": 0}
+    real = arena_mod.stack_states
+
+    def spy(states):
+        calls["n"] += 1
+        return real(states)
+
+    monkeypatch.setattr(arena_mod, "stack_states", spy)
+    import metrics_tpu.wrappers.bootstrapping as boot
+
+    rng = np.random.RandomState(7)
+    wrapper = boot.BootStrapper(mt.MeanMetric(), num_bootstraps=4)
+    x = jnp.asarray(rng.randn(32).astype(np.float32))
+    for _ in range(4):  # build() reruns on the cold cache -> spy traces
+        wrapper.update(x)
+    assert calls["n"] >= 1, "fused fan-out no longer stacks through the arena helper"

@@ -924,6 +924,38 @@ def main() -> None:
     except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
         print(json.dumps({"metric": "window_close(streaming)", "error": str(err)[:160]}))
 
+    # arena_suites row (ISSUE 17): N concurrent suites as ONE MetricArena —
+    # arena_speedup_100k (the ratio over the per-instance loop at the 100k
+    # tier) and retraces_per_add are what sweep_regress gates round over
+    # round (a speedup collapse means the vmapped lane fell back to
+    # per-tenant dispatch; a retrace growth means the slab-bucket shape
+    # discipline broke); the tier methodology (sampled loop extrapolation,
+    # counted engine builds) lives in bench.py bench_arena_suites, reused
+    # here verbatim.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_arena_suites()
+        tiers = probe["tiers"]
+        tier_keys = sorted(tiers, key=int)
+        top = tiers[tier_keys[-1]]
+        mid = tiers[tier_keys[-2]] if len(tier_keys) > 1 else top
+        row = {
+            "metric": "arena_suites(arena)",
+            "mode": "sync",
+            "updates_per_s": top["suites_per_s"],
+            "arena_speedup_100k": mid["vs_loop"],
+            "builds_top_tier": top["builds"],
+            "retraces_per_add": probe["retraces_per_add"],
+            "slab_record_bytes": probe["slab_record_bytes"],
+            "loop_suites_per_s": probe["loop_suites_per_s"],
+            "tiers": tiers,
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "arena_suites(arena)", "error": str(err)[:160]}))
+
     # drift_report row (ISSUE 15): one PSI/KS drift computation over two
     # 4096-sample vectors — the psi/ks columns double as a determinism
     # canary (fixed seed, fixed shift: a changed score means the binning

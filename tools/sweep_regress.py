@@ -37,8 +37,10 @@ archived phase columns say so rather than guessing.
 
 Exit 1 when any metric's ratio worsened by more than ``threshold``x, a p50
 latency worsened by more than ``p50-threshold``x, a p99/p50 tail ratio grew
-by more than ``tail-threshold``x, a row's mode flipped jit->eager, or a
-previously-present metric disappeared.
+by more than ``tail-threshold``x, a row's mode flipped jit->eager, a
+previously-present metric disappeared, or a tenant-arena row fell below the
+``--arena-speedup-floor`` (default 10x over the per-instance loop at the
+100k tier) or started retracing per add (ISSUE 17).
 """
 from __future__ import annotations
 
@@ -62,6 +64,7 @@ def compare(
     wire_hidden_floor: float = 0.5,
     close_collective_ceiling: float = 1.0,
     ingraph_collective_ceiling: float = 0.0,
+    arena_speedup_floor: float = 10.0,
 ) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
@@ -165,6 +168,28 @@ def compare(
                 f"{ingraph_collective_ceiling} ceiling — the in-graph step "
                 "grew a host wire phase)"
             )
+        # ---- the tenant-arena gates (ISSUE 17): a row that archived
+        # arena_speedup_100k made the vmapped-lane promise — the 100k-tier
+        # arena must stay ≥ arena_speedup_floor x over the per-instance
+        # Python loop (a collapse means tenants fell back to per-suite
+        # dispatch), and retraces_per_add must stay under 1 (a new program
+        # per add means the slab-bucket shape discipline broke and a
+        # million tenants would mean a million compiles) ----
+        new_spd = new_row.get("arena_speedup_100k")
+        if new_spd is not None and float(new_spd) < arena_speedup_floor:
+            old_spd = old_row.get("arena_speedup_100k")
+            problems.append(
+                f"{name}: arena_speedup_100k "
+                f"{'(unrecorded)' if old_spd is None else f'{float(old_spd):.1f}'} -> "
+                f"{float(new_spd):.1f} (below the {arena_speedup_floor}x floor — the "
+                "vmapped arena lane stopped beating the per-instance loop)"
+            )
+        new_rpa = new_row.get("retraces_per_add")
+        if new_rpa is not None and float(new_rpa) >= 1.0:
+            problems.append(
+                f"{name}: retraces_per_add {float(new_rpa):.2f} (>= 1: every tenant "
+                "add now retraces — the slab-bucketed shape set broke)"
+            )
     return problems
 
 
@@ -227,7 +252,7 @@ _USAGE = (
     "usage: sweep_regress.py [--threshold X] [--p50-threshold X] "
     "[--tail-threshold X] [--wire-hidden-floor X] "
     "[--close-collective-ceiling X] [--ingraph-collective-ceiling X] "
-    "[--explain] OLD.json NEW.json"
+    "[--arena-speedup-floor X] [--explain] OLD.json NEW.json"
 )
 
 
@@ -242,7 +267,8 @@ def main(argv) -> int:
     argv, wire_floor, ok4 = _pop_flag(argv, "--wire-hidden-floor", 0.5)
     argv, close_ceiling, ok5 = _pop_flag(argv, "--close-collective-ceiling", 1.0)
     argv, ingraph_ceiling, ok6 = _pop_flag(argv, "--ingraph-collective-ceiling", 0.0)
-    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6) or len(argv) != 2:
+    argv, arena_floor, ok7 = _pop_flag(argv, "--arena-speedup-floor", 10.0)
+    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok7) or len(argv) != 2:
         print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
@@ -256,6 +282,7 @@ def main(argv) -> int:
         wire_floor,
         close_ceiling,
         ingraph_ceiling,
+        arena_floor,
     )
     if problems:
         print("\n".join(problems))
