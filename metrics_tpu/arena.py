@@ -96,6 +96,7 @@ _counters: Dict[str, int] = {
     "arena_slab_bytes_written": 0,
     "arena_slab_restores": 0,
     "arena_slab_demotions": 0,
+    "arena_slab_prunes": 0,
 }
 
 #: Live arena registry: one JSON-safe block per arena name (capacity, tenant
@@ -327,7 +328,7 @@ class MetricArena:
         self._rows: List[Optional[Dict[str, Any]]] = []  # row lane
         self._live = np.zeros((0,), dtype=bool)
         self._counts = np.zeros((0,), dtype=np.int64)
-        self._cohorts: List[Optional[str]] = []
+        self._cohorts: np.ndarray = np.empty((0,), dtype=object)
         self._free: List[int] = []  # recycled ids, descending (pop() = lowest)
         self._watermark = 0  # never-issued id frontier
         self._grow_to(self._bucket_capacity(max(int(capacity), 1)))
@@ -403,7 +404,7 @@ class MetricArena:
             self._rows.extend([None] * pad)
         self._live = np.concatenate([self._live, np.zeros(pad, dtype=bool)])
         self._counts = np.concatenate([self._counts, np.zeros(pad, dtype=np.int64)])
-        self._cohorts.extend([None] * pad)
+        self._cohorts = np.concatenate([self._cohorts, np.full(pad, None, dtype=object)])
         self._capacity = new_cap
         if old_cap:
             _counters["arena_grows"] += 1
@@ -422,7 +423,7 @@ class MetricArena:
             del self._rows[new_cap:]
         self._live = self._live[:new_cap]
         self._counts = self._counts[:new_cap]
-        del self._cohorts[new_cap:]
+        self._cohorts = self._cohorts[:new_cap]
         self._free = [i for i in self._free if i < new_cap]
         self._watermark = min(self._watermark, new_cap)
         self._capacity = new_cap
@@ -448,11 +449,12 @@ class MetricArena:
             ids.extend(range(self._watermark, needed))
             self._watermark = needed
         label = str(cohort) if cohort is not None else None
-        for tid in ids:
-            self._live[tid] = True
-            self._counts[tid] = 0
-            self._cohorts[tid] = label
-            if not self._fused:
+        idx = np.asarray(ids, dtype=np.int64)
+        self._live[idx] = True
+        self._counts[idx] = 0
+        self._cohorts[idx] = label
+        if not self._fused:
+            for tid in ids:
                 self._rows[tid] = self._fresh_row()
         _counters["arena_tenants_added"] += len(ids)
         return ids
@@ -463,10 +465,10 @@ class MetricArena:
         ids = self._as_ids(tenant_ids)
         self._check_live(ids)
         self.reset(tenant_ids=ids)
-        for tid in ids.tolist():
-            self._live[tid] = False
-            self._cohorts[tid] = None
-            if not self._fused:
+        self._live[ids] = False
+        self._cohorts[ids] = None
+        if not self._fused:
+            for tid in ids.tolist():
                 self._rows[tid] = None
         self._free = sorted(set(self._free).union(ids.tolist()), reverse=True)
         _counters["arena_tenants_removed"] += int(ids.size)
@@ -682,17 +684,24 @@ class MetricArena:
                     f"state {name.replace(_SEP, '.')} of {self._name} reduces by {spec!r}"
                 )
 
+    def _effective_cohorts(self, ids: np.ndarray) -> np.ndarray:
+        """Cohort labels for ``ids`` with unlabelled rows mapped to the
+        default cohort — one vectorized pass, no per-tenant Python loop."""
+        raw = self._cohorts[ids]
+        return np.where((raw == None) | (raw == ""), self._default_cohort, raw).astype(str)  # noqa: E711 — elementwise None test on an object array
+
     def _cohort_layout(self) -> Tuple[List[str], np.ndarray]:
         """(sorted cohort labels, per-row segment index) — dead rows land in
-        the drop segment ``len(cohorts)``."""
-        labels = sorted(
-            {self._cohorts[i] or self._default_cohort for i in np.nonzero(self._live)[0]}
-        )
-        index = {c: i for i, c in enumerate(labels)}
-        seg = np.full(self._capacity, len(labels), dtype=np.int32)
-        for tid in np.nonzero(self._live)[0]:
-            seg[tid] = index[self._cohorts[tid] or self._default_cohort]
-        return labels, seg
+        the drop segment ``len(cohorts)``. Vectorized (``np.unique`` over the
+        live rows' labels): one window close at a million tenants is numpy
+        work, not millions of interpreter iterations."""
+        live_ids = np.nonzero(self._live)[0]
+        if not live_ids.size:
+            return [], np.zeros(self._capacity, dtype=np.int32)
+        labels_arr, inverse = np.unique(self._effective_cohorts(live_ids), return_inverse=True)
+        seg = np.full(self._capacity, len(labels_arr), dtype=np.int32)
+        seg[live_ids] = inverse.astype(np.int32)
+        return labels_arr.tolist(), seg
 
     def _cohort_exe(self, num_cohorts: int) -> Any:
         flat_specs = self._flat_specs
@@ -792,10 +801,7 @@ class MetricArena:
         if labels:
             merged, vals = self._cohort_step(labels, seg)
             counts = np.zeros(len(labels), dtype=np.int64)
-            for tid in np.nonzero(self._live)[0]:
-                counts[labels.index(self._cohorts[tid] or self._default_cohort)] += int(
-                    self._counts[tid]
-                )
+            np.add.at(counts, seg[self._live], self._counts[self._live])
             for i, label in enumerate(labels):
                 slot[label] = {
                     "states": {name: np.asarray(leaf[i]) for name, leaf in merged.items()},
@@ -911,11 +917,8 @@ class MetricArena:
         )
 
     def _cohort_sample(self, cohort: str) -> np.ndarray:
-        ids = [
-            tid
-            for tid in np.nonzero(self._live)[0].tolist()
-            if (self._cohorts[tid] or self._default_cohort) == cohort
-        ]
+        live_ids = np.nonzero(self._live)[0]
+        ids = live_ids[self._effective_cohorts(live_ids) == cohort].tolist() if live_ids.size else []
         if not ids:
             raise ValueError(f"cohort {cohort!r} has no live tenants in arena {self._name!r}")
         rows: List[np.ndarray] = []
@@ -964,12 +967,48 @@ class MetricArena:
     def _slab_path(self, path: str, k: int) -> str:
         return f"{path}.slab{k}"
 
+    def _scan_generations(self) -> int:
+        # tolerate rings widened by a previous METRICS_TPU_JOURNAL_GENERATIONS
+        return _journal.journal_generations() + 8
+
+    def _slab_on_disk(self, path: str, k: int) -> bool:
+        base = self._slab_path(path, k)
+        return any(
+            os.path.exists(_journal._gen_path(base, g)) for g in range(self._scan_generations())
+        )
+
+    def _prune_stale_slabs(self, path: str) -> None:
+        """Unlink slab files (and their generation rings) beyond the current
+        slab count — after a shrink, a stale higher-numbered record must not
+        survive for :meth:`restore` to walk onto and resurrect removed
+        tenants."""
+        gens = self._scan_generations()
+        k = self.slabs
+        while True:
+            base = self._slab_path(path, k)
+            stale = [
+                _journal._gen_path(base, g)
+                for g in range(gens)
+                if os.path.exists(_journal._gen_path(base, g))
+            ]
+            if not stale:
+                return
+            for gpath in stale:
+                try:
+                    os.remove(gpath)
+                except OSError:  # pragma: no cover - best-effort cleanup; restore ignores stale slabs anyway
+                    pass
+            _counters["arena_slab_prunes"] += 1
+            k += 1
+
     def save(self, path: Optional[str] = None) -> int:
         """Persist the arena as ONE CRC-framed journal record per slab (each
         with its own atomic-write generation ring) — slab-granular
         durability: a crash tears at most the slab being written, and that
         slab demotes to its previous good generation on :meth:`restore`.
-        Returns total bytes written."""
+        Slab files beyond the current slab count (left behind by a shrink)
+        are unlinked afterwards so a later restore cannot resurrect retired
+        tenants. Returns total bytes written."""
         path = str(path) if path else self._journal_path
         if not path:
             raise ValueError("this arena was constructed without journal_path")
@@ -996,7 +1035,7 @@ class MetricArena:
                         "capacity": self._capacity,
                         "live": [int(b) for b in self._live[sl]],
                         "counts": [int(c) for c in self._counts[sl]],
-                        "cohorts": list(self._cohorts[sl.start : sl.stop]),
+                        "cohorts": self._cohorts[sl].tolist(),
                         "static_attrs": statics,
                     },
                     "epoch": _psync.world_epoch(),
@@ -1006,6 +1045,7 @@ class MetricArena:
             total += len(record)
             _counters["arena_slab_saves"] += 1
         _counters["arena_slab_bytes_written"] += total
+        self._prune_stale_slabs(path)
         self._updates_since_save = 0
         if t0 and _telemetry.armed:
             _telemetry.emit(
@@ -1034,67 +1074,113 @@ class MetricArena:
             for key, value in (statics or {}).get("", {}).items():
                 setattr(self._template, key, value)
 
+    def _check_slab_layout(self, arrays: Dict[str, np.ndarray]) -> None:
+        """A record whose state names/shapes/dtypes do not match the template
+        config must demote like any other corrupt record — name-only matching
+        would silently leave mismatched states at init values."""
+        if set(arrays) != set(self._flat_proto):
+            missing = sorted(n.replace(_SEP, ".") for n in set(self._flat_proto) - set(arrays))
+            unknown = sorted(n.replace(_SEP, ".") for n in set(arrays) - set(self._flat_proto))
+            raise ValueError(
+                f"slab record layout mismatch vs the template config "
+                f"(missing states: {missing or None}, unknown states: {unknown or None})"
+            )
+        for name, proto in self._flat_proto.items():
+            arr = arrays[name]
+            want = (self._slab,) + tuple(np.shape(proto))
+            have_dtype = np.asarray(arr).dtype
+            want_dtype = np.asarray(proto).dtype
+            if tuple(arr.shape) != want or have_dtype != want_dtype:
+                raise ValueError(
+                    f"slab record state {name.replace(_SEP, '.')} is "
+                    f"{have_dtype}{tuple(arr.shape)}, template wants {want_dtype}{want}"
+                )
+
+    def _recover_slab(
+        self, base: str, gens: int
+    ) -> Tuple[Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]], int]:
+        """Walk one slab's generation ring newest-first; return the first
+        generation that verifies (record, demotions-counted) or ``None`` if
+        every generation is torn."""
+        demotions = 0
+        for g in range(gens):
+            gpath = _journal._gen_path(base, g)
+            if not os.path.exists(gpath):
+                continue
+            try:
+                with open(gpath, "rb") as fh:
+                    data = fh.read()
+                manifest, payload = _journal.decode_record(data, origin=repr(gpath))
+                arrays = _journal.unpack_raw_record(manifest, payload)
+                meta = manifest.get("arena") or {}
+                if int(meta.get("slab_size", self._slab)) != self._slab:
+                    raise ValueError(
+                        f"slab record carries slab_size={meta.get('slab_size')}, "
+                        f"arena uses {self._slab}"
+                    )
+                self._check_slab_layout(arrays)
+            except Exception as exc:  # noqa: BLE001 — demote to the previous generation of THIS slab
+                demotions += 1
+                _counters["arena_slab_demotions"] += 1
+                _faults.note_fault(
+                    _faults.classify(exc, "journal"), site="journal-load", owner=self, error=exc
+                )
+                _faults.warn_fault(
+                    self,
+                    "journal",
+                    f"Arena slab record {gpath!r} failed verification "
+                    f"({type(exc).__name__}: {exc}); demoting to the previous good "
+                    "generation of this slab (other slabs are unaffected).",
+                )
+                continue
+            return (meta, arrays), demotions
+        return None, demotions
+
     def restore(self, path: Optional[str] = None) -> Dict[str, Any]:
         """Rebuild the stack from the per-slab records. Each slab walks its
-        generation ring newest-first: a torn or checksum-failed generation
-        classifies a ``journal`` fault, counts an ``arena_slab_demotions``
-        and demotes to the previous good generation OF THAT SLAB — other
-        slabs restore untouched. A slab with no good generation resets to
-        init (its tenants report dead). Returns ``{slabs, demotions,
-        tenants}``."""
+        generation ring newest-first: a torn, checksum-failed or
+        layout-mismatched generation classifies a ``journal`` fault, counts
+        an ``arena_slab_demotions`` and demotes to the previous good
+        generation OF THAT SLAB — other slabs restore untouched. The newest
+        good slab-0 record is AUTHORITATIVE for the arena extent (``save()``
+        rewrites every slab), so stale higher-numbered slab files — left by
+        a crash between a shrink's save and its prune, or by an older writer
+        — never resurrect removed tenants. A slab with no good generation
+        resets to init (its tenants report dead). Returns ``{slabs,
+        demotions, tenants}``."""
         path = str(path) if path else self._journal_path
         if not path:
             raise ValueError("this arena was constructed without journal_path")
+        if not self._fused:
+            raise ValueError(
+                f"arena {self._name!r} carries cat/list states; the slab byte layout "
+                "needs fixed-shape array states (restore the tenants individually)"
+            )
         t0 = _telemetry.now() if _telemetry.armed else 0.0
-        gens = _journal.journal_generations() + 8
-        recovered: Dict[int, Tuple[Dict[str, Any], Dict[str, np.ndarray]]] = {}
-        demotions = 0
-        k = 0
-        while True:
-            base = self._slab_path(path, k)
-            paths = [_journal._gen_path(base, g) for g in range(gens)]
-            if not any(os.path.exists(p) for p in paths):
-                break
-            for gpath in paths:
-                if not os.path.exists(gpath):
-                    continue
-                try:
-                    with open(gpath, "rb") as fh:
-                        data = fh.read()
-                    manifest, payload = _journal.decode_record(data, origin=repr(gpath))
-                    arrays = _journal.unpack_raw_record(manifest, payload)
-                    meta = manifest.get("arena") or {}
-                    if int(meta.get("slab_size", self._slab)) != self._slab:
-                        raise ValueError(
-                            f"slab record carries slab_size={meta.get('slab_size')}, "
-                            f"arena uses {self._slab}"
-                        )
-                except Exception as exc:  # noqa: BLE001 — demote to the previous generation of THIS slab
-                    demotions += 1
-                    _counters["arena_slab_demotions"] += 1
-                    _faults.note_fault(
-                        _faults.classify(exc, "journal"), site="journal-load", owner=self, error=exc
-                    )
-                    _faults.warn_fault(
-                        self,
-                        "journal",
-                        f"Arena slab record {gpath!r} failed verification "
-                        f"({type(exc).__name__}: {exc}); demoting to the previous good "
-                        "generation of this slab (other slabs are unaffected).",
-                    )
-                    continue
-                recovered[k] = (meta, arrays)
-                break
-            k += 1
-        slab_count = k
-        if slab_count == 0:
+        gens = self._scan_generations()
+        if not self._slab_on_disk(path, 0):
             raise _journal.JournalFault(
                 f"no arena slab records found at {path!r}", site="journal-load"
             )
-        cap = max(
-            (int(meta.get("capacity", slab_count * self._slab)) for meta, _ in recovered.values()),
-            default=slab_count * self._slab,
-        )
+        recovered: Dict[int, Tuple[Dict[str, Any], Dict[str, np.ndarray]]] = {}
+        rec0, demotions = self._recover_slab(self._slab_path(path, 0), gens)
+        if rec0 is not None:
+            recovered[0] = rec0
+            slab_count = max(1, int(rec0[0].get("capacity", self._slab)) // self._slab)
+        else:
+            # slab 0 demoted all the way out: no authoritative extent — fall
+            # back to walking the slab files upward until one is missing
+            slab_count = 1
+            while self._slab_on_disk(path, slab_count):
+                slab_count += 1
+        for k in range(1, slab_count):
+            if not self._slab_on_disk(path, k):
+                continue  # a missing slab resets to init (its tenants report dead)
+            rec, dem = self._recover_slab(self._slab_path(path, k), gens)
+            demotions += dem
+            if rec is not None:
+                recovered[k] = rec
+        cap = slab_count * self._slab
         # rebuild the stack host-side, then land it as one device tree
         S = self._slab
         host = {
@@ -1103,16 +1189,15 @@ class MetricArena:
         }
         live = np.zeros(cap, dtype=bool)
         counts = np.zeros(cap, dtype=np.int64)
-        cohorts: List[Optional[str]] = [None] * cap
+        cohorts = np.full(cap, None, dtype=object)
         for k, (meta, arrays) in recovered.items():
             sl = slice(k * S, (k + 1) * S)
             for name in host:
-                if name in arrays:
-                    host[name][sl] = arrays[name]
+                host[name][sl] = arrays[name]  # layout validated per generation
             live[sl] = np.asarray(meta.get("live", [0] * S), dtype=bool)[: S]
             counts[sl] = np.asarray(meta.get("counts", [0] * S), dtype=np.int64)[: S]
-            for i, label in enumerate((meta.get("cohorts") or [None] * S)[:S]):
-                cohorts[k * S + i] = label
+            labels = (list(meta.get("cohorts") or []) + [None] * S)[:S]
+            cohorts[sl] = np.asarray(labels, dtype=object)
             self._apply_static_attrs(meta.get("static_attrs") or {})
             _counters["arena_slab_restores"] += 1
         self._capacity = cap

@@ -269,10 +269,56 @@ def test_all_generations_torn_slab_resets_to_init(tmp_path):
     assert info["demotions"] == 1 and info["tenants"] == 0  # slab reset to init, dead
 
 
+def test_shrink_save_restore_never_resurrects_removed_tenants(tmp_path):
+    path = str(tmp_path / "arena.j")
+    arena = MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="shrink", journal_path=path)
+    ids = arena.add(8)
+    arena.update(ids, jnp.ones((8, 1)))
+    arena.save()
+    assert os.path.exists(path + ".slab1")
+    with open(path + ".slab1", "rb") as fh:
+        stale = fh.read()
+    arena.remove(ids[4:])  # trailing slab empties -> shrink to 1 slab
+    assert arena.slabs == 1
+    arena.save()
+    # save() pruned the retired slab's files...
+    assert not os.path.exists(path + ".slab1")
+    assert arena_mod.arena_stats()["arena_slab_prunes"] >= 1
+    # ...and even if a stale record survives (crash between the shrink's save
+    # and its prune, or an older writer), the newest slab-0 record's capacity
+    # is authoritative: the stale slab must not resurrect removed tenants
+    with open(path + ".slab1", "wb") as fh:
+        fh.write(stale)
+    twin = MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="shrink2", journal_path=path)
+    info = twin.restore()
+    assert info == {"slabs": 1, "demotions": 0, "tenants": 4}
+    assert twin.capacity == 4
+    np.testing.assert_array_equal(np.asarray(twin.compute()), np.asarray(arena.compute()))
+
+
+def test_template_layout_mismatch_demotes_not_silent_init(tmp_path):
+    path = str(tmp_path / "arena.j")
+    arena = MetricArena(mt.MeanMetric(), capacity=4, slab=4, name="layout", journal_path=path)
+    ids = arena.add(4)
+    arena.update(ids, jnp.ones((4, 1)))
+    arena.save()
+    # a different template config (different state names) must demote the
+    # record like any other corruption — never come back live at init values
+    twin = MetricArena(
+        mt.Accuracy(num_classes=2), capacity=4, slab=4, name="layout2", journal_path=path
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        info = twin.restore()
+    assert info["demotions"] == 1 and info["tenants"] == 0
+
+
 def test_row_lane_refuses_slab_journal(tmp_path):
     arena = MetricArena(mt.AUROC(pos_label=1), capacity=2, slab=2, name="rowj")
     with pytest.raises(ValueError, match="cat/list"):
         arena.save(str(tmp_path / "x.j"))
+    with pytest.raises(ValueError, match="cat/list"):
+        arena.restore(str(tmp_path / "x.j"))
 
 
 # --------------------------------------------------------------- env knobs
