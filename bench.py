@@ -1542,6 +1542,116 @@ def bench_arena_suites() -> dict:
     return out
 
 
+def bench_cold_start() -> dict:
+    """``cold_start``: fleet replica replacement (ISSUE 18) — first-result
+    latency and compiles-per-boot for a fresh engine, cold (empty store)
+    vs warmed (persistent progcache populated, ``precompile()`` on boot).
+    The cold boot traces and compiles every update/flush/compute program
+    and exports each into the on-disk store; the warmed boot replays the
+    identical traffic with the store attached and must serve EVERY program
+    from disk: ``warm_boot_compiles`` is the number it compiled anyway —
+    ``tools/sweep_regress.py`` gates it at ``--warm-boot-compile-ceiling``
+    (default 0.0). ``replacement_wall_ms`` prices the whole warmed boot
+    (construct + precompile + first traffic to a synced result) — the wall
+    a rolling restart pays per replica. In-process boots (fresh engine,
+    fresh module instances, fresh jit twins) isolate compile-vs-load; the
+    true two-process certification lives in ``make dryrun``
+    (``cold_start_certification``)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MeanMetric, MetricCollection
+    from metrics_tpu.ops import engine, progcache
+    from metrics_tpu.utils.checks import set_validation_mode
+
+    set_validation_mode("first")
+    rng = np.random.RandomState(23)
+    batches = [
+        (
+            jnp.asarray(rng.randint(0, 2, (64,)).astype(np.int32)),
+            jnp.asarray(rng.randint(0, 2, (64,)).astype(np.int32)),
+        )
+        for _ in range(8)
+    ]
+
+    def make_suite():
+        return MetricCollection(
+            {"acc": Accuracy(num_classes=2), "mean": MeanMetric()}
+        )
+
+    def boot(warmed: bool) -> dict:
+        engine.reset_engine()
+        engine.reset_stats(reset_warnings=True)
+        t_boot = time.perf_counter()
+        suite = make_suite()
+        if warmed:
+            sds = jax.ShapeDtypeStruct((64,), jnp.int32)
+            suite.precompile(sds, sds, defer_chunks=4, forward=False)
+        t0 = time.perf_counter()
+        suite.update(*batches[0])
+        suite.update(*batches[1])
+        first = suite.compute()
+        jax.block_until_ready(jax.tree.leaves(first))
+        first_result_ms = (time.perf_counter() - t0) * 1e3
+        # the remaining traffic walks the same pow2 flush ladder the warmed
+        # boot's precompile(defer_chunks=4) drives — chunk sets {1, 2, 4} —
+        # so the cold boot stores exactly the programs a warmed boot needs
+        for i, stop in ((2, 4), (4, 8)):
+            for b in batches[i:stop]:
+                suite.update(*b)
+            final = suite.compute()
+        jax.block_until_ready(jax.tree.leaves(final))
+        wall_ms = (time.perf_counter() - t_boot) * 1e3
+        stats = progcache.progcache_stats()
+        return {
+            "first_result_ms": first_result_ms,
+            "wall_ms": wall_ms,
+            "compiles": int(engine.program_summary()["compiles"]),
+            "hits": int(stats["progcache_hits"]),
+            "stores": int(stats["progcache_stores"]),
+            "bytes": int(stats["progcache_bytes_stored"]),
+        }
+
+    store = tempfile.mkdtemp(prefix="metrics_tpu_coldstart_")
+    try:
+        progcache.configure(reset=True)
+        progcache.configure(enabled=True, cache_dir=store)
+        cold = boot(warmed=False)
+        warm = boot(warmed=True)
+    finally:
+        progcache.configure(reset=True)
+        engine.reset_engine()
+        engine.reset_stats(reset_warnings=True)
+        shutil.rmtree(store, ignore_errors=True)
+        try:  # the store pointed JAX's own cache under it; point it back
+            jax.config.update(
+                "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+            )
+        except Exception:  # noqa: BLE001 — older jax without the knob
+            pass
+
+    speedup = (
+        round(cold["first_result_ms"] / warm["first_result_ms"], 2)
+        if warm["first_result_ms"] > 0
+        else 0.0
+    )
+    return {
+        "cold_first_result_ms": round(cold["first_result_ms"], 3),
+        "warm_first_result_ms": round(warm["first_result_ms"], 3),
+        "first_result_speedup": speedup,
+        "cold_compiles": cold["compiles"],
+        "cold_stores": cold["stores"],
+        "store_bytes": cold["bytes"],
+        "warm_boot_compiles": warm["compiles"],
+        "warm_hits": warm["hits"],
+        "replacement_wall_ms": round(warm["wall_ms"], 3),
+        "cold_wall_ms": round(cold["wall_ms"], 3),
+    }
+
+
 def bench_ingraph_step() -> dict:
     """``ingraph_step``: the functional-core whole-suite step — ONE jitted,
     donated ``apply_update`` program over an epoch-stamped ``FuncState``
@@ -1705,6 +1815,10 @@ def main() -> None:
     # scales out (ISSUE 17): same pure kernels, but N suites share ONE
     # vmapped donated program instead of N dispatch loops
     arena_probe = bench_arena_suites()
+    # the cold-start probe rides AFTER the arena row and resets the engine
+    # around itself (each boot must start with a cold program registry —
+    # that is the thing being measured); rows before it keep their regime
+    cold_start_probe = bench_cold_start()
     boot_floor = bench_bootstrap_shaped_floor()
     ours_overhead_batched = bench_overhead_batched_ours()
     ref_overhead = _safe(bench_overhead_reference)
@@ -2144,6 +2258,30 @@ def main() -> None:
                 "dispatch, the arena pays one dispatch per pow2 chunk — "
                 "compile count stays bounded by the slab-bucket set at any "
                 "tenant count (docs/performance.md Tenant arenas)"
+            ),
+        },
+        "cold_start": {
+            # ISSUE 18: replica-replacement cost with the persistent
+            # program cache. warm_boot_compiles is the fleet promise —
+            # sweep_regress gates it at --warm-boot-compile-ceiling
+            # (default 0.0: a warmed replica compiles NOTHING).
+            "cold_first_result_ms": cold_start_probe["cold_first_result_ms"],
+            "warm_first_result_ms": cold_start_probe["warm_first_result_ms"],
+            "first_result_speedup": cold_start_probe["first_result_speedup"],
+            "cold_compiles": cold_start_probe["cold_compiles"],
+            "cold_stores": cold_start_probe["cold_stores"],
+            "store_bytes": cold_start_probe["store_bytes"],
+            "warm_boot_compiles": cold_start_probe["warm_boot_compiles"],
+            "warm_hits": cold_start_probe["warm_hits"],
+            "replacement_wall_ms": cold_start_probe["replacement_wall_ms"],
+            "cold_wall_ms": cold_start_probe["cold_wall_ms"],
+            "unit": "ms to first synced compute result (fresh engine boot)",
+            "note": (
+                "cold boot traces+compiles+stores every program; warmed "
+                "boot precompile()s from the on-disk store and serves "
+                "first traffic with zero fresh compiles — the two-process "
+                "certification (corrupt-entry demotion included) runs in "
+                "make dryrun (docs/performance.md Cold start cost model)"
             ),
         },
         "drift_report": {

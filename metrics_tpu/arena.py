@@ -656,6 +656,82 @@ class MetricArena:
 
         return _engine.acquire_keyed(("arena-reset", self._key, self._capacity), build)
 
+    def precompile(self, *args: Any, batch: Optional[int] = None, **kwargs: Any) -> Dict[str, Any]:
+        """AOT-warm the arena's gather → ``vmap(update)`` → scatter, fused
+        compute and mask-reset programs for the current capacity — without
+        touching a single tenant's state (everything lowers from
+        :class:`jax.ShapeDtypeStruct` declarations, so no example data is
+        dispatched and nothing needs rolling back).
+
+        ``args``/``kwargs`` mirror one :meth:`update` call's batch: leaves
+        (arrays or ``ShapeDtypeStruct``) carry a leading tenant axis. The
+        update program is warmed for every ``pow2_chunks`` bucket of that
+        batch size (``batch`` overrides it; defaults to one slab), exactly
+        the shape set live ragged traffic dispatches. With the persistent
+        program cache enabled, warmed programs load from (or store to) the
+        on-disk tier — :attr:`~metrics_tpu.ops.engine.Executable.cache_source`
+        per program lands in the returned ``sources`` map alongside the
+        ``compiles`` / ``progcache_hits`` / ``progcache_stores`` deltas.
+
+        The row lane (``cat``-state suites) dispatches per-tenant eager
+        kernels, not engine-cached arena programs — it reports ``skipped``."""
+        before = _engine.program_summary()
+        stats0 = _engine.engine_stats()
+
+        def _report(sources: Dict[str, str], skipped: Optional[str] = None) -> Dict[str, Any]:
+            after = _engine.program_summary()
+            stats1 = _engine.engine_stats()
+            out = {
+                "programs": after["count"] - before["count"],
+                "compiles": after["compiles"] - before["compiles"],
+                "progcache_hits": int(stats1.get("progcache_hits", 0))
+                - int(stats0.get("progcache_hits", 0)),
+                "progcache_stores": int(stats1.get("progcache_stores", 0))
+                - int(stats0.get("progcache_stores", 0)),
+                "sources": sources,
+            }
+            if skipped:
+                out["skipped"] = skipped
+            return out
+
+        if not self._fused:
+            return _report(
+                {}, "row lane (cat-state suites) dispatches eager per-tenant kernels"
+            )
+        if self._stacked is None:
+            return _report({}, "no capacity reserved yet — add a tenant first")
+        state_s = {
+            k: jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+            for k, leaf in self._stacked.items()
+        }
+        if batch is not None:
+            n = int(batch)
+        else:
+            dims = [
+                int(tuple(leaf.shape)[0])
+                for leaf in jax.tree.leaves((args, kwargs))
+                if hasattr(leaf, "shape") and len(tuple(leaf.shape)) >= 1
+            ]
+            n = dims[0] if dims else self._slab
+        sources: Dict[str, str] = {}
+        for c in sorted(set(_engine.pow2_chunks(n))):
+
+            def _chunk(leaf: Any, c: int = c) -> Any:
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    return jax.ShapeDtypeStruct((c,) + tuple(leaf.shape)[1:], leaf.dtype)
+                return leaf
+
+            a_s, k_s = jax.tree.map(_chunk, (args, kwargs))
+            ids_s = jax.ShapeDtypeStruct((c,), jnp.int32)
+            sources[f"arena-update/{c}"] = self._update_exe(c).precompile(
+                state_s, (ids_s, a_s, k_s)
+            )
+        sources["arena-compute"] = self._compute_exe().precompile(state_s)
+        sources["arena-reset"] = self._reset_exe().precompile(
+            state_s, (jax.ShapeDtypeStruct((self._capacity,), jnp.bool_),)
+        )
+        return _report(sources)
+
     # ------------------------------------------------------ per-tenant states
     def tenant_state(self, tenant_id: int) -> Dict[str, Any]:
         """One tenant's functional state tree (a view of the stack) — the

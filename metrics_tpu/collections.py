@@ -1756,6 +1756,7 @@ class MetricCollection:
         path: str,
         handoff: Optional[Any] = None,
         rank: Optional[int] = None,
+        warm: bool = True,
     ) -> Dict[str, Any]:
         """Re-enter the world after a restart, without corrupting a single
         collective.
@@ -1775,8 +1776,18 @@ class MetricCollection:
            clears this rank's dead mark and bumps the world epoch, so every
            stale in-flight protocol fences and the surviving quorum's
            recovery edge re-probes the full world on its next compute.
+        4. **Warm the programs** (``warm=True`` and the persistent program
+           cache enabled): :func:`~metrics_tpu.ops.engine.warm_programs`
+           rehydrates every stored executable signature for the programs
+           this process has acquired — including the unpack/restore programs
+           the journal restore itself just acquired — so the first
+           post-rejoin compute serves without a recompile stall. Pair with
+           :meth:`precompile` *before* ``rejoin`` on a truly fresh process to
+           acquire the update/compute programs themselves from the
+           persistent tier.
 
-        Returns ``{generation, epoch, handoff, restored_step, rank}``.
+        Returns ``{generation, epoch, handoff, restored_step, rank,
+        warmed_programs}``.
         """
         from metrics_tpu.ops import journal as _journal
 
@@ -1826,6 +1837,12 @@ class MetricCollection:
         lad = self.__dict__.get("_fault_ladders", {}).get("sync-degrade")
         if lad is not None and lad.demoted:
             lad.promote()
+        warmed = 0
+        if warm:
+            from metrics_tpu.ops import progcache as _progcache
+
+            if _progcache.enabled():
+                warmed = _engine.warm_programs()
         if t0 and _telemetry.armed:
             _telemetry.emit(
                 "rank-rejoin", self, "sync", t0, _telemetry.now() - t0,
@@ -1835,6 +1852,7 @@ class MetricCollection:
                     "generation": gen,
                     "handoff": handoff_used,
                     "restored_step": _stamp(meta),
+                    "warmed_programs": warmed,
                 },
             )
         return {
@@ -1843,6 +1861,147 @@ class MetricCollection:
             "handoff": handoff_used,
             "restored_step": _stamp(meta),
             "rank": int(live_rank),
+            "warmed_programs": warmed,
+        }
+
+    def precompile(
+        self,
+        *args: Any,
+        defer_chunks: Optional[int] = None,
+        forward: bool = True,
+        compute: bool = True,
+        sync: bool = False,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """AOT-warm every program this suite will dispatch for the declared
+        batch shapes, then roll the accumulator state back — so a fresh
+        process pays its compiles (or persistent program-cache loads) up
+        front instead of stalling the first serving step.
+
+        ``args``/``kwargs`` mirror one :meth:`update` call; leaves may be
+        real arrays **or** :class:`jax.ShapeDtypeStruct` declarations —
+        either way the warmup drives zero-filled example batches through the
+        *real* update / deferred-flush / forward / compute paths (the only
+        way every program key, layout probe and compute-group coalescing
+        decision matches live traffic exactly). Member state is deep-copied
+        before the warmup and restored after it — donation invalidates the
+        original buffers, so snapshots hold fresh copies, never references.
+
+        The fused one-program paths require validation mode ``"first"`` or
+        ``"off"`` (``METRICS_TPU_VALIDATION``); under the default ``"full"``
+        mode every call is eager and there is nothing to precompile.
+
+        Args:
+            defer_chunks: with deferred dispatch on, live queues flush as
+                stacked scan programs whose shapes are the power-of-two
+                chunk lengths up to this bound — the warmup drives a flush
+                at every pow2 length ``1, 2, 4, … defer_chunks`` so however
+                raggedly live observations land mid-queue, every chunk
+                shape is already compiled. Defaults to the auto-flush
+                threshold (:func:`~metrics_tpu.ops.engine.defer_max_pending`);
+                pass ``0`` to warm only the per-call programs.
+            forward: also drive :meth:`forward` (warms the fused forward
+                program and its deferred chunk ladder; batch values are
+                discarded).
+            compute: also drive :meth:`compute` (failures are swallowed —
+                a compute that divides by an all-zero count must not abort
+                the warmup; state is rolled back regardless).
+            sync: also enter/exit a sync context to warm the sync-pack /
+                unpack programs. **Collective** — every rank must call
+                ``precompile(sync=True)`` together; default off.
+
+        With the persistent program cache enabled
+        (``METRICS_TPU_PROGCACHE=1``), freshly traced programs are stored
+        as they compile and previously stored ones load instead of
+        compiling — the report's ``compiles`` / ``progcache_hits`` deltas
+        certify which happened. Returns ``{steps, compiles, progcache_hits,
+        progcache_stores, programs}``."""
+
+        def _zeros(leaf: Any) -> Any:
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                # fresh zeros even for real arrays: warmup must never donate
+                # a buffer the caller still holds
+                return jnp.zeros(tuple(leaf.shape), leaf.dtype)
+            return leaf
+
+        ex_args = jax.tree.map(_zeros, args)
+        ex_kwargs = jax.tree.map(_zeros, kwargs)
+        members = list(self.items(keep_base=True, copy_state=False))
+        snap = {}
+        for name, m in members:
+            states = {
+                s: jax.tree.map(
+                    lambda leaf: leaf.copy() if hasattr(leaf, "copy") else leaf,
+                    getattr(m, s),
+                )
+                for s in m._defaults
+            }
+            snap[name] = (states, m._update_count, m._computed)
+        before = _engine.program_summary()
+        stats0 = _engine.engine_stats()
+        owners = (self,) + tuple(m for _, m in members)
+        cap = int(defer_chunks) if defer_chunks is not None else _engine.defer_max_pending()
+        if not _engine.defer_enabled():
+            cap = 0  # per-call dispatch only: no scan chunk shapes exist
+        steps_driven = 0
+        try:
+            # first call per signature is eager (validated) and licenses the
+            # fused program; the second exercises the steady-state dispatch
+            for _ in range(2):
+                self.update(*ex_args, **ex_kwargs)
+                steps_driven += 1
+            _engine.flush_barrier(owners)
+            # deferred chunk ladder: one flush per pow2 queue length, so
+            # every scan chunk shape a ragged live queue can decompose into
+            # (pow2_chunks) is compiled before traffic arrives
+            c = 1
+            while c <= cap:
+                for _ in range(c):
+                    self.update(*ex_args, **ex_kwargs)
+                    steps_driven += 1
+                _engine.flush_barrier(owners)
+                c <<= 1
+            if forward:
+                try:
+                    for _ in range(2):
+                        self.forward(*ex_args, **ex_kwargs)
+                        steps_driven += 1
+                    _engine.flush_barrier(owners)
+                    c = 1
+                    while c <= cap:
+                        for _ in range(c):
+                            self.forward(*ex_args, **ex_kwargs)
+                            steps_driven += 1
+                        _engine.flush_barrier(owners)
+                        c <<= 1
+                except Exception:  # noqa: BLE001 — warmup is best-effort
+                    pass
+            if compute:
+                try:
+                    self.compute()
+                except Exception:  # noqa: BLE001 — zero-filled state may
+                    pass  # legitimately reject compute (empty-state guards)
+            if sync:
+                with self.sync_context():
+                    pass
+        finally:
+            for name, m in members:
+                states, cnt, computed = snap[name]
+                for s, v in states.items():
+                    object.__setattr__(m, s, v)
+                object.__setattr__(m, "_update_count", cnt)
+                object.__setattr__(m, "_computed", computed)
+            self._repoint_groups()
+        after = _engine.program_summary()
+        stats1 = _engine.engine_stats()
+        return {
+            "steps": steps_driven,
+            "compiles": after["compiles"] - before["compiles"],
+            "progcache_hits": int(stats1.get("progcache_hits", 0))
+            - int(stats0.get("progcache_hits", 0)),
+            "progcache_stores": int(stats1.get("progcache_stores", 0))
+            - int(stats0.get("progcache_stores", 0)),
+            "programs": after["count"] - before["count"],
         }
 
     # ---------------------------------------------------- functional export

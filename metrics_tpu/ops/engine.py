@@ -62,6 +62,7 @@ per-call fused dispatch behavior exactly.
 """
 from __future__ import annotations
 
+import enum
 import hashlib
 import os
 import time
@@ -72,6 +73,7 @@ import jax
 import numpy as np
 
 from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import progcache as _progcache
 from metrics_tpu.ops import telemetry as _telemetry
 
 __all__ = [
@@ -99,6 +101,7 @@ __all__ = [
     "set_roofline_peaks",
     "state_donatable",
     "state_intact",
+    "warm_programs",
 ]
 
 
@@ -229,6 +232,14 @@ def _value_digest(value: Any, depth: int = 0) -> Any:
     program baking the wrong constants. Arrays digest by full content hash;
     containers recurse (bounded); everything else falls back to repr.
     """
+    if isinstance(value, enum.Enum):
+        # a journal manifest rehydrates enum-valued hyperparameters as their
+        # plain values (the wire format has no enum type), and EnumStr
+        # compares equal to its value — the string-moded restored instance
+        # traces the SAME program the enum-moded one did. Digest both forms
+        # identically, or a rejoin re-enters the epoch with every program
+        # key cold and the first post-restore step recompiles needlessly.
+        return _value_digest(value.value, depth)
     if isinstance(value, (jax.Array, np.ndarray, np.generic)) and not isinstance(
         value, jax.core.Tracer
     ):
@@ -283,6 +294,32 @@ def config_fingerprint(metric: Any) -> tuple:
 
 
 # --------------------------------------------------------------- program cache
+#: AOT-lane sentinels: ``_AOT_MISS`` is the consult's "fall through to the
+#: jit twins" result; ``_JIT_TWIN`` marks a signature as deliberately served
+#: by the twins (fresh-compiled here, or demoted), so later dispatches skip
+#: the store probe.
+_AOT_MISS = object()
+_JIT_TWIN = object()
+
+
+def _counters_progcache_fallback(exe: "Executable", err: BaseException) -> None:
+    """A rehydrated/AOT program failed AT EXECUTION (exact-aval mismatch or
+    a bad module): classify, count a demotion, warn once per kind — the
+    signature falls back to the jit twin permanently for this process."""
+    from metrics_tpu.ops import progcache as _pc
+
+    _pc._counters["progcache_demotions"] += 1
+    domain = _faults.classify(err, "runtime")
+    _faults.note_fault(domain, site="progcache-load", owner=exe, error=err)
+    _faults.warn_fault(
+        exe,
+        domain,
+        f"progcache AOT program for kind {exe.kind!r} failed at execution "
+        f"({type(err).__name__}: {err}); this signature serves from a fresh "
+        "compile — results are unaffected.",
+    )
+
+
 class Executable:
     """A cached fused program: donated fast path plus its plain twin.
 
@@ -301,6 +338,16 @@ class Executable:
     retained so :func:`program_report` can attach XLA ``cost_analysis()`` /
     ``memory_analysis()`` on demand (an AOT re-lower of the plain twin —
     paid only when a report is actually requested, never on the hot path).
+
+    With the persistent program cache enabled
+    (:mod:`metrics_tpu.ops.progcache`), each executable also carries an
+    **AOT lane**: per ``(donated, signature-digest)`` compiled callables
+    rehydrated from exported modules (persistent-tier hits) or built ahead
+    of traffic (:meth:`precompile`). ``_dispatch`` consults the lane before
+    the jit twins, so a warmed boot dispatches without a single trace or
+    XLA compile; their first-call wall is attributed to
+    ``cache_load_time_s`` (not ``compile_time_s``) and the row's
+    ``cache_source`` reports ``fresh`` / ``persistent`` / ``aot``.
     """
 
     __slots__ = (
@@ -316,6 +363,10 @@ class Executable:
         "plain_runs",
         "compiles",
         "compile_time_s",
+        "cache_load_time_s",
+        "cache_source",
+        "aot",
+        "pc_sigs",
         "dispatch_time_s",
         "arg_structs",
         "analysis",
@@ -336,6 +387,13 @@ class Executable:
         self.plain_runs = 0
         self.compiles = 0
         self.compile_time_s = 0.0
+        self.cache_load_time_s = 0.0
+        self.cache_source = "fresh"
+        # the AOT lane: {(donated, sig): compiled | _JIT_TWIN} — None until
+        # the persistent cache is enabled/attached, so the disabled dispatch
+        # path pays exactly one `is not None` check
+        self.aot: Optional[Dict[Tuple[bool, str], Any]] = None
+        self.pc_sigs: Optional[set] = None
         self.dispatch_time_s = 0.0
         self.arg_structs: Optional[tuple] = None
         self.analysis: Optional[Dict[str, Any]] = None
@@ -362,17 +420,177 @@ class Executable:
         except Exception:  # noqa: BLE001 — the ledger never breaks a dispatch
             pass
 
+    def _attach_cache_lane(self) -> None:
+        """Arm the AOT lane (idempotent): index which signatures the
+        persistent store holds for this program identity."""
+        if self.aot is None:
+            self.aot = {}
+            self.pc_sigs = set(_progcache.stored_sigs(self.kind, self.key_digest))
+
+    def _lanes(self) -> Tuple[bool, ...]:
+        if self.donated is not None and donation_supported():
+            return (False, True)
+        return (False,)
+
+    def _install_loaded(self, donated: bool, sig: str, compiled: Any, load_dur: float) -> None:
+        self.aot[(donated, sig)] = compiled
+        self.cache_load_time_s += load_dur
+        if self.cache_source == "fresh":
+            self.cache_source = "persistent"
+
+    def _dispatch_cached(
+        self, donated: bool, state: Any, args: tuple, kwargs: dict, t0: float, record_span: bool
+    ) -> Any:
+        """The AOT-lane consult: serve this call from a rehydrated or
+        precompiled executable when one exists for its signature, returning
+        ``_AOT_MISS`` to fall through to the jit twins otherwise. Loads
+        demote classified on any defect — a suspect entry is never run."""
+        try:
+            sig = _progcache.signature_digest(state, args, kwargs)
+        except Exception:  # noqa: BLE001 — undigestable call: jit twin serves
+            return _AOT_MISS
+        cached = self.aot.get((donated, sig))
+        if cached is None:
+            if self.pc_sigs and sig in self.pc_sigs:
+                loaded = _progcache.load_program(
+                    self.kind, self.key_digest, sig,
+                    donate=donated, state=state, args=args, kwargs=kwargs,
+                )
+                if loaded is None:
+                    self.pc_sigs.discard(sig)
+                    self.aot[(donated, sig)] = _JIT_TWIN
+                    return _AOT_MISS
+                compiled, load_dur = loaded
+                self._install_loaded(donated, sig, compiled, load_dur)
+                self._capture_structs(state, args, kwargs)
+                cached = compiled
+            else:
+                # first sight, nothing stored: mark the signature as served
+                # by the jit twin so later dispatches skip the store probe
+                # (the fresh-compile branch counts the miss exactly once)
+                self.aot[(donated, sig)] = _JIT_TWIN
+                return _AOT_MISS
+        elif cached is _JIT_TWIN:
+            return _AOT_MISS
+        try:
+            out = cached(state, *args, **kwargs)
+        except Exception as err:  # noqa: BLE001 — exact-aval mismatch or a
+            # failed rehydrated program: demote THIS signature to the jit
+            # twin (never a wrong program). If the donated attempt consumed
+            # buffers the twin raises too and the caller's ladder handles it.
+            self.aot[(donated, sig)] = _JIT_TWIN
+            _counters_progcache_fallback(self, err)
+            return _AOT_MISS
+        if donated:
+            self.donated_runs += 1
+        else:
+            self.plain_runs += 1
+        host_dur = time.perf_counter() - t0
+        self.dispatch_time_s += host_dur
+        if record_span and _telemetry.armed:
+            _telemetry.emit(
+                "engine-dispatch", self.kind, "engine", t0, host_dur,
+                {"async_host_wall": True, "cache_source": self.cache_source},
+            )
+        return out
+
+    def precompile(self, state: Any, args: tuple = (), kwargs: Optional[dict] = None) -> str:
+        """AOT-compile this program for ONE declared abstract signature
+        before traffic arrives: persistent tier first (rehydrate a stored
+        entry), else export + ``.lower(...).compile()`` fresh and persist
+        the entry. ``state``/``args``/``kwargs`` may be concrete arrays or
+        ``ShapeDtypeStruct`` declarations. Returns where the program came
+        from: ``"cached"`` (lane already warm), ``"persistent"``,
+        ``"aot"``, or ``"unsupported"`` (unexportable kind — it compiles
+        lazily at first dispatch instead)."""
+        kwargs = kwargs or {}
+        self._attach_cache_lane()
+        sig = _progcache.signature_digest(state, args, kwargs)
+        missing = [d for d in self._lanes() if not callable(self.aot.get((d, sig)))]
+        if not missing:
+            return "cached"
+        if self.pc_sigs and sig in self.pc_sigs:
+            for d in list(missing):
+                loaded = _progcache.load_program(
+                    self.kind, self.key_digest, sig,
+                    donate=d, state=state, args=args, kwargs=kwargs,
+                )
+                if loaded is None:
+                    self.pc_sigs.discard(sig)
+                    break
+                self._install_loaded(d, sig, loaded[0], loaded[1])
+                missing.remove(d)
+            if not missing:
+                self._capture_structs(state, args, kwargs)
+                return "persistent"
+        built = _progcache.build_aot(
+            self.kind, self.key_digest, self.plain,
+            lanes=tuple(missing), state=state, args=args, kwargs=kwargs,
+        )
+        if built is None:
+            for d in missing:
+                self.aot.setdefault((d, sig), _JIT_TWIN)
+            return "unsupported"
+        compiled_by_lane, dur, _sig = built
+        for d, compiled in compiled_by_lane.items():
+            self.aot[(d, sig)] = compiled
+        # an AOT build is real compile wall (trace + export + wrapper XLA),
+        # paid at boot instead of first dispatch — attributed as compile
+        # cost, NOT cache-load cost
+        self.compile_time_s += dur
+        self.cache_source = "aot"
+        if self.pc_sigs is not None:
+            self.pc_sigs.add(sig)
+        self._capture_structs(state, args, kwargs)
+        return "aot"
+
+    def warm_from_store(self) -> int:
+        """Eagerly rehydrate EVERY signature the persistent store holds for
+        this program (both donation lanes), deriving lowering avals from
+        each exported module itself — the rejoin/rolling-restart path,
+        where cached executables must be live before the first post-rejoin
+        dispatch. Returns the number of compiled callables installed."""
+        if not _progcache.enabled():
+            return 0
+        self._attach_cache_lane()
+        loaded = 0
+        for sig in sorted(self.pc_sigs or ()):
+            for d in self._lanes():
+                if callable(self.aot.get((d, sig))):
+                    continue
+                got = _progcache.load_program(self.kind, self.key_digest, sig, donate=d)
+                if got is None:
+                    self.pc_sigs.discard(sig)
+                    break
+                self._install_loaded(d, sig, got[0], got[1])
+                loaded += 1
+        return loaded
+
     def _dispatch(
         self, fn: Callable, donated: bool, state: Any, args: tuple, kwargs: dict, record_span: bool = True
     ) -> Any:
-        if not _telemetry.armed or not jax.core.trace_state_clean():
-            # disarmed (METRICS_TPU_TELEMETRY=0): the documented contract is
-            # ONE predicate on the dispatch path — no clocks, no cache-size
+        if not _telemetry.armed and self.aot is None:
+            # disarmed (METRICS_TPU_TELEMETRY=0) with no persistent
+            # program-cache lane: the documented contract is ONE compound
+            # predicate on the dispatch path — no clocks, no cache-size
             # probes, no tallies (ledger capture is part of the recorder).
-            # Abstract tracing (eval_shape probes, nested traces) likewise
-            # never dispatches: the ledger counts real executions only.
+            # An attached cache lane overrides disarm: serving a stored
+            # program instead of recompiling NEEDS the consult + the compile
+            # tallies (zero-compile certification counts them), so progcache
+            # buys its ledger even when the span recorder is off.
+            return fn(state, *args, **kwargs)
+        if not jax.core.trace_state_clean():
+            # abstract tracing (eval_shape probes, nested traces) never
+            # dispatches: the ledger counts real executions only.
             return fn(state, *args, **kwargs)
         t0 = time.perf_counter()
+        if self.aot is not None:
+            # persistent/AOT lane active: consult it BEFORE the jit twins, so
+            # a stored signature never traces (a would-be jit-cache miss is
+            # resolved from the rehydrated exported module instead)
+            out = self._dispatch_cached(donated, state, args, kwargs, t0, record_span)
+            if out is not _AOT_MISS:
+                return out
         size_fn = getattr(fn, "_cache_size", None)
         before = size_fn() if size_fn is not None else -1
         out = fn(state, *args, **kwargs)
@@ -383,14 +601,26 @@ class Executable:
             self.plain_runs += 1
         if compiled:
             # this call traced+compiled a new aval signature: a ledger
-            # compile event (its wall time IS the cold-start cost the
-            # persistent-AOT-cache roadmap item needs attributed per program)
+            # compile event. First-call wall lands in compile_time_s ONLY
+            # here — persistent-tier rehydrations attribute theirs to
+            # cache_load_time_s in _dispatch_cached, so a warmed boot's
+            # ledger no longer overstates compile cost
             dur = time.perf_counter() - t0
             self.compiles += 1
             self.compile_time_s += dur
             self._capture_structs(state, args, kwargs)
             if _telemetry.armed:
                 _telemetry.emit("engine-compile", self.kind, "engine", t0, dur, {"donated": donated})
+            if self.aot is not None:
+                # cache was consulted and had nothing usable: a miss. Export
+                # + persist the fresh program so the NEXT process skips this
+                # compile (classified + warn-once internally, never raises)
+                _progcache.note_miss()
+                sig = _progcache.store_program(
+                    self.kind, self.key_digest, self.plain, state, args, kwargs
+                )
+                if sig is not None and self.pc_sigs is not None:
+                    self.pc_sigs.add(sig)
         else:
             host_dur = time.perf_counter() - t0
             self.dispatch_time_s += host_dur
@@ -525,6 +755,11 @@ def acquire_keyed(
     # the per-program device-histogram identity: kind alone collides (every
     # same-kind config shares it), so the cache-key digest disambiguates
     exe.probe_key = f"{exe.kind}:{exe.key_digest[:8]}"
+    if _progcache.enabled():
+        # arm the persistent/AOT lane: index which signatures the on-disk
+        # store already holds for this (kind, fingerprint) identity, so the
+        # first dispatch of a stored signature rehydrates instead of tracing
+        exe._attach_cache_lane()
     if _telemetry.armed:
         _telemetry.emit(
             "engine-build", exe.kind, "engine", t0, time.perf_counter() - t0, {"key": exe.key_digest}
@@ -533,6 +768,22 @@ def acquire_keyed(
     while len(_PROGRAM_CACHE) > _CACHE_CAP:
         _PROGRAM_CACHE.popitem(last=False)
     return exe
+
+
+def warm_programs() -> int:
+    """Rehydrate every persistent-store signature for every cached program
+    into its AOT lane — the rolling-restart warm-boot step: acquire your
+    suite's programs (``MetricCollection.precompile`` drives the real call
+    paths), then ``warm_programs()`` turns each stored signature into a
+    live compiled callable before traffic or a post-``rejoin`` compute can
+    stall on it. No-op (returning 0) while the persistent cache is
+    disabled. Returns the number of compiled callables installed."""
+    if not _progcache.enabled():
+        return 0
+    loaded = 0
+    for exe in list(_PROGRAM_CACHE.values()):
+        loaded += exe.warm_from_store()
+    return loaded
 
 
 def engine_stats() -> Dict[str, Any]:
@@ -558,7 +809,10 @@ def engine_stats() -> Dict[str, Any]:
     decay ticks, drift reports) — and the tenant-arena counters from
     :mod:`metrics_tpu.arena` (``arena_*``: tenant lifecycle, vmapped
     update/compute/reset program traffic, slab-journal saves, bytes and
-    demotions). ``telemetry.snapshot()`` is the superset
+    demotions) — and the persistent program cache counters from
+    :mod:`metrics_tpu.ops.progcache` (``progcache_*``: entry hits, misses,
+    stores/bytes, classified demotions, size-cap evictions).
+    ``telemetry.snapshot()`` is the superset
     surface that adds the span-recorder counters and the program-ledger
     summary on top."""
     out: Dict[str, Any] = {
@@ -598,6 +852,9 @@ def engine_stats() -> Dict[str, Any]:
     from metrics_tpu import arena as _arena
 
     out.update(_arena.arena_stats())
+    # the persistent program cache (hits/misses/stores/demotions/evictions
+    # — ops/progcache.py; imported at module level, no laziness needed)
+    out.update(_progcache.progcache_stats())
     return out
 
 
@@ -847,6 +1104,10 @@ def program_report(analyze: bool = True) -> List[Dict[str, Any]]:
             "plain_runs": exe.plain_runs,
             "compiles": exe.compiles,
             "compile_time_s": round(exe.compile_time_s, 6),
+            # the warmed-boot attribution split: persistent-tier rehydration
+            # wall lands here, never in compile_time_s
+            "cache_load_time_s": round(exe.cache_load_time_s, 6),
+            "cache_source": exe.cache_source,
             "compiled_signatures": exe.compiled_signatures(),
             "dispatch_time_s": round(exe.dispatch_time_s, 6),
             "device": device,
@@ -874,6 +1135,7 @@ def program_summary() -> Dict[str, Any]:
         "count": len(_PROGRAM_CACHE),
         "compiles": 0,
         "compile_time_s": 0.0,
+        "cache_load_time_s": 0.0,
         "hits": 0,
         "donated_runs": 0,
         "plain_runs": 0,
@@ -881,10 +1143,12 @@ def program_summary() -> Dict[str, Any]:
     for exe in _PROGRAM_CACHE.values():
         out["compiles"] += exe.compiles
         out["compile_time_s"] += exe.compile_time_s
+        out["cache_load_time_s"] += exe.cache_load_time_s
         out["hits"] += exe.hits
         out["donated_runs"] += exe.donated_runs
         out["plain_runs"] += exe.plain_runs
     out["compile_time_s"] = round(out["compile_time_s"], 6)
+    out["cache_load_time_s"] = round(out["cache_load_time_s"], 6)
     return out
 
 

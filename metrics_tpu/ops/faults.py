@@ -98,7 +98,10 @@ TIERS = ("fused", "chunked", "eager", "host")
 #: before a stored record is read, modelling an unreadable newest generation.
 #: ``epoch-fence`` models a membership change racing a collective: the
 #: injected ``EpochFault`` is what the real fence raises when a protocol's
-#: entry epoch goes stale mid-flight.
+#: entry epoch goes stale mid-flight. ``progcache-load``/``progcache-store``
+#: fire before a persistent program-cache entry is read/written: a load
+#: failure demotes the store's ``progcache`` ladder lane so traffic falls
+#: back to fresh compiles (never a wrong program).
 FAULT_SITES = (
     "probe",
     "compile",
@@ -110,6 +113,8 @@ FAULT_SITES = (
     "host-offload",
     "journal-write",
     "journal-load",
+    "progcache-load",
+    "progcache-store",
 )
 
 _SITE_DEFAULT_EXC = {
@@ -127,6 +132,11 @@ _SITE_DEFAULT_EXC = {
     "host-offload": HostOffloadFault,
     "journal-write": JournalFault,
     "journal-load": JournalFault,
+    # journal domain: a persistent program-cache entry is an on-disk record
+    # with the same corruption surface as a journal record — and the same
+    # recovery story (demote to a fresh compile, never a wrong program)
+    "progcache-load": JournalFault,
+    "progcache-store": JournalFault,
 }
 
 _DOMAIN_EXC = {

@@ -1,0 +1,729 @@
+"""Persistent cross-process program cache — the fleet cold-start plane.
+
+The engine's program cache (:mod:`metrics_tpu.ops.engine`) is in-memory
+only: every replica of a fleet re-traces and re-compiles every fused
+program at boot, and a rolling restart pays that cost once per replaced
+replica. This module adds the missing persistence tier underneath it:
+
+- **Store**: after a fresh compile, the plain twin is exported at the
+  just-compiled abstract signature (``jax.export``) and the serialized
+  StableHLO module lands in a CRC-framed on-disk entry stamped with the
+  store version, the backend platform, and ``jax.__version__``. Entries
+  are keyed by exactly the identity ``acquire_keyed`` uses — ``(kind,
+  config-fingerprint digest, abstract-signature digest)`` — so a second
+  process with the same configuration resolves the same files.
+- **Load**: on a would-be jit-cache miss, ``Executable._dispatch``
+  consults the store *before* tracing. A hit deserializes the exported
+  module and AOT-compiles a thin rehydration wrapper
+  (``jax.jit(exported.call, ...).lower(...).compile()``) — no re-trace
+  of metric code, and the wrapper's XLA compile is served by JAX's own
+  persistent compilation cache (enabled under ``<store>/xla`` whenever
+  the progcache is on), so a warmed boot performs **zero XLA compiles**.
+- **Never a wrong program**: any truncated, bit-flipped, version- or
+  backend-mismatched entry raises a classified :class:`JournalFault`
+  and demotes the store's ``progcache`` fault-ladder lane — traffic
+  falls back to a fresh compile with bit-identical results, warns once,
+  and the ladder re-probes after clean operations. Program kinds whose
+  export is unsupported (e.g. host callbacks) are remembered per kind
+  and fall back to JAX's persistent compilation cache alone.
+
+Everything is **off by default** (``METRICS_TPU_PROGCACHE=1`` opts in;
+``METRICS_TPU_PROGCACHE_DIR`` and ``METRICS_TPU_PROGCACHE_MAX_MB`` size
+and place the store) — with the knob unset, no directory is created, no
+index is scanned, and the dispatch hot path is untouched. The on-disk
+footprint is LRU-capped: entries are aged by mtime (touched on every
+load), and a store that would exceed the cap evicts oldest-first,
+counting ``progcache_evictions`` and logging what was dropped.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import struct
+import time
+import zlib
+from typing import Any, Dict, FrozenSet, Optional, Set, Tuple
+
+import jax
+import numpy as np
+
+from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import telemetry as _telemetry
+from metrics_tpu.utils.exceptions import JournalFault
+
+__all__ = [
+    "abstract_signature",
+    "build_aot",
+    "configure",
+    "decode_entry",
+    "enabled",
+    "cache_dir",
+    "load_program",
+    "max_cap_mb",
+    "progcache_stats",
+    "signature_digest",
+    "store_program",
+    "stored_sigs",
+]
+
+# ------------------------------------------------------------- entry framing
+# Same framing discipline as ops/journal.py: fixed header, CRC32 over the
+# JSON manifest and the payload separately, atomic tmp+fsync+replace writes.
+_MAGIC = b"MTPC"
+_VERSION = 1
+_HEADER = struct.Struct("<4sIIQII")  # magic, version, manifest_len, payload_len, crc_m, crc_p
+_SUFFIX = ".mpc"
+_KIND_SAFE = re.compile(r"[^A-Za-z0-9_.]")
+
+# ------------------------------------------------------------------ counters
+_counters: Dict[str, int] = {
+    "progcache_hits": 0,
+    "progcache_misses": 0,
+    "progcache_stores": 0,
+    "progcache_demotions": 0,
+    "progcache_evictions": 0,
+    "progcache_bytes_stored": 0,
+}
+
+
+def progcache_stats() -> Dict[str, int]:
+    """Monotonic event counters, merged into ``engine.engine_stats()``:
+    ``progcache_hits`` (persistent entries rehydrated into the AOT lane),
+    ``progcache_misses`` (consults that found no usable entry — a fresh
+    compile followed), ``progcache_stores`` / ``progcache_bytes_stored``
+    (entries written), ``progcache_demotions`` (corrupt/stale/mismatched
+    entries or failed stores, each classified through the fault ladder)
+    and ``progcache_evictions`` (size-cap LRU removals)."""
+    return dict(_counters)
+
+
+def _zero_counters() -> None:
+    for key in _counters:
+        _counters[key] = 0
+
+
+_telemetry.register_reset("progcache", _zero_counters)
+
+
+class _ProgCacheOwner:
+    """Ladder + warn-dedupe anchor for the store (one lane per process —
+    the store is process-global, so its health is too)."""
+
+
+_OWNER = _ProgCacheOwner()
+_ENABLE_WARN_OWNER = _ProgCacheOwner()
+_CAP_WARN_OWNER = _ProgCacheOwner()
+_EVICT_WARN_OWNER = _ProgCacheOwner()
+_JAXCACHE_WARN_OWNER = _ProgCacheOwner()
+
+#: program kinds whose ``jax.export`` failed in this process: skipped on
+#: later stores (JAX's persistent compilation cache still covers their
+#: XLA compiles — the documented fallback tier for unexportable programs)
+_export_unsupported: Set[str] = set()
+
+# ------------------------------------------------------------------- knobs
+_override: Dict[str, Any] = {}
+_TRUE_TOKENS = ("1", "true", "on", "yes")
+_FALSE_TOKENS = ("0", "false", "off", "no")
+
+
+def _parse_bool(raw: str) -> bool:
+    token = raw.strip().lower()
+    if token in _TRUE_TOKENS:
+        return True
+    if token in _FALSE_TOKENS:
+        return False
+    raise ValueError(raw)
+
+
+def enabled() -> bool:
+    """Whether the persistent tier is active (``METRICS_TPU_PROGCACHE``,
+    default **off** — tier-1 behavior is byte-identical with the knob
+    unset). Read per consult through the shared warn-once env parser."""
+    if "enabled" in _override:
+        return bool(_override["enabled"])
+    from metrics_tpu.parallel import sync as _psync
+
+    return bool(
+        _psync._env_parse(
+            "METRICS_TPU_PROGCACHE",
+            False,
+            _parse_bool,
+            "a boolean (0/1/on/off)",
+            owner=_ENABLE_WARN_OWNER,
+        )
+    )
+
+
+def cache_dir() -> str:
+    """Root of the on-disk store (``METRICS_TPU_PROGCACHE_DIR``; defaults
+    under the user cache directory). Nothing is created until the first
+    enabled store."""
+    if "dir" in _override:
+        return str(_override["dir"])
+    raw = os.environ.get("METRICS_TPU_PROGCACHE_DIR", "")
+    if raw and raw.strip():
+        return raw.strip()
+    return os.path.join(os.path.expanduser("~"), ".cache", "metrics_tpu", "progcache")
+
+
+def max_cap_mb() -> int:
+    """On-disk size cap in MB (``METRICS_TPU_PROGCACHE_MAX_MB``, default
+    512; ``0`` or negative disables the cap). Enforced oldest-first after
+    every store — never silently: each eviction counts and is logged."""
+    if "max_mb" in _override:
+        return int(_override["max_mb"])
+    from metrics_tpu.parallel import sync as _psync
+
+    return int(_psync._env_int("METRICS_TPU_PROGCACHE_MAX_MB", 512, owner=_CAP_WARN_OWNER))
+
+
+def configure(
+    *,
+    enabled: Optional[bool] = None,  # noqa: A002 — mirrors the knob name
+    cache_dir: Optional[str] = None,  # noqa: A002
+    max_mb: Optional[int] = None,
+    reset: bool = False,
+) -> None:
+    """Runtime override of the env knobs (tests, certifications, and boot
+    scripts that place the store explicitly). ``reset=True`` first clears
+    every override AND the store's process-local health state — the
+    ``progcache`` ladder lane, the per-kind export-unsupported memo, and
+    the directory index — so a re-pointed store starts clean."""
+    global _index
+    if reset:
+        _override.clear()
+        _export_unsupported.clear()
+        _OWNER.__dict__.pop("_fault_ladders", None)
+        _jax_cache_dir[0] = None
+    if enabled is not None:
+        _override["enabled"] = bool(enabled)
+    if cache_dir is not None:
+        _override["dir"] = str(cache_dir)
+    if max_mb is not None:
+        _override["max_mb"] = int(max_mb)
+    _index = None
+    _sizes.clear()
+
+
+# ------------------------------------------- JAX persistent-cache fallback
+_jax_cache_dir: list = [None]
+
+
+def _configure_jax_cache(root: str) -> None:
+    """Point JAX's own persistent compilation cache under the store — the
+    fallback tier: rehydration-wrapper compiles (and any program whose
+    export is unsupported) hit it by module hash, so even the XLA compile
+    of a wrapper is served from disk on a warmed boot."""
+    target = os.path.join(root, "xla")
+    if _jax_cache_dir[0] == target:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", target)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _jax_cache_dir[0] = target
+    except Exception as err:  # noqa: BLE001 — older jax: progcache still works
+        _jax_cache_dir[0] = target
+        _faults.warn_fault(
+            _JAXCACHE_WARN_OWNER,
+            "journal",
+            f"could not enable JAX's persistent compilation cache under {target!r} "
+            f"({type(err).__name__}: {err}); exported-module loads still skip tracing "
+            "but wrapper XLA compiles will be fresh.",
+        )
+
+
+# ------------------------------------------------------------ ladder lane
+def _lane_armed() -> bool:
+    """The store's ``progcache`` fault-ladder lane: demoted by a failed
+    load/store, re-probed (and promoted) after the recovery-policy count
+    of clean would-be consults — standard ladder semantics, one lane for
+    the whole store."""
+    lad = _faults.ladder(_OWNER, "progcache")
+    if not lad.demoted:
+        return True
+    if lad.note_clean():
+        lad.promote()
+        return True
+    return False
+
+
+# -------------------------------------------------------------- signatures
+def abstract_signature(state: Any, args: tuple, kwargs: dict) -> Tuple[Any, tuple, dict]:
+    """The call's abstract signature: array leaves (concrete arrays or
+    ``ShapeDtypeStruct`` declarations) become ``ShapeDtypeStruct``; python
+    leaves pass through (they trace exactly as they would at dispatch)."""
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, (jax.Array, np.ndarray, np.generic)):
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+        return x
+
+    return jax.tree.map(leaf, (state, args, kwargs))
+
+
+def signature_digest(state: Any, args: tuple = (), kwargs: Optional[dict] = None) -> str:
+    """Stable digest of the abstract call signature — the third component
+    of the on-disk key. Arrays digest as (shape, dtype, weak_type); python
+    leaves by ``repr`` (they are trace-time constants); the treedef string
+    pins the structure. Deterministic across processes by construction."""
+    leaves, treedef = jax.tree_util.tree_flatten((state, args, kwargs or {}))
+    parts = [str(treedef)]
+    for x in leaves:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{tuple(shape)}:{dtype}:{bool(getattr(x, 'weak_type', False))}")
+        else:
+            parts.append(repr(x))
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+# ------------------------------------------------------------- entry codec
+def _frame_entry(manifest: Dict[str, Any], payload: bytes) -> bytes:
+    mbytes = json.dumps(manifest, sort_keys=True).encode()
+    return (
+        _HEADER.pack(
+            _MAGIC, _VERSION, len(mbytes), len(payload), zlib.crc32(mbytes), zlib.crc32(payload)
+        )
+        + mbytes
+        + payload
+    )
+
+
+def decode_entry(data: bytes, origin: str = "<bytes>") -> Tuple[Dict[str, Any], bytes]:
+    """Validate and split one framed entry. Every defect — truncation, bad
+    magic, unknown store version, CRC mismatch — raises a classified
+    :class:`JournalFault` (site ``progcache-load``); the caller demotes to
+    a fresh compile, never executes suspect bytes."""
+    if len(data) < _HEADER.size:
+        raise JournalFault(
+            f"progcache entry {origin} truncated: {len(data)} bytes < {_HEADER.size}-byte header",
+            site="progcache-load",
+        )
+    magic, version, mlen, plen, crc_m, crc_p = _HEADER.unpack_from(data)
+    if magic != _MAGIC:
+        raise JournalFault(
+            f"progcache entry {origin} has bad magic {magic!r}", site="progcache-load"
+        )
+    if version != _VERSION:
+        raise JournalFault(
+            f"progcache entry {origin} has store version {version}, this build reads {_VERSION}",
+            site="progcache-load",
+        )
+    end = _HEADER.size + mlen + plen
+    if len(data) < end:
+        raise JournalFault(
+            f"progcache entry {origin} truncated: {len(data)} bytes < {end} framed",
+            site="progcache-load",
+        )
+    mbytes = data[_HEADER.size : _HEADER.size + mlen]
+    payload = bytes(data[_HEADER.size + mlen : end])
+    if zlib.crc32(mbytes) != crc_m:
+        raise JournalFault(
+            f"progcache entry {origin} manifest CRC mismatch", site="progcache-load"
+        )
+    if zlib.crc32(payload) != crc_p:
+        raise JournalFault(
+            f"progcache entry {origin} payload CRC mismatch", site="progcache-load"
+        )
+    return json.loads(mbytes.decode()), payload
+
+
+def _validate_manifest(
+    manifest: Dict[str, Any], kind: str, key_digest: str, sig: str, origin: str
+) -> None:
+    backend = jax.default_backend()
+    if manifest.get("backend") != backend:
+        raise JournalFault(
+            f"progcache entry {origin} was built for backend "
+            f"{manifest.get('backend')!r}, this process runs {backend!r}",
+            site="progcache-load",
+        )
+    if manifest.get("jax_version") != jax.__version__:
+        raise JournalFault(
+            f"progcache entry {origin} was built under jax "
+            f"{manifest.get('jax_version')!r}, this process runs {jax.__version__!r}",
+            site="progcache-load",
+        )
+    if (manifest.get("kind"), manifest.get("key"), manifest.get("sig")) != (
+        kind,
+        key_digest,
+        sig,
+    ):
+        raise JournalFault(
+            f"progcache entry {origin} is keyed "
+            f"({manifest.get('kind')}, {manifest.get('key')}, {manifest.get('sig')}), "
+            f"expected ({kind}, {key_digest}, {sig})",
+            site="progcache-load",
+        )
+
+
+# ---------------------------------------------------------------- the index
+_index: Optional[Dict[Tuple[str, str], Set[str]]] = None
+_sizes: Dict[str, int] = {}
+
+
+def _fname_kind(kind: str) -> str:
+    return _KIND_SAFE.sub("_", kind)
+
+
+def _entry_name(kind: str, key_digest: str, sig: str) -> str:
+    return f"{_fname_kind(kind)}-{key_digest}-{sig}{_SUFFIX}"
+
+
+def _ensure_index() -> Dict[Tuple[str, str], Set[str]]:
+    global _index
+    if _index is not None:
+        return _index
+    _index = {}
+    root = cache_dir()
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return _index
+    for name in names:
+        if not name.endswith(_SUFFIX):
+            continue
+        parts = name[: -len(_SUFFIX)].rsplit("-", 2)
+        if len(parts) != 3:
+            continue
+        _index.setdefault((parts[0], parts[1]), set()).add(parts[2])
+        try:
+            _sizes[name] = os.path.getsize(os.path.join(root, name))
+        except OSError:
+            pass
+    return _index
+
+
+def _drop_indexed(name: str) -> None:
+    _sizes.pop(name, None)
+    parts = name[: -len(_SUFFIX)].rsplit("-", 2)
+    if len(parts) == 3 and _index is not None:
+        sigs = _index.get((parts[0], parts[1]))
+        if sigs is not None:
+            sigs.discard(parts[2])
+
+
+def stored_sigs(kind: str, key_digest: str) -> FrozenSet[str]:
+    """Signature digests the store holds for one program identity. Empty
+    (and free of any disk probe) when the progcache is disabled."""
+    if not enabled():
+        return frozenset()
+    return frozenset(_ensure_index().get((_fname_kind(kind), key_digest), ()))
+
+
+def note_miss() -> None:
+    """Count one consult that found no usable entry (the fresh compile
+    that follows is the cache miss cost)."""
+    _counters["progcache_misses"] += 1
+
+
+# ----------------------------------------------------------- store / load
+def _write_entry(kind: str, key_digest: str, sig: str, payload: bytes) -> int:
+    """Frame + atomically write one entry, then sweep the size cap.
+    Returns the framed byte count. Raises on any IO failure."""
+    if _faults.armed:
+        _faults.maybe_fail("progcache-store")
+    manifest = {
+        "kind": kind,
+        "key": key_digest,
+        "sig": sig,
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "store_version": _VERSION,
+        "created": time.time(),
+    }
+    data = _frame_entry(manifest, payload)
+    root = cache_dir()
+    os.makedirs(root, exist_ok=True)
+    _configure_jax_cache(root)
+    name = _entry_name(kind, key_digest, sig)
+    path = os.path.join(root, name)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _ensure_index().setdefault((_fname_kind(kind), key_digest), set()).add(sig)
+    _sizes[name] = len(data)
+    _evict_over_cap(root, keep=name)
+    return len(data)
+
+
+def _evict_over_cap(root: str, keep: Optional[str] = None) -> None:
+    cap_mb = max_cap_mb()
+    if cap_mb <= 0:
+        return
+    cap = cap_mb * 1024 * 1024
+    try:
+        names = [n for n in os.listdir(root) if n.endswith(_SUFFIX)]
+    except OSError:
+        return
+    entries = []
+    total = 0
+    for n in names:
+        try:
+            st = os.stat(os.path.join(root, n))
+        except OSError:
+            continue
+        entries.append((st.st_mtime, n, st.st_size))
+        total += st.st_size
+    if total <= cap:
+        return
+    entries.sort()  # oldest mtime first — loads touch their entry, so this is LRU
+    dropped = []
+    for _mtime, n, size in entries:
+        if total <= cap:
+            break
+        if n == keep:
+            continue
+        try:
+            os.remove(os.path.join(root, n))
+        except OSError:
+            continue
+        total -= size
+        dropped.append(n)
+        _counters["progcache_evictions"] += 1
+        _drop_indexed(n)
+    if dropped:
+        if _telemetry.armed:
+            _telemetry.emit(
+                "progcache-store",
+                "evict",
+                "progcache",
+                attrs={"evicted": dropped[:16], "count": len(dropped)},
+            )
+        _faults.warn_fault(
+            _EVICT_WARN_OWNER,
+            "journal",
+            f"progcache size cap ({cap_mb} MB) evicted {len(dropped)} entry(ies) "
+            f"oldest-first: {', '.join(dropped[:4])}"
+            + ("…" if len(dropped) > 4 else "")
+            + " — raise METRICS_TPU_PROGCACHE_MAX_MB to keep warm boots compile-free.",
+        )
+
+
+def store_program(
+    kind: str, key_digest: str, jit_fn: Any, state: Any, args: tuple, kwargs: dict
+) -> Optional[str]:
+    """Export ``jit_fn`` (the plain twin) at the call's signature and
+    persist the serialized module. Returns the signature digest on success,
+    None otherwise — an export failure marks the *kind* unsupported (JAX's
+    persistent compilation cache remains its tier), an IO failure demotes
+    the whole ``progcache`` lane. Never raises into the dispatch path."""
+    if not enabled() or kind in _export_unsupported or not _lane_armed():
+        return None
+    t0 = time.perf_counter()
+    sig = signature_digest(state, args, kwargs)
+    try:
+        from jax import export as _jexport
+
+        state_s, args_s, kwargs_s = abstract_signature(state, args, kwargs)
+        exported = _jexport.export(jit_fn)(state_s, *args_s, **kwargs_s)
+        payload = exported.serialize()
+    except Exception as err:  # noqa: BLE001 — unexportable program kind
+        _export_unsupported.add(kind)
+        _counters["progcache_demotions"] += 1
+        domain = _faults.classify(err, "compile")
+        _faults.note_fault(domain, site="progcache-store", owner=_OWNER, error=err)
+        _faults.warn_fault(
+            _OWNER,
+            domain,
+            f"progcache cannot export programs of kind {kind!r} "
+            f"({type(err).__name__}: {err}); this kind rides JAX's persistent "
+            "compilation cache only.",
+        )
+        return None
+    try:
+        nbytes = _write_entry(kind, key_digest, sig, payload)
+    except Exception as err:  # noqa: BLE001 — disk trouble: demote the lane
+        _counters["progcache_demotions"] += 1
+        _faults.demote(
+            _OWNER,
+            "progcache",
+            err,
+            default_domain="journal",
+            site="progcache-store",
+            warn=(
+                f"progcache store failed for {kind}:{key_digest}:{sig} "
+                f"({type(err).__name__}: {err}); demoting the persistent tier — "
+                "traffic serves fresh compiles until the lane recovers."
+            ),
+        )
+        return None
+    _counters["progcache_stores"] += 1
+    _counters["progcache_bytes_stored"] += nbytes
+    if _telemetry.armed:
+        _telemetry.emit(
+            "progcache-store",
+            kind,
+            "progcache",
+            t0,
+            time.perf_counter() - t0,
+            {"key": key_digest, "sig": sig, "bytes": nbytes},
+        )
+    return sig
+
+
+def _rehydrate(payload: bytes, donate: bool, avals: Optional[Tuple[Any, tuple, dict]]):
+    """Deserialize one exported module and AOT-compile the rehydration
+    wrapper. With no caller avals, the signature is reconstructed from the
+    exported module's own ``in_avals``/``in_tree`` (the warm-from-store
+    path, where no example inputs exist yet)."""
+    from jax import export as _jexport
+
+    exported = _jexport.deserialize(payload)
+    wrapper = jax.jit(exported.call, donate_argnums=(0,) if donate else ())
+    if avals is None:
+        structs = [
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in exported.in_avals
+        ]
+        (lower_args, lower_kwargs) = jax.tree_util.tree_unflatten(exported.in_tree, structs)
+        return wrapper.lower(*lower_args, **lower_kwargs).compile()
+    state_s, args_s, kwargs_s = avals
+    return wrapper.lower(state_s, *args_s, **kwargs_s).compile()
+
+
+def load_program(
+    kind: str,
+    key_digest: str,
+    sig: str,
+    *,
+    donate: bool,
+    state: Any = None,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+) -> Optional[Tuple[Any, float]]:
+    """Rehydrate one persistent entry into an AOT-compiled callable.
+    Returns ``(compiled, load_seconds)``, or None after counting and
+    classifying a demotion (corrupt bytes, stale stamps, deserialization
+    or wrapper-compile failure — the caller falls back to a fresh compile,
+    never to a suspect program)."""
+    if not enabled() or not _lane_armed():
+        return None
+    t0 = time.perf_counter()
+    name = _entry_name(kind, key_digest, sig)
+    path = os.path.join(cache_dir(), name)
+    try:
+        if _faults.armed:
+            _faults.maybe_fail("progcache-load")
+        _configure_jax_cache(cache_dir())
+        with open(path, "rb") as fh:
+            data = fh.read()
+        manifest, payload = decode_entry(data, origin=name)
+        _validate_manifest(manifest, kind, key_digest, sig, origin=name)
+        avals = None
+        if state is not None or args or kwargs:
+            avals = abstract_signature(state, args, kwargs or {})
+        compiled = _rehydrate(payload, donate, avals)
+        try:
+            os.utime(path)  # LRU recency for the size-cap sweep
+        except OSError:
+            pass
+    except Exception as err:  # noqa: BLE001 — every load defect demotes
+        _counters["progcache_demotions"] += 1
+        _drop_indexed(name)
+        _faults.demote(
+            _OWNER,
+            "progcache",
+            err,
+            default_domain="journal",
+            site="progcache-load",
+            warn=(
+                f"progcache entry {name} failed to load ({type(err).__name__}: {err}); "
+                "demoting to a fresh compile — results are unaffected."
+            ),
+        )
+        return None
+    dur = time.perf_counter() - t0
+    _counters["progcache_hits"] += 1
+    if _telemetry.armed:
+        _telemetry.emit(
+            "progcache-load",
+            kind,
+            "progcache",
+            t0,
+            dur,
+            {"key": key_digest, "sig": sig, "donated": donate},
+        )
+    return compiled, dur
+
+
+def build_aot(
+    kind: str,
+    key_digest: str,
+    jit_fn: Any,
+    *,
+    lanes: Tuple[bool, ...],
+    state: Any,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    persist: bool = True,
+) -> Optional[Tuple[Dict[bool, Any], float, str]]:
+    """AOT-build one program signature ahead of traffic: export the plain
+    twin once at the declared signature, optionally persist the entry, and
+    compile one rehydration wrapper per requested donation lane. The
+    callable served is ALWAYS the exported module — the exact artifact a
+    warmed boot loads — so AOT-precompiled and persistent-loaded traffic
+    execute identical programs. Returns ``({donate: compiled}, seconds,
+    sig)`` or None (kind unexportable — counted + warned once)."""
+    kwargs = kwargs or {}
+    t0 = time.perf_counter()
+    sig = signature_digest(state, args, kwargs)
+    try:
+        from jax import export as _jexport
+
+        state_s, args_s, kwargs_s = abstract_signature(state, args, kwargs)
+        exported = _jexport.export(jit_fn)(state_s, *args_s, **kwargs_s)
+        payload = exported.serialize()
+        compiled = {
+            donate: _rehydrate(payload, donate, (state_s, args_s, kwargs_s))
+            for donate in lanes
+        }
+    except Exception as err:  # noqa: BLE001
+        _export_unsupported.add(kind)
+        _counters["progcache_demotions"] += 1
+        domain = _faults.classify(err, "compile")
+        _faults.note_fault(domain, site="progcache-store", owner=_OWNER, error=err)
+        _faults.warn_fault(
+            _OWNER,
+            domain,
+            f"progcache cannot AOT-export programs of kind {kind!r} "
+            f"({type(err).__name__}: {err}); they compile lazily at first dispatch.",
+        )
+        return None
+    if persist and enabled() and _lane_armed():
+        try:
+            nbytes = _write_entry(kind, key_digest, sig, payload)
+            _counters["progcache_stores"] += 1
+            _counters["progcache_bytes_stored"] += nbytes
+        except Exception as err:  # noqa: BLE001
+            _counters["progcache_demotions"] += 1
+            _faults.demote(
+                _OWNER,
+                "progcache",
+                err,
+                default_domain="journal",
+                site="progcache-store",
+                warn=(
+                    f"progcache store failed for {kind}:{key_digest}:{sig} "
+                    f"({type(err).__name__}: {err}); the AOT program still serves "
+                    "in-memory, but the next boot will recompile it."
+                ),
+            )
+    dur = time.perf_counter() - t0
+    if _telemetry.armed:
+        _telemetry.emit(
+            "progcache-store",
+            kind,
+            "progcache",
+            t0,
+            dur,
+            {"key": key_digest, "sig": sig, "aot": True, "lanes": len(compiled)},
+        )
+    return compiled, dur, sig

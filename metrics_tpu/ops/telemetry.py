@@ -158,6 +158,13 @@ SPAN_SITES = {
     "vmapped compute + ring slot + live-tenant reset",
     "arena-journal": "one slab-granular arena save or restore (one CRC-framed "
     "record per slab, per-slab generation demotion)",
+    # persistent program cache (ops/progcache.py)
+    "progcache-load": "one persistent program-cache load: read + validate one "
+    "CRC-framed entry, deserialize the exported module, AOT-compile the "
+    "rehydration wrapper (XLA served from the compilation cache)",
+    "progcache-store": "one persistent program-cache store: export + "
+    "serialize a freshly compiled program, CRC-frame it, atomic write + "
+    "size-capped LRU sweep",
 }
 
 #: The sync-protocol phases the fleet straggler report attributes
@@ -750,8 +757,9 @@ def snapshot() -> Dict[str, Any]:
         >>> snap = telemetry_snapshot()
         >>> snap["snapshot_schema"]
         1
-        >>> sorted(snap["programs"])
-        ['compile_time_s', 'compiles', 'count', 'donated_runs', 'hits', 'plain_runs']
+        >>> sorted(snap["programs"])  # doctest: +NORMALIZE_WHITESPACE
+        ['cache_load_time_s', 'compile_time_s', 'compiles', 'count',
+         'donated_runs', 'hits', 'plain_runs']
     """
     from metrics_tpu.ops import engine as _engine
 
@@ -847,6 +855,9 @@ _COUNTER_PREFIXES = (
     # the tenant-arena plane: lifecycle, vmapped program traffic, slab
     # journal bytes/demotions (arena.py)
     "arena_",
+    # the persistent program cache: entry hits/misses/stores, classified
+    # demotions, size-cap evictions (ops/progcache.py)
+    "progcache_",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —

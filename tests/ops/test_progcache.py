@@ -1,0 +1,285 @@
+"""Persistent cross-process program cache (ISSUE-18 contracts).
+
+Contracts (`metrics_tpu/ops/progcache.py` + engine wiring):
+
+- **Cross-process round trip** — a second process (simulated by
+  `engine.reset_engine()` + fresh module instances) replaying the same
+  traffic over Accuracy / Mean / AUROC / compute-group suites and an
+  arena slab program serves every stored program from disk: zero fresh
+  compiles where the store covered the cold boot, bit-exact values
+  always.
+- **Fault ladder, never a wrong program** — truncated, bit-flipped,
+  wrong-jax-version and wrong-backend entries each demote through the
+  `progcache` lane with ONE classified warning (warn-once per
+  owner+domain), count in `progcache_demotions`, and traffic falls back
+  to fresh compiles with bit-identical results.
+- **AOT precompile** — `MetricCollection.precompile()` then live ragged
+  traffic compiles nothing new (counter-pinned on
+  `program_summary()["compiles"]`).
+- **Disabled by default** — with the knob unset the store allocates no
+  directory and probes no disk: every `progcache_*` counter stays zero.
+- **Warn-once env knobs** — garbage `METRICS_TPU_PROGCACHE` warns once
+  naming the value and falls back to off.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.ops import engine, progcache
+from metrics_tpu.parallel import sync as psync
+
+
+@pytest.fixture(autouse=True)
+def _clean_world(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_PROGCACHE", raising=False)
+    monkeypatch.delenv("METRICS_TPU_PROGCACHE_DIR", raising=False)
+    monkeypatch.delenv("METRICS_TPU_PROGCACHE_MAX_MB", raising=False)
+    psync.reset_membership()
+    engine.reset_engine()
+    engine.reset_stats(reset_warnings=True)
+    progcache.configure(reset=True)
+    yield
+    psync.reset_membership()
+    engine.reset_engine()
+    engine.reset_stats(reset_warnings=True)
+    progcache.configure(reset=True)
+    try:
+        # an enabled store points JAX's own compilation cache under it;
+        # point it back off the (about-to-be-deleted) tmp dir
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        )
+    except Exception:  # noqa: BLE001 — older jax without the knob
+        pass
+
+
+def _enable(tmp_path, **kw):
+    progcache.configure(enabled=True, cache_dir=str(tmp_path / "store"), **kw)
+
+
+def _new_process():
+    """Simulate a replacement process sharing only the on-disk store."""
+    engine.reset_engine()
+    engine.reset_stats(reset_warnings=True)
+
+
+def _assert_bitexact(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype and xa.shape == ya.shape
+        assert xa.tobytes() == ya.tobytes()
+
+
+# ------------------------------------------------------------- suite zoo
+def _acc_suite():
+    return mt.MetricCollection({"acc": mt.Accuracy(num_classes=2)})
+
+
+def _acc_batch(rng):
+    return (
+        jnp.asarray(rng.randint(0, 2, (16,)).astype(np.int32)),
+        jnp.asarray(rng.randint(0, 2, (16,)).astype(np.int32)),
+    )
+
+
+def _mean_suite():
+    return mt.MetricCollection({"mean": mt.MeanMetric()})
+
+
+def _mean_batch(rng):
+    return (jnp.asarray(rng.randn(16).astype(np.float32)),)
+
+
+def _auroc_suite():
+    return mt.MetricCollection({"auroc": mt.AUROC()})
+
+
+def _auroc_batch(rng):
+    return (
+        jnp.asarray(rng.rand(16).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 2, (16,)).astype(np.int32)),
+    )
+
+
+def _group_suite():
+    # Precision + Recall share StatScores state: a real compute group.
+    return mt.MetricCollection(
+        {
+            "precision": mt.Precision(num_classes=2),
+            "recall": mt.Recall(num_classes=2),
+        }
+    )
+
+
+SUITES = {
+    "accuracy": (_acc_suite, _acc_batch),
+    "mean": (_mean_suite, _mean_batch),
+    "auroc": (_auroc_suite, _auroc_batch),
+    "compute-group": (_group_suite, _acc_batch),
+}
+
+
+def _run_traffic(factory, batch_fn, rounds=(3, 2), seed=7):
+    suite = factory()
+    rng = np.random.RandomState(seed)
+    vals = []
+    for n in rounds:
+        for _ in range(n):
+            suite.update(*batch_fn(rng))
+        vals.append(suite.compute())
+    return vals
+
+
+# ------------------------------------------------------------ round trips
+@pytest.mark.parametrize("name", sorted(SUITES))
+def test_roundtrip_bitexact(tmp_path, name):
+    factory, batch_fn = SUITES[name]
+    _enable(tmp_path)
+
+    cold_vals = _run_traffic(factory, batch_fn)
+    cold = engine.program_summary()
+    stats = progcache.progcache_stats()
+    cold_compiles, cold_stores = cold["compiles"], stats["progcache_stores"]
+
+    _new_process()
+    warm_vals = _run_traffic(factory, batch_fn)
+    warm = engine.program_summary()
+
+    _assert_bitexact(cold_vals, warm_vals)
+    if cold_stores == cold_compiles:
+        # every cold program was exportable: warm boot is compile-free
+        assert warm["compiles"] == 0
+    else:
+        assert warm["compiles"] < cold_compiles or cold_compiles == 0
+    if cold_stores:
+        assert progcache.progcache_stats()["progcache_hits"] > 0
+
+
+def test_arena_slab_roundtrip(tmp_path):
+    _enable(tmp_path)
+
+    def drive():
+        arena = mt.MetricArena(mt.MeanMetric(), capacity=4, slab=4, name="pc")
+        ids = arena.add(4)
+        rng = np.random.RandomState(11)
+        for _ in range(3):
+            arena.update(ids, jnp.asarray(rng.randn(4).astype(np.float32)))
+        return np.asarray(arena.compute(ids))
+
+    cold_vals = drive()
+    cold_compiles = engine.program_summary()["compiles"]
+    assert cold_compiles > 0
+    assert progcache.progcache_stats()["progcache_stores"] > 0
+
+    _new_process()
+    warm_vals = drive()
+    assert engine.program_summary()["compiles"] == 0
+    assert progcache.progcache_stats()["progcache_hits"] > 0
+    assert cold_vals.tobytes() == warm_vals.tobytes()
+
+
+# --------------------------------------------------------------- corruption
+def _tamper(root, how):
+    """Corrupt every stored entry the given way; return how many."""
+    names = [n for n in os.listdir(root) if n.endswith(".mpc")]
+    assert names, "cold boot stored nothing to corrupt"
+    for name in names:
+        path = os.path.join(root, name)
+        blob = bytearray(open(path, "rb").read())
+        if how == "truncate":
+            blob = blob[: len(blob) // 2]
+        elif how == "bitflip":
+            blob[-1] ^= 0xFF
+        else:  # rewrite the manifest with a mismatched field
+            manifest, payload = progcache.decode_entry(bytes(blob), origin=name)
+            if how == "jax-version":
+                manifest["jax_version"] = "0.0.0-elsewhere"
+            elif how == "backend":
+                manifest["backend"] = "not-a-backend"
+            blob = progcache._frame_entry(manifest, payload)
+        with open(path, "wb") as fh:
+            fh.write(bytes(blob))
+    return len(names)
+
+
+@pytest.mark.parametrize("how", ["truncate", "bitflip", "jax-version", "backend"])
+def test_corrupt_entries_demote_classified_warn_once(tmp_path, how):
+    factory, batch_fn = SUITES["accuracy"]
+    _enable(tmp_path)
+    cold_vals = _run_traffic(factory, batch_fn)
+    assert progcache.progcache_stats()["progcache_stores"] > 0
+
+    _tamper(str(tmp_path / "store"), how)
+    _new_process()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        warm_vals = _run_traffic(factory, batch_fn)
+
+    # never a wrong program: the demoted entry is replaced by a fresh
+    # compile with bit-identical results
+    _assert_bitexact(cold_vals, warm_vals)
+    assert progcache.progcache_stats()["progcache_demotions"] >= 1
+    assert engine.program_summary()["compiles"] > 0
+    matching = [w for w in caught if "progcache" in str(w.message)]
+    assert len(matching) == 1, [str(w.message) for w in caught]
+
+
+# ------------------------------------------------------------- precompile
+def test_precompile_then_live_traffic_zero_compiles(tmp_path):
+    _enable(tmp_path)
+    suite = mt.MetricCollection(
+        {"acc": mt.Accuracy(num_classes=2), "mean": mt.MeanMetric()}
+    )
+    sds = jax.ShapeDtypeStruct((16,), jnp.int32)
+    report = suite.precompile(sds, sds, defer_chunks=8, forward=False)
+    assert report["programs"] > 0
+
+    before = engine.program_summary()["compiles"]
+    rng = np.random.RandomState(3)
+    for n in (4, 3, 7, 1, 6):  # ragged: exercises every pow2 flush chunk
+        for _ in range(n):
+            suite.update(*_acc_batch(rng))
+        suite.compute()
+    assert engine.program_summary()["compiles"] == before
+
+
+def test_precompile_restores_state(tmp_path):
+    _enable(tmp_path)
+    suite = mt.MetricCollection({"mean": mt.MeanMetric()})
+    suite.update(jnp.ones((16,)))
+    want = np.asarray(suite.compute()["mean"])
+    suite.precompile(jax.ShapeDtypeStruct((16,), jnp.float32), defer_chunks=2)
+    got = np.asarray(suite.compute()["mean"])
+    assert want.tobytes() == got.tobytes()
+
+
+# ------------------------------------------------------ disabled by default
+def test_disabled_by_default_probes_nothing(tmp_path, monkeypatch):
+    store = tmp_path / "never"
+    monkeypatch.setenv("METRICS_TPU_PROGCACHE_DIR", str(store))
+    assert not progcache.enabled()
+
+    _run_traffic(*SUITES["accuracy"])
+    assert not store.exists()
+    assert all(v == 0 for v in progcache.progcache_stats().values())
+    assert progcache.stored_sigs("collection-deferred-update", "x") == frozenset()
+
+
+def test_garbage_enable_knob_warns_once_and_stays_off(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_PROGCACHE", "banana")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert not progcache.enabled()
+        assert not progcache.enabled()
+    matching = [w for w in caught if "METRICS_TPU_PROGCACHE" in str(w.message)]
+    assert len(matching) == 1

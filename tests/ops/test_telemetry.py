@@ -258,7 +258,15 @@ def test_snapshot_schema_superset_and_stable():
     assert snap["snapshot_schema"] == 1
     assert set(snap) == set(mt.telemetry_snapshot()), "snapshot keys drift call-over-call"
     progs = snap["programs"]
-    assert set(progs) == {"count", "compiles", "compile_time_s", "hits", "donated_runs", "plain_runs"}
+    assert set(progs) == {
+        "count",
+        "compiles",
+        "compile_time_s",
+        "cache_load_time_s",
+        "hits",
+        "donated_runs",
+        "plain_runs",
+    }
     health = snap["sync_health"]
     assert set(health) == {
         "monotonic_step",

@@ -956,6 +956,40 @@ def main() -> None:
     except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
         print(json.dumps({"metric": "arena_suites(arena)", "error": str(err)[:160]}))
 
+    # cold_start row (ISSUE 18): replica replacement with the persistent
+    # program cache — warm_boot_compiles is what sweep_regress gates at
+    # --warm-boot-compile-ceiling (default 0.0: a warmed replica re-enters
+    # the fleet compiling NOTHING); first-result latency cold vs warmed and
+    # the replacement wall ride along. Methodology (in-process boots around
+    # engine resets; the two-process certification runs in make dryrun)
+    # lives in bench.py bench_cold_start, reused here verbatim.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_cold_start()
+        row = {
+            "metric": "cold_start(progcache)",
+            "mode": "boot",
+            # boots-to-first-result per second on the warmed path: the
+            # rate a rolling restart can cycle replicas at
+            "updates_per_s": round(1000.0 / probe["warm_first_result_ms"], 1)
+            if probe["warm_first_result_ms"] > 0
+            else 0.0,
+            "cold_first_result_ms": probe["cold_first_result_ms"],
+            "warm_first_result_ms": probe["warm_first_result_ms"],
+            "first_result_speedup": probe["first_result_speedup"],
+            "warm_boot_compiles": probe["warm_boot_compiles"],
+            "warm_hits": probe["warm_hits"],
+            "cold_compiles": probe["cold_compiles"],
+            "cold_stores": probe["cold_stores"],
+            "store_bytes": probe["store_bytes"],
+            "replacement_wall_ms": probe["replacement_wall_ms"],
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "cold_start(progcache)", "error": str(err)[:160]}))
+
     # drift_report row (ISSUE 15): one PSI/KS drift computation over two
     # 4096-sample vectors — the psi/ks columns double as a determinism
     # canary (fixed seed, fixed shift: a changed score means the binning

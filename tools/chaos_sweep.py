@@ -274,6 +274,8 @@ def scenario_kill_rank_quorum_rejoin() -> dict:
     merge over survivors {0,1}; rank 2 restores its journal, rejoins (next
     epoch), and the post-rejoin full-world sync is bit-exact vs an
     uninterrupted run. Zero stale-epoch collectives issued, counter-asserted."""
+    from metrics_tpu.ops import progcache
+
     engine.reset_engine()
     psync.reset_membership()
     faults.set_recovery_policy(steps=1)
@@ -285,7 +287,14 @@ def scenario_kill_rank_quorum_rejoin() -> dict:
             METRICS_TPU_SYNC_DEGRADED="quorum",
             METRICS_TPU_SYNC_RETRIES="1",
             METRICS_TPU_SYNC_DEAD_AFTER="2",
+            # the revived rank must serve its first post-rejoin compute
+            # without a recompile stall: every program the pre-kill world
+            # compiled is exported to this store, and the post-kill
+            # reset_engine() below simulates the replacement process
+            METRICS_TPU_PROGCACHE="1",
+            METRICS_TPU_PROGCACHE_DIR=os.path.join(d, "progstore"),
         ) as env:
+            progcache.configure(reset=True)
             env.simulate_distributed()
             suites = []
             for r in range(3):
@@ -316,7 +325,7 @@ def scenario_kill_rank_quorum_rejoin() -> dict:
                     for r in live
                 ]
 
-            killed = {"dead": True}
+            killed = {"dead": False}
             psync.set_expected_world(3)
             psync.set_peer_prober(lambda: [2])
 
@@ -353,9 +362,19 @@ def scenario_kill_rank_quorum_rejoin() -> dict:
             bucketing._host_allgather = host
             bucketing._payload_allgather = payload
 
+            # steady state before the kill: one full-world sync so every
+            # program the fleet dispatches (pack AND unpack) compiles — and,
+            # with the persistent program cache on, lands in the store the
+            # replacement process will boot from
+            pre_kill = {k: np.asarray(v) for k, v in suites[0].compute().items()}
+            ok = all(_eq(pre_kill[k], full_oracle[k]) for k in full_oracle)
+            for _, m in suites[0].items(keep_base=True, copy_state=False):
+                m._computed = None
+            killed["dead"] = True
+
             # kill-rank mid-sync -> K timeouts -> dead declared -> quorum serve
             got = {k: np.asarray(v) for k, v in suites[0].compute().items()}
-            ok = all(_eq(got[k], quorum_oracle[k]) for k in quorum_oracle)
+            ok = ok and all(_eq(got[k], quorum_oracle[k]) for k in quorum_oracle)
             ok = ok and not all(_eq(got[k], full_oracle[k]) for k in full_oracle)
             ok = ok and not all(_eq(got[k], local_oracle[k]) for k in local_oracle)
             stats = engine.engine_stats()
@@ -365,7 +384,12 @@ def scenario_kill_rank_quorum_rejoin() -> dict:
             ok = ok and health["degraded"] and health["degraded_tier"] == "quorum"
 
             # rank 2 restarts: journal restore + rejoin (next epoch); the
-            # revived transport answers for the full world again
+            # revived transport answers for the full world again. The
+            # restart is a REPLACEMENT PROCESS: its in-memory program cache
+            # starts empty (reset_engine), and only the persistent program
+            # store — populated by the pre-kill world's compiles — stands
+            # between its first post-rejoin compute and a recompile stall
+            engine.reset_engine()
             restored = _suite()
             rejoin_info = restored.rejoin(rank2_path, rank=2)
             suites[2] = restored
@@ -376,18 +400,29 @@ def scenario_kill_rank_quorum_rejoin() -> dict:
             # the survivors' recovery edge (steps=1) re-probes the FULL world
             for _, m in suites[0].items(keep_base=True, copy_state=False):
                 m._computed = None
+            compiles_before = engine.program_summary()["compiles"]
             got2 = {k: np.asarray(v) for k, v in suites[0].compute().items()}
+            post_rejoin_compiles = engine.program_summary()["compiles"] - compiles_before
+            post_rejoin_hits = int(engine.engine_stats()["progcache_hits"])
             ok = ok and all(_eq(got2[k], full_oracle[k]) for k in full_oracle)
             ok = ok and not suites[0].sync_health()["degraded"]
             # the certified invariant: no collective ever went out stale
             ok = ok and engine.engine_stats()["sync_stale_collectives"] == 0
+            # ...and the revived world's first compute recompiled NOTHING:
+            # every program it dispatched rehydrated from the persistent
+            # store (counter-asserted — the zero-recompile rolling restart)
+            ok = ok and post_rejoin_compiles == 0
+            ok = ok and post_rejoin_hits > 0
         return {
             "scenario": "kill-rank-quorum-rejoin",
             "ok": bool(ok),
             "epoch": psync.world_epoch(),
+            "post_rejoin_compiles": int(post_rejoin_compiles),
+            "post_rejoin_progcache_hits": post_rejoin_hits,
         }
     finally:
         faults.set_recovery_policy(steps=8)
+        progcache.configure(reset=True)
         psync.reset_membership()
 
 

@@ -38,9 +38,12 @@ archived phase columns say so rather than guessing.
 Exit 1 when any metric's ratio worsened by more than ``threshold``x, a p50
 latency worsened by more than ``p50-threshold``x, a p99/p50 tail ratio grew
 by more than ``tail-threshold``x, a row's mode flipped jit->eager, a
-previously-present metric disappeared, or a tenant-arena row fell below the
+previously-present metric disappeared, a tenant-arena row fell below the
 ``--arena-speedup-floor`` (default 10x over the per-instance loop at the
-100k tier) or started retracing per add (ISSUE 17).
+100k tier) or started retracing per add (ISSUE 17), or a cold-start row's
+``warm_boot_compiles`` rose above ``--warm-boot-compile-ceiling`` (default
+0.0 — a warmed replica must re-enter the fleet compiling nothing;
+ISSUE 18).
 """
 from __future__ import annotations
 
@@ -65,6 +68,7 @@ def compare(
     close_collective_ceiling: float = 1.0,
     ingraph_collective_ceiling: float = 0.0,
     arena_speedup_floor: float = 10.0,
+    warm_boot_compile_ceiling: float = 0.0,
 ) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
@@ -190,6 +194,23 @@ def compare(
                 f"{name}: retraces_per_add {float(new_rpa):.2f} (>= 1: every tenant "
                 "add now retraces — the slab-bucketed shape set broke)"
             )
+        # ---- the cold-start gate (ISSUE 18): a row that archived
+        # warm_boot_compiles made the zero-recompile-restart promise — a
+        # warmed replica (persistent progcache + precompile on boot) must
+        # serve its whole first traffic ladder without one fresh compile.
+        # The ceiling is EXACTLY 0 by default: any rise means a program
+        # stopped round-tripping through the store and every rolling
+        # restart pays a recompile stall per replica ----
+        new_wbc = new_row.get("warm_boot_compiles")
+        if new_wbc is not None and float(new_wbc) > warm_boot_compile_ceiling:
+            old_wbc = old_row.get("warm_boot_compiles")
+            problems.append(
+                f"{name}: warm_boot_compiles "
+                f"{'(unrecorded)' if old_wbc is None else f'{float(old_wbc):.0f}'} -> "
+                f"{float(new_wbc):.0f} (above the {warm_boot_compile_ceiling:g} "
+                "ceiling — a warmed boot re-entered the fleet paying fresh "
+                "compiles; the persistent program cache stopped covering it)"
+            )
     return problems
 
 
@@ -252,7 +273,8 @@ _USAGE = (
     "usage: sweep_regress.py [--threshold X] [--p50-threshold X] "
     "[--tail-threshold X] [--wire-hidden-floor X] "
     "[--close-collective-ceiling X] [--ingraph-collective-ceiling X] "
-    "[--arena-speedup-floor X] [--explain] OLD.json NEW.json"
+    "[--arena-speedup-floor X] [--warm-boot-compile-ceiling X] "
+    "[--explain] OLD.json NEW.json"
 )
 
 
@@ -268,7 +290,8 @@ def main(argv) -> int:
     argv, close_ceiling, ok5 = _pop_flag(argv, "--close-collective-ceiling", 1.0)
     argv, ingraph_ceiling, ok6 = _pop_flag(argv, "--ingraph-collective-ceiling", 0.0)
     argv, arena_floor, ok7 = _pop_flag(argv, "--arena-speedup-floor", 10.0)
-    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok7) or len(argv) != 2:
+    argv, warm_boot_ceiling, ok8 = _pop_flag(argv, "--warm-boot-compile-ceiling", 0.0)
+    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok7 and ok8) or len(argv) != 2:
         print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
@@ -283,6 +306,7 @@ def main(argv) -> int:
         close_ceiling,
         ingraph_ceiling,
         arena_floor,
+        warm_boot_ceiling,
     )
     if problems:
         print("\n".join(problems))
