@@ -33,6 +33,7 @@ import json
 import os
 import sys
 import time
+import warnings
 
 import numpy as np
 
@@ -1542,6 +1543,84 @@ def bench_arena_suites() -> dict:
     return out
 
 
+def bench_ingest_gateway() -> dict:
+    """``ingest_gateway``: the admission-controlled front door (ISSUE 19).
+    Three numbers: sustained admitted rows/s through ``offer()`` + ``flush()``
+    into a ``MetricArena`` (columnar staging + occurrence-split dispatch
+    riding the arena's pow2-chunked vmapped program), per-offer latency
+    percentiles on the pinned-schema fast path, and the shed fraction at
+    exactly 2x overload against a bounded row watermark — with the
+    settlement accounting identity (`offered == admitted + coalesced + shed
+    + quarantined`) checked exactly after the drain.
+    ``tools/sweep_regress.py`` gates the overload row at
+    ``--ingest-shed-ceiling`` (a gateway that sheds MORE than the overload
+    excess is throwing away admissible load) and fails any run where the
+    identity broke."""
+    import jax
+
+    from metrics_tpu.aggregation import MeanMetric
+    from metrics_tpu.arena import MetricArena
+    from metrics_tpu.ingest import IngestGateway
+    from metrics_tpu.ops import engine
+
+    engine.reset_stats()
+    rng = np.random.RandomState(19)
+    tenants = 64 if SMOKE else 256
+    rows = tenants  # one row per tenant per payload
+    payloads = 8 if SMOKE else 64
+    arena = MetricArena(MeanMetric(), capacity=tenants, slab=min(64, tenants), name="bench_ingest")
+    ids = np.asarray(arena.add(tenants))
+    gw = IngestGateway(
+        arena, name="bench_ingest", auto_flush=True,
+        max_rows=rows * payloads * 2, flush_rows=rows * 8,
+    )
+    x = rng.rand(rows, 4).astype(np.float32)
+    gw.offer(x, tenant_ids=ids)
+    gw.flush()  # warmup: pins the schema + compiles the arena chunk program
+    jax.block_until_ready(jax.tree.leaves(arena._stacked))
+    best = float("inf")
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        for _ in range(payloads):
+            gw.offer(x, tenant_ids=ids)
+        gw.flush()
+        jax.block_until_ready(jax.tree.leaves(arena._stacked))
+        best = min(best, time.perf_counter() - start)
+    admitted_per_s = rows * payloads / best if best > 0 else 0.0
+    lat = _latency_percentiles(lambda: gw.offer(x, tenant_ids=ids), payloads)
+    gw.flush()
+    gw.close()
+
+    # 2x overload: a bounded gateway fed exactly twice its row watermark
+    # with no consumer until the burst ends — the shed fraction should sit
+    # at the overload excess (~0.5), never above the regression ceiling
+    engine.reset_stats()
+    over = IngestGateway(
+        arena, name="bench_ingest_2x", auto_flush=False, max_rows=rows * payloads,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the shed warning is the point
+        for _ in range(payloads * 2):
+            over.offer(x, tenant_ids=ids)
+        over.flush()
+        s = engine.engine_stats()
+        shed_fraction = s["ingest_shed_rows"] / max(1, s["ingest_offered_rows"])
+        exact = s["ingest_offered_rows"] == (
+            s["ingest_admitted_rows"] + s["ingest_coalesced_rows"]
+            + s["ingest_shed_rows"] + s["ingest_quarantined_rows"]
+        )
+        over.close()
+    return {
+        "admitted_updates_per_s": round(admitted_per_s, 1),
+        "latency_ms": lat,
+        "shed_fraction_2x": round(float(shed_fraction), 4),
+        "accounting_exact": bool(exact),
+        "tenants": tenants,
+        "payload_rows": rows,
+        "payloads_per_flush": payloads,
+    }
+
+
 def bench_cold_start() -> dict:
     """``cold_start``: fleet replica replacement (ISSUE 18) — first-result
     latency and compiles-per-boot for a fresh engine, cold (empty store)
@@ -1815,6 +1894,10 @@ def main() -> None:
     # scales out (ISSUE 17): same pure kernels, but N suites share ONE
     # vmapped donated program instead of N dispatch loops
     arena_probe = bench_arena_suites()
+    # the ingest-gateway probe rides right after the arena row it fronts
+    # (ISSUE 19): same vmapped arena regime, with admission control between
+    # the caller and the update machinery
+    ingest_probe = bench_ingest_gateway()
     # the cold-start probe rides AFTER the arena row and resets the engine
     # around itself (each boot must start with a cold program registry —
     # that is the thing being measured); rows before it keep their regime
@@ -2258,6 +2341,29 @@ def main() -> None:
                 "dispatch, the arena pays one dispatch per pow2 chunk — "
                 "compile count stays bounded by the slab-bucket set at any "
                 "tenant count (docs/performance.md Tenant arenas)"
+            ),
+        },
+        "ingest_gateway": {
+            # ISSUE 19: the admission-controlled front door. Sustained
+            # admitted rows/s through offer()+flush() into the arena, the
+            # per-offer latency distribution, and the shed fraction at 2x
+            # overload with the settlement accounting identity checked
+            # exactly — sweep_regress gates shed_fraction_2x at
+            # --ingest-shed-ceiling and fails on a broken identity.
+            "admitted_updates_per_s": ingest_probe["admitted_updates_per_s"],
+            "latency_ms": ingest_probe["latency_ms"],
+            "shed_fraction_2x": ingest_probe["shed_fraction_2x"],
+            "accounting_exact": ingest_probe["accounting_exact"],
+            "tenants": ingest_probe["tenants"],
+            "payload_rows": ingest_probe["payload_rows"],
+            "payloads_per_flush": ingest_probe["payloads_per_flush"],
+            "unit": "admitted tenant-rows/s through the gateway",
+            "note": (
+                "columnar staging + schema-fingerprint admission in front "
+                "of the arena's vmapped update (ingest.py): watermark-"
+                "bounded staging, coalesce-before-shed under SLO pressure, "
+                "poison quarantine — docs/robustness.md Overload & "
+                "admission control"
             ),
         },
         "cold_start": {

@@ -172,6 +172,15 @@ from metrics_tpu.arena import (  # noqa: E402
     unstack_states,
 )
 
+# the overload-safe ingestion gateway (docs/robustness.md "Overload &
+# admission control"): columnar staging, SLO-driven admission tiers,
+# poison-payload quarantine, exact settlement accounting
+from metrics_tpu.ingest import (  # noqa: E402
+    IngestGateway,
+    ingest_state,
+    ingest_stats,
+)
+
 # world membership (docs/robustness.md "World membership"): epoch registry +
 # peer-health surface behind epoch-fenced collectives and quorum compute
 from metrics_tpu.parallel.sync import world_health  # noqa: E402
@@ -218,6 +227,9 @@ __all__ = [
     "arena_stats",
     "stack_states",
     "unstack_states",
+    "IngestGateway",
+    "ingest_state",
+    "ingest_stats",
     "Metric",
     "CompositionalMetric",
     "MetricCollection",

@@ -855,6 +855,12 @@ def engine_stats() -> Dict[str, Any]:
     # the persistent program cache (hits/misses/stores/demotions/evictions
     # — ops/progcache.py; imported at module level, no laziness needed)
     out.update(_progcache.progcache_stats())
+    # the ingestion gateway's settlement counters (offered / admitted /
+    # coalesced / shed / quarantined rows, flush traffic) — lazy: the
+    # gateway imports engine through the arena it routes into
+    from metrics_tpu import ingest as _ingest
+
+    out.update(_ingest.ingest_stats())
     return out
 
 

@@ -53,6 +53,7 @@ from metrics_tpu.utils.exceptions import (
     EpochFault,
     FaultError,
     HostOffloadFault,
+    IngestFault,
     JournalFault,
     RuntimeFault,
     SyncFault,
@@ -101,7 +102,10 @@ TIERS = ("fused", "chunked", "eager", "host")
 #: entry epoch goes stale mid-flight. ``progcache-load``/``progcache-store``
 #: fire before a persistent program-cache entry is read/written: a load
 #: failure demotes the store's ``progcache`` ladder lane so traffic falls
-#: back to fresh compiles (never a wrong program).
+#: back to fresh compiles (never a wrong program). ``ingest-admit`` fires at
+#: the gateway door before a payload is staged (modelling poison admission);
+#: ``ingest-shed`` fires in the overload shed/flush path — both are settled
+#: into the gateway's exact accounting instead of raising into the caller.
 FAULT_SITES = (
     "probe",
     "compile",
@@ -115,6 +119,8 @@ FAULT_SITES = (
     "journal-load",
     "progcache-load",
     "progcache-store",
+    "ingest-admit",
+    "ingest-shed",
 )
 
 _SITE_DEFAULT_EXC = {
@@ -137,6 +143,10 @@ _SITE_DEFAULT_EXC = {
     # recovery story (demote to a fresh compile, never a wrong program)
     "progcache-load": JournalFault,
     "progcache-store": JournalFault,
+    # ingest domain: admission-control events — a payload rejected at the
+    # gateway door (poison quarantine) or evicted from staging under overload
+    "ingest-admit": IngestFault,
+    "ingest-shed": IngestFault,
 }
 
 _DOMAIN_EXC = {
@@ -147,6 +157,7 @@ _DOMAIN_EXC = {
     "host": HostOffloadFault,
     "sync": SyncFault,
     "journal": JournalFault,
+    "ingest": IngestFault,
 }
 
 

@@ -612,7 +612,10 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     model-monitoring families (``metrics_tpu_metric_value{name,window}``
     per-window metric values, ``metrics_tpu_drift_score{name,kind}`` PSI/KS
     scores, ``metrics_tpu_fleet_window_id{name}`` and the per-rank
-    ``metrics_tpu_fleet_window_skew{rank,name}`` lag attribution), and
+    ``metrics_tpu_fleet_window_skew{rank,name}`` lag attribution), the
+    ingestion-gateway families (``metrics_tpu_ingest_staging_rows`` /
+    ``_staging_bytes`` / ``_degraded`` / ``_quarantine_depth``, with
+    ``rank`` + ``gateway`` labels), and
     the latency **histogram** families: the fleet-merged
     ``metrics_tpu_fleet_latency_seconds{site=...,le=...}`` (exact bucket
     sums across ranks) and the rank-labelled
@@ -778,6 +781,33 @@ def fleet_prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
         for rank, lag in (entry.get("per_rank_lag") or {}).items():
             skew_samples.append((f'{{rank="{rank}",name="{wname}"}}', float(lag)))
     family("metrics_tpu_fleet_window_skew", "gauge", skew_samples)
+
+    # the ingestion-gateway families (ingest.py): per-rank, per-gateway
+    # staging occupancy, degraded-tier flags and quarantine depth — the
+    # admission-control surface a fleet dashboard alerts on (the ingest_*
+    # settlement counters already aggregate above as metrics_tpu_fleet_*)
+    ingest_samples: Dict[str, List[Tuple[str, float]]] = {
+        "staging_rows": [], "staging_bytes": [], "degraded": [], "quarantine_depth": []
+    }
+    for rank in sorted(ranks):
+        plane = ranks[rank]
+        if not _is_live_plane(plane):
+            continue
+        gw_blocks = ((plane.get("ingest_state") or {}).get("gateways")) or {}
+        for gname, st in gw_blocks.items():
+            if not isinstance(st, dict):
+                continue
+            glabel = f'{{rank="{rank}",gateway="{gname}"}}'
+            ingest_samples["staging_rows"].append((glabel, float(st.get("staging_rows", 0))))
+            ingest_samples["staging_bytes"].append((glabel, float(st.get("staging_bytes", 0))))
+            ingest_samples["degraded"].append((glabel, 1.0 if st.get("degraded") else 0.0))
+            ingest_samples["quarantine_depth"].append(
+                (glabel, float(st.get("quarantine_depth", 0)))
+            )
+    family("metrics_tpu_ingest_staging_rows", "gauge", ingest_samples["staging_rows"])
+    family("metrics_tpu_ingest_staging_bytes", "gauge", ingest_samples["staging_bytes"])
+    family("metrics_tpu_ingest_degraded", "gauge", ingest_samples["degraded"])
+    family("metrics_tpu_ingest_quarantine_depth", "gauge", ingest_samples["quarantine_depth"])
 
     lines: List[str] = []
     for name, kind, samples in families:

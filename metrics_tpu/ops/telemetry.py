@@ -165,6 +165,11 @@ SPAN_SITES = {
     "progcache-store": "one persistent program-cache store: export + "
     "serialize a freshly compiled program, CRC-frame it, atomic write + "
     "size-capped LRU sweep",
+    # ingestion gateway (ingest.py)
+    "ingest-offer": "one payload offered at the gateway door: fingerprint "
+    "check + stage/coalesce/shed/quarantine settlement",
+    "ingest-flush": "one staging drain: staged payloads routed into target "
+    "update() dispatches (arena pow2-chunked or suite deferral)",
 }
 
 #: The sync-protocol phases the fleet straggler report attributes
@@ -821,6 +826,14 @@ def snapshot() -> Dict[str, Any]:
     from metrics_tpu import streaming as _streaming
 
     out["streaming"] = _streaming.streaming_snapshot()
+    # the ingestion-gateway plane: staging occupancy, degraded flags and
+    # quarantine depth per live gateway (ingest.py). The ingest_* EVENT
+    # counters already rode in through engine_stats(); this block is gateway
+    # STATE — its flattened keys start "ingest_state_" and scrape as gauges
+    # (staging drains, degraded clears, quarantine rings rotate)
+    from metrics_tpu import ingest as _ingest
+
+    out["ingest_state"] = _ingest.ingest_state()
     return out
 
 
@@ -858,6 +871,9 @@ _COUNTER_PREFIXES = (
     # the persistent program cache: entry hits/misses/stores, classified
     # demotions, size-cap evictions (ops/progcache.py)
     "progcache_",
+    # the ingestion gateway's settlement counters: offered / admitted /
+    # coalesced / shed / quarantined rows and flush traffic (ingest.py)
+    "ingest_",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —
@@ -871,7 +887,7 @@ _GAUGE_SUFFIXES = ("_ratio", "_p50_s", "_p95_s", "_p99_s", "_max_s")
 # and totals can fall too. The flattened streaming block is window STATE
 # (window ids jump on rejoin, per-window values and drift scores move both
 # ways) — the value-gauge carve-out beside the window_*/drift_* counters.
-_GAUGE_PREFIXES = ("sync_health_", "sync_phase_stats_", "streaming_")
+_GAUGE_PREFIXES = ("sync_health_", "sync_phase_stats_", "streaming_", "ingest_state_")
 
 
 def is_counter_key(key: str) -> bool:

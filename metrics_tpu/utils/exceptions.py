@@ -21,7 +21,16 @@ class MetricsUserError(Exception):
 # --------------------------------------------------------------- fault domains
 #: Canonical failure-domain names, in ladder-relevant order. Every
 #: :class:`FaultError` subclass carries one of these as ``domain``.
-FAULT_DOMAINS = ("trace", "compile", "runtime", "donation", "host", "sync", "journal")
+FAULT_DOMAINS = (
+    "trace",
+    "compile",
+    "runtime",
+    "donation",
+    "host",
+    "sync",
+    "journal",
+    "ingest",
+)
 
 
 class FaultError(Exception):
@@ -118,6 +127,17 @@ class EpochFault(SyncFault):
     immediately (the caller re-enters at the current epoch instead)."""
 
 
+class IngestFault(FaultError):
+    """Ingestion-gateway admission failure: a payload was shed under overload
+    (bounded staging watermarks, degraded-tier load shedding) or quarantined
+    as poison (schema mismatch against the pinned fingerprint, NaN/Inf storm).
+    Never surfaces mid-suite — the gateway settles every offered row into the
+    accounting identity and routes the event through the taxonomy instead of
+    raising into the caller's update loop."""
+
+    domain = "ingest"
+
+
 class JournalFault(FaultError):
     """State-journal failure: a record could not be written, or a stored
     record is torn / checksum-failed / layout-incompatible on load. Load
@@ -134,6 +154,7 @@ __all__ = [
     "EpochFault",
     "FaultError",
     "HostOffloadFault",
+    "IngestFault",
     "JournalFault",
     "MetricsUserError",
     "RuntimeFault",

@@ -97,3 +97,53 @@ def test_sync_rows_use_coalesced_phase_spelling():
     problems = compare(old, new)
     lines = explain(old, new, problems)
     assert lines and "regressed phase: wire" in lines[0]
+
+
+def _ingest_row(shed, exact=True):
+    return dict(
+        _row("ingest_gateway(ingest)", 1.0),
+        ingest_shed_fraction_2x=shed,
+        accounting_exact=exact,
+    )
+
+
+def test_ingest_shed_ceiling_gate():
+    old = {"rows": [_ingest_row(0.5)]}
+    # shedding 80% at 2x overload: admissible load is being thrown away
+    new = {"rows": [_ingest_row(0.8)]}
+    problems = compare(old, new)
+    assert any("ingest_shed_fraction_2x" in p for p in problems)
+    # the excess fraction itself (0.5) passes the default 0.6 ceiling
+    assert not compare(old, {"rows": [_ingest_row(0.5)]})
+    # a raised ceiling admits the same row
+    assert not compare(old, new, ingest_shed_ceiling=0.9)
+    # an old artifact without the column still gates the new one
+    bare_old = {"rows": [_row("ingest_gateway(ingest)", 1.0)]}
+    problems = compare(bare_old, new)
+    assert any("(unrecorded)" in p and "ingest_shed_fraction_2x" in p for p in problems)
+
+
+def test_ingest_accounting_exact_is_a_hard_failure():
+    old = {"rows": [_ingest_row(0.5)]}
+    new = {"rows": [_ingest_row(0.5, exact=False)]}
+    problems = compare(old, new)
+    assert any("accounting_exact false" in p for p in problems)
+
+
+def test_cli_accepts_ingest_shed_ceiling_flag(tmp_path):
+    a, b = tmp_path / "old.json", tmp_path / "new.json"
+    a.write_text(json.dumps({"rows": [_ingest_row(0.5)]}))
+    b.write_text(json.dumps({"rows": [_ingest_row(0.8)]}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sweep_regress.py"),
+         "--ingest-shed-ceiling", "0.9", str(a), str(b)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sweep_regress.py"),
+         str(a), str(b)],
+        capture_output=True, text=True,
+    )
+    assert r2.returncode == 1
+    assert "ingest_shed_fraction_2x" in r2.stdout

@@ -956,6 +956,34 @@ def main() -> None:
     except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
         print(json.dumps({"metric": "arena_suites(arena)", "error": str(err)[:160]}))
 
+    # ingest_gateway row (ISSUE 19): the admission-controlled front door —
+    # ingest_shed_fraction_2x and accounting_exact are what sweep_regress
+    # gates round over round (--ingest-shed-ceiling: a gateway shedding
+    # more than the overload excess is throwing away admissible load; a
+    # broken settlement identity is a correctness failure, not a perf
+    # regression); admitted throughput and the per-offer latency
+    # distribution ride along. Methodology (pinned-schema fast path,
+    # exactly-2x burst against a bounded watermark) lives in bench.py
+    # bench_ingest_gateway, reused here verbatim.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_ingest_gateway()
+        row = {
+            "metric": "ingest_gateway(ingest)",
+            "mode": "sync",
+            "updates_per_s": probe["admitted_updates_per_s"],
+            "ingest_shed_fraction_2x": probe["shed_fraction_2x"],
+            "accounting_exact": probe["accounting_exact"],
+            "tenants": probe["tenants"],
+            "payload_rows": probe["payload_rows"],
+            "latency_ms": probe["latency_ms"],
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "ingest_gateway(ingest)", "error": str(err)[:160]}))
+
     # cold_start row (ISSUE 18): replica replacement with the persistent
     # program cache — warm_boot_compiles is what sweep_regress gates at
     # --warm-boot-compile-ceiling (default 0.0: a warmed replica re-enters

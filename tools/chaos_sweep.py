@@ -59,6 +59,21 @@ Scenarios:
   previous good generation (classified, counted), so the recovered window
   is the previous good window — re-accumulated only from records that
   verify, never from corrupt bytes.
+- ``burst-arrival-shed`` — a 2x-overload arrival burst at a bounded
+  ``IngestGateway``: watermarks shed exactly the excess (never exceeded,
+  byte- and row-asserted per offer), the settlement accounting identity is
+  exact, and the admitted rows land bit-exactly on the oracle that saw only
+  the admitted payloads.
+- ``poison-payload-quarantine`` — a poison storm at the gateway door
+  (schema mismatch, NaN/Inf storm, an injected ``ingest-admit`` fault):
+  every poison classifies into the bounded quarantine ring without a raise,
+  and the target's state stays bit-intact.
+- ``slow-consumer-backlog`` — a stalled consumer lets the backlog climb
+  while the SLO budget fires: the gateway demotes to the degraded tier,
+  coalesces same-schema load instead of growing the tail, sheds the rest;
+  the woken consumer's drain absorbs an injected ``ingest-shed`` apply
+  fault (quarantined, drain continues) and the clean follow-up flush walks
+  the recovery edge back to the normal tier — accounting exact throughout.
 
 ``--fast`` runs everything except the deferral interaction (the
 ``make faults`` / CI subset); the full sweep adds it. One JSON line per
@@ -731,6 +746,161 @@ def scenario_torn_window_ring_slot() -> dict:
     }
 
 
+def _ingest_identity_exact() -> bool:
+    """The settlement accounting identity, as a pure counter check (staging
+    must be drained before calling): offered == admitted + coalesced + shed
+    + quarantined, row-exact."""
+    s = engine.engine_stats()
+    return s["ingest_offered_rows"] == (
+        s["ingest_admitted_rows"] + s["ingest_coalesced_rows"]
+        + s["ingest_shed_rows"] + s["ingest_quarantined_rows"]
+    )
+
+
+def scenario_burst_arrival_shed() -> dict:
+    """A 2x-overload burst at a bounded gateway: the watermark sheds exactly
+    the excess (and is never exceeded mid-burst), the accounting identity is
+    exact after the drain, and the admitted rows are bit-exact vs the oracle
+    that saw only the admitted payloads."""
+    engine.reset_engine()
+    from metrics_tpu.ingest import IngestGateway
+
+    arena = mt.MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="chaos-burst")
+    oracle = mt.MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="chaos-burst-oracle")
+    ids = np.asarray(arena.add(8))
+    oracle.add(8)
+    gw = IngestGateway(arena, name="chaos-burst", auto_flush=False, max_rows=64)
+    rng = np.random.RandomState(7)
+    admitted = shed = 0
+    bounded = True
+    for _ in range(16):  # 16 payloads x 8 rows = 128 offered at a 64-row watermark
+        x = rng.rand(8, 2).astype(np.float32)
+        out = gw.offer(x, tenant_ids=ids)
+        if out["outcome"] == "staged":
+            oracle.update(ids, x)
+            admitted += out["rows"]
+        else:
+            shed += out["rows"]
+        st = gw.state()
+        bounded = bounded and st["staging_rows"] <= gw.max_rows
+        bounded = bounded and st["staging_bytes"] <= gw.max_bytes
+    gw.flush()
+    s = engine.engine_stats()
+    ok = bounded and admitted == 64 and shed == 64
+    ok = ok and s["ingest_admitted_rows"] == admitted and s["ingest_shed_rows"] == shed
+    ok = ok and _ingest_identity_exact()
+    ok = ok and s["fault_ingest"] >= 1  # sheds route through the fault taxonomy
+    ok = ok and _eq(arena.compute(list(ids)), oracle.compute(list(ids)))
+    gw.close()
+    return {
+        "scenario": "burst-arrival-shed",
+        "ok": bool(ok),
+        "admitted_rows": admitted,
+        "shed_rows": shed,
+    }
+
+
+def scenario_poison_payload_quarantine() -> dict:
+    """A poison storm at the gateway door — schema mismatch, NaN storm, and
+    an injected ``ingest-admit`` admission fault: every poison classifies
+    into the bounded quarantine ring without a raise, and the target's
+    state stays bit-intact."""
+    engine.reset_engine()
+    from metrics_tpu.ingest import IngestGateway
+
+    arena = mt.MetricArena(mt.MeanMetric(), capacity=4, slab=4, name="chaos-poison")
+    ids = np.asarray(arena.add(4))
+    gw = IngestGateway(arena, name="chaos-poison", auto_flush=False, quarantine_cap=4)
+    rng = np.random.RandomState(11)
+    gw.offer(rng.rand(4, 2).astype(np.float32), tenant_ids=ids)
+    gw.flush()
+    before = np.asarray(arena.compute(list(ids)))
+    gw.offer(rng.rand(4, 3).astype(np.float32), tenant_ids=ids)  # schema mismatch
+    gw.offer(np.full((4, 2), np.nan, np.float32), tenant_ids=ids)  # NaN storm
+    with faults.inject_faults("ingest-admit") as plan:
+        gw.offer(rng.rand(4, 2).astype(np.float32), tenant_ids=ids)
+    gw.flush()
+    s = engine.engine_stats()
+    ok = plan.fired == 1
+    ring = gw.quarantined()
+    ok = ok and len(ring) == 3 and all("reason" in e and "error" in e for e in ring)
+    ok = ok and s["ingest_quarantined_payloads"] == 3
+    ok = ok and s["ingest_quarantined_rows"] == 12
+    ok = ok and s["fault_ingest"] >= 3  # every poison classified, never raised
+    ok = ok and _eq(np.asarray(arena.compute(list(ids))), before)  # target bit-intact
+    ok = ok and _ingest_identity_exact()
+    gw.close()
+    return {
+        "scenario": "poison-payload-quarantine",
+        "ok": bool(ok),
+        "quarantined": len(ring),
+    }
+
+
+def scenario_slow_consumer_backlog() -> dict:
+    """A stalled consumer lets the backlog climb while the SLO budget plane
+    fires: the gateway demotes to the degraded tier, coalesces same-schema
+    load instead of growing the tail, sheds the rest; the woken consumer's
+    drain absorbs an injected ``ingest-shed`` apply fault (that payload is
+    quarantined, the drain continues) and the clean follow-up flush walks
+    the recovery edge back to the normal tier — accounting exact throughout."""
+    engine.reset_engine()
+    from metrics_tpu.ingest import IngestGateway
+    from metrics_tpu.ops import telemetry as telemetry_mod
+
+    arena = mt.MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="chaos-backlog")
+    oracle = mt.MetricArena(mt.MeanMetric(), capacity=8, slab=4, name="chaos-backlog-oracle")
+    ids = np.asarray(arena.add(8))
+    oracle.add(8)
+    gw = IngestGateway(arena, name="chaos-backlog", auto_flush=False, max_rows=32)
+    rng = np.random.RandomState(13)
+    x = lambda: rng.rand(8, 2).astype(np.float32)  # noqa: E731
+    faults.set_recovery_policy(steps=1)
+    try:
+        a = x()
+        gw.offer(a, tenant_ids=ids)  # healthy consumer: admitted cleanly
+        gw.flush()
+        oracle.update(ids, a)
+        ok = not gw.degraded
+        ok = ok and gw.offer(x(), tenant_ids=ids)["outcome"] == "staged"  # consumer stalls
+        # the SLO budget plane reports a new violation while the backlog sits
+        telemetry_mod._slo_violations["engine-flush"] = (
+            telemetry_mod._slo_violations.get("engine-flush", 0) + 1
+        )
+        # degraded tier (watermark 32 * 0.5 = 16): same-schema load coalesces
+        # into the staged payload instead of growing the tail...
+        ok = ok and gw.offer(x(), tenant_ids=ids)["outcome"] == "coalesced"
+        ok = ok and gw.degraded
+        # ...and load past the shrunk watermark is shed, never queued
+        ok = ok and gw.offer(x(), tenant_ids=ids)["outcome"] == "shed"
+        ok = ok and gw.state()["staging_rows"] == 16
+        # the consumer wakes into an apply fault mid-drain: the poisoned
+        # payload quarantines (classified), the drain does not raise
+        with faults.inject_faults("ingest-shed") as plan:
+            gw.flush()
+        s = engine.engine_stats()
+        ok = ok and plan.fired == 1
+        ok = ok and s["ingest_apply_faults"] == 1 and s["ingest_quarantined_rows"] == 16
+        ok = ok and gw.degraded  # a faulted drain is not a recovery edge
+        e = x()
+        ok = ok and gw.offer(e, tenant_ids=ids)["outcome"] == "staged"
+        gw.flush()  # clean drain, no new violations: the standard recovery edge
+        oracle.update(ids, e)
+        ok = ok and not gw.degraded
+        ok = ok and s["ingest_degraded_offers"] >= 2
+        ok = ok and _ingest_identity_exact()
+        ok = ok and _eq(arena.compute(list(ids)), oracle.compute(list(ids)))
+    finally:
+        faults.set_recovery_policy(steps=8)
+        gw.close()
+    return {
+        "scenario": "slow-consumer-backlog",
+        "ok": bool(ok),
+        "quarantined_rows": int(engine.engine_stats()["ingest_quarantined_rows"]),
+        "recovered": not gw.degraded,
+    }
+
+
 FAST = [
     scenario_timeout_then_compile,
     scenario_crash_with_torn_journal,
@@ -742,6 +912,9 @@ FAST = [
     scenario_barrier_with_torn_generation,
     scenario_rank_dies_mid_window_close,
     scenario_torn_window_ring_slot,
+    scenario_burst_arrival_shed,
+    scenario_poison_payload_quarantine,
+    scenario_slow_consumer_backlog,
 ]
 FULL = FAST + [scenario_flush_fault_during_journal_save]
 

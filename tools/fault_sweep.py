@@ -222,6 +222,79 @@ def _scenario_journal_load(site: str):
     return ok, plan.fired
 
 
+def _scenario_progcache_store(site: str):
+    """Injected store failure while a freshly compiled program is exported to
+    the persistent cache: the compute itself stays bit-exact (a store failure
+    never surfaces to the caller), the failure classifies through the journal
+    domain, and no partial entry lands in the store."""
+    import tempfile
+
+    from metrics_tpu.ops import progcache
+
+    d = tempfile.mkdtemp(prefix="mt-fault-sweep-")
+    progcache.configure(reset=True)
+    progcache.configure(enabled=True, cache_dir=d)
+    engine.set_deferred_dispatch(False)
+    try:
+        m = mt.MeanMetric()
+        with faults.inject_faults(site, count=100) as plan:
+            for _ in range(N_STEPS):
+                m.update(A)
+            value = np.asarray(m.compute())
+        stats = engine.engine_stats()
+        ok = _tree_equal(value, _oracle_mean(N_STEPS))
+        ok = ok and stats["fault_journal"] >= 1
+        ok = ok and stats["progcache_stores"] == 0
+    finally:
+        engine.set_deferred_dispatch(True)
+        progcache.configure(reset=True)
+    return ok, plan.fired
+
+
+def _scenario_progcache_load(site: str):
+    """Warm-boot load failure: a stored entry's read fails classified mid-
+    rehydration; the replacement process demotes to a fresh compile with
+    bit-exact values (never a wrong program), and a later uninjected boot
+    rehydrates from the intact store."""
+    import tempfile
+
+    from metrics_tpu.ops import progcache
+
+    d = tempfile.mkdtemp(prefix="mt-fault-sweep-")
+    progcache.configure(reset=True)
+    progcache.configure(enabled=True, cache_dir=d)
+    engine.set_deferred_dispatch(False)
+    try:
+        warm = mt.MeanMetric()
+        for _ in range(N_STEPS):
+            warm.update(A)
+        np.asarray(warm.compute())  # populate the store
+        ok = engine.engine_stats()["progcache_stores"] >= 1
+        # replacement process: empty in-memory cache, loads injected to fail
+        engine.reset_engine()
+        with faults.inject_faults(site, count=100) as plan:
+            m = mt.MeanMetric()
+            for _ in range(N_STEPS):
+                m.update(A)
+            value = np.asarray(m.compute())
+        ok = ok and _tree_equal(value, _oracle_mean(N_STEPS))
+        ok = ok and engine.engine_stats()["fault_journal"] >= 1
+        # uninjected boot: the store was never corrupted — entries rehydrate
+        engine.reset_engine()
+        progcache.configure(reset=True)  # clear the demoted store lane
+        progcache.configure(enabled=True, cache_dir=d)
+        hits0 = engine.engine_stats()["progcache_hits"]
+        fresh = mt.MeanMetric()
+        for _ in range(N_STEPS):
+            fresh.update(A)
+        ok = ok and _tree_equal(np.asarray(fresh.compute()), _oracle_mean(N_STEPS))
+        ok = ok and engine.engine_stats()["progcache_hits"] > hits0
+    finally:
+        engine.set_deferred_dispatch(True)
+        progcache.configure(reset=True)
+    return ok, plan.fired
+
+
 def _scenario_host_offload(site: str):
     rows = jnp.asarray([1.0, 2.0])
     c = mt.CatMetric(compute_on_cpu=True)
@@ -247,12 +320,15 @@ SWEEP = {
     "host-offload": _scenario_host_offload,
     "journal-write": _scenario_journal_write,
     "journal-load": _scenario_journal_load,
+    "progcache-store": _scenario_progcache_store,
+    "progcache-load": _scenario_progcache_load,
 }
 
 # Site families exercised by tools/chaos_sweep.py instead: a fence trip needs
-# a scripted membership race (epoch bump mid-protocol), which is a multi-step
-# chaos scenario, not a one-site injection sweep.
-CHAOS_COVERED = frozenset({"epoch-fence"})
+# a scripted membership race (epoch bump mid-protocol), and the ingest
+# gateway's admission/shed sites need the multi-step overload scenarios
+# (burst-arrival-shed, poison-payload-quarantine) — not one-site sweeps.
+CHAOS_COVERED = frozenset({"epoch-fence", "ingest-admit", "ingest-shed"})
 
 
 def _coverage_gaps():

@@ -69,6 +69,7 @@ def compare(
     ingraph_collective_ceiling: float = 0.0,
     arena_speedup_floor: float = 10.0,
     warm_boot_compile_ceiling: float = 0.0,
+    ingest_shed_ceiling: float = 0.6,
 ) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
@@ -211,6 +212,32 @@ def compare(
                 "ceiling — a warmed boot re-entered the fleet paying fresh "
                 "compiles; the persistent program cache stopped covering it)"
             )
+        # ---- the ingest-gateway gates (ISSUE 19): a row that archived
+        # ingest_shed_fraction_2x made the overload promise — at exactly 2x
+        # offered load against the watermark, the shed fraction sits at the
+        # overload excess (~0.5); above the ceiling the gateway is throwing
+        # away ADMISSIBLE load (watermark accounting or eviction broke). A
+        # false accounting_exact is a correctness failure outright: a row
+        # whose settlement identity does not balance cannot be trusted on
+        # any other column ----
+        new_shed = new_row.get("ingest_shed_fraction_2x")
+        if new_shed is not None and float(new_shed) > ingest_shed_ceiling:
+            old_shed = old_row.get("ingest_shed_fraction_2x")
+            problems.append(
+                f"{name}: ingest_shed_fraction_2x "
+                f"{'(unrecorded)' if old_shed is None else f'{float(old_shed):.2f}'} -> "
+                f"{float(new_shed):.2f} (above the {ingest_shed_ceiling:g} ceiling — "
+                "the gateway sheds more than the 2x-overload excess: "
+                "admissible load is being thrown away)"
+            )
+        new_exact = new_row.get("accounting_exact")
+        if new_exact is not None and not bool(new_exact):
+            problems.append(
+                f"{name}: accounting_exact false (the ingest settlement "
+                "identity offered == admitted + coalesced + shed + "
+                "quarantined broke — rows were double-counted or dropped "
+                "from the books)"
+            )
     return problems
 
 
@@ -274,7 +301,7 @@ _USAGE = (
     "[--tail-threshold X] [--wire-hidden-floor X] "
     "[--close-collective-ceiling X] [--ingraph-collective-ceiling X] "
     "[--arena-speedup-floor X] [--warm-boot-compile-ceiling X] "
-    "[--explain] OLD.json NEW.json"
+    "[--ingest-shed-ceiling X] [--explain] OLD.json NEW.json"
 )
 
 
@@ -291,7 +318,8 @@ def main(argv) -> int:
     argv, ingraph_ceiling, ok6 = _pop_flag(argv, "--ingraph-collective-ceiling", 0.0)
     argv, arena_floor, ok7 = _pop_flag(argv, "--arena-speedup-floor", 10.0)
     argv, warm_boot_ceiling, ok8 = _pop_flag(argv, "--warm-boot-compile-ceiling", 0.0)
-    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok7 and ok8) or len(argv) != 2:
+    argv, ingest_shed_ceiling, ok9 = _pop_flag(argv, "--ingest-shed-ceiling", 0.6)
+    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok7 and ok8 and ok9) or len(argv) != 2:
         print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
@@ -307,6 +335,7 @@ def main(argv) -> int:
         ingraph_ceiling,
         arena_floor,
         warm_boot_ceiling,
+        ingest_shed_ceiling,
     )
     if problems:
         print("\n".join(problems))
