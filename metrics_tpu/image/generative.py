@@ -12,17 +12,41 @@ Parity: reference `image/{fid,kid,inception,lpip}.py`. TPU-first changes:
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple, Union
+import time
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops import autotune as _autotune
+from metrics_tpu.ops import telemetry as _telemetry
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
 
 _VALID_FEATURE_INTS = (64, 192, 768, 2048)
+
+# FID's host-LAPACK fallback (non-f64 backends) is the one place a metric's
+# compute leaves the device ledger entirely — counted + span-attributed so
+# perf_report can say where that wall went instead of losing it to "host".
+_counters: Dict[str, Any] = {
+    "fid_host_sqrtm": 0,
+    "fid_host_sqrtm_time_s": 0.0,
+}
+
+
+def fid_stats() -> Dict[str, Any]:
+    """FID-lane counters, merged into :func:`metrics_tpu.ops.engine.engine_stats`."""
+    return dict(_counters)
+
+
+def _zero_counters() -> None:
+    _counters["fid_host_sqrtm"] = 0
+    _counters["fid_host_sqrtm_time_s"] = 0.0
+
+
+_telemetry.register_reset("fid", _zero_counters)
 
 
 def _psd_sqrt(mat: jax.Array) -> jax.Array:
@@ -37,12 +61,58 @@ def _trace_sqrtm_product(sigma1: jax.Array, sigma2: jax.Array) -> jax.Array:
 
     Uses trace sqrtm(Σ₁Σ₂) = Σᵢ √λᵢ(√Σ₁ Σ₂ √Σ₁); the inner matrix is
     symmetric PSD so ``eigh`` applies (reference computes the same trace on
-    the host via `scipy.linalg.sqrtm`, `image/fid.py:61-75`).
+    the host via `scipy.linalg.sqrtm`, `image/fid.py:61-75`). With the
+    autotuner armed the matmul-only Newton–Schulz variant may serve instead.
     """
+    variant = _autotune.dispatch("fid_sqrtm", (sigma1, sigma2))
+    if variant == "newton_schulz":
+        return _trace_sqrtm_newton_schulz(sigma1, sigma2)
+    return _trace_sqrtm_eigh(sigma1, sigma2)
+
+
+def _trace_sqrtm_eigh(sigma1: jax.Array, sigma2: jax.Array) -> jax.Array:
+    """Reference formulation: two symmetric eigendecompositions."""
     s1_half = _psd_sqrt(sigma1)
     inner = s1_half @ sigma2 @ s1_half
     vals = jnp.linalg.eigh(inner)[0]
     return jnp.sum(jnp.sqrt(jnp.clip(vals, min=0.0)))
+
+
+_NS_ITERS = 30
+_NS_JITTER = 1e-6
+
+
+def _trace_sqrtm_newton_schulz(sigma1: jax.Array, sigma2: jax.Array) -> jax.Array:
+    """Matmul-only formulation: coupled Newton–Schulz square-root iteration.
+
+    ``A = Σ₁Σ₂`` is similar to the PSD matrix ``√Σ₂ Σ₁ √Σ₂``, so its square
+    root exists and the Frobenius-normalized spectrum lies in ``[0, 1]`` —
+    inside the iteration's convergence region. ``Yₖ → √(A/‖A‖_F)`` under
+    ``T = ½(3I − ZY); Y ← YT; Z ← TZ``, all MXU matmuls (no eigh, batchable
+    under vmap). Exact-zero eigenvalues of the normalized product put
+    ``I − Y₀`` on the unit circle, where the non-normal transients of the
+    coupled iteration overflow float32 — the :data:`_NS_JITTER` diagonal
+    shift lifts them off it; its √-perturbation of the trace stays orders
+    below the declared 1e-2 tolerance, and the sweep's exactness check
+    disqualifies the variant wherever the contract still fails.
+    """
+    a = sigma1 @ sigma2
+    norm = jnp.sqrt(jnp.sum(a * a))
+    norm = jnp.maximum(norm, jnp.asarray(1e-30, a.dtype))
+    eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+
+    def body(_, yz):
+        y, z = yz
+        t = 0.5 * (3.0 * eye - z @ y)
+        return y @ t, t @ z
+
+    y0 = a / norm + _NS_JITTER * eye
+    y, _ = jax.lax.fori_loop(0, _NS_ITERS, body, (y0, eye))
+    return jnp.trace(y) * jnp.sqrt(norm)
+
+
+_autotune.register_variant("fid_sqrtm", "eigh", _trace_sqrtm_eigh, reference=True)
+_autotune.register_variant("fid_sqrtm", "newton_schulz", _trace_sqrtm_newton_schulz, tolerance=1e-2)
 
 
 def _compute_fid(mu1: jax.Array, sigma1: jax.Array, mu2: jax.Array, sigma2: jax.Array) -> jax.Array:
@@ -221,11 +291,23 @@ class FrechetInceptionDistance(_FeatureBufferMetric):
             # TPU has no native float64 — the emulated f64 eigh of a 2048x2048
             # covariance takes minutes-to-never. Features stay device-extracted;
             # the O(D^2) statistics finish on host LAPACK in f64, the same
-            # device/host split as the reference's scipy sqrtm (`image/fid.py:61-95`)
-            return jnp.asarray(
-                _fid_from_features_host(np.asarray(real_features), np.asarray(fake_features)),
-                dtype=orig_dtype,
-            )
+            # device/host split as the reference's scipy sqrtm (`image/fid.py:61-95`).
+            # Counted + span-attributed: this wall never touches the device
+            # ledger, so without the fid-host-sqrtm site it would vanish
+            # from perf_report entirely.
+            t0 = time.perf_counter()
+            fid_host = _fid_from_features_host(np.asarray(real_features), np.asarray(fake_features))
+            host_dur = time.perf_counter() - t0
+            _counters["fid_host_sqrtm"] += 1
+            _counters["fid_host_sqrtm_time_s"] += host_dur
+            if _telemetry.armed:
+                _telemetry.emit(
+                    "fid-host-sqrtm", self, "image", t0, host_dur,
+                    {"dim": int(real_features.shape[1]),
+                     "n_real": int(real_features.shape[0]),
+                     "n_fake": int(fake_features.shape[0])},
+                )
+            return jnp.asarray(fid_host, dtype=orig_dtype)
         with _f64_compute():
             real64 = real_features.astype(jnp.float64)
             fake64 = fake_features.astype(jnp.float64)

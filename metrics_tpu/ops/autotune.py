@@ -1,0 +1,752 @@
+"""Roofline-guided kernel autotuner — variant sweeps for the heavy metrics.
+
+The roofline ledger (:func:`metrics_tpu.ops.engine.roofline_peaks` +
+``program_report``) classifies every cached program against the machine
+peaks, but until now each heavy kernel ran whatever single formulation
+was written first. This module closes the loop:
+
+- **Registry**: each heavy kernel declares named *variants* — mathematically
+  equivalent formulations with an explicit exactness contract versus the
+  reference variant (``tolerance=None`` means bit-exact; a float ``t`` means
+  ``allclose(rtol=t, atol=t)``). The reference variant is always the floor:
+  it is never disqualified and serves whenever no winner is installed.
+- **Sweep harness** (:func:`sweep`): each candidate is dispatched through a
+  real :class:`~metrics_tpu.ops.engine.Executable` (so compiles, dispatch
+  tallies and the sampled device probes all land in the ordinary program
+  ledger), its output is checked against the reference under the declared
+  contract, and its best-of wall is scored as achieved FLOP/s / bytes/s
+  against :func:`engine.roofline_peaks`. A variant that errors at dispatch
+  or fails its exactness check is **disqualified** — classified through the
+  ``autotune-sweep`` fault site and the module's ``autotune`` ladder lane —
+  and never installed.
+- **Selection table**: winners are kept per ``(kernel, shape class)`` (pow2
+  shape buckets, so ragged production shapes reuse one sweep). Installed
+  selections change the engine's acquire keys (a digest of the table is
+  appended while the autotuner is armed), so stale traces are invalidated
+  and the next acquisition bakes the winning formulation.
+- **Persistence**: when the persistent program cache is enabled
+  (:mod:`metrics_tpu.ops.progcache`), the selection table is exported into
+  the store as a CRC-stamped JSON sidecar. A warm boot restores it before
+  the first consult — **zero sweeps**, counter-pinned by the dryrun
+  certification.
+
+Everything is **off by default**: ``METRICS_TPU_AUTOTUNE`` (read through the
+shared warn-once env parsers) gates the whole plane, and with the knob unset
+every consult is one predicate — behavior and compiled programs are
+byte-identical to the untuned build (zero sweeps, zero installs).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.ops import faults as _faults
+from metrics_tpu.ops import telemetry as _telemetry
+from metrics_tpu.utils.exceptions import JournalFault, RuntimeFault
+
+__all__ = [
+    "autotune_stats",
+    "configure",
+    "dispatch",
+    "enabled",
+    "ensure",
+    "kernels",
+    "load_registrations",
+    "register_kernel",
+    "register_variant",
+    "selection_digest",
+    "selection_table",
+    "shape_class",
+    "sweep",
+    "variants",
+]
+
+# ------------------------------------------------------------------ counters
+_counters: Dict[str, int] = {
+    "autotune_sweeps": 0,
+    "autotune_candidates": 0,
+    "autotune_installs": 0,
+    "autotune_disqualified": 0,
+    "autotune_hits": 0,
+    "autotune_persists": 0,
+    "autotune_restores": 0,
+}
+
+
+def autotune_stats() -> Dict[str, int]:
+    """Monotonic event counters, merged into ``engine.engine_stats()``:
+    ``autotune_sweeps`` (sweep harness runs), ``autotune_candidates``
+    (variants timed), ``autotune_installs`` (selections recorded),
+    ``autotune_disqualified`` (variants that errored or failed exactness),
+    ``autotune_hits`` (consults served from the selection table),
+    ``autotune_persists`` / ``autotune_restores`` (selection-table writes
+    to / entries restored from the progcache store)."""
+    return dict(_counters)
+
+
+def _zero_counters() -> None:
+    for key in _counters:
+        _counters[key] = 0
+
+
+_telemetry.register_reset("autotune", _zero_counters)
+
+
+class _AutotuneOwner:
+    """Ladder + warn-dedupe anchor (one ``autotune`` lane per process — the
+    selection table is process-global, so its health is too)."""
+
+
+_OWNER = _AutotuneOwner()
+_ENABLE_WARN_OWNER = _AutotuneOwner()
+_PERSIST_WARN_OWNER = _AutotuneOwner()
+
+# ------------------------------------------------------------------- registry
+class _Variant:
+    __slots__ = ("name", "fn", "tolerance", "reference", "host")
+
+    def __init__(self, name: str, fn: Callable, tolerance: Optional[float], reference: bool, host: bool):
+        self.name = name
+        self.fn = fn
+        self.tolerance = tolerance  # None = bit-exact contract
+        self.reference = reference
+        self.host = host  # host-side numpy variant: timed eagerly, never jitted
+
+
+class _Kernel:
+    __slots__ = ("name", "variants", "reference", "classify")
+
+    def __init__(self, name: str, classify: Optional[Callable]):
+        self.name = name
+        self.variants: "Dict[str, _Variant]" = {}
+        self.reference: Optional[str] = None
+        self.classify = classify
+
+
+_KERNELS: Dict[str, _Kernel] = {}
+
+
+def register_kernel(name: str, *, classify: Optional[Callable] = None) -> None:
+    """Declare a tunable kernel family. ``classify(args) -> str`` overrides
+    the default pow2 shape-class bucketing (:func:`shape_class`)."""
+    if name not in _KERNELS:
+        _KERNELS[name] = _Kernel(name, classify)
+    elif classify is not None:
+        _KERNELS[name].classify = classify
+
+
+def register_variant(
+    kernel: str,
+    name: str,
+    fn: Callable,
+    *,
+    tolerance: Optional[float] = None,
+    reference: bool = False,
+    host: bool = False,
+) -> None:
+    """Register one named variant under ``kernel``. Exactly one variant per
+    kernel must be the ``reference`` — it defines correct output (its own
+    ``tolerance`` is ignored) and is the selection floor. ``tolerance=None``
+    declares a bit-exact contract; a float ``t`` declares
+    ``allclose(rtol=t, atol=t)`` versus the reference."""
+    register_kernel(kernel)
+    k = _KERNELS[kernel]
+    if reference:
+        if k.reference is not None and k.reference != name:
+            raise ValueError(f"kernel {kernel!r} already has reference {k.reference!r}")
+        k.reference = name
+    k.variants[name] = _Variant(name, fn, tolerance, reference, host)
+
+
+def kernels() -> Tuple[str, ...]:
+    """Registered kernel family names."""
+    return tuple(_KERNELS)
+
+
+def load_registrations() -> Tuple[str, ...]:
+    """Import every in-tree module that registers kernel variants, so the
+    full registry is populated without the caller having touched each metric
+    surface first (sweep drivers, certifications, bench). Returns
+    :func:`kernels` afterwards."""
+    import metrics_tpu.detection.mean_ap  # noqa: F401 — registers map_box_iou
+    import metrics_tpu.image.generative  # noqa: F401 — registers fid_sqrtm
+    import metrics_tpu.ops.binned  # noqa: F401 — registers binned_counts
+    import metrics_tpu.ops.histogram  # noqa: F401 — registers bincount
+    import metrics_tpu.ops.sorted_curves  # noqa: F401 — registers auroc_sort/ap_sort
+
+    return kernels()
+
+
+def variants(kernel: str) -> Tuple[str, ...]:
+    """Registered variant names for ``kernel`` (reference first)."""
+    k = _KERNELS[kernel]
+    names = sorted(k.variants, key=lambda n: (not k.variants[n].reference, n))
+    return tuple(names)
+
+
+# ------------------------------------------------------------------- the gate
+_TRUE_TOKENS = ("1", "true", "on", "yes")
+_FALSE_TOKENS = ("0", "false", "off", "no")
+
+
+def _parse_bool(raw: str) -> bool:
+    token = raw.strip().lower()
+    if token in _TRUE_TOKENS:
+        return True
+    if token in _FALSE_TOKENS:
+        return False
+    raise ValueError(raw)
+
+
+#: Hot-path guard (same shape as ``faults.armed``): consults check this one
+#: module attribute, so the disabled autotuner costs a single predicate and
+#: compiled programs stay byte-identical to the untuned build.
+active: bool = False
+_enabled_known: bool = False
+_override: Dict[str, Any] = {}
+
+
+def _init_enabled() -> None:
+    global active, _enabled_known
+    if "enabled" in _override:
+        val = bool(_override["enabled"])
+    else:
+        from metrics_tpu.parallel import sync as _psync
+
+        val = bool(
+            _psync._env_parse(
+                "METRICS_TPU_AUTOTUNE",
+                False,
+                _parse_bool,
+                "a boolean (0/1/on/off)",
+                owner=_ENABLE_WARN_OWNER,
+            )
+        )
+    active = val
+    _enabled_known = True
+    _sync_engine_hooks()
+
+
+def enabled() -> bool:
+    """Whether the autotuner is armed (``METRICS_TPU_AUTOTUNE``, default
+    **off** — with the knob unset every consult is one predicate and the
+    compiled programs are byte-identical to the untuned build). Read once
+    per process through the shared warn-once env parser; override with
+    :func:`configure`."""
+    if not _enabled_known:
+        _init_enabled()
+    return active
+
+
+def configure(*, enabled: Optional[bool] = None, reset: bool = False) -> None:  # noqa: A002 — mirrors the knob name
+    """Runtime override of the env knob (tests, certifications, bench).
+    ``reset=True`` first clears the override, the selection table, the
+    swept-class memo, the restore attempt and the ``autotune`` ladder lane —
+    a re-armed autotuner starts clean (counters are NOT touched; that is
+    ``engine.reset_stats()``'s job)."""
+    global _enabled_known, active
+    if reset:
+        _override.clear()
+        _SELECTIONS.clear()
+        _SWEPT.clear()
+        _SWEEP_RESULTS.clear()
+        _restore_state[0] = False
+        _digest_cache[0] = None
+        _OWNER.__dict__.pop("_fault_ladders", None)
+        _enabled_known = False
+        active = False
+    if enabled is not None:
+        _override["enabled"] = bool(enabled)
+        _enabled_known = False
+    if not _enabled_known:
+        _init_enabled()
+    else:
+        _sync_engine_hooks()
+
+
+# ----------------------------------------------------------- selection table
+#: (kernel, shape_class) -> winning variant name (reference names included:
+#: a reference win is still a recorded selection, so the class never re-sweeps)
+_SELECTIONS: Dict[Tuple[str, str], str] = {}
+_SWEPT: set = set()
+_SWEEP_RESULTS: Dict[Tuple[str, str], Dict[str, Any]] = {}
+_digest_cache: list = [None]
+_restore_state: list = [False]
+#: per-trace consult log: (kernel -> variant) consulted while tracing, drained
+#: into ``Executable.variant`` by the engine's compile-detection hook
+_trace_consults: Dict[str, str] = {}
+
+
+def selection_table() -> Dict[str, str]:
+    """The installed selections, as ``"kernel|shape_class" -> variant``."""
+    return {f"{k}|{sc}": v for (k, sc), v in sorted(_SELECTIONS.items())}
+
+
+def selection_digest() -> str:
+    """Stable digest of the selection table — appended to the engine's
+    acquire keys while the autotuner is armed, so an install invalidates
+    stale traces and identical tables resolve identical persistent-cache
+    entries across processes."""
+    if _digest_cache[0] is None:
+        blob = json.dumps(selection_table(), sort_keys=True).encode()
+        _digest_cache[0] = hashlib.sha1(blob).hexdigest()[:12]
+    return _digest_cache[0]
+
+
+def _engine_key_suffix() -> tuple:
+    return ("autotune", selection_digest())
+
+
+def _engine_note_compile(exe: Any) -> None:
+    """Drain the trace-time consult log into the just-compiled program's
+    ledger row (``program_report`` ``variant`` column)."""
+    if _trace_consults:
+        exe.variant = ",".join(f"{k}={v}" for k, v in sorted(_trace_consults.items()))
+        _trace_consults.clear()
+
+
+def _sync_engine_hooks() -> None:
+    from metrics_tpu.ops import engine as _engine
+
+    if active:
+        _engine._autotune_key = _engine_key_suffix
+        _engine._autotune_note = _engine_note_compile
+    else:
+        _engine._autotune_key = None
+        _engine._autotune_note = None
+
+
+# --------------------------------------------------------------- shape class
+def _pow2(n: int) -> int:
+    return max(1, 1 << (int(n) - 1).bit_length()) if n > 0 else 0
+
+
+def shape_class(*args: Any) -> str:
+    """Default shape-class bucketing: array args as ``dtype[pow2-dims]``,
+    python leaves by ``repr`` (trace-time constants). Ragged production
+    shapes land in O(log^2) classes, so one sweep covers a bucket."""
+    parts: List[str] = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            dims = "x".join(str(_pow2(d)) for d in shape)
+            parts.append(f"{dtype}[{dims}]")
+        else:
+            parts.append(repr(a))
+    return ",".join(parts)
+
+
+def _classify(kernel: str, args: tuple) -> str:
+    k = _KERNELS[kernel]
+    if k.classify is not None:
+        return str(k.classify(args))
+    return shape_class(*args)
+
+
+# ------------------------------------------------------------------ consults
+def dispatch(kernel: str, args: tuple, *, sweep_on_miss: bool = False) -> Optional[str]:
+    """The call-site consult: which variant should serve this call?
+
+    Returns ``None`` for the reference path — always when the autotuner is
+    disabled (one predicate, byte-identical programs), when no selection is
+    installed for this ``(kernel, shape class)``, or when the installed
+    winner IS the reference. Works under tracing (shape classes come from
+    static shapes); ``sweep_on_miss=True`` lets an eager call site with
+    concrete inputs trigger the sweep for a first-seen shape class (skipped
+    while the ``autotune`` ladder lane is demoted)."""
+    if not active:
+        if _enabled_known:
+            return None
+        _init_enabled()
+        if not active:
+            return None
+    if kernel not in _KERNELS:
+        return None
+    _maybe_restore()
+    sc = _classify(kernel, args)
+    name = _SELECTIONS.get((kernel, sc))
+    if name is None:
+        if sweep_on_miss and (kernel, sc) not in _SWEPT and _lane_clean() and _concrete(args):
+            try:
+                sweep(kernel, args)
+            except Exception as err:  # noqa: BLE001 — a failed sweep must never
+                # break the caller: demote the lane (blocks further auto-sweeps
+                # until it re-probes clean) and serve the reference
+                _faults.demote(
+                    _OWNER, "autotune", err,
+                    default_domain="runtime", site="autotune-sweep",
+                    warn=f"autotune sweep for {kernel!r} failed ({type(err).__name__}: {err}); "
+                    "serving the reference variant",
+                )
+            name = _SELECTIONS.get((kernel, sc))
+        if name is None:
+            return None
+    _counters["autotune_hits"] += 1
+    k = _KERNELS[kernel]
+    if name not in k.variants:
+        # a restored selection naming a variant this build doesn't register:
+        # the reference is always the floor
+        return None
+    import jax
+
+    if not jax.core.trace_state_clean():
+        _trace_consults[kernel] = name
+    if k.variants[name].reference:
+        return None
+    return name
+
+
+def ensure(kernel: str, *args: Any) -> Optional[str]:
+    """Sweep-if-needed for one concrete call signature: returns the installed
+    winner for ``(kernel, shape_class(args))``, sweeping first when the class
+    has never been swept. ``None`` when the autotuner is disabled."""
+    if not enabled():
+        return None
+    _maybe_restore()
+    sc = _classify(kernel, args)
+    if (kernel, sc) not in _SWEPT:
+        sweep(kernel, args)
+    return _SELECTIONS.get((kernel, sc))
+
+
+def _concrete(args: tuple) -> bool:
+    import jax
+
+    return not any(isinstance(a, jax.core.Tracer) for a in args)
+
+
+def _lane_clean() -> bool:
+    lad = _faults.ladder(_OWNER, "autotune")
+    if not lad.demoted:
+        return True
+    if lad.note_clean():
+        lad.promote()
+        return True
+    return False
+
+
+# -------------------------------------------------------------- the harness
+def _is_array(x: Any) -> bool:
+    import jax
+
+    return isinstance(x, (jax.Array, np.ndarray, np.generic))
+
+
+def _outputs_match(ref: Any, out: Any, tolerance: Optional[float]) -> bool:
+    """The exactness contract: ``tolerance=None`` ⇒ bit-exact (NaNs equal);
+    a float ``t`` ⇒ ``allclose(rtol=t, atol=t, equal_nan=True)`` per leaf."""
+    import jax
+
+    ref_leaves, ref_tree = jax.tree_util.tree_flatten(ref)
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out)
+    if ref_tree != out_tree or len(ref_leaves) != len(out_leaves):
+        return False
+    for a, b in zip(ref_leaves, out_leaves):
+        a = np.asarray(a)
+        b = np.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            return False
+        if tolerance is None:
+            if np.issubdtype(a.dtype, np.floating):
+                same = (a == b) | (np.isnan(a) & np.isnan(b))
+                if not bool(np.all(same)):
+                    return False
+            elif not np.array_equal(a, b):
+                return False
+        elif not np.allclose(b, a, rtol=tolerance, atol=tolerance, equal_nan=True):
+            return False
+    return True
+
+
+def _time_candidate(run: Callable[[], Any], trials: int) -> float:
+    import jax
+
+    best = float("inf")
+    for _ in range(max(1, trials)):
+        t0 = time.perf_counter()
+        out = run()
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def sweep(kernel: str, args: tuple, *, trials: int = 3) -> Dict[str, Any]:
+    """Run the variant sweep for ``(kernel, shape_class(args))`` on concrete
+    inputs and install the winner.
+
+    Every registered variant is built and timed through a real
+    :class:`~metrics_tpu.ops.engine.Executable` (kind ``autotune:<kernel>``,
+    keyed by variant + shape class — host variants are timed eagerly), its
+    output checked against the reference under the declared exactness
+    contract, and its best-of wall scored as achieved FLOP/s and bytes/s
+    (from the reference program's XLA cost analysis) against
+    :func:`engine.roofline_peaks`. Disqualified variants (dispatch error,
+    injected ``autotune-sweep`` fault, or exactness failure) demote
+    classified and are never installed; the reference is always the floor.
+    Returns the sweep report; the same class never re-sweeps (consult
+    :func:`selection_table`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops import engine as _engine
+
+    if not enabled():
+        raise RuntimeError("autotune.sweep requires METRICS_TPU_AUTOTUNE (or configure(enabled=True))")
+    k = _KERNELS[kernel]
+    if k.reference is None:
+        raise ValueError(f"kernel {kernel!r} has no reference variant")
+    sc = _classify(kernel, args)
+    if (kernel, sc) in _SWEPT:
+        return _SWEEP_RESULTS[(kernel, sc)]
+    t_sweep = time.perf_counter()
+    _counters["autotune_sweeps"] += 1
+
+    array_idx = [i for i, a in enumerate(args) if _is_array(a)]
+    dev_args = tuple(jnp.asarray(args[i]) for i in array_idx)
+    host_args = tuple(args)
+
+    def _make_step(fn: Callable) -> Callable:
+        def step(state: Any, *arrs: Any) -> Any:
+            full = list(args)
+            for i, arr in zip(array_idx, arrs):
+                full[i] = arr
+            return fn(*full)
+
+        return step
+
+    static_key = tuple(repr(args[i]) for i in range(len(args)) if i not in array_idx)
+    peaks = _engine.roofline_peaks()
+    names = variants(kernel)  # reference first
+    rows: List[Dict[str, Any]] = []
+    ref_out: Any = None
+    ref_analysis: Optional[Dict[str, Any]] = None
+    disqualified = 0
+
+    for name in names:
+        v = k.variants[name]
+        _counters["autotune_candidates"] += 1
+        row: Dict[str, Any] = {
+            "variant": name,
+            "reference": v.reference,
+            "ok": False,
+            "exact": None,
+            "wall_s": None,
+            "score": 0.0,
+            "compute_utilization": 0.0,
+            "memory_utilization": 0.0,
+        }
+        try:
+            if v.host:
+                run = lambda fn=v.fn: fn(*host_args)  # noqa: E731
+                out = run()
+            else:
+                exe = _engine.acquire_keyed(
+                    (f"autotune:{kernel}", name, sc) + static_key,
+                    lambda fn=v.fn: (_make_step(fn), None, {"autotune": True}),
+                    donate=False,
+                )
+                exe.variant = name
+                run = lambda e=exe: e(None, *dev_args)  # noqa: E731
+                out = run()  # warmup: compile lands in the ledger, not the timing
+                jax.block_until_ready(out)
+            if v.reference:
+                ref_out = out
+                if not v.host:
+                    ref_analysis = _engine._analyze(exe)
+            else:
+                # the injection point: a poisoned candidate dies HERE, after
+                # the reference is already banked — the floor is never at risk
+                if _faults.armed:
+                    _faults.maybe_fail("autotune-sweep")
+                row["exact"] = _outputs_match(ref_out, out, v.tolerance)
+                if not row["exact"]:
+                    raise RuntimeFault(
+                        f"autotune variant {kernel}:{name} failed its exactness contract "
+                        f"(tolerance={v.tolerance!r}) vs reference {k.reference!r}",
+                        site="autotune-sweep",
+                    )
+            t0 = time.perf_counter()
+            wall = _time_candidate(run, trials)
+            if not v.host:
+                # feed the probed device plane: sweep timings are real
+                # device-inclusive walls, so the candidates' roofline rows
+                # classify like any probed program
+                _telemetry.observe_device_dispatch(exe.probe_key, t0, wall)
+            row["wall_s"] = wall
+            flops = float((ref_analysis or {}).get("flops", 0.0) or 0.0)
+            nbytes = float((ref_analysis or {}).get("bytes_accessed", 0.0) or 0.0)
+            if peaks.get("calibrated") and wall > 0 and (flops > 0 or nbytes > 0):
+                u_c = flops / wall / peaks["peak_flops_per_s"]
+                u_m = nbytes / wall / peaks["peak_bytes_per_s"]
+                row["compute_utilization"] = round(u_c, 6)
+                row["memory_utilization"] = round(u_m, 6)
+                row["score"] = max(u_c, u_m)
+            elif wall > 0:
+                # uncalibrated / unanalyzed: 1/wall is the same argmax —
+                # achieved work per second with the (fixed) algorithmic
+                # numerator divided out
+                row["score"] = 1.0 / wall
+            row["ok"] = True
+        except Exception as err:  # noqa: BLE001 — a bad candidate is a
+            # classified disqualification, never a sweep abort
+            if v.reference:
+                raise  # the reference failing means the kernel itself is broken
+            disqualified += 1
+            _counters["autotune_disqualified"] += 1
+            row["error"] = f"{type(err).__name__}: {str(err)[:160]}"
+            _faults.demote(
+                _OWNER, "autotune", err,
+                default_domain="runtime", site="autotune-sweep",
+                warn=f"autotune variant {kernel}:{name} disqualified "
+                f"({type(err).__name__}: {str(err)[:120]}); the reference variant remains the floor",
+            )
+        rows.append(row)
+
+    winner = k.reference
+    best = next(r for r in rows if r["reference"])
+    for row in rows:
+        if row["ok"] and not row["reference"] and row["score"] > best["score"]:
+            winner = row["variant"]
+            best = row
+    _install(kernel, sc, winner)
+    report = {
+        "kernel": kernel,
+        "shape_class": sc,
+        "winner": winner,
+        "reference": k.reference,
+        "candidates": rows,
+        "disqualified": disqualified,
+    }
+    _SWEPT.add((kernel, sc))
+    _SWEEP_RESULTS[(kernel, sc)] = report
+    if _telemetry.armed:
+        _telemetry.emit(
+            "autotune-sweep", kernel, "autotune", t_sweep, time.perf_counter() - t_sweep,
+            {"shape_class": sc, "winner": winner, "candidates": len(rows), "disqualified": disqualified},
+        )
+    return report
+
+
+def _install(kernel: str, sc: str, winner: str) -> None:
+    _SELECTIONS[(kernel, sc)] = winner
+    _digest_cache[0] = None
+    _counters["autotune_installs"] += 1
+    if _telemetry.armed:
+        now = time.perf_counter()
+        _telemetry.emit(
+            "autotune-install", kernel, "autotune", now, 0.0,
+            {"shape_class": sc, "variant": winner},
+        )
+    _persist()
+
+
+# --------------------------------------------------------------- persistence
+_TABLE_FILE = "autotune_selections.json"
+_TABLE_VERSION = 1
+
+
+def _table_path() -> str:
+    from metrics_tpu.ops import progcache as _progcache
+
+    return os.path.join(_progcache.cache_dir(), _TABLE_FILE)
+
+
+def _persist() -> None:
+    """Export the selection table into the progcache store (atomic tmp +
+    fsync + replace, CRC-stamped) so a warm boot restores it at zero sweeps.
+    No-op while the persistent cache is disabled; failures demote classified
+    (the in-memory table keeps serving)."""
+    from metrics_tpu.ops import progcache as _progcache
+
+    if not _progcache.enabled():
+        return
+    import jax
+
+    try:
+        sel_blob = json.dumps(selection_table(), sort_keys=True)
+        doc = {
+            "magic": "MTAT",
+            "version": _TABLE_VERSION,
+            "backend": jax.default_backend(),
+            "jax": jax.__version__,
+            "selections": json.loads(sel_blob),
+            "crc": zlib.crc32(sel_blob.encode()),
+        }
+        path = _table_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        _counters["autotune_persists"] += 1
+    except Exception as err:  # noqa: BLE001 — persistence is best-effort;
+        # the in-memory table keeps serving and the next install retries
+        _faults.demote(
+            _PERSIST_WARN_OWNER, "autotune", err,
+            default_domain="journal", site="autotune-sweep",
+            warn=f"could not persist the autotune selection table "
+            f"({type(err).__name__}: {str(err)[:120]}); selections stay in-memory only",
+        )
+
+
+def _maybe_restore() -> None:
+    """Load the persisted selection table on the first consult of an armed
+    process (warm boot = zero sweeps). Corrupt tables demote classified and
+    are ignored; a backend/version mismatch is simply a cold start."""
+    if _restore_state[0]:
+        return
+    _restore_state[0] = True
+    from metrics_tpu.ops import progcache as _progcache
+
+    if not _progcache.enabled():
+        return
+    path = _table_path()
+    if not os.path.exists(path):
+        return
+    import jax
+
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        if doc.get("magic") != "MTAT" or int(doc.get("version", -1)) != _TABLE_VERSION:
+            raise JournalFault(
+                f"autotune selection table {path} has unknown framing "
+                f"(magic={doc.get('magic')!r}, version={doc.get('version')!r})",
+                site="autotune-sweep",
+            )
+        selections = doc.get("selections", {})
+        sel_blob = json.dumps(selections, sort_keys=True)
+        if zlib.crc32(sel_blob.encode()) != int(doc.get("crc", -1)):
+            raise JournalFault(
+                f"autotune selection table {path} CRC mismatch", site="autotune-sweep"
+            )
+        if doc.get("backend") != jax.default_backend():
+            return  # another machine's winners: sweep fresh, never mis-serve
+        restored = 0
+        for key, variant in selections.items():
+            kernel, _, sc = key.partition("|")
+            if not kernel or not sc:
+                continue
+            _SELECTIONS[(kernel, sc)] = str(variant)
+            _SWEPT.add((kernel, sc))
+            restored += 1
+        if restored:
+            _digest_cache[0] = None
+            _counters["autotune_restores"] += restored
+    except Exception as err:  # noqa: BLE001 — a suspect table is never
+        # served: demote classified and sweep fresh
+        _faults.demote(
+            _OWNER, "autotune", err,
+            default_domain="journal", site="autotune-sweep",
+            warn=f"could not restore the autotune selection table "
+            f"({type(err).__name__}: {str(err)[:120]}); sweeping fresh",
+        )

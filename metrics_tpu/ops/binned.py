@@ -15,6 +15,15 @@ at the device dispatch floor (~2.4 ms), while the "smart" alternative —
 bucketize via ``jnp.searchsorted`` + scatter histogram, O(N*C*log T) — takes
 ~78 ms because XLA lowers searchsorted to a serial binary-search scan on TPU.
 The asymptotically-better algorithm loses by 30x: let the MXU brute-force it.
+
+That 30x is a *measurement on one chip*, not a law: the memory-vs-compute
+tradeoff flips with the bins×batch shape and the backend. The bucketize
+formulations stay in-tree as autotuner variants (``scatter_add`` /
+``segment_sum``, :mod:`metrics_tpu.ops.autotune`): ascending-threshold
+bucketing + a per-(class, bucket) histogram + a reversed cumulative sum
+recovers exactly the ``>=``-counts — bit-exact for {0,1} targets, O(N·C·logT
++ C·T) work instead of the einsum's O(N·C·T). The sweep decides per shape
+class; with ``METRICS_TPU_AUTOTUNE`` off the einsum below always runs.
 """
 from __future__ import annotations
 
@@ -23,6 +32,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops import autotune as _autotune
 from metrics_tpu.utils.compute import high_precision
 
 
@@ -45,6 +55,19 @@ def binned_curve_counts(
     target = jnp.asarray(target, dtype=jnp.float32)
     thresholds = jnp.asarray(thresholds, dtype=jnp.float32)
 
+    variant = _autotune.dispatch("binned_counts", (preds, target, thresholds))
+    if variant == "scatter_add":
+        return _binned_bucketize(preds, target, thresholds, via_segment_sum=False)
+    if variant == "segment_sum":
+        return _binned_bucketize(preds, target, thresholds, via_segment_sum=True)
+    return _binned_onehot_matmul(preds, target, thresholds)
+
+
+@high_precision
+def _binned_onehot_matmul(
+    preds: jax.Array, target: jax.Array, thresholds: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Reference formulation: compare + MXU einsum contraction."""
     ge = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)
     tps = jnp.einsum("nc,nct->ct", target, ge)
     ge_total = jnp.einsum("nct->ct", ge)
@@ -52,6 +75,64 @@ def binned_curve_counts(
     fps = ge_total - tps
     fns = pos_total - tps
     return tps, fps, fns
+
+
+@high_precision
+def _binned_bucketize(
+    preds: jax.Array, target: jax.Array, thresholds: jax.Array, *, via_segment_sum: bool
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Bucketize formulation: per-(class, bucket) histogram + reversed
+    cumulative sum recovers the ``>=``-counts without the O(N·C·T) compare
+    tensor. Thresholds are sorted internally (results mapped back through
+    the permutation), so any threshold grid matches the einsum; sums of
+    {0,1} values below 2**24 per cell are exact in f32 in any order, which
+    is what makes the contract bit-exact."""
+    n, c = preds.shape
+    t = thresholds.shape[0]
+    order = jnp.argsort(thresholds)
+    sorted_thr = thresholds[order]
+    # bucket of each score: how many (sorted) thresholds are <= it
+    idx = jnp.searchsorted(sorted_thr, preds.reshape(-1), side="right").reshape(n, c)
+    flat = (idx * c + jnp.arange(c, dtype=idx.dtype)[None, :]).reshape(-1)
+    if via_segment_sum:
+        tp_hist = jax.ops.segment_sum(target.reshape(-1), flat, num_segments=(t + 1) * c)
+        all_hist = jax.ops.segment_sum(jnp.ones(n * c, jnp.float32), flat, num_segments=(t + 1) * c)
+    else:
+        tp_hist = jnp.zeros((t + 1) * c, jnp.float32).at[flat].add(target.reshape(-1))
+        all_hist = jnp.zeros((t + 1) * c, jnp.float32).at[flat].add(1.0)
+    tp_hist = tp_hist.reshape(t + 1, c)
+    all_hist = all_hist.reshape(t + 1, c)
+    # preds >= sorted_thr[j]  ⇔  bucket > j: a suffix sum over buckets j+1..T
+    tp_ge = jnp.cumsum(tp_hist[::-1], axis=0)[::-1][1:]  # (T, C), sorted order
+    all_ge = jnp.cumsum(all_hist[::-1], axis=0)[::-1][1:]
+    inv = jnp.argsort(order)  # back to the caller's threshold order
+    tps = tp_ge[inv].T  # (C, T)
+    ge_total = all_ge[inv].T
+    pos_total = target.sum(axis=0)[:, None]  # (C, 1)
+    fps = ge_total - tps
+    fns = pos_total - tps
+    return tps, fps, fns
+
+
+def _binned_scatter_add(
+    preds: jax.Array, target: jax.Array, thresholds: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    return _binned_bucketize(preds, target, thresholds, via_segment_sum=False)
+
+
+def _binned_segment_sum(
+    preds: jax.Array, target: jax.Array, thresholds: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    return _binned_bucketize(preds, target, thresholds, via_segment_sum=True)
+
+
+# Bit-exact contract (tolerance None): every formulation sums the same {0,1}
+# indicator terms; f32 integer-valued sums below 2**24 are order-invariant.
+# Fractional targets would break that — the sweep's exactness check
+# disqualifies the bucketize variants on such inputs, reference serves.
+_autotune.register_variant("binned_counts", "onehot_matmul", _binned_onehot_matmul, reference=True)
+_autotune.register_variant("binned_counts", "scatter_add", _binned_scatter_add)
+_autotune.register_variant("binned_counts", "segment_sum", _binned_segment_sum)
 
 
 __all__ = ["binned_curve_counts"]

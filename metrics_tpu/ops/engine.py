@@ -65,6 +65,7 @@ from __future__ import annotations
 import enum
 import hashlib
 import os
+import sys
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -371,6 +372,7 @@ class Executable:
         "arg_structs",
         "analysis",
         "analysis_failed",
+        "variant",
         "__weakref__",
     )
 
@@ -398,6 +400,9 @@ class Executable:
         self.arg_structs: Optional[tuple] = None
         self.analysis: Optional[Dict[str, Any]] = None
         self.analysis_failed = False
+        # the autotuner's ledger column: which kernel variants this program
+        # baked at trace time (None for untuned programs — the default)
+        self.variant: Optional[str] = None
 
     def _capture_structs(self, state: Any, args: tuple, kwargs: dict) -> None:
         """Retain the just-compiled call's abstract signature (arrays as
@@ -609,6 +614,8 @@ class Executable:
             self.compiles += 1
             self.compile_time_s += dur
             self._capture_structs(state, args, kwargs)
+            if _autotune_note is not None:
+                _autotune_note(self)
             if _telemetry.armed:
                 _telemetry.emit("engine-compile", self.kind, "engine", t0, dur, {"donated": donated})
             if self.aot is not None:
@@ -701,6 +708,16 @@ _PROGRAM_CACHE: "OrderedDict[tuple, Executable]" = OrderedDict()
 _CACHE_CAP = 256
 _stats = {"builds": 0, "hits": 0, "device_probes": 0, "program_analyses": 0}
 
+#: Autotuner hooks (ops/autotune.py), armed only while METRICS_TPU_AUTOTUNE
+#: is on: ``_autotune_key()`` returns the selection-table digest suffix
+#: appended to every acquire key (an installed winner invalidates stale
+#: traces; identical tables resolve identical persistent-cache entries), and
+#: ``_autotune_note(exe)`` drains trace-time variant consults into the
+#: just-compiled program's ledger row. Both None when the autotuner is off —
+#: one predicate each, keys and programs byte-identical to the untuned build.
+_autotune_key: Optional[Callable[[], tuple]] = None
+_autotune_note: Optional[Callable[[Any], None]] = None
+
 
 def acquire(
     owner: Any,
@@ -730,6 +747,8 @@ def acquire_keyed(
     """:func:`acquire` for callers that assemble their own cache key —
     MetricCollection keys by its members' fingerprints, the fan-out wrappers
     by wrapper + clone fingerprints."""
+    if _autotune_key is not None:
+        key = key + _autotune_key()
     exe = _PROGRAM_CACHE.get(key)
     if exe is not None:
         _stats["hits"] += 1
@@ -861,6 +880,18 @@ def engine_stats() -> Dict[str, Any]:
     from metrics_tpu import ingest as _ingest
 
     out.update(_ingest.ingest_stats())
+    # the kernel autotuner (sweeps, candidates, installs, disqualifications,
+    # table hits, persists/restores — ops/autotune.py; a light module, but
+    # lazy to keep import order acyclic with the kernel modules that
+    # register variants)
+    from metrics_tpu.ops import autotune as _autotune
+
+    out.update(_autotune.autotune_stats())
+    # the FID host-f64 fallback counters (image/generative.py) — guarded:
+    # the image stack is heavy and only merged when already imported
+    _generative = sys.modules.get("metrics_tpu.image.generative")
+    if _generative is not None:
+        out.update(_generative.fid_stats())
     return out
 
 
@@ -1117,6 +1148,9 @@ def program_report(analyze: bool = True) -> List[Dict[str, Any]]:
             "compiled_signatures": exe.compiled_signatures(),
             "dispatch_time_s": round(exe.dispatch_time_s, 6),
             "device": device,
+            # the autotuner's column: which kernel variants this program
+            # baked at trace time (None for untuned programs)
+            "variant": exe.variant,
         }
         analysis = _analyze(exe) if analyze else None
         row["analysis"] = analysis

@@ -106,6 +106,10 @@ TIERS = ("fused", "chunked", "eager", "host")
 #: the gateway door before a payload is staged (modelling poison admission);
 #: ``ingest-shed`` fires in the overload shed/flush path — both are settled
 #: into the gateway's exact accounting instead of raising into the caller.
+#: ``autotune-sweep`` fires while a non-reference kernel variant is being
+#: evaluated: the injected fault disqualifies that candidate classified and
+#: the reference variant keeps serving (the autotuner's floor is never at
+#: risk from a poisoned variant).
 FAULT_SITES = (
     "probe",
     "compile",
@@ -121,6 +125,7 @@ FAULT_SITES = (
     "progcache-store",
     "ingest-admit",
     "ingest-shed",
+    "autotune-sweep",
 )
 
 _SITE_DEFAULT_EXC = {
@@ -147,6 +152,9 @@ _SITE_DEFAULT_EXC = {
     # gateway door (poison quarantine) or evicted from staging under overload
     "ingest-admit": IngestFault,
     "ingest-shed": IngestFault,
+    # runtime domain: a kernel-variant candidate dying mid-sweep — the
+    # autotuner disqualifies it classified and the reference stays the floor
+    "autotune-sweep": RuntimeFault,
 }
 
 _DOMAIN_EXC = {

@@ -26,6 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops import autotune as _autotune
 from metrics_tpu.ops._dispatch import pallas_enabled
 
 _TN = 512  # elements of x per grid step
@@ -104,13 +105,54 @@ def fused_bincount(
             return jnp.round(counts).astype(jnp.int32)
         return counts
 
+    if weights is None:
+        # unweighted counts are pure integers — the one path where every
+        # autotuner formulation is bit-exact by construction
+        variant = _autotune.dispatch("bincount", (x, length))
+        if variant == "scatter_add":
+            return _bincount_scatter_add(x, length)
+        if variant == "onehot_matmul":
+            return _bincount_onehot_matmul(x, length)
+        return _bincount_segment_sum(x, length)
     valid = (x >= 0) & (x < length)
     idx = jnp.where(valid, x, 0)
-    if weights is None:
-        w_int = valid.astype(jnp.int32)
-        return jax.ops.segment_sum(w_int, idx, num_segments=length)
     w = jnp.asarray(weights).reshape(-1).astype(jnp.float32)
     return jax.ops.segment_sum(jnp.where(valid, w, 0.0), idx, num_segments=length)
+
+
+def _bincount_segment_sum(x: jax.Array, length: int) -> jax.Array:
+    """Reference formulation: deterministic XLA segment-sum."""
+    valid = (x >= 0) & (x < length)
+    idx = jnp.where(valid, x, 0)
+    w_int = valid.astype(jnp.int32)
+    return jax.ops.segment_sum(w_int, idx, num_segments=length)
+
+
+def _bincount_scatter_add(x: jax.Array, length: int) -> jax.Array:
+    """Scatter-add formulation: a direct indexed-add histogram."""
+    valid = (x >= 0) & (x < length)
+    idx = jnp.where(valid, x, 0)
+    return jnp.zeros((length,), jnp.int32).at[idx].add(valid.astype(jnp.int32))
+
+
+def _bincount_onehot_matmul(x: jax.Array, length: int) -> jax.Array:
+    """One-hot contraction formulation: ``valid @ one_hot(x, L)`` on the
+    MXU — O(N·L) compares but scatter-free (counts below 2**24 are exact
+    in the f32 accumulator)."""
+    valid = (x >= 0) & (x < length)
+    idx = jnp.where(valid, x, 0)
+    onehot = (idx[:, None] == jnp.arange(length, dtype=idx.dtype)[None, :]).astype(jnp.float32)
+    counts = jnp.matmul(
+        valid.astype(jnp.float32)[None, :], onehot, precision=jax.lax.Precision.HIGHEST
+    )[0]
+    return counts.astype(jnp.int32)
+
+
+# Bit-exact contract (tolerance None): unweighted counts are integers in
+# int32 or an exact-below-2**24 f32 accumulator, whatever the formulation.
+_autotune.register_variant("bincount", "segment_sum", _bincount_segment_sum, reference=True)
+_autotune.register_variant("bincount", "scatter_add", _bincount_scatter_add)
+_autotune.register_variant("bincount", "onehot_matmul", _bincount_onehot_matmul)
 
 
 __all__ = ["fused_bincount"]

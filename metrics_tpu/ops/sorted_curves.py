@@ -18,12 +18,23 @@ static), and integrate analytically.
 Everything is sort + cumsum + segment reductions: O(N log N), jittable,
 shard_map-safe — this is what lets exact AUROC/AP run inside fused SPMD
 programs where the reference must leave the device.
+
+**Autotuned formulations** (:mod:`metrics_tpu.ops.autotune`, armed via
+``METRICS_TPU_AUTOTUNE``): the reference AUROC path argsorts and then
+scatters midranks back to the original order; the ``single_sort`` variant
+derives ranks, tie runs, and the U-statistic entirely in sorted space (no
+scatter — the sum is order-invariant), and the ``packed_sort`` variant
+fuses score and label into ONE multi-operand ``lax.sort`` over sortable
+score bits (integer tie detection, the gather fused into the sort). Both
+declare a small float-summation tolerance; with the autotuner off the
+reference path below is byte-identical to what always ran.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops import autotune as _autotune
 from metrics_tpu.utils.compute import high_precision
 
 
@@ -46,6 +57,64 @@ def midranks(x: jax.Array) -> jax.Array:
     return jnp.zeros(n, jnp.float32).at[order].set(mid_sorted)
 
 
+def _auroc_from_rank_sum(rank_sum_pos: jax.Array, n_pos: jax.Array, n: int) -> jax.Array:
+    """AUROC from the midrank sum over positives (Mann–Whitney U identity)."""
+    n_neg = n - n_pos
+    u = rank_sum_pos - n_pos * (n_pos + 1.0) * 0.5
+    denom = n_pos * n_neg
+    return jnp.where(denom > 0, u / jnp.maximum(denom, 1.0), jnp.nan)
+
+
+def _auroc_midranks(preds: jax.Array, y: jax.Array) -> jax.Array:
+    """Reference formulation: midranks scattered back to input order."""
+    ranks = midranks(preds)
+    return _auroc_from_rank_sum(jnp.sum(ranks * y), jnp.sum(y), y.shape[0])
+
+
+def _sorted_midranks(run_id: jax.Array, n: int) -> jax.Array:
+    """1-based midrank per SORTED position, from tie-run ids (no scatter)."""
+    pos = jnp.arange(n, dtype=jnp.float32)
+    run_count = jax.ops.segment_sum(jnp.ones(n, jnp.float32), run_id, num_segments=n)
+    run_first = jax.ops.segment_min(pos, run_id, num_segments=n)
+    return run_first[run_id] + (run_count[run_id] + 1.0) * 0.5
+
+
+def _auroc_single_sort(preds: jax.Array, y: jax.Array) -> jax.Array:
+    """Single-sort variant: ranks, tie runs, and the U-statistic all derived
+    in sorted space — the rank sum is order-invariant, so the reference's
+    ``.at[order].set`` scatter back to input order disappears."""
+    n = preds.shape[0]
+    order = jnp.argsort(preds)
+    sy = y[order]
+    run_id = _tie_run_ids(preds[order])
+    mid = _sorted_midranks(run_id, n)
+    return _auroc_from_rank_sum(jnp.sum(mid * sy), jnp.sum(sy), n)
+
+
+def _sortable_score_keys(preds: jax.Array) -> jax.Array:
+    """Monotone uint32 image of float32 scores: unsigned-ascending key order
+    == float-ascending value order, and bit-equality == float tie (``-0.0``
+    folds to ``+0.0`` first so the zero tie run stays one run). NaN scores
+    sort by payload sign instead of last — callers with NaN scores keep the
+    reference variant."""
+    p = jnp.where(preds == 0.0, jnp.float32(0.0), preds)
+    ub = jax.lax.bitcast_convert_type(p, jnp.uint32)
+    sign = ub >> jnp.uint32(31)
+    return jnp.where(sign == jnp.uint32(1), ~ub, ub | jnp.uint32(1 << 31))
+
+
+def _auroc_packed_sort(preds: jax.Array, y: jax.Array) -> jax.Array:
+    """Key-packed variant: ONE multi-operand ``lax.sort`` over sortable
+    score bits carries the labels along (the gather is fused into the sort)
+    and tie runs come from integer bit-equality."""
+    n = preds.shape[0]
+    keys = _sortable_score_keys(preds)
+    sorted_keys, sy = jax.lax.sort((keys, y), num_keys=1)
+    run_id = _tie_run_ids(sorted_keys)
+    mid = _sorted_midranks(run_id, n)
+    return _auroc_from_rank_sum(jnp.sum(mid * sy), jnp.sum(sy), n)
+
+
 @high_precision
 def binary_auroc_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
     """Exact binary AUROC via midranks. Returns NaN when a class is empty."""
@@ -53,12 +122,12 @@ def binary_auroc_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
     y = jnp.asarray(target).reshape(-1).astype(jnp.float32)
     if preds.shape[0] == 0:  # empty shard: no data ⇒ undefined, like an empty class
         return jnp.asarray(jnp.nan, dtype=jnp.float32)
-    ranks = midranks(preds)
-    n_pos = jnp.sum(y)
-    n_neg = y.shape[0] - n_pos
-    u = jnp.sum(ranks * y) - n_pos * (n_pos + 1.0) * 0.5
-    denom = n_pos * n_neg
-    return jnp.where(denom > 0, u / jnp.maximum(denom, 1.0), jnp.nan)
+    variant = _autotune.dispatch("auroc_sort", (preds, y))
+    if variant == "single_sort":
+        return _auroc_single_sort(preds, y)
+    if variant == "packed_sort":
+        return _auroc_packed_sort(preds, y)
+    return _auroc_midranks(preds, y)
 
 
 @high_precision
@@ -73,18 +142,42 @@ def binary_average_precision_sorted(preds: jax.Array, target: jax.Array) -> jax.
     n = preds.shape[0]
     if n == 0:  # empty shard: no data ⇒ undefined, like a positives-free input
         return jnp.asarray(jnp.nan, dtype=jnp.float32)
+    if _autotune.dispatch("ap_sort", (preds, y)) == "packed_sort":
+        return _ap_packed_sort(preds, y)
     order = jnp.argsort(-preds)
     ys = y[order]
     ps = preds[order]
+    run_id = _tie_run_ids(ps)
+    return _ap_from_descending(ys, run_id, n)
+
+
+def _ap_from_descending(ys: jax.Array, run_id: jax.Array, n: int) -> jax.Array:
+    """AP from descending-sorted labels + tie-run ids (shared tail of both
+    formulations: run-END precisions are intra-run-order invariant)."""
     cum_tp = jnp.cumsum(ys)
     cnt = jnp.arange(1, n + 1, dtype=jnp.float32)
-    run_id = _tie_run_ids(ps)
     run_tp_end = jax.ops.segment_max(cum_tp, run_id, num_segments=n)
     run_cnt_end = jax.ops.segment_max(cnt, run_id, num_segments=n)
     prec_end = run_tp_end[run_id] / run_cnt_end[run_id]  # precision at i's group end
     n_pos = cum_tp[-1]
     ap = jnp.sum(ys * prec_end) / jnp.maximum(n_pos, 1.0)
     return jnp.where(n_pos > 0, ap, jnp.nan)
+
+
+def _ap_argsort(preds: jax.Array, y: jax.Array) -> jax.Array:
+    """Reference formulation: descending argsort + two gathers."""
+    order = jnp.argsort(-preds)
+    return _ap_from_descending(y[order], _tie_run_ids(preds[order]), preds.shape[0])
+
+
+def _ap_packed_sort(preds: jax.Array, y: jax.Array) -> jax.Array:
+    """Key-packed variant: complemented sortable score bits sort descending
+    in ONE multi-operand ``lax.sort`` carrying the labels; tie runs come
+    from integer bit-equality (run-end precisions are unchanged by the
+    intra-run order, so the value matches the argsort path)."""
+    desc_keys = ~_sortable_score_keys(preds)
+    sorted_keys, ys = jax.lax.sort((desc_keys, y), num_keys=1)
+    return _ap_from_descending(ys, _tie_run_ids(sorted_keys), preds.shape[0])
 
 
 def _one_vs_rest(preds: jax.Array, target: jax.Array, num_classes: int) -> jax.Array:
@@ -137,6 +230,20 @@ def multiclass_average_precision_sorted(
         w = support / jnp.maximum(support.sum(), 1.0)
         return jnp.sum(jnp.where(valid, scores * w, 0.0))
     raise ValueError(f"Unsupported average {average!r} for traced AP")
+
+
+# ---------------------------------------------------------------- autotuner
+# Variant registration (consulted only while METRICS_TPU_AUTOTUNE is armed).
+# Exactness contract: the non-reference formulations reduce identical terms
+# in a different order, so they declare a small float-summation tolerance;
+# registered fns take the normalized (float32[n], float32[n]) signature the
+# public entry points establish before dispatching.
+_SORT_TOL = 1e-4
+_autotune.register_variant("auroc_sort", "midranks", _auroc_midranks, reference=True)
+_autotune.register_variant("auroc_sort", "single_sort", _auroc_single_sort, tolerance=_SORT_TOL)
+_autotune.register_variant("auroc_sort", "packed_sort", _auroc_packed_sort, tolerance=_SORT_TOL)
+_autotune.register_variant("ap_sort", "argsort", _ap_argsort, reference=True)
+_autotune.register_variant("ap_sort", "packed_sort", _ap_packed_sort, tolerance=_SORT_TOL)
 
 
 __all__ = [

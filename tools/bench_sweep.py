@@ -1018,6 +1018,34 @@ def main() -> None:
     except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
         print(json.dumps({"metric": "cold_start(progcache)", "error": str(err)[:160]}))
 
+    # kernel_attack row (ISSUE 20): the roofline-guided variant sweep over
+    # every registered heavy kernel — kernel_min_winner_vs_baseline is what
+    # sweep_regress gates at --kernel-utilization-floor (default 1.0: an
+    # installed winner may never score below the reference floor); the
+    # per-kernel winner/baseline walls and utilizations ride along.
+    # Methodology lives in bench.py bench_kernel_attack, reused verbatim.
+    try:
+        import bench as _bench
+
+        probe = _bench.bench_kernel_attack()
+        row = {
+            "metric": "kernel_attack(autotune)",
+            "mode": "sweep",
+            # full variant sweeps per second: the one-time cold-process cost
+            # of the whole attack (a warm boot restores the table and pays 0)
+            "updates_per_s": probe["sweeps_per_s"],
+            "sweep_wall_ms": probe["sweep_wall_ms"],
+            "kernel_min_winner_vs_baseline": probe["kernel_min_winner_vs_baseline"],
+            "kernels": probe["kernels"],
+            "sweeps": probe["sweeps"],
+            "candidates": probe["candidates"],
+            "disqualified": probe["disqualified"],
+        }
+        results.append(row)
+        print(json.dumps(row))
+    except Exception as err:  # noqa: BLE001 — a failed bench row is recorded in the row, never silently dropped
+        print(json.dumps({"metric": "kernel_attack(autotune)", "error": str(err)[:160]}))
+
     # drift_report row (ISSUE 15): one PSI/KS drift computation over two
     # 4096-sample vectors — the psi/ks columns double as a determinism
     # canary (fixed seed, fixed shift: a changed score means the binning

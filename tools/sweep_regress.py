@@ -43,7 +43,9 @@ previously-present metric disappeared, a tenant-arena row fell below the
 100k tier) or started retracing per add (ISSUE 17), or a cold-start row's
 ``warm_boot_compiles`` rose above ``--warm-boot-compile-ceiling`` (default
 0.0 — a warmed replica must re-enter the fleet compiling nothing;
-ISSUE 18).
+ISSUE 18), or a kernel-attack row's ``kernel_min_winner_vs_baseline`` fell
+below ``--kernel-utilization-floor`` (default 1.0 — the autotuner may
+never install a variant scoring under the reference floor; ISSUE 20).
 """
 from __future__ import annotations
 
@@ -70,6 +72,7 @@ def compare(
     arena_speedup_floor: float = 10.0,
     warm_boot_compile_ceiling: float = 0.0,
     ingest_shed_ceiling: float = 0.6,
+    kernel_utilization_floor: float = 1.0,
 ) -> list:
     old_rows = {r["metric"]: r for r in old["rows"] if "updates_per_s" in r}
     new_rows = {r["metric"]: r for r in new["rows"] if "updates_per_s" in r}
@@ -238,6 +241,22 @@ def compare(
                 "quarantined broke — rows were double-counted or dropped "
                 "from the books)"
             )
+        # ---- the kernel-attack gate (ISSUE 20): a row that archived
+        # kernel_min_winner_vs_baseline made the autotuner's promise — an
+        # installed winner scores at least the reference variant on the
+        # roofline (the reference is the selection floor by construction).
+        # A ratio below the floor means the selection machinery installed a
+        # slower formulation: the sweep's scoring or install logic broke ----
+        new_kmin = new_row.get("kernel_min_winner_vs_baseline")
+        if new_kmin is not None and float(new_kmin) < kernel_utilization_floor:
+            old_kmin = old_row.get("kernel_min_winner_vs_baseline")
+            problems.append(
+                f"{name}: kernel_min_winner_vs_baseline "
+                f"{'(unrecorded)' if old_kmin is None else f'{float(old_kmin):.3f}'} -> "
+                f"{float(new_kmin):.3f} (below the {kernel_utilization_floor:g} floor — "
+                "the autotuner installed a variant scoring under the "
+                "reference; the selection floor broke)"
+            )
     return problems
 
 
@@ -301,7 +320,8 @@ _USAGE = (
     "[--tail-threshold X] [--wire-hidden-floor X] "
     "[--close-collective-ceiling X] [--ingraph-collective-ceiling X] "
     "[--arena-speedup-floor X] [--warm-boot-compile-ceiling X] "
-    "[--ingest-shed-ceiling X] [--explain] OLD.json NEW.json"
+    "[--ingest-shed-ceiling X] [--kernel-utilization-floor X] "
+    "[--explain] OLD.json NEW.json"
 )
 
 
@@ -319,7 +339,8 @@ def main(argv) -> int:
     argv, arena_floor, ok7 = _pop_flag(argv, "--arena-speedup-floor", 10.0)
     argv, warm_boot_ceiling, ok8 = _pop_flag(argv, "--warm-boot-compile-ceiling", 0.0)
     argv, ingest_shed_ceiling, ok9 = _pop_flag(argv, "--ingest-shed-ceiling", 0.6)
-    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok7 and ok8 and ok9) or len(argv) != 2:
+    argv, kernel_floor, ok10 = _pop_flag(argv, "--kernel-utilization-floor", 1.0)
+    if not (ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok7 and ok8 and ok9 and ok10) or len(argv) != 2:
         print(_USAGE)
         return 2
     with open(argv[0]) as f_old, open(argv[1]) as f_new:
@@ -336,6 +357,7 @@ def main(argv) -> int:
         arena_floor,
         warm_boot_ceiling,
         ingest_shed_ceiling,
+        kernel_floor,
     )
     if problems:
         print("\n".join(problems))
